@@ -1,0 +1,141 @@
+"""Benchmark: HPO trial throughput of the TPU-native framework.
+
+Workload (mirrors BASELINE.json's quality/throughput framing): a fixed-shape
+transformer regression trial (glucose-like windowed series, 5 epochs, batch 32)
+run as an HPO sweep over lr/weight-decay. Fixed architecture keeps every trial
+on one XLA executable, so the sweep amortizes a single compile — the
+compile-cache story that makes HPO viable on TPU (SURVEY.md §7 hard parts).
+
+Baseline: the same trial implemented in torch (the reference's stack is
+torch + Ray on CUDA; this image has torch-CPU), run sequentially the way the
+reference runs one trial per device. ``vs_baseline`` = our trials/hour divided
+by torch's extrapolated trials/hour on this host.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+NUM_TRIALS = 8
+NUM_EPOCHS = 5
+BATCH = 32
+D_MODEL = 64
+LAYERS = 2
+HEADS = 4
+TORCH_TRIALS_MEASURED = 2
+
+
+def _data():
+    from distributed_machine_learning_tpu.data import glucose_like_data
+
+    return glucose_like_data(num_steps=20_000, num_features=16)
+
+
+def run_ours(train, val) -> float:
+    """Returns trials/hour for the full sweep (includes compile time)."""
+    from distributed_machine_learning_tpu import tune
+
+    space = {
+        "model": "transformer",
+        "d_model": D_MODEL,
+        "num_heads": HEADS,
+        "num_layers": LAYERS,
+        "dim_feedforward": D_MODEL * 2,
+        "dropout": 0.1,
+        "learning_rate": tune.loguniform(1e-4, 1e-2),
+        "weight_decay": tune.loguniform(1e-6, 1e-3),
+        "num_epochs": NUM_EPOCHS,
+        "batch_size": BATCH,
+        "max_seq_length": 128,
+        "loss_function": "mse",
+    }
+    t0 = time.time()
+    analysis = tune.run(
+        tune.with_parameters(tune.train_regressor, train_data=train, val_data=val),
+        space,
+        metric="validation_mape",
+        mode="min",
+        num_samples=NUM_TRIALS,
+        storage_path="/tmp/bench_results",
+        name=f"bench_{int(t0)}",
+        verbose=0,
+    )
+    wall = time.time() - t0
+    done = analysis.num_terminated()
+    if done != NUM_TRIALS:
+        print(f"WARNING: only {done}/{NUM_TRIALS} trials finished",
+              file=sys.stderr)
+    return done * 3600.0 / wall
+
+
+def run_torch_baseline(train, val) -> float:
+    """Sequential torch-CPU trials of the same shape; extrapolated trials/hour."""
+    import numpy as np
+    import torch
+    import torch.nn as nn
+
+    torch.manual_seed(0)
+    device = "cpu"
+
+    class Baseline(nn.Module):
+        def __init__(self, in_features):
+            super().__init__()
+            self.proj = nn.Linear(in_features, D_MODEL)
+            enc = nn.TransformerEncoderLayer(
+                d_model=D_MODEL, nhead=HEADS, dim_feedforward=D_MODEL * 2,
+                dropout=0.1, batch_first=True)
+            self.encoder = nn.TransformerEncoder(enc, num_layers=LAYERS)
+            self.head = nn.Linear(D_MODEL, 1)
+
+        def forward(self, x):
+            h = self.encoder(self.proj(x))
+            return self.head(h[:, -1, :])
+
+    x = torch.from_numpy(train.x)
+    y = torch.from_numpy(train.y)
+    n = len(x)
+    times = []
+    for trial in range(TORCH_TRIALS_MEASURED):
+        t0 = time.time()
+        model = Baseline(train.x.shape[-1]).to(device)
+        opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+        loss_fn = nn.MSELoss()
+        for epoch in range(NUM_EPOCHS):
+            perm = torch.randperm(n)
+            for i in range(0, n - BATCH + 1, BATCH):
+                sel = perm[i : i + BATCH]
+                opt.zero_grad()
+                out = model(x[sel])
+                loss = loss_fn(out, y[sel])
+                loss.backward()
+                opt.step()
+        with torch.no_grad():
+            model.eval()
+            _ = model(torch.from_numpy(val.x))
+        times.append(time.time() - t0)
+    per_trial = sum(times) / len(times)
+    return 3600.0 / per_trial
+
+
+def main():
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", "/tmp/dml_tpu_jax_cache"
+    )
+    train, val = _data()
+    ours = run_ours(train, val)
+    baseline = run_torch_baseline(train, val)
+    print(json.dumps({
+        "metric": "hpo_trials_per_hour_transformer_glucose",
+        "value": round(ours, 2),
+        "unit": "trials/hour",
+        "vs_baseline": round(ours / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

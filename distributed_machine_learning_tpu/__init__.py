@@ -1,0 +1,15 @@
+"""distributed_machine_learning_tpu: a TPU-native distributed HPO framework.
+
+A brand-new JAX/XLA framework with the capabilities of
+`Ravikiran-Bhonagiri/Distributed-Machine-Learning` (see SURVEY.md): many
+concurrent jit-compiled regression-training trials packed onto TPU cores under
+native ASHA/PBT/median schedulers with random/grid/Bayesian search, per-epoch
+metric streaming, pytree checkpoint/restore, and an experiment store with
+best-config analysis — no Ray, no torch in the loop.
+"""
+
+from distributed_machine_learning_tpu import data, models, ops, tune, utils
+
+__version__ = "0.1.0"
+
+__all__ = ["data", "models", "ops", "tune", "utils", "__version__"]

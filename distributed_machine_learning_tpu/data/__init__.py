@@ -1,0 +1,27 @@
+from distributed_machine_learning_tpu.data import features
+from distributed_machine_learning_tpu.data.loader import (
+    Dataset,
+    get_dataset,
+    load_dataframe_from_npy,
+    make_regression_dataset,
+    split_into_intervals,
+    train_val_split,
+)
+from distributed_machine_learning_tpu.data.synthetic import (
+    california_housing_data,
+    dummy_regression_data,
+    glucose_like_data,
+)
+
+__all__ = [
+    "features",
+    "Dataset",
+    "get_dataset",
+    "load_dataframe_from_npy",
+    "make_regression_dataset",
+    "split_into_intervals",
+    "train_val_split",
+    "california_housing_data",
+    "dummy_regression_data",
+    "glucose_like_data",
+]

@@ -1,0 +1,60 @@
+"""Wearable-sensor feature-name configuration.
+
+Capability parity with the reference's `config.py:2-78` (heart-rate / sleep /
+intensity / steps feature lists at rolling windows plus temporal sin/cos
+encodings) and the assembly at `ray-tune-hpo-regression.py:13-19`.  The names
+are generated from the window grid rather than hand-enumerated, which yields the
+same shape of feature surface without copying the reference's literal tables.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+ROLLING_WINDOWS_MIN = (15, 30, 60, 120, 240, 480, 720, 1440)
+
+
+def _rolling(base: str, stats=("mean", "std")) -> List[str]:
+    return [f"{base}_{stat}_{w}min" for w in ROLLING_WINDOWS_MIN for stat in stats]
+
+
+def sensor_features(base: str) -> List[str]:
+    """Raw reading + rolling mean/std at each window for one sensor channel."""
+    return [base] + _rolling(base)
+
+
+heart_rate_features_1: List[str] = [sensor_features("heart_rate")[0]]
+heart_rate_features_2: List[str] = _rolling("heart_rate")
+sleep_features_1: List[str] = [sensor_features("sleep")[0]]
+sleep_features_2: List[str] = _rolling("sleep")
+intensity_features_1: List[str] = [sensor_features("intensity")[0]]
+intensity_features_2: List[str] = _rolling("intensity")
+steps_features_1: List[str] = [sensor_features("steps")[0]]
+steps_features_2: List[str] = _rolling("steps")
+
+# sin/cos encodings of time-of-day / day-of-week / day-of-month / month.
+temporal_features: List[str] = [
+    f"{unit}_{fn}"
+    for unit in ("minute_of_day", "day_of_week", "day_of_month", "month")
+    for fn in ("sin", "cos")
+]
+
+# Assembly parity with `ray-tune-hpo-regression.py:13-19`:
+# features_1 = raw sensor channels + temporal; features = everything.
+features_1: List[str] = (
+    heart_rate_features_1
+    + sleep_features_1
+    + intensity_features_1
+    + steps_features_1
+    + temporal_features
+)
+
+features: List[str] = (
+    heart_rate_features_1 + heart_rate_features_2
+    + sleep_features_1 + sleep_features_2
+    + intensity_features_1 + intensity_features_2
+    + steps_features_1 + steps_features_2
+    + temporal_features
+)
+
+LABEL_COLUMN = "Historic Glucose mg/dL"

@@ -1,0 +1,171 @@
+"""Data pipeline: .npy DataFrame loading, windowing, splits, and batch iteration.
+
+Capability parity with the reference's L0 data layer (SURVEY.md §1):
+
+* ``load_dataframe_from_npy`` — pickled ``{"columns": ..., "data": ...}`` dict
+  in a ``.npy`` file -> DataFrame (`ray-tune-hpo-regression.py:414-418`).
+* ``split_into_intervals`` — strided sliding-window segmentation
+  (`:403-411`), here a zero-copy ``sliding_window_view`` instead of the
+  reference's python loop over intervals.
+* ``make_regression_dataset`` / ``get_dataset`` — the `get_data_loaders`
+  pipeline (`:423-459`): feature selection, column dedup, label extraction,
+  windowing (interval=96, stride=96), deterministic 70/30 split.
+* ``Dataset`` — an ndarray-backed batch source replacing torch
+  ``TensorDataset``/``DataLoader``: shuffled batching with a dropped remainder
+  produces the static shapes jit wants, and ``as_jax`` stages the whole set to
+  device once (HBM-resident epochs; no per-batch host->device copies, unlike
+  the reference's per-batch ``.to(device)`` at `:327`).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+from distributed_machine_learning_tpu.data import features as F
+from distributed_machine_learning_tpu.utils.seeding import rng_from
+
+
+def load_dataframe_from_npy(path: str):
+    """Load a DataFrame stored as a pickled {columns, data} dict in .npy."""
+    import pandas as pd
+
+    payload = np.load(path, allow_pickle=True).item()
+    return pd.DataFrame(payload["data"], columns=payload["columns"])
+
+
+def split_into_intervals(
+    array: np.ndarray, interval: int, stride: int
+) -> np.ndarray:
+    """[T, F] -> [num_intervals, interval, F] with the given stride.
+
+    Vectorized with stride tricks (the reference loops in python, `:403-411`).
+    """
+    if array.ndim == 1:
+        array = array[:, None]
+    T = array.shape[0]
+    if T < interval:
+        return np.empty((0, interval, array.shape[1]), dtype=array.dtype)
+    windows = np.lib.stride_tricks.sliding_window_view(array, interval, axis=0)
+    # sliding_window_view gives [T-interval+1, F, interval]; stride + reorder.
+    return np.ascontiguousarray(np.transpose(windows[::stride], (0, 2, 1)))
+
+
+@dataclass
+class Dataset:
+    """A fully materialized (x, y) array pair with seeded batch iteration."""
+
+    x: np.ndarray
+    y: np.ndarray
+
+    def __post_init__(self):
+        if len(self.x) != len(self.y):
+            raise ValueError(f"x/y length mismatch: {len(self.x)} vs {len(self.y)}")
+
+    def __len__(self) -> int:
+        return len(self.x)
+
+    @property
+    def num_features(self) -> int:
+        return int(self.x.shape[-1])
+
+    def batches(
+        self,
+        batch_size: int,
+        shuffle: bool = True,
+        seed_parts: Sequence = (0,),
+        drop_remainder: bool = True,
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Yield (x, y) batches. Static batch shape by default (jit-friendly)."""
+        n = len(self)
+        idx = np.arange(n)
+        if shuffle:
+            rng_from(*seed_parts).shuffle(idx)
+        end = (n // batch_size) * batch_size if drop_remainder else n
+        if end == 0:
+            end = n  # tiny dataset: emit one ragged batch rather than nothing
+        for start in range(0, end, batch_size):
+            sel = idx[start : start + batch_size]
+            yield self.x[sel], self.y[sel]
+
+    def num_batches(self, batch_size: int, drop_remainder: bool = True) -> int:
+        n = len(self)
+        return max(n // batch_size if drop_remainder else -(-n // batch_size), 1)
+
+    def as_jax(self, device=None):
+        """Stage the full arrays onto a device once (HBM-resident epochs)."""
+        import jax
+
+        if device is not None:
+            return (
+                jax.device_put(self.x, device),
+                jax.device_put(self.y, device),
+            )
+        return jax.numpy.asarray(self.x), jax.numpy.asarray(self.y)
+
+
+def train_val_split(
+    x: np.ndarray,
+    y: np.ndarray,
+    val_fraction: float = 0.3,
+    seed: int = 42,
+    shuffle: bool = True,
+) -> Tuple[Dataset, Dataset]:
+    """Deterministic split, parity with `train_test_split(..., random_state=42)` (`:449`)."""
+    n = len(x)
+    idx = np.arange(n)
+    if shuffle:
+        rng_from("split", seed).shuffle(idx)
+    n_val = int(round(n * val_fraction))
+    val_idx, train_idx = idx[:n_val], idx[n_val:]
+    return Dataset(x[train_idx], y[train_idx]), Dataset(x[val_idx], y[val_idx])
+
+
+def make_regression_dataset(
+    features_df,
+    labels_df,
+    feature_columns: Optional[Sequence[str]] = None,
+    label_column: str = F.LABEL_COLUMN,
+    interval: int = 96,
+    stride: int = 96,
+    val_fraction: float = 0.3,
+    seed: int = 42,
+) -> Tuple[Dataset, Dataset]:
+    """The reference's `get_data_loaders` pipeline (`:423-459`), DataFrame -> Datasets.
+
+    Selects feature columns (deduplicating, `:442-443`), extracts the label,
+    windows both with (interval, stride), labels each window with its last-step
+    glucose value, and splits 70/30.
+    """
+    if feature_columns is not None:
+        cols = [c for c in dict.fromkeys(feature_columns) if c in features_df.columns]
+        features_df = features_df[cols]
+    features_df = features_df.loc[:, ~features_df.columns.duplicated()]
+
+    x = features_df.to_numpy(dtype=np.float32)
+    y = labels_df[label_column].to_numpy(dtype=np.float32)
+
+    xw = split_into_intervals(x, interval, stride)
+    yw = split_into_intervals(y, interval, stride)[:, -1, 0:1]  # last-step label
+    return train_val_split(xw, yw, val_fraction=val_fraction, seed=seed)
+
+
+def get_dataset(
+    patient_id: str,
+    data_dir: str,
+    feature_columns: Optional[Sequence[str]] = None,
+    **kwargs,
+) -> Tuple[Dataset, Dataset]:
+    """Load `{data_dir}/{id}_features.npy` + `{id}_labels.npy` and build datasets.
+
+    Path scheme generalizes the reference's hard-coded home-dir paths
+    (`:434-435`) into a configurable ``data_dir``.
+    """
+    fdf = load_dataframe_from_npy(os.path.join(data_dir, f"{patient_id}_features.npy"))
+    ldf = load_dataframe_from_npy(os.path.join(data_dir, f"{patient_id}_labels.npy"))
+    if feature_columns is None:
+        feature_columns = F.features
+    return make_regression_dataset(fdf, ldf, feature_columns=feature_columns, **kwargs)

@@ -1,0 +1,97 @@
+"""Synthetic and public datasets for smoke tests and benchmarks.
+
+* ``dummy_regression_data`` — parity with the reference's `create_dummy_data`
+  (`/root/reference/ray-tune-hpo-regression-sample.py:28-55`): random
+  ``(1000, 50, 10)`` sequence regression set with an 80/20 split.
+* ``glucose_like_data`` — a learnable synthetic stand-in for the wearable
+  glucose workload (the real patient ``.npy`` files are private): smooth
+  sensor-driven latent + noise, windowed like the real pipeline.
+* ``california_housing_data`` — sklearn California Housing (BASELINE.json
+  config 1), gated on sklearn availability.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from distributed_machine_learning_tpu.data.loader import Dataset, train_val_split
+from distributed_machine_learning_tpu.utils.seeding import rng_from
+
+
+def dummy_regression_data(
+    num_samples: int = 1000,
+    seq_len: int = 50,
+    num_features: int = 10,
+    val_fraction: float = 0.2,
+    seed: int = 0,
+) -> Tuple[Dataset, Dataset]:
+    """Random sequence-regression data in the reference smoke-test shape."""
+    rng = rng_from("dummy", seed)
+    x = rng.standard_normal((num_samples, seq_len, num_features)).astype(np.float32)
+    # Learnable target (not pure noise like the reference): weighted sum of the
+    # last few steps, so validation loss actually responds to training.
+    w = rng.standard_normal((num_features,)).astype(np.float32)
+    y = (x[:, -5:, :] @ w).mean(axis=1, keepdims=True) + 0.1 * rng.standard_normal(
+        (num_samples, 1)
+    ).astype(np.float32)
+    return train_val_split(x, y, val_fraction=val_fraction, seed=seed, shuffle=False)
+
+
+def glucose_like_data(
+    num_steps: int = 20_000,
+    num_features: int = 16,
+    interval: int = 96,
+    stride: int = 96,
+    val_fraction: float = 0.3,
+    seed: int = 7,
+) -> Tuple[Dataset, Dataset]:
+    """Windowed synthetic wearable-sensor series with a forecastable glucose target."""
+    from distributed_machine_learning_tpu.data.loader import split_into_intervals
+
+    rng = rng_from("glucose", seed)
+    t = np.arange(num_steps, dtype=np.float32)
+    # Sensor channels: daily/meal-cycle sinusoids + AR noise.
+    phases = rng.uniform(0, 2 * np.pi, num_features)
+    periods = rng.choice([96.0, 288.0, 1440.0], num_features)
+    sensors = np.sin(2 * np.pi * t[:, None] / periods[None, :] + phases[None, :])
+    noise = rng.standard_normal((num_steps, num_features)).astype(np.float32)
+    for i in range(1, num_steps):  # AR(1) smoothing
+        noise[i] = 0.9 * noise[i - 1] + 0.1 * noise[i]
+    x = (sensors + 0.5 * noise).astype(np.float32)
+
+    w = rng.standard_normal((num_features,)).astype(np.float32) / np.sqrt(num_features)
+    latent = x @ w
+    glucose = 120.0 + 30.0 * np.tanh(np.convolve(latent, np.ones(12) / 12, mode="same"))
+    glucose = (glucose + rng.standard_normal(num_steps) * 2.0).astype(np.float32)
+
+    xw = split_into_intervals(x, interval, stride)
+    yw = split_into_intervals(glucose, interval, stride)[:, -1, 0:1]
+    return train_val_split(xw, yw, val_fraction=val_fraction, seed=seed)
+
+
+def california_housing_data(
+    val_fraction: float = 0.25, seed: int = 0
+) -> Tuple[Dataset, Dataset]:
+    """sklearn California Housing, standardized; falls back to synthetic tabular."""
+    try:
+        from sklearn.datasets import fetch_california_housing
+
+        bunch = fetch_california_housing()
+        x = bunch.data.astype(np.float32)
+        y = bunch.target.astype(np.float32)[:, None]
+    except Exception:
+        x, y = _synthetic_tabular(seed)
+    x = (x - x.mean(axis=0)) / (x.std(axis=0) + 1e-8)
+    return train_val_split(x, y, val_fraction=val_fraction, seed=seed)
+
+
+def _synthetic_tabular(seed: int, n: int = 20_000, f: int = 8):
+    rng = rng_from("tabular", seed)
+    x = rng.standard_normal((n, f)).astype(np.float32)
+    w = rng.standard_normal((f,)).astype(np.float32)
+    y = (x @ w + 0.3 * np.sin(3 * x[:, 0]) + 0.1 * rng.standard_normal(n)).astype(
+        np.float32
+    )[:, None]
+    return x, y

@@ -1,0 +1,98 @@
+"""Model zoo + config->model factory.
+
+``build_model(config)`` constructs a model from a trial config dict, deriving
+architecture fields from the config keys the reference's search spaces use
+(`/root/reference/ray-tune-hpo-regression.py:379-400`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from distributed_machine_learning_tpu.models.cnn import CNN1DRegressor
+from distributed_machine_learning_tpu.models.mlp import MLPRegressor
+from distributed_machine_learning_tpu.models.resnet import (
+    ResNet18Regressor,
+    ResNetRegressor,
+)
+from distributed_machine_learning_tpu.models.transformer import (
+    SimpleTransformerRegressor,
+    TransformerRegressor,
+)
+from distributed_machine_learning_tpu.utils.registry import Registry
+
+models: Registry = Registry("model")
+
+
+@models.register("mlp")
+def _build_mlp(config: Dict[str, Any]):
+    return MLPRegressor(
+        hidden_sizes=tuple(config.get("hidden_sizes", (128, 64))),
+        dropout_rate=config.get("dropout", 0.0),
+        out_features=config.get("out_features", 1),
+    )
+
+
+@models.register("cnn1d")
+def _build_cnn(config: Dict[str, Any]):
+    return CNN1DRegressor(
+        channels=tuple(config.get("channels", (32, 64))),
+        kernel_size=config.get("kernel_size", 5),
+        dropout_rate=config.get("dropout", 0.0),
+        head_hidden=config.get("head_hidden", 64),
+        out_features=config.get("out_features", 1),
+    )
+
+
+@models.register("transformer")
+def _build_transformer(config: Dict[str, Any]):
+    d_model = config.get("d_model", 64)
+    return TransformerRegressor(
+        d_model=d_model,
+        num_heads=config.get("num_heads", 4),
+        num_layers=config.get("num_encoder_layers", config.get("num_layers", 2)),
+        dim_feedforward=config.get("dim_feedforward", d_model * 2),
+        dropout_rate=config.get("dropout", 0.1),
+        attention_type=config.get("attention_type", "scaled_dot_product"),
+        key_dim_scaling=config.get("key_dim_scaling", 0.5),
+        depthwise_separable_conv=config.get("depthwise_separable_conv", False),
+        attn_kernel_size=config.get("attn_kernel_size", 3),
+        stochastic_depth_rate=config.get("stochastic_depth_rate", 0.0),
+        shared_weights=config.get("shared_weights", False),
+        max_seq_length=config.get("max_seq_length", 2000),
+        out_features=config.get("out_features", 1),
+    )
+
+
+@models.register("simple_transformer")
+def _build_simple_transformer(config: Dict[str, Any]):
+    return SimpleTransformerRegressor(
+        d_model=config.get("d_model", 64),
+        num_heads=config.get("num_heads", 4),
+        num_layers=config.get("num_layers", 2),
+        dim_feedforward=config.get("dim_feedforward", 256),
+        dropout_rate=config.get("dropout", 0.1),
+        max_seq_length=config.get("max_seq_length", 2000),
+    )
+
+
+@models.register("resnet18")
+def _build_resnet18(config: Dict[str, Any]):
+    return ResNet18Regressor(out_features=config.get("out_features", 1))
+
+
+def build_model(config: Dict[str, Any]):
+    """Construct a model from a trial config; ``config['model']`` picks the family."""
+    return models.get(config.get("model", "transformer"))(config)
+
+
+__all__ = [
+    "models",
+    "build_model",
+    "MLPRegressor",
+    "CNN1DRegressor",
+    "TransformerRegressor",
+    "SimpleTransformerRegressor",
+    "ResNetRegressor",
+    "ResNet18Regressor",
+]

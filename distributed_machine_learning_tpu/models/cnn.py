@@ -1,0 +1,30 @@
+"""1-D CNN tabular/time-series regressor (BASELINE.json config 3, PBT workload)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CNN1DRegressor(nn.Module):
+    """Conv1d stack over [batch, seq, features] with global average pooling."""
+
+    channels: Sequence[int] = (32, 64)
+    kernel_size: int = 5
+    dropout_rate: float = 0.0
+    head_hidden: int = 64
+    out_features: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        if x.ndim == 2:  # tabular -> single-step sequence
+            x = x[:, None, :]
+        for ch in self.channels:
+            x = nn.Conv(int(ch), kernel_size=(self.kernel_size,), padding="SAME")(x)
+            x = nn.relu(x)
+            x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
+        x = x.mean(axis=1)  # global average pool over sequence
+        x = nn.relu(nn.Dense(self.head_hidden)(x))
+        return nn.Dense(self.out_features)(x)

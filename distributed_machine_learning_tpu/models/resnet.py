@@ -1,0 +1,67 @@
+"""ResNet regression models (BASELINE.json config 5: ResNet-18 regression head).
+
+Standard pre-activation-free ResNet-v1 basic blocks in flax.  BatchNorm state is
+carried as a ``batch_stats`` collection; the trainable plumbs it through the
+train step (see ``tune.trainable``).  Works on [B, H, W, C] images; a 1-D
+variant wraps time-series inputs as [B, S, 1, C].
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    features: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9)
+        residual = x
+        y = nn.Conv(self.features, (3, 3), strides=(self.strides, self.strides),
+                    padding="SAME", use_bias=False)(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False)(y)
+        y = norm()(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.features, (1, 1),
+                               strides=(self.strides, self.strides),
+                               use_bias=False, name="proj")(residual)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNetRegressor(nn.Module):
+    """ResNet-v1 with a regression head. stage_sizes=(2,2,2,2) == ResNet-18."""
+
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    width: int = 64
+    out_features: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        if x.ndim == 3:  # [B, S, F] time series -> pseudo-image [B, S, 1, F]
+            x = x[:, :, None, :]
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding="SAME",
+                    use_bias=False, name="stem")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if (i > 0 and j == 0) else 1
+                x = BasicBlock(self.width * (2 ** i), strides=strides,
+                               name=f"stage{i}_block{j}")(x, train=train)
+        x = x.mean(axis=(1, 2))  # global average pool
+        return nn.Dense(self.out_features, name="head")(x)
+
+
+def ResNet18Regressor(out_features: int = 1) -> ResNetRegressor:
+    return ResNetRegressor(stage_sizes=(2, 2, 2, 2), out_features=out_features)

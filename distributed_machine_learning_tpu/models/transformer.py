@@ -1,0 +1,155 @@
+"""Transformer regressors (flax.linen).
+
+* ``TransformerRegressor`` — the flagship configurable model, capability parity
+  with the reference's `TransformerModel`
+  (`/root/reference/ray-tune-hpo-regression.py:183-240`): input projection,
+  sin/cos positional encoding, N custom encoder layers, last-token pooling, and
+  the 5-layer ReLU MLP regression head (128-64-32-16-1).  The reference's dead
+  search-space knobs (`shared_weights`, `stochastic_depth_rate`,
+  `key_dim_scaling` — SURVEY.md §2 C17/C19) are implemented for real:
+  ``shared_weights`` runs ONE parameter set through ``nn.scan`` (ALBERT-style),
+  which also gives XLA a rolled loop (one layer compiled once) instead of N
+  unrolled layers — faster compiles, the key cost in HPO sweeps.
+* ``SimpleTransformerRegressor`` — smoke-test model, parity with
+  `SimpleTransformerModel` (`-sample.py:60-83`).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_machine_learning_tpu.models.layers import (
+    EncoderLayer,
+    PositionalEncoding,
+)
+
+
+class _ScanEncoderBody(nn.Module):
+    """nn.scan body adapter: EncoderLayer as a (carry, _) -> (carry, None) step."""
+
+    layer_kwargs: dict
+
+    @nn.compact
+    def __call__(self, carry, deterministic: bool = True):
+        out = EncoderLayer(name="layer", **self.layer_kwargs)(
+            carry, deterministic=deterministic
+        )
+        return out, None
+
+
+class RegressionHead(nn.Module):
+    """ReLU MLP head; default widths match the reference's fc1..fc5 (`:217-221`)."""
+
+    hidden_sizes: Sequence[int] = (128, 64, 32, 16)
+    out_features: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for width in self.hidden_sizes:
+            x = nn.relu(nn.Dense(width)(x))
+        return nn.Dense(self.out_features)(x)
+
+
+class TransformerRegressor(nn.Module):
+    d_model: int = 64
+    num_heads: int = 4
+    num_layers: int = 2
+    dim_feedforward: int = 128
+    dropout_rate: float = 0.1
+    attention_type: str = "scaled_dot_product"
+    key_dim_scaling: float = 0.5
+    depthwise_separable_conv: bool = False
+    attn_kernel_size: int = 3
+    stochastic_depth_rate: float = 0.0
+    shared_weights: bool = False
+    max_seq_length: int = 2000
+    head_hidden_sizes: Sequence[int] = (128, 64, 32, 16)
+    out_features: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        """x: [batch, seq, input_features] -> [batch, out_features].
+
+        input_size is derived from the data (x.shape[-1]) instead of the
+        reference's hard-coded ``input_size=10`` (`:271` vs its 81-column
+        pipeline — SURVEY.md §3.3 note).
+        """
+        layer_kwargs = dict(
+            d_model=self.d_model,
+            num_heads=self.num_heads,
+            dim_feedforward=self.dim_feedforward,
+            dropout_rate=self.dropout_rate,
+            attention_type=self.attention_type,
+            key_dim_scaling=self.key_dim_scaling,
+            depthwise_separable_conv=self.depthwise_separable_conv,
+            attn_kernel_size=self.attn_kernel_size,
+            stochastic_depth_rate=self.stochastic_depth_rate,
+        )
+
+        x = nn.Dense(self.d_model, name="input_projection")(x)
+        x = PositionalEncoding(
+            d_model=self.d_model,
+            dropout_rate=self.dropout_rate,
+            max_len=self.max_seq_length,
+        )(x, deterministic=deterministic)
+
+        if self.shared_weights:
+            # ALBERT-style: one EncoderLayer parameter set applied num_layers
+            # times, rolled with nn.scan so XLA compiles the body once.
+            ScanLayer = nn.scan(
+                _ScanEncoderBody,
+                variable_broadcast="params",
+                split_rngs={"params": False, "dropout": True},
+                length=self.num_layers,
+                in_axes=(nn.broadcast,),
+            )
+            x, _ = ScanLayer(layer_kwargs=layer_kwargs, name="shared_layer")(
+                x, deterministic
+            )
+        else:
+            for i in range(self.num_layers):
+                x = EncoderLayer(name=f"layer_{i}", **layer_kwargs)(
+                    x, deterministic=deterministic
+                )
+
+        x = x[:, -1, :]  # last-token pooling (`:235`)
+        return RegressionHead(
+            hidden_sizes=tuple(self.head_hidden_sizes),
+            out_features=self.out_features,
+            name="head",
+        )(x)
+
+
+class SimpleTransformerRegressor(nn.Module):
+    """Smoke-test model: stock encoder stack + last-token + single Linear head.
+
+    Parity: `SimpleTransformerModel` (`-sample.py:60-83`).
+    """
+
+    d_model: int = 64
+    num_heads: int = 4
+    num_layers: int = 2
+    dim_feedforward: int = 256
+    dropout_rate: float = 0.1
+    max_seq_length: int = 2000
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        x = nn.Dense(self.d_model, name="input_projection")(x)
+        x = PositionalEncoding(
+            d_model=self.d_model,
+            dropout_rate=self.dropout_rate,
+            max_len=self.max_seq_length,
+        )(x, deterministic=deterministic)
+        for i in range(self.num_layers):
+            x = EncoderLayer(
+                d_model=self.d_model,
+                num_heads=self.num_heads,
+                dim_feedforward=self.dim_feedforward,
+                dropout_rate=self.dropout_rate,
+                name=f"layer_{i}",
+            )(x, deterministic=deterministic)
+        return nn.Dense(1, name="head")(x[:, -1, :])

@@ -1,0 +1,20 @@
+from distributed_machine_learning_tpu.ops.attention import (
+    blockwise_attention,
+    dot_product_attention,
+    linear_attention,
+)
+from distributed_machine_learning_tpu.ops.losses import get_loss, losses
+from distributed_machine_learning_tpu.ops.optimizers import make_optimizer, optimizers
+from distributed_machine_learning_tpu.ops.schedules import get_schedule, schedules
+
+__all__ = [
+    "blockwise_attention",
+    "dot_product_attention",
+    "linear_attention",
+    "get_loss",
+    "losses",
+    "make_optimizer",
+    "optimizers",
+    "get_schedule",
+    "schedules",
+]

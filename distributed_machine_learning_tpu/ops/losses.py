@@ -1,0 +1,55 @@
+"""Loss registry.
+
+Parity with the reference's name->fn loss map (mse / mae / huber / mape,
+`/root/reference/ray-tune-hpo-regression.py:313-319`) and its custom MAPE loss
+(`:245-247`).  All losses are pure jax functions of ``(predictions, targets)``
+returning a scalar, so they fuse into the jitted train step.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+from distributed_machine_learning_tpu.utils.registry import Registry
+
+losses: Registry = Registry("loss")
+
+
+@losses.register("mse")
+def mse_loss(predictions: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean((predictions - targets) ** 2)
+
+
+@losses.register("mae")
+def mae_loss(predictions: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean(jnp.abs(predictions - targets))
+
+
+@losses.register("huber")
+def huber_loss(predictions: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    # delta=1.0 matches torch.nn.SmoothL1Loss defaults used by the reference.
+    return jnp.mean(optax.huber_loss(predictions, targets, delta=1.0))
+
+
+@losses.register("mape")
+def mape_loss(predictions: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Mean absolute percentage error ×100.
+
+    The reference divides by the *signed* target (`:245-247`), which makes the
+    training objective negative and unbounded below whenever targets < 0; we
+    use |t| (the standard MAPE definition and the clear intent — its glucose
+    targets are strictly positive, so the behaviors coincide on its data).
+    """
+    return jnp.mean(
+        jnp.abs(targets - predictions) / (jnp.abs(targets) + 1e-8)
+    ) * 100.0
+
+
+@losses.register("rmse")
+def rmse_loss(predictions: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sqrt(jnp.mean((predictions - targets) ** 2))
+
+
+def get_loss(name: str):
+    return losses.get(name)

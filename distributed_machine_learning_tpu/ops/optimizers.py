@@ -1,0 +1,92 @@
+"""Optimizer registry.
+
+Parity with the reference's name->class optimizer map (adam / adamw / sgd /
+rmsprop, `/root/reference/ray-tune-hpo-regression.py:253-258, 290-296`), fixed
+so that ``momentum`` is only forwarded to optimizers that accept it (the
+reference passed it unconditionally and TypeError'd on Adam/AdamW — SURVEY.md
+§2 C14).  Gradient clipping is composed here as an optax chain rather than an
+imperative call (`:338-339`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import optax
+
+from distributed_machine_learning_tpu.utils.registry import Registry
+
+optimizers: Registry = Registry("optimizer")
+
+ScalarOrSchedule = Union[float, optax.Schedule]
+
+
+@optimizers.register("adam")
+def adam(learning_rate: ScalarOrSchedule, weight_decay: float = 0.0, **_):
+    tx = optax.adam(learning_rate)
+    if weight_decay:
+        # Reference Adam ignores decoupled decay; emulate torch's L2-style
+        # `weight_decay` by adding wd * p to the gradient before the update.
+        tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    return tx
+
+
+@optimizers.register("adamw")
+def adamw(learning_rate: ScalarOrSchedule, weight_decay: float = 0.0, **_):
+    return optax.adamw(learning_rate, weight_decay=weight_decay)
+
+
+@optimizers.register("sgd")
+def sgd(
+    learning_rate: ScalarOrSchedule,
+    weight_decay: float = 0.0,
+    momentum: float = 0.0,
+    **_,
+):
+    tx = optax.sgd(learning_rate, momentum=momentum or None)
+    if weight_decay:
+        tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    return tx
+
+
+@optimizers.register("rmsprop")
+def rmsprop(
+    learning_rate: ScalarOrSchedule,
+    weight_decay: float = 0.0,
+    momentum: float = 0.0,
+    **_,
+):
+    tx = optax.rmsprop(learning_rate, momentum=momentum)
+    if weight_decay:
+        tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    return tx
+
+
+@optimizers.register("lamb")
+def lamb(learning_rate: ScalarOrSchedule, weight_decay: float = 0.0, **_):
+    return optax.lamb(learning_rate, weight_decay=weight_decay)
+
+
+@optimizers.register("adafactor")
+def adafactor(learning_rate: ScalarOrSchedule, weight_decay: float = 0.0, **_):
+    return optax.adafactor(learning_rate, weight_decay_rate=weight_decay or None)
+
+
+def make_optimizer(
+    name: str,
+    learning_rate: ScalarOrSchedule,
+    weight_decay: float = 0.0,
+    momentum: float = 0.0,
+    gradient_clipping: Optional[float] = None,
+) -> optax.GradientTransformation:
+    """Build an optax transformation from config values.
+
+    ``gradient_clipping`` > 0 prepends global-norm clipping, matching the
+    reference's ``clip_grad_norm_`` guard (`:338-339`).
+    """
+    tx = optimizers.get(name)(
+        learning_rate, weight_decay=weight_decay, momentum=momentum
+    )
+    if gradient_clipping and gradient_clipping > 0:
+        tx = optax.chain(optax.clip_by_global_norm(float(gradient_clipping)), tx)
+    return tx

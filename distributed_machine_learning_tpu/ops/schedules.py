@@ -1,0 +1,59 @@
+"""Learning-rate schedules.
+
+Parity with the reference's linear-warmup + linear-decay LambdaLR
+(`/root/reference/ray-tune-hpo-regression.py:299-310`), fixed to actually step
+per optimizer step (the reference stepped its step-based schedule once per
+epoch, `:348`).  Schedules are optax schedules: ``step -> lr`` scalars that
+trace cleanly under jit.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from distributed_machine_learning_tpu.utils.registry import Registry
+
+schedules: Registry = Registry("schedule")
+
+
+@schedules.register("constant")
+def constant_schedule(learning_rate: float, **_) -> optax.Schedule:
+    return optax.constant_schedule(learning_rate)
+
+
+@schedules.register("warmup_linear_decay")
+def warmup_linear_decay(
+    learning_rate: float,
+    warmup_steps: int = 0,
+    total_steps: int = 10_000,
+    **_,
+) -> optax.Schedule:
+    """Linear 0->lr over ``warmup_steps``, then linear lr->0 at ``total_steps``."""
+    warmup_steps = max(int(warmup_steps), 0)
+    decay_steps = max(int(total_steps) - warmup_steps, 1)
+    return optax.join_schedules(
+        [
+            optax.linear_schedule(0.0, learning_rate, max(warmup_steps, 1)),
+            optax.linear_schedule(learning_rate, 0.0, decay_steps),
+        ],
+        boundaries=[warmup_steps],
+    )
+
+
+@schedules.register("warmup_cosine")
+def warmup_cosine(
+    learning_rate: float,
+    warmup_steps: int = 0,
+    total_steps: int = 10_000,
+    **_,
+) -> optax.Schedule:
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=learning_rate,
+        warmup_steps=max(int(warmup_steps), 1),
+        decay_steps=max(int(total_steps), 2),
+    )
+
+
+def get_schedule(name: str, **kwargs) -> optax.Schedule:
+    return schedules.get(name)(**kwargs)

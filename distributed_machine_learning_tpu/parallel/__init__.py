@@ -1,0 +1,1 @@
+"""Parallelism: device meshes, sharded train steps, multi-core trials."""

@@ -1,0 +1,65 @@
+"""Device-mesh helpers.
+
+TPU-native replacement for the reference's device story (Ray sets
+``CUDA_VISIBLE_DEVICES``, every trial hard-codes ``cuda:0`` —
+`ray-tune-hpo-regression.py:286`; SURVEY.md §2b D3/D4): trials either own one
+core (DeviceManager lease) or span several via a named ``jax.sharding.Mesh``,
+with XLA inserting ICI collectives from sharding annotations.
+
+Axis conventions used across the framework:
+  ``dp`` — data parallel (batch dimension)
+  ``sp`` — sequence parallel (sequence dimension of activations)
+  ``tp`` — tensor parallel (hidden/heads dimensions of params+activations)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    axis_sizes: Dict[str, int],
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a named mesh from {axis: size}. Total size must match #devices.
+
+    Axis order follows dict insertion order; put the fastest-varying axis
+    (usually ``tp``) last so it maps to ICI-adjacent cores.
+    """
+    devices = list(devices) if devices is not None else list(jax.devices())
+    sizes = {k: int(v) for k, v in axis_sizes.items() if int(v) > 0}
+    total = int(np.prod(list(sizes.values()))) if sizes else 1
+    if total != len(devices):
+        raise ValueError(
+            f"mesh axes {sizes} need {total} devices, got {len(devices)}"
+        )
+    arr = np.array(devices).reshape(*sizes.values())
+    return Mesh(arr, tuple(sizes.keys()))
+
+
+def auto_mesh(n_devices: Optional[int] = None, *, tp: int = 1, sp: int = 1) -> Mesh:
+    """A mesh over the first n devices: dp fills whatever tp/sp don't use."""
+    devices = list(jax.devices())
+    n = n_devices or len(devices)
+    if n % (tp * sp) != 0:
+        raise ValueError(f"{n} devices not divisible by tp*sp={tp * sp}")
+    return make_mesh({"dp": n // (tp * sp), "sp": sp, "tp": tp}, devices[:n])
+
+
+def batch_sharding(mesh: Mesh, *, shard_seq: bool = False) -> NamedSharding:
+    """[batch, seq, ...] arrays: batch over dp, optionally seq over sp."""
+    if shard_seq and "sp" in mesh.axis_names:
+        return NamedSharding(mesh, P("dp", "sp"))
+    return NamedSharding(mesh, P("dp"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def mesh_devices(mesh: Mesh) -> List:
+    return list(mesh.devices.flat)

@@ -1,0 +1,85 @@
+"""Parameter/activation sharding rules (Megatron-style tensor parallelism).
+
+Rules map flax param paths to PartitionSpecs over the (dp, sp, tp) mesh:
+
+* attention q/k/v DenseGeneral kernels  [d_model, heads, head_dim] -> shard
+  heads on ``tp`` (each core owns a head group; attention is embarrassingly
+  parallel over heads, no collective inside the core attention op);
+* attention out kernel [heads, head_dim, d_model] -> shard heads on ``tp``
+  (row-parallel; XLA inserts the psum on the output);
+* feed-forward in kernel [d_model, dim_ff] -> column-parallel on ``tp``;
+  feed-forward out kernel [dim_ff, d_model] -> row-parallel on ``tp``;
+* embeddings/projections/norms/heads -> replicated.
+
+This is the standard 1D-TP recipe (shard the two big matmuls of each block
+column-then-row so only one reduce per block is needed); XLA GSPMD propagates
+the activation shardings and places the collectives on ICI.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (path regex, spec) — first match wins. Paths look like
+# "layer_0/attention/query/kernel" (flax param tree joined with '/').
+TRANSFORMER_TP_RULES: Tuple[Tuple[str, P], ...] = (
+    (r".*attention/(query|key|value)/kernel$", P(None, "tp", None)),
+    (r".*attention/(query|key|value)/bias$", P("tp", None)),
+    (r".*attention/out/kernel$", P("tp", None, None)),
+    (r".*attention/out/bias$", P()),
+    (r".*ff/Dense_0/kernel$", P(None, "tp")),   # column parallel
+    (r".*ff/Dense_0/bias$", P("tp")),
+    (r".*ff/Dense_1/kernel$", P("tp", None)),   # row parallel
+    (r".*ff/Dense_1/bias$", P()),
+    (r".*ff/pointwise/kernel$", P(None, None, "tp")),
+    (r".*ff/pointwise/bias$", P("tp")),
+    (r".*ff/out_proj/kernel$", P("tp", None)),
+    (r".*ff/out_proj/bias$", P()),
+    (r".*", P()),  # everything else replicated
+)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        parts.append(str(getattr(p, "key", getattr(p, "idx", p))))
+    return "/".join(parts)
+
+
+def partition_spec_for(path: str, rules=TRANSFORMER_TP_RULES) -> P:
+    for pattern, spec in rules:
+        if re.fullmatch(pattern, path):
+            return spec
+    return P()
+
+
+def param_shardings(params: Any, mesh: Mesh, rules=TRANSFORMER_TP_RULES):
+    """A pytree of NamedShardings matching ``params``' structure."""
+
+    def assign(path, leaf):
+        spec = partition_spec_for(_path_str(path), rules)
+        # Drop axes the mesh doesn't have / that exceed the leaf's rank.
+        cleaned = []
+        for i, axis in enumerate(spec):
+            if i >= leaf.ndim:
+                break
+            cleaned.append(axis if axis in (None,) or axis in mesh.axis_names else None)
+        # Avoid sharding a dim the axis size doesn't divide.
+        final = []
+        for i, axis in enumerate(cleaned):
+            if axis is not None and leaf.shape[i] % mesh.shape[axis] != 0:
+                axis = None
+            final.append(axis)
+        return NamedSharding(mesh, P(*final))
+
+    return jax.tree_util.tree_map_with_path(assign, params)
+
+
+def shard_params(params: Any, mesh: Mesh, rules=TRANSFORMER_TP_RULES):
+    """device_put the param pytree according to the rules."""
+    shardings = param_shardings(params, mesh, rules)
+    return jax.device_put(params, shardings)

@@ -1,0 +1,105 @@
+"""Sharded training step over a named mesh (dp × sp × tp).
+
+This is the multi-core trial path (SURVEY.md §2c: data parallelism *within a
+trial* — BASELINE.json config 5 — plus tensor/sequence parallelism the
+reference never had).  Design per the standard JAX recipe: pick a mesh,
+annotate param + batch shardings, jit, and let XLA GSPMD insert the
+collectives (psum for row-parallel matmuls and the gradient all-reduce over
+dp; all-gathers where seq-sharded activations meet attention) on ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_machine_learning_tpu.parallel.sharding import (
+    TRANSFORMER_TP_RULES,
+    param_shardings,
+    shard_params,
+)
+
+
+def make_sharded_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    loss_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    mesh: Mesh,
+    rules=TRANSFORMER_TP_RULES,
+    shard_seq: bool = True,
+    flag_name: str = "deterministic",
+):
+    """Returns (init_fn, step_fn).
+
+    init_fn(rng, sample_x) -> (params, opt_state) already sharded on the mesh.
+    step_fn(params, opt_state, x, y, rng) -> (params, opt_state, loss); jitted
+    with explicit in/out shardings; donates params/opt_state.
+    """
+    seq_axis = "sp" if (shard_seq and "sp" in mesh.axis_names) else None
+    x_sharding = NamedSharding(mesh, P("dp", seq_axis))
+    y_sharding = NamedSharding(mesh, P("dp"))
+    repl = NamedSharding(mesh, P())
+
+    def init_fn(rng, sample_x):
+        variables = model.init(
+            {"params": rng, "dropout": jax.random.fold_in(rng, 1)},
+            sample_x,
+            **{flag_name: True if flag_name == "deterministic" else False},
+        )
+        params = shard_params(variables["params"], mesh, rules)
+        p_shardings = param_shardings(params, mesh, rules)
+
+        def _init_opt(p):
+            return tx.init(p)
+
+        # jit the optimizer init with param shardings so optimizer moments
+        # inherit the TP layout instead of materializing replicated.
+        opt_state = jax.jit(_init_opt, in_shardings=(p_shardings,))(params)
+        return params, opt_state
+
+    def _step(params, opt_state, x, y, rng):
+        x = jax.lax.with_sharding_constraint(x, x_sharding)
+
+        def loss_of(p):
+            preds = model.apply(
+                {"params": p},
+                x,
+                rngs={"dropout": rng},
+                **{flag_name: False if flag_name == "deterministic" else True},
+            )
+            return loss_fn(preds.astype(jnp.float32), y)
+
+        loss, grads = jax.value_and_grad(loss_of)(params)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt, loss
+
+    step_fn = jax.jit(
+        _step,
+        donate_argnums=(0, 1),
+        in_shardings=(None, None, x_sharding, y_sharding, repl),
+    )
+    return init_fn, step_fn
+
+
+def make_data_parallel_eval(
+    model,
+    mesh: Mesh,
+    flag_name: str = "deterministic",
+):
+    """Sharded eval: predictions for a dp-sharded batch."""
+    x_sharding = NamedSharding(mesh, P("dp"))
+
+    def _eval(params, x):
+        x = jax.lax.with_sharding_constraint(x, x_sharding)
+        return model.apply(
+            {"params": params},
+            x,
+            **{flag_name: True if flag_name == "deterministic" else False},
+        )
+
+    return jax.jit(_eval)

@@ -1,0 +1,88 @@
+"""Public tune API.
+
+Mirrors the surface the reference consumed from Ray Tune
+(`ray-tune-hpo-regression.py:7-9, 373, 379-400, 469-478`):
+
+    from distributed_machine_learning_tpu import tune
+
+    analysis = tune.run(
+        tune.with_parameters(my_trainable, train_data=..., val_data=...),
+        param_space={"lr": tune.loguniform(1e-5, 1e-2), ...},
+        metric="validation_mape", mode="min", num_samples=50,
+        scheduler=tune.ASHAScheduler(...),
+        search_alg=tune.BayesOptSearch(...),
+    )
+    print(analysis.best_config)
+"""
+
+from distributed_machine_learning_tpu.tune.experiment import (
+    ExperimentAnalysis,
+    ExperimentStore,
+)
+from distributed_machine_learning_tpu.tune.runner import run
+from distributed_machine_learning_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from distributed_machine_learning_tpu.tune.search import (
+    BayesOptSearch,
+    GridSearch,
+    RandomSearch,
+    Searcher,
+)
+from distributed_machine_learning_tpu.tune.search_space import (
+    Constraint,
+    SearchSpace,
+    choice,
+    constant,
+    loguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from distributed_machine_learning_tpu.tune.session import (
+    get_checkpoint,
+    get_devices,
+    get_trial_id,
+    report,
+    with_parameters,
+)
+from distributed_machine_learning_tpu.tune.trainable import train_regressor
+from distributed_machine_learning_tpu.tune.trial import Resources, Trial, TrialStatus
+
+__all__ = [
+    "run",
+    "report",
+    "get_checkpoint",
+    "get_devices",
+    "get_trial_id",
+    "with_parameters",
+    "train_regressor",
+    "choice",
+    "uniform",
+    "loguniform",
+    "quniform",
+    "randint",
+    "sample_from",
+    "constant",
+    "Constraint",
+    "SearchSpace",
+    "ASHAScheduler",
+    "FIFOScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "TrialScheduler",
+    "RandomSearch",
+    "GridSearch",
+    "BayesOptSearch",
+    "Searcher",
+    "ExperimentAnalysis",
+    "ExperimentStore",
+    "Resources",
+    "Trial",
+    "TrialStatus",
+]

@@ -1,0 +1,53 @@
+"""Pytree checkpoint save/restore.
+
+The reference has no checkpointing at all (SURVEY.md §5: no torch.save/load,
+no ``tune.checkpoint_dir`` anywhere); PBT and preemption-aware recovery make it
+first-class here.  Format: flax msgpack for the array pytree (framework- and
+process-portable, no pickle), written atomically so a preempted write never
+leaves a truncated checkpoint behind.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+from flax import serialization
+
+
+def _to_host(tree):
+    """Device arrays -> numpy so serialization never hangs on device buffers."""
+    return jax.tree.map(
+        lambda x: np.asarray(x) if hasattr(x, "shape") else x, tree
+    )
+
+
+def save_checkpoint(path: str, tree: Dict[str, Any]) -> str:
+    """Serialize a pytree dict to ``path`` atomically. Returns the path."""
+    payload = serialization.to_bytes(_to_host(tree))
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)  # atomic on POSIX
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path
+
+
+def load_checkpoint(path: str) -> Optional[Dict[str, Any]]:
+    """Decode a checkpoint without needing a target template (msgpack restore)."""
+    if not path or not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        return serialization.msgpack_restore(f.read())
+
+
+def restore_into(template, tree: Dict[str, Any]):
+    """Restore a raw decoded dict into ``template``'s pytree structure/dtypes."""
+    return serialization.from_state_dict(template, tree)

@@ -1,0 +1,137 @@
+"""Trial executor: binds trials to TPU devices and runs them.
+
+Native replacement for Ray's actor-per-trial resource scheduling (SURVEY.md
+§2b D3): the reference leaned on Ray setting ``CUDA_VISIBLE_DEVICES`` so every
+trial could hard-code ``cuda:0`` (`ray-tune-hpo-regression.py:286`).  Here a
+``DeviceManager`` owns the enumerated ``jax.devices()`` of the slice and leases
+1..N cores per trial; the trainable runs under ``jax.default_device`` (JAX
+config contexts are thread-local) so its jit executables land on its leased
+core without any process-env games.  Threads, not processes: JAX dispatch
+releases the GIL while XLA executes, so N trials on N cores overlap compute;
+compilation contention is bounded and amortized by the jit cache.
+
+``report`` is synchronous with the runner (the thread blocks until the
+scheduler answers), which makes early-stop decisions take effect on the very
+next epoch and keeps scheduler state single-threaded.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import traceback
+from typing import Callable, Dict, List, Optional
+
+import jax
+
+from distributed_machine_learning_tpu.tune import checkpoint as ckpt_lib
+from distributed_machine_learning_tpu.tune.session import (
+    PauseTrial,
+    Session,
+    StopTrial,
+    set_session,
+)
+from distributed_machine_learning_tpu.tune.trial import Trial
+
+
+class DeviceManager:
+    """Leases jax devices to trials. Thread-compatible (runner-thread only)."""
+
+    def __init__(self, devices: Optional[List] = None):
+        self.devices = list(devices) if devices is not None else list(jax.devices())
+        if not self.devices:
+            raise RuntimeError("No jax devices available")
+        self._free = list(range(len(self.devices)))
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.devices)
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def acquire(self, n: int) -> Optional[List]:
+        if n > len(self.devices):
+            raise ValueError(
+                f"Trial requests {n} devices but only {len(self.devices)} exist"
+            )
+        if len(self._free) < n:
+            return None
+        idxs = [self._free.pop(0) for _ in range(n)]
+        return [(i, self.devices[i]) for i in idxs]
+
+    def release(self, leased: List):
+        for i, _ in leased:
+            self._free.append(i)
+        self._free.sort()
+
+
+class ResultEvent:
+    __slots__ = ("trial", "metrics", "decision", "done")
+
+    def __init__(self, trial: Trial, metrics: Dict):
+        self.trial = trial
+        self.metrics = metrics
+        self.decision = "continue"
+        self.done = threading.Event()
+
+
+class ThreadTrialExecutor:
+    """Runs each trial in a daemon thread pinned to its leased devices."""
+
+    def __init__(self, store, event_queue: "queue.Queue"):
+        self.store = store
+        self.events = event_queue
+        self._threads: Dict[str, threading.Thread] = {}
+
+    def start_trial(self, trial: Trial, trainable: Callable, leased_devices: List):
+        devices = [d for _, d in leased_devices]
+        trial.assigned_devices = leased_devices
+        thread = threading.Thread(
+            target=self._run,
+            args=(trial, trainable, devices),
+            name=f"trial-{trial.trial_id}",
+            daemon=True,
+        )
+        self._threads[trial.trial_id] = thread
+        thread.start()
+
+    def is_alive(self, trial: Trial) -> bool:
+        t = self._threads.get(trial.trial_id)
+        return t is not None and t.is_alive()
+
+    def join_all(self, timeout: float = 5.0):
+        for t in self._threads.values():
+            t.join(timeout=timeout)
+
+    # -- trial thread body ---------------------------------------------------
+    def _run(self, trial: Trial, trainable: Callable, devices: List):
+        def report_fn(metrics: Dict, checkpoint) -> str:
+            if checkpoint is not None:
+                count = trial.training_iteration + 1
+                path = os.path.join(
+                    self.store.checkpoint_dir(trial), f"ckpt_{count:06d}.msgpack"
+                )
+                ckpt_lib.save_checkpoint(path, checkpoint)
+                trial.latest_checkpoint = path
+            event = ResultEvent(trial, metrics)
+            self.events.put(("result", event))
+            event.done.wait()
+            return event.decision
+
+        def checkpoint_loader():
+            return ckpt_lib.load_checkpoint(trial.restore_path)
+
+        set_session(Session(trial, report_fn, checkpoint_loader, devices))
+        try:
+            with jax.default_device(devices[0]):
+                trainable(dict(trial.config))
+            self.events.put(("complete", trial, None))
+        except (StopTrial, PauseTrial):
+            self.events.put(("complete", trial, None))
+        except BaseException:  # noqa: BLE001 - report crash to the runner
+            self.events.put(("error", trial, traceback.format_exc()))
+        finally:
+            set_session(None)

@@ -1,0 +1,202 @@
+"""Experiment store + analysis.
+
+Parity with Ray Tune's ``local_dir`` results persistence and
+``analysis.best_config`` (`ray-tune-hpo-regression.py:476,480`), upgraded per
+SURVEY.md §5: a structured per-trial JSONL metric stream (step, epoch, metrics,
+wallclock) plus an experiment-level summary, all plain files so an experiment
+directory is greppable and survives the driver.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from distributed_machine_learning_tpu.tune.trial import Trial, TrialStatus
+
+
+def _jsonable(value):
+    if hasattr(value, "item"):  # numpy / jax scalars
+        try:
+            return value.item()
+        except Exception:
+            pass
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    if isinstance(value, dict):
+        return {k: _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    return repr(value)
+
+
+class ExperimentStore:
+    """Writes trial configs, per-epoch results, and experiment state to disk."""
+
+    def __init__(self, storage_path: str, name: str):
+        self.root = os.path.join(os.path.expanduser(storage_path), name)
+        os.makedirs(self.root, exist_ok=True)
+        self._result_files = {}
+
+    def trial_dir(self, trial: Trial) -> str:
+        d = os.path.join(self.root, trial.trial_id)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def checkpoint_dir(self, trial: Trial) -> str:
+        d = os.path.join(self.trial_dir(trial), "checkpoints")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def write_params(self, trial: Trial):
+        with open(os.path.join(self.trial_dir(trial), "params.json"), "w") as f:
+            json.dump(_jsonable(trial.config), f, indent=2)
+
+    def append_result(self, trial: Trial, result: Dict[str, Any]):
+        f = self._result_files.get(trial.trial_id)
+        if f is None or f.closed:
+            f = open(os.path.join(self.trial_dir(trial), "result.jsonl"), "a")
+            self._result_files[trial.trial_id] = f
+        f.write(json.dumps(_jsonable(result)) + "\n")
+        f.flush()
+
+    def write_state(self, trials: List[Trial], extra: Optional[Dict] = None):
+        state = {
+            "timestamp": time.time(),
+            "trials": [
+                {
+                    "trial_id": t.trial_id,
+                    "status": t.status.value,
+                    "config": _jsonable(t.config),
+                    "last_result": _jsonable(t.last_result),
+                    "training_iteration": t.training_iteration,
+                    "error": t.error,
+                    "runtime_s": t.runtime_s(),
+                }
+                for t in trials
+            ],
+        }
+        if extra:
+            state.update(_jsonable(extra))
+        tmp = os.path.join(self.root, ".state.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f, indent=2)
+        os.replace(tmp, os.path.join(self.root, "experiment_state.json"))
+
+    def close(self):
+        for f in self._result_files.values():
+            if not f.closed:
+                f.close()
+
+
+class ExperimentAnalysis:
+    """Query interface over a finished (or in-flight) experiment.
+
+    ``best_config`` / ``best_trial`` parity with `analysis.best_config`
+    (`ray-tune-hpo-regression.py:480`).
+    """
+
+    def __init__(
+        self,
+        trials: List[Trial],
+        metric: str,
+        mode: str = "min",
+        root: Optional[str] = None,
+        wall_clock_s: float = 0.0,
+    ):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+        self.trials = trials
+        self.metric = metric
+        self.mode = mode
+        self.root = root
+        self.wall_clock_s = wall_clock_s
+
+    def _score(self, trial: Trial) -> Optional[float]:
+        hist = trial.metric_history(self.metric)
+        if not hist:
+            return None
+        return min(hist) if self.mode == "min" else max(hist)
+
+    @property
+    def best_trial(self) -> Trial:
+        scored = [(self._score(t), t) for t in self.trials]
+        scored = [(s, t) for s, t in scored if s is not None]
+        if not scored:
+            raise ValueError(f"No trial reported metric {self.metric!r}")
+        return min(scored, key=lambda p: p[0] if self.mode == "min" else -p[0])[1]
+
+    @property
+    def best_config(self) -> Dict[str, Any]:
+        return self.best_trial.config
+
+    @property
+    def best_result(self) -> Dict[str, Any]:
+        t = self.best_trial
+        best = self._score(t)
+        for r in t.results:
+            if r.get(self.metric) == best:
+                return r
+        return t.last_result or {}
+
+    @property
+    def best_checkpoint(self) -> Optional[str]:
+        return self.best_trial.latest_checkpoint
+
+    def dataframe(self):
+        """Last-result-per-trial table (pandas if available, else list of dicts)."""
+        rows = []
+        for t in self.trials:
+            row = {"trial_id": t.trial_id, "status": t.status.value}
+            row.update({f"config/{k}": v for k, v in t.config.items()})
+            if t.last_result:
+                row.update(t.last_result)
+            rows.append(row)
+        try:
+            import pandas as pd
+
+            return pd.DataFrame(rows)
+        except Exception:
+            return rows
+
+    def num_terminated(self) -> int:
+        return sum(t.status == TrialStatus.TERMINATED for t in self.trials)
+
+    def trials_per_hour(self) -> float:
+        if self.wall_clock_s <= 0:
+            return 0.0
+        return self.num_terminated() * 3600.0 / self.wall_clock_s
+
+    @classmethod
+    def from_directory(cls, root: str, metric: str, mode: str = "min"):
+        """Rehydrate an analysis from an experiment directory on disk."""
+        trials: List[Trial] = []
+        state_path = os.path.join(root, "experiment_state.json")
+        state = {}
+        if os.path.exists(state_path):
+            with open(state_path) as f:
+                state = json.load(f)
+        by_id = {t["trial_id"]: t for t in state.get("trials", [])}
+        for entry in sorted(os.listdir(root)):
+            tdir = os.path.join(root, entry)
+            if not os.path.isdir(tdir):
+                continue
+            params_path = os.path.join(tdir, "params.json")
+            config = {}
+            if os.path.exists(params_path):
+                with open(params_path) as f:
+                    config = json.load(f)
+            trial = Trial(trial_id=entry, config=config)
+            results_path = os.path.join(tdir, "result.jsonl")
+            if os.path.exists(results_path):
+                with open(results_path) as f:
+                    trial.results = [json.loads(line) for line in f if line.strip()]
+            meta = by_id.get(entry)
+            if meta:
+                trial.status = TrialStatus(meta.get("status", "TERMINATED"))
+            elif trial.results:
+                trial.status = TrialStatus.TERMINATED
+            trials.append(trial)
+        return cls(trials, metric=metric, mode=mode, root=root)

@@ -1,0 +1,259 @@
+"""The tune.run() driver loop.
+
+Native, single-process replacement for ``tune.run(...)``
+(`ray-tune-hpo-regression.py:469-478`): samples trial configs from the search
+algorithm, leases TPU cores from the DeviceManager, streams per-epoch results
+through the scheduler, early-stops / requeues / retries, persists everything to
+the experiment store, and returns an ExperimentAnalysis with ``best_config``
+(`:480`).
+
+Event-driven: trial threads block in ``report`` until this loop answers, so
+all scheduler/searcher state is mutated from exactly one thread.
+"""
+
+from __future__ import annotations
+
+import queue
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from distributed_machine_learning_tpu.tune.executor import (
+    DeviceManager,
+    ThreadTrialExecutor,
+)
+from distributed_machine_learning_tpu.tune.experiment import (
+    ExperimentAnalysis,
+    ExperimentStore,
+)
+from distributed_machine_learning_tpu.tune.schedulers.base import (
+    CONTINUE,
+    FIFOScheduler,
+    REQUEUE,
+    STOP,
+    TrialScheduler,
+)
+from distributed_machine_learning_tpu.tune.search.base import RandomSearch, Searcher
+from distributed_machine_learning_tpu.tune.search_space import SearchSpace
+from distributed_machine_learning_tpu.tune.trial import (
+    Resources,
+    Trial,
+    TrialStatus,
+)
+
+DEFAULT_STORAGE = "~/dml_tpu_results"
+
+
+def run(
+    trainable: Callable,
+    param_space: Union[Dict[str, Any], SearchSpace],
+    *,
+    metric: str,
+    mode: str = "min",
+    num_samples: int = 10,
+    scheduler: Optional[TrialScheduler] = None,
+    search_alg: Optional[Searcher] = None,
+    resources_per_trial: Optional[Dict[str, int]] = None,
+    max_concurrent: Optional[int] = None,
+    storage_path: str = DEFAULT_STORAGE,
+    name: Optional[str] = None,
+    seed: int = 0,
+    max_failures: int = 0,
+    stop: Optional[Dict[str, float]] = None,
+    time_budget_s: Optional[float] = None,
+    devices: Optional[List] = None,
+    verbose: int = 1,
+) -> ExperimentAnalysis:
+    """Run an HPO experiment; see module docstring.
+
+    ``stop``: dict of result-key -> threshold; a trial stops once any key's
+    reported value reaches the threshold (e.g. ``{"training_iteration": 20}``).
+    ``max_failures``: per-trial retry budget; retries restore from the trial's
+    latest checkpoint when one exists (preemption tolerance, SURVEY.md §5).
+    """
+    if mode not in ("min", "max"):
+        raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+    space = (
+        param_space
+        if isinstance(param_space, SearchSpace)
+        else SearchSpace(param_space)
+    )
+    searcher = search_alg or RandomSearch()
+    searcher.set_search_space(space, seed)
+    sched = scheduler or FIFOScheduler()
+    sched.set_experiment(metric, mode)
+    resources = Resources.parse(resources_per_trial)
+
+    name = name or f"exp_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:6]}"
+    store = ExperimentStore(storage_path, name)
+    device_mgr = DeviceManager(devices)
+    events: "queue.Queue" = queue.Queue()
+    executor = ThreadTrialExecutor(store, events)
+
+    max_concurrent = max_concurrent or device_mgr.num_devices
+    trials: List[Trial] = []
+    pending: List[Trial] = []
+    running: Dict[str, List] = {}  # trial_id -> leased devices
+    next_index = 0
+    searcher_exhausted = False
+    start_time = time.time()
+    last_status_print = 0.0
+
+    def log(msg: str):
+        if verbose:
+            print(f"[tune] {msg}", flush=True)
+
+    def budget_exceeded() -> bool:
+        return time_budget_s is not None and time.time() - start_time > time_budget_s
+
+    def maybe_create_trial():
+        nonlocal next_index, searcher_exhausted
+        if searcher_exhausted or next_index >= num_samples or budget_exceeded():
+            return
+        config = searcher.suggest(next_index)
+        if config is None:
+            searcher_exhausted = True
+            return
+        trial = Trial(
+            trial_id=f"trial_{next_index:05d}",
+            config=config,
+            resources=resources,
+        )
+        next_index += 1
+        trials.append(trial)
+        pending.append(trial)
+        sched.on_trial_add(trial)
+        store.write_params(trial)
+
+    def launch_ready():
+        while pending and len(running) < max_concurrent:
+            leased = device_mgr.acquire(pending[0].resources.devices)
+            if leased is None:
+                return
+            trial = pending.pop(0)
+            trial.status = TrialStatus.RUNNING
+            trial.started_at = trial.started_at or time.time()
+            trial.stop_requested = False
+            running[trial.trial_id] = leased
+            executor.start_trial(trial, trainable, leased)
+
+    def finish_trial(trial: Trial, status: TrialStatus):
+        leased = running.pop(trial.trial_id, None)
+        if leased:
+            device_mgr.release(leased)
+        trial.status = status
+        trial.finished_at = time.time()
+        if status == TrialStatus.TERMINATED:
+            searcher.on_trial_complete(
+                trial.trial_id, trial.config, trial.last_result, metric, mode
+            )
+        sched.on_trial_complete(trial)
+
+    def requeue_trial(trial: Trial):
+        leased = running.pop(trial.trial_id, None)
+        if leased:
+            device_mgr.release(leased)
+        trial.status = TrialStatus.PENDING
+        pending.append(trial)
+
+    # -------- main event loop ------------------------------------------------
+    while True:
+        while len(trials) < num_samples and not searcher_exhausted and (
+            len(pending) + len(running) < max_concurrent + 2
+        ):
+            before = len(trials)
+            maybe_create_trial()
+            if len(trials) == before:
+                break
+        launch_ready()
+
+        if not running and not pending:
+            if searcher_exhausted or len(trials) >= num_samples or budget_exceeded():
+                break
+            if len(trials) == 0 and next_index == 0:
+                break  # nothing to do at all
+            continue
+
+        try:
+            event = events.get(timeout=0.5)
+        except queue.Empty:
+            if verbose and time.time() - last_status_print > 15:
+                last_status_print = time.time()
+                log(
+                    f"{sum(t.status == TrialStatus.TERMINATED for t in trials)}"
+                    f"/{num_samples} done, {len(running)} running, "
+                    f"{device_mgr.num_free}/{device_mgr.num_devices} cores free"
+                )
+            # Reap threads that died without reporting (shouldn't happen).
+            for tid in list(running):
+                trial = next(t for t in trials if t.trial_id == tid)
+                if not executor.is_alive(trial):
+                    finish_trial(trial, TrialStatus.ERROR)
+            continue
+
+        kind = event[0]
+        if kind == "result":
+            result_event = event[1]
+            trial = result_event.trial
+            metrics = dict(result_event.metrics)
+            metrics.setdefault("training_iteration", trial.training_iteration + 1)
+            metrics["trial_id"] = trial.trial_id
+            metrics["timestamp"] = time.time()
+            metrics["time_total_s"] = trial.runtime_s()
+            trial.results.append(metrics)
+            store.append_result(trial, metrics)
+
+            decision = sched.on_trial_result(trial, metrics)
+            if stop and any(
+                k in metrics and float(metrics[k]) >= v for k, v in stop.items()
+            ):
+                decision = STOP if decision == CONTINUE else decision
+            if trial.stop_requested or budget_exceeded():
+                decision = STOP
+            if decision == REQUEUE:
+                trial._requeue_on_complete = True
+                decision = STOP
+            result_event.decision = "stop" if decision == STOP else "continue"
+            result_event.done.set()
+
+        elif kind == "complete":
+            trial = event[1]
+            if getattr(trial, "_requeue_on_complete", False):
+                trial._requeue_on_complete = False
+                requeue_trial(trial)
+            else:
+                finish_trial(trial, TrialStatus.TERMINATED)
+            store.write_state(trials)
+
+        elif kind == "error":
+            trial, tb = event[1], event[2]
+            trial.error = tb
+            trial.num_failures += 1
+            if trial.num_failures <= max_failures:
+                log(
+                    f"{trial.trial_id} failed ({trial.num_failures}/{max_failures}); "
+                    "retrying"
+                    + (" from checkpoint" if trial.latest_checkpoint else "")
+                )
+                if trial.latest_checkpoint:
+                    trial.restore_path = trial.latest_checkpoint
+                requeue_trial(trial)
+            else:
+                if verbose:
+                    log(f"{trial.trial_id} errored:\n{tb}")
+                finish_trial(trial, TrialStatus.ERROR)
+                sched.on_trial_error(trial)
+            store.write_state(trials)
+
+    wall = time.time() - start_time
+    store.write_state(trials, extra={"wall_clock_s": wall})
+    store.close()
+    analysis = ExperimentAnalysis(
+        trials, metric=metric, mode=mode, root=store.root, wall_clock_s=wall
+    )
+    n_done = analysis.num_terminated()
+    log(
+        f"experiment {name}: {n_done}/{len(trials)} trials terminated in "
+        f"{wall:.1f}s ({analysis.trials_per_hour():.1f} trials/hour)"
+    )
+    return analysis

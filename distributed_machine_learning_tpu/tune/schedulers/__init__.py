@@ -1,0 +1,21 @@
+from distributed_machine_learning_tpu.tune.schedulers.asha import ASHAScheduler
+from distributed_machine_learning_tpu.tune.schedulers.base import (
+    CONTINUE,
+    FIFOScheduler,
+    REQUEUE,
+    STOP,
+    TrialScheduler,
+)
+from distributed_machine_learning_tpu.tune.schedulers.median import MedianStoppingRule
+from distributed_machine_learning_tpu.tune.schedulers.pbt import PopulationBasedTraining
+
+__all__ = [
+    "ASHAScheduler",
+    "FIFOScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "TrialScheduler",
+    "CONTINUE",
+    "STOP",
+    "REQUEUE",
+]

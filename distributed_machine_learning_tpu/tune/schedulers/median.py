@@ -1,0 +1,54 @@
+"""Median stopping rule: stop a trial whose best result so far is worse than
+the median of other trials' running averages at the same iteration."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from distributed_machine_learning_tpu.tune.schedulers.base import (
+    CONTINUE,
+    STOP,
+    TrialScheduler,
+)
+from distributed_machine_learning_tpu.tune.trial import Trial
+
+
+class MedianStoppingRule(TrialScheduler):
+    def __init__(
+        self,
+        metric: str = None,
+        mode: str = None,
+        grace_period: int = 1,
+        min_samples_required: int = 3,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        # trial_id -> list of scores per iteration (lower=better)
+        self._history: Dict[str, List[float]] = {}
+
+    def set_experiment(self, metric: str, mode: str):
+        self.metric = self.metric if self.metric is not None else metric
+        self.mode = self.mode if self.mode is not None else mode
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        if self.metric not in result:
+            return CONTINUE
+        score = self._score(result)
+        self._history.setdefault(trial.trial_id, []).append(score)
+        it = len(self._history[trial.trial_id])
+        if it <= self.grace_period:
+            return CONTINUE
+
+        running_avgs = [
+            float(np.mean(h[:it]))
+            for tid, h in self._history.items()
+            if tid != trial.trial_id and len(h) >= it
+        ]
+        if len(running_avgs) < self.min_samples:
+            return CONTINUE
+        best_so_far = min(self._history[trial.trial_id])
+        return STOP if best_so_far > float(np.median(running_avgs)) else CONTINUE

@@ -1,0 +1,127 @@
+"""Population-Based Training (stop-and-respawn variant).
+
+The reference has no PBT and no checkpointing (SURVEY.md §5); BASELINE.json
+config 3 requires PBT exercising checkpoint mutate/restore.  Design: at every
+``perturbation_interval`` reports, a bottom-quantile trial is stopped, its
+config mutated (explore), its weights replaced by a top-quantile peer's latest
+checkpoint (exploit), and the trial is requeued — the executor restarts it and
+the trainable resumes from the restored epoch.  Stop-and-respawn keeps the
+trainable a plain function (no in-band weight surgery) and matches how
+preemption-tolerant TPU trials must restart anyway.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from distributed_machine_learning_tpu.tune.schedulers.base import (
+    CONTINUE,
+    REQUEUE,
+    TrialScheduler,
+)
+from distributed_machine_learning_tpu.tune.search_space import Domain
+from distributed_machine_learning_tpu.tune.trial import Trial
+from distributed_machine_learning_tpu.utils.seeding import rng_from
+
+
+class PopulationBasedTraining(TrialScheduler):
+    def __init__(
+        self,
+        metric: str = None,
+        mode: str = None,
+        perturbation_interval: int = 2,
+        hyperparam_mutations: Optional[Dict[str, Any]] = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        perturbation_factors=(0.8, 1.2),
+        seed: int = 0,
+    ):
+        if not hyperparam_mutations:
+            raise ValueError("PBT requires hyperparam_mutations")
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self.factors = perturbation_factors
+        self.seed = seed
+        # trial_id -> (iteration, score) of the latest report (lower=better)
+        self._latest: Dict[str, tuple] = {}
+        self._num_perturbations = 0
+
+    def set_experiment(self, metric: str, mode: str):
+        self.metric = self.metric if self.metric is not None else metric
+        self.mode = self.mode if self.mode is not None else mode
+
+    # -- explore -------------------------------------------------------------
+    def _mutate(self, config: Dict[str, Any], rng: np.random.Generator) -> Dict[str, Any]:
+        new = dict(config)
+        for key, spec in self.mutations.items():
+            resample = rng.random() < self.resample_p or key not in new
+            if isinstance(spec, Domain):
+                if resample:
+                    new[key] = spec.sample(rng)
+                elif isinstance(new.get(key), (int, float)) and not isinstance(new[key], bool):
+                    new[key] = type(new[key])(
+                        new[key] * self.factors[int(rng.integers(len(self.factors)))]
+                    )
+                else:
+                    new[key] = spec.sample(rng)
+            elif isinstance(spec, (list, tuple)):
+                if resample or new.get(key) not in spec:
+                    new[key] = spec[int(rng.integers(len(spec)))]
+                else:  # step to a neighbor in the ordered list
+                    i = list(spec).index(new[key])
+                    j = int(np.clip(i + rng.choice([-1, 1]), 0, len(spec) - 1))
+                    new[key] = spec[j]
+            elif callable(spec):
+                new[key] = spec()
+            else:
+                raise TypeError(f"Unsupported mutation spec for {key!r}: {spec!r}")
+        return new
+
+    # -- exploit -------------------------------------------------------------
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        if self.metric not in result:
+            return CONTINUE
+        it = int(result.get("training_iteration", trial.training_iteration))
+        self._latest[trial.trial_id] = (it, self._score(result))
+
+        if it == 0 or it % self.interval != 0:
+            return CONTINUE
+
+        population = list(self._latest.items())
+        if len(population) < 4:  # need a meaningful quantile split
+            return CONTINUE
+        population.sort(key=lambda kv: kv[1][1])  # ascending score = best first
+        k = max(1, int(len(population) * self.quantile))
+        top_ids = [tid for tid, _ in population[:k]]
+        bottom_ids = {tid for tid, _ in population[-k:]}
+
+        if trial.trial_id not in bottom_ids or trial.trial_id in top_ids:
+            return CONTINUE
+
+        rng = rng_from("pbt", self.seed, trial.trial_id, it)
+        donor_id = top_ids[int(rng.integers(len(top_ids)))]
+        donor = self._find_trial(donor_id)
+        if donor is None or not donor.latest_checkpoint:
+            return CONTINUE
+
+        # Exploit: resume from the donor's weights; explore: mutate its config.
+        trial.restore_path = donor.latest_checkpoint
+        trial.config = self._mutate(dict(donor.config), rng)
+        self._num_perturbations += 1
+        return REQUEUE
+
+    def on_trial_add(self, trial: Trial):
+        self._trials = getattr(self, "_trials", {})
+        self._trials[trial.trial_id] = trial
+
+    def _find_trial(self, trial_id: str) -> Optional[Trial]:
+        return getattr(self, "_trials", {}).get(trial_id)
+
+    def debug_state(self):
+        return {"num_perturbations": self._num_perturbations}

@@ -1,0 +1,8 @@
+from distributed_machine_learning_tpu.tune.search.base import (
+    GridSearch,
+    RandomSearch,
+    Searcher,
+)
+from distributed_machine_learning_tpu.tune.search.bayesopt import BayesOptSearch
+
+__all__ = ["Searcher", "RandomSearch", "GridSearch", "BayesOptSearch"]

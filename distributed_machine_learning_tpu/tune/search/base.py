@@ -1,0 +1,74 @@
+"""Search-algorithm interface: proposes configs, learns from completed trials.
+
+Native replacement for Ray Tune's search algs (random sampling of the space
+dict; ``BayesOptSearch`` at `ray-tune-hpo-regression.py:474`; SURVEY.md §2b D2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from distributed_machine_learning_tpu.tune.search_space import SearchSpace
+
+
+class Searcher:
+    def set_search_space(self, space: SearchSpace, seed: int):
+        self.space = space
+        self.seed = seed
+
+    def suggest(self, trial_index: int) -> Optional[Dict[str, Any]]:
+        """Propose a config for trial #``trial_index``; None when exhausted."""
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, config: Dict[str, Any],
+                          result: Optional[Dict[str, Any]], metric: str, mode: str):
+        pass
+
+
+class RandomSearch(Searcher):
+    """Seeded i.i.d. sampling of the search space (Ray's default variant
+    generator)."""
+
+    def suggest(self, trial_index: int) -> Dict[str, Any]:
+        return self.space.sample(("random", self.seed, trial_index))
+
+
+class GridSearch(Searcher):
+    """Exhaustive cartesian product over Choice domains; non-choice domains are
+    sampled per grid point (matching ray.tune.grid_search semantics)."""
+
+    def set_search_space(self, space: SearchSpace, seed: int):
+        super().set_search_space(space, seed)
+        from itertools import product
+
+        from distributed_machine_learning_tpu.tune.search_space import Choice
+
+        keys, values = [], []
+        for k, dom in space.space.items():
+            if isinstance(dom, Choice):
+                keys.append(k)
+                values.append(list(dom.categories))
+        self._grid_keys = keys
+        self._grid_points = list(product(*values)) if keys else [()]
+
+    def suggest(self, trial_index: int) -> Optional[Dict[str, Any]]:
+        # Walk an internal cursor so infeasible grid points (fixed values that
+        # violate a joint constraint) are skipped rather than crashing the run.
+        cursor = getattr(self, "_cursor", 0)
+        while cursor < len(self._grid_points):
+            point = dict(zip(self._grid_keys, self._grid_points[cursor]))
+            cursor += 1
+            try:
+                cfg = self.space.with_overrides(**point).sample(
+                    ("grid", self.seed, cursor - 1)
+                )
+            except RuntimeError:
+                continue  # no feasible completion of this grid point
+            self._cursor = cursor
+            return cfg
+        self._cursor = cursor
+        return None
+
+    @property
+    def num_points(self) -> int:
+        return len(self._grid_points)

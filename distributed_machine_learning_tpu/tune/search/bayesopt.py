@@ -1,0 +1,115 @@
+"""Bayesian-optimization search over the continuous subspace.
+
+The reference used ``BayesOptSearch(random_search_steps=10)``
+(`ray-tune-hpo-regression.py:474`) over a categorical-heavy space — a latent
+incompatibility, since upstream ``bayes_opt`` only models continuous params
+(SURVEY.md §2b D2).  Here the mixed-space strategy is deliberate: a Gaussian
+process with expected-improvement acquisition models the *continuous* keys
+(uniform/loguniform, normalized to the unit cube); categorical/integer keys are
+sampled randomly per suggestion.  Pure numpy — no GP library dependency.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from distributed_machine_learning_tpu.tune.search.base import Searcher
+from distributed_machine_learning_tpu.tune.search_space import SearchSpace
+from distributed_machine_learning_tpu.utils.seeding import rng_from
+
+
+def _rbf_kernel(a: np.ndarray, b: np.ndarray, lengthscale: float) -> np.ndarray:
+    d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+    return np.exp(-0.5 * d2 / lengthscale**2)
+
+
+class BayesOptSearch(Searcher):
+    def __init__(
+        self,
+        metric: str = None,
+        mode: str = None,
+        random_search_steps: int = 10,
+        num_candidates: int = 512,
+        lengthscale: float = 0.2,
+        noise: float = 1e-4,
+        xi: float = 0.01,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.random_steps = random_search_steps
+        self.num_candidates = num_candidates
+        self.lengthscale = lengthscale
+        self.noise = noise
+        self.xi = xi
+        self._X: List[np.ndarray] = []  # observed unit-cube points
+        self._y: List[float] = []       # observed scores (lower = better)
+        self._pending: Dict[str, np.ndarray] = {}
+
+    def set_search_space(self, space: SearchSpace, seed: int):
+        super().set_search_space(space, seed)
+        self._cont_keys = space.continuous_keys()
+
+    # -- encode/decode continuous subspace -----------------------------------
+    def _encode(self, config: Dict[str, Any]) -> np.ndarray:
+        return np.array(
+            [self.space.domain(k).to_unit(config[k]) for k in self._cont_keys],
+            dtype=np.float64,
+        )
+
+    def _apply(self, config: Dict[str, Any], u: np.ndarray) -> Dict[str, Any]:
+        out = dict(config)
+        for k, ui in zip(self._cont_keys, u):
+            out[k] = self.space.domain(k).from_unit(float(ui))
+        return out
+
+    # -- searcher API --------------------------------------------------------
+    def suggest(self, trial_index: int) -> Optional[Dict[str, Any]]:
+        base = self.space.sample(("bayesopt", self.seed, trial_index))
+        if not self._cont_keys or len(self._y) < self.random_steps:
+            return base  # bootstrap phase: pure random (random_search_steps)
+
+        rng = rng_from("bayesopt-acq", self.seed, trial_index)
+        X = np.stack(self._X)
+        y = np.array(self._y)
+        y_mean, y_std = y.mean(), y.std() + 1e-9
+        yn = (y - y_mean) / y_std
+
+        K = _rbf_kernel(X, X, self.lengthscale) + self.noise * np.eye(len(X))
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+
+        cand = rng.random((self.num_candidates, len(self._cont_keys)))
+        Ks = _rbf_kernel(cand, X, self.lengthscale)
+        mu = Ks @ alpha
+        v = np.linalg.solve(L, Ks.T)
+        var = np.clip(1.0 - (v**2).sum(axis=0), 1e-12, None)
+        sigma = np.sqrt(var)
+
+        # Expected improvement (minimization of normalized score).
+        best = yn.min()
+        from math import erf, sqrt
+
+        z = (best - self.xi - mu) / sigma
+        cdf = 0.5 * (1.0 + np.vectorize(erf)(z / sqrt(2.0)))
+        pdf = np.exp(-0.5 * z**2) / np.sqrt(2 * np.pi)
+        ei = sigma * (z * cdf + pdf)
+        u_best = cand[int(np.argmax(ei))]
+        config = self._apply(base, u_best)
+
+        # Re-check joint constraints after the GP overrides continuous keys.
+        if not all(c(config) for c in self.space.constraints):
+            return base
+        return config
+
+    def on_trial_complete(self, trial_id, config, result, metric, mode):
+        metric = self.metric if self.metric is not None else metric
+        mode = self.mode if self.mode is not None else mode
+        if not result or metric not in result or not self._cont_keys:
+            return
+        score = float(result[metric])
+        if mode == "max":
+            score = -score
+        self._X.append(self._encode(config))
+        self._y.append(score)

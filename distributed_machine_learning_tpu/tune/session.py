@@ -1,0 +1,103 @@
+"""Per-trial session: the contract between a trainable and the runner.
+
+Replaces Ray Tune's ``tune.report(...)`` / ``tune.with_parameters`` /
+``tune.checkpoint_dir`` surface (`ray-tune-hpo-regression.py:373,470`).  A
+trainable is any callable ``fn(config, **bound_params)`` that calls
+``report(**metrics)`` per epoch.  ``report`` blocks until the scheduler has
+seen the metrics and answers continue/stop, so early stopping (ASHA) takes
+effect at the next epoch boundary — the reference's structurally-inert ASHA
+fixed (SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_session_store = threading.local()
+
+
+class StopTrial(Exception):
+    """Raised inside a trainable when the scheduler stops the trial early."""
+
+
+class PauseTrial(Exception):
+    """Raised inside a trainable when the scheduler pauses the trial (PBT)."""
+
+
+class Session:
+    """Thread-local handle wired up by the executor before the trainable runs."""
+
+    def __init__(
+        self,
+        trial,
+        report_fn: Callable[[Dict[str, Any], Optional[Any]], str],
+        checkpoint_loader: Callable[[], Optional[Dict[str, Any]]],
+        devices=None,
+    ):
+        self.trial = trial
+        self._report_fn = report_fn
+        self._checkpoint_loader = checkpoint_loader
+        self.devices = devices or []
+
+    def report(self, metrics: Dict[str, Any], checkpoint: Optional[Any] = None):
+        decision = self._report_fn(metrics, checkpoint)
+        if decision == "stop":
+            raise StopTrial()
+        if decision == "pause":
+            raise PauseTrial()
+
+    def get_checkpoint(self) -> Optional[Dict[str, Any]]:
+        return self._checkpoint_loader()
+
+
+def _get_session() -> Session:
+    sess = getattr(_session_store, "session", None)
+    if sess is None:
+        raise RuntimeError(
+            "No active trial session: tune.report()/tune.get_checkpoint() must "
+            "be called from inside a trainable running under tune.run()"
+        )
+    return sess
+
+
+def set_session(session: Optional[Session]):
+    _session_store.session = session
+
+
+def report(_metrics: Optional[Dict[str, Any]] = None, *, checkpoint=None, **kwargs):
+    """Report metrics (kwargs-style like the reference's ``tune.report``).
+
+    Optionally attach a ``checkpoint`` pytree; the framework persists it and
+    PBT/fault-recovery restore from it.
+    """
+    metrics = dict(_metrics or {})
+    metrics.update(kwargs)
+    _get_session().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Dict[str, Any]]:
+    """Return the checkpoint pytree this trial should resume from, if any."""
+    return _get_session().get_checkpoint()
+
+
+def get_trial_id() -> str:
+    return _get_session().trial.trial_id
+
+
+def get_devices():
+    """The jax devices assigned to this trial by the executor."""
+    return list(_get_session().devices)
+
+
+def with_parameters(fn: Callable, **bound) -> Callable:
+    """Bind large objects (datasets) to a trainable once, outside the config.
+
+    Parity with ``tune.with_parameters`` (`:470`): in-process execution means
+    binding is a closure, not an object-store broadcast; with the process
+    executor the bound objects are pickled once per worker, not per trial.
+    """
+    partial = functools.partial(fn, **bound)
+    functools.update_wrapper(partial, fn)
+    return partial

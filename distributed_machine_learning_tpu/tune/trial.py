@@ -1,0 +1,89 @@
+"""Trial state: the unit of work the scheduler and executor reason about.
+
+A Trial owns its sampled config, a monotonically growing result stream (the
+per-epoch metric records the reference never produced — it reported once at
+trial end, `ray-tune-hpo-regression.py:373`, leaving ASHA inert; SURVEY.md
+§3.1), resource requirements, and checkpoint bookkeeping for PBT/fault
+recovery.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class TrialStatus(str, enum.Enum):
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    PAUSED = "PAUSED"
+    TERMINATED = "TERMINATED"  # finished or early-stopped, successfully
+    ERROR = "ERROR"
+
+
+@dataclass
+class Resources:
+    """Per-trial resource request, parity with ``resources_per_trial``
+    (`ray-tune-hpo-regression.py:475`) translated to TPU terms."""
+
+    devices: int = 1  # TPU cores (or CPU virtual devices in tests)
+    cpus: int = 1
+
+    @classmethod
+    def parse(cls, spec) -> "Resources":
+        if spec is None:
+            return cls()
+        if isinstance(spec, Resources):
+            return spec
+        if isinstance(spec, dict):
+            return cls(
+                devices=int(spec.get("devices", spec.get("tpu", spec.get("gpu", 1)))) or 1,
+                cpus=int(spec.get("cpu", 1)),
+            )
+        raise TypeError(f"Cannot parse resources from {spec!r}")
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    resources: Resources = field(default_factory=Resources)
+    status: TrialStatus = TrialStatus.PENDING
+
+    results: List[Dict[str, Any]] = field(default_factory=list)
+    error: Optional[str] = None
+    num_failures: int = 0
+
+    # Checkpoint/restore bookkeeping (PBT exploit, fault recovery).
+    restore_path: Optional[str] = None
+    latest_checkpoint: Optional[str] = None
+
+    # Runtime bookkeeping.
+    created_at: float = field(default_factory=time.time)
+    started_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    stop_requested: bool = False
+    pause_requested: bool = False
+    assigned_devices: List[Any] = field(default_factory=list)
+
+    @property
+    def last_result(self) -> Optional[Dict[str, Any]]:
+        return self.results[-1] if self.results else None
+
+    @property
+    def training_iteration(self) -> int:
+        return len(self.results)
+
+    def metric_history(self, metric: str) -> List[float]:
+        return [r[metric] for r in self.results if metric in r]
+
+    def runtime_s(self) -> float:
+        if self.started_at is None:
+            return 0.0
+        end = self.finished_at or time.time()
+        return end - self.started_at
+
+    def __repr__(self) -> str:  # keep logs compact
+        return f"Trial({self.trial_id}, {self.status.value}, iters={self.training_iteration})"

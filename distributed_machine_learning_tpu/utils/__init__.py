@@ -1,0 +1,4 @@
+from distributed_machine_learning_tpu.utils.registry import Registry
+from distributed_machine_learning_tpu.utils.seeding import fold_seed, rng_from
+
+__all__ = ["Registry", "fold_seed", "rng_from"]

@@ -1,0 +1,23 @@
+"""Deterministic seeding helpers shared by samplers, data generators, and trials."""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+
+def rng_from(*parts) -> np.random.Generator:
+    """Build a numpy Generator from an arbitrary tuple of seed parts.
+
+    Hashing makes (experiment_seed, trial_index) style derivations stable across
+    processes and platforms, unlike Python's salted ``hash``.
+    """
+    h = hashlib.sha256("/".join(str(p) for p in parts).encode()).digest()
+    return np.random.default_rng(int.from_bytes(h[:8], "little"))
+
+
+def fold_seed(*parts) -> int:
+    """A stable 31-bit integer seed derived from the parts (for jax.random.key)."""
+    h = hashlib.sha256("/".join(str(p) for p in parts).encode()).digest()
+    return int.from_bytes(h[:4], "little") & 0x7FFFFFFF

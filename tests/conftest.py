@@ -1,0 +1,34 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+Must set env before jax initializes (SURVEY.md §4: the fake-cluster strategy —
+N CPU devices stand in for N TPU cores so placement/sharding logic is tested
+without TPU hardware).
+"""
+
+import os
+import warnings
+
+# Hard override: the image pins JAX_PLATFORMS=axon (the real-TPU tunnel);
+# tests must run on virtual CPU devices regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# Buffer donation is a no-op on CPU; silence the per-call warning.
+warnings.filterwarnings(
+    "ignore", message=".*buffer donation.*", category=UserWarning
+)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tmp_results(tmp_path_factory):
+    return str(tmp_path_factory.mktemp("results"))

@@ -1,0 +1,165 @@
+"""Model-zoo tests: shapes, attention equivalences, intended-feature knobs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu.models import build_model
+from distributed_machine_learning_tpu.models.layers import sincos_position_table
+from distributed_machine_learning_tpu.ops.attention import (
+    blockwise_attention,
+    dot_product_attention,
+    linear_attention,
+)
+
+
+def _init_and_apply(model, x):
+    variables = model.init(
+        {"params": jax.random.key(0), "dropout": jax.random.key(1)}, x,
+    )
+    return model.apply(variables, x), variables
+
+
+def test_sincos_table_properties():
+    table = sincos_position_table(100, 16)
+    assert table.shape == (100, 16)
+    np.testing.assert_allclose(table[0, 0::2], 0.0, atol=1e-7)   # sin(0)=0
+    np.testing.assert_allclose(table[0, 1::2], 1.0, atol=1e-7)   # cos(0)=1
+    assert np.abs(table).max() <= 1.0 + 1e-6
+
+
+@pytest.mark.parametrize("attention_type", [
+    "scaled_dot_product", "multi_head_attention", "linear_attention", "blockwise",
+])
+def test_transformer_forward_shapes(attention_type):
+    model = build_model({
+        "model": "transformer",
+        "d_model": 32,
+        "num_heads": 4,
+        "num_layers": 2,
+        "dim_feedforward": 64,
+        "attention_type": attention_type,
+        "max_seq_length": 64,
+    })
+    x = jnp.ones((3, 16, 7))
+    out, _ = _init_and_apply(model, x)
+    assert out.shape == (3, 1)
+    assert jnp.isfinite(out).all()
+
+
+def test_depthwise_separable_ff_any_dim_feedforward():
+    # The reference's version shape-crashed unless dim_feedforward == d_model
+    # (SURVEY.md §2 C8). Ours projects back to d_model.
+    model = build_model({
+        "model": "transformer", "d_model": 32, "num_heads": 4,
+        "dim_feedforward": 96,  # != d_model
+        "depthwise_separable_conv": True, "max_seq_length": 64,
+    })
+    out, _ = _init_and_apply(model, jnp.ones((2, 12, 5)))
+    assert out.shape == (2, 1)
+
+
+def test_shared_weights_shares_parameters():
+    common = dict(model="transformer", d_model=32, num_heads=4, num_layers=4,
+                  dim_feedforward=64, max_seq_length=64)
+    x = jnp.ones((2, 8, 5))
+    _, v_shared = _init_and_apply(build_model({**common, "shared_weights": True}), x)
+    _, v_plain = _init_and_apply(build_model({**common, "shared_weights": False}), x)
+    n_shared = sum(p.size for p in jax.tree.leaves(v_shared["params"]))
+    n_plain = sum(p.size for p in jax.tree.leaves(v_plain["params"]))
+    assert n_shared < n_plain / 2  # one layer's params instead of four
+
+
+def test_stochastic_depth_active_only_in_train_mode():
+    model = build_model({
+        "model": "transformer", "d_model": 16, "num_heads": 2, "num_layers": 1,
+        "dim_feedforward": 32, "stochastic_depth_rate": 0.9, "max_seq_length": 32,
+    })
+    x = jnp.ones((4, 8, 3))
+    variables = model.init({"params": jax.random.key(0), "dropout": jax.random.key(1)}, x)
+    d1 = model.apply(variables, x, deterministic=True)
+    d2 = model.apply(variables, x, deterministic=True)
+    np.testing.assert_allclose(d1, d2)  # eval is deterministic
+    t1 = model.apply(variables, x, deterministic=False,
+                     rngs={"dropout": jax.random.key(2)})
+    t2 = model.apply(variables, x, deterministic=False,
+                     rngs={"dropout": jax.random.key(3)})
+    assert not np.allclose(t1, t2)  # train mode is stochastic
+
+
+def test_blockwise_attention_matches_dense():
+    key = jax.random.key(0)
+    q, k, v = (jax.random.normal(kk, (2, 64, 4, 8)) for kk in jax.random.split(key, 3))
+    dense = dot_product_attention(q, k, v)
+    blocked = blockwise_attention(q, k, v, block_size=16)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(blocked),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_blockwise_attention_causal_matches_masked_dense():
+    key = jax.random.key(1)
+    q, k, v = (jax.random.normal(kk, (1, 32, 2, 8)) for kk in jax.random.split(key, 3))
+    mask = jnp.tril(jnp.ones((32, 32), bool))[None, None]
+    dense = dot_product_attention(q, k, v, mask=mask)
+    blocked = blockwise_attention(q, k, v, block_size=8, causal=True)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(blocked),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_linear_attention_causal_matches_quadratic_reference():
+    # Causal kernelized attention == explicit per-position normalization.
+    key = jax.random.key(2)
+    q, k, v = (jax.random.normal(kk, (1, 16, 2, 4)) for kk in jax.random.split(key, 3))
+    out = linear_attention(q, k, v, causal=True)
+
+    qf = np.asarray(jax.nn.elu(q) + 1.0)
+    kf = np.asarray(jax.nn.elu(k) + 1.0)
+    vn = np.asarray(v)
+    want = np.zeros_like(vn)
+    B, S, H, D = qf.shape
+    for b in range(B):
+        for h in range(H):
+            kv = np.zeros((D, vn.shape[-1]))
+            ks = np.zeros(D)
+            for s in range(S):
+                kv += np.outer(kf[b, s, h], vn[b, s, h])
+                ks += kf[b, s, h]
+                denom = qf[b, s, h] @ ks + 1e-6
+                want[b, s, h] = (qf[b, s, h] @ kv) / denom
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-5)
+
+
+def test_mlp_and_cnn_and_resnet_shapes():
+    x_seq = jnp.ones((4, 12, 6))
+    out, _ = _init_and_apply(build_model({"model": "mlp", "hidden_sizes": (32, 16)}), x_seq)
+    assert out.shape == (4, 1)
+    out, _ = _init_and_apply(build_model({"model": "cnn1d", "channels": (8, 16)}), x_seq)
+    assert out.shape == (4, 1)
+
+    resnet = build_model({"model": "resnet18"})
+    x_img = jnp.ones((2, 32, 32, 3))
+    variables = resnet.init({"params": jax.random.key(0)}, x_img)
+    assert "batch_stats" in variables
+    out = resnet.apply(variables, x_img)
+    assert out.shape == (2, 1)
+
+
+def test_invalid_attention_type_raises():
+    model = build_model({
+        "model": "transformer", "d_model": 16, "num_heads": 2,
+        "attention_type": "nope", "max_seq_length": 32,
+    })
+    with pytest.raises(ValueError, match="attention_type"):
+        _init_and_apply(model, jnp.ones((1, 4, 3)))
+
+
+def test_blockwise_attention_non_divisible_seq_len():
+    # Regression: block size must adapt to sequence lengths it doesn't divide.
+    model = build_model({
+        "model": "transformer", "d_model": 16, "num_heads": 2, "num_layers": 1,
+        "dim_feedforward": 32, "attention_type": "blockwise",
+        "max_seq_length": 256,
+    })
+    out, _ = _init_and_apply(model, jnp.ones((2, 200, 5)))  # 200 % 128 != 0
+    assert out.shape == (2, 1)

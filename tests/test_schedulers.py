@@ -1,0 +1,147 @@
+"""Scheduler unit tests: ASHA rung logic, median rule, PBT exploit/explore."""
+
+import numpy as np
+
+from distributed_machine_learning_tpu import tune
+from distributed_machine_learning_tpu.tune.schedulers.base import (
+    CONTINUE,
+    REQUEUE,
+    STOP,
+)
+from distributed_machine_learning_tpu.tune.trial import Trial
+
+
+def _mk_trial(i, config=None):
+    return Trial(trial_id=f"t{i:03d}", config=config or {})
+
+
+def _result(trial, it, value, metric="loss"):
+    r = {metric: value, "training_iteration": it}
+    trial.results.append(r)
+    return r
+
+
+class TestASHA:
+    def test_rungs_follow_eta(self):
+        s = tune.ASHAScheduler(metric="loss", mode="min", max_t=27,
+                               grace_period=1, reduction_factor=3)
+        assert s.rungs == [1, 3, 9, 27]
+
+    def test_bad_trials_stop_at_first_rung(self):
+        s = tune.ASHAScheduler(metric="loss", mode="min", max_t=9,
+                               grace_period=1, reduction_factor=2)
+        trials = [_mk_trial(i) for i in range(8)]
+        for t in trials:
+            s.on_trial_add(t)
+        decisions = []
+        # losses 0..7: later (worse) trials should be stopped at rung 1.
+        for i, t in enumerate(trials):
+            decisions.append(s.on_trial_result(t, _result(t, 1, float(i))))
+        assert decisions[0] == CONTINUE          # best seen so far always promoted
+        assert STOP in decisions[4:]             # clearly-bad trials cut
+
+    def test_max_t_stops(self):
+        s = tune.ASHAScheduler(metric="loss", mode="min", max_t=4)
+        t = _mk_trial(0)
+        s.on_trial_add(t)
+        assert s.on_trial_result(t, _result(t, 4, 0.1)) == STOP
+
+    def test_mode_max_inverts(self):
+        s = tune.ASHAScheduler(metric="acc", mode="max", max_t=8,
+                               grace_period=1, reduction_factor=2)
+        good, bad = _mk_trial(0), _mk_trial(1)
+        for t in (good, bad):
+            s.on_trial_add(t)
+        for i in range(4):
+            filler = _mk_trial(10 + i)
+            s.on_trial_add(filler)
+            s.on_trial_result(filler, _result(filler, 1, 0.5, "acc"))
+        assert s.on_trial_result(good, _result(good, 1, 0.9, "acc")) == CONTINUE
+        assert s.on_trial_result(bad, _result(bad, 1, 0.1, "acc")) == STOP
+
+
+class TestMedianStopping:
+    def test_below_median_trial_stops(self):
+        s = tune.MedianStoppingRule(metric="loss", mode="min", grace_period=1,
+                                    min_samples_required=3)
+        goods = [_mk_trial(i) for i in range(3)]
+        for it in (1, 2):
+            for t in goods:
+                s.on_trial_result(t, _result(t, it, 0.1))
+        bad = _mk_trial(9)
+        assert s.on_trial_result(bad, _result(bad, 1, 5.0)) == CONTINUE  # grace
+        assert s.on_trial_result(bad, _result(bad, 2, 5.0)) == STOP
+
+
+class TestPBT:
+    def _population(self, s, n=8):
+        trials = []
+        for i in range(n):
+            t = _mk_trial(i, {"learning_rate": 1e-3 * (i + 1)})
+            t.latest_checkpoint = f"/fake/ckpt_{i}"
+            s.on_trial_add(t)
+            trials.append(t)
+        return trials
+
+    def test_bottom_quantile_requeued_with_donor_weights(self):
+        s = tune.PopulationBasedTraining(
+            metric="loss", mode="min", perturbation_interval=2,
+            hyperparam_mutations={"learning_rate": tune.loguniform(1e-5, 1e-1)},
+        )
+        trials = self._population(s)
+        # iteration 2: trial i has loss i (t0 best, t7 worst)
+        decisions = {}
+        for i, t in enumerate(trials):
+            decisions[i] = s.on_trial_result(t, _result(t, 2, float(i)))
+        assert decisions[0] == CONTINUE
+        assert decisions[7] == REQUEUE
+        worst = trials[7]
+        assert worst.restore_path in {f"/fake/ckpt_{i}" for i in range(2)}
+        assert worst.config["learning_rate"] != 8e-3  # mutated
+
+    def test_no_perturbation_off_interval(self):
+        s = tune.PopulationBasedTraining(
+            metric="loss", mode="min", perturbation_interval=5,
+            hyperparam_mutations={"learning_rate": tune.loguniform(1e-5, 1e-1)},
+        )
+        trials = self._population(s)
+        for i, t in enumerate(trials):
+            assert s.on_trial_result(t, _result(t, 3, float(i))) == CONTINUE
+
+    def test_mutation_perturbs_or_resamples_within_domain(self):
+        s = tune.PopulationBasedTraining(
+            metric="loss", mode="min", perturbation_interval=1,
+            hyperparam_mutations={
+                "learning_rate": tune.loguniform(1e-5, 1e-1),
+                "batch_size": [16, 32, 64],
+            },
+        )
+        rng = np.random.default_rng(0)
+        for _ in range(50):
+            new = s._mutate({"learning_rate": 1e-3, "batch_size": 32}, rng)
+            assert new["batch_size"] in (16, 32, 64)
+            assert 0 < new["learning_rate"] < 1.0
+
+
+def test_set_experiment_propagates_mode_max():
+    # Regression: default mode must not mask the experiment's mode="max".
+    s = tune.ASHAScheduler(max_t=8, grace_period=1, reduction_factor=2)
+    s.set_experiment("acc", "max")
+    assert s.mode == "max"
+    for i in range(4):
+        t = _mk_trial(i)
+        s.on_trial_add(t)
+        s.on_trial_result(t, _result(t, 1, 0.5, "acc"))
+    good, bad = _mk_trial(10), _mk_trial(11)
+    s.on_trial_add(good); s.on_trial_add(bad)
+    assert s.on_trial_result(good, _result(good, 1, 0.9, "acc")) == CONTINUE
+    assert s.on_trial_result(bad, _result(bad, 1, 0.1, "acc")) == STOP
+
+    m = tune.MedianStoppingRule()
+    m.set_experiment("acc", "max")
+    assert m.mode == "max"
+    p = tune.PopulationBasedTraining(
+        perturbation_interval=1,
+        hyperparam_mutations={"lr": tune.loguniform(1e-5, 1e-1)})
+    p.set_experiment("acc", "max")
+    assert p.mode == "max"

@@ -1,0 +1,132 @@
+"""Search-space DSL unit tests (SURVEY.md §4: what the reference lacked)."""
+
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu import tune
+from distributed_machine_learning_tpu.tune.search_space import SearchSpace
+from distributed_machine_learning_tpu.utils.seeding import rng_from
+
+
+def test_domains_sample_within_bounds():
+    rng = rng_from("t", 0)
+    for _ in range(100):
+        assert tune.choice([1, 2, 3]).sample(rng) in (1, 2, 3)
+        assert 0.0 <= tune.uniform(0.0, 1.0).sample(rng) <= 1.0
+        v = tune.loguniform(1e-5, 1e-1).sample(rng)
+        assert 1e-5 <= v <= 1e-1
+        assert tune.randint(2, 8).sample(rng) in range(2, 8)
+        q = tune.quniform(0.0, 1.0, 0.25).sample(rng)
+        assert q in (0.0, 0.25, 0.5, 0.75, 1.0)
+
+
+def test_sampling_is_deterministic_per_seed():
+    space = SearchSpace({
+        "a": tune.choice(["x", "y", "z"]),
+        "b": tune.loguniform(1e-4, 1e-1),
+        "c": 42,
+    })
+    c1 = space.sample(("seed", 7, 3))
+    c2 = space.sample(("seed", 7, 3))
+    c3 = space.sample(("seed", 7, 4))
+    assert c1 == c2
+    assert c1 != c3
+    assert c1["c"] == 42  # literals pass through
+
+
+def test_sample_from_conditional_resolution():
+    # The reference's intended dim_feedforward = d_model * choice(2,3,4)
+    # (its version returned a sampler object — SURVEY.md §2 C19).
+    space = SearchSpace({
+        "d_model": tune.choice([64, 128]),
+        "dim_feedforward": tune.sample_from(
+            lambda cfg: cfg["d_model"] * tune.choice([2, 3, 4])
+        ),
+    })
+    for i in range(20):
+        cfg = space.sample(("s", i))
+        assert cfg["dim_feedforward"] in {
+            cfg["d_model"] * k for k in (2, 3, 4)
+        }
+        assert isinstance(cfg["dim_feedforward"], int)
+
+
+def test_sample_from_chained_dependencies_any_order():
+    space = SearchSpace({
+        "c": tune.sample_from(lambda cfg: cfg["b"] + 1),
+        "b": tune.sample_from(lambda cfg: cfg["a"] * 2),
+        "a": tune.choice([1, 2]),
+    })
+    cfg = space.sample(("s", 0))
+    assert cfg["b"] == cfg["a"] * 2
+    assert cfg["c"] == cfg["b"] + 1
+
+
+def test_sample_from_cycle_raises():
+    space = SearchSpace({
+        "a": tune.sample_from(lambda cfg: cfg["b"]),
+        "b": tune.sample_from(lambda cfg: cfg["a"]),
+    })
+    with pytest.raises(RuntimeError, match="Cyclic"):
+        space.sample(("s", 0))
+
+
+def test_constraints_reject_invalid_joint_samples():
+    space = SearchSpace(
+        {
+            "d_model": tune.choice([60, 64, 100, 128]),
+            "num_heads": tune.choice([3, 4, 8]),
+        },
+        constraints=[
+            tune.Constraint(
+                lambda c: c["d_model"] % c["num_heads"] == 0,
+                "d_model divisible by num_heads",
+            )
+        ],
+    )
+    for i in range(50):
+        cfg = space.sample(("s", i))
+        assert cfg["d_model"] % cfg["num_heads"] == 0
+
+
+def test_continuous_keys_and_unit_mapping():
+    space = SearchSpace({
+        "lr": tune.loguniform(1e-5, 1e-1),
+        "wd": tune.uniform(0.0, 0.1),
+        "opt": tune.choice(["adam", "sgd"]),
+    })
+    assert set(space.continuous_keys()) == {"lr", "wd"}
+    dom = space.domain("lr")
+    for v in (1e-5, 1e-3, 1e-1):
+        assert np.isclose(dom.from_unit(dom.to_unit(v)), v, rtol=1e-6)
+
+
+def test_nested_sample_from_defers_cleanly():
+    # Regression: a nested SampleFrom referencing a not-yet-resolved key must
+    # defer to the next fixpoint pass, not leak the internal exception.
+    space = SearchSpace({
+        "a": tune.sample_from(
+            lambda c: tune.sample_from(lambda c2: c2["b"] * 2)),
+        "b": tune.sample_from(lambda c: 5),
+    })
+    cfg = space.sample(("s", 0))
+    assert cfg["a"] == 10 and cfg["b"] == 5
+
+
+def test_grid_search_skips_infeasible_points():
+    from distributed_machine_learning_tpu.tune.search import GridSearch
+
+    space = SearchSpace(
+        {"d_model": tune.choice([64, 100]), "num_heads": tune.choice([4, 8])},
+        constraints=[tune.Constraint(lambda c: c["d_model"] % c["num_heads"] == 0)],
+    )
+    gs = GridSearch()
+    gs.set_search_space(space, seed=0)
+    configs = []
+    i = 0
+    while (cfg := gs.suggest(i)) is not None:
+        configs.append(cfg)
+        i += 1
+    # (100, 8) is infeasible and must be skipped, not crash.
+    assert len(configs) == 3
+    assert all(c["d_model"] % c["num_heads"] == 0 for c in configs)
