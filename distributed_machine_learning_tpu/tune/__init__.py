@@ -23,6 +23,7 @@ from distributed_machine_learning_tpu.tune.runner import run
 from distributed_machine_learning_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
     TrialScheduler,
@@ -32,6 +33,7 @@ from distributed_machine_learning_tpu.tune.search import (
     GridSearch,
     RandomSearch,
     Searcher,
+    TPESearch,
 )
 from distributed_machine_learning_tpu.tune.search_space import (
     Constraint,
@@ -72,6 +74,7 @@ __all__ = [
     "Constraint",
     "SearchSpace",
     "ASHAScheduler",
+    "HyperBandScheduler",
     "FIFOScheduler",
     "MedianStoppingRule",
     "PopulationBasedTraining",
@@ -79,6 +82,7 @@ __all__ = [
     "RandomSearch",
     "GridSearch",
     "BayesOptSearch",
+    "TPESearch",
     "Searcher",
     "ExperimentAnalysis",
     "ExperimentStore",

@@ -203,7 +203,14 @@ def run(
             trial.results.append(metrics)
             store.append_result(trial, metrics)
 
+            # Snapshot before the scheduler runs: PBT mutates trial.config in
+            # place on REQUEUE, and the searcher must see the config that
+            # actually produced these metrics.
+            reported_config = dict(trial.config)
             decision = sched.on_trial_result(trial, metrics)
+            searcher.on_trial_result(
+                trial.trial_id, reported_config, metrics, metric, mode
+            )
             if stop and any(
                 k in metrics and float(metrics[k]) >= v for k, v in stop.items()
             ):

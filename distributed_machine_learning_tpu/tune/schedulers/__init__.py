@@ -6,11 +6,15 @@ from distributed_machine_learning_tpu.tune.schedulers.base import (
     STOP,
     TrialScheduler,
 )
+from distributed_machine_learning_tpu.tune.schedulers.hyperband import (
+    HyperBandScheduler,
+)
 from distributed_machine_learning_tpu.tune.schedulers.median import MedianStoppingRule
 from distributed_machine_learning_tpu.tune.schedulers.pbt import PopulationBasedTraining
 
 __all__ = [
     "ASHAScheduler",
+    "HyperBandScheduler",
     "FIFOScheduler",
     "MedianStoppingRule",
     "PopulationBasedTraining",

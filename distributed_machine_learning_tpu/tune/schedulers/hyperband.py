@@ -1,0 +1,108 @@
+"""Async HyperBand: the bracket scheduler half of BOHB.
+
+The reference only used ASHA (`ray-tune-hpo-regression.py:473`), but the
+framework's north star (BASELINE.json configs; SURVEY.md §2b D1) also calls for
+BOHB = async HyperBand brackets (Li et al. 2018) + a TPE model proposing
+configs (Falkner et al. 2018, `search/tpe.py`).
+
+A single successive-halving bracket commits to one grace period; HyperBand
+hedges by running several brackets whose grace periods span
+``grace_period * eta^s`` for s = 0..num_brackets-1, assigning new trials to
+brackets round-robin weighted by each bracket's trial budget.  Each bracket is
+an independent :class:`ASHAScheduler` (async, so no barrier at rung
+boundaries — a stopped trial frees its TPU core immediately).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from distributed_machine_learning_tpu.tune.schedulers.asha import ASHAScheduler
+from distributed_machine_learning_tpu.tune.schedulers.base import (
+    CONTINUE,
+    TrialScheduler,
+)
+from distributed_machine_learning_tpu.tune.trial import Trial
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Asynchronous HyperBand over per-epoch metric streams.
+
+    Pair with :class:`~distributed_machine_learning_tpu.tune.search.tpe.TPESearch`
+    for BOHB.
+    """
+
+    def __init__(
+        self,
+        metric: str = None,
+        mode: str = None,
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: float = 3.0,
+        num_brackets: int = 3,
+        time_attr: str = "training_iteration",
+    ):
+        if num_brackets < 1:
+            raise ValueError("num_brackets must be >= 1")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.eta = reduction_factor
+        self.time_attr = time_attr
+
+        self.brackets: List[ASHAScheduler] = []
+        for s in range(num_brackets):
+            g = int(grace_period * reduction_factor**s)
+            if s > 0 and g >= max_t:
+                break  # a bracket whose first rung is max_t never stops anything
+            self.brackets.append(
+                ASHAScheduler(
+                    metric=metric,
+                    mode=mode,
+                    max_t=max_t,
+                    grace_period=g,
+                    reduction_factor=reduction_factor,
+                    time_attr=time_attr,
+                )
+            )
+        # HyperBand allocates the most trials to the most-aggressive bracket
+        # (smallest grace period, most halvings): n_s ~ eta^s where s counts
+        # halvings remaining, i.e. weight eta^(num_brackets-1-idx) for bracket
+        # idx ordered by increasing grace period.
+        n = len(self.brackets)
+        self._weights = [self.eta ** (n - 1 - i) for i in range(n)]
+        self._assigned_counts = [0] * len(self.brackets)
+        self._trial_bracket: Dict[str, int] = {}
+
+    def set_experiment(self, metric: str, mode: str):
+        self.metric = self.metric if self.metric is not None else metric
+        self.mode = self.mode if self.mode is not None else mode
+        for b in self.brackets:
+            b.set_experiment(self.metric, self.mode)
+
+    def _pick_bracket(self) -> int:
+        # Fill towards the target proportions: pick the bracket with the
+        # largest deficit of assigned trials vs its weight share.
+        total_w = sum(self._weights)
+        total_n = sum(self._assigned_counts) + 1
+        deficits = [
+            w / total_w - n / total_n
+            for w, n in zip(self._weights, self._assigned_counts)
+        ]
+        return max(range(len(deficits)), key=lambda i: deficits[i])
+
+    def on_trial_add(self, trial: Trial):
+        idx = self._pick_bracket()
+        self._assigned_counts[idx] += 1
+        self._trial_bracket[trial.trial_id] = idx
+        self.brackets[idx].on_trial_add(trial)
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        idx = self._trial_bracket.get(trial.trial_id)
+        if idx is None:
+            return CONTINUE
+        return self.brackets[idx].on_trial_result(trial, result)
+
+    def debug_state(self) -> List[Dict[int, int]]:
+        return [b.debug_state() for b in self.brackets]

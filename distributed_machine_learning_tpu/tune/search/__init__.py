@@ -4,5 +4,6 @@ from distributed_machine_learning_tpu.tune.search.base import (
     Searcher,
 )
 from distributed_machine_learning_tpu.tune.search.bayesopt import BayesOptSearch
+from distributed_machine_learning_tpu.tune.search.tpe import TPESearch
 
-__all__ = ["Searcher", "RandomSearch", "GridSearch", "BayesOptSearch"]
+__all__ = ["Searcher", "RandomSearch", "GridSearch", "BayesOptSearch", "TPESearch"]

@@ -20,6 +20,24 @@ class Searcher:
         """Propose a config for trial #``trial_index``; None when exhausted."""
         raise NotImplementedError
 
+    def _effective_score(self, result: Optional[Dict[str, Any]], metric: str,
+                         mode: str) -> Optional[float]:
+        """Resolve searcher-level metric/mode overrides against the experiment
+        defaults and normalize so LOWER is always better; None if absent."""
+        own_metric = getattr(self, "metric", None)
+        own_mode = getattr(self, "mode", None)
+        metric = own_metric if own_metric is not None else metric
+        mode = own_mode if own_mode is not None else mode
+        if not result or metric not in result:
+            return None
+        score = float(result[metric])
+        return -score if mode == "max" else score
+
+    def on_trial_result(self, trial_id: str, config: Dict[str, Any],
+                        result: Dict[str, Any], metric: str, mode: str):
+        """Per-epoch observation hook (multi-fidelity searchers, e.g. TPE/BOHB)."""
+        pass
+
     def on_trial_complete(self, trial_id: str, config: Dict[str, Any],
                           result: Optional[Dict[str, Any]], metric: str, mode: str):
         pass

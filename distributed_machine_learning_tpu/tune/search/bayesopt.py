@@ -104,12 +104,8 @@ class BayesOptSearch(Searcher):
         return config
 
     def on_trial_complete(self, trial_id, config, result, metric, mode):
-        metric = self.metric if self.metric is not None else metric
-        mode = self.mode if self.mode is not None else mode
-        if not result or metric not in result or not self._cont_keys:
+        score = self._effective_score(result, metric, mode)
+        if score is None or not self._cont_keys:
             return
-        score = float(result[metric])
-        if mode == "max":
-            score = -score
         self._X.append(self._encode(config))
         self._y.append(score)

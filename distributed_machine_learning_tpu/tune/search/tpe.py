@@ -1,0 +1,205 @@
+"""TPE (Tree-structured Parzen Estimator) search — the model half of BOHB.
+
+The reference's only model-based search was a broken BayesOpt-over-categoricals
+(`ray-tune-hpo-regression.py:474`; SURVEY.md §2b D2).  TPE (Bergstra et al.
+2011) handles the mixed continuous/categorical spaces the reference actually
+declares: observations are split into a *good* set (top ``gamma`` quantile by
+score) and a *bad* set; candidates are drawn from a Parzen (kernel-density)
+model of the good set and ranked by the density ratio l(x)/g(x).
+
+BOHB twist (Falkner et al. 2018): with a multi-fidelity scheduler reporting
+per-epoch results, the model is fit on the observations from the **largest
+budget** (``training_iteration``) that has at least ``min_points`` samples, so
+early-stopped trials still inform the model without drowning out full-budget
+signal.  Per-epoch observations arrive through ``on_trial_result``.
+
+Pure numpy; 1-D kernels per hyperparameter:
+
+* continuous domains (uniform/loguniform) — Gaussian KDE in the unit cube
+  (bandwidth per Scott's rule, floored), reflected at the [0,1] borders;
+* ``choice`` domains — smoothed categorical frequencies;
+* other/int domains — resampled from the prior (random), as in hyperopt.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from distributed_machine_learning_tpu.tune.search.base import Searcher
+from distributed_machine_learning_tpu.tune.search_space import Choice, SearchSpace
+from distributed_machine_learning_tpu.utils.seeding import rng_from
+
+
+class _ParzenModel:
+    """Per-key 1-D Parzen densities over one observation set."""
+
+    def __init__(self, configs: List[Dict[str, Any]], space: SearchSpace,
+                 cont_keys: List[str], cat_keys: List[str]):
+        self.space = space
+        self.cont_keys = cont_keys
+        self.cat_keys = cat_keys
+        # Continuous: unit-cube coordinates per key.
+        self.cont: Dict[str, np.ndarray] = {}
+        self.bw: Dict[str, float] = {}
+        self._pts: Dict[str, np.ndarray] = {}  # observations + border reflections
+        for k in cont_keys:
+            x = np.array(
+                [space.domain(k).to_unit(c[k]) for c in configs], dtype=np.float64
+            )
+            self.cont[k] = x
+            n = max(len(x), 1)
+            scott = n ** (-0.2) * (x.std() + 1e-3)
+            self.bw[k] = float(np.clip(scott, 0.05, 0.5))
+            self._pts[k] = np.concatenate([x, -x, 2.0 - x])
+        # Categorical: smoothed counts.
+        self.cat: Dict[str, np.ndarray] = {}
+        self._cats: Dict[str, list] = {}
+        self._cat_index: Dict[str, Dict[Any, int]] = {}
+        for k in cat_keys:
+            cats = list(space.domain(k).categories)
+            self._cats[k] = cats
+            self._cat_index[k] = {v: i for i, v in enumerate(cats)}
+            counts = np.ones(len(cats), dtype=np.float64)  # +1 smoothing
+            for c in configs:
+                idx = self._cat_index[k].get(c[k])
+                if idx is not None:
+                    counts[idx] += 1.0
+                # else: value came from an override outside the domain
+            self.cat[k] = counts / counts.sum()
+
+    def sample_cont(self, k: str, rng: np.random.Generator) -> float:
+        x = self.cont[k]
+        if len(x) == 0:
+            return float(rng.random())
+        center = float(x[int(rng.integers(len(x)))])
+        u = rng.normal(center, self.bw[k])
+        # Reflect at the borders (modular fold handles multiple bounces so a
+        # draw past 2.0 folds back toward 1.0, not to the opposite border).
+        u = abs(u) % 2.0
+        if u > 1.0:
+            u = 2.0 - u
+        return float(u)
+
+    def logpdf_cont(self, k: str, u: float) -> float:
+        x = self.cont[k]
+        if len(x) == 0:
+            return 0.0
+        bw = self.bw[k]
+        # Mixture of Gaussians at observations (+ reflections at 0 and 1).
+        z = (u - self._pts[k]) / bw
+        dens = np.exp(-0.5 * z**2).sum() / (len(x) * bw * np.sqrt(2 * np.pi))
+        return float(np.log(dens + 1e-12))
+
+    def sample_cat(self, k: str, rng: np.random.Generator) -> Any:
+        cats = self._cats[k]
+        return cats[int(rng.choice(len(cats), p=self.cat[k]))]
+
+    def logpdf_cat(self, k: str, value: Any) -> float:
+        idx = self._cat_index[k].get(value)
+        if idx is None:
+            return float(np.log(1e-12))
+        return float(np.log(self.cat[k][idx] + 1e-12))
+
+
+class TPESearch(Searcher):
+    """TPE over the declared search space; BOHB when paired with
+    :class:`~...schedulers.hyperband.HyperBandScheduler`."""
+
+    def __init__(
+        self,
+        metric: str = None,
+        mode: str = None,
+        n_initial_points: int = 10,
+        gamma: float = 0.25,
+        num_candidates: int = 64,
+        min_points: int = 8,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.n_initial = n_initial_points
+        self.gamma = gamma
+        self.num_candidates = num_candidates
+        self.min_points = min_points
+        # budget (training_iteration) -> list of (score, config); one entry per
+        # trial per budget, latest report wins.
+        self._obs: Dict[int, Dict[str, Tuple[float, Dict[str, Any]]]] = {}
+
+    def set_search_space(self, space: SearchSpace, seed: int):
+        super().set_search_space(space, seed)
+        self._cont_keys = space.continuous_keys()
+        self._cat_keys = [
+            k for k, v in space.space.items() if isinstance(v, Choice)
+        ]
+
+    # -- observation ingestion ------------------------------------------------
+    def _record(self, trial_id: str, config: Dict[str, Any],
+                result: Optional[Dict[str, Any]], metric: str, mode: str):
+        score = self._effective_score(result, metric, mode)
+        if score is None or not np.isfinite(score):
+            return
+        budget = int(result.get("training_iteration", 1))
+        self._obs.setdefault(budget, {})[trial_id] = (score, dict(config))
+
+    def on_trial_result(self, trial_id: str, config: Dict[str, Any],
+                        result: Dict[str, Any], metric: str, mode: str):
+        self._record(trial_id, config, result, metric, mode)
+
+    def on_trial_complete(self, trial_id, config, result, metric, mode):
+        self._record(trial_id, config, result, metric, mode)
+
+    # -- model ----------------------------------------------------------------
+    def _training_set(self) -> List[Tuple[float, Dict[str, Any]]]:
+        """Observations at the largest budget with >= min_points samples."""
+        for budget in sorted(self._obs, reverse=True):
+            if len(self._obs[budget]) >= self.min_points:
+                return list(self._obs[budget].values())
+        # Fall back to the most-populated budget.
+        if self._obs:
+            best = max(self._obs.values(), key=len)
+            return list(best.values())
+        return []
+
+    def suggest(self, trial_index: int) -> Optional[Dict[str, Any]]:
+        base = self.space.sample(("tpe", self.seed, trial_index))
+        obs = self._training_set()
+        if len(obs) < max(self.n_initial, 2) or not (
+            self._cont_keys or self._cat_keys
+        ):
+            return base
+
+        rng = rng_from("tpe-model", self.seed, trial_index)
+        obs.sort(key=lambda sc: sc[0])
+        n_good = max(1, int(np.ceil(self.gamma * len(obs))))
+        good = [c for _, c in obs[:n_good]]
+        bad = [c for _, c in obs[n_good:]] or good
+        l = _ParzenModel(good, self.space, self._cont_keys, self._cat_keys)
+        g = _ParzenModel(bad, self.space, self._cont_keys, self._cat_keys)
+
+        # Score candidate override-sets by density ratio, then resolve the
+        # winners through the space so sample_from keys that depend on the
+        # overridden values (e.g. dim_feedforward = d_model * k) re-resolve
+        # and joint constraints are enforced.
+        scored: List[Tuple[float, Dict[str, Any]]] = []
+        for _ in range(self.num_candidates):
+            over: Dict[str, Any] = {}
+            ratio = 0.0
+            for k in self._cont_keys:
+                u = l.sample_cont(k, rng)
+                over[k] = self.space.domain(k).from_unit(u)
+                ratio += l.logpdf_cont(k, u) - g.logpdf_cont(k, u)
+            for k in self._cat_keys:
+                v = l.sample_cat(k, rng)
+                over[k] = v
+                ratio += l.logpdf_cat(k, v) - g.logpdf_cat(k, v)
+            scored.append((ratio, over))
+        scored.sort(key=lambda ro: -ro[0])
+        for _, over in scored:
+            try:
+                return self.space.with_overrides(**over).sample(
+                    ("tpe-resolve", self.seed, trial_index)
+                )
+            except RuntimeError:
+                continue  # overrides violate joint constraints; try next best
+        return base
