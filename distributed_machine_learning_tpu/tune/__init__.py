@@ -15,6 +15,12 @@ Mirrors the surface the reference consumed from Ray Tune
     print(analysis.best_config)
 """
 
+from distributed_machine_learning_tpu.tune.callbacks import (
+    Callback,
+    JsonlCallback,
+    LoggerCallback,
+    ProfilerCallback,
+)
 from distributed_machine_learning_tpu.tune.experiment import (
     ExperimentAnalysis,
     ExperimentStore,
@@ -86,6 +92,10 @@ __all__ = [
     "Searcher",
     "ExperimentAnalysis",
     "ExperimentStore",
+    "Callback",
+    "LoggerCallback",
+    "JsonlCallback",
+    "ProfilerCallback",
     "Resources",
     "Trial",
     "TrialStatus",

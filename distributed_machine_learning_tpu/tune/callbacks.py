@@ -1,0 +1,168 @@
+"""Experiment callbacks: observability hooks on the runner's event loop.
+
+The reference had no observability beyond a log file and Ray's results dir
+(SURVEY.md §5).  Callbacks receive every trial lifecycle event from the
+single-threaded runner loop (so they never need locks) and power the built-in
+structured logging, JSONL event stream, and profiler integration.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from distributed_machine_learning_tpu.tune.trial import Trial
+from distributed_machine_learning_tpu.utils.logging import (
+    JsonlEventLog,
+    add_file_handler,
+    get_logger,
+    remove_handler,
+)
+
+
+class Callback:
+    """Base class; override any subset of hooks."""
+
+    def setup(self, experiment_root: str, metric: str, mode: str):
+        pass
+
+    def on_trial_start(self, trial: Trial):
+        pass
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]):
+        pass
+
+    def on_trial_complete(self, trial: Trial):
+        pass
+
+    def on_trial_error(self, trial: Trial, error: str):
+        pass
+
+    def on_experiment_end(self, trials: List[Trial], wall_clock_s: float):
+        pass
+
+
+class LoggerCallback(Callback):
+    """Structured per-event logging through the framework logger tree.
+
+    Replaces the reference's hard-coded-path file logging (C23,
+    `ray-tune-hpo-regression-sample.py:16-23`): pass ``log_file`` to also log
+    to a file of your choosing.
+    """
+
+    def __init__(self, log_file: Optional[str] = None):
+        self._log_file = log_file
+        self._log = None
+        self._handler = None
+
+    def setup(self, experiment_root: str, metric: str, mode: str):
+        self._log = get_logger("tune")
+        if self._log_file is not None:
+            self._handler = add_file_handler(self._log_file)
+        self._metric = metric
+        self._log.info("experiment started (root=%s, metric=%s/%s)",
+                       experiment_root, metric, mode)
+
+    def on_trial_start(self, trial: Trial):
+        self._log.info("%s started: %s", trial.trial_id, trial.config)
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]):
+        val = result.get(self._metric)
+        self._log.info("%s iter %s: %s=%s", trial.trial_id,
+                       result.get("training_iteration"), self._metric, val)
+
+    def on_trial_complete(self, trial: Trial):
+        self._log.info("%s terminated after %d result(s) in %.1fs",
+                       trial.trial_id, len(trial.results), trial.runtime_s())
+
+    def on_trial_error(self, trial: Trial, error: str):
+        self._log.error("%s errored: %s", trial.trial_id,
+                        error.strip().splitlines()[-1] if error else "?")
+
+    def on_experiment_end(self, trials: List[Trial], wall_clock_s: float):
+        self._log.info("experiment finished: %d trials in %.1fs",
+                       len(trials), wall_clock_s)
+        if self._handler is not None:
+            remove_handler(self._handler)
+            self._handler = None
+
+
+class JsonlCallback(Callback):
+    """Machine-readable experiment event stream -> ``<root>/events.jsonl``."""
+
+    def __init__(self, path: Optional[str] = None):
+        self._path = path
+        self._log: Optional[JsonlEventLog] = None
+
+    def setup(self, experiment_root: str, metric: str, mode: str):
+        path = self._path or os.path.join(experiment_root, "events.jsonl")
+        self._log = JsonlEventLog(path)
+        self._log.write("experiment_start", {"root": experiment_root,
+                                             "metric": metric, "mode": mode})
+
+    def on_trial_start(self, trial: Trial):
+        self._log.write("trial_start", {"trial_id": trial.trial_id,
+                                        "config": trial.config})
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]):
+        # The runner already stamps trial_id into each result record.
+        self._log.write("trial_result", {**result, "trial_id": trial.trial_id})
+
+    def on_trial_complete(self, trial: Trial):
+        self._log.write("trial_complete", {"trial_id": trial.trial_id,
+                                           "num_results": len(trial.results),
+                                           "runtime_s": trial.runtime_s()})
+
+    def on_trial_error(self, trial: Trial, error: str):
+        self._log.write("trial_error", {"trial_id": trial.trial_id,
+                                        "error": error})
+
+    def on_experiment_end(self, trials: List[Trial], wall_clock_s: float):
+        self._log.write("experiment_end", {"num_trials": len(trials),
+                                           "wall_clock_s": wall_clock_s})
+        self._log.close()
+
+
+class ProfilerCallback(Callback):
+    """Capture a ``jax.profiler`` trace of the experiment.
+
+    The trace is process-global (trials share the process), so this profiles
+    the whole sweep — XLA compilations, device compute, and the host-side
+    scheduler — into ``<root>/profile`` for TensorBoard/XProf.  ``duration_s``
+    bounds the capture window to keep traces small on long sweeps.
+    """
+
+    def __init__(self, logdir: Optional[str] = None,
+                 duration_s: Optional[float] = None):
+        self._logdir = logdir
+        self._duration_s = duration_s
+        self._started_at: Optional[float] = None
+        self._active = False
+
+    def setup(self, experiment_root: str, metric: str, mode: str):
+        import jax
+
+        self._dir = self._logdir or os.path.join(experiment_root, "profile")
+        jax.profiler.start_trace(self._dir)
+        self._active = True
+        self._started_at = time.time()
+
+    def _maybe_stop(self):
+        if self._active and self._duration_s is not None and (
+            time.time() - self._started_at > self._duration_s
+        ):
+            self._stop()
+
+    def _stop(self):
+        import jax
+
+        if self._active:
+            self._active = False
+            jax.profiler.stop_trace()
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]):
+        self._maybe_stop()
+
+    def on_experiment_end(self, trials: List[Trial], wall_clock_s: float):
+        self._stop()

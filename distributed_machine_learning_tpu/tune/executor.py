@@ -20,6 +20,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 import traceback
 from typing import Callable, Dict, List, Optional
 
@@ -36,13 +37,19 @@ from distributed_machine_learning_tpu.tune.trial import Trial
 
 
 class DeviceManager:
-    """Leases jax devices to trials. Thread-compatible (runner-thread only)."""
+    """Leases jax devices to trials. Thread-compatible (runner-thread only).
+
+    Tracks per-device busy time so the runner can report chip utilization
+    (the BASELINE.md ≥90%-utilization target needs to be measurable).
+    """
 
     def __init__(self, devices: Optional[List] = None):
         self.devices = list(devices) if devices is not None else list(jax.devices())
         if not self.devices:
             raise RuntimeError("No jax devices available")
         self._free = list(range(len(self.devices)))
+        self._busy_s = [0.0] * len(self.devices)
+        self._leased_at: Dict[int, float] = {}
 
     @property
     def num_devices(self) -> int:
@@ -60,12 +67,29 @@ class DeviceManager:
         if len(self._free) < n:
             return None
         idxs = [self._free.pop(0) for _ in range(n)]
+        now = time.time()
+        for i in idxs:
+            self._leased_at[i] = now
         return [(i, self.devices[i]) for i in idxs]
 
     def release(self, leased: List):
+        now = time.time()
         for i, _ in leased:
             self._free.append(i)
+            start = self._leased_at.pop(i, None)
+            if start is not None:
+                self._busy_s[i] += now - start
         self._free.sort()
+
+    def utilization(self, wall_clock_s: float) -> float:
+        """Fraction of device-seconds spent leased to trials over the run."""
+        if wall_clock_s <= 0:
+            return 0.0
+        now = time.time()
+        busy = sum(self._busy_s) + sum(
+            now - start for start in self._leased_at.values()
+        )
+        return min(busy / (wall_clock_s * len(self.devices)), 1.0)
 
 
 class ResultEvent:
@@ -126,7 +150,11 @@ class ThreadTrialExecutor:
 
         set_session(Session(trial, report_fn, checkpoint_loader, devices))
         try:
-            with jax.default_device(devices[0]):
+            # TraceAnnotation tags this trial's host activity in profiler
+            # captures (ProfilerCallback), so per-trial spans are visible.
+            with jax.default_device(devices[0]), jax.profiler.TraceAnnotation(
+                f"trial:{trial.trial_id}"
+            ):
                 trainable(dict(trial.config))
             self.events.put(("complete", trial, None))
         except (StopTrial, PauseTrial):

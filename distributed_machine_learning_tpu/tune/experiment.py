@@ -105,6 +105,7 @@ class ExperimentAnalysis:
         mode: str = "min",
         root: Optional[str] = None,
         wall_clock_s: float = 0.0,
+        device_utilization: float = 0.0,
     ):
         if mode not in ("min", "max"):
             raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
@@ -113,6 +114,7 @@ class ExperimentAnalysis:
         self.mode = mode
         self.root = root
         self.wall_clock_s = wall_clock_s
+        self.device_utilization = device_utilization
 
     def _score(self, trial: Trial) -> Optional[float]:
         hist = trial.metric_history(self.metric)

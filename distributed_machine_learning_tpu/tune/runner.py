@@ -63,6 +63,7 @@ def run(
     time_budget_s: Optional[float] = None,
     devices: Optional[List] = None,
     verbose: int = 1,
+    callbacks: Optional[List] = None,
 ) -> ExperimentAnalysis:
     """Run an HPO experiment; see module docstring.
 
@@ -89,6 +90,9 @@ def run(
     device_mgr = DeviceManager(devices)
     events: "queue.Queue" = queue.Queue()
     executor = ThreadTrialExecutor(store, events)
+    callbacks = list(callbacks or [])
+    for cb in callbacks:
+        cb.setup(store.root, metric, mode)
 
     max_concurrent = max_concurrent or device_mgr.num_devices
     trials: List[Trial] = []
@@ -135,6 +139,8 @@ def run(
             trial.started_at = trial.started_at or time.time()
             trial.stop_requested = False
             running[trial.trial_id] = leased
+            for cb in callbacks:
+                cb.on_trial_start(trial)
             executor.start_trial(trial, trainable, leased)
 
     def finish_trial(trial: Trial, status: TrialStatus):
@@ -157,110 +163,146 @@ def run(
         pending.append(trial)
 
     # -------- main event loop ------------------------------------------------
-    while True:
-        while len(trials) < num_samples and not searcher_exhausted and (
-            len(pending) + len(running) < max_concurrent + 2
-        ):
-            before = len(trials)
-            maybe_create_trial()
-            if len(trials) == before:
-                break
-        launch_ready()
-
-        if not running and not pending:
-            if searcher_exhausted or len(trials) >= num_samples or budget_exceeded():
-                break
-            if len(trials) == 0 and next_index == 0:
-                break  # nothing to do at all
-            continue
-
-        try:
-            event = events.get(timeout=0.5)
-        except queue.Empty:
-            if verbose and time.time() - last_status_print > 15:
-                last_status_print = time.time()
-                log(
-                    f"{sum(t.status == TrialStatus.TERMINATED for t in trials)}"
-                    f"/{num_samples} done, {len(running)} running, "
-                    f"{device_mgr.num_free}/{device_mgr.num_devices} cores free"
-                )
-            # Reap threads that died without reporting (shouldn't happen).
-            for tid in list(running):
-                trial = next(t for t in trials if t.trial_id == tid)
-                if not executor.is_alive(trial):
-                    finish_trial(trial, TrialStatus.ERROR)
-            continue
-
-        kind = event[0]
-        if kind == "result":
-            result_event = event[1]
-            trial = result_event.trial
-            metrics = dict(result_event.metrics)
-            metrics.setdefault("training_iteration", trial.training_iteration + 1)
-            metrics["trial_id"] = trial.trial_id
-            metrics["timestamp"] = time.time()
-            metrics["time_total_s"] = trial.runtime_s()
-            trial.results.append(metrics)
-            store.append_result(trial, metrics)
-
-            # Snapshot before the scheduler runs: PBT mutates trial.config in
-            # place on REQUEUE, and the searcher must see the config that
-            # actually produced these metrics.
-            reported_config = dict(trial.config)
-            decision = sched.on_trial_result(trial, metrics)
-            searcher.on_trial_result(
-                trial.trial_id, reported_config, metrics, metric, mode
-            )
-            if stop and any(
-                k in metrics and float(metrics[k]) >= v for k, v in stop.items()
+    def event_loop():
+        nonlocal last_status_print
+        while True:
+            while len(trials) < num_samples and not searcher_exhausted and (
+                len(pending) + len(running) < max_concurrent + 2
             ):
-                decision = STOP if decision == CONTINUE else decision
-            if trial.stop_requested or budget_exceeded():
-                decision = STOP
-            if decision == REQUEUE:
-                trial._requeue_on_complete = True
-                decision = STOP
-            result_event.decision = "stop" if decision == STOP else "continue"
-            result_event.done.set()
+                before = len(trials)
+                maybe_create_trial()
+                if len(trials) == before:
+                    break
+            launch_ready()
 
-        elif kind == "complete":
-            trial = event[1]
-            if getattr(trial, "_requeue_on_complete", False):
-                trial._requeue_on_complete = False
-                requeue_trial(trial)
-            else:
-                finish_trial(trial, TrialStatus.TERMINATED)
-            store.write_state(trials)
+            if not running and not pending:
+                if (
+                    searcher_exhausted
+                    or len(trials) >= num_samples
+                    or budget_exceeded()
+                ):
+                    break
+                if len(trials) == 0 and next_index == 0:
+                    break  # nothing to do at all
+                continue
 
-        elif kind == "error":
-            trial, tb = event[1], event[2]
-            trial.error = tb
-            trial.num_failures += 1
-            if trial.num_failures <= max_failures:
-                log(
-                    f"{trial.trial_id} failed ({trial.num_failures}/{max_failures}); "
-                    "retrying"
-                    + (" from checkpoint" if trial.latest_checkpoint else "")
+            try:
+                event = events.get(timeout=0.5)
+            except queue.Empty:
+                if verbose and time.time() - last_status_print > 15:
+                    last_status_print = time.time()
+                    log(
+                        f"{sum(t.status == TrialStatus.TERMINATED for t in trials)}"
+                        f"/{num_samples} done, {len(running)} running, "
+                        f"{device_mgr.num_free}/{device_mgr.num_devices} cores free"
+                    )
+                # Reap threads that died without reporting (shouldn't happen).
+                for tid in list(running):
+                    trial = next(t for t in trials if t.trial_id == tid)
+                    if not executor.is_alive(trial):
+                        finish_trial(trial, TrialStatus.ERROR)
+                        for cb in callbacks:
+                            cb.on_trial_error(
+                                trial, "trial thread died without reporting"
+                            )
+                continue
+
+            kind = event[0]
+            if kind == "result":
+                result_event = event[1]
+                trial = result_event.trial
+                metrics = dict(result_event.metrics)
+                metrics.setdefault(
+                    "training_iteration", trial.training_iteration + 1
                 )
-                if trial.latest_checkpoint:
-                    trial.restore_path = trial.latest_checkpoint
-                requeue_trial(trial)
-            else:
-                if verbose:
-                    log(f"{trial.trial_id} errored:\n{tb}")
-                finish_trial(trial, TrialStatus.ERROR)
-                sched.on_trial_error(trial)
-            store.write_state(trials)
+                metrics["trial_id"] = trial.trial_id
+                metrics["timestamp"] = time.time()
+                metrics["time_total_s"] = trial.runtime_s()
+                trial.results.append(metrics)
+                store.append_result(trial, metrics)
 
-    wall = time.time() - start_time
-    store.write_state(trials, extra={"wall_clock_s": wall})
-    store.close()
+                # Snapshot before the scheduler runs: PBT mutates trial.config
+                # in place on REQUEUE, and the searcher must see the config
+                # that actually produced these metrics.
+                reported_config = dict(trial.config)
+                decision = sched.on_trial_result(trial, metrics)
+                searcher.on_trial_result(
+                    trial.trial_id, reported_config, metrics, metric, mode
+                )
+                for cb in callbacks:
+                    cb.on_trial_result(trial, metrics)
+                if stop and any(
+                    k in metrics and float(metrics[k]) >= v
+                    for k, v in stop.items()
+                ):
+                    decision = STOP if decision == CONTINUE else decision
+                if trial.stop_requested or budget_exceeded():
+                    decision = STOP
+                if decision == REQUEUE:
+                    trial._requeue_on_complete = True
+                    decision = STOP
+                result_event.decision = "stop" if decision == STOP else "continue"
+                result_event.done.set()
+
+            elif kind == "complete":
+                trial = event[1]
+                if getattr(trial, "_requeue_on_complete", False):
+                    trial._requeue_on_complete = False
+                    requeue_trial(trial)
+                else:
+                    finish_trial(trial, TrialStatus.TERMINATED)
+                    for cb in callbacks:
+                        cb.on_trial_complete(trial)
+                store.write_state(trials)
+
+            elif kind == "error":
+                trial, tb = event[1], event[2]
+                trial.error = tb
+                trial.num_failures += 1
+                if trial.num_failures <= max_failures:
+                    log(
+                        f"{trial.trial_id} failed "
+                        f"({trial.num_failures}/{max_failures}); retrying"
+                        + (" from checkpoint" if trial.latest_checkpoint else "")
+                    )
+                    if trial.latest_checkpoint:
+                        trial.restore_path = trial.latest_checkpoint
+                    requeue_trial(trial)
+                else:
+                    if verbose:
+                        log(f"{trial.trial_id} errored:\n{tb}")
+                    finish_trial(trial, TrialStatus.ERROR)
+                    sched.on_trial_error(trial)
+                    for cb in callbacks:
+                        cb.on_trial_error(trial, tb)
+                store.write_state(trials)
+
+    # Teardown always runs (Ctrl-C, store errors, ...): callbacks must see
+    # experiment end so e.g. ProfilerCallback stops the process-global trace
+    # and JsonlCallback closes its file.
+    try:
+        event_loop()
+    finally:
+        wall = time.time() - start_time
+        utilization = device_mgr.utilization(wall)
+        store.write_state(
+            trials,
+            extra={"wall_clock_s": wall, "device_utilization": utilization},
+        )
+        store.close()
+        for cb in callbacks:
+            try:
+                cb.on_experiment_end(trials, wall)
+            except Exception as exc:  # noqa: BLE001 - don't mask the original
+                log(f"{type(cb).__name__}.on_experiment_end failed: {exc}")
     analysis = ExperimentAnalysis(
-        trials, metric=metric, mode=mode, root=store.root, wall_clock_s=wall
+        trials, metric=metric, mode=mode, root=store.root, wall_clock_s=wall,
+        device_utilization=utilization,
     )
     n_done = analysis.num_terminated()
     log(
         f"experiment {name}: {n_done}/{len(trials)} trials terminated in "
-        f"{wall:.1f}s ({analysis.trials_per_hour():.1f} trials/hour)"
+        f"{wall:.1f}s ({analysis.trials_per_hour():.1f} trials/hour, "
+        f"{100 * utilization:.0f}% device utilization)"
     )
     return analysis
