@@ -60,10 +60,12 @@ from distributed_machine_learning_tpu.tune.session import (
     with_parameters,
 )
 from distributed_machine_learning_tpu.tune.trainable import train_regressor
+from distributed_machine_learning_tpu.tune.vectorized import run_vectorized
 from distributed_machine_learning_tpu.tune.trial import Resources, Trial, TrialStatus
 
 __all__ = [
     "run",
+    "run_vectorized",
     "report",
     "get_checkpoint",
     "get_devices",

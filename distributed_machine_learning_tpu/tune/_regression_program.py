@@ -1,0 +1,222 @@
+"""Shared pieces of the built-in regression workload.
+
+Single source of truth for the forward-call convention, the jittable
+epoch/eval program bodies, and validation padding — used by both the
+per-trial trainable (``tune/trainable.py``) and the vmapped population
+runner (``tune/vectorized.py``), so a numerics change lands in both paths.
+
+Capability lineage: this is the reference's L2 training loop
+(`/root/reference/ray-tune-hpo-regression.py:260-373`) re-shaped for XLA —
+an epoch is one ``lax.scan`` program, eval is a padded masked scan with
+static shapes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def make_forward(model, flag_name: str, has_bn: bool) -> Callable:
+    """Unified apply() over the zoo's two call conventions.
+
+    Returns ``forward(params, batch_stats, x, dropout_key, train) ->
+    (preds, new_batch_stats)``.
+    """
+
+    def forward(params, batch_stats, x, dropout_key, train: bool):
+        vs = {"params": params}
+        if has_bn:
+            vs["batch_stats"] = batch_stats
+        kwargs = {flag_name: (not train) if flag_name == "deterministic" else train}
+        rngs = {"dropout": dropout_key} if train else None
+        if has_bn and train:
+            out, mut = model.apply(
+                vs, x, rngs=rngs, mutable=["batch_stats"], **kwargs
+            )
+            return out, mut["batch_stats"]
+        out = model.apply(vs, x, rngs=rngs, **kwargs)
+        return out, batch_stats
+
+    return forward
+
+
+def per_example_losses(preds: jnp.ndarray, targets: jnp.ndarray):
+    """Per-example squared error, absolute error, and APE (for masked eval)."""
+    se = jnp.mean((preds - targets) ** 2, axis=-1)
+    ae = jnp.mean(jnp.abs(preds - targets), axis=-1)
+    ape = jnp.mean(jnp.abs(targets - preds) / (jnp.abs(targets) + 1e-8), axis=-1)
+    return se, ae, ape
+
+
+def make_epoch_fn(
+    forward: Callable,
+    tx: optax.GradientTransformation,
+    loss_fn: Callable,
+    n_train: int,
+    num_batches: int,
+    batch_size: int,
+) -> Callable:
+    """One training epoch as a pure function: shuffle + scan over batches.
+
+    ``epoch(params, opt_state, batch_stats, x_all, y_all, epoch_key) ->
+    (params, opt_state, batch_stats, mean_loss)``.  Jit/vmap at the call
+    site.
+    """
+
+    def epoch(params, opt_state, batch_stats, x_all, y_all, epoch_key):
+        perm_key, drop0 = jax.random.split(epoch_key)
+        perm = jax.random.permutation(perm_key, n_train)
+        perm = perm[: num_batches * batch_size].reshape(num_batches, batch_size)
+
+        def step(carry, idx):
+            params, opt_state, batch_stats, key = carry
+            key, dkey = jax.random.split(key)
+            xb, yb = x_all[idx], y_all[idx]
+
+            def loss_of(p):
+                preds, new_bs = forward(p, batch_stats, xb, dkey, train=True)
+                return loss_fn(preds.astype(jnp.float32), yb), new_bs
+
+            (loss, new_bs), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                params
+            )
+            updates, new_opt = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, new_opt, new_bs, key), loss
+
+        (params, opt_state, batch_stats, _), losses = jax.lax.scan(
+            step, (params, opt_state, batch_stats, drop0), perm
+        )
+        return params, opt_state, batch_stats, losses.mean()
+
+    return epoch
+
+
+def make_eval_fn(
+    forward: Callable, loss_name: str, n_blocks: int, eval_bs: int
+) -> Callable:
+    """Masked blockwise eval: ``(params, batch_stats, x, y, mask) ->
+    {validation_loss, _mse, _rmse, _mae, _mape}``.  Jit/vmap at the call
+    site."""
+
+    def evaluate(params, batch_stats, x_all, y_all, mask):
+        xb = x_all.reshape(n_blocks, eval_bs, *x_all.shape[1:])
+        yb = y_all.reshape(n_blocks, eval_bs, *y_all.shape[1:])
+        mb = mask.reshape(n_blocks, eval_bs)
+
+        def step(_, batch):
+            x, y, m = batch
+            preds, _ = forward(
+                params, batch_stats, x, jax.random.key(0), train=False
+            )
+            preds = preds.astype(jnp.float32)
+            se, ae, ape = per_example_losses(preds, y)
+            hub = jnp.mean(optax.huber_loss(preds, y, delta=1.0), axis=-1)
+            return None, (
+                (se * m).sum(), (ae * m).sum(), (ape * m).sum(), (hub * m).sum()
+            )
+
+        _, (se, ae, ape, hub) = jax.lax.scan(step, None, (xb, yb, mb))
+        count = mask.sum()
+        mse = se.sum() / count
+        mae = ae.sum() / count
+        mape = 100.0 * ape.sum() / count
+        huber = hub.sum() / count
+        rmse = jnp.sqrt(mse)
+        by_name = {
+            "mse": mse, "mae": mae, "mape": mape, "huber": huber, "rmse": rmse,
+        }
+        return {
+            "validation_loss": by_name.get(loss_name, mse),
+            "validation_mse": mse,
+            "validation_rmse": rmse,
+            "validation_mae": mae,
+            "validation_mape": mape,
+        }
+
+    return evaluate
+
+
+@dataclass
+class StagedData:
+    """Device-resident dataset + padded validation block layout."""
+
+    x_train: jnp.ndarray
+    y_train: jnp.ndarray
+    x_val: jnp.ndarray
+    y_val: jnp.ndarray
+    val_mask: jnp.ndarray
+    n_train: int
+    num_batches: int
+    batch_size: int
+    n_val_blocks: int
+    eval_bs: int
+
+
+def stage_data(
+    train_data, val_data, batch_size: int, compute_dtype
+) -> StagedData:
+    """Stage both splits to device once; pad validation to whole blocks."""
+    n_train = len(train_data)
+    batch_size = int(min(batch_size, n_train))
+    num_batches = max(n_train // batch_size, 1)
+
+    n_val = len(val_data)
+    eval_bs = int(min(max(batch_size, 1), n_val))
+    n_val_pad = -(-n_val // eval_bs) * eval_bs
+    pad = n_val_pad - n_val
+
+    x_val = (
+        np.concatenate(
+            [val_data.x, np.zeros((pad, *val_data.x.shape[1:]), val_data.x.dtype)]
+        )
+        if pad
+        else val_data.x
+    )
+    y_val = (
+        np.concatenate(
+            [val_data.y, np.zeros((pad, *val_data.y.shape[1:]), val_data.y.dtype)]
+        )
+        if pad
+        else val_data.y
+    )
+    return StagedData(
+        x_train=jnp.asarray(train_data.x, dtype=compute_dtype),
+        y_train=jnp.asarray(train_data.y, dtype=jnp.float32),
+        x_val=jnp.asarray(x_val, dtype=compute_dtype),
+        y_val=jnp.asarray(y_val, dtype=jnp.float32),
+        val_mask=jnp.asarray(
+            np.concatenate([np.ones(n_val, np.float32), np.zeros(pad, np.float32)])
+        ),
+        n_train=n_train,
+        num_batches=num_batches,
+        batch_size=batch_size,
+        n_val_blocks=n_val_pad // eval_bs,
+        eval_bs=eval_bs,
+    )
+
+
+def detect_call_convention(model, sample_x):
+    """Init the model and learn (variables, train-flag kwarg name).
+
+    The init is jitted: eager ``model.init`` dispatches hundreds of tiny ops
+    one by one, which is pathological on a remote/tunneled TPU backend; one
+    compiled executable makes trial startup near-constant.
+    """
+    rng = {"params": jax.random.key(0), "dropout": jax.random.key(1)}
+    try:
+        variables = jax.jit(
+            lambda r, x: model.init(r, x, deterministic=True)
+        )(rng, sample_x)
+        return variables, "deterministic"
+    except TypeError:
+        variables = jax.jit(
+            lambda r, x: model.init(r, x, train=False)
+        )(rng, sample_x)
+        return variables, "train"

@@ -22,9 +22,20 @@ from distributed_machine_learning_tpu.utils.logging import (
 
 
 class Callback:
-    """Base class; override any subset of hooks."""
+    """Base class; override any subset of hooks.
+
+    Hooks run on the single runner thread, after the trial thread has been
+    unblocked — a raising callback is logged and skipped, never fatal.
+    ``on_trial_start`` may fire more than once per trial (fault retries, PBT
+    requeues), and every failure fires ``on_trial_error`` even when the trial
+    will be retried.  ``on_heartbeat`` ticks whenever the runner is idle
+    (~every 0.5s) so time-based callbacks don't depend on trial traffic.
+    """
 
     def setup(self, experiment_root: str, metric: str, mode: str):
+        pass
+
+    def on_heartbeat(self):
         pass
 
     def on_trial_start(self, trial: Trial):
@@ -162,6 +173,11 @@ class ProfilerCallback(Callback):
             jax.profiler.stop_trace()
 
     def on_trial_result(self, trial: Trial, result: Dict[str, Any]):
+        self._maybe_stop()
+
+    def on_heartbeat(self):
+        # Enforce duration_s by wall clock, not trial traffic: without this a
+        # long first epoch (or a crashed sole trial) would overrun the window.
         self._maybe_stop()
 
     def on_experiment_end(self, trials: List[Trial], wall_clock_s: float):

@@ -91,8 +91,6 @@ def run(
     events: "queue.Queue" = queue.Queue()
     executor = ThreadTrialExecutor(store, events)
     callbacks = list(callbacks or [])
-    for cb in callbacks:
-        cb.setup(store.root, metric, mode)
 
     max_concurrent = max_concurrent or device_mgr.num_devices
     trials: List[Trial] = []
@@ -106,6 +104,16 @@ def run(
     def log(msg: str):
         if verbose:
             print(f"[tune] {msg}", flush=True)
+
+    def safe_cb(hook: str, *args):
+        """Observers must never wedge the sweep: a raising callback is logged
+        and dropped for that event (the trial thread may be blocked in
+        ``report`` waiting on this loop — see executor.ResultEvent)."""
+        for cb in callbacks:
+            try:
+                getattr(cb, hook)(*args)
+            except Exception as exc:  # noqa: BLE001 - observer isolation
+                log(f"{type(cb).__name__}.{hook} raised: {exc!r}")
 
     def budget_exceeded() -> bool:
         return time_budget_s is not None and time.time() - start_time > time_budget_s
@@ -139,8 +147,7 @@ def run(
             trial.started_at = trial.started_at or time.time()
             trial.stop_requested = False
             running[trial.trial_id] = leased
-            for cb in callbacks:
-                cb.on_trial_start(trial)
+            safe_cb("on_trial_start", trial)
             executor.start_trial(trial, trainable, leased)
 
     def finish_trial(trial: Trial, status: TrialStatus):
@@ -201,10 +208,12 @@ def run(
                     trial = next(t for t in trials if t.trial_id == tid)
                     if not executor.is_alive(trial):
                         finish_trial(trial, TrialStatus.ERROR)
-                        for cb in callbacks:
-                            cb.on_trial_error(
-                                trial, "trial thread died without reporting"
-                            )
+                        safe_cb(
+                            "on_trial_error",
+                            trial,
+                            "trial thread died without reporting",
+                        )
+                safe_cb("on_heartbeat")
                 continue
 
             kind = event[0]
@@ -229,8 +238,6 @@ def run(
                 searcher.on_trial_result(
                     trial.trial_id, reported_config, metrics, metric, mode
                 )
-                for cb in callbacks:
-                    cb.on_trial_result(trial, metrics)
                 if stop and any(
                     k in metrics and float(metrics[k]) >= v
                     for k, v in stop.items()
@@ -242,7 +249,10 @@ def run(
                     trial._requeue_on_complete = True
                     decision = STOP
                 result_event.decision = "stop" if decision == STOP else "continue"
+                # Unblock the trial thread BEFORE observers run: a slow or
+                # buggy callback must not stall (or hang) training.
                 result_event.done.set()
+                safe_cb("on_trial_result", trial, metrics)
 
             elif kind == "complete":
                 trial = event[1]
@@ -251,14 +261,16 @@ def run(
                     requeue_trial(trial)
                 else:
                     finish_trial(trial, TrialStatus.TERMINATED)
-                    for cb in callbacks:
-                        cb.on_trial_complete(trial)
+                    safe_cb("on_trial_complete", trial)
                 store.write_state(trials)
 
             elif kind == "error":
                 trial, tb = event[1], event[2]
                 trial.error = tb
                 trial.num_failures += 1
+                # Every failure is observable, including ones that will be
+                # retried (preemptions are exactly what observers watch for).
+                safe_cb("on_trial_error", trial, tb)
                 if trial.num_failures <= max_failures:
                     log(
                         f"{trial.trial_id} failed "
@@ -273,28 +285,27 @@ def run(
                         log(f"{trial.trial_id} errored:\n{tb}")
                     finish_trial(trial, TrialStatus.ERROR)
                     sched.on_trial_error(trial)
-                    for cb in callbacks:
-                        cb.on_trial_error(trial, tb)
                 store.write_state(trials)
 
-    # Teardown always runs (Ctrl-C, store errors, ...): callbacks must see
-    # experiment end so e.g. ProfilerCallback stops the process-global trace
-    # and JsonlCallback closes its file.
+    # Teardown always runs (Ctrl-C, store errors, a callback's setup raising):
+    # callbacks must see experiment end so e.g. ProfilerCallback stops the
+    # process-global trace and JsonlCallback closes its file.
     try:
+        for cb in callbacks:
+            cb.setup(store.root, metric, mode)
         event_loop()
     finally:
         wall = time.time() - start_time
         utilization = device_mgr.utilization(wall)
-        store.write_state(
-            trials,
-            extra={"wall_clock_s": wall, "device_utilization": utilization},
-        )
-        store.close()
-        for cb in callbacks:
-            try:
-                cb.on_experiment_end(trials, wall)
-            except Exception as exc:  # noqa: BLE001 - don't mask the original
-                log(f"{type(cb).__name__}.on_experiment_end failed: {exc}")
+        try:
+            store.write_state(
+                trials,
+                extra={"wall_clock_s": wall, "device_utilization": utilization},
+            )
+            store.close()
+        except Exception as exc:  # noqa: BLE001 - callbacks still tear down
+            log(f"experiment store teardown failed: {exc!r}")
+        safe_cb("on_experiment_end", trials, wall)
     analysis = ExperimentAnalysis(
         trials, metric=metric, mode=mode, root=store.root, wall_clock_s=wall,
         device_utilization=utilization,

@@ -18,6 +18,10 @@ re-designed for XLA rather than translated:
   ASHA actually gets rungs (the reference reported once at trial end, `:373`)
   and PBT/fault-recovery can restore.
 
+The jittable program bodies (forward convention, epoch scan, masked eval,
+data staging) live in ``tune/_regression_program.py``, shared with the
+vmapped population runner (``tune/vectorized.py``).
+
 Config keys (all optional unless noted): ``model`` family; model arch keys
 (see models.build_model); ``optimizer``, ``learning_rate`` (required),
 ``weight_decay``, ``momentum``, ``gradient_clipping``; ``loss_function``;
@@ -31,8 +35,6 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
-import optax
 
 from distributed_machine_learning_tpu.data.loader import Dataset
 from distributed_machine_learning_tpu.models import build_model
@@ -40,27 +42,20 @@ from distributed_machine_learning_tpu.ops.losses import get_loss
 from distributed_machine_learning_tpu.ops.optimizers import make_optimizer
 from distributed_machine_learning_tpu.ops.schedules import get_schedule
 from distributed_machine_learning_tpu.tune import session
+from distributed_machine_learning_tpu.tune._regression_program import (
+    detect_call_convention,
+    make_epoch_fn,
+    make_eval_fn,
+    make_forward,
+    per_example_losses,
+    stage_data,
+)
 from distributed_machine_learning_tpu.tune.checkpoint import restore_into
 from distributed_machine_learning_tpu.utils.seeding import fold_seed
 
-
-def _detect_call_convention(model, sample_x):
-    """Init the model and learn (variables, train-flag kwarg name)."""
-    rng = {"params": jax.random.key(0), "dropout": jax.random.key(1)}
-    try:
-        variables = model.init(rng, sample_x, deterministic=True)
-        return variables, "deterministic"
-    except TypeError:
-        variables = model.init(rng, sample_x, train=False)
-        return variables, "train"
-
-
-def _per_example_losses(preds: jnp.ndarray, targets: jnp.ndarray):
-    """Per-example squared error, absolute error, and APE (for masked eval)."""
-    se = jnp.mean((preds - targets) ** 2, axis=-1)
-    ae = jnp.mean(jnp.abs(preds - targets), axis=-1)
-    ape = jnp.mean(jnp.abs(targets - preds) / (jnp.abs(targets) + 1e-8), axis=-1)
-    return se, ae, ape
+# Back-compat aliases (vectorized.py and external users imported these names).
+_detect_call_convention = detect_call_convention
+_per_example_losses = per_example_losses
 
 
 def train_regressor(
@@ -73,16 +68,16 @@ def train_regressor(
         raise ValueError("train_regressor needs train_data/val_data bound")
 
     num_epochs = int(config.get("num_epochs", 20))
-    batch_size = int(min(config.get("batch_size", 32), len(train_data)))
     seed = int(config.get("seed", 0))
     loss_name = str(config.get("loss_function", "mse"))
     compute_dtype = (
         jnp.bfloat16 if config.get("compute_dtype") == "bfloat16" else jnp.float32
     )
 
-    n_train = len(train_data)
-    num_batches = max(n_train // batch_size, 1)
-    steps_per_epoch = num_batches
+    data = stage_data(
+        train_data, val_data, int(config.get("batch_size", 32)), compute_dtype
+    )
+    steps_per_epoch = data.num_batches
     total_steps = int(config.get("total_steps", num_epochs * steps_per_epoch))
     schedule = get_schedule(
         str(config.get("lr_schedule", "warmup_linear_decay")),
@@ -99,118 +94,23 @@ def train_regressor(
     )
 
     model = build_model(config)
-    sample_x = jnp.asarray(train_data.x[:1], dtype=compute_dtype)
-    variables, flag_name = _detect_call_convention(model, sample_x)
+    sample_x = data.x_train[:1]
+    variables, flag_name = detect_call_convention(model, sample_x)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
     has_bn = "batch_stats" in variables
     opt_state = tx.init(params)
 
-    def forward(params, batch_stats, x, dropout_key, train: bool):
-        vs = {"params": params}
-        if has_bn:
-            vs["batch_stats"] = batch_stats
-        kwargs = {flag_name: (not train) if flag_name == "deterministic" else train}
-        rngs = {"dropout": dropout_key} if train else None
-        if has_bn and train:
-            out, mut = model.apply(
-                vs, x, rngs=rngs, mutable=["batch_stats"], **kwargs
-            )
-            return out, mut["batch_stats"]
-        out = model.apply(vs, x, rngs=rngs, **kwargs)
-        return out, batch_stats
-
-    loss_fn_train = get_loss(loss_name)
-
-    # ---- jitted epoch: shuffle + scan over batches, all on device ----------
-    def train_epoch(params, opt_state, batch_stats, x_all, y_all, epoch_key):
-        perm_key, init_drop_key = jax.random.split(epoch_key)
-        perm = jax.random.permutation(perm_key, n_train)[: num_batches * batch_size]
-        perm = perm.reshape(num_batches, batch_size)
-
-        def step(carry, idx):
-            params, opt_state, batch_stats, key = carry
-            key, dkey = jax.random.split(key)
-            xb = x_all[idx]
-            yb = y_all[idx]
-
-            def loss_of(p):
-                preds, new_bs = forward(p, batch_stats, xb, dkey, train=True)
-                return loss_fn_train(preds.astype(jnp.float32), yb), new_bs
-
-            (loss, new_bs), grads = jax.value_and_grad(loss_of, has_aux=True)(params)
-            updates, new_opt = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return (params, new_opt, new_bs, key), loss
-
-        (params, opt_state, batch_stats, _), losses = jax.lax.scan(
-            step, (params, opt_state, batch_stats, init_drop_key), perm
-        )
-        return params, opt_state, batch_stats, losses.mean()
-
-    train_epoch = jax.jit(train_epoch, donate_argnums=(0, 1, 2))
-
-    # ---- jitted eval: padded scan with masking -----------------------------
-    n_val = len(val_data)
-    eval_bs = int(min(max(batch_size, 1), n_val))
-    n_val_pad = -(-n_val // eval_bs) * eval_bs
-
-    def evaluate(params, batch_stats, x_all, y_all, mask):
-        xb = x_all.reshape(n_val_pad // eval_bs, eval_bs, *x_all.shape[1:])
-        yb = y_all.reshape(n_val_pad // eval_bs, eval_bs, *y_all.shape[1:])
-        mb = mask.reshape(n_val_pad // eval_bs, eval_bs)
-
-        def step(_, batch):
-            x, y, m = batch
-            preds, _ = forward(params, batch_stats, x, jax.random.key(0), train=False)
-            preds = preds.astype(jnp.float32)
-            se, ae, ape = _per_example_losses(preds, y)
-            hub = jnp.mean(optax.huber_loss(preds, y, delta=1.0), axis=-1)
-            return None, (
-                (se * m).sum(),
-                (ae * m).sum(),
-                (ape * m).sum(),
-                (hub * m).sum(),
-            )
-
-        _, (se, ae, ape, hub) = jax.lax.scan(step, None, (xb, yb, mb))
-        count = mask.sum()
-        mse = se.sum() / count
-        mae = ae.sum() / count
-        mape = 100.0 * ape.sum() / count
-        huber = hub.sum() / count
-        rmse = jnp.sqrt(mse)
-        by_name = {
-            "mse": mse, "mae": mae, "mape": mape, "huber": huber, "rmse": rmse
-        }
-        return {
-            "validation_loss": by_name.get(loss_name, mse),
-            "validation_mse": mse,
-            "validation_rmse": rmse,
-            "validation_mae": mae,
-            "validation_mape": mape,
-        }
-
-    evaluate = jax.jit(evaluate)
-
-    # ---- stage data to the trial's device ----------------------------------
-    x_train = jnp.asarray(train_data.x, dtype=compute_dtype)
-    y_train = jnp.asarray(train_data.y, dtype=jnp.float32)
-    pad = n_val_pad - n_val
-    x_val = jnp.asarray(
-        np.concatenate([val_data.x, np.zeros((pad, *val_data.x.shape[1:]),
-                                             dtype=val_data.x.dtype)])
-        if pad else val_data.x,
-        dtype=compute_dtype,
+    forward = make_forward(model, flag_name, has_bn)
+    train_epoch = jax.jit(
+        make_epoch_fn(
+            forward, tx, get_loss(loss_name),
+            data.n_train, data.num_batches, data.batch_size,
+        ),
+        donate_argnums=(0, 1, 2),
     )
-    y_val = jnp.asarray(
-        np.concatenate([val_data.y, np.zeros((pad, *val_data.y.shape[1:]),
-                                             dtype=val_data.y.dtype)])
-        if pad else val_data.y,
-        dtype=jnp.float32,
-    )
-    val_mask = jnp.asarray(
-        np.concatenate([np.ones(n_val, np.float32), np.zeros(pad, np.float32)])
+    evaluate = jax.jit(
+        make_eval_fn(forward, loss_name, data.n_val_blocks, data.eval_bs)
     )
 
     # ---- restore (PBT exploit / fault retry) -------------------------------
@@ -235,9 +135,11 @@ def train_regressor(
     for epoch in range(start_epoch, num_epochs):
         epoch_key = jax.random.key(fold_seed(seed, "epoch", epoch))
         params, opt_state, batch_stats, train_loss = train_epoch(
-            params, opt_state, batch_stats, x_train, y_train, epoch_key
+            params, opt_state, batch_stats, data.x_train, data.y_train, epoch_key
         )
-        metrics = evaluate(params, batch_stats, x_val, y_val, val_mask)
+        metrics = evaluate(
+            params, batch_stats, data.x_val, data.y_val, data.val_mask
+        )
         step_count = (epoch + 1) * steps_per_epoch
         record = {
             "epoch": epoch,

@@ -89,6 +89,50 @@ def test_error_event_reaches_callbacks(tmp_results):
     assert ("error", "trial_00000") in cb.events
 
 
+def test_raising_callback_does_not_wedge_sweep(tmp_results):
+    """An observer that throws must be logged and skipped, not hang the
+    reporting trial thread or kill the experiment (runner.safe_cb)."""
+
+    class Bomb(tune.Callback):
+        def on_trial_result(self, trial, result):
+            raise KeyError("buggy observer")
+
+    cb = RecordingCallback()
+    analysis = tune.run(
+        _trainable,
+        {"x": tune.uniform(-1, 1)},
+        metric="loss", mode="min", num_samples=2,
+        storage_path=tmp_results, name="cb_bomb", verbose=0,
+        callbacks=[Bomb(), cb],
+    )
+    assert analysis.num_terminated() == 2
+    # the healthy observer behind the bomb still saw everything
+    assert [e[0] for e in cb.events].count("result") == 6
+
+
+def test_retried_failures_emit_error_events(tmp_results):
+    """Every failure is observable, including ones that get retried."""
+    attempts = {"n": 0}
+
+    def flaky(config):
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            raise RuntimeError("preempted")
+        tune.report(loss=1.0)
+
+    cb = RecordingCallback()
+    analysis = tune.run(
+        flaky, {"x": 1}, metric="loss", mode="min", num_samples=1,
+        max_failures=1,
+        storage_path=tmp_results, name="cb_retry", verbose=0, callbacks=[cb],
+    )
+    kinds = [e[0] for e in cb.events]
+    assert kinds.count("error") == 1  # the retried failure was observed
+    assert kinds.count("start") == 2  # initial launch + retry relaunch
+    assert kinds.count("complete") == 1
+    assert analysis.num_terminated() == 1
+
+
 def test_device_manager_utilization_accounting():
     mgr = DeviceManager(devices=["d0", "d1"])
     t0 = time.time()
