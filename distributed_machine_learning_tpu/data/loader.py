@@ -26,7 +26,7 @@ from typing import Dict, Iterator, Optional, Sequence, Tuple
 import numpy as np
 
 from distributed_machine_learning_tpu.data import features as F
-from distributed_machine_learning_tpu.utils.seeding import rng_from
+from distributed_machine_learning_tpu.utils.seeding import fold_seed, rng_from
 
 
 def load_dataframe_from_npy(path: str):
@@ -42,13 +42,18 @@ def split_into_intervals(
 ) -> np.ndarray:
     """[T, F] -> [num_intervals, interval, F] with the given stride.
 
-    Vectorized with stride tricks (the reference loops in python, `:403-411`).
+    Native C++/OpenMP when available (data/native.py), stride-tricks numpy
+    otherwise (the reference loops in python, `:403-411`).
     """
     if array.ndim == 1:
         array = array[:, None]
     T = array.shape[0]
     if T < interval:
         return np.empty((0, interval, array.shape[1]), dtype=array.dtype)
+    if array.dtype == np.float32:
+        from distributed_machine_learning_tpu.data import native
+
+        return native.window(array, interval, stride)
     windows = np.lib.stride_tricks.sliding_window_view(array, interval, axis=0)
     # sliding_window_view gives [T-interval+1, F, interval]; stride + reorder.
     return np.ascontiguousarray(np.transpose(windows[::stride], (0, 2, 1)))
@@ -80,16 +85,25 @@ class Dataset:
         drop_remainder: bool = True,
     ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         """Yield (x, y) batches. Static batch shape by default (jit-friendly)."""
+        from distributed_machine_learning_tpu.data import native as _native
+
         n = len(self)
-        idx = np.arange(n)
         if shuffle:
-            rng_from(*seed_parts).shuffle(idx)
+            # Native Fisher-Yates (C++/OpenMP) when the library is built,
+            # numpy permutation otherwise; both deterministic in seed_parts.
+            idx = _native.shuffled_indices(n, fold_seed(*seed_parts))
+        else:
+            idx = np.arange(n)
         end = (n // batch_size) * batch_size if drop_remainder else n
         if end == 0:
             end = n  # tiny dataset: emit one ragged batch rather than nothing
+        if self.x.dtype == np.float32 and self.y.dtype == np.float32:
+            take = _native.gather
+        else:
+            take = lambda a, sel: a[sel]  # noqa: E731
         for start in range(0, end, batch_size):
             sel = idx[start : start + batch_size]
-            yield self.x[sel], self.y[sel]
+            yield take(self.x, sel), take(self.y, sel)
 
     def num_batches(self, batch_size: int, drop_remainder: bool = True) -> int:
         n = len(self)
@@ -133,12 +147,15 @@ def make_regression_dataset(
     stride: int = 96,
     val_fraction: float = 0.3,
     seed: int = 42,
+    standardize: bool = False,
 ) -> Tuple[Dataset, Dataset]:
     """The reference's `get_data_loaders` pipeline (`:423-459`), DataFrame -> Datasets.
 
     Selects feature columns (deduplicating, `:442-443`), extracts the label,
     windows both with (interval, stride), labels each window with its last-step
-    glucose value, and splits 70/30.
+    glucose value, and splits 70/30. ``standardize=True`` z-scores the feature
+    columns first (native one-pass Welford kernel) — a capability the reference
+    lacked entirely (its raw sensor scales went straight into the model).
     """
     if feature_columns is not None:
         cols = [c for c in dict.fromkeys(feature_columns) if c in features_df.columns]
@@ -147,6 +164,10 @@ def make_regression_dataset(
 
     x = features_df.to_numpy(dtype=np.float32)
     y = labels_df[label_column].to_numpy(dtype=np.float32)
+    if standardize:
+        from distributed_machine_learning_tpu.data import native as _native
+
+        x, _, _ = _native.standardize(x)
 
     xw = split_into_intervals(x, interval, stride)
     yw = split_into_intervals(y, interval, stride)[:, -1, 0:1]  # last-step label

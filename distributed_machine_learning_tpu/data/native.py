@@ -1,0 +1,175 @@
+"""ctypes bindings for the native C++ data-layer kernels.
+
+The hot host-side data-prep ops (windowing, shuffled batch gather,
+standardization — the work the reference does in Python loops / delegates to
+torch DataLoaders, `ray-tune-hpo-regression.py:403-411,452-457`) live in
+``native/window_ops.cpp`` as a C-ABI shared library with OpenMP. This module
+compiles it with the system ``g++`` on first use (cached by source hash under
+``~/.cache/dml_tpu/``), binds it with ctypes, and exposes numpy-signature
+wrappers. Every wrapper has a pure-numpy fallback, so the package works
+identically (slower) where no C++ toolchain exists; ``native_available()``
+reports which path is active.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native", "window_ops.cpp")
+_CACHE_DIR = os.environ.get(
+    "DML_TPU_NATIVE_CACHE", os.path.join(os.path.expanduser("~"), ".cache", "dml_tpu")
+)
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    """Compile window_ops.cpp -> .so (hash-cached) and dlopen it."""
+    try:
+        with open(_SRC, "rb") as f:
+            src = f.read()
+    except OSError:
+        return None
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    so_path = os.path.join(_CACHE_DIR, f"libdmlnative_{tag}.so")
+    if not os.path.exists(so_path):
+        os.makedirs(_CACHE_DIR, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_CACHE_DIR)
+        os.close(fd)
+        cmd = [
+            "g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-fopenmp",
+            _SRC, "-o", tmp,
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so_path)
+        except (subprocess.SubprocessError, OSError):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+    try:
+        lib = ctypes.CDLL(so_path)
+    except OSError:
+        return None
+
+    i64 = ctypes.c_int64
+    u64 = ctypes.c_uint64
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+
+    lib.dml_window.argtypes = [f32p, i64, i64, i64, i64, f32p]
+    lib.dml_window.restype = i64
+    lib.dml_gather.argtypes = [f32p, i64, i64, i64p, i64, f32p]
+    lib.dml_gather.restype = i64
+    lib.dml_shuffled_indices.argtypes = [i64, u64, i64p]
+    lib.dml_shuffled_indices.restype = i64
+    lib.dml_column_stats.argtypes = [f32p, i64, i64, f64p, f64p]
+    lib.dml_column_stats.restype = i64
+    lib.dml_standardize.argtypes = [f32p, i64, i64, f64p, f64p, ctypes.c_double]
+    lib.dml_standardize.restype = i64
+    return lib
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if not _tried:
+            if os.environ.get("DML_TPU_DISABLE_NATIVE"):
+                _lib = None
+            else:
+                _lib = _build_and_load()
+            _tried = True
+    return _lib
+
+
+def native_available() -> bool:
+    return _get_lib() is not None
+
+
+def window(array: np.ndarray, interval: int, stride: int) -> np.ndarray:
+    """[T, F] float32 -> [n_windows, interval, F]; native parallel memcpy."""
+    if array.ndim == 1:
+        array = array[:, None]
+    T, F = array.shape
+    if T < interval:
+        return np.empty((0, interval, F), dtype=np.float32)
+    n_windows = (T - interval) // stride + 1
+    lib = _get_lib()
+    arr = np.ascontiguousarray(array, dtype=np.float32)
+    if lib is None:
+        w = np.lib.stride_tricks.sliding_window_view(arr, interval, axis=0)
+        return np.ascontiguousarray(np.transpose(w[::stride], (0, 2, 1)))
+    out = np.empty((n_windows, interval, F), dtype=np.float32)
+    rc = lib.dml_window(arr, T, F, interval, stride, out)
+    if rc != n_windows:  # pragma: no cover
+        raise RuntimeError(f"dml_window failed: rc={rc}")
+    return out
+
+
+def shuffled_indices(n: int, seed: int) -> np.ndarray:
+    """Deterministic permutation of [0, n) (the epoch shuffle in
+    Dataset.batches). Native and fallback paths use different (equally
+    deterministic) generators, so the *order* is toolchain-dependent but
+    reproducibility per build is not."""
+    lib = _get_lib()
+    if lib is None:
+        return np.random.default_rng(seed).permutation(n).astype(np.int64)
+    out = np.empty(n, dtype=np.int64)
+    lib.dml_shuffled_indices(n, np.uint64(seed & (2**64 - 1)), out)
+    return out
+
+
+def gather(x: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """x[idx] for row-major float32 x of any trailing shape.
+
+    Negative indices are rejected on both paths (numpy's wrap-around would
+    otherwise make behavior toolchain-dependent).
+    """
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    if idx.size and (idx.min() < 0 or idx.max() >= len(x)):
+        raise IndexError("gather index out of range")
+    lib = _get_lib()
+    if lib is None:
+        return x[idx]
+    x = np.ascontiguousarray(x, dtype=np.float32)
+    row_elems = int(np.prod(x.shape[1:], dtype=np.int64)) if x.ndim > 1 else 1
+    out = np.empty((len(idx),) + x.shape[1:], dtype=np.float32)
+    lib.dml_gather(x.reshape(len(x), -1) if x.ndim > 1 else x[:, None],
+                   len(x), max(row_elems, 1), idx, len(idx),
+                   out.reshape(len(idx), -1) if out.ndim > 1 else out[:, None])
+    return out
+
+
+def standardize(
+    x: np.ndarray, eps: float = 1e-8
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-column z-score of [N, F] float32; returns (standardized, mean, std)."""
+    x = np.ascontiguousarray(x, dtype=np.float32)
+    n, m = x.shape
+    lib = _get_lib()
+    if lib is None:
+        mean = x.mean(axis=0, dtype=np.float64)
+        std = x.std(axis=0, dtype=np.float64)
+        scaled = (x - mean) / np.where(std > eps, std, 1.0)
+        return scaled.astype(np.float32), mean, std
+    mean = np.empty(m, dtype=np.float64)
+    std = np.empty(m, dtype=np.float64)
+    lib.dml_column_stats(x, n, m, mean, std)
+    out = x.copy()
+    lib.dml_standardize(out, n, m, mean, std, eps)
+    return out, mean, std

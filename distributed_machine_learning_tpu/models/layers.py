@@ -37,7 +37,15 @@ ATTENTION_TYPES = (
     "multi_head_attention",
     "linear_attention",
     "blockwise",
+    "flash",
 )
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - backend probing never fatal
+        return False
 
 
 def sincos_position_table(max_len: int, d_model: int) -> np.ndarray:
@@ -134,6 +142,28 @@ class MultiHeadAttention(nn.Module):
 
         if self.attention_type == "linear_attention":
             out = linear_attention(q, k, v, causal=self.causal)
+        elif self.attention_type == "flash":
+            # Hand-written Pallas MXU kernel on TPU; off-TPU the same math
+            # runs through the lax.scan blockwise path (Mosaic kernels only
+            # compile for TPU backends).
+            bs = min(self.block_size, S)
+            while S % bs:
+                bs -= 1
+            scale = float(head_dim) ** (-self.key_dim_scaling)
+            if _on_tpu():
+                from distributed_machine_learning_tpu.ops.pallas_attention import (
+                    flash_attention,
+                )
+
+                out = flash_attention(
+                    q, k, v, scale=scale, causal=self.causal,
+                    block_q=bs, block_k=bs,
+                )
+            else:
+                q_scaled = q * (scale / (float(head_dim) ** -0.5))
+                out = blockwise_attention(
+                    q_scaled, k, v, block_size=bs, causal=self.causal
+                )
         elif self.attention_type == "blockwise":
             # Largest divisor of S not exceeding the configured block size, so
             # any static sequence length works.

@@ -1,0 +1,119 @@
+// Native data-layer kernels for the TPU HPO framework.
+//
+// The reference's data pipeline is pure numpy/pandas in Python
+// (`/root/reference/ray-tune-hpo-regression.py:403-459`): strided sliding-
+// window segmentation (`split_into_intervals`, :403-411, a Python loop that
+// copies every window) feeding per-trial DataLoaders. Host-side data prep is
+// the part of the stack JAX does not own — it runs on the TPU VM's CPUs while
+// the chip trains — so it is implemented natively here: C++ with OpenMP,
+// exposed to Python over a plain C ABI (ctypes; see data/native.py).
+//
+// All functions are C-ABI, operate on caller-allocated buffers, and return 0
+// on success / negative error codes, so the binding layer stays trivial and
+// no C++ types cross the boundary.
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// Strided sliding-window segmentation:
+//   data [n_steps, n_feats] row-major  ->  out [n_windows, interval, n_feats]
+// where n_windows = (n_steps - interval) / stride + 1 (caller computes &
+// allocates). Parity: split_into_intervals (reference :403-411), called with
+// interval=96, stride=96 at :446.
+int64_t dml_window(const float* data, int64_t n_steps, int64_t n_feats,
+                   int64_t interval, int64_t stride, float* out) {
+  if (interval <= 0 || stride <= 0 || n_steps < interval) return -1;
+  const int64_t n_windows = (n_steps - interval) / stride + 1;
+  const int64_t row_bytes = n_feats * static_cast<int64_t>(sizeof(float));
+#pragma omp parallel for schedule(static)
+  for (int64_t w = 0; w < n_windows; ++w) {
+    const float* src = data + w * stride * n_feats;
+    float* dst = out + w * interval * n_feats;
+    std::memcpy(dst, src, static_cast<size_t>(interval * row_bytes));
+  }
+  return n_windows;
+}
+
+// Gather rows of x [n, row_elems] at idx [n_idx] into out [n_idx, row_elems].
+// This is the shuffled-minibatch assembly step (the torch DataLoader work the
+// reference delegates, SURVEY.md §2 C5): one gather per epoch instead of
+// Python-level indexing.
+int64_t dml_gather(const float* x, int64_t n, int64_t row_elems,
+                   const int64_t* idx, int64_t n_idx, float* out) {
+  const size_t row_bytes = static_cast<size_t>(row_elems) * sizeof(float);
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n_idx; ++i) {
+    const int64_t j = idx[i];
+    if (j < 0 || j >= n) continue;  // bounds-checked; caller validates
+    std::memcpy(out + i * row_elems, x + j * row_elems, row_bytes);
+  }
+  return n_idx;
+}
+
+static inline uint64_t splitmix64(uint64_t* s) {
+  uint64_t z = (*s += 0x9E3779B97F4A7C15ull);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  return z ^ (z >> 31);
+}
+
+// Fisher-Yates permutation of [0, n) into out, seeded deterministically —
+// the epoch shuffle (reference delegates to DataLoader(shuffle) semantics;
+// its own loader never set shuffle, one of the survey's noted gaps).
+int64_t dml_shuffled_indices(int64_t n, uint64_t seed, int64_t* out) {
+  if (n < 0) return -1;
+  for (int64_t i = 0; i < n; ++i) out[i] = i;
+  uint64_t state = seed ^ 0xD1B54A32D192ED03ull;
+  for (int64_t i = n - 1; i > 0; --i) {
+    const uint64_t r = splitmix64(&state) % static_cast<uint64_t>(i + 1);
+    const int64_t j = static_cast<int64_t>(r);
+    const int64_t tmp = out[i];
+    out[i] = out[j];
+    out[j] = tmp;
+  }
+  return n;
+}
+
+// Per-column standardization stats over x [n, m]: mean and std (population)
+// into mean[m], std[m]. Welford per column, parallel over columns.
+int64_t dml_column_stats(const float* x, int64_t n, int64_t m,
+                         double* mean, double* std_out) {
+  if (n <= 0 || m <= 0) return -1;
+#pragma omp parallel for schedule(static)
+  for (int64_t c = 0; c < m; ++c) {
+    double mu = 0.0, m2 = 0.0;
+    for (int64_t r = 0; r < n; ++r) {
+      const double v = static_cast<double>(x[r * m + c]);
+      const double d = v - mu;
+      mu += d / static_cast<double>(r + 1);
+      m2 += d * (v - mu);
+    }
+    mean[c] = mu;
+    std_out[c] = std::sqrt(m2 / static_cast<double>(n));
+  }
+  return m;
+}
+
+// In-place standardize x [n, m] with given per-column mean/std (std<=eps
+// columns pass through unscaled).
+int64_t dml_standardize(float* x, int64_t n, int64_t m, const double* mean,
+                        const double* std_in, double eps) {
+#pragma omp parallel for schedule(static)
+  for (int64_t r = 0; r < n; ++r) {
+    for (int64_t c = 0; c < m; ++c) {
+      const double s = std_in[c];
+      const double centered = static_cast<double>(x[r * m + c]) - mean[c];
+      x[r * m + c] = static_cast<float>(s > eps ? centered / s : centered);
+    }
+  }
+  return n * m;
+}
+
+}  // extern "C"

@@ -1,0 +1,249 @@
+"""Pallas TPU flash-attention kernel.
+
+The hot op of the transformer family (SURVEY.md §3.3: the reference's inner
+loop is ``nn.MultiheadAttention`` at `ray-tune-hpo-regression.py:139`, lowered
+to cuDNN on its CUDA stack). Here the softmax-attention forward is a hand-
+written Pallas kernel tiled for the MXU:
+
+* grid ``(batch*heads, q_blocks, kv_blocks)`` with the kv dimension innermost,
+  so each (q-block, head) streams key/value blocks HBM -> VMEM while running
+  (max, denom, accumulator) statistics live in VMEM scratch — the flash
+  online-softmax recurrence; peak VMEM is O(block_q * (head_dim + block_k))
+  instead of O(seq^2).
+* both matmuls (`q k^T` and `p v`) hit the MXU via ``jnp.dot`` with
+  ``preferred_element_type=float32``; the softmax chain stays on the VPU in
+  float32 regardless of input dtype (bfloat16 inputs supported).
+* causal masking skips fully-masked kv blocks entirely (``@pl.when``), so the
+  causal forward does ~half the work.
+
+Gradients: the kernel is wrapped in ``jax.custom_vjp``; the backward pass
+re-computes attention through the differentiable ``blockwise_attention``
+scan (ops/attention.py) — same math, so gradients are exact while the
+backward memory stays O(block) like the forward.
+
+Selected via ``MultiHeadAttention(attention_type="flash")`` (models/layers.py),
+which routes to this kernel on TPU backends and to the differentiable
+``blockwise_attention`` scan elsewhere (compiled Mosaic kernels only exist for
+TPU). Off-TPU the kernel itself still runs under Pallas interpret mode — the
+tests exercise exactly that.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports cleanly where libtpu/mosaic is available
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+NEG_INF = float("-inf")
+
+
+def _flash_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    m_ref,
+    l_ref,
+    acc_ref,
+    *,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    causal: bool,
+):
+    """One (bh, q_block, kv_block) grid step of the online-softmax recurrence."""
+    kv_idx = pl.program_id(2)
+    q_idx = pl.program_id(1)
+    num_kv = pl.num_programs(2)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Causal: a kv block strictly above the diagonal of this q block is all
+    # masked; skip its matmuls entirely.
+    q_start = q_idx * block_q
+    k_start = kv_idx * block_k
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [block_q, d]
+        k = k_ref[0].astype(jnp.float32)  # [block_k, d]
+        v = v_ref[0].astype(jnp.float32)  # [block_k, d]
+
+        logits = (
+            jax.lax.dot_general(
+                q,
+                k,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [block_q, block_k]
+
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0) + q_start
+            cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1) + k_start
+            logits = jnp.where(rows >= cols, logits, NEG_INF)
+
+        m_prev = m_ref[:, :1]  # [block_q, 1]
+        l_prev = l_ref[:, :1]
+        row_max = jnp.max(logits, axis=-1, keepdims=True)  # [block_q, 1]
+        m_new = jnp.maximum(m_prev, row_max)
+        # Fully-masked rows keep m=-inf; exp against a safe max stays 0.
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(logits - m_safe)
+        p = jnp.where(jnp.isfinite(logits), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p,
+            v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        # Live iff this kv block intersects the causal triangle of this q block.
+        @pl.when(k_start <= q_start + block_q - 1)
+        def _():
+            _compute()
+
+    else:
+        _compute()
+
+    @pl.when(kv_idx == num_kv - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def _flash_forward(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> jnp.ndarray:
+    B, S, H, D = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    while S % block_q:
+        block_q -= 1
+    while S % block_k:
+        block_k -= 1
+    nq, nk = S // block_q, S // block_k
+
+    # [B, S, H, D] -> [B*H, S, D]: one grid row per (batch, head).
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        causal=causal,
+    )
+
+    if not _HAS_PLTPU:  # pragma: no cover
+        raise RuntimeError(
+            "flash_attention requires jax.experimental.pallas.tpu; "
+            "use blockwise_attention on this backend"
+        )
+    scratch_shapes = [
+        pltpu.VMEM((block_q, 128), jnp.float32),  # running max
+        pltpu.VMEM((block_q, 128), jnp.float32),  # running denom
+        pltpu.VMEM((block_q, D), jnp.float32),  # output accumulator
+    ]
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        scratch_shapes=scratch_shapes,
+        interpret=interpret,
+    )(qb, kb, vb)
+
+    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    scale: Optional[float] = None,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Flash softmax attention. q, k, v: [B, S, H, D] -> [B, S, H, D].
+
+    ``scale`` defaults to 1/sqrt(D) (override = the reference's intended
+    ``key_dim_scaling`` knob, SURVEY.md §2 C19). ``interpret=True`` runs the
+    kernel in the Pallas interpreter (CPU tests); on TPU leave it False.
+    """
+    s = (q.shape[-1] ** -0.5) if scale is None else scale
+    return _flash_forward(q, k, v, s, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    s = (q.shape[-1] ** -0.5) if scale is None else scale
+    out = _flash_forward(q, k, v, s, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    # Exact gradients via the differentiable O(block)-memory scan
+    # implementation of the same function (ops/attention.py).
+    from distributed_machine_learning_tpu.ops.attention import (
+        blockwise_attention,
+    )
+
+    q, k, v = res
+    s = (q.shape[-1] ** -0.5) if scale is None else scale
+
+    def ref_fn(q_, k_, v_):
+        S = q_.shape[1]
+        bs = min(block_k, S)
+        while S % bs:
+            bs -= 1
+        # blockwise_attention uses 1/sqrt(D); fold any custom scale in by
+        # pre-scaling q.
+        q_scaled = q_ * (s / (q_.shape[-1] ** -0.5))
+        return blockwise_attention(q_scaled, k_, v_, block_size=bs, causal=causal)
+
+    _, vjp = jax.vjp(ref_fn, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -1,0 +1,136 @@
+"""Ring attention: exact softmax attention over sequence-sharded inputs.
+
+Long-context sequence parallelism, TPU-native. The sequence axis is sharded
+over a mesh axis (``sp``); each device holds a query chunk and rotates
+key/value chunks around the ring with ``jax.lax.ppermute`` (one ICI hop per
+step) while maintaining flash-style online-softmax statistics, so
+
+* memory per device is O(S/n * S/n) per step instead of O(S^2);
+* communication is the K/V chunk per step, riding nearest-neighbor ICI links
+  (the layout the TPU torus is built for) and overlapping with the block
+  matmuls XLA schedules between permutes;
+* the result is *exact* softmax attention — bitwise-independent of how many
+  devices the sequence is sharded over (up to float associativity).
+
+The reference has no long-context path at all (SURVEY.md §5: sequence length
+capped at 2000 by a dense PE table, vanilla ``nn.MultiheadAttention`` at
+`ray-tune-hpo-regression.py:139`); this module is the capability the TPU
+framework adds so sequence length scales with the mesh instead of with HBM.
+
+``ring_attention`` is differentiable (the loop is a ``lax.scan`` of jax ops;
+ppermute has a transpose rule), so it drops straight into the sharded train
+step for training over long sequences.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """shard_map across jax API generations (>=0.8 keyword-only; older
+    experimental takes check_rep)."""
+    try:
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    except (AttributeError, TypeError):  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map as legacy
+
+        return legacy(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+        )
+
+
+def _ring_local(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str,
+    causal: bool,
+    scale: Optional[float],
+) -> jnp.ndarray:
+    """Per-device body; q, k, v are the local [B, S/n, H, D] shards."""
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    s = (D ** -0.5) if scale is None else scale
+
+    qf = q.astype(jnp.float32) * s
+    # Rotate kv blocks "down" the ring: after step i, this device holds the
+    # shard originally owned by device (my_idx + i) mod n.
+    perm = [(j, (j - 1) % n) for j in range(n)]
+
+    q_pos = my_idx * Sq + jnp.arange(Sq)
+
+    def step(carry, i):
+        m, l, acc, k_cur, v_cur = carry
+        src = (my_idx + i) % n
+        k_pos = src * Sk + jnp.arange(Sk)
+
+        logits = jnp.einsum(
+            "bqhd,bkhd->bqhk",
+            qf,
+            k_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            cmask = q_pos[None, :, None, None] >= k_pos[None, None, None, :]
+            logits = jnp.where(cmask, logits, -jnp.inf)
+
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(logits - m_safe[..., None])
+        p = jnp.where(jnp.isfinite(logits), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_new = l * corr + p.sum(axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bqhk,bkhd->bqhd", p, v_cur.astype(jnp.float32)
+        )
+
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l_new, acc_new, k_nxt, v_nxt), None
+
+    m0 = jnp.full((B, Sq, H), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, Sq, H), jnp.float32)
+    acc0 = jnp.zeros((B, Sq, H, D), jnp.float32)
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        step, (m0, l0, acc0, k, v), jnp.arange(n)
+    )
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    batch_axis: Optional[str] = "dp",
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Exact softmax attention with the sequence sharded over ``axis_name``.
+
+    q, k, v: [B, S, H, D] global arrays (S divisible by the axis size).
+    ``batch_axis`` optionally shards batch over a second mesh axis (dp); pass
+    None if batch is replicated. Returns [B, S, H, D] with the same sharding.
+    """
+    if axis_name not in mesh.axis_names:
+        raise ValueError(f"mesh has no axis {axis_name!r}: {mesh.axis_names}")
+    baxis = batch_axis if (batch_axis and batch_axis in mesh.axis_names) else None
+    spec = P(baxis, axis_name, None, None)
+    fn = _shard_map(
+        partial(_ring_local, axis_name=axis_name, causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

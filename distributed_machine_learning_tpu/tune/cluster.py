@@ -1,0 +1,717 @@
+"""Multi-host trial execution: driver <-> per-host worker supervisors.
+
+The TPU-native replacement for the reference's delegated Ray Core layer
+(SURVEY.md §2b D4, §5 "distributed communication backend"): Ray's gRPC control
+plane + object store scheduled trial actors across a cluster
+(`ray-tune-hpo-regression.py:469-478` never sees it). Here the control plane
+is explicit and minimal:
+
+* ``serve_worker`` — one supervisor process per TPU host. It owns that host's
+  ``jax.devices()``, runs trials in device-pinned threads (same execution model
+  as the single-host executor), streams per-epoch metrics to the driver, and
+  applies the driver's continue/stop decisions. Trial *data* never moves over
+  this plane — datasets load host-locally and checkpoints go to shared storage
+  (GCS on a real pod) — only configs, metrics, and decisions do, which is why
+  plain length-prefixed frames over TCP (DCN between hosts) are enough.
+* ``run_distributed`` — the driver loop. Scheduler (ASHA/PBT/...), searcher,
+  and experiment store are the same single-threaded components as
+  ``tune.run``; only the executor is remote. Worker death (preemption) is
+  detected as a connection drop: the worker's running trials are requeued to
+  surviving workers, restoring from their latest shared-storage checkpoint,
+  within the per-trial ``max_failures`` budget (SURVEY.md §5: promoted to
+  first-class because TPU pods are preemptible).
+
+Trainables cross hosts **by name** (``"module:function"``) or by pickle-by-
+reference — the worker imports the module host-side. This mirrors how real
+pods run (same container image everywhere) and keeps arbitrary bytes off the
+control plane.
+
+Wire format: 8-byte big-endian length + pickle. Single driver per worker.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import pickle
+import queue
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+import traceback
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+from distributed_machine_learning_tpu.tune import checkpoint as ckpt_lib
+from distributed_machine_learning_tpu.tune.experiment import (
+    ExperimentAnalysis,
+    ExperimentStore,
+)
+from distributed_machine_learning_tpu.tune.schedulers.base import (
+    CONTINUE,
+    FIFOScheduler,
+    REQUEUE,
+    STOP,
+    TrialScheduler,
+)
+from distributed_machine_learning_tpu.tune.search.base import RandomSearch, Searcher
+from distributed_machine_learning_tpu.tune.search_space import SearchSpace
+from distributed_machine_learning_tpu.tune.session import (
+    PauseTrial,
+    Session,
+    StopTrial,
+    set_session,
+)
+from distributed_machine_learning_tpu.tune.trial import Trial, TrialStatus
+
+_LEN = struct.Struct(">Q")
+
+
+# --------------------------------------------------------------------------
+# framing
+# --------------------------------------------------------------------------
+
+
+def _send(sock: socket.socket, lock: threading.Lock, msg: Dict[str, Any]):
+    payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    with lock:
+        sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv(sock: socket.socket) -> Optional[Dict[str, Any]]:
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (n,) = _LEN.unpack(header)
+    payload = _recv_exact(sock, n)
+    if payload is None:
+        return None
+    return pickle.loads(payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def resolve_trainable(spec: Union[str, Callable]) -> Callable:
+    """Resolve ``"module:function"`` (or ``module.function``) to a callable."""
+    if callable(spec):
+        return spec
+    if ":" in spec:
+        mod_name, attr = spec.split(":", 1)
+    else:
+        mod_name, _, attr = spec.rpartition(".")
+    if not mod_name:
+        raise ValueError(f"Cannot resolve trainable spec {spec!r}")
+    obj = importlib.import_module(mod_name)
+    for part in attr.split("."):
+        obj = getattr(obj, part)
+    if not callable(obj):
+        raise TypeError(f"{spec!r} resolved to non-callable {obj!r}")
+    return obj
+
+
+# --------------------------------------------------------------------------
+# worker supervisor (one per TPU host)
+# --------------------------------------------------------------------------
+
+
+class _WorkerState:
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.send_lock = threading.Lock()
+        self.decisions: Dict[str, "queue.Queue[str]"] = {}
+        self.dec_lock = threading.Lock()
+
+
+def _worker_run_trial(state: _WorkerState, msg: Dict[str, Any], devices: List):
+    trial_id = msg["trial_id"]
+    dq: "queue.Queue[str]" = queue.Queue()
+    with state.dec_lock:
+        state.decisions[trial_id] = dq
+
+    trial = Trial(trial_id=trial_id, config=dict(msg["config"]))
+    trial.restore_path = msg.get("restore_path")
+    ckpt_dir = msg.get("checkpoint_dir")
+    iteration = [int(msg.get("start_iteration", 0))]
+
+    def report_fn(metrics: Dict[str, Any], checkpoint) -> str:
+        iteration[0] += 1
+        ckpt_path = None
+        if checkpoint is not None and ckpt_dir:
+            os.makedirs(ckpt_dir, exist_ok=True)
+            ckpt_path = os.path.join(ckpt_dir, f"ckpt_{iteration[0]:06d}.msgpack")
+            ckpt_lib.save_checkpoint(ckpt_path, checkpoint)
+        _send(
+            state.sock,
+            state.send_lock,
+            {
+                "type": "result",
+                "trial_id": trial_id,
+                "metrics": metrics,
+                "checkpoint_path": ckpt_path,
+            },
+        )
+        return dq.get()
+
+    def checkpoint_loader():
+        if trial.restore_path:
+            return ckpt_lib.load_checkpoint(trial.restore_path)
+        return None
+
+    try:
+        trainable = resolve_trainable(msg["trainable"])
+        set_session(Session(trial, report_fn, checkpoint_loader, devices))
+        import jax
+
+        with jax.default_device(devices[0]):
+            trainable(dict(trial.config))
+        _send(state.sock, state.send_lock, {"type": "complete", "trial_id": trial_id})
+    except (StopTrial, PauseTrial):
+        _send(state.sock, state.send_lock, {"type": "complete", "trial_id": trial_id})
+    except BaseException:  # noqa: BLE001 - ship the traceback to the driver
+        _send(
+            state.sock,
+            state.send_lock,
+            {
+                "type": "error",
+                "trial_id": trial_id,
+                "traceback": traceback.format_exc(),
+            },
+        )
+    finally:
+        set_session(None)
+        with state.dec_lock:
+            # Guard against the retry race: if the driver already redispatched
+            # this trial_id (our "error" frame triggers an immediate requeue),
+            # the map now holds the NEW incarnation's queue — popping it would
+            # silently drop that incarnation's decisions and wedge it.
+            if state.decisions.get(trial_id) is dq:
+                del state.decisions[trial_id]
+
+
+def serve_worker(
+    host: str = "127.0.0.1",
+    port: int = 0,
+    slots: Optional[int] = None,
+    ready_file: Optional[str] = None,
+) -> None:
+    """Run a host supervisor until the driver sends shutdown (blocking).
+
+    ``slots`` defaults to the host's jax device count — one trial per core,
+    the TPU analogue of the reference's one-trial-per-GPU placement
+    (`ray-tune-hpo-regression.py:475`).
+    """
+    # Bind and announce readiness BEFORE importing jax: jax cold-import takes
+    # tens of seconds, and the driver's connect queues in the backlog while
+    # device enumeration finishes (it blocks on the hello frame, not connect).
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    server.bind((host, port))
+    server.listen(8)
+    actual_port = server.getsockname()[1]
+    print(f"LISTENING {host}:{actual_port}", flush=True)
+    if ready_file:
+        with open(ready_file, "w") as f:
+            f.write(f"{host}:{actual_port}\n")
+
+    import jax
+
+    devices = list(jax.devices())
+    slots = slots or len(devices)
+
+    debug = bool(os.environ.get("DML_CLUSTER_DEBUG"))
+
+    def dbg(msg: str):
+        if debug:
+            print(f"[worker] {msg}", flush=True)
+
+    while True:
+        sock, peer = server.accept()
+        dbg(f"accepted driver {peer}")
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        state = _WorkerState(sock)
+        _send(
+            sock,
+            state.send_lock,
+            {
+                "type": "hello",
+                "slots": slots,
+                "host": socket.gethostname(),
+                "num_devices": len(devices),
+            },
+        )
+        shutdown = False
+        while True:
+            msg = _recv(sock)
+            if msg is None:
+                dbg("driver EOF")
+                break  # driver went away; await a new one
+            mtype = msg.get("type")
+            dbg(f"recv {mtype} {msg.get('trial_id', '')}")
+            if mtype == "run_trial":
+                # Round-robin device assignment by slot index keeps concurrent
+                # trials on distinct cores.
+                slot = int(msg.get("slot", 0))
+                dev = [devices[slot % len(devices)]]
+                threading.Thread(
+                    target=_worker_run_trial,
+                    args=(state, msg, dev),
+                    name=f"trial-{msg['trial_id']}",
+                    daemon=True,
+                ).start()
+            elif mtype == "decision":
+                with state.dec_lock:
+                    dq = state.decisions.get(msg["trial_id"])
+                if dq is not None:
+                    dq.put(msg["decision"])
+            elif mtype == "shutdown":
+                shutdown = True
+                break
+        # Unblock any trials still waiting on decisions so threads exit.
+        with state.dec_lock:
+            for dq in state.decisions.values():
+                dq.put("stop")
+        sock.close()
+        if shutdown:
+            break
+    server.close()
+
+
+# --------------------------------------------------------------------------
+# driver side
+# --------------------------------------------------------------------------
+
+
+class RemoteWorker:
+    """Driver-side handle for one host supervisor connection."""
+
+    def __init__(self, address: str):
+        self.address = address
+        host, port = address.rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)), timeout=30)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.send_lock = threading.Lock()
+        # The hello frame waits on the worker's jax cold-import; give it time.
+        self.sock.settimeout(300)
+        hello = _recv(self.sock)
+        self.sock.settimeout(None)
+        if not hello or hello.get("type") != "hello":
+            raise ConnectionError(f"Bad hello from worker {address}: {hello!r}")
+        self.slots: int = int(hello["slots"])
+        self.hostname: str = hello.get("host", address)
+        self.running: Dict[str, int] = {}  # trial_id -> slot
+        self.alive = True
+
+    @property
+    def free_slots(self) -> int:
+        return self.slots - len(self.running) if self.alive else 0
+
+    def send(self, msg: Dict[str, Any]):
+        _send(self.sock, self.send_lock, msg)
+
+    def close(self, shutdown: bool = False):
+        try:
+            if shutdown and self.alive:
+                self.send({"type": "shutdown"})
+        except OSError:
+            pass
+        try:
+            # shutdown() (not just close()) is required: the reader thread
+            # blocked in recv() holds the file description open, so a bare
+            # close() would never send FIN and the worker would never see
+            # EOF — wedging it for the next driver.
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.alive = False
+
+
+def run_distributed(
+    trainable: Union[str, Callable],
+    param_space: Union[Dict[str, Any], SearchSpace],
+    *,
+    metric: str,
+    workers: Sequence[str],
+    mode: str = "min",
+    num_samples: int = 10,
+    scheduler: Optional[TrialScheduler] = None,
+    search_alg: Optional[Searcher] = None,
+    storage_path: str = "~/dml_tpu_results",
+    name: Optional[str] = None,
+    seed: int = 0,
+    max_failures: int = 0,
+    time_budget_s: Optional[float] = None,
+    verbose: int = 1,
+    shutdown_workers: bool = False,
+) -> ExperimentAnalysis:
+    """``tune.run`` across multiple host supervisors (see module docstring).
+
+    ``trainable`` should be a ``"module:function"`` spec (resolved on each
+    worker host); a module-level callable also works (pickled by reference).
+    ``workers``: list of ``"host:port"`` supervisor addresses. Supervisors
+    outlive the experiment (they re-accept the next driver) unless
+    ``shutdown_workers=True``.
+    """
+    if mode not in ("min", "max"):
+        raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+    if not workers:
+        raise ValueError("run_distributed needs at least one worker address")
+    space = (
+        param_space
+        if isinstance(param_space, SearchSpace)
+        else SearchSpace(param_space)
+    )
+    searcher = search_alg or RandomSearch()
+    searcher.set_search_space(space, seed)
+    sched = scheduler or FIFOScheduler()
+    sched.set_experiment(metric, mode)
+
+    name = name or f"dist_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:6]}"
+    store = ExperimentStore(storage_path, name)
+
+    events: "queue.Queue[Tuple]" = queue.Queue()
+    pool: List[RemoteWorker] = []
+    for addr in workers:
+        w = RemoteWorker(addr)
+        pool.append(w)
+
+        def reader(worker: RemoteWorker):
+            while True:
+                msg = _recv(worker.sock)
+                if msg is None:
+                    events.put(("worker_dead", worker))
+                    return
+                events.put(("msg", worker, msg))
+
+        threading.Thread(
+            target=reader, args=(w,), name=f"reader-{addr}", daemon=True
+        ).start()
+
+    trainable_spec: Any = trainable
+    trials: List[Trial] = []
+    by_id: Dict[str, Trial] = {}
+    pending: List[Trial] = []
+    assignment: Dict[str, RemoteWorker] = {}
+    next_index = 0
+    searcher_exhausted = False
+    start_time = time.time()
+
+    def log(msg: str):
+        if verbose:
+            print(f"[tune.cluster] {msg}", flush=True)
+
+    def budget_exceeded() -> bool:
+        return time_budget_s is not None and time.time() - start_time > time_budget_s
+
+    def maybe_create_trial():
+        nonlocal next_index, searcher_exhausted
+        if searcher_exhausted or next_index >= num_samples or budget_exceeded():
+            return False
+        config = searcher.suggest(next_index)
+        if config is None:
+            searcher_exhausted = True
+            return False
+        trial = Trial(trial_id=f"trial_{next_index:05d}", config=config)
+        next_index += 1
+        trials.append(trial)
+        by_id[trial.trial_id] = trial
+        pending.append(trial)
+        sched.on_trial_add(trial)
+        store.write_params(trial)
+        return True
+
+    def dispatch(trial: Trial, worker: RemoteWorker):
+        slot = next(
+            s for s in range(worker.slots) if s not in worker.running.values()
+        )
+        worker.running[trial.trial_id] = slot
+        assignment[trial.trial_id] = worker
+        trial.status = TrialStatus.RUNNING
+        trial.started_at = trial.started_at or time.time()
+        trial.stop_requested = False
+        try:
+            worker.send(
+                {
+                    "type": "run_trial",
+                    "trial_id": trial.trial_id,
+                    "config": dict(trial.config),
+                    "trainable": trainable_spec,
+                    "slot": slot,
+                    "checkpoint_dir": store.checkpoint_dir(trial),
+                    "restore_path": trial.restore_path,
+                    "start_iteration": trial.training_iteration,
+                }
+            )
+        except OSError:
+            # Reader thread will (or already did) flag the death; requeue now
+            # so the trial isn't stranded on a dead worker.
+            worker.alive = False
+            requeue_trial(trial)
+
+    def launch_ready():
+        while pending:
+            worker = max(pool, key=lambda w: w.free_slots, default=None)
+            if worker is None or worker.free_slots <= 0:
+                return
+            dispatch(pending.pop(0), worker)
+
+    def release(trial: Trial):
+        worker = assignment.pop(trial.trial_id, None)
+        if worker is not None:
+            worker.running.pop(trial.trial_id, None)
+
+    def finish_trial(trial: Trial, status: TrialStatus):
+        release(trial)
+        trial.status = status
+        trial.finished_at = time.time()
+        if status == TrialStatus.TERMINATED:
+            searcher.on_trial_complete(
+                trial.trial_id, trial.config, trial.last_result, metric, mode
+            )
+        sched.on_trial_complete(trial)
+
+    def requeue_trial(trial: Trial):
+        release(trial)
+        trial.status = TrialStatus.PENDING
+        pending.append(trial)
+
+    def handle_failure(trial: Trial, why: str):
+        trial.num_failures += 1
+        # A PBT-style REQUEUE may be pending when the worker dies; the trial is
+        # being requeued NOW (failure path), so clear the flag — otherwise its
+        # eventual genuine completion would trigger a spurious extra re-run.
+        pbt_requeue = getattr(trial, "_requeue_on_complete", False)
+        trial._requeue_on_complete = False
+        if trial.num_failures <= max_failures:
+            # Keep a scheduler-chosen restore target (PBT exploit points
+            # restore_path at a DONOR's checkpoint) over our own.
+            if trial.latest_checkpoint and not (pbt_requeue and trial.restore_path):
+                trial.restore_path = trial.latest_checkpoint
+            log(
+                f"{trial.trial_id} failed ({why}); retry "
+                f"{trial.num_failures}/{max_failures}"
+                + (" from checkpoint" if trial.restore_path else "")
+            )
+            requeue_trial(trial)
+        else:
+            trial.error = why
+            finish_trial(trial, TrialStatus.ERROR)
+            sched.on_trial_error(trial)
+
+    # ---- main loop ----
+    try:
+        while True:
+            while (
+                len(trials) < num_samples
+                and not searcher_exhausted
+                and len(pending) < sum(max(w.free_slots, 0) for w in pool) + 2
+            ):
+                if not maybe_create_trial():
+                    break
+            launch_ready()
+
+            active = bool(pending) or any(w.running for w in pool)
+            if not active:
+                if (
+                    searcher_exhausted
+                    or len(trials) >= num_samples
+                    or budget_exceeded()
+                    or not any(w.alive for w in pool)
+                ):
+                    break
+                continue
+            if pending and not any(w.alive for w in pool):
+                # Cluster died with work outstanding.
+                for trial in list(pending):
+                    pending.remove(trial)
+                    trial.error = "no live workers"
+                    finish_trial(trial, TrialStatus.ERROR)
+                break
+
+            try:
+                event = events.get(timeout=0.5)
+            except queue.Empty:
+                continue
+
+            if event[0] == "worker_dead":
+                worker = event[1]
+                if getattr(worker, "_death_handled", False):
+                    continue
+                worker._death_handled = True
+                worker.alive = False
+                lost = [by_id[tid] for tid in list(worker.running)]
+                log(
+                    f"worker {worker.address} died with "
+                    f"{len(lost)} running trials"
+                )
+                for trial in lost:
+                    handle_failure(trial, f"worker {worker.address} died")
+                continue
+
+            _, worker, msg = event
+            mtype = msg.get("type")
+            trial = by_id.get(msg.get("trial_id", ""))
+            if trial is None:
+                continue
+
+            if mtype == "result":
+                metrics = dict(msg["metrics"])
+                metrics.setdefault("training_iteration", trial.training_iteration + 1)
+                metrics["trial_id"] = trial.trial_id
+                metrics["timestamp"] = time.time()
+                metrics["time_total_s"] = trial.runtime_s()
+                metrics["hostname"] = worker.hostname
+                if msg.get("checkpoint_path"):
+                    trial.latest_checkpoint = msg["checkpoint_path"]
+                trial.results.append(metrics)
+                store.append_result(trial, metrics)
+
+                reported_config = dict(trial.config)
+                decision = sched.on_trial_result(trial, metrics)
+                searcher.on_trial_result(
+                    trial.trial_id, reported_config, metrics, metric, mode
+                )
+                if trial.stop_requested or budget_exceeded():
+                    decision = STOP
+                if decision == REQUEUE:
+                    trial._requeue_on_complete = True
+                    decision = STOP
+                try:
+                    worker.send(
+                        {
+                            "type": "decision",
+                            "trial_id": trial.trial_id,
+                            "decision": "stop" if decision == STOP else "continue",
+                        }
+                    )
+                except OSError:
+                    worker.alive = False  # reader will requeue its trials
+
+            elif mtype == "complete":
+                if getattr(trial, "_requeue_on_complete", False):
+                    trial._requeue_on_complete = False
+                    requeue_trial(trial)
+                else:
+                    finish_trial(trial, TrialStatus.TERMINATED)
+                store.write_state(trials)
+
+            elif mtype == "error":
+                handle_failure(trial, msg.get("traceback", "unknown error"))
+                store.write_state(trials)
+    finally:
+        wall = time.time() - start_time
+        for w in pool:
+            w.close(shutdown=shutdown_workers)
+        try:
+            store.write_state(trials, extra={"wall_clock_s": wall})
+            store.close()
+        except Exception as exc:  # noqa: BLE001
+            log(f"store teardown failed: {exc!r}")
+
+    analysis = ExperimentAnalysis(
+        trials, metric=metric, mode=mode, root=store.root, wall_clock_s=wall
+    )
+    log(
+        f"experiment {name}: {analysis.num_terminated()}/{len(trials)} trials "
+        f"terminated in {wall:.1f}s across {len(workers)} workers"
+    )
+    return analysis
+
+
+# --------------------------------------------------------------------------
+# local worker spawning (dev / tests / single-machine multi-process)
+# --------------------------------------------------------------------------
+
+
+def start_local_workers(
+    n: int,
+    slots: int = 2,
+    env: Optional[Dict[str, str]] = None,
+    timeout: float = 180.0,
+) -> Tuple[List[subprocess.Popen], List[str]]:
+    """Spawn ``n`` worker supervisor subprocesses on localhost.
+
+    Each worker writes its bound address to a ready-file; returns
+    (processes, addresses). Caller terminates the processes (or
+    ``run_distributed`` shuts them down via the protocol).
+    """
+    import tempfile
+
+    procs: List[subprocess.Popen] = []
+    addrs: List[str] = []
+    for i in range(n):
+        fd, ready = tempfile.mkstemp(prefix=f"dml_worker_{i}_")
+        os.close(fd)
+        os.unlink(ready)
+        child_env = dict(os.environ)
+        if env:
+            child_env.update(env)
+        log_path = os.path.join(
+            tempfile.gettempdir(), f"dml_worker_{os.getpid()}_{i}.log"
+        )
+        log_f = open(log_path, "w")
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "distributed_machine_learning_tpu.tune.cluster",
+                "--host",
+                "127.0.0.1",
+                "--port",
+                "0",
+                "--slots",
+                str(slots),
+                "--ready-file",
+                ready,
+            ],
+            env=child_env,
+            stdout=log_f,
+            stderr=subprocess.STDOUT,
+        )
+        log_f.close()
+        proc.log_path = log_path  # type: ignore[attr-defined]
+        procs.append(proc)
+        deadline = time.time() + timeout
+        while not os.path.exists(ready):
+            if proc.poll() is not None:
+                raise RuntimeError(f"worker {i} exited rc={proc.returncode}")
+            if time.time() > deadline:
+                raise TimeoutError(f"worker {i} did not become ready")
+            time.sleep(0.05)
+        with open(ready) as f:
+            addrs.append(f.read().strip())
+        os.unlink(ready)
+    return procs, addrs
+
+
+def _main(argv: Optional[Sequence[str]] = None):
+    import argparse
+
+    parser = argparse.ArgumentParser(description="dml-tpu host trial supervisor")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=7711)
+    parser.add_argument("--slots", type=int, default=None)
+    parser.add_argument("--ready-file", default=None)
+    args = parser.parse_args(argv)
+    serve_worker(args.host, args.port, slots=args.slots, ready_file=args.ready_file)
+
+
+if __name__ == "__main__":
+    _main()

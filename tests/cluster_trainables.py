@@ -1,0 +1,65 @@
+"""Module-level trainables for the multi-host control-plane tests.
+
+Workers resolve trainables by import (``cluster_trainables:fn``), mirroring how
+a real pod ships the same container image to every host — so these live in an
+importable module, not inside the test functions.
+"""
+
+from __future__ import annotations
+
+import os
+
+from distributed_machine_learning_tpu import tune
+
+
+def quadratic_trial(config):
+    """Deterministic synthetic loss curve: converges toward (x - 3)^2."""
+    x = float(config["x"])
+    epochs = int(config.get("epochs", 5))
+    for epoch in range(1, epochs + 1):
+        loss = (x - 3.0) ** 2 + 1.0 / epoch
+        tune.report(
+            {"loss": loss, "epoch": epoch},
+            checkpoint={"x": x, "epoch": epoch},
+        )
+
+
+def crash_once_trial(config):
+    """Fails on its first attempt, succeeds after restart (retry-path test).
+
+    Uses a marker file under ``config['marker_dir']`` keyed by trial id, the
+    cross-process analogue of an in-memory attempt counter.
+    """
+    marker = os.path.join(config["marker_dir"], f"{tune.get_trial_id()}.attempted")
+    first_attempt = not os.path.exists(marker)
+    if first_attempt:
+        with open(marker, "w") as f:
+            f.write("1")
+    restored = tune.get_checkpoint()
+    start = int(restored["epoch"]) if restored else 0
+    for epoch in range(start + 1, 4):
+        if first_attempt and epoch == 2:
+            raise RuntimeError("injected failure (first attempt)")
+        tune.report(
+            {"loss": 10.0 / epoch, "epoch": epoch},
+            checkpoint={"epoch": epoch},
+        )
+
+
+def slow_trial(config):
+    """Reports slowly; used by the worker-death test so trials are in flight."""
+    import time
+
+    for epoch in range(1, int(config.get("epochs", 10)) + 1):
+        time.sleep(float(config.get("sleep_s", 0.2)))
+        tune.report({"loss": 1.0 / epoch, "epoch": epoch})
+
+
+def jax_device_trial(config):
+    """Touches jax on the worker host to prove device-pinned execution."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.arange(8.0) * float(config["x"])
+    y = float(jax.jit(lambda v: (v**2).sum())(x))
+    tune.report({"loss": y, "device": str(jax.devices()[0])})

@@ -1,0 +1,185 @@
+"""Multi-host control plane: driver <-> worker supervisors over TCP.
+
+SURVEY.md §2b D4/D5: the capability the reference delegated to Ray Core —
+cluster trial placement, metric RPC, fault handling — exercised here with
+real worker subprocesses on localhost (the same supervisor binary a TPU pod
+host would run).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from distributed_machine_learning_tpu import tune
+from distributed_machine_learning_tpu.tune.cluster import (
+    resolve_trainable,
+    run_distributed,
+    start_local_workers,
+)
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _worker_env():
+    # Strip any TPU-claiming sitecustomize (e.g. an .axon_site entry) from the
+    # workers' PYTHONPATH: worker supervisors in these tests are CPU-only, and
+    # a per-process TPU-session claim would serialize/deadlock their startup.
+    keep = [
+        p
+        for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        if p and ".axon_site" not in p
+    ]
+    return {
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.pathsep.join([TESTS_DIR] + keep),
+    }
+
+
+@pytest.fixture(scope="module")
+def worker_pool():
+    procs, addrs = start_local_workers(2, slots=2, env=_worker_env())
+    yield addrs
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except Exception:
+            p.kill()
+
+
+def test_resolve_trainable_specs():
+    fn = resolve_trainable("cluster_trainables:quadratic_trial")
+    assert callable(fn)
+    fn2 = resolve_trainable("os.path.join")
+    assert fn2 is os.path.join
+    assert resolve_trainable(fn) is fn
+
+
+def test_distributed_sweep_completes(worker_pool, tmp_path):
+    analysis = run_distributed(
+        "cluster_trainables:quadratic_trial",
+        {"x": tune.uniform(0.0, 6.0), "epochs": 4},
+        metric="loss",
+        mode="min",
+        num_samples=8,
+        workers=worker_pool,
+        storage_path=str(tmp_path),
+        name="dist_smoke",
+        seed=3,
+        verbose=0,
+    )
+    assert analysis.num_terminated() == 8
+    best = analysis.best_config
+    assert 0.0 <= best["x"] <= 6.0
+    # Best trial should be the sampled x closest to the optimum at 3.0.
+    xs = [t.config["x"] for t in analysis.trials]
+    assert abs(best["x"] - 3.0) == min(abs(x - 3.0) for x in xs)
+    # Per-epoch streaming: every trial has one result per epoch.
+    for t in analysis.trials:
+        assert len(t.results) == 4
+        assert t.results[-1]["hostname"]
+
+
+def test_distributed_asha_early_stops(worker_pool, tmp_path):
+    from distributed_machine_learning_tpu.tune.schedulers import ASHAScheduler
+
+    analysis = run_distributed(
+        "cluster_trainables:quadratic_trial",
+        {"x": tune.uniform(0.0, 6.0), "epochs": 8},
+        metric="loss",
+        mode="min",
+        num_samples=8,
+        workers=worker_pool,
+        scheduler=ASHAScheduler(max_t=8, grace_period=1, reduction_factor=2),
+        storage_path=str(tmp_path),
+        name="dist_asha",
+        seed=5,
+        verbose=0,
+    )
+    assert analysis.num_terminated() == 8
+    iters = [len(t.results) for t in analysis.trials]
+    assert any(i < 8 for i in iters), f"ASHA never early-stopped: {iters}"
+    assert any(i == 8 for i in iters)
+
+
+def test_distributed_retry_restores_from_checkpoint(worker_pool, tmp_path):
+    marker_dir = str(tmp_path / "markers")
+    os.makedirs(marker_dir, exist_ok=True)
+    analysis = run_distributed(
+        "cluster_trainables:crash_once_trial",
+        {"marker_dir": marker_dir},
+        metric="loss",
+        mode="min",
+        num_samples=3,
+        workers=worker_pool,
+        max_failures=2,
+        storage_path=str(tmp_path),
+        name="dist_retry",
+        verbose=0,
+    )
+    assert analysis.num_terminated() == 3
+    for t in analysis.trials:
+        assert t.num_failures == 1  # crashed once, then recovered
+        epochs = [r["epoch"] for r in t.results]
+        # epoch 1 reported pre-crash; retry restores from its checkpoint and
+        # continues with 2, 3 rather than restarting at 1.
+        assert epochs[0] == 1 and epochs[-1] == 3
+        assert epochs.count(1) == 1
+
+
+def test_worker_death_requeues_trials(tmp_path):
+    procs, addrs = start_local_workers(2, slots=2, env=_worker_env())
+    result = {}
+
+    def drive():
+        result["analysis"] = run_distributed(
+            "cluster_trainables:slow_trial",
+            {"epochs": 10, "sleep_s": 0.2},
+            metric="loss",
+            mode="min",
+            num_samples=4,
+            workers=addrs,
+            max_failures=3,
+            storage_path=str(tmp_path),
+            name="dist_death",
+            verbose=0,
+        )
+
+    # All 4 trials land immediately (2 slots x 2 workers); killing one worker
+    # mid-flight forces its 2 trials to requeue onto the survivor.
+    t = threading.Thread(target=drive)
+    t.start()
+    time.sleep(1.0)
+    procs[0].kill()
+    t.join(timeout=120)
+    assert not t.is_alive(), "driver hung after worker death"
+    analysis = result["analysis"]
+    done = analysis.num_terminated()
+    assert done == 4, f"only {done}/4 trials finished after worker death"
+    assert any(t_.num_failures > 0 for t_ in analysis.trials)
+    for p in procs[1:]:
+        p.terminate()
+
+
+def test_jax_runs_on_worker(worker_pool, tmp_path):
+    analysis = run_distributed(
+        "cluster_trainables:jax_device_trial",
+        {"x": tune.choice([1.0, 2.0])},
+        metric="loss",
+        mode="min",
+        num_samples=2,
+        workers=worker_pool,
+        storage_path=str(tmp_path),
+        name="dist_jax",
+        verbose=0,
+    )
+    assert analysis.num_terminated() == 2
+    for t in analysis.trials:
+        assert "cpu" in t.results[-1]["device"].lower()

@@ -1,0 +1,104 @@
+"""Native C++ data-layer kernels vs their numpy fallbacks.
+
+The C ABI in native/window_ops.cpp must agree bit-for-bit with the numpy
+reference implementations, under both the compiled library and the
+DML_TPU_DISABLE_NATIVE fallback. SURVEY.md §2 C4/C5: windowing and batch
+assembly are the reference's host-side data path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu.data import native
+from distributed_machine_learning_tpu.data.loader import (
+    Dataset,
+    split_into_intervals,
+)
+
+
+@pytest.fixture(scope="module")
+def arr():
+    return np.random.default_rng(0).normal(size=(1003, 7)).astype(np.float32)
+
+
+def test_native_library_builds():
+    # The image ships g++; the library must actually compile here.
+    assert native.native_available()
+
+
+def test_window_matches_stride_tricks(arr):
+    for interval, stride in [(96, 96), (96, 48), (50, 7), (1003, 1)]:
+        w = native.window(arr, interval, stride)
+        sv = np.lib.stride_tricks.sliding_window_view(arr, interval, axis=0)
+        ref = np.ascontiguousarray(np.transpose(sv[::stride], (0, 2, 1)))
+        assert w.shape == ref.shape
+        np.testing.assert_array_equal(w, ref)
+
+
+def test_window_short_input(arr):
+    out = native.window(arr[:10], 96, 96)
+    assert out.shape == (0, 96, 7)
+
+
+def test_window_1d_input(arr):
+    w = native.window(arr[:, 0], 96, 96)
+    assert w.shape == ((1003 - 96) // 96 + 1, 96, 1)
+
+
+def test_shuffled_indices_deterministic_permutation():
+    a = native.shuffled_indices(500, seed=1)
+    b = native.shuffled_indices(500, seed=1)
+    c = native.shuffled_indices(500, seed=2)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    assert sorted(a.tolist()) == list(range(500))
+
+
+def test_gather_matches_numpy(arr):
+    w = native.window(arr, 32, 32)
+    idx = native.shuffled_indices(len(w), seed=3)[:8]
+    np.testing.assert_array_equal(native.gather(w, idx), w[idx])
+
+
+def test_gather_bounds_check(arr):
+    if not native.native_available():
+        pytest.skip("fallback indexes numpy directly")
+    with pytest.raises(IndexError):
+        native.gather(arr, np.array([len(arr)], dtype=np.int64))
+
+
+def test_standardize_zero_mean_unit_std(arr):
+    out, mean, std = native.standardize(arr)
+    np.testing.assert_allclose(out.mean(axis=0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(out.std(axis=0), 1.0, atol=1e-3)
+    np.testing.assert_allclose(mean, arr.mean(axis=0), atol=1e-4)
+
+
+def test_standardize_constant_column():
+    x = np.ones((100, 3), dtype=np.float32)
+    x[:, 1] = np.linspace(0, 1, 100)
+    out, _, _ = native.standardize(x)
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out[:, 0], 0.0, atol=1e-6)
+
+
+def test_split_into_intervals_uses_native_path(arr):
+    out = split_into_intervals(arr, 96, 96)
+    sv = np.lib.stride_tricks.sliding_window_view(arr, 96, axis=0)
+    ref = np.ascontiguousarray(np.transpose(sv[::96], (0, 2, 1)))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_dataset_batches_native_gather_matches_manual(arr):
+    w = native.window(arr, 32, 32).astype(np.float32)
+    y = w[:, -1, :1].copy()
+    ds = Dataset(w, y)
+    batches = list(ds.batches(8, shuffle=True, seed_parts=("t", 0)))
+    assert all(bx.shape == (8, 32, 7) for bx, _ in batches)
+    # Same seed -> same batches.
+    batches2 = list(ds.batches(8, shuffle=True, seed_parts=("t", 0)))
+    for (x1, y1), (x2, y2) in zip(batches, batches2):
+        np.testing.assert_array_equal(x1, x2)
+        np.testing.assert_array_equal(y1, y2)
