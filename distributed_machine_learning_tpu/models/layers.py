@@ -25,6 +25,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh
 
 from distributed_machine_learning_tpu.ops.attention import (
     blockwise_attention,
@@ -118,6 +119,13 @@ class MultiHeadAttention(nn.Module):
     dropout_rate: float = 0.0
     causal: bool = False
     block_size: int = 128
+    # Sequence parallelism: when set (with a mesh), softmax attention runs as
+    # ring attention sharded over this mesh axis — the long-context path.
+    # Requires the surrounding jit to shard x's sequence dim over `seq_axis`.
+    seq_axis: Optional[str] = None
+    batch_axis: Optional[str] = "dp"
+    head_axis: Optional[str] = "tp"
+    mesh: Optional[Mesh] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
@@ -140,7 +148,27 @@ class MultiHeadAttention(nn.Module):
 
         q, k, v = proj("query"), proj("key"), proj("value")
 
-        if self.attention_type == "linear_attention":
+        if self.seq_axis is not None:
+            if self.mesh is None:
+                raise ValueError(
+                    "seq_axis set but no mesh given: ring attention needs the "
+                    "device mesh to shard the sequence over"
+                )
+            from distributed_machine_learning_tpu.parallel.ring_attention import (
+                ring_attention,
+            )
+
+            scale = float(head_dim) ** (-self.key_dim_scaling)
+            out = ring_attention(
+                q, k, v,
+                mesh=self.mesh,
+                axis_name=self.seq_axis,
+                batch_axis=self.batch_axis,
+                head_axis=self.head_axis,
+                causal=self.causal,
+                scale=scale,
+            )
+        elif self.attention_type == "linear_attention":
             out = linear_attention(q, k, v, causal=self.causal)
         elif self.attention_type == "flash":
             # Hand-written Pallas MXU kernel on TPU; off-TPU the same math
@@ -242,6 +270,10 @@ class EncoderLayer(nn.Module):
     depthwise_separable_conv: bool = False
     attn_kernel_size: int = 3
     stochastic_depth_rate: float = 0.0
+    seq_axis: Optional[str] = None
+    batch_axis: Optional[str] = "dp"
+    head_axis: Optional[str] = "tp"
+    mesh: Optional[Mesh] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
@@ -251,6 +283,10 @@ class EncoderLayer(nn.Module):
             attention_type=self.attention_type,
             key_dim_scaling=self.key_dim_scaling,
             dropout_rate=self.dropout_rate,
+            seq_axis=self.seq_axis,
+            batch_axis=self.batch_axis,
+            head_axis=self.head_axis,
+            mesh=self.mesh,
             name="attention",
         )(x, deterministic=deterministic)
         attn = StochasticDepth(self.stochastic_depth_rate)(attn, deterministic)

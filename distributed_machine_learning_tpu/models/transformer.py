@@ -16,10 +16,11 @@
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax.sharding import Mesh
 
 from distributed_machine_learning_tpu.models.layers import (
     EncoderLayer,
@@ -68,6 +69,14 @@ class TransformerRegressor(nn.Module):
     max_seq_length: int = 2000
     head_hidden_sizes: Sequence[int] = (128, 64, 32, 16)
     out_features: int = 1
+    # Long-context sequence parallelism: with a mesh + seq_axis, every
+    # attention block runs as ring attention over that mesh axis
+    # (parallel/ring_attention.py) while the rest of the model stays under
+    # GSPMD — sequence length then scales with the mesh, not per-chip HBM.
+    seq_axis: Optional[str] = None
+    batch_axis: Optional[str] = "dp"
+    head_axis: Optional[str] = "tp"
+    mesh: Optional[Mesh] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
@@ -87,6 +96,10 @@ class TransformerRegressor(nn.Module):
             depthwise_separable_conv=self.depthwise_separable_conv,
             attn_kernel_size=self.attn_kernel_size,
             stochastic_depth_rate=self.stochastic_depth_rate,
+            seq_axis=self.seq_axis,
+            batch_axis=self.batch_axis,
+            head_axis=self.head_axis,
+            mesh=self.mesh,
         )
 
         x = nn.Dense(self.d_model, name="input_projection")(x)

@@ -114,19 +114,23 @@ def ring_attention(
     mesh: Mesh,
     axis_name: str = "sp",
     batch_axis: Optional[str] = "dp",
+    head_axis: Optional[str] = None,
     causal: bool = False,
     scale: Optional[float] = None,
 ) -> jnp.ndarray:
     """Exact softmax attention with the sequence sharded over ``axis_name``.
 
     q, k, v: [B, S, H, D] global arrays (S divisible by the axis size).
-    ``batch_axis`` optionally shards batch over a second mesh axis (dp); pass
-    None if batch is replicated. Returns [B, S, H, D] with the same sharding.
+    ``batch_axis`` optionally shards batch over a second mesh axis (dp);
+    ``head_axis`` optionally shards heads over a third (tp) — heads are
+    independent, so tensor parallelism composes with the ring for free.
+    Returns [B, S, H, D] with the same sharding.
     """
     if axis_name not in mesh.axis_names:
         raise ValueError(f"mesh has no axis {axis_name!r}: {mesh.axis_names}")
     baxis = batch_axis if (batch_axis and batch_axis in mesh.axis_names) else None
-    spec = P(baxis, axis_name, None, None)
+    haxis = head_axis if (head_axis and head_axis in mesh.axis_names) else None
+    spec = P(baxis, axis_name, haxis, None)
     fn = _shard_map(
         partial(_ring_local, axis_name=axis_name, causal=causal, scale=scale),
         mesh=mesh,

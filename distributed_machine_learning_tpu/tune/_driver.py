@@ -1,0 +1,191 @@
+"""Shared trial-lifecycle core for the single-host and cluster drivers.
+
+``tune.run`` (runner.py, thread executor on local devices) and
+``cluster.run_distributed`` (cluster.py, remote host supervisors) differ only
+in *where* trials execute; the lifecycle — sampling configs from the
+searcher, stamping and persisting per-epoch results, routing them through the
+scheduler, REQUEUE bookkeeping (PBT), retry-with-restore on failure — is one
+state machine. This module owns it, so scheduler-protocol changes land in
+exactly one place. (The reference delegated all of this to Ray Tune's trial
+runner; SURVEY.md §1 L4.)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from distributed_machine_learning_tpu.tune.schedulers.base import (
+    CONTINUE,
+    REQUEUE,
+    STOP,
+)
+from distributed_machine_learning_tpu.tune.trial import Trial, TrialStatus
+
+
+class TrialLifecycle:
+    """Single-threaded trial state machine shared by both drivers.
+
+    The executor layer (threads or remote workers) calls in with events;
+    this class mutates trial/searcher/scheduler/store state and answers
+    with decisions. It never blocks and never touches sockets or devices.
+    """
+
+    def __init__(
+        self,
+        *,
+        searcher,
+        scheduler,
+        store,
+        metric: str,
+        mode: str,
+        num_samples: int,
+        max_failures: int = 0,
+        stop_rules: Optional[Dict[str, float]] = None,
+        time_budget_s: Optional[float] = None,
+        log: Callable[[str], None] = lambda msg: None,
+    ):
+        self.searcher = searcher
+        self.scheduler = scheduler
+        self.store = store
+        self.metric = metric
+        self.mode = mode
+        self.num_samples = num_samples
+        self.max_failures = max_failures
+        self.stop_rules = stop_rules or {}
+        self.time_budget_s = time_budget_s
+        self.log = log
+
+        self.trials: List[Trial] = []
+        self.by_id: Dict[str, Trial] = {}
+        self.pending: List[Trial] = []
+        self.next_index = 0
+        self.searcher_exhausted = False
+        self.start_time = time.time()
+
+    # -- creation ----------------------------------------------------------
+
+    def budget_exceeded(self) -> bool:
+        return (
+            self.time_budget_s is not None
+            and time.time() - self.start_time > self.time_budget_s
+        )
+
+    def exhausted(self) -> bool:
+        """No further trials will ever be created."""
+        return (
+            self.searcher_exhausted
+            or self.next_index >= self.num_samples
+            or self.budget_exceeded()
+        )
+
+    def create_trial(self, **trial_kwargs) -> Optional[Trial]:
+        """Sample the next config; returns the new PENDING trial or None."""
+        if self.exhausted():
+            return None
+        config = self.searcher.suggest(self.next_index)
+        if config is None:
+            self.searcher_exhausted = True
+            return None
+        trial = Trial(
+            trial_id=f"trial_{self.next_index:05d}", config=config, **trial_kwargs
+        )
+        self.next_index += 1
+        self.trials.append(trial)
+        self.by_id[trial.trial_id] = trial
+        self.pending.append(trial)
+        self.scheduler.on_trial_add(trial)
+        self.store.write_params(trial)
+        return trial
+
+    # -- results -----------------------------------------------------------
+
+    def process_result(
+        self, trial: Trial, metrics: Dict[str, Any], extra: Optional[Dict] = None
+    ) -> str:
+        """Stamp + persist a result, run scheduler/searcher; returns
+        "stop" or "continue" (REQUEUE is folded into stop + a flag consumed
+        by :meth:`complete_trial`)."""
+        metrics = dict(metrics)
+        metrics.setdefault("training_iteration", trial.training_iteration + 1)
+        metrics["trial_id"] = trial.trial_id
+        metrics["timestamp"] = time.time()
+        metrics["time_total_s"] = trial.runtime_s()
+        if extra:
+            metrics.update(extra)
+        trial.results.append(metrics)
+        self.store.append_result(trial, metrics)
+
+        # Snapshot before the scheduler runs: PBT mutates trial.config in
+        # place on REQUEUE, and the searcher must see the config that
+        # actually produced these metrics.
+        reported_config = dict(trial.config)
+        decision = self.scheduler.on_trial_result(trial, metrics)
+        self.searcher.on_trial_result(
+            trial.trial_id, reported_config, metrics, self.metric, self.mode
+        )
+        if self.stop_rules and any(
+            k in metrics and float(metrics[k]) >= v
+            for k, v in self.stop_rules.items()
+        ):
+            decision = STOP if decision == CONTINUE else decision
+        if trial.stop_requested or self.budget_exceeded():
+            decision = STOP
+        if decision == REQUEUE:
+            trial._requeue_on_complete = True
+            decision = STOP
+        return "stop" if decision == STOP else "continue"
+
+    # -- terminal events ---------------------------------------------------
+
+    def complete_trial(self, trial: Trial) -> bool:
+        """Trial finished cleanly. Returns True if it was requeued (PBT)."""
+        if getattr(trial, "_requeue_on_complete", False):
+            trial._requeue_on_complete = False
+            self.requeue(trial)
+            return True
+        self.finish(trial, TrialStatus.TERMINATED)
+        return False
+
+    def fail_trial(self, trial: Trial, why: str) -> bool:
+        """Trial errored/preempted. Returns True if it will be retried."""
+        trial.num_failures += 1
+        # A PBT-style REQUEUE may be pending when the failure lands; the
+        # trial is being requeued NOW, so consume the flag — otherwise its
+        # eventual genuine completion would trigger a spurious extra re-run.
+        pbt_requeue = getattr(trial, "_requeue_on_complete", False)
+        trial._requeue_on_complete = False
+        if trial.num_failures <= self.max_failures:
+            # Keep a scheduler-chosen restore target (PBT exploit points
+            # restore_path at a DONOR's checkpoint) over our own.
+            if trial.latest_checkpoint and not (pbt_requeue and trial.restore_path):
+                trial.restore_path = trial.latest_checkpoint
+            self.log(
+                f"{trial.trial_id} failed "
+                f"({trial.num_failures}/{self.max_failures}): {why.splitlines()[-1] if why else why}; retrying"
+                + (" from checkpoint" if trial.restore_path else "")
+            )
+            self.requeue(trial)
+            return True
+        trial.error = why
+        self.finish(trial, TrialStatus.ERROR)
+        self.scheduler.on_trial_error(trial)
+        return False
+
+    def finish(self, trial: Trial, status: TrialStatus):
+        trial.status = status
+        trial.finished_at = time.time()
+        if status == TrialStatus.TERMINATED:
+            self.searcher.on_trial_complete(
+                trial.trial_id, trial.config, trial.last_result, self.metric, self.mode
+            )
+        self.scheduler.on_trial_complete(trial)
+
+    def requeue(self, trial: Trial):
+        trial.status = TrialStatus.PENDING
+        self.pending.append(trial)
+
+    def mark_running(self, trial: Trial):
+        trial.status = TrialStatus.RUNNING
+        trial.started_at = trial.started_at or time.time()
+        trial.stop_requested = False

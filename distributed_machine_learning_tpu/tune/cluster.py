@@ -50,11 +50,9 @@ from distributed_machine_learning_tpu.tune.experiment import (
     ExperimentAnalysis,
     ExperimentStore,
 )
+from distributed_machine_learning_tpu.tune._driver import TrialLifecycle
 from distributed_machine_learning_tpu.tune.schedulers.base import (
-    CONTINUE,
     FIFOScheduler,
-    REQUEUE,
-    STOP,
     TrialScheduler,
 )
 from distributed_machine_learning_tpu.tune.search.base import RandomSearch, Searcher
@@ -405,37 +403,27 @@ def run_distributed(
         ).start()
 
     trainable_spec: Any = trainable
-    trials: List[Trial] = []
-    by_id: Dict[str, Trial] = {}
-    pending: List[Trial] = []
     assignment: Dict[str, RemoteWorker] = {}
-    next_index = 0
-    searcher_exhausted = False
-    start_time = time.time()
 
     def log(msg: str):
         if verbose:
             print(f"[tune.cluster] {msg}", flush=True)
 
-    def budget_exceeded() -> bool:
-        return time_budget_s is not None and time.time() - start_time > time_budget_s
-
-    def maybe_create_trial():
-        nonlocal next_index, searcher_exhausted
-        if searcher_exhausted or next_index >= num_samples or budget_exceeded():
-            return False
-        config = searcher.suggest(next_index)
-        if config is None:
-            searcher_exhausted = True
-            return False
-        trial = Trial(trial_id=f"trial_{next_index:05d}", config=config)
-        next_index += 1
-        trials.append(trial)
-        by_id[trial.trial_id] = trial
-        pending.append(trial)
-        sched.on_trial_add(trial)
-        store.write_params(trial)
-        return True
+    lifecycle = TrialLifecycle(
+        searcher=searcher,
+        scheduler=sched,
+        store=store,
+        metric=metric,
+        mode=mode,
+        num_samples=num_samples,
+        max_failures=max_failures,
+        time_budget_s=time_budget_s,
+        log=log,
+    )
+    trials = lifecycle.trials
+    by_id = lifecycle.by_id
+    pending = lifecycle.pending
+    start_time = lifecycle.start_time
 
     def dispatch(trial: Trial, worker: RemoteWorker):
         slot = next(
@@ -443,9 +431,7 @@ def run_distributed(
         )
         worker.running[trial.trial_id] = slot
         assignment[trial.trial_id] = worker
-        trial.status = TrialStatus.RUNNING
-        trial.started_at = trial.started_at or time.time()
-        trial.stop_requested = False
+        lifecycle.mark_running(trial)
         try:
             worker.send(
                 {
@@ -463,7 +449,8 @@ def run_distributed(
             # Reader thread will (or already did) flag the death; requeue now
             # so the trial isn't stranded on a dead worker.
             worker.alive = False
-            requeue_trial(trial)
+            release(trial)
+            lifecycle.requeue(trial)
 
     def launch_ready():
         while pending:
@@ -477,64 +464,19 @@ def run_distributed(
         if worker is not None:
             worker.running.pop(trial.trial_id, None)
 
-    def finish_trial(trial: Trial, status: TrialStatus):
-        release(trial)
-        trial.status = status
-        trial.finished_at = time.time()
-        if status == TrialStatus.TERMINATED:
-            searcher.on_trial_complete(
-                trial.trial_id, trial.config, trial.last_result, metric, mode
-            )
-        sched.on_trial_complete(trial)
-
-    def requeue_trial(trial: Trial):
-        release(trial)
-        trial.status = TrialStatus.PENDING
-        pending.append(trial)
-
-    def handle_failure(trial: Trial, why: str):
-        trial.num_failures += 1
-        # A PBT-style REQUEUE may be pending when the worker dies; the trial is
-        # being requeued NOW (failure path), so clear the flag — otherwise its
-        # eventual genuine completion would trigger a spurious extra re-run.
-        pbt_requeue = getattr(trial, "_requeue_on_complete", False)
-        trial._requeue_on_complete = False
-        if trial.num_failures <= max_failures:
-            # Keep a scheduler-chosen restore target (PBT exploit points
-            # restore_path at a DONOR's checkpoint) over our own.
-            if trial.latest_checkpoint and not (pbt_requeue and trial.restore_path):
-                trial.restore_path = trial.latest_checkpoint
-            log(
-                f"{trial.trial_id} failed ({why}); retry "
-                f"{trial.num_failures}/{max_failures}"
-                + (" from checkpoint" if trial.restore_path else "")
-            )
-            requeue_trial(trial)
-        else:
-            trial.error = why
-            finish_trial(trial, TrialStatus.ERROR)
-            sched.on_trial_error(trial)
-
     # ---- main loop ----
     try:
         while True:
-            while (
-                len(trials) < num_samples
-                and not searcher_exhausted
-                and len(pending) < sum(max(w.free_slots, 0) for w in pool) + 2
-            ):
-                if not maybe_create_trial():
+            while not lifecycle.exhausted() and len(pending) < sum(
+                max(w.free_slots, 0) for w in pool
+            ) + 2:
+                if lifecycle.create_trial() is None:
                     break
             launch_ready()
 
             active = bool(pending) or any(w.running for w in pool)
             if not active:
-                if (
-                    searcher_exhausted
-                    or len(trials) >= num_samples
-                    or budget_exceeded()
-                    or not any(w.alive for w in pool)
-                ):
+                if lifecycle.exhausted() or not any(w.alive for w in pool):
                     break
                 continue
             if pending and not any(w.alive for w in pool):
@@ -542,7 +484,7 @@ def run_distributed(
                 for trial in list(pending):
                     pending.remove(trial)
                     trial.error = "no live workers"
-                    finish_trial(trial, TrialStatus.ERROR)
+                    lifecycle.finish(trial, TrialStatus.ERROR)
                 break
 
             try:
@@ -562,7 +504,8 @@ def run_distributed(
                     f"{len(lost)} running trials"
                 )
                 for trial in lost:
-                    handle_failure(trial, f"worker {worker.address} died")
+                    release(trial)
+                    lifecycle.fail_trial(trial, f"worker {worker.address} died")
                 continue
 
             _, worker, msg = event
@@ -572,48 +515,31 @@ def run_distributed(
                 continue
 
             if mtype == "result":
-                metrics = dict(msg["metrics"])
-                metrics.setdefault("training_iteration", trial.training_iteration + 1)
-                metrics["trial_id"] = trial.trial_id
-                metrics["timestamp"] = time.time()
-                metrics["time_total_s"] = trial.runtime_s()
-                metrics["hostname"] = worker.hostname
                 if msg.get("checkpoint_path"):
                     trial.latest_checkpoint = msg["checkpoint_path"]
-                trial.results.append(metrics)
-                store.append_result(trial, metrics)
-
-                reported_config = dict(trial.config)
-                decision = sched.on_trial_result(trial, metrics)
-                searcher.on_trial_result(
-                    trial.trial_id, reported_config, metrics, metric, mode
+                decision = lifecycle.process_result(
+                    trial, msg["metrics"], extra={"hostname": worker.hostname}
                 )
-                if trial.stop_requested or budget_exceeded():
-                    decision = STOP
-                if decision == REQUEUE:
-                    trial._requeue_on_complete = True
-                    decision = STOP
                 try:
                     worker.send(
                         {
                             "type": "decision",
                             "trial_id": trial.trial_id,
-                            "decision": "stop" if decision == STOP else "continue",
+                            "decision": decision,
                         }
                     )
                 except OSError:
                     worker.alive = False  # reader will requeue its trials
 
             elif mtype == "complete":
-                if getattr(trial, "_requeue_on_complete", False):
-                    trial._requeue_on_complete = False
-                    requeue_trial(trial)
-                else:
-                    finish_trial(trial, TrialStatus.TERMINATED)
+                release(trial)
+                lifecycle.complete_trial(trial)
                 store.write_state(trials)
 
             elif mtype == "error":
-                handle_failure(trial, msg.get("traceback", "unknown error"))
+                trial.error = msg.get("traceback", "unknown error")
+                release(trial)
+                lifecycle.fail_trial(trial, trial.error)
                 store.write_state(trials)
     finally:
         wall = time.time() - start_time

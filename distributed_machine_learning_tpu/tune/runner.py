@@ -26,11 +26,9 @@ from distributed_machine_learning_tpu.tune.experiment import (
     ExperimentAnalysis,
     ExperimentStore,
 )
+from distributed_machine_learning_tpu.tune._driver import TrialLifecycle
 from distributed_machine_learning_tpu.tune.schedulers.base import (
-    CONTINUE,
     FIFOScheduler,
-    REQUEUE,
-    STOP,
     TrialScheduler,
 )
 from distributed_machine_learning_tpu.tune.search.base import RandomSearch, Searcher
@@ -93,17 +91,28 @@ def run(
     callbacks = list(callbacks or [])
 
     max_concurrent = max_concurrent or device_mgr.num_devices
-    trials: List[Trial] = []
-    pending: List[Trial] = []
     running: Dict[str, List] = {}  # trial_id -> leased devices
-    next_index = 0
-    searcher_exhausted = False
-    start_time = time.time()
     last_status_print = 0.0
 
     def log(msg: str):
         if verbose:
             print(f"[tune] {msg}", flush=True)
+
+    lifecycle = TrialLifecycle(
+        searcher=searcher,
+        scheduler=sched,
+        store=store,
+        metric=metric,
+        mode=mode,
+        num_samples=num_samples,
+        max_failures=max_failures,
+        stop_rules=stop,
+        time_budget_s=time_budget_s,
+        log=log,
+    )
+    trials = lifecycle.trials
+    pending = lifecycle.pending
+    start_time = lifecycle.start_time
 
     def safe_cb(hook: str, *args):
         """Observers must never wedge the sweep: a raising callback is logged
@@ -115,81 +124,37 @@ def run(
             except Exception as exc:  # noqa: BLE001 - observer isolation
                 log(f"{type(cb).__name__}.{hook} raised: {exc!r}")
 
-    def budget_exceeded() -> bool:
-        return time_budget_s is not None and time.time() - start_time > time_budget_s
-
-    def maybe_create_trial():
-        nonlocal next_index, searcher_exhausted
-        if searcher_exhausted or next_index >= num_samples or budget_exceeded():
-            return
-        config = searcher.suggest(next_index)
-        if config is None:
-            searcher_exhausted = True
-            return
-        trial = Trial(
-            trial_id=f"trial_{next_index:05d}",
-            config=config,
-            resources=resources,
-        )
-        next_index += 1
-        trials.append(trial)
-        pending.append(trial)
-        sched.on_trial_add(trial)
-        store.write_params(trial)
-
     def launch_ready():
         while pending and len(running) < max_concurrent:
             leased = device_mgr.acquire(pending[0].resources.devices)
             if leased is None:
                 return
             trial = pending.pop(0)
-            trial.status = TrialStatus.RUNNING
-            trial.started_at = trial.started_at or time.time()
-            trial.stop_requested = False
+            lifecycle.mark_running(trial)
             running[trial.trial_id] = leased
             safe_cb("on_trial_start", trial)
             executor.start_trial(trial, trainable, leased)
 
-    def finish_trial(trial: Trial, status: TrialStatus):
+    def release_devices(trial: Trial):
         leased = running.pop(trial.trial_id, None)
         if leased:
             device_mgr.release(leased)
-        trial.status = status
-        trial.finished_at = time.time()
-        if status == TrialStatus.TERMINATED:
-            searcher.on_trial_complete(
-                trial.trial_id, trial.config, trial.last_result, metric, mode
-            )
-        sched.on_trial_complete(trial)
-
-    def requeue_trial(trial: Trial):
-        leased = running.pop(trial.trial_id, None)
-        if leased:
-            device_mgr.release(leased)
-        trial.status = TrialStatus.PENDING
-        pending.append(trial)
 
     # -------- main event loop ------------------------------------------------
     def event_loop():
         nonlocal last_status_print
         while True:
-            while len(trials) < num_samples and not searcher_exhausted and (
+            while not lifecycle.exhausted() and (
                 len(pending) + len(running) < max_concurrent + 2
             ):
-                before = len(trials)
-                maybe_create_trial()
-                if len(trials) == before:
+                if lifecycle.create_trial(resources=resources) is None:
                     break
             launch_ready()
 
             if not running and not pending:
-                if (
-                    searcher_exhausted
-                    or len(trials) >= num_samples
-                    or budget_exceeded()
-                ):
+                if lifecycle.exhausted():
                     break
-                if len(trials) == 0 and next_index == 0:
+                if len(trials) == 0 and lifecycle.next_index == 0:
                     break  # nothing to do at all
                 continue
 
@@ -205,9 +170,10 @@ def run(
                     )
                 # Reap threads that died without reporting (shouldn't happen).
                 for tid in list(running):
-                    trial = next(t for t in trials if t.trial_id == tid)
+                    trial = lifecycle.by_id[tid]
                     if not executor.is_alive(trial):
-                        finish_trial(trial, TrialStatus.ERROR)
+                        release_devices(trial)
+                        lifecycle.finish(trial, TrialStatus.ERROR)
                         safe_cb(
                             "on_trial_error",
                             trial,
@@ -220,71 +186,30 @@ def run(
             if kind == "result":
                 result_event = event[1]
                 trial = result_event.trial
-                metrics = dict(result_event.metrics)
-                metrics.setdefault(
-                    "training_iteration", trial.training_iteration + 1
+                result_event.decision = lifecycle.process_result(
+                    trial, result_event.metrics
                 )
-                metrics["trial_id"] = trial.trial_id
-                metrics["timestamp"] = time.time()
-                metrics["time_total_s"] = trial.runtime_s()
-                trial.results.append(metrics)
-                store.append_result(trial, metrics)
-
-                # Snapshot before the scheduler runs: PBT mutates trial.config
-                # in place on REQUEUE, and the searcher must see the config
-                # that actually produced these metrics.
-                reported_config = dict(trial.config)
-                decision = sched.on_trial_result(trial, metrics)
-                searcher.on_trial_result(
-                    trial.trial_id, reported_config, metrics, metric, mode
-                )
-                if stop and any(
-                    k in metrics and float(metrics[k]) >= v
-                    for k, v in stop.items()
-                ):
-                    decision = STOP if decision == CONTINUE else decision
-                if trial.stop_requested or budget_exceeded():
-                    decision = STOP
-                if decision == REQUEUE:
-                    trial._requeue_on_complete = True
-                    decision = STOP
-                result_event.decision = "stop" if decision == STOP else "continue"
                 # Unblock the trial thread BEFORE observers run: a slow or
                 # buggy callback must not stall (or hang) training.
                 result_event.done.set()
-                safe_cb("on_trial_result", trial, metrics)
+                safe_cb("on_trial_result", trial, trial.last_result)
 
             elif kind == "complete":
                 trial = event[1]
-                if getattr(trial, "_requeue_on_complete", False):
-                    trial._requeue_on_complete = False
-                    requeue_trial(trial)
-                else:
-                    finish_trial(trial, TrialStatus.TERMINATED)
+                release_devices(trial)
+                if not lifecycle.complete_trial(trial):
                     safe_cb("on_trial_complete", trial)
                 store.write_state(trials)
 
             elif kind == "error":
                 trial, tb = event[1], event[2]
                 trial.error = tb
-                trial.num_failures += 1
                 # Every failure is observable, including ones that will be
                 # retried (preemptions are exactly what observers watch for).
                 safe_cb("on_trial_error", trial, tb)
-                if trial.num_failures <= max_failures:
-                    log(
-                        f"{trial.trial_id} failed "
-                        f"({trial.num_failures}/{max_failures}); retrying"
-                        + (" from checkpoint" if trial.latest_checkpoint else "")
-                    )
-                    if trial.latest_checkpoint:
-                        trial.restore_path = trial.latest_checkpoint
-                    requeue_trial(trial)
-                else:
-                    if verbose:
-                        log(f"{trial.trial_id} errored:\n{tb}")
-                    finish_trial(trial, TrialStatus.ERROR)
-                    sched.on_trial_error(trial)
+                release_devices(trial)
+                if not lifecycle.fail_trial(trial, tb) and verbose:
+                    log(f"{trial.trial_id} errored:\n{tb}")
                 store.write_state(trials)
 
     # Teardown always runs (Ctrl-C, store errors, a callback's setup raising):

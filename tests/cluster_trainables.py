@@ -55,6 +55,21 @@ def slow_trial(config):
         tune.report({"loss": 1.0 / epoch, "epoch": epoch})
 
 
+def pbt_trial(config):
+    """Checkpoint-carrying trainable for PBT-over-cluster: loss improves with
+    a per-config 'rate', so PBT exploits good rates into bad trials."""
+    restored = tune.get_checkpoint()
+    start = int(restored["epoch"]) if restored else 0
+    score = float(restored["score"]) if restored else 100.0
+    rate = float(config["rate"])
+    for epoch in range(start + 1, int(config.get("epochs", 8)) + 1):
+        score = score * (1.0 - rate)
+        tune.report(
+            {"loss": score, "epoch": epoch},
+            checkpoint={"epoch": epoch, "score": score},
+        )
+
+
 def jax_device_trial(config):
     """Touches jax on the worker host to prove device-pinned execution."""
     import jax

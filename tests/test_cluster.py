@@ -134,6 +134,45 @@ def test_distributed_retry_restores_from_checkpoint(worker_pool, tmp_path):
         assert epochs.count(1) == 1
 
 
+def test_distributed_pbt_exploits_and_restores(worker_pool, tmp_path):
+    """PBT over the cluster: REQUEUE decisions stop a lagging trial, restore a
+    donor checkpoint on a (possibly different) worker, and resume mid-stream —
+    the full exploit/explore loop across the control plane."""
+    from distributed_machine_learning_tpu.tune.schedulers import (
+        PopulationBasedTraining,
+    )
+
+    analysis = run_distributed(
+        "cluster_trainables:pbt_trial",
+        {"rate": tune.uniform(0.01, 0.5), "epochs": 8},
+        metric="loss",
+        mode="min",
+        num_samples=4,
+        workers=worker_pool,
+        scheduler=PopulationBasedTraining(
+            perturbation_interval=2,
+            hyperparam_mutations={"rate": tune.uniform(0.01, 0.5)},
+            quantile_fraction=0.5,
+            seed=11,
+        ),
+        storage_path=str(tmp_path),
+        name="dist_pbt",
+        seed=9,
+        verbose=0,
+    )
+    assert analysis.num_terminated() == 4
+    # Every trial must reach the final epoch despite stop/respawn cycles.
+    assert all(t.results[-1]["epoch"] == 8 for t in analysis.trials)
+    # At least one trial must have been respawned (PBT acted): a respawn
+    # restores a donor epoch, so its reported epoch sequence is not the
+    # plain 1..8 staircase.
+    def respawned(t):
+        epochs = [r["epoch"] for r in t.results]
+        return epochs != list(range(1, 9))
+
+    assert any(respawned(t) for t in analysis.trials), "PBT never requeued"
+
+
 def test_worker_death_requeues_trials(tmp_path):
     procs, addrs = start_local_workers(2, slots=2, env=_worker_env())
     result = {}
