@@ -1,61 +1,180 @@
 """Benchmark: HPO trial throughput of the TPU-native framework.
 
 Workload (mirrors BASELINE.json's quality/throughput framing): a fixed-shape
-transformer regression trial (glucose-like windowed series, 5 epochs, batch 32)
-run as an HPO sweep over lr/weight-decay. Fixed architecture keeps every trial
-on one XLA executable, so the sweep amortizes a single compile — the
+transformer regression trial (glucose-like windowed series, batch 32) run as
+an HPO sweep over lr/weight-decay.  Fixed architecture keeps every trial on
+one XLA executable, so the sweep amortizes a single compile — the
 compile-cache story that makes HPO viable on TPU (SURVEY.md §7 hard parts).
 
 Baseline: the same trial implemented in torch (the reference's stack is
-torch + Ray on CUDA; this image has torch-CPU), run sequentially the way the
-reference runs one trial per device. ``vs_baseline`` = our trials/hour divided
-by torch's extrapolated trials/hour on this host.
+torch + Ray on CUDA; this image has torch-CPU), timed per-step and
+extrapolated to a full trial.  ``vs_baseline`` = our trials/hour divided by
+torch's trials/hour on this host.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Robustness contract (VERDICT.md round 1, next-round #1b): this script ALWAYS
+prints exactly ONE JSON line with {"metric", "value", "unit", "vs_baseline",
+"backend", ...}.  TPU-backend init failure or hang must not abort it: the
+TPU is probed in a subprocess with a bounded timeout and the benchmark falls
+back to a scaled-down CPU workload when the probe or the TPU run fails.
+
+Process architecture (see memory: the image injects an ``.axon_site``
+sitecustomize that claims the single TPU-tunnel session in EVERY interpreter
+at startup; two concurrent claimants deadlock):
+
+  parent (re-execed with .axon_site stripped; never touches jax)
+    ├── probe child   [tunnel env]     import jax; jax.devices()  (timeout)
+    ├── "ours" child  [tunnel env OR sanitized cpu env]  run_vectorized sweep
+    └── torch child   [sanitized cpu env]                per-step baseline
+
+Only one tunnel-env child runs at a time, and children are terminated with
+SIGTERM (never SIGKILL) so a wedged child cannot take the relay down with it.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
+import subprocess
 import sys
 import time
 
-NUM_TRIALS = 32
-NUM_EPOCHS = 10
+_REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+# Full (TPU) workload.
+FULL = dict(num_trials=32, num_epochs=10, data_steps=100_000)
+# Scaled CPU-fallback workload (1-core host; keep it minute-scale).
+SMALL = dict(num_trials=8, num_epochs=3, data_steps=30_000)
+
 BATCH = 32
 D_MODEL = 64
 LAYERS = 2
 HEADS = 4
-TORCH_TRIALS_MEASURED = 2
+FEATURES = 16
+SEQ = 96  # glucose windows are interval=96
+DFF = D_MODEL * 2
+TORCH_STEPS_MEASURED = 30
+
+# Peak MXU throughput used for the MFU denominator, by platform.
+PEAK_FLOPS = {
+    "tpu": 9.85e13,   # v5e, fp32-precision matmuls on the MXU (~bf16 peak / 2)
+    "cpu": None,      # MFU is not meaningful on the host CPU
+}
 
 
-def _data():
+# ---------------------------------------------------------------------------
+# Environment plumbing
+
+
+def _tunnel_pythonpath() -> str:
+    """The original PYTHONPATH (with .axon_site) stashed across the re-exec."""
+    return os.environ.get("DML_TUNNEL_PYTHONPATH", "")
+
+
+def _cpu_env(n_devices: int = 1) -> dict:
+    from __graft_entry__ import _sanitized_cpu_env
+
+    return _sanitized_cpu_env(n_devices)
+
+
+def _tpu_env() -> dict:
+    env = dict(os.environ)
+    pp = _tunnel_pythonpath()
+    if pp:
+        env["PYTHONPATH"] = pp + os.pathsep + _REPO_ROOT
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dml_tpu_jax_cache")
+    return env
+
+
+def _run_child(args, env, timeout_s: float):
+    """Run a child; returns (rc, out, err, exited).
+
+    On timeout, terminate with SIGTERM then SIGINT — never SIGKILL: a
+    SIGKILLed tunnel-holder can take the relay down for the whole session.
+    ``exited=False`` means the child survived both signals and is STILL
+    RUNNING (still holding the tunnel if it claimed it); the caller must not
+    start another tunnel-env child while that is the case — two concurrent
+    claimants deadlock."""
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)] + args,
+        env=env, cwd=_REPO_ROOT,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+        return proc.returncode, out, err, True
+    except subprocess.TimeoutExpired:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            out, err = proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.send_signal(signal.SIGINT)
+            try:
+                out, err = proc.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                return 124, "", "child survived SIGTERM+SIGINT; left running", False
+        return 124, out, err, True
+
+
+def _parse_result(out: str):
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Analytic FLOPs (for MFU)
+
+
+def transformer_fwd_flops(batch: int, seq: int) -> float:
+    """Analytic forward FLOPs of the bench transformer (matmuls only)."""
+    d, dff, layers, feats = D_MODEL, DFF, LAYERS, FEATURES
+    f = 2.0 * batch * seq * feats * d                 # input projection
+    per_layer = (
+        4 * 2.0 * batch * seq * d * d                 # Q,K,V,O projections
+        + 2 * 2.0 * batch * seq * seq * d             # scores + apply
+        + 2 * 2.0 * batch * seq * d * dff             # FF in + out
+    )
+    f += layers * per_layer
+    f += 2.0 * batch * (d * 128 + 128 * 64 + 64 * 32 + 32 * 16 + 16)  # head
+    return f
+
+
+def sweep_total_flops(num_trials: int, num_epochs: int, steps_per_epoch: int,
+                      n_val: int) -> float:
+    """Train (fwd+bwd ~= 3x fwd) + one eval pass per epoch, per trial."""
+    train = 3.0 * transformer_fwd_flops(BATCH, SEQ) * steps_per_epoch
+    evalp = transformer_fwd_flops(max(n_val, 1), SEQ)
+    return num_trials * num_epochs * (train + evalp)
+
+
+# ---------------------------------------------------------------------------
+# Child: our framework (runs under either env; jax imported lazily)
+
+
+def child_ours(scale: dict) -> None:
+    from distributed_machine_learning_tpu import tune
     from distributed_machine_learning_tpu.data import glucose_like_data
 
-    return glucose_like_data(num_steps=100_000, num_features=16)
-
-
-def run_ours(train, val) -> float:
-    """Returns trials/hour for the full sweep (includes compile time).
-
-    Uses the vectorized runner: all NUM_TRIALS same-architecture trials train
-    as ONE vmapped XLA program on one chip (tune/vectorized.py), so the sweep
-    pays one compile and keeps the MXU fed — the TPU-native replacement for
-    the reference's one-trial-per-GPU layout."""
-    from distributed_machine_learning_tpu import tune
-
+    train, val = glucose_like_data(
+        num_steps=scale["data_steps"], num_features=FEATURES
+    )
     space = {
         "model": "transformer",
         "d_model": D_MODEL,
         "num_heads": HEADS,
         "num_layers": LAYERS,
-        "dim_feedforward": D_MODEL * 2,
+        "dim_feedforward": DFF,
         "dropout": 0.1,
         "learning_rate": tune.loguniform(1e-4, 1e-2),
         "weight_decay": tune.loguniform(1e-6, 1e-3),
         "seed": tune.randint(0, 1_000_000),
-        "num_epochs": NUM_EPOCHS,
+        "num_epochs": scale["num_epochs"],
         "batch_size": BATCH,
         "max_seq_length": 128,
         "loss_function": "mse",
@@ -67,35 +186,53 @@ def run_ours(train, val) -> float:
         val_data=val,
         metric="validation_mape",
         mode="min",
-        num_samples=NUM_TRIALS,
-        max_batch_trials=NUM_TRIALS,
+        num_samples=scale["num_trials"],
+        max_batch_trials=scale["num_trials"],
         storage_path="/tmp/bench_results",
         name=f"bench_{int(t0)}",
         verbose=0,
     )
     wall = time.time() - t0
     done = analysis.num_terminated()
-    if done != NUM_TRIALS:
-        print(f"WARNING: only {done}/{NUM_TRIALS} trials finished",
-              file=sys.stderr)
-    return done * 3600.0 / wall
+    steps_per_epoch = len(train.x) // BATCH
+    flops = sweep_total_flops(
+        done, scale["num_epochs"], steps_per_epoch, len(val.x)
+    )
+    import jax
+
+    platform = jax.devices()[0].platform
+    print(json.dumps({
+        "trials_per_hour": done * 3600.0 / wall,
+        "wall_s": wall,
+        "done": done,
+        "flops": flops,
+        "platform": platform,
+        "best_mape": float(analysis.best_result.get("validation_mape", -1)),
+    }))
 
 
-def run_torch_baseline(train, val) -> float:
-    """Sequential torch-CPU trials of the same shape; extrapolated trials/hour."""
-    import numpy as np
+# ---------------------------------------------------------------------------
+# Child: torch baseline (per-step timing, extrapolated to a full trial)
+
+
+def child_torch(scale: dict) -> None:
+    import numpy as np  # noqa: F401
     import torch
     import torch.nn as nn
 
+    from distributed_machine_learning_tpu.data import glucose_like_data
+
     torch.manual_seed(0)
-    device = "cpu"
+    train, val = glucose_like_data(
+        num_steps=scale["data_steps"], num_features=FEATURES
+    )
 
     class Baseline(nn.Module):
         def __init__(self, in_features):
             super().__init__()
             self.proj = nn.Linear(in_features, D_MODEL)
             enc = nn.TransformerEncoderLayer(
-                d_model=D_MODEL, nhead=HEADS, dim_feedforward=D_MODEL * 2,
+                d_model=D_MODEL, nhead=HEADS, dim_feedforward=DFF,
                 dropout=0.1, batch_first=True)
             self.encoder = nn.TransformerEncoder(enc, num_layers=LAYERS)
             self.head = nn.Linear(D_MODEL, 1)
@@ -106,44 +243,181 @@ def run_torch_baseline(train, val) -> float:
 
     x = torch.from_numpy(train.x)
     y = torch.from_numpy(train.y)
+    xv = torch.from_numpy(val.x)
     n = len(x)
-    times = []
-    for trial in range(TORCH_TRIALS_MEASURED):
-        t0 = time.time()
-        model = Baseline(train.x.shape[-1]).to(device)
-        opt = torch.optim.Adam(model.parameters(), lr=1e-3)
-        loss_fn = nn.MSELoss()
-        for epoch in range(NUM_EPOCHS):
-            perm = torch.randperm(n)
-            for i in range(0, n - BATCH + 1, BATCH):
-                sel = perm[i : i + BATCH]
-                opt.zero_grad()
-                out = model(x[sel])
-                loss = loss_fn(out, y[sel])
-                loss.backward()
-                opt.step()
-        with torch.no_grad():
-            model.eval()
-            _ = model(torch.from_numpy(val.x))
-        times.append(time.time() - t0)
-    per_trial = sum(times) / len(times)
-    return 3600.0 / per_trial
+    steps_per_epoch = n // BATCH
 
+    model = Baseline(train.x.shape[-1])
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    loss_fn = nn.MSELoss()
+    perm = torch.randperm(n)
 
-def main():
-    os.environ.setdefault(
-        "JAX_COMPILATION_CACHE_DIR", "/tmp/dml_tpu_jax_cache"
+    def one_step(i):
+        sel = perm[(i * BATCH) % (n - BATCH): (i * BATCH) % (n - BATCH) + BATCH]
+        opt.zero_grad()
+        loss = loss_fn(model(x[sel]), y[sel])
+        loss.backward()
+        opt.step()
+
+    for i in range(3):  # warmup
+        one_step(i)
+    t0 = time.time()
+    for i in range(TORCH_STEPS_MEASURED):
+        one_step(i + 3)
+    step_s = (time.time() - t0) / TORCH_STEPS_MEASURED
+    t0 = time.time()
+    with torch.no_grad():
+        model.eval()
+        _ = model(xv)
+    eval_s = time.time() - t0
+
+    per_trial_s = (
+        scale["num_epochs"] * (steps_per_epoch * step_s + eval_s)
     )
-    train, val = _data()
-    ours = run_ours(train, val)
-    baseline = run_torch_baseline(train, val)
     print(json.dumps({
-        "metric": "hpo_trials_per_hour_transformer_glucose",
-        "value": round(ours, 2),
-        "unit": "trials/hour",
-        "vs_baseline": round(ours / baseline, 2),
+        "trials_per_hour": 3600.0 / per_trial_s,
+        "per_trial_s": per_trial_s,
+        "step_s": step_s,
+        "steps_measured": TORCH_STEPS_MEASURED,
+        "extrapolated": True,
     }))
 
 
+# ---------------------------------------------------------------------------
+# Child: TPU probe
+
+
+def child_probe() -> None:
+    import jax
+
+    devs = jax.devices()
+    assert devs and devs[0].platform != "cpu", f"no accelerator: {devs}"
+    # One tiny computation proves the backend actually executes, not just inits.
+    import jax.numpy as jnp
+
+    out = float(jnp.ones((8, 8)).sum())
+    assert out == 64.0, out
+    print(f"probe OK: {len(devs)} x {devs[0].platform}")
+
+
+# ---------------------------------------------------------------------------
+# Parent orchestration
+
+
+def emit(value: float, vs_baseline, backend: str, extra: dict) -> None:
+    line = {
+        "metric": "hpo_trials_per_hour_transformer_glucose",
+        "value": round(value, 2) if value is not None else None,
+        "unit": "trials/hour",
+        "vs_baseline": (round(vs_baseline, 2)
+                        if vs_baseline is not None else None),
+        "backend": backend,
+        **extra,
+    }
+    print(json.dumps(line), flush=True)
+
+
+def main() -> None:
+    t_start = time.time()
+    log = lambda m: print(f"[bench] {m}", file=sys.stderr, flush=True)
+
+    backend = "cpu"
+    tunnel_ok = True  # may use the tunnel env (no zombie claimant yet)
+    probe_ok = False
+    if _tunnel_pythonpath():
+        for attempt in (1, 2):
+            log(f"probing TPU backend (attempt {attempt}, timeout 180s)")
+            rc, out, err, exited = _run_child(
+                ["--child", "probe"], _tpu_env(), 180
+            )
+            log(f"probe rc={rc}: {out.strip() or err.strip()[-200:]}")
+            if rc == 0:
+                probe_ok = True
+                break
+            if not exited:
+                # A wedged probe still holds the tunnel; a second tunnel-env
+                # child would deadlock against it. Give up on the TPU.
+                log("probe child still running; abandoning the TPU path")
+                tunnel_ok = False
+                break
+        backend = "tpu" if probe_ok else "cpu"
+    else:
+        log("no tunnel PYTHONPATH recorded; running on CPU")
+
+    ours = None
+    if backend == "tpu" and tunnel_ok:
+        log(f"running sweep on TPU: {FULL}")
+        rc, out, err, exited = _run_child(
+            ["--child", "ours", "full"], _tpu_env(), 900
+        )
+        ours = _parse_result(out) if rc == 0 else None
+        if ours is None:
+            log(f"TPU sweep failed rc={rc}; tail: {err[-500:]}")
+            backend = "cpu"
+    if ours is None:
+        # CPU children never claim the tunnel, so this is safe even if a
+        # wedged tunnel child is still lingering.
+        log(f"running sweep on CPU fallback: {SMALL}")
+        rc, out, err, _ = _run_child(
+            ["--child", "ours", "small"], _cpu_env(), 900
+        )
+        ours = _parse_result(out) if rc == 0 else None
+        if ours is None:
+            log(f"CPU sweep failed rc={rc}; tail: {err[-500:]}")
+
+    scale_name = "full" if backend == "tpu" else "small"
+    log("running torch baseline (per-step, extrapolated)")
+    rc, out, err, _ = _run_child(
+        ["--child", "torch", scale_name], _cpu_env(), 600
+    )
+    torch_res = _parse_result(out) if rc == 0 else None
+    if torch_res is None:
+        log(f"torch baseline failed rc={rc}; tail: {err[-500:]}")
+
+    if ours is None:
+        emit(None, None, backend, {
+            "error": "benchmark children failed; see stderr",
+            "total_s": round(time.time() - t_start, 1),
+        })
+        return
+
+    peak = PEAK_FLOPS.get(backend)
+    mfu = (ours["flops"] / ours["wall_s"] / peak) if peak else None
+    vs = (ours["trials_per_hour"] / torch_res["trials_per_hour"]
+          if torch_res else None)
+    extra = {
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "peak_flops_assumed": peak,
+        "workload": dict(FULL if scale_name == "full" else SMALL,
+                         batch=BATCH, d_model=D_MODEL, layers=LAYERS,
+                         seq=SEQ),
+        "baseline": ("torch-cpu-1core-extrapolated" if torch_res else None),
+        "best_validation_mape": ours.get("best_mape"),
+        "total_s": round(time.time() - t_start, 1),
+    }
+    emit(ours["trials_per_hour"], vs, backend, extra)
+
+
 if __name__ == "__main__":
-    main()
+    argv = sys.argv[1:]
+    if argv and argv[0] == "--child":
+        kind = argv[1]
+        if kind == "probe":
+            child_probe()
+        elif kind == "ours":
+            child_ours(FULL if argv[2] == "full" else SMALL)
+        elif kind == "torch":
+            child_torch(FULL if argv[2] == "full" else SMALL)
+        else:
+            raise SystemExit(f"unknown child kind {kind!r}")
+    else:
+        # Re-exec free of the .axon_site sitecustomize so the parent never
+        # holds the TPU tunnel (children claim it one at a time instead).
+        pp = os.environ.get("PYTHONPATH", "")
+        if ".axon_site" in pp:
+            env = dict(os.environ)
+            env["DML_TUNNEL_PYTHONPATH"] = pp
+            env["PYTHONPATH"] = _REPO_ROOT
+            os.execve(sys.executable,
+                      [sys.executable, os.path.abspath(__file__)] + argv, env)
+        main()

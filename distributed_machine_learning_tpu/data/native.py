@@ -121,15 +121,38 @@ def window(array: np.ndarray, interval: int, stride: int) -> np.ndarray:
     return out
 
 
+_SM64_MIX = np.uint64(0xD1B54A32D192ED03)
+_SM64_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+_SM64_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_SM64_M2 = np.uint64(0x94D049BB133111EB)
+
+
+def _splitmix64_draws(seed: int, count: int) -> np.ndarray:
+    """The first ``count`` splitmix64 outputs for ``seed`` (vectorized;
+    bit-identical to ``splitmix64`` in native/window_ops.cpp)."""
+    state = np.uint64(seed & (2**64 - 1)) ^ _SM64_MIX
+    with np.errstate(over="ignore"):
+        z = state + np.arange(1, count + 1, dtype=np.uint64) * _SM64_GOLDEN
+        z = (z ^ (z >> np.uint64(30))) * _SM64_M1
+        z = (z ^ (z >> np.uint64(27))) * _SM64_M2
+        return z ^ (z >> np.uint64(31))
+
+
 def shuffled_indices(n: int, seed: int) -> np.ndarray:
     """Deterministic permutation of [0, n) (the epoch shuffle in
-    Dataset.batches). Native and fallback paths use different (equally
-    deterministic) generators, so the *order* is toolchain-dependent but
-    reproducibility per build is not."""
+    Dataset.batches). The numpy fallback implements the same splitmix64
+    Fisher-Yates as the native path, so a given seed produces the same batch
+    order whether or not the C++ toolchain built — training runs stay
+    reproducible across hosts with and without g++."""
     lib = _get_lib()
-    if lib is None:
-        return np.random.default_rng(seed).permutation(n).astype(np.int64)
     out = np.empty(n, dtype=np.int64)
+    if lib is None:
+        out[:] = np.arange(n)
+        draws = _splitmix64_draws(seed, max(n - 1, 0))
+        for k, i in enumerate(range(n - 1, 0, -1)):
+            j = int(draws[k] % np.uint64(i + 1))
+            out[i], out[j] = out[j], out[i]
+        return out
     lib.dml_shuffled_indices(n, np.uint64(seed & (2**64 - 1)), out)
     return out
 

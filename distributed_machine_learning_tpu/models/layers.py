@@ -154,6 +154,18 @@ class MultiHeadAttention(nn.Module):
                     "seq_axis set but no mesh given: ring attention needs the "
                     "device mesh to shard the sequence over"
                 )
+            if self.attention_type not in (
+                "scaled_dot_product", "multi_head_attention", "flash",
+                "blockwise",
+            ):
+                # Ring attention computes exact softmax attention; silently
+                # substituting it for a different kernel (e.g. linear
+                # attention) would change the math the config asked for.
+                raise ValueError(
+                    f"attention_type={self.attention_type!r} cannot run "
+                    f"sequence-parallel: ring attention implements softmax "
+                    f"attention only. Drop seq_axis or use a softmax variant."
+                )
             from distributed_machine_learning_tpu.parallel.ring_attention import (
                 ring_attention,
             )

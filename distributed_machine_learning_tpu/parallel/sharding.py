@@ -83,3 +83,36 @@ def shard_params(params: Any, mesh: Mesh, rules=TRANSFORMER_TP_RULES):
     """device_put the param pytree according to the rules."""
     shardings = param_shardings(params, mesh, rules)
     return jax.device_put(params, shardings)
+
+
+def opt_state_shardings(opt_shape: Any, p_shardings: Any, mesh: Mesh):
+    """Shardings for an optimizer-state pytree: param-mirroring subtrees
+    (adam mu/nu, momentum traces, ...) inherit the param's sharding; scalars
+    and counts are replicated on the mesh.
+
+    Needed because ``jit(tx.init)`` without ``out_shardings`` is free to
+    place outputs on a single device, which silently drops the TP layout of
+    the moments AND produces mixed committed placements that later jits
+    reject.  Matching is by key-path suffix: a leaf at
+    ``(..., 'mu', 'layer_0', 'kernel')`` matches the param at
+    ``('layer_0', 'kernel')``.
+    """
+    flat_params = {
+        tuple(repr(k) for k in path): sh
+        for path, sh in jax.tree_util.tree_flatten_with_path(p_shardings)[0]
+    }
+    replicated = NamedSharding(mesh, P())
+
+    def assign(path, leaf):
+        spath = tuple(repr(k) for k in path)
+        for i in range(len(spath)):
+            match = flat_params.get(spath[i:])
+            if match is not None:
+                # Guard: the matched spec must fit the leaf's rank (a spec
+                # may be shorter than the rank, never longer).
+                if len(match.spec) <= getattr(leaf, "ndim", 0):
+                    return match
+                return replicated
+        return replicated
+
+    return jax.tree_util.tree_map_with_path(assign, opt_shape)

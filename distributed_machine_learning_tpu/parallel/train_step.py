@@ -19,6 +19,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_machine_learning_tpu.parallel.sharding import (
     TRANSFORMER_TP_RULES,
+    opt_state_shardings,
     param_shardings,
     shard_params,
 )
@@ -53,12 +54,16 @@ def make_sharded_train_step(
         params = shard_params(variables["params"], mesh, rules)
         p_shardings = param_shardings(params, mesh, rules)
 
-        def _init_opt(p):
-            return tx.init(p)
-
-        # jit the optimizer init with param shardings so optimizer moments
-        # inherit the TP layout instead of materializing replicated.
-        opt_state = jax.jit(_init_opt, in_shardings=(p_shardings,))(params)
+        # jit the optimizer init with explicit out shardings so the moments
+        # inherit the TP layout (without out_shardings, XLA may place the
+        # whole state on one device, dropping the layout AND producing mixed
+        # committed placements that later jits reject).
+        o_shardings = opt_state_shardings(
+            jax.eval_shape(tx.init, params), p_shardings, mesh
+        )
+        opt_state = jax.jit(
+            tx.init, in_shardings=(p_shardings,), out_shardings=o_shardings
+        )(params)
         return params, opt_state
 
     def _step(params, opt_state, x, y, rng):

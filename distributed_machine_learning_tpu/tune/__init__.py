@@ -60,6 +60,9 @@ from distributed_machine_learning_tpu.tune.session import (
     with_parameters,
 )
 from distributed_machine_learning_tpu.tune.trainable import train_regressor
+from distributed_machine_learning_tpu.tune.trainable_sharded import (
+    train_sharded_regressor,
+)
 from distributed_machine_learning_tpu.tune.vectorized import run_vectorized
 from distributed_machine_learning_tpu.tune.trial import Resources, Trial, TrialStatus
 
@@ -72,6 +75,7 @@ __all__ = [
     "get_trial_id",
     "with_parameters",
     "train_regressor",
+    "train_sharded_regressor",
     "choice",
     "uniform",
     "loguniform",

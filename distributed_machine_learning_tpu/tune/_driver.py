@@ -43,6 +43,7 @@ class TrialLifecycle:
         max_failures: int = 0,
         stop_rules: Optional[Dict[str, float]] = None,
         time_budget_s: Optional[float] = None,
+        keep_checkpoints_num: int = 0,
         log: Callable[[str], None] = lambda msg: None,
     ):
         self.searcher = searcher
@@ -54,6 +55,7 @@ class TrialLifecycle:
         self.max_failures = max_failures
         self.stop_rules = stop_rules or {}
         self.time_budget_s = time_budget_s
+        self.keep_checkpoints_num = keep_checkpoints_num
         self.log = log
 
         self.trials: List[Trial] = []
@@ -107,7 +109,8 @@ class TrialLifecycle:
         "stop" or "continue" (REQUEUE is folded into stop + a flag consumed
         by :meth:`complete_trial`)."""
         metrics = dict(metrics)
-        metrics.setdefault("training_iteration", trial.training_iteration + 1)
+        trial.reports_since_restart += 1
+        metrics.setdefault("training_iteration", trial.training_iteration)
         metrics["trial_id"] = trial.trial_id
         metrics["timestamp"] = time.time()
         metrics["time_total_s"] = trial.runtime_s()
@@ -115,6 +118,7 @@ class TrialLifecycle:
             metrics.update(extra)
         trial.results.append(metrics)
         self.store.append_result(trial, metrics)
+        self._prune_checkpoints(trial)
 
         # Snapshot before the scheduler runs: PBT mutates trial.config in
         # place on REQUEUE, and the searcher must see the config that
@@ -136,6 +140,26 @@ class TrialLifecycle:
             decision = STOP
         return "stop" if decision == STOP else "continue"
 
+    def _prune_checkpoints(self, trial: Trial):
+        """Retention: keep the last k checkpoints of ``trial``, never deleting
+        one that any trial's pending restore (PBT exploit / retry) points at.
+
+        Runs on the single lifecycle thread, so the protect set is consistent
+        with every REQUEUE decision made so far."""
+        if self.keep_checkpoints_num <= 0 or not trial.latest_checkpoint:
+            return
+        from distributed_machine_learning_tpu.tune import checkpoint as ckpt_lib
+
+        protected = {t.restore_path for t in self.trials if t.restore_path}
+        protected.add(trial.latest_checkpoint)
+        directory = self.store.checkpoint_dir(trial)
+        try:
+            ckpt_lib.prune_checkpoints(
+                directory, self.keep_checkpoints_num, protect=protected
+            )
+        except Exception as e:  # retention must never kill a run
+            self.log(f"checkpoint pruning failed for {trial.trial_id}: {e}")
+
     # -- terminal events ---------------------------------------------------
 
     def complete_trial(self, trial: Trial) -> bool:
@@ -156,10 +180,25 @@ class TrialLifecycle:
         pbt_requeue = getattr(trial, "_requeue_on_complete", False)
         trial._requeue_on_complete = False
         if trial.num_failures <= self.max_failures:
-            # Keep a scheduler-chosen restore target (PBT exploit points
-            # restore_path at a DONOR's checkpoint) over our own.
-            if trial.latest_checkpoint and not (pbt_requeue and trial.restore_path):
+            if pbt_requeue and trial.restore_path:
+                # A scheduler-chosen restore target (PBT exploit pointing at a
+                # DONOR's checkpoint) is being applied right now — keep it;
+                # the scheduler already set restore_base.
+                pass
+            elif (
+                trial.latest_checkpoint
+                and trial.latest_checkpoint_iteration >= trial.restore_base
+            ):
+                # Most-advanced restore point available: the trial's own
+                # newest checkpoint — unless the current incarnation was
+                # seeded by a donor exploit it hasn't checkpointed past yet
+                # (own checkpoint older than restore_base), in which case
+                # overwriting would silently undo the exploit's weights.
                 trial.restore_path = trial.latest_checkpoint
+                trial.restore_base = trial.latest_checkpoint_iteration
+            elif not trial.restore_path:
+                trial.restore_base = 0
+            # else: keep the seed restore target (donor / previous retry).
             self.log(
                 f"{trial.trial_id} failed "
                 f"({trial.num_failures}/{self.max_failures}): {why.splitlines()[-1] if why else why}; retrying"
@@ -183,6 +222,7 @@ class TrialLifecycle:
 
     def requeue(self, trial: Trial):
         trial.status = TrialStatus.PENDING
+        trial.reports_since_restart = 0
         self.pending.append(trial)
 
     def mark_running(self, trial: Trial):

@@ -1,21 +1,26 @@
-"""Pytree checkpoint save/restore.
+"""Pytree checkpoint save/restore over pluggable storage.
 
 The reference has no checkpointing at all (SURVEY.md §5: no torch.save/load,
 no ``tune.checkpoint_dir`` anywhere); PBT and preemption-aware recovery make it
 first-class here.  Format: flax msgpack for the array pytree (framework- and
-process-portable, no pickle), written atomically so a preempted write never
-leaves a truncated checkpoint behind.
+process-portable, no pickle).  Paths route through ``tune.storage`` so the
+same code writes local files (atomically — a preempted write never leaves a
+truncated checkpoint), ``gs://`` objects on a real pod, or the in-memory test
+fake, selected purely by the path's scheme.
 """
 
 from __future__ import annotations
 
-import os
-import tempfile
+import re
 from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 from flax import serialization
+
+from distributed_machine_learning_tpu.tune.storage import get_storage
+
+_CKPT_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
 
 
 def _to_host(tree):
@@ -26,28 +31,61 @@ def _to_host(tree):
 
 
 def save_checkpoint(path: str, tree: Dict[str, Any]) -> str:
-    """Serialize a pytree dict to ``path`` atomically. Returns the path."""
+    """Serialize a pytree dict to ``path`` (any storage scheme). Returns path."""
     payload = serialization.to_bytes(_to_host(tree))
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), suffix=".tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            f.write(payload)
-        os.replace(tmp, path)  # atomic on POSIX
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+    backend, p = get_storage(path)
+    backend.write_bytes(p, payload)
     return path
 
 
 def load_checkpoint(path: str) -> Optional[Dict[str, Any]]:
     """Decode a checkpoint without needing a target template (msgpack restore)."""
-    if not path or not os.path.exists(path):
+    if not path:
         return None
-    with open(path, "rb") as f:
-        return serialization.msgpack_restore(f.read())
+    backend, p = get_storage(path)
+    data = backend.read_bytes(p)
+    if data is None:
+        return None
+    return serialization.msgpack_restore(data)
 
 
 def restore_into(template, tree: Dict[str, Any]):
     """Restore a raw decoded dict into ``template``'s pytree structure/dtypes."""
     return serialization.from_state_dict(template, tree)
+
+
+def checkpoint_path(directory: str, iteration: int) -> str:
+    backend, d = get_storage(directory)
+    return backend.join(d, f"ckpt_{iteration:06d}.msgpack")
+
+
+def prune_checkpoints(directory: str, keep: int, protect=None) -> int:
+    """Keep only the ``keep`` newest ``ckpt_*.msgpack`` files in ``directory``.
+
+    ``protect`` (a full path, or an iterable of them) is never deleted even if
+    old — e.g. a checkpoint another trial's PBT exploit is about to restore.
+    Returns the number of files deleted.
+    """
+    if keep <= 0:
+        return 0
+    if protect is None:
+        protected = set()
+    elif isinstance(protect, str):
+        protected = {protect}
+    else:
+        protected = set(protect)
+    backend, d = get_storage(directory)
+    found = []
+    for name in backend.listdir(d):
+        m = _CKPT_RE.match(name)
+        if m:
+            found.append((int(m.group(1)), name))
+    found.sort()
+    deleted = 0
+    for _, name in found[:-keep] if len(found) > keep else []:
+        full = backend.join(d, name)
+        if full in protected:
+            continue
+        backend.delete(full)
+        deleted += 1
+    return deleted

@@ -26,11 +26,25 @@ reference — the worker imports the module host-side. This mirrors how real
 pods run (same container image everywhere) and keeps arbitrary bytes off the
 control plane.
 
-Wire format: 8-byte big-endian length + pickle. Single driver per worker.
+Wire format: 8-byte big-endian length + [32-byte HMAC-SHA256 when a shared
+secret is configured] + pickle. Single driver per worker.
+
+Security model: the control plane carries pickled frames, so anyone who can
+complete a frame exchange can execute code on the worker. Defenses, in order:
+(1) the supervisor binds loopback by default — exposing it on a routable
+interface is an explicit operator choice; (2) setting ``DML_CLUSTER_SECRET``
+(env var, same value on driver and workers — how real pods share it: baked
+into the job spec) MACs every frame, and frames failing verification are
+dropped *before* unpickling, closing the connection; (3) the expected
+deployment is a private pod network (DCN between TPU hosts), which is the
+trusted-network assumption this plane inherits from the reference's Ray
+cluster (`ray-tune-hpo-regression.py` never configures Ray auth either).
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac as hmac_lib
 import importlib
 import os
 import pickle
@@ -66,6 +80,12 @@ from distributed_machine_learning_tpu.tune.session import (
 from distributed_machine_learning_tpu.tune.trial import Trial, TrialStatus
 
 _LEN = struct.Struct(">Q")
+_MAC_SIZE = 32  # HMAC-SHA256
+
+
+def _cluster_secret() -> Optional[bytes]:
+    s = os.environ.get("DML_CLUSTER_SECRET")
+    return s.encode() if s else None
 
 
 # --------------------------------------------------------------------------
@@ -73,13 +93,23 @@ _LEN = struct.Struct(">Q")
 # --------------------------------------------------------------------------
 
 
-def _send(sock: socket.socket, lock: threading.Lock, msg: Dict[str, Any]):
+def _send(
+    sock: socket.socket,
+    lock: threading.Lock,
+    msg: Dict[str, Any],
+    secret: Optional[bytes] = None,
+):
     payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    if secret:
+        mac = hmac_lib.new(secret, payload, hashlib.sha256).digest()
+        payload = mac + payload
     with lock:
         sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
-def _recv(sock: socket.socket) -> Optional[Dict[str, Any]]:
+def _recv(
+    sock: socket.socket, secret: Optional[bytes] = None
+) -> Optional[Dict[str, Any]]:
     header = _recv_exact(sock, _LEN.size)
     if header is None:
         return None
@@ -87,6 +117,21 @@ def _recv(sock: socket.socket) -> Optional[Dict[str, Any]]:
     payload = _recv_exact(sock, n)
     if payload is None:
         return None
+    if secret:
+        # Verify BEFORE unpickling — an unauthenticated frame must never
+        # reach pickle.loads (that is the code-execution boundary).
+        if len(payload) < _MAC_SIZE:
+            return None
+        mac, payload = payload[:_MAC_SIZE], payload[_MAC_SIZE:]
+        expect = hmac_lib.new(secret, payload, hashlib.sha256).digest()
+        if not hmac_lib.compare_digest(mac, expect):
+            print("[cluster] dropping frame with bad MAC; closing connection",
+                  flush=True)
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            return None
     return pickle.loads(payload)
 
 
@@ -127,8 +172,9 @@ def resolve_trainable(spec: Union[str, Callable]) -> Callable:
 
 
 class _WorkerState:
-    def __init__(self, sock: socket.socket):
+    def __init__(self, sock: socket.socket, secret: Optional[bytes] = None):
         self.sock = sock
+        self.secret = secret
         self.send_lock = threading.Lock()
         self.decisions: Dict[str, "queue.Queue[str]"] = {}
         self.dec_lock = threading.Lock()
@@ -149,8 +195,10 @@ def _worker_run_trial(state: _WorkerState, msg: Dict[str, Any], devices: List):
         iteration[0] += 1
         ckpt_path = None
         if checkpoint is not None and ckpt_dir:
-            os.makedirs(ckpt_dir, exist_ok=True)
-            ckpt_path = os.path.join(ckpt_dir, f"ckpt_{iteration[0]:06d}.msgpack")
+            # Storage-aware: ckpt_dir may be a local/shared filesystem path
+            # or gs:// — the driver picked it (checkpoint_storage) and it
+            # must be reachable from every worker host; workers just write.
+            ckpt_path = ckpt_lib.checkpoint_path(ckpt_dir, iteration[0])
             ckpt_lib.save_checkpoint(ckpt_path, checkpoint)
         _send(
             state.sock,
@@ -161,6 +209,7 @@ def _worker_run_trial(state: _WorkerState, msg: Dict[str, Any], devices: List):
                 "metrics": metrics,
                 "checkpoint_path": ckpt_path,
             },
+            state.secret,
         )
         return dq.get()
 
@@ -169,6 +218,11 @@ def _worker_run_trial(state: _WorkerState, msg: Dict[str, Any], devices: List):
             return ckpt_lib.load_checkpoint(trial.restore_path)
         return None
 
+    # The terminal frame is sent only AFTER session/decision-map cleanup: the
+    # driver frees this trial's slot the moment it processes the frame, and a
+    # redispatch into a slot whose previous thread is still tearing down
+    # could briefly double-book the device (ADVICE r1).
+    terminal: Dict[str, Any]
     try:
         trainable = resolve_trainable(msg["trainable"])
         set_session(Session(trial, report_fn, checkpoint_loader, devices))
@@ -176,28 +230,27 @@ def _worker_run_trial(state: _WorkerState, msg: Dict[str, Any], devices: List):
 
         with jax.default_device(devices[0]):
             trainable(dict(trial.config))
-        _send(state.sock, state.send_lock, {"type": "complete", "trial_id": trial_id})
+        terminal = {"type": "complete", "trial_id": trial_id}
     except (StopTrial, PauseTrial):
-        _send(state.sock, state.send_lock, {"type": "complete", "trial_id": trial_id})
+        terminal = {"type": "complete", "trial_id": trial_id}
     except BaseException:  # noqa: BLE001 - ship the traceback to the driver
-        _send(
-            state.sock,
-            state.send_lock,
-            {
-                "type": "error",
-                "trial_id": trial_id,
-                "traceback": traceback.format_exc(),
-            },
-        )
+        terminal = {
+            "type": "error",
+            "trial_id": trial_id,
+            "traceback": traceback.format_exc(),
+        }
     finally:
         set_session(None)
         with state.dec_lock:
-            # Guard against the retry race: if the driver already redispatched
-            # this trial_id (our "error" frame triggers an immediate requeue),
-            # the map now holds the NEW incarnation's queue — popping it would
-            # silently drop that incarnation's decisions and wedge it.
+            # The same-incarnation guard stays even though the terminal frame
+            # now follows cleanup: a worker-death requeue on the driver can
+            # still race a slow teardown here.
             if state.decisions.get(trial_id) is dq:
                 del state.decisions[trial_id]
+        try:
+            _send(state.sock, state.send_lock, terminal, state.secret)
+        except OSError:
+            pass  # driver went away; its reader already flagged the death
 
 
 def serve_worker(
@@ -205,6 +258,7 @@ def serve_worker(
     port: int = 0,
     slots: Optional[int] = None,
     ready_file: Optional[str] = None,
+    secret: Optional[bytes] = None,
 ) -> None:
     """Run a host supervisor until the driver sends shutdown (blocking).
 
@@ -215,6 +269,15 @@ def serve_worker(
     # Bind and announce readiness BEFORE importing jax: jax cold-import takes
     # tens of seconds, and the driver's connect queues in the backlog while
     # device enumeration finishes (it blocks on the hello frame, not connect).
+    secret = secret if secret is not None else _cluster_secret()
+    if host not in ("127.0.0.1", "localhost", "::1") and not secret:
+        print(
+            "[cluster] WARNING: supervisor bound to a routable interface "
+            f"({host}) without DML_CLUSTER_SECRET — anyone who can reach the "
+            "port can run code on this host (pickled control frames). Set a "
+            "shared secret or keep the bind on loopback/private networks.",
+            flush=True,
+        )
     server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     server.bind((host, port))
@@ -240,7 +303,7 @@ def serve_worker(
         sock, peer = server.accept()
         dbg(f"accepted driver {peer}")
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        state = _WorkerState(sock)
+        state = _WorkerState(sock, secret)
         _send(
             sock,
             state.send_lock,
@@ -250,10 +313,11 @@ def serve_worker(
                 "host": socket.gethostname(),
                 "num_devices": len(devices),
             },
+            secret,
         )
         shutdown = False
         while True:
-            msg = _recv(sock)
+            msg = _recv(sock, secret)
             if msg is None:
                 dbg("driver EOF")
                 break  # driver went away; await a new one
@@ -296,15 +360,16 @@ def serve_worker(
 class RemoteWorker:
     """Driver-side handle for one host supervisor connection."""
 
-    def __init__(self, address: str):
+    def __init__(self, address: str, secret: Optional[bytes] = None):
         self.address = address
+        self.secret = secret if secret is not None else _cluster_secret()
         host, port = address.rsplit(":", 1)
         self.sock = socket.create_connection((host, int(port)), timeout=30)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.send_lock = threading.Lock()
         # The hello frame waits on the worker's jax cold-import; give it time.
         self.sock.settimeout(300)
-        hello = _recv(self.sock)
+        hello = _recv(self.sock, self.secret)
         self.sock.settimeout(None)
         if not hello or hello.get("type") != "hello":
             raise ConnectionError(f"Bad hello from worker {address}: {hello!r}")
@@ -318,7 +383,7 @@ class RemoteWorker:
         return self.slots - len(self.running) if self.alive else 0
 
     def send(self, msg: Dict[str, Any]):
-        _send(self.sock, self.send_lock, msg)
+        _send(self.sock, self.send_lock, msg, self.secret)
 
     def close(self, shutdown: bool = False):
         try:
@@ -358,6 +423,8 @@ def run_distributed(
     time_budget_s: Optional[float] = None,
     verbose: int = 1,
     shutdown_workers: bool = False,
+    keep_checkpoints_num: int = 0,
+    checkpoint_storage: Optional[str] = None,
 ) -> ExperimentAnalysis:
     """``tune.run`` across multiple host supervisors (see module docstring).
 
@@ -371,6 +438,14 @@ def run_distributed(
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
     if not workers:
         raise ValueError("run_distributed needs at least one worker address")
+    if checkpoint_storage and checkpoint_storage.startswith("mem://"):
+        raise ValueError(
+            "checkpoint_storage='mem://...' is process-local (a test fake): "
+            "worker subprocesses would write checkpoints into their own "
+            "memory and restores on other workers would silently find "
+            "nothing. Use a shared filesystem path or gs:// for distributed "
+            "runs."
+        )
     space = (
         param_space
         if isinstance(param_space, SearchSpace)
@@ -382,7 +457,7 @@ def run_distributed(
     sched.set_experiment(metric, mode)
 
     name = name or f"dist_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:6]}"
-    store = ExperimentStore(storage_path, name)
+    store = ExperimentStore(storage_path, name, checkpoint_storage)
 
     events: "queue.Queue[Tuple]" = queue.Queue()
     pool: List[RemoteWorker] = []
@@ -392,7 +467,7 @@ def run_distributed(
 
         def reader(worker: RemoteWorker):
             while True:
-                msg = _recv(worker.sock)
+                msg = _recv(worker.sock, worker.secret)
                 if msg is None:
                     events.put(("worker_dead", worker))
                     return
@@ -418,6 +493,7 @@ def run_distributed(
         num_samples=num_samples,
         max_failures=max_failures,
         time_budget_s=time_budget_s,
+        keep_checkpoints_num=keep_checkpoints_num,
         log=log,
     )
     trials = lifecycle.trials
@@ -517,6 +593,11 @@ def run_distributed(
             if mtype == "result":
                 if msg.get("checkpoint_path"):
                     trial.latest_checkpoint = msg["checkpoint_path"]
+                    trial.latest_checkpoint_iteration = int(
+                        msg["metrics"].get(
+                            "training_iteration", trial.training_iteration + 1
+                        )
+                    )
                 decision = lifecycle.process_result(
                     trial, msg["metrics"], extra={"hostname": worker.hostname}
                 )
@@ -631,7 +712,11 @@ def _main(argv: Optional[Sequence[str]] = None):
     import argparse
 
     parser = argparse.ArgumentParser(description="dml-tpu host trial supervisor")
-    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument(
+        "--host", default="127.0.0.1",
+        help="bind address; use a routable address only on a trusted network "
+        "and set DML_CLUSTER_SECRET (see module docstring)",
+    )
     parser.add_argument("--port", type=int, default=7711)
     parser.add_argument("--slots", type=int, default=None)
     parser.add_argument("--ready-file", default=None)

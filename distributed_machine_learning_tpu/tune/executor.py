@@ -66,11 +66,65 @@ class DeviceManager:
             )
         if len(self._free) < n:
             return None
-        idxs = [self._free.pop(0) for _ in range(n)]
+        idxs = self._pick_adjacent(n)
+        for i in idxs:
+            self._free.remove(i)
         now = time.time()
         for i in idxs:
             self._leased_at[i] = now
         return [(i, self.devices[i]) for i in idxs]
+
+    def _pick_adjacent(self, n: int) -> List[int]:
+        """Choose n free devices that are ICI-adjacent (SURVEY.md §7 step 9).
+
+        A multi-device trial's collectives ride the ICI links between its
+        cores; a lease of topologically scattered cores pays extra hops for
+        every all-reduce.  Preference order:
+
+        1. the free window of n *consecutive* device indices whose physical
+           ``coords`` (when the platform exposes them) span the smallest
+           bounding box — consecutive indices are ICI-adjacent on TPU
+           (enumeration follows the torus), and the coords check breaks ties
+           across wraparound boundaries;
+        2. failing any full window, the n free indices with the tightest
+           index span (fragmented pool).
+        """
+        free = sorted(self._free)
+        if n == 1:
+            return [free[0]]
+        free_set = set(free)
+        best_window, best_cost = None, None
+        for start in free:
+            window = list(range(start, start + n))
+            if not all(i in free_set for i in window):
+                continue
+            cost = self._coords_span(window)
+            if best_cost is None or cost < best_cost:
+                best_window, best_cost = window, cost
+        if best_window is not None:
+            return best_window
+        # No contiguous window free: take the tightest cluster of n indices.
+        best, best_span = free[:n], free[n - 1] - free[0]
+        for k in range(1, len(free) - n + 1):
+            span = free[k + n - 1] - free[k]
+            if span < best_span:
+                best, best_span = free[k : k + n], span
+        return list(best)
+
+    def _coords_span(self, idxs: List[int]) -> float:
+        """Bounding-box volume of the devices' physical coords (1.0 if the
+        platform exposes no coords — all windows tie, index order wins)."""
+        coords = []
+        for i in idxs:
+            c = getattr(self.devices[i], "coords", None)
+            if c is None:
+                return 1.0
+            coords.append(tuple(c))
+        span = 1.0
+        for dim in range(len(coords[0])):
+            vals = [c[dim] for c in coords]
+            span *= max(vals) - min(vals) + 1
+        return span
 
     def release(self, leased: List):
         now = time.time()
@@ -135,11 +189,12 @@ class ThreadTrialExecutor:
         def report_fn(metrics: Dict, checkpoint) -> str:
             if checkpoint is not None:
                 count = trial.training_iteration + 1
-                path = os.path.join(
-                    self.store.checkpoint_dir(trial), f"ckpt_{count:06d}.msgpack"
+                path = ckpt_lib.checkpoint_path(
+                    self.store.checkpoint_dir(trial), count
                 )
                 ckpt_lib.save_checkpoint(path, checkpoint)
                 trial.latest_checkpoint = path
+                trial.latest_checkpoint_iteration = count
             event = ResultEvent(trial, metrics)
             self.events.put(("result", event))
             event.done.wait()

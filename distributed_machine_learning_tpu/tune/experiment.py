@@ -35,9 +35,21 @@ def _jsonable(value):
 class ExperimentStore:
     """Writes trial configs, per-epoch results, and experiment state to disk."""
 
-    def __init__(self, storage_path: str, name: str):
+    def __init__(
+        self,
+        storage_path: str,
+        name: str,
+        checkpoint_storage: Optional[str] = None,
+    ):
         self.root = os.path.join(os.path.expanduser(storage_path), name)
         os.makedirs(self.root, exist_ok=True)
+        # Checkpoints may live elsewhere than the metrics store — on a pod,
+        # shared storage (gs://bucket/...) so any worker can restore any
+        # trial's state (PBT exploit, preemption recovery); see tune.storage.
+        self.checkpoint_root = (
+            checkpoint_storage.rstrip("/") + "/" + name
+            if checkpoint_storage else None
+        )
         self._result_files = {}
 
     def trial_dir(self, trial: Trial) -> str:
@@ -46,6 +58,11 @@ class ExperimentStore:
         return d
 
     def checkpoint_dir(self, trial: Trial) -> str:
+        if self.checkpoint_root:
+            from distributed_machine_learning_tpu.tune.storage import get_storage
+
+            backend, d = get_storage(self.checkpoint_root)
+            return backend.join(d, trial.trial_id, "checkpoints")
         d = os.path.join(self.trial_dir(trial), "checkpoints")
         os.makedirs(d, exist_ok=True)
         return d

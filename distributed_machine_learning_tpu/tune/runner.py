@@ -62,6 +62,8 @@ def run(
     devices: Optional[List] = None,
     verbose: int = 1,
     callbacks: Optional[List] = None,
+    keep_checkpoints_num: int = 0,
+    checkpoint_storage: Optional[str] = None,
 ) -> ExperimentAnalysis:
     """Run an HPO experiment; see module docstring.
 
@@ -69,6 +71,11 @@ def run(
     reported value reaches the threshold (e.g. ``{"training_iteration": 20}``).
     ``max_failures``: per-trial retry budget; retries restore from the trial's
     latest checkpoint when one exists (preemption tolerance, SURVEY.md §5).
+    ``keep_checkpoints_num``: retention — keep only the newest k checkpoints
+    per trial (0 = keep all); checkpoints referenced by a pending PBT exploit
+    or retry are never pruned.
+    ``checkpoint_storage``: alternate root for checkpoints (``gs://...`` for
+    shared pod storage, ``mem://...`` in tests); metrics stay local.
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
@@ -84,7 +91,7 @@ def run(
     resources = Resources.parse(resources_per_trial)
 
     name = name or f"exp_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:6]}"
-    store = ExperimentStore(storage_path, name)
+    store = ExperimentStore(storage_path, name, checkpoint_storage)
     device_mgr = DeviceManager(devices)
     events: "queue.Queue" = queue.Queue()
     executor = ThreadTrialExecutor(store, events)
@@ -108,6 +115,7 @@ def run(
         max_failures=max_failures,
         stop_rules=stop,
         time_budget_s=time_budget_s,
+        keep_checkpoints_num=keep_checkpoints_num,
         log=log,
     )
     trials = lifecycle.trials

@@ -48,8 +48,8 @@ class PopulationBasedTraining(TrialScheduler):
         self.resample_p = resample_probability
         self.factors = perturbation_factors
         self.seed = seed
-        # trial_id -> (iteration, score) of the latest report (lower=better)
-        self._latest: Dict[str, tuple] = {}
+        # trial_id -> [(iteration, score), ...] in report order (lower=better)
+        self._history: Dict[str, list] = {}
         self._num_perturbations = 0
 
     def set_experiment(self, metric: str, mode: str):
@@ -88,30 +88,54 @@ class PopulationBasedTraining(TrialScheduler):
         if self.metric not in result:
             return CONTINUE
         it = int(result.get("training_iteration", trial.training_iteration))
-        self._latest[trial.trial_id] = (it, self._score(result))
+        self._history.setdefault(trial.trial_id, []).append(
+            (it, self._score(result))
+        )
 
         if it == 0 or it % self.interval != 0:
             return CONTINUE
 
-        population = list(self._latest.items())
-        if len(population) < 4:  # need a meaningful quantile split
+        # Iteration-bucketed ranking: each peer is judged by its most recent
+        # score at-or-before iteration `it`, so a trial at epoch 2 is never
+        # quantile-ranked against a peer's epoch-6 score (which would
+        # systematically judge late starters "bad" and bias exploitation).
+        scores: Dict[str, float] = {}
+        for tid, hist in self._history.items():
+            eligible = [s for i2, s in hist if i2 <= it]
+            if eligible:
+                scores[tid] = eligible[-1]
+        if len(scores) < 4:  # need a meaningful quantile split
             return CONTINUE
-        population.sort(key=lambda kv: kv[1][1])  # ascending score = best first
-        k = max(1, int(len(population) * self.quantile))
-        top_ids = [tid for tid, _ in population[:k]]
-        bottom_ids = {tid for tid, _ in population[-k:]}
+        ranked = sorted(scores.items(), key=lambda kv: kv[1])  # best first
+        k = max(1, int(len(ranked) * self.quantile))
+        top_ids = [tid for tid, _ in ranked[:k]]
+        bottom_ids = {tid for tid, _ in ranked[-k:]}
 
         if trial.trial_id not in bottom_ids or trial.trial_id in top_ids:
             return CONTINUE
 
         rng = rng_from("pbt", self.seed, trial.trial_id, it)
-        donor_id = top_ids[int(rng.integers(len(top_ids)))]
-        donor = self._find_trial(donor_id)
-        if donor is None or not donor.latest_checkpoint:
+        donors = []
+        for tid in top_ids:
+            donor = self._find_trial(tid)
+            if donor is None or not donor.latest_checkpoint:
+                continue
+            # Budget preservation: never exploit a checkpoint AHEAD of the
+            # laggard's own progress — restoring a donor's final-epoch state
+            # would leave the laggard zero epochs of remaining budget (it
+            # would terminate immediately, silently losing its training run).
+            # A terminated donor is fine as long as its checkpoint iteration
+            # is within the laggard's reach.
+            if donor.latest_checkpoint_iteration > it:
+                continue
+            donors.append(donor)
+        if not donors:
             return CONTINUE
+        donor = donors[int(rng.integers(len(donors)))]
 
         # Exploit: resume from the donor's weights; explore: mutate its config.
         trial.restore_path = donor.latest_checkpoint
+        trial.restore_base = donor.latest_checkpoint_iteration
         trial.config = self._mutate(dict(donor.config), rng)
         self._num_perturbations += 1
         return REQUEUE

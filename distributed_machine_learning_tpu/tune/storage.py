@@ -1,0 +1,196 @@
+"""Pluggable byte storage for checkpoints and experiment artifacts.
+
+The reference keeps results on a local ``local_dir`` only
+(`/root/reference/ray-tune-hpo-regression.py:476`); a TPU pod needs shared
+storage — checkpoints written by one host must be restorable on another
+(PBT exploit across workers, preemption recovery), and the BASELINE north
+star names GCS explicitly.  This module dispatches on the path scheme:
+
+* plain paths / ``file://``  -> ``LocalStorage`` (atomic POSIX writes)
+* ``gs://``, ``s3://``, ...  -> ``FsspecStorage`` (via fsspec/gcsfs when
+  installed; a clear error otherwise — the libraries are optional)
+* ``mem://``                 -> ``MemoryStorage`` (process-local fake for
+  tests; no disk, no network)
+
+Every consumer (checkpoint save/load, retention pruning) goes through
+``get_storage`` so a ``storage_path='gs://bucket/exp'`` flows end to end
+without any caller branching on scheme.
+"""
+
+from __future__ import annotations
+
+import os
+import posixpath
+import tempfile
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class StorageBackend:
+    """Minimal byte-level interface checkpoints need."""
+
+    def write_bytes(self, path: str, data: bytes) -> str:
+        raise NotImplementedError
+
+    def read_bytes(self, path: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def listdir(self, path: str) -> List[str]:
+        """Names (not full paths) of entries under ``path``; [] if absent."""
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    def join(self, *parts: str) -> str:
+        return posixpath.join(*parts)
+
+
+class LocalStorage(StorageBackend):
+    """Local filesystem with atomic writes (temp file + rename)."""
+
+    def write_bytes(self, path: str, data: bytes) -> str:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                                   suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)  # atomic on POSIX
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        return path
+
+    def read_bytes(self, path: str) -> Optional[bytes]:
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def listdir(self, path: str) -> List[str]:
+        if not os.path.isdir(path):
+            return []
+        return sorted(os.listdir(path))
+
+    def delete(self, path: str) -> None:
+        if os.path.exists(path):
+            os.unlink(path)
+
+    def join(self, *parts: str) -> str:
+        return os.path.join(*parts)
+
+
+class MemoryStorage(StorageBackend):
+    """Process-local in-memory store keyed by full path (test fake).
+
+    A single shared namespace (class-level) so independently constructed
+    instances — e.g. the saver inside the executor and the loader in a test —
+    see the same data, mirroring how a bucket behaves across components.
+    """
+
+    _store: Dict[str, bytes] = {}
+    _lock = threading.Lock()
+
+    def write_bytes(self, path: str, data: bytes) -> str:
+        with self._lock:
+            self._store[path] = bytes(data)
+        return path
+
+    def read_bytes(self, path: str) -> Optional[bytes]:
+        with self._lock:
+            return self._store.get(path)
+
+    def exists(self, path: str) -> bool:
+        with self._lock:
+            return path in self._store
+
+    def listdir(self, path: str) -> List[str]:
+        prefix = path.rstrip("/") + "/"
+        with self._lock:
+            names = {
+                key[len(prefix):].split("/", 1)[0]
+                for key in self._store if key.startswith(prefix)
+            }
+        return sorted(names)
+
+    def delete(self, path: str) -> None:
+        with self._lock:
+            self._store.pop(path, None)
+
+    @classmethod
+    def clear(cls) -> None:
+        with cls._lock:
+            cls._store.clear()
+
+
+class FsspecStorage(StorageBackend):
+    """Remote object storage (gs://, s3://, ...) through fsspec."""
+
+    def __init__(self, scheme: str):
+        try:
+            import fsspec
+        except ImportError as e:  # pragma: no cover - env-dependent
+            raise ImportError(
+                f"storage scheme {scheme!r} needs the optional 'fsspec' "
+                f"package (plus the filesystem driver, e.g. 'gcsfs' for "
+                f"gs://); install it or use a local storage_path"
+            ) from e
+        self._fs = fsspec.filesystem(scheme)
+        self._scheme = scheme
+
+    def _strip(self, path: str) -> str:
+        return path.split("://", 1)[1] if "://" in path else path
+
+    def write_bytes(self, path: str, data: bytes) -> str:
+        with self._fs.open(self._strip(path), "wb") as f:
+            f.write(data)
+        return path
+
+    def read_bytes(self, path: str) -> Optional[bytes]:
+        p = self._strip(path)
+        if not self._fs.exists(p):
+            return None
+        with self._fs.open(p, "rb") as f:
+            return f.read()
+
+    def exists(self, path: str) -> bool:
+        return self._fs.exists(self._strip(path))
+
+    def listdir(self, path: str) -> List[str]:
+        p = self._strip(path)
+        if not self._fs.exists(p):
+            return []
+        return sorted(posixpath.basename(e.rstrip("/"))
+                      for e in self._fs.ls(p, detail=False))
+
+    def delete(self, path: str) -> None:
+        p = self._strip(path)
+        if self._fs.exists(p):
+            self._fs.rm(p)
+
+
+_local = LocalStorage()
+_memory = MemoryStorage()
+_fsspec_cache: Dict[str, FsspecStorage] = {}
+
+
+def get_storage(path: str) -> Tuple[StorageBackend, str]:
+    """Backend + normalized path for ``path``, dispatched on its scheme."""
+    if "://" not in path:
+        return _local, path
+    scheme, rest = path.split("://", 1)
+    if scheme == "file":
+        return _local, rest
+    if scheme == "mem":
+        return _memory, path  # keep full mem:// key
+    backend = _fsspec_cache.get(scheme)
+    if backend is None:
+        backend = _fsspec_cache[scheme] = FsspecStorage(scheme)
+    return backend, path

@@ -1,0 +1,257 @@
+"""Built-in multi-device (sharded) regression trainable.
+
+The multi-core-per-trial path (BASELINE config 5: N cores per trial via
+``resources_per_trial={"devices": N}``; the reference's analogue is Ray's
+``resources_per_trial`` at `/root/reference/ray-tune-hpo-regression.py:475`,
+which only ever granted a single GPU).  The executor leases N devices to the
+trial; this trainable builds a named mesh over exactly those devices and runs
+the same epoch-is-one-program design as ``train_regressor``, jitted with
+GSPMD shardings:
+
+* batch dim sharded over ``dp`` (XLA inserts the gradient all-reduce);
+* transformer params optionally tensor-parallel over ``tp``
+  (``parallel/sharding.py`` rules; column/row-parallel FF, head-sharded
+  attention);
+* BatchNorm models get synchronized BN for free: under jit the batch mean
+  over a dp-sharded axis is the *global* mean (GSPMD adds the psum), so
+  multi-device BN statistics match the single-device run.
+
+Config keys, beyond ``train_regressor``'s: ``mesh_shape`` — dict of mesh axis
+sizes, e.g. ``{"dp": 4}`` (default: pure dp over all leased devices) or
+``{"dp": 2, "tp": 2}``.  ``batch_size`` is the *global* batch and must be
+divisible by dp.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_machine_learning_tpu.data.loader import Dataset
+from distributed_machine_learning_tpu.models import build_model
+from distributed_machine_learning_tpu.ops.losses import get_loss
+from distributed_machine_learning_tpu.ops.optimizers import make_optimizer
+from distributed_machine_learning_tpu.ops.schedules import get_schedule
+from distributed_machine_learning_tpu.parallel.mesh import make_mesh
+from distributed_machine_learning_tpu.parallel.sharding import (
+    TRANSFORMER_TP_RULES,
+    opt_state_shardings,
+    param_shardings,
+    shard_params,
+)
+from distributed_machine_learning_tpu.tune import session
+from distributed_machine_learning_tpu.tune._regression_program import (
+    detect_call_convention,
+    make_forward,
+    per_example_losses,
+)
+from distributed_machine_learning_tpu.tune.checkpoint import restore_into
+from distributed_machine_learning_tpu.utils.seeding import fold_seed
+
+
+def _host(tree):
+    return jax.tree.map(lambda a: np.asarray(a), tree)
+
+
+def train_sharded_regressor(
+    config: Dict[str, Any],
+    train_data: Optional[Dataset] = None,
+    val_data: Optional[Dataset] = None,
+):
+    """Multi-device trainable. Bind datasets with ``tune.with_parameters``."""
+    if train_data is None or val_data is None:
+        raise ValueError("train_sharded_regressor needs train_data/val_data")
+
+    devices = session.get_devices() or list(jax.devices())
+    mesh_shape = dict(config.get("mesh_shape") or {"dp": len(devices)})
+    mesh = make_mesh(mesh_shape, devices)
+    dp = int(mesh.shape.get("dp", 1))
+
+    num_epochs = int(config.get("num_epochs", 20))
+    seed = int(config.get("seed", 0))
+    loss_name = str(config.get("loss_function", "mse"))
+    global_batch = int(config.get("batch_size", 32))
+    if global_batch % dp != 0:
+        raise ValueError(
+            f"global batch_size={global_batch} must be divisible by dp={dp}"
+        )
+
+    x_np = np.asarray(train_data.x, np.float32)
+    y_np = np.asarray(train_data.y, np.float32)
+    n_train = len(x_np)
+    if n_train < global_batch:
+        raise ValueError(
+            f"train set ({n_train} rows) is smaller than the global "
+            f"batch_size ({global_batch}); lower batch_size (it must stay "
+            f"divisible by dp={dp})"
+        )
+    num_batches = n_train // global_batch
+    steps_per_epoch = num_batches
+
+    total_steps = int(config.get("total_steps", num_epochs * steps_per_epoch))
+    schedule = get_schedule(
+        str(config.get("lr_schedule", "warmup_linear_decay")),
+        learning_rate=float(config["learning_rate"]),
+        warmup_steps=int(config.get("warmup_steps", 0)),
+        total_steps=max(total_steps, 1),
+    )
+    tx = make_optimizer(
+        str(config.get("optimizer", "adam")),
+        learning_rate=schedule,
+        weight_decay=float(config.get("weight_decay", 0.0)),
+        momentum=float(config.get("momentum", 0.0)),
+        gradient_clipping=float(config.get("gradient_clipping", 0.0)),
+    )
+    loss_fn = get_loss(loss_name)
+
+    model = build_model(config)
+    sample_x = x_np[:1]
+    variables, flag_name = detect_call_convention(model, sample_x)
+    has_bn = "batch_stats" in variables
+    forward = make_forward(model, flag_name, has_bn)
+
+    # Shard params per the TP rules (pure-dp meshes leave everything
+    # replicated); optimizer state inherits the layout via jit init.
+    params = shard_params(variables["params"], mesh, TRANSFORMER_TP_RULES)
+    p_shardings = param_shardings(params, mesh, TRANSFORMER_TP_RULES)
+    o_shardings = opt_state_shardings(
+        jax.eval_shape(tx.init, params), p_shardings, mesh
+    )
+    opt_state = jax.jit(
+        tx.init, in_shardings=(p_shardings,), out_shardings=o_shardings
+    )(params)
+    batch_stats = jax.device_put(
+        variables.get("batch_stats", {}),
+        jax.tree.map(lambda _: NamedSharding(mesh, P()),
+                     variables.get("batch_stats", {})),
+    )
+
+    # Batched-epoch shardings: [num_batches, global_batch, ...] with the
+    # in-batch dim over dp.
+    def batched_sharding(ndim):
+        return NamedSharding(mesh, P(*([None, "dp"] + [None] * (ndim - 2))))
+
+    xb_sharding = batched_sharding(x_np.ndim + 1)
+    yb_sharding = batched_sharding(y_np.ndim + 1)
+    xv_sharding = NamedSharding(mesh, P("dp"))
+
+    def epoch_fn(params, opt_state, batch_stats, xb, yb, epoch_key):
+        def step(carry, batch):
+            params, opt_state, batch_stats, i = carry
+            x, y = batch
+            key = jax.random.fold_in(epoch_key, i)
+
+            def loss_of(p):
+                preds, new_bs = forward(p, batch_stats, x, key, True)
+                return loss_fn(preds.astype(jnp.float32), y), new_bs
+
+            (loss, new_bs), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state, new_bs, i + 1), loss
+
+        (params, opt_state, batch_stats, _), losses = jax.lax.scan(
+            step, (params, opt_state, batch_stats, jnp.int32(0)), (xb, yb)
+        )
+        return params, opt_state, batch_stats, losses.mean()
+
+    train_epoch = jax.jit(
+        epoch_fn,
+        donate_argnums=(0, 1, 2),
+        in_shardings=(None, None, None, xb_sharding, yb_sharding, None),
+    )
+
+    # Eval: pad the val set to a multiple of dp, mask the padding out.
+    xv_np = np.asarray(val_data.x, np.float32)
+    yv_np = np.asarray(val_data.y, np.float32)
+    n_val = len(xv_np)
+    pad = (-n_val) % dp
+    if pad:
+        xv_np = np.concatenate([xv_np, np.zeros_like(xv_np[:pad])])
+        yv_np = np.concatenate([yv_np, np.ones_like(yv_np[:pad])])
+    mask_np = (np.arange(len(xv_np)) < n_val).astype(np.float32)
+
+    def eval_fn(params, batch_stats, xv, yv, mask):
+        preds, _ = forward(params, batch_stats, xv, jax.random.key(0), False)
+        se, ae, ape = per_example_losses(preds.astype(jnp.float32), yv)
+        denom = mask.sum()
+        return {
+            "validation_loss": (se * mask).sum() / denom,
+            "validation_mae": (ae * mask).sum() / denom,
+            "validation_mape": 100.0 * (ape * mask).sum() / denom,
+        }
+
+    evaluate = jax.jit(
+        eval_fn, in_shardings=(None, None, xv_sharding, xv_sharding, xv_sharding)
+    )
+    xv = jax.device_put(xv_np, xv_sharding)
+    yv = jax.device_put(yv_np, xv_sharding)
+    mask = jax.device_put(mask_np, xv_sharding)
+
+    # ---- restore (PBT exploit / fault retry) -------------------------------
+    start_epoch = 0
+    ckpt = session.get_checkpoint()
+    if ckpt is not None:
+        template = {
+            "params": _host(params),
+            "opt_state": _host(opt_state),
+            "batch_stats": _host(batch_stats),
+            "epoch": 0,
+        }
+        restored = restore_into(template, ckpt)
+        # Re-shard restored host arrays into the live mesh layout.
+        params = jax.device_put(restored["params"], p_shardings)
+        opt_state = jax.device_put(restored["opt_state"], o_shardings)
+        batch_stats = jax.device_put(
+            restored["batch_stats"],
+            jax.tree.map(lambda _: NamedSharding(mesh, P()),
+                         restored["batch_stats"]),
+        )
+        start_epoch = int(restored["epoch"]) + 1
+
+    checkpoint_freq = int(config.get("checkpoint_freq", 1))
+    rng = np.random.default_rng(fold_seed(seed, "shuffle"))
+
+    # ---- epoch loop: host-driven so the scheduler can interrupt ------------
+    for epoch in range(start_epoch, num_epochs):
+        perm = rng.permutation(n_train)[: num_batches * global_batch]
+        xb = jax.device_put(
+            x_np[perm].reshape(num_batches, global_batch, *x_np.shape[1:]),
+            xb_sharding,
+        )
+        yb = jax.device_put(
+            y_np[perm].reshape(num_batches, global_batch, *y_np.shape[1:]),
+            yb_sharding,
+        )
+        epoch_key = jax.random.key(fold_seed(seed, "epoch", epoch))
+        params, opt_state, batch_stats, train_loss = train_epoch(
+            params, opt_state, batch_stats, xb, yb, epoch_key
+        )
+        metrics = evaluate(params, batch_stats, xv, yv, mask)
+        step_count = (epoch + 1) * steps_per_epoch
+        record = {
+            "epoch": epoch,
+            "train_loss": float(train_loss),
+            "lr": float(schedule(min(step_count, total_steps))),
+            "steps": step_count,
+            "num_devices": len(devices),
+            **{k: float(v) for k, v in metrics.items()},
+        }
+        checkpoint = None
+        if checkpoint_freq and (epoch + 1) % checkpoint_freq == 0:
+            checkpoint = {
+                "params": _host(params),
+                "opt_state": _host(opt_state),
+                "batch_stats": _host(batch_stats),
+                "epoch": epoch,
+            }
+        session.report(record, checkpoint=checkpoint)
+
+    return None

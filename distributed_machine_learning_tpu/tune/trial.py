@@ -59,6 +59,17 @@ class Trial:
     # Checkpoint/restore bookkeeping (PBT exploit, fault recovery).
     restore_path: Optional[str] = None
     latest_checkpoint: Optional[str] = None
+    # Training iteration the latest checkpoint was taken at — PBT uses it to
+    # refuse exploiting donor state ahead of a laggard's own progress.
+    latest_checkpoint_iteration: int = 0
+
+    # Progress accounting. ``training_iteration`` must mean *restorable
+    # progress*, not "reports ever made": a respawned trial restored from an
+    # epoch-e checkpoint continues at e+1, so its iteration counter has to
+    # rewind with it — otherwise schedulers comparing iterations (PBT's
+    # budget gate, ASHA rungs) mix incompatible units after any respawn.
+    restore_base: int = 0  # progress at the last (re)start
+    reports_since_restart: int = 0
 
     # Runtime bookkeeping.
     created_at: float = field(default_factory=time.time)
@@ -74,7 +85,9 @@ class Trial:
 
     @property
     def training_iteration(self) -> int:
-        return len(self.results)
+        """Current restorable progress (see field comment above); equals
+        ``len(results)`` for a trial that never restored."""
+        return self.restore_base + self.reports_since_restart
 
     def metric_history(self, metric: str) -> List[float]:
         return [r[metric] for r in self.results if metric in r]

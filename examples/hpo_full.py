@@ -1,0 +1,175 @@
+"""Production-parity HPO driver: the reference's full 20-hyperparameter sweep.
+
+Counterpart of `/root/reference/ray-tune-hpo-regression.py:465-480` (C21 in
+SURVEY.md §2a): windowed wearable-sensor regression, a custom transformer with
+every architecture knob searchable, ASHA early stopping, and Bayesian search —
+written in this framework's DSL with the reference's latent bugs fixed:
+
+* ``dim_feedforward`` really is ``d_model x {2,3,4}`` — the reference's
+  ``tune.sample_from(lambda: ... tune.choice(...))`` returned a sampler
+  object, not an int (`:383`); here ``sample_from`` resolves against the
+  sampled config.
+* ``d_model % num_heads == 0`` is enforced as a joint ``Constraint`` — the
+  reference could sample e.g. d_model=320, heads=32 and crash (never checked).
+* ``batch_size`` / ``max_seq_length`` actually take effect (dead knobs in the
+  reference: loaders were fixed at batch 32 / window 96, `:456,:446`).
+* per-epoch reporting makes ASHA live (the reference reported once at trial
+  end, `:373`, so ASHA never cut anything).
+
+The real patient ``.npy`` files are private, so the default data source is
+the synthetic glucose-like workload in the same shape; pass ``--features`` /
+``--labels`` to run on real ``{columns, data}`` .npy dumps like the
+reference's (`:414-418,:423-459`).
+
+Run (CPU dev box):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/hpo_full.py --num-samples 8 --num-epochs 2 --fast
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_machine_learning_tpu import tune  # noqa: E402
+from distributed_machine_learning_tpu.data import glucose_like_data  # noqa: E402
+
+
+def build_search_space(args) -> tune.SearchSpace:
+    """The reference's 20 hyperparameters (`:379-400`), resolvable + valid."""
+    space = {
+        "model": "transformer",
+        # -- architecture ----------------------------------------------------
+        "num_heads": tune.choice([2, 4, 8, 16, 32]),
+        "num_layers": tune.choice([2, 4, 6, 8, 12, 16]),
+        "d_model": tune.choice([64, 128, 192, 256, 320, 512]),
+        "dim_feedforward": tune.sample_from(
+            lambda cfg: cfg["d_model"] * cfg["ff_multiplier"]
+        ),
+        "ff_multiplier": tune.choice([2, 3, 4]),
+        "attention_type": tune.choice(
+            ["scaled_dot_product", "multi_head_attention", "linear_attention"]
+        ),
+        "key_dim_scaling": tune.choice([1.0, 0.5, 0.25]),
+        "attn_kernel_size": tune.choice([3, 5, 7]),
+        "depthwise_separable_conv": tune.choice([True, False]),
+        "shared_weights": tune.choice([True, False]),
+        "stochastic_depth_rate": tune.uniform(0.0, 0.2),
+        "dropout": tune.loguniform(0.01, 0.5),
+        "max_seq_length": tune.choice([50, 100, 200, 500, 1000, 2000]),
+        # -- optimization ----------------------------------------------------
+        "learning_rate": tune.loguniform(1e-5, 5e-2),
+        "weight_decay": tune.loguniform(1e-6, 1e-1),
+        "batch_size": tune.choice([16, 32, 64, 128, 256]),
+        "warmup_steps": tune.choice([100, 500, 1000, 2000]),
+        "total_steps": tune.choice([10_000, 20_000, 50_000, 100_000]),
+        "loss_function": tune.choice(["mse", "mae", "huber", "mape"]),
+        "gradient_clipping": tune.uniform(0.0, 1.0),
+        "optimizer": tune.choice(["adam", "adamw", "sgd", "rmsprop"]),
+        # -- budget ----------------------------------------------------------
+        "num_epochs": args.num_epochs,
+        "seed": tune.randint(0, 1_000_000),
+    }
+    if args.fast:  # minute-scale smoke settings for dev boxes / CI
+        space.update({
+            "num_heads": tune.choice([2, 4]),
+            "num_layers": tune.choice([1, 2]),
+            "d_model": tune.choice([32, 64]),
+            "max_seq_length": 96,
+            "batch_size": 32,
+            "warmup_steps": 10,
+        })
+        space.pop("total_steps")  # let the trainable derive it from epochs
+    return tune.SearchSpace(
+        space,
+        constraints=[
+            tune.Constraint(
+                lambda cfg: cfg["d_model"] % cfg["num_heads"] == 0,
+                description="d_model divisible by num_heads",
+            ),
+            tune.Constraint(
+                # The depthwise FF path projects back to d_model; its kernel
+                # size must fit the sequence.
+                lambda cfg: cfg["attn_kernel_size"] < cfg["max_seq_length"],
+                description="attention kernel fits the sequence",
+            ),
+        ],
+    )
+
+
+def load_data(args):
+    if args.features and args.labels:
+        from distributed_machine_learning_tpu.data import (
+            load_dataframe_from_npy,
+            make_regression_dataset,
+        )
+
+        return make_regression_dataset(
+            load_dataframe_from_npy(args.features),
+            load_dataframe_from_npy(args.labels),
+            interval=96,
+            stride=96,
+        )
+    return glucose_like_data(
+        num_steps=args.data_steps, num_features=args.num_features
+    )
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--features", help=".npy features dump (optional)")
+    parser.add_argument("--labels", help=".npy labels dump (optional)")
+    parser.add_argument("--num-samples", type=int, default=50)
+    parser.add_argument("--num-epochs", type=int, default=20)
+    parser.add_argument("--data-steps", type=int, default=50_000)
+    parser.add_argument("--num-features", type=int, default=16)
+    parser.add_argument("--storage", default="~/dml_tpu_results")
+    parser.add_argument("--fast", action="store_true",
+                        help="shrink arch choices to minute-scale")
+    parser.add_argument("--search", choices=["bayesopt", "random", "tpe"],
+                        default="bayesopt")
+    args = parser.parse_args(argv)
+
+    train, val = load_data(args)
+    space = build_search_space(args)
+
+    if args.search == "bayesopt":
+        # GP over the continuous subspace, random for categoricals — the
+        # deliberate mixed-space strategy (the reference's BayesOptSearch
+        # could not handle its own categorical-heavy space).
+        from distributed_machine_learning_tpu.tune.search import BayesOptSearch
+
+        search_alg = BayesOptSearch(random_search_steps=10)
+    elif args.search == "tpe":
+        from distributed_machine_learning_tpu.tune.search import TPESearch
+
+        search_alg = TPESearch()
+    else:
+        search_alg = None
+
+    analysis = tune.run(
+        tune.with_parameters(
+            tune.train_regressor, train_data=train, val_data=val
+        ),
+        space,
+        metric="validation_mape",
+        mode="min",
+        num_samples=args.num_samples,
+        scheduler=tune.ASHAScheduler(
+            max_t=args.num_epochs, grace_period=1, reduction_factor=2
+        ),
+        search_alg=search_alg,
+        storage_path=args.storage,
+        name="hpo_full",
+    )
+    print("Best hyperparameters found:\n", analysis.best_config)
+    print("Best validation_mape:",
+          analysis.best_result.get("validation_mape"))
+    return analysis
+
+
+if __name__ == "__main__":
+    main()

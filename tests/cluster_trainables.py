@@ -57,17 +57,57 @@ def slow_trial(config):
 
 def pbt_trial(config):
     """Checkpoint-carrying trainable for PBT-over-cluster: loss improves with
-    a per-config 'rate', so PBT exploits good rates into bad trials."""
+    a per-config 'rate', so PBT exploits good rates into bad trials.
+
+    If ``barrier_dir``/``population`` are set, trials pace each other in
+    lockstep through a filesystem barrier: a marker ``{tid}__{epoch}`` is
+    written only AFTER ``report`` returns (i.e. after the driver has processed
+    that epoch's metrics), and no trial starts epoch k+1 until every
+    population member's epoch-k marker exists.  That makes "the whole
+    population has comparable scores when the perturbation interval fires"
+    true by construction instead of by race, so the PBT-over-cluster test is
+    deterministic."""
+    import time
+
+    bdir = config.get("barrier_dir")
+    population = int(config.get("population", 0))
     restored = tune.get_checkpoint()
     start = int(restored["epoch"]) if restored else 0
     score = float(restored["score"]) if restored else 100.0
     rate = float(config["rate"])
+    tid = tune.get_trial_id()
+
+    def wait_for_peers(epoch):
+        if not bdir:
+            return
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            reached = set()
+            for name in os.listdir(bdir):
+                peer, _, ep = name.partition("__")
+                if ep and int(ep) == epoch:
+                    reached.add(peer)
+            if len(reached) >= population:
+                return
+            time.sleep(0.02)
+
+    if bdir:
+        # A respawned trial restored at epoch e never re-reports epochs <= e;
+        # back-fill its markers so peers' barriers don't wait out the timeout.
+        for ep in range(1, start + 1):
+            with open(os.path.join(bdir, f"{tid}__{ep}"), "w"):
+                pass
+
     for epoch in range(start + 1, int(config.get("epochs", 8)) + 1):
         score = score * (1.0 - rate)
         tune.report(
             {"loss": score, "epoch": epoch},
             checkpoint={"epoch": epoch, "score": score},
         )
+        if bdir:
+            with open(os.path.join(bdir, f"{tid}__{epoch}"), "w"):
+                pass
+            wait_for_peers(epoch)
 
 
 def jax_device_trial(config):

@@ -142,19 +142,23 @@ def test_distributed_pbt_exploits_and_restores(worker_pool, tmp_path):
         PopulationBasedTraining,
     )
 
+    barrier_dir = tmp_path / "barrier"
+    barrier_dir.mkdir()
+    pbt = PopulationBasedTraining(
+        perturbation_interval=2,
+        hyperparam_mutations={"rate": tune.uniform(0.01, 0.5)},
+        quantile_fraction=0.5,
+        seed=11,
+    )
     analysis = run_distributed(
         "cluster_trainables:pbt_trial",
-        {"rate": tune.uniform(0.01, 0.5), "epochs": 8},
+        {"rate": tune.uniform(0.01, 0.5), "epochs": 8,
+         "barrier_dir": str(barrier_dir), "population": 4},
         metric="loss",
         mode="min",
         num_samples=4,
         workers=worker_pool,
-        scheduler=PopulationBasedTraining(
-            perturbation_interval=2,
-            hyperparam_mutations={"rate": tune.uniform(0.01, 0.5)},
-            quantile_fraction=0.5,
-            seed=11,
-        ),
+        scheduler=pbt,
         storage_path=str(tmp_path),
         name="dist_pbt",
         seed=9,
@@ -163,14 +167,16 @@ def test_distributed_pbt_exploits_and_restores(worker_pool, tmp_path):
     assert analysis.num_terminated() == 4
     # Every trial must reach the final epoch despite stop/respawn cycles.
     assert all(t.results[-1]["epoch"] == 8 for t in analysis.trials)
-    # At least one trial must have been respawned (PBT acted): a respawn
-    # restores a donor epoch, so its reported epoch sequence is not the
-    # plain 1..8 staircase.
-    def respawned(t):
-        epochs = [r["epoch"] for r in t.results]
-        return epochs != list(range(1, 9))
-
-    assert any(respawned(t) for t in analysis.trials), "PBT never requeued"
+    # PBT must have acted: the barrier-paced population guarantees every
+    # trial's scores are comparable when the interval fires, so the bottom
+    # trial is requeued by construction.  (Epoch-sequence heuristics are NOT
+    # a reliable respawn detector: a laggard stopped at epoch k and restored
+    # from a donor checkpoint also at epoch k re-reports the plain staircase.)
+    assert pbt.debug_state()["num_perturbations"] >= 1, "PBT never requeued"
+    # The exploit actually routed donor weights: some trial restored from a
+    # checkpoint it did not write itself.
+    restored = [t for t in analysis.trials if t.restore_path]
+    assert any(t.trial_id not in t.restore_path for t in restored)
 
 
 def test_worker_death_requeues_trials(tmp_path):
@@ -222,3 +228,41 @@ def test_jax_runs_on_worker(worker_pool, tmp_path):
     assert analysis.num_terminated() == 2
     for t in analysis.trials:
         assert "cpu" in t.results[-1]["device"].lower()
+
+
+def test_hmac_authenticated_control_plane(tmp_path, monkeypatch):
+    """With DML_CLUSTER_SECRET set on both sides, every frame is MACed and a
+    sweep runs end-to-end; a driver with the WRONG secret is rejected at the
+    hello (frames failing verification never reach pickle.loads)."""
+    from distributed_machine_learning_tpu.tune.cluster import RemoteWorker
+
+    secret_env = dict(_worker_env(), DML_CLUSTER_SECRET="s3cret")
+    procs, addrs = start_local_workers(1, slots=2, env=secret_env)
+    try:
+        monkeypatch.setenv("DML_CLUSTER_SECRET", "s3cret")
+        analysis = run_distributed(
+            "cluster_trainables:quadratic_trial",
+            {"x": tune.uniform(0.0, 6.0), "epochs": 2},
+            metric="loss",
+            mode="min",
+            num_samples=2,
+            workers=addrs,
+            storage_path=str(tmp_path),
+            name="dist_hmac",
+            verbose=0,
+        )
+        assert analysis.num_terminated() == 2
+
+        # Wrong secret: the worker's hello frame fails our MAC check.
+        monkeypatch.setenv("DML_CLUSTER_SECRET", "wrong")
+        with pytest.raises((ConnectionError, OSError)):
+            RemoteWorker(addrs[0])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()

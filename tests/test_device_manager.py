@@ -1,0 +1,73 @@
+"""DeviceManager leasing: ICI-adjacent multi-device placement (SURVEY.md §7
+step 9 — pack whole trials onto adjacent cores, unlike popping first-free)."""
+
+from types import SimpleNamespace
+
+from distributed_machine_learning_tpu.tune.executor import DeviceManager
+
+
+def fake_devices(coords_list):
+    return [SimpleNamespace(id=i, coords=c) for i, c in enumerate(coords_list)]
+
+
+def grid_2x4():
+    # A 2x4 torus enumerated row-major: index adjacency == ring adjacency.
+    return fake_devices([(x, y, 0) for y in range(2) for x in range(4)])
+
+
+def test_single_device_lease_pops_lowest():
+    dm = DeviceManager(grid_2x4())
+    lease = dm.acquire(1)
+    assert [i for i, _ in lease] == [0]
+
+
+def test_multi_device_lease_is_contiguous():
+    dm = DeviceManager(grid_2x4())
+    a = dm.acquire(2)
+    b = dm.acquire(2)
+    assert [i for i, _ in a] == [0, 1]
+    assert [i for i, _ in b] == [2, 3]
+
+
+def test_lease_prefers_tight_coords_window():
+    # Free: {2,3} (same row, adjacent) and {4,5} (row boundary: coords
+    # (0,1),(1,1)) — both contiguous index windows; {2,3} spans x=2..3,y=0
+    # (volume 2) while {3,4} spans both rows and x=0..3 (volume 8).
+    dm = DeviceManager(grid_2x4())
+    dm.acquire(2)  # takes 0,1
+    hold = dm.acquire(1)  # takes 2
+    lease = dm.acquire(2)  # free: 3,4,5,6,7 -> windows (3,4),(4,5),(5,6),(6,7)
+    # (3,4) crosses the row boundary: coords (3,0),(0,1) -> volume 4*2=8;
+    # (4,5): (0,1),(1,1) -> volume 2. Must pick a volume-2 window, not (3,4).
+    idxs = [i for i, _ in lease]
+    assert idxs != [3, 4]
+    assert idxs in ([4, 5], [5, 6], [6, 7])
+    dm.release(hold)
+
+
+def test_fragmented_pool_takes_tightest_cluster():
+    dm = DeviceManager(grid_2x4())
+    leases = [dm.acquire(1) for _ in range(8)]
+    # Free up a scattered set: 1, 4, 5, 7 — no contiguous pair except (4,5).
+    for lease in (leases[1], leases[4], leases[5], leases[7]):
+        dm.release(lease)
+    lease = dm.acquire(2)
+    assert [i for i, _ in lease] == [4, 5]
+    # Now free: 1, 7 — no contiguous window; tightest cluster is just [1, 7].
+    lease2 = dm.acquire(2)
+    assert [i for i, _ in lease2] == [1, 7]
+
+
+def test_release_returns_capacity():
+    dm = DeviceManager(grid_2x4())
+    lease = dm.acquire(8)
+    assert dm.num_free == 0 and dm.acquire(1) is None
+    dm.release(lease)
+    assert dm.num_free == 8
+
+
+def test_devices_without_coords_fall_back_to_index_order():
+    devs = [SimpleNamespace(id=i) for i in range(4)]  # no .coords attr
+    dm = DeviceManager(devs)
+    assert [i for i, _ in dm.acquire(2)] == [0, 1]
+    assert [i for i, _ in dm.acquire(2)] == [2, 3]

@@ -102,3 +102,21 @@ def test_dataset_batches_native_gather_matches_manual(arr):
     for (x1, y1), (x2, y2) in zip(batches, batches2):
         np.testing.assert_array_equal(x1, x2)
         np.testing.assert_array_equal(y1, y2)
+
+
+def test_shuffle_identical_native_and_fallback():
+    """Same seed -> same permutation with or without the C++ library, so batch
+    order (and thus training) is reproducible across hosts/toolchains
+    (ADVICE r1: the two paths previously used different generators)."""
+    from unittest import mock
+
+    from distributed_machine_learning_tpu.data import native
+
+    if not native.native_available():
+        pytest.skip("native library not built; nothing to compare against")
+    for n, seed in [(1, 7), (2, 0), (97, 123), (1024, 2**63 + 5)]:
+        with_lib = native.shuffled_indices(n, seed)
+        with mock.patch.object(native, "_get_lib", return_value=None):
+            without = native.shuffled_indices(n, seed)
+        np.testing.assert_array_equal(with_lib, without)
+        assert sorted(without.tolist()) == list(range(n))
