@@ -34,6 +34,7 @@ from distributed_machine_learning_tpu.tune.session import (
     set_session,
 )
 from distributed_machine_learning_tpu.tune.trial import Trial
+from distributed_machine_learning_tpu.utils.compile_cache import get_tracker
 
 
 class DeviceManager:
@@ -186,7 +187,20 @@ class ThreadTrialExecutor:
 
     # -- trial thread body ---------------------------------------------------
     def _run(self, trial: Trial, trainable: Callable, devices: List):
+        # Compile-time accounting: jit compiles triggered by this trial run on
+        # this thread, so the tracker's per-thread counters are per-trial.
+        tracker = get_tracker()
+        compile_base = tracker.thread_seconds()
+        hits_base = tracker.thread_cache_hits()
+
         def report_fn(metrics: Dict, checkpoint) -> str:
+            metrics.setdefault(
+                "compile_time_s",
+                round(tracker.thread_seconds() - compile_base, 4),
+            )
+            metrics.setdefault(
+                "compile_cache_hits", tracker.thread_cache_hits() - hits_base
+            )
             if checkpoint is not None:
                 count = trial.training_iteration + 1
                 path = ckpt_lib.checkpoint_path(

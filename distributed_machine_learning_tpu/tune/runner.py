@@ -64,6 +64,7 @@ def run(
     callbacks: Optional[List] = None,
     keep_checkpoints_num: int = 0,
     checkpoint_storage: Optional[str] = None,
+    compile_cache_dir: Optional[str] = "auto",
 ) -> ExperimentAnalysis:
     """Run an HPO experiment; see module docstring.
 
@@ -76,9 +77,22 @@ def run(
     or retry are never pruned.
     ``checkpoint_storage``: alternate root for checkpoints (``gs://...`` for
     shared pod storage, ``mem://...`` in tests); metrics stay local.
+    ``compile_cache_dir``: persistent XLA compile-cache directory ("auto" =
+    ``$DML_TPU_COMPILE_CACHE`` or ``~/.cache/dml_tpu/xla_cache``; None
+    disables).  The framework owns compile-time amortization (SURVEY.md §7):
+    identical-architecture trials skip XLA backend compilation, and every
+    result record carries ``compile_time_s`` / ``compile_cache_hits``.
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+    if compile_cache_dir is not None:
+        from distributed_machine_learning_tpu.utils.compile_cache import (
+            enable_persistent_cache,
+        )
+
+        enable_persistent_cache(
+            None if compile_cache_dir == "auto" else compile_cache_dir
+        )
     space = (
         param_space
         if isinstance(param_space, SearchSpace)
@@ -230,10 +244,18 @@ def run(
     finally:
         wall = time.time() - start_time
         utilization = device_mgr.utilization(wall)
+        from distributed_machine_learning_tpu.utils import compile_cache as cc
+
         try:
             store.write_state(
                 trials,
-                extra={"wall_clock_s": wall, "device_utilization": utilization},
+                extra={
+                    "wall_clock_s": wall,
+                    "device_utilization": utilization,
+                    "compile_time_total_s": round(cc.get_tracker().total_seconds(), 3),
+                    "compile_cache_hits": cc.get_tracker().total_cache_hits(),
+                    "compile_cache_entries": cc.cache_entry_count(),
+                },
             )
             store.close()
         except Exception as exc:  # noqa: BLE001 - callbacks still tear down

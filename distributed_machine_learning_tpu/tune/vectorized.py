@@ -228,6 +228,7 @@ def run_vectorized(
     seed: int = 0,
     device=None,
     verbose: int = 1,
+    compile_cache_dir: Optional[str] = "auto",
 ) -> ExperimentAnalysis:
     """Run an HPO sweep with trials batched into vmapped populations.
 
@@ -238,6 +239,15 @@ def run_vectorized(
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+    from distributed_machine_learning_tpu.utils import compile_cache as cc
+
+    if compile_cache_dir is not None:
+        # One sweep = one compile per static-signature group; the persistent
+        # cache extends that amortization across sweeps and processes.
+        cc.enable_persistent_cache(
+            None if compile_cache_dir == "auto" else compile_cache_dir
+        )
+    tracker = cc.get_tracker()
     space = (
         param_space if isinstance(param_space, SearchSpace)
         else SearchSpace(param_space)
@@ -302,9 +312,19 @@ def run_vectorized(
                     program = programs[sig] = _GroupProgram(
                         dict(members[0].config), train_data, val_data
                     )
+                compile_before = tracker.thread_seconds()
+                t_pop = time.time()
                 _run_population(
                     program, members, sched, searcher, store, metric, mode, log
                 )
+                compile_s = tracker.thread_seconds() - compile_before
+                if compile_s > 0.05:
+                    log(
+                        f"group of {len(members)}: "
+                        f"{time.time() - t_pop - compile_s:.1f}s execute + "
+                        f"{compile_s:.1f}s compile "
+                        f"({tracker.thread_cache_hits()} cache hits so far)"
+                    )
 
     wall = time.time() - start_time
     store.write_state(
@@ -313,6 +333,9 @@ def run_vectorized(
             "wall_clock_s": wall,
             "device_utilization": 1.0,
             "vectorized": True,
+            "compile_time_total_s": round(tracker.total_seconds(), 3),
+            "compile_cache_hits": tracker.total_cache_hits(),
+            "compile_cache_entries": cc.cache_entry_count(),
         },
     )
     store.close()
