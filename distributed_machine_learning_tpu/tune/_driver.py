@@ -44,6 +44,7 @@ class TrialLifecycle:
         stop_rules: Optional[Dict[str, float]] = None,
         time_budget_s: Optional[float] = None,
         keep_checkpoints_num: int = 0,
+        time_limit_per_trial_s: Optional[float] = None,
         log: Callable[[str], None] = lambda msg: None,
     ):
         self.searcher = searcher
@@ -56,6 +57,7 @@ class TrialLifecycle:
         self.stop_rules = stop_rules or {}
         self.time_budget_s = time_budget_s
         self.keep_checkpoints_num = keep_checkpoints_num
+        self.time_limit_per_trial_s = time_limit_per_trial_s
         self.log = log
 
         self.trials: List[Trial] = []
@@ -134,6 +136,20 @@ class TrialLifecycle:
         ):
             decision = STOP if decision == CONTINUE else decision
         if trial.stop_requested or self.budget_exceeded():
+            decision = STOP
+        if (
+            self.time_limit_per_trial_s is not None
+            and trial.incarnation_runtime_s() > self.time_limit_per_trial_s
+            and decision == CONTINUE
+        ):
+            # Soft per-trial time limit: stop at the report boundary.  Trials
+            # that never reach a report boundary are reaped by the runner's
+            # hard-kill path (process executor).  Measured per incarnation so
+            # a retried trial gets a fresh clock.
+            self.log(
+                f"{trial.trial_id} hit time limit "
+                f"({trial.incarnation_runtime_s():.0f}s); stopping"
+            )
             decision = STOP
         if decision == REQUEUE:
             trial._requeue_on_complete = True
@@ -227,5 +243,7 @@ class TrialLifecycle:
 
     def mark_running(self, trial: Trial):
         trial.status = TrialStatus.RUNNING
-        trial.started_at = trial.started_at or time.time()
+        now = time.time()
+        trial.started_at = trial.started_at or now
+        trial.restarted_at = now
         trial.stop_requested = False

@@ -1,0 +1,119 @@
+"""Child-process entry for the process-per-trial executor.
+
+Run as ``python -m distributed_machine_learning_tpu.tune._process_child`` by
+``ProcessTrialExecutor`` with the trial's device visibility already fixed in
+the process environment (the TPU analogue of Ray setting
+``CUDA_VISIBLE_DEVICES`` per trial actor, `ray-tune-hpo-regression.py:286`;
+SURVEY.md §7 step 3).  Speaks a length-prefixed pickle protocol over binary
+stdio:
+
+    parent -> child   {"trial_id", "config", "trainable": bytes,
+                       "restore": pytree|None, "sys_path": [...]}   (init)
+    child  -> parent  ("result", metrics, ckpt_bytes|None)
+    parent -> child   ("decision", "continue"|"stop"|"pause")
+    child  -> parent  ("complete",) | ("error", traceback_str)
+
+The child's real stdout is reserved for frames; ``print`` inside trainables
+is redirected to stderr so it can't corrupt the stream.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import sys
+import traceback
+
+_LEN = struct.Struct(">Q")
+
+
+def read_frame(stream):
+    header = stream.read(_LEN.size)
+    if len(header) < _LEN.size:
+        raise EOFError("frame stream closed")
+    (n,) = _LEN.unpack(header)
+    payload = stream.read(n)
+    if len(payload) < n:
+        raise EOFError("truncated frame")
+    return pickle.loads(payload)
+
+
+def write_frame(stream, obj) -> None:
+    payload = pickle.dumps(obj)
+    stream.write(_LEN.pack(len(payload)) + payload)
+    stream.flush()
+
+
+class _TrialStub:
+    """Just enough of a Trial for Session users inside the child."""
+
+    def __init__(self, trial_id: str, config: dict):
+        self.trial_id = trial_id
+        self.config = config
+
+
+def main() -> None:
+    stdin = sys.stdin.buffer
+    stdout = sys.stdout.buffer
+    sys.stdout = sys.stderr  # user prints must not corrupt the frame stream
+
+    # Everything from here on reports failures as frames: an unpicklable
+    # trainable or a broken import must surface as the trial's error, not as
+    # a silent child death.
+    try:
+        init = read_frame(stdin)
+        for p in reversed(init.get("sys_path", [])):
+            if p not in sys.path:
+                sys.path.insert(0, p)
+        import cloudpickle
+
+        trainable = cloudpickle.loads(init["trainable"])
+
+        import jax
+
+        from distributed_machine_learning_tpu.tune.session import (
+            PauseTrial,
+            Session,
+            StopTrial,
+            set_session,
+        )
+        from distributed_machine_learning_tpu.utils.compile_cache import (
+            get_tracker,
+        )
+        tracker = get_tracker()
+        devices = jax.devices()
+    except BaseException:  # noqa: BLE001
+        write_frame(stdout, ("error", traceback.format_exc()))
+        return
+
+    def report_fn(metrics, checkpoint) -> str:
+        metrics.setdefault("compile_time_s", round(tracker.thread_seconds(), 4))
+        metrics.setdefault("compile_cache_hits", tracker.thread_cache_hits())
+        ckpt_bytes = None
+        if checkpoint is not None:
+            ckpt_bytes = pickle.dumps(jax.device_get(checkpoint))
+        write_frame(stdout, ("result", dict(metrics), ckpt_bytes))
+        msg = read_frame(stdin)
+        assert msg[0] == "decision", msg
+        return msg[1]
+
+    restore = init.get("restore")
+    try:
+        set_session(
+            Session(
+                _TrialStub(init["trial_id"], dict(init["config"])),
+                report_fn,
+                lambda: restore,
+                devices,
+            )
+        )
+        trainable(dict(init["config"]))
+        write_frame(stdout, ("complete",))
+    except (StopTrial, PauseTrial):
+        write_frame(stdout, ("complete",))
+    except BaseException:  # noqa: BLE001 - everything goes back to the parent
+        write_frame(stdout, ("error", traceback.format_exc()))
+
+
+if __name__ == "__main__":
+    main()

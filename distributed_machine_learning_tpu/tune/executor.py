@@ -18,7 +18,11 @@ next epoch and keeps scheduler state single-threaded.
 from __future__ import annotations
 
 import os
+import pickle
 import queue
+import re
+import subprocess
+import sys
 import threading
 import time
 import traceback
@@ -232,3 +236,224 @@ class ThreadTrialExecutor:
             self.events.put(("error", trial, traceback.format_exc()))
         finally:
             set_session(None)
+
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _host_chip_ordinals(devices: List) -> List[int]:
+    """Host-local CHIP ordinals for ``TPU_VISIBLE_CHIPS``.
+
+    Lease bookkeeping indexes into a possibly user-filtered device list, and
+    on v2/v3 each chip exposes two cores — neither of which matches what
+    ``TPU_VISIBLE_CHIPS`` wants (chip numbers among THIS host's chips).  Map
+    each leased device to its chip via physical ``coords`` (cores on one chip
+    share coords), numbering chips in this host's device-enumeration order.
+    """
+    try:
+        import jax as _jax
+
+        host_devices = _jax.local_devices()
+    except Exception:  # pragma: no cover - backend gone; fall back to ids
+        return sorted({getattr(d, "id", 0) for d in devices})
+    chip_of: Dict = {}
+    for d in host_devices:
+        key = tuple(getattr(d, "coords", None) or (d.id,))
+        chip_of.setdefault(key, len(chip_of))
+    return sorted(
+        {chip_of[tuple(getattr(d, "coords", None) or (d.id,))] for d in devices}
+    )
+
+
+class ProcessTrialExecutor:
+    """Runs each trial in its OWN OS process, with hard kill support.
+
+    The thread executor cannot preempt a wedged trial (a hung jit compile or
+    a stuck epoch loop holds its core until the trainable next reports).
+    This executor trades per-trial process startup (~1s CPU / a few s TPU
+    init) for real isolation: the runner can :meth:`kill` a trial past its
+    time limit, and its device lease is freed immediately — the capability
+    the reference got from Ray's actor-per-trial model (SURVEY.md §2b D5).
+
+    Device isolation is by process environment, the TPU analogue of Ray
+    setting ``CUDA_VISIBLE_DEVICES`` (`ray-tune-hpo-regression.py:286`):
+    ``TPU_VISIBLE_CHIPS``/``TPU_VISIBLE_DEVICES`` for the leased chips on
+    real TPU, ``--xla_force_host_platform_device_count`` on the CPU test
+    platform.  Trainables and their ``with_parameters`` bindings must be
+    picklable.  Checkpoints flow back over the pipe and are persisted by the
+    parent, so ``mem://``/``gs://`` checkpoint storage works unchanged.
+    """
+
+    supports_kill = True
+
+    def __init__(self, store, event_queue: "queue.Queue"):
+        self.store = store
+        self.events = event_queue
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._pumps: Dict[str, threading.Thread] = {}
+
+    # -- env -----------------------------------------------------------------
+    def _child_env(self, devices: List) -> dict:
+        env = dict(os.environ)
+        platform = devices[0].platform
+        if platform == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+            # The child sees exactly as many virtual devices as it leased.
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+",
+                "",
+                env.get("XLA_FLAGS", ""),
+            ).strip()
+            env["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={len(devices)}"
+            ).strip()
+            # Strip TPU-tunnel sitecustomize paths: a CPU child must not
+            # claim (or wait on) the real TPU backend.
+            env["PYTHONPATH"] = os.pathsep.join(
+                [_REPO_ROOT]
+                + [
+                    p
+                    for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                    if p and ".axon_site" not in p
+                ]
+            )
+        else:
+            visible = ",".join(str(c) for c in _host_chip_ordinals(devices))
+            env["TPU_VISIBLE_CHIPS"] = visible
+            env["TPU_VISIBLE_DEVICES"] = visible
+            env["PYTHONPATH"] = os.pathsep.join(
+                [_REPO_ROOT, env.get("PYTHONPATH", "")]
+            ).rstrip(os.pathsep)
+        return env
+
+    # -- lifecycle -----------------------------------------------------------
+    def start_trial(self, trial: Trial, trainable: Callable, leased_devices: List):
+        trial.assigned_devices = leased_devices
+        trial._kill_reason = None  # fresh incarnation, fresh diagnosis
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "distributed_machine_learning_tpu.tune._process_child"],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=None,  # trainable prints/tracebacks pass through
+            env=self._child_env([d for _, d in leased_devices]),
+            cwd=_REPO_ROOT,
+        )
+        self._procs[trial.trial_id] = proc
+        # The init frame (cloudpickled trainable + restore checkpoint) is
+        # written by the pump thread, not here: a dead child's BrokenPipe or
+        # a large payload must cost this trial, not stall/abort the runner's
+        # event loop.
+        pump = threading.Thread(
+            target=self._pump,
+            args=(trial, trainable, proc),
+            name=f"trial-pump-{trial.trial_id}",
+            daemon=True,
+        )
+        self._pumps[trial.trial_id] = pump
+        pump.start()
+
+    def is_alive(self, trial: Trial) -> bool:
+        t = self._pumps.get(trial.trial_id)
+        return t is not None and t.is_alive()
+
+    def kill(self, trial: Trial, reason: str = "killed by runner"):
+        """Hard-preempt a trial: SIGTERM, then SIGKILL after a grace period.
+
+        The pump thread observes stream EOF and reports ``reason`` as the
+        trial's error, so the runner's normal error path (retry budget,
+        device release) applies."""
+        trial._kill_reason = reason
+        proc = self._procs.get(trial.trial_id)
+        if proc is None or proc.poll() is not None:
+            return
+        proc.terminate()
+
+        def _escalate():
+            try:
+                proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+        threading.Thread(target=_escalate, daemon=True).start()
+
+    def join_all(self, timeout: float = 5.0):
+        for proc in self._procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        for t in self._pumps.values():
+            t.join(timeout=timeout)
+
+    # -- parent-side pump thread --------------------------------------------
+    def _pump(self, trial: Trial, trainable: Callable, proc: subprocess.Popen):
+        from distributed_machine_learning_tpu.tune import _process_child as pc
+
+        try:
+            import cloudpickle
+
+            restore = None
+            if trial.restore_path:
+                restore = ckpt_lib.load_checkpoint(trial.restore_path)
+            pc.write_frame(
+                proc.stdin,
+                {
+                    "trial_id": trial.trial_id,
+                    "config": dict(trial.config),
+                    # cloudpickle, not pickle: drivers define trainables in
+                    # __main__ (closures over datasets via with_parameters),
+                    # which reference-pickling cannot rebuild in the child.
+                    "trainable": cloudpickle.dumps(trainable),
+                    "restore": restore,
+                    "sys_path": list(sys.path),
+                },
+            )
+            while True:
+                msg = pc.read_frame(proc.stdout)
+                kind = msg[0]
+                if kind == "result":
+                    metrics, ckpt_bytes = msg[1], msg[2]
+                    if ckpt_bytes is not None:
+                        count = trial.training_iteration + 1
+                        path = ckpt_lib.checkpoint_path(
+                            self.store.checkpoint_dir(trial), count
+                        )
+                        ckpt_lib.save_checkpoint(path, pickle.loads(ckpt_bytes))
+                        trial.latest_checkpoint = path
+                        trial.latest_checkpoint_iteration = count
+                    event = ResultEvent(trial, metrics)
+                    self.events.put(("result", event))
+                    event.done.wait()
+                    pc.write_frame(proc.stdin, ("decision", event.decision))
+                elif kind == "complete":
+                    self.events.put(("complete", trial, None))
+                    return
+                elif kind == "error":
+                    self.events.put(("error", trial, msg[1]))
+                    return
+        except (EOFError, OSError):
+            reason = getattr(trial, "_kill_reason", None) or (
+                f"trial process died unexpectedly "
+                f"(rc={proc.poll()})"
+            )
+            self.events.put(("error", trial, reason))
+        except Exception:  # noqa: BLE001 - e.g. unpicklable trainable
+            self.events.put(("error", trial, traceback.format_exc()))
+        finally:
+            try:
+                proc.stdin.close()
+            except OSError:
+                pass
+            # Reap the child so it never lingers as a zombie; forget the
+            # Popen (a retry incarnation gets fresh entries).
+            try:
+                proc.wait(timeout=15.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5.0)
+            # Identity-guarded: a retry incarnation may already have
+            # registered ITS proc under this trial_id.
+            if self._procs.get(trial.trial_id) is proc:
+                self._procs.pop(trial.trial_id, None)

@@ -20,6 +20,7 @@ from typing import Any, Callable, Dict, List, Optional, Union
 
 from distributed_machine_learning_tpu.tune.executor import (
     DeviceManager,
+    ProcessTrialExecutor,
     ThreadTrialExecutor,
 )
 from distributed_machine_learning_tpu.tune.experiment import (
@@ -65,6 +66,8 @@ def run(
     keep_checkpoints_num: int = 0,
     checkpoint_storage: Optional[str] = None,
     compile_cache_dir: Optional[str] = "auto",
+    time_limit_per_trial_s: Optional[float] = None,
+    trial_executor: str = "thread",
 ) -> ExperimentAnalysis:
     """Run an HPO experiment; see module docstring.
 
@@ -82,6 +85,16 @@ def run(
     disables).  The framework owns compile-time amortization (SURVEY.md §7):
     identical-architecture trials skip XLA backend compilation, and every
     result record carries ``compile_time_s`` / ``compile_cache_hits``.
+    ``time_limit_per_trial_s``: per-trial wall-clock budget.  Enforced softly
+    at every report boundary (both executors), and enforced HARD — SIGTERM,
+    then SIGKILL — for trials that stop reporting (a wedged jit, a stuck
+    epoch loop) when ``trial_executor="process"``.  A killed trial follows
+    the normal error path: retried within ``max_failures`` (restoring its
+    latest checkpoint) or marked ERROR, and its devices are re-leased either
+    way.
+    ``trial_executor``: "thread" (default; lowest overhead, no preemption) or
+    "process" (one OS process per trial with per-process device visibility;
+    requires picklable trainables).
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
@@ -108,7 +121,14 @@ def run(
     store = ExperimentStore(storage_path, name, checkpoint_storage)
     device_mgr = DeviceManager(devices)
     events: "queue.Queue" = queue.Queue()
-    executor = ThreadTrialExecutor(store, events)
+    if trial_executor == "thread":
+        executor = ThreadTrialExecutor(store, events)
+    elif trial_executor == "process":
+        executor = ProcessTrialExecutor(store, events)
+    else:
+        raise ValueError(
+            f"trial_executor must be 'thread' or 'process', got {trial_executor!r}"
+        )
     callbacks = list(callbacks or [])
 
     max_concurrent = max_concurrent or device_mgr.num_devices
@@ -130,6 +150,7 @@ def run(
         stop_rules=stop,
         time_budget_s=time_budget_s,
         keep_checkpoints_num=keep_checkpoints_num,
+        time_limit_per_trial_s=time_limit_per_trial_s,
         log=log,
     )
     trials = lifecycle.trials
@@ -190,6 +211,32 @@ def run(
                         f"/{num_samples} done, {len(running)} running, "
                         f"{device_mgr.num_free}/{device_mgr.num_devices} cores free"
                     )
+                # Hard preemption: a trial past its time limit that has gone
+                # quiet (no report) is killed outright when the executor can
+                # (process executor); the thread executor can only flag it
+                # for stop at its next report.
+                if time_limit_per_trial_s is not None:
+                    grace = max(2.0, 0.25 * time_limit_per_trial_s)
+                    for tid in list(running):
+                        trial = lifecycle.by_id[tid]
+                        overdue = (
+                            trial.incarnation_runtime_s() - time_limit_per_trial_s
+                        )
+                        if overdue <= grace or not executor.is_alive(trial):
+                            continue
+                        if getattr(executor, "supports_kill", False):
+                            log(
+                                f"{trial.trial_id} exceeded time limit "
+                                f"({trial.incarnation_runtime_s():.0f}s > "
+                                f"{time_limit_per_trial_s:.0f}s); killing"
+                            )
+                            executor.kill(
+                                trial,
+                                f"time limit exceeded "
+                                f"({time_limit_per_trial_s:.0f}s)",
+                            )
+                        else:
+                            trial.stop_requested = True
                 # Reap threads that died without reporting (shouldn't happen).
                 for tid in list(running):
                     trial = lifecycle.by_id[tid]
@@ -205,6 +252,18 @@ def run(
                 continue
 
             kind = event[0]
+            # Stale-event guard: the heartbeat reaper may have already
+            # finished a trial whose executor posted its terminal event in
+            # the same instant (kill/EOF race).  Events for trials no longer
+            # in ``running`` must not be double-processed — a second
+            # finish/fail would requeue an already-terminal trial.
+            ev_trial = event[1].trial if kind == "result" else event[1]
+            if ev_trial.trial_id not in running:
+                if kind == "result":
+                    event[1].decision = "stop"
+                    event[1].done.set()
+                continue
+
             if kind == "result":
                 result_event = event[1]
                 trial = result_event.trial

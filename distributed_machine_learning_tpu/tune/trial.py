@@ -71,9 +71,13 @@ class Trial:
     restore_base: int = 0  # progress at the last (re)start
     reports_since_restart: int = 0
 
-    # Runtime bookkeeping.
+    # Runtime bookkeeping.  ``started_at`` is the FIRST start (total-runtime
+    # accounting); ``restarted_at`` is the current incarnation's start —
+    # per-trial time limits measure against it so a retried trial gets a
+    # fresh budget instead of being instantly over-limit.
     created_at: float = field(default_factory=time.time)
     started_at: Optional[float] = None
+    restarted_at: Optional[float] = None
     finished_at: Optional[float] = None
     stop_requested: bool = False
     pause_requested: bool = False
@@ -97,6 +101,13 @@ class Trial:
             return 0.0
         end = self.finished_at or time.time()
         return end - self.started_at
+
+    def incarnation_runtime_s(self) -> float:
+        """Runtime of the current (re)start only — the time-limit clock."""
+        if self.restarted_at is None:
+            return self.runtime_s()
+        end = self.finished_at or time.time()
+        return end - self.restarted_at
 
     def __repr__(self) -> str:  # keep logs compact
         return f"Trial({self.trial_id}, {self.status.value}, iters={self.training_iteration})"

@@ -1,0 +1,163 @@
+"""Process-per-trial executor: isolation, timeout preemption, kill-ability.
+
+The capability the reference inherited from Ray's actor-per-trial model
+(SURVEY.md §2b D5) and the thread executor cannot provide: a wedged trial
+(stuck compile, hung loop) is SIGTERM/SIGKILLed past its time limit and its
+device lease is returned to the pool.
+"""
+
+import time
+
+import pytest
+
+from distributed_machine_learning_tpu import tune
+from distributed_machine_learning_tpu.data import dummy_regression_data
+from distributed_machine_learning_tpu.tune.session import get_trial_id
+from distributed_machine_learning_tpu.tune.trial import TrialStatus
+
+
+def fake_trainable(config):
+    """Reports a decreasing loss without touching jax (fast child startup)."""
+    for epoch in range(int(config.get("num_epochs", 3))):
+        tune.report(
+            validation_loss=1.0 / (epoch + 1 + config.get("offset", 0.0)),
+            epoch=epoch,
+        )
+
+
+def sleeper_trainable(config):
+    """First trial wedges forever; the rest finish quickly."""
+    if get_trial_id() == "trial_00000":
+        time.sleep(10_000)
+    for epoch in range(2):
+        tune.report(validation_loss=1.0 / (epoch + 1), epoch=epoch)
+
+
+def flaky_sleeper(config):
+    """Wedges on its first incarnation only (marker file), then runs clean."""
+    import os
+
+    marker = config["marker"]
+    if not os.path.exists(marker):
+        open(marker, "w").close()
+        time.sleep(10_000)
+    for epoch in range(2):
+        tune.report(validation_loss=1.0 / (epoch + 1), epoch=epoch)
+
+
+def slow_epochs_trainable(config):
+    for epoch in range(int(config.get("num_epochs", 20))):
+        time.sleep(0.4)
+        tune.report(validation_loss=1.0 / (epoch + 1), epoch=epoch)
+
+
+def jax_trainable(config):
+    """One real jax training child: proves device visibility + compile work."""
+    train, val = dummy_regression_data(
+        num_samples=80, seq_len=6, num_features=3
+    )
+    tune.train_regressor(config, train_data=train, val_data=val)
+
+
+def test_process_trials_run_e2e(tmp_path):
+    analysis = tune.run(
+        fake_trainable,
+        {"num_epochs": 3, "offset": tune.uniform(0.0, 1.0)},
+        metric="validation_loss",
+        num_samples=3,
+        trial_executor="process",
+        storage_path=str(tmp_path),
+        verbose=0,
+    )
+    assert all(t.status == TrialStatus.TERMINATED for t in analysis.trials)
+    assert all(t.training_iteration == 3 for t in analysis.trials)
+    assert analysis.best_trial is not None
+    # compile accounting fields flow back from the child too
+    assert "compile_time_s" in analysis.trials[0].last_result
+
+
+def test_wedged_trial_killed_device_reclaimed(tmp_path):
+    """A trial that never reports is hard-killed at its time limit, and the
+    single device it held is re-leased to the next trial (which completes)."""
+    import jax
+
+    t0 = time.time()
+    analysis = tune.run(
+        sleeper_trainable,
+        {},
+        metric="validation_loss",
+        num_samples=2,
+        trial_executor="process",
+        time_limit_per_trial_s=4.0,
+        devices=jax.devices()[:1],  # one core: trial 2 needs trial 1's lease
+        storage_path=str(tmp_path),
+        verbose=0,
+    )
+    wedged = analysis.trials[0]
+    healthy = analysis.trials[1]
+    assert wedged.status == TrialStatus.ERROR
+    assert "time limit" in (wedged.error or "")
+    assert healthy.status == TrialStatus.TERMINATED
+    assert healthy.training_iteration == 2
+    assert time.time() - t0 < 120
+
+
+def test_killed_trial_retry_gets_fresh_clock(tmp_path):
+    """A time-limit kill follows the retry path, and the retry incarnation
+    is measured on its OWN clock — not instantly re-killed because total
+    runtime already exceeds the limit."""
+    analysis = tune.run(
+        flaky_sleeper,
+        {"marker": str(tmp_path / "wedged_once")},
+        metric="validation_loss",
+        num_samples=1,
+        trial_executor="process",
+        time_limit_per_trial_s=4.0,
+        max_failures=1,
+        storage_path=str(tmp_path),
+        verbose=0,
+    )
+    trial = analysis.trials[0]
+    assert trial.num_failures == 1
+    assert trial.status == TrialStatus.TERMINATED
+    assert trial.training_iteration == 2
+
+
+def test_soft_time_limit_thread_executor(tmp_path):
+    """Thread executor: the limit takes effect at the next report boundary
+    and the trial terminates gracefully (not ERROR)."""
+    analysis = tune.run(
+        slow_epochs_trainable,
+        {"num_epochs": 20},
+        metric="validation_loss",
+        num_samples=1,
+        time_limit_per_trial_s=1.0,
+        storage_path=str(tmp_path),
+        verbose=0,
+    )
+    trial = analysis.trials[0]
+    assert trial.status == TrialStatus.TERMINATED
+    assert 1 <= trial.training_iteration < 20
+
+
+def test_process_executor_real_jax_trial(tmp_path):
+    analysis = tune.run(
+        jax_trainable,
+        {
+            "model": "mlp",
+            "hidden_sizes": (8,),
+            "learning_rate": 0.01,
+            "num_epochs": 2,
+            "batch_size": 16,
+            "lr_schedule": "constant",
+        },
+        metric="validation_loss",
+        num_samples=1,
+        trial_executor="process",
+        storage_path=str(tmp_path),
+        verbose=0,
+    )
+    trial = analysis.trials[0]
+    assert trial.status == TrialStatus.TERMINATED
+    assert trial.training_iteration == 2
+    assert trial.last_result["validation_loss"] > 0
