@@ -246,4 +246,5 @@ class TrialLifecycle:
         now = time.time()
         trial.started_at = trial.started_at or now
         trial.restarted_at = now
+        trial.incarnation += 1
         trial.stop_requested = False

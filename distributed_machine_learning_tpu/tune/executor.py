@@ -152,13 +152,14 @@ class DeviceManager:
 
 
 class ResultEvent:
-    __slots__ = ("trial", "metrics", "decision", "done")
+    __slots__ = ("trial", "metrics", "decision", "done", "incarnation")
 
-    def __init__(self, trial: Trial, metrics: Dict):
+    def __init__(self, trial: Trial, metrics: Dict, incarnation: int = 0):
         self.trial = trial
         self.metrics = metrics
         self.decision = "continue"
         self.done = threading.Event()
+        self.incarnation = incarnation
 
 
 class ThreadTrialExecutor:
@@ -174,7 +175,7 @@ class ThreadTrialExecutor:
         trial.assigned_devices = leased_devices
         thread = threading.Thread(
             target=self._run,
-            args=(trial, trainable, devices),
+            args=(trial, trainable, devices, trial.incarnation),
             name=f"trial-{trial.trial_id}",
             daemon=True,
         )
@@ -186,11 +187,15 @@ class ThreadTrialExecutor:
         return t is not None and t.is_alive()
 
     def join_all(self, timeout: float = 5.0):
+        """Best-effort wait (shared deadline): daemon threads can't be
+        preempted, so a still-running trial is simply abandoned."""
+        deadline = time.time() + timeout
         for t in self._threads.values():
-            t.join(timeout=timeout)
+            t.join(timeout=max(deadline - time.time(), 0.0))
 
     # -- trial thread body ---------------------------------------------------
-    def _run(self, trial: Trial, trainable: Callable, devices: List):
+    def _run(self, trial: Trial, trainable: Callable, devices: List,
+             incarnation: int = 0):
         # Compile-time accounting: jit compiles triggered by this trial run on
         # this thread, so the tracker's per-thread counters are per-trial.
         tracker = get_tracker()
@@ -213,7 +218,7 @@ class ThreadTrialExecutor:
                 ckpt_lib.save_checkpoint(path, checkpoint)
                 trial.latest_checkpoint = path
                 trial.latest_checkpoint_iteration = count
-            event = ResultEvent(trial, metrics)
+            event = ResultEvent(trial, metrics, incarnation)
             self.events.put(("result", event))
             event.done.wait()
             return event.decision
@@ -229,11 +234,11 @@ class ThreadTrialExecutor:
                 f"trial:{trial.trial_id}"
             ):
                 trainable(dict(trial.config))
-            self.events.put(("complete", trial, None))
+            self.events.put(("complete", trial, None, incarnation))
         except (StopTrial, PauseTrial):
-            self.events.put(("complete", trial, None))
+            self.events.put(("complete", trial, None, incarnation))
         except BaseException:  # noqa: BLE001 - report crash to the runner
-            self.events.put(("error", trial, traceback.format_exc()))
+            self.events.put(("error", trial, traceback.format_exc(), incarnation))
         finally:
             set_session(None)
 
@@ -349,7 +354,7 @@ class ProcessTrialExecutor:
         # event loop.
         pump = threading.Thread(
             target=self._pump,
-            args=(trial, trainable, proc),
+            args=(trial, trainable, proc, trial.incarnation),
             name=f"trial-pump-{trial.trial_id}",
             daemon=True,
         )
@@ -381,14 +386,22 @@ class ProcessTrialExecutor:
         threading.Thread(target=_escalate, daemon=True).start()
 
     def join_all(self, timeout: float = 5.0):
-        for proc in self._procs.values():
+        """Terminate every still-running child, then wait for the pumps
+        (shared deadline).  Runner teardown calls this so an interrupted
+        sweep never leaves orphan trial processes holding devices."""
+        for proc in list(self._procs.values()):
             if proc.poll() is None:
                 proc.terminate()
-        for t in self._pumps.values():
-            t.join(timeout=timeout)
+        deadline = time.time() + timeout
+        for t in list(self._pumps.values()):
+            t.join(timeout=max(deadline - time.time(), 0.0))
+        for proc in list(self._procs.values()):
+            if proc.poll() is None:
+                proc.kill()
 
     # -- parent-side pump thread --------------------------------------------
-    def _pump(self, trial: Trial, trainable: Callable, proc: subprocess.Popen):
+    def _pump(self, trial: Trial, trainable: Callable, proc: subprocess.Popen,
+              incarnation: int = 0):
         from distributed_machine_learning_tpu.tune import _process_child as pc
 
         try:
@@ -423,24 +436,24 @@ class ProcessTrialExecutor:
                         ckpt_lib.save_checkpoint(path, pickle.loads(ckpt_bytes))
                         trial.latest_checkpoint = path
                         trial.latest_checkpoint_iteration = count
-                    event = ResultEvent(trial, metrics)
+                    event = ResultEvent(trial, metrics, incarnation)
                     self.events.put(("result", event))
                     event.done.wait()
                     pc.write_frame(proc.stdin, ("decision", event.decision))
                 elif kind == "complete":
-                    self.events.put(("complete", trial, None))
+                    self.events.put(("complete", trial, None, incarnation))
                     return
                 elif kind == "error":
-                    self.events.put(("error", trial, msg[1]))
+                    self.events.put(("error", trial, msg[1], incarnation))
                     return
         except (EOFError, OSError):
             reason = getattr(trial, "_kill_reason", None) or (
                 f"trial process died unexpectedly "
                 f"(rc={proc.poll()})"
             )
-            self.events.put(("error", trial, reason))
+            self.events.put(("error", trial, reason, incarnation))
         except Exception:  # noqa: BLE001 - e.g. unpicklable trainable
-            self.events.put(("error", trial, traceback.format_exc()))
+            self.events.put(("error", trial, traceback.format_exc(), incarnation))
         finally:
             try:
                 proc.stdin.close()

@@ -184,6 +184,39 @@ def run(
             device_mgr.release(leased)
 
     # -------- main event loop ------------------------------------------------
+    last_enforce = [0.0]
+
+    def enforce_time_limits():
+        """Hard preemption: a trial past its time limit that has gone quiet
+        (no report) is killed outright when the executor can (process
+        executor); the thread executor can only flag it for stop at its next
+        report.  Runs on EVERY loop iteration (rate-limited), not just idle
+        ones — a busy event stream must not starve enforcement."""
+        if time_limit_per_trial_s is None:
+            return
+        now = time.time()
+        if now - last_enforce[0] < 1.0:
+            return
+        last_enforce[0] = now
+        grace = max(2.0, 0.25 * time_limit_per_trial_s)
+        for tid in list(running):
+            trial = lifecycle.by_id[tid]
+            overdue = trial.incarnation_runtime_s() - time_limit_per_trial_s
+            if overdue <= grace or not executor.is_alive(trial):
+                continue
+            if getattr(executor, "supports_kill", False):
+                log(
+                    f"{trial.trial_id} exceeded time limit "
+                    f"({trial.incarnation_runtime_s():.0f}s > "
+                    f"{time_limit_per_trial_s:.0f}s); killing"
+                )
+                executor.kill(
+                    trial,
+                    f"time limit exceeded ({time_limit_per_trial_s:.0f}s)",
+                )
+            else:
+                trial.stop_requested = True
+
     def event_loop():
         nonlocal last_status_print
         while True:
@@ -201,6 +234,7 @@ def run(
                     break  # nothing to do at all
                 continue
 
+            enforce_time_limits()
             try:
                 event = events.get(timeout=0.5)
             except queue.Empty:
@@ -211,54 +245,35 @@ def run(
                         f"/{num_samples} done, {len(running)} running, "
                         f"{device_mgr.num_free}/{device_mgr.num_devices} cores free"
                     )
-                # Hard preemption: a trial past its time limit that has gone
-                # quiet (no report) is killed outright when the executor can
-                # (process executor); the thread executor can only flag it
-                # for stop at its next report.
-                if time_limit_per_trial_s is not None:
-                    grace = max(2.0, 0.25 * time_limit_per_trial_s)
-                    for tid in list(running):
-                        trial = lifecycle.by_id[tid]
-                        overdue = (
-                            trial.incarnation_runtime_s() - time_limit_per_trial_s
-                        )
-                        if overdue <= grace or not executor.is_alive(trial):
-                            continue
-                        if getattr(executor, "supports_kill", False):
-                            log(
-                                f"{trial.trial_id} exceeded time limit "
-                                f"({trial.incarnation_runtime_s():.0f}s > "
-                                f"{time_limit_per_trial_s:.0f}s); killing"
-                            )
-                            executor.kill(
-                                trial,
-                                f"time limit exceeded "
-                                f"({time_limit_per_trial_s:.0f}s)",
-                            )
-                        else:
-                            trial.stop_requested = True
-                # Reap threads that died without reporting (shouldn't happen).
+                # Reap trials whose executor died without a terminal event
+                # (shouldn't happen: both executors post one on every path).
+                # Routed through fail_trial so the retry budget and error
+                # reporting behave exactly like an ordinary trial error.
                 for tid in list(running):
                     trial = lifecycle.by_id[tid]
                     if not executor.is_alive(trial):
+                        why = "trial executor died without reporting"
+                        safe_cb("on_trial_error", trial, why)
                         release_devices(trial)
-                        lifecycle.finish(trial, TrialStatus.ERROR)
-                        safe_cb(
-                            "on_trial_error",
-                            trial,
-                            "trial thread died without reporting",
-                        )
+                        lifecycle.fail_trial(trial, why)
                 safe_cb("on_heartbeat")
                 continue
 
             kind = event[0]
-            # Stale-event guard: the heartbeat reaper may have already
-            # finished a trial whose executor posted its terminal event in
-            # the same instant (kill/EOF race).  Events for trials no longer
-            # in ``running`` must not be double-processed — a second
-            # finish/fail would requeue an already-terminal trial.
-            ev_trial = event[1].trial if kind == "result" else event[1]
-            if ev_trial.trial_id not in running:
+            # Stale-event guard: a dead incarnation's late events (kill/EOF
+            # races, reaped trials) must not be applied — especially not to
+            # a relaunched retry of the same trial.  Anything whose
+            # incarnation tag doesn't match the trial's current incarnation,
+            # or whose trial is no longer running, is dropped.
+            if kind == "result":
+                ev_trial, ev_inc = event[1].trial, event[1].incarnation
+            else:
+                ev_trial = event[1]
+                ev_inc = event[3] if len(event) > 3 else ev_trial.incarnation
+            if (
+                ev_trial.trial_id not in running
+                or ev_inc != ev_trial.incarnation
+            ):
                 if kind == "result":
                     event[1].decision = "stop"
                     event[1].done.set()
@@ -301,6 +316,13 @@ def run(
             cb.setup(store.root, metric, mode)
         event_loop()
     finally:
+        # Tear the executor down FIRST: an interrupted sweep must not leave
+        # orphan trial processes holding devices (process executor terminates
+        # children; thread executor best-effort joins).
+        try:
+            executor.join_all(timeout=5.0)
+        except Exception as exc:  # noqa: BLE001
+            log(f"executor teardown failed: {exc!r}")
         wall = time.time() - start_time
         utilization = device_mgr.utilization(wall)
         from distributed_machine_learning_tpu.utils import compile_cache as cc

@@ -70,6 +70,10 @@ class Trial:
     # budget gate, ASHA rungs) mix incompatible units after any respawn.
     restore_base: int = 0  # progress at the last (re)start
     reports_since_restart: int = 0
+    # Monotone (re)start counter.  Executor events are tagged with the
+    # incarnation that produced them so the runner can drop a dead
+    # incarnation's late events instead of applying them to a retry.
+    incarnation: int = 0
 
     # Runtime bookkeeping.  ``started_at`` is the FIRST start (total-runtime
     # accounting); ``restarted_at`` is the current incarnation's start —

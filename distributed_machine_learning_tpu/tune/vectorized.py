@@ -24,11 +24,19 @@ chunk granularity rather than trial granularity.
 
 Scheduler semantics: per-epoch results are streamed trial-by-trial through the
 scheduler exactly as the threaded runner does, so ASHA/median-stopping decide
-on the same rung statistics.  A stopped trial's subsequent results are simply
-discarded — trials advance in lockstep, so early stopping saves reporting, not
-FLOPs.  Sweeps that want the FLOP savings of ASHA should use ``tune.run``;
-sweeps that want maximum trials/hour on few chips should use this.  PBT
-(REQUEUE) is not supported here.
+on the same rung statistics.  Early stopping saves real FLOPs here too: when
+survivors drop to half the population, the population is **compacted** —
+stopped trials' rows are sliced out of the vmapped param/optimizer pytrees
+and the remaining trials continue as a smaller program.  Compaction points
+are halving boundaries, so a K-trial group compiles at most log2(K) distinct
+population sizes (each cached by jit and the persistent compile cache).
+Because each new size means an XLA recompile, ``compaction="auto"`` (the
+default) applies a measured cost model — compact only when
+``remaining_epochs x epoch_exec_time x shrink_fraction`` exceeds the
+observed compile cost — so a cold compile cache never turns the FLOP saving
+into a wall-clock loss ("always"/"never" override it).  Per-trial PRNG keys
+travel with their rows, so a surviving trial's trajectory is independent of
+who else is still in the population.  PBT (REQUEUE) is not supported here.
 
 The jittable program bodies are shared with the per-trial trainable via
 ``tune/_regression_program.py``.
@@ -229,6 +237,7 @@ def run_vectorized(
     device=None,
     verbose: int = 1,
     compile_cache_dir: Optional[str] = "auto",
+    compaction: str = "auto",
 ) -> ExperimentAnalysis:
     """Run an HPO sweep with trials batched into vmapped populations.
 
@@ -248,6 +257,10 @@ def run_vectorized(
             None if compile_cache_dir == "auto" else compile_cache_dir
         )
     tracker = cc.get_tracker()
+    if compaction not in ("auto", "always", "never"):
+        raise ValueError(
+            f"compaction must be 'auto', 'always' or 'never', got {compaction!r}"
+        )
     space = (
         param_space if isinstance(param_space, SearchSpace)
         else SearchSpace(param_space)
@@ -279,6 +292,7 @@ def run_vectorized(
     programs: Dict[Tuple, _GroupProgram] = {}
     next_index = 0
     exhausted = False
+    row_epochs = 0  # trial-epochs actually computed (compaction shrinks this)
 
     with jax.default_device(device):
         # Chunked suggest->train loop: adaptive searchers observe all results
@@ -314,8 +328,9 @@ def run_vectorized(
                     )
                 compile_before = tracker.thread_seconds()
                 t_pop = time.time()
-                _run_population(
-                    program, members, sched, searcher, store, metric, mode, log
+                row_epochs += _run_population(
+                    program, members, sched, searcher, store, metric, mode,
+                    log, tracker, compaction,
                 )
                 compile_s = tracker.thread_seconds() - compile_before
                 if compile_s > 0.05:
@@ -333,6 +348,7 @@ def run_vectorized(
             "wall_clock_s": wall,
             "device_utilization": 1.0,
             "vectorized": True,
+            "row_epochs_computed": row_epochs,
             "compile_time_total_s": round(tracker.total_seconds(), 3),
             "compile_cache_hits": tracker.total_cache_hits(),
             "compile_cache_entries": cc.cache_entry_count(),
@@ -359,8 +375,13 @@ def _run_population(
     metric: str,
     mode: str,
     log,
-):
-    """Train one population of K same-shape trials to completion."""
+    tracker,
+    compaction: str = "auto",
+) -> int:
+    """Train one population of K same-shape trials to completion.
+
+    Returns the number of trial-epochs actually computed (rows x epochs),
+    the honest FLOP-cost denominator under compaction."""
     k = len(batch)
     now = time.time()
     for t in batch:
@@ -383,10 +404,20 @@ def _run_population(
 
     data = program.data
     active = [True] * k
+    # ``rows[i]`` = index into ``batch`` of the trial living at population
+    # row i.  Compaction slices stopped rows out of the pytrees and shrinks
+    # this mapping; everything per-trial (keys, lr/wd, records) is looked up
+    # through it.
+    rows = list(range(k))
+    row_epochs = 0
+    exec_ema = None  # measured per-epoch execute seconds at the current size
+    compile_cost_s = None  # most recent substantial compile observed
     for epoch in range(program.num_epochs):
         epoch_keys = jax.vmap(lambda key: jax.random.fold_in(key, epoch))(
             base_keys
         )
+        c0 = tracker.thread_seconds()
+        t0 = time.time()
         params, opt_state, batch_stats, train_losses = program.train_epoch(
             params, opt_state, batch_stats, data.x_train, data.y_train,
             epoch_keys,
@@ -395,7 +426,15 @@ def _run_population(
             params, batch_stats, data.x_val, data.y_val, data.val_mask
         )
         train_losses = np.asarray(train_losses)
+        # Materialize eval BEFORE reading the clocks: eval execution is part
+        # of the per-epoch cost the compaction model weighs.
         metrics_np = {key: np.asarray(v) for key, v in metrics_k.items()}
+        compile_delta = tracker.thread_seconds() - c0
+        exec_s = max(time.time() - t0 - compile_delta, 0.0)
+        if compile_delta > 0.05:
+            compile_cost_s = compile_delta
+        exec_ema = exec_s if exec_ema is None else 0.5 * (exec_ema + exec_s)
+        row_epochs += len(rows)
         step_count = (epoch + 1) * program.steps_per_epoch
         # Trial-independent: evaluate once per epoch, not once per trial.
         shape_val = float(
@@ -403,18 +442,20 @@ def _run_population(
         )
         now = time.time()
 
-        for i, trial in enumerate(batch):
-            if not active[i]:
+        for i, r in enumerate(rows):
+            trial = batch[r]
+            if not active[r]:
                 continue
             record = {
                 "epoch": epoch,
                 "training_iteration": epoch + 1,
                 "train_loss": float(train_losses[i]),
                 "steps": step_count,
-                "lr": float(lrs[i]) * shape_val,
+                "lr": float(lrs[r]) * shape_val,
                 "trial_id": trial.trial_id,
                 "timestamp": now,
                 "time_total_s": now - trial.started_at,
+                "population_size": len(rows),
                 **{key: float(v[i]) for key, v in metrics_np.items()},
             }
             trial.results.append(record)
@@ -429,16 +470,46 @@ def _run_population(
                     "mode; use tune.run for population-based training"
                 )
             if decision == STOP:
-                active[i] = False
+                active[r] = False
                 trial.status = TrialStatus.TERMINATED
                 trial.finished_at = time.time()
                 sched.on_trial_complete(trial)
                 searcher.on_trial_complete(
                     trial.trial_id, trial.config, trial.last_result, metric, mode
                 )
-        if not any(active):
+        if not any(active[r] for r in rows):
             log(f"population fully early-stopped at epoch {epoch}")
             break
+
+        # Compaction: once survivors fit in half the rows, slice them out and
+        # continue as a smaller vmapped program (halving boundaries bound the
+        # number of distinct compiled population sizes to log2(K)).  A new
+        # size means an XLA recompile, so "auto" only compacts when the
+        # measured epoch savings outweigh the measured compile cost.
+        pos = [i for i, r in enumerate(rows) if active[r]]
+        remaining = program.num_epochs - epoch - 1
+        if compaction != "never" and remaining > 0 and len(pos) <= len(rows) // 2:
+            if compaction == "always":
+                worth_it = True
+            else:
+                saved_s = (
+                    remaining * (exec_ema or 0.0) * (1.0 - len(pos) / len(rows))
+                )
+                # No compile observed yet (everything cache-hit) -> treat the
+                # recompile as ~free; otherwise require the savings to beat
+                # the last compile actually paid.
+                worth_it = saved_s > (compile_cost_s or 0.0)
+            if worth_it:
+                sel = jnp.asarray(pos)
+                params, opt_state, batch_stats = jax.tree.map(
+                    lambda a: a[sel], (params, opt_state, batch_stats)
+                )
+                base_keys = base_keys[sel]
+                rows = [rows[i] for i in pos]
+                log(
+                    f"compacted population -> {len(rows)} survivors at epoch "
+                    f"{epoch} (FLOPs now scale with survivors)"
+                )
 
     now = time.time()
     for i, trial in enumerate(batch):
@@ -449,3 +520,4 @@ def _run_population(
             searcher.on_trial_complete(
                 trial.trial_id, trial.config, trial.last_result, metric, mode
             )
+    return row_epochs

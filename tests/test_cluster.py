@@ -184,18 +184,25 @@ def test_worker_death_requeues_trials(tmp_path):
     result = {}
 
     def drive():
-        result["analysis"] = run_distributed(
-            "cluster_trainables:slow_trial",
-            {"epochs": 10, "sleep_s": 0.2},
-            metric="loss",
-            mode="min",
-            num_samples=4,
-            workers=addrs,
-            max_failures=3,
-            storage_path=str(tmp_path),
-            name="dist_death",
-            verbose=0,
-        )
+        # Capture, don't swallow: a raise in here must fail the test with
+        # ITS traceback, not an opaque KeyError on the result dict.
+        try:
+            result["analysis"] = run_distributed(
+                "cluster_trainables:slow_trial",
+                {"epochs": 10, "sleep_s": 0.2},
+                metric="loss",
+                mode="min",
+                num_samples=4,
+                workers=addrs,
+                max_failures=3,
+                storage_path=str(tmp_path),
+                name="dist_death",
+                verbose=0,
+            )
+        except BaseException:
+            import traceback
+
+            result["error"] = traceback.format_exc()
 
     # All 4 trials land immediately (2 slots x 2 workers); killing one worker
     # mid-flight forces its 2 trials to requeue onto the survivor.
@@ -205,6 +212,7 @@ def test_worker_death_requeues_trials(tmp_path):
     procs[0].kill()
     t.join(timeout=120)
     assert not t.is_alive(), "driver hung after worker death"
+    assert "error" not in result, f"run_distributed raised:\n{result['error']}"
     analysis = result["analysis"]
     done = analysis.num_terminated()
     assert done == 4, f"only {done}/4 trials finished after worker death"

@@ -143,6 +143,69 @@ def test_vectorized_asha_early_stops(tiny_data, tmp_path):
     assert lengths[-1] == 6  # somebody survived to the end
 
 
+def test_vectorized_compaction_shrinks_population(tiny_data, tmp_path):
+    """ASHA stops trials -> the vmapped population is compacted, so later
+    epochs run with fewer rows (real FLOP savings, not just discarded
+    reports) while survivors' trajectories are unaffected."""
+    train, val = tiny_data
+    space = dict(MLP_SPACE, num_epochs=8)
+    asha = run_vectorized(
+        space, train_data=train, val_data=val,
+        metric="validation_mse", mode="min", num_samples=8,
+        scheduler=tune.ASHAScheduler(
+            max_t=8, grace_period=1, reduction_factor=2
+        ),
+        storage_path=str(tmp_path), name="compact", seed=7, verbose=0,
+        compaction="always",
+    )
+    assert asha.num_terminated() == 8
+    survivor = max(asha.trials, key=lambda t: len(t.results))
+    sizes = [r["population_size"] for r in survivor.results]
+    assert sizes[0] == 8
+    assert sizes[-1] < 8  # population actually shrank
+    assert sizes == sorted(sizes, reverse=True)  # monotone non-increasing
+
+    # Trajectory independence: the same config/seed in a FIFO run (no
+    # compaction, full population throughout) lands at the same loss.
+    fifo = run_vectorized(
+        space, train_data=train, val_data=val,
+        metric="validation_mse", mode="min", num_samples=8,
+        storage_path=str(tmp_path), name="nocompact", seed=7, verbose=0,
+    )
+    fifo_twin = next(
+        t for t in fifo.trials if t.config == survivor.config
+    )
+    a = survivor.results[-1]["validation_mse"]
+    b = fifo_twin.results[len(survivor.results) - 1]["validation_mse"]
+    assert a == pytest.approx(b, rel=1e-3), (a, b)
+
+    # Honest FLOP accounting: compaction computed fewer trial-epochs than
+    # the no-compaction run.
+    import json, os
+
+    asha_state = json.load(
+        open(os.path.join(asha.root, "experiment_state.json"))
+    )
+    fifo_state = json.load(
+        open(os.path.join(fifo.root, "experiment_state.json"))
+    )
+    assert asha_state["row_epochs_computed"] < fifo_state["row_epochs_computed"]
+
+
+def test_vectorized_compaction_never(tiny_data, tmp_path):
+    train, val = tiny_data
+    analysis = run_vectorized(
+        dict(MLP_SPACE, num_epochs=6), train_data=train, val_data=val,
+        metric="validation_mse", mode="min", num_samples=8,
+        scheduler=tune.ASHAScheduler(
+            max_t=6, grace_period=1, reduction_factor=2
+        ),
+        storage_path=str(tmp_path), seed=7, verbose=0, compaction="never",
+    )
+    survivor = max(analysis.trials, key=lambda t: len(t.results))
+    assert all(r["population_size"] == 8 for r in survivor.results)
+
+
 def test_vectorized_rejects_pbt(tiny_data, tmp_path):
     train, val = tiny_data
     with pytest.raises(ValueError, match="vectorized"):
