@@ -398,6 +398,10 @@ class ProcessTrialExecutor:
         for proc in list(self._procs.values()):
             if proc.poll() is None:
                 proc.kill()
+                try:
+                    proc.wait(timeout=5.0)  # reap — no zombies, chips freed
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    pass
 
     # -- parent-side pump thread --------------------------------------------
     def _pump(self, trial: Trial, trainable: Callable, proc: subprocess.Popen,

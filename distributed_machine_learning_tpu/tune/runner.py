@@ -316,14 +316,15 @@ def run(
             cb.setup(store.root, metric, mode)
         event_loop()
     finally:
-        # Tear the executor down FIRST: an interrupted sweep must not leave
-        # orphan trial processes holding devices (process executor terminates
-        # children; thread executor best-effort joins).
+        # Clock first (teardown time is not experiment time), then tear the
+        # executor down: an interrupted sweep must not leave orphan trial
+        # processes holding devices (process executor terminates children;
+        # thread executor best-effort joins).
+        wall = time.time() - start_time
         try:
             executor.join_all(timeout=5.0)
         except Exception as exc:  # noqa: BLE001
             log(f"executor teardown failed: {exc!r}")
-        wall = time.time() - start_time
         utilization = device_mgr.utilization(wall)
         from distributed_machine_learning_tpu.utils import compile_cache as cc
 

@@ -257,6 +257,7 @@ def run_vectorized(
             None if compile_cache_dir == "auto" else compile_cache_dir
         )
     tracker = cc.get_tracker()
+    compile_s_at_start = tracker.total_seconds()
     if compaction not in ("auto", "always", "never"):
         raise ValueError(
             f"compaction must be 'auto', 'always' or 'never', got {compaction!r}"
@@ -288,6 +289,12 @@ def run_vectorized(
             print(f"[tune.vectorized] {msg}", flush=True)
 
     device = device or jax.devices()[0]
+    # Population sizes stay multiples of 8 on accelerators: the sublane-
+    # aligned sizes are the ones XLA:TPU tiles cleanly (empirically, this
+    # backend kernel-faults on some ragged population sizes — 25/26/28 crash
+    # while 8/16/24/32/40/50 run; aligned targets sidestep the fault and
+    # tile better anyway).
+    size_multiple = 1 if device.platform == "cpu" else 8
     trials: List[Trial] = []
     programs: Dict[Tuple, _GroupProgram] = {}
     next_index = 0
@@ -330,7 +337,7 @@ def run_vectorized(
                 t_pop = time.time()
                 row_epochs += _run_population(
                     program, members, sched, searcher, store, metric, mode,
-                    log, tracker, compaction,
+                    log, tracker, compaction, size_multiple,
                 )
                 compile_s = tracker.thread_seconds() - compile_before
                 if compile_s > 0.05:
@@ -349,7 +356,10 @@ def run_vectorized(
             "device_utilization": 1.0,
             "vectorized": True,
             "row_epochs_computed": row_epochs,
-            "compile_time_total_s": round(tracker.total_seconds(), 3),
+            # This RUN's compile seconds (tracker counts are process-wide).
+            "compile_time_total_s": round(
+                tracker.total_seconds() - compile_s_at_start, 3
+            ),
             "compile_cache_hits": tracker.total_cache_hits(),
             "compile_cache_entries": cc.cache_entry_count(),
         },
@@ -377,6 +387,7 @@ def _run_population(
     log,
     tracker,
     compaction: str = "auto",
+    size_multiple: int = 1,
 ) -> int:
     """Train one population of K same-shape trials to completion.
 
@@ -397,6 +408,16 @@ def _run_population(
     wds = np.asarray(
         [float(t.config.get("weight_decay", 0.0)) for t in batch], np.float32
     )
+    # Pad the population up to the platform's size multiple with dummy rows
+    # (row 0's hyperparams, distinct seeds).  On TPU the sublane padding
+    # makes these rows nearly free, and aligned sizes avoid the backend's
+    # ragged-size kernel fault (see run_vectorized).
+    pad_rows = (-k) % size_multiple
+    if pad_rows:
+        seeds = np.concatenate([seeds, seeds[:1] + 1 + np.arange(pad_rows,
+                                dtype=np.uint32) * 7919])
+        lrs = np.concatenate([lrs, np.repeat(lrs[:1], pad_rows)])
+        wds = np.concatenate([wds, np.repeat(wds[:1], pad_rows)])
     base_keys = jax.vmap(jax.random.key)(jnp.asarray(seeds))
     params, opt_state, batch_stats = program.init_population(
         base_keys, jnp.asarray(lrs), jnp.asarray(wds)
@@ -405,10 +426,10 @@ def _run_population(
     data = program.data
     active = [True] * k
     # ``rows[i]`` = index into ``batch`` of the trial living at population
-    # row i.  Compaction slices stopped rows out of the pytrees and shrinks
-    # this mapping; everything per-trial (keys, lr/wd, records) is looked up
-    # through it.
-    rows = list(range(k))
+    # row i (-1 for dummy pad rows, which are never reported).  Compaction
+    # slices stopped rows out of the pytrees and shrinks this mapping;
+    # everything per-trial (keys, lr/wd, records) is looked up through it.
+    rows = list(range(k)) + [-1] * pad_rows
     row_epochs = 0
     exec_ema = None  # measured per-epoch execute seconds at the current size
     compile_cost_s = None  # most recent substantial compile observed
@@ -443,6 +464,8 @@ def _run_population(
         now = time.time()
 
         for i, r in enumerate(rows):
+            if r < 0:  # dummy pad row
+                continue
             trial = batch[r]
             if not active[r]:
                 continue
@@ -477,7 +500,7 @@ def _run_population(
                 searcher.on_trial_complete(
                     trial.trial_id, trial.config, trial.last_result, metric, mode
                 )
-        if not any(active[r] for r in rows):
+        if not any(active[r] for r in rows if r >= 0):
             log(f"population fully early-stopped at epoch {epoch}")
             break
 
@@ -486,29 +509,41 @@ def _run_population(
         # number of distinct compiled population sizes to log2(K)).  A new
         # size means an XLA recompile, so "auto" only compacts when the
         # measured epoch savings outweigh the measured compile cost.
-        pos = [i for i, r in enumerate(rows) if active[r]]
+        pos = [i for i, r in enumerate(rows) if r >= 0 and active[r]]
         remaining = program.num_epochs - epoch - 1
-        if compaction != "never" and remaining > 0 and len(pos) <= len(rows) // 2:
+        target = len(rows) // 2
+        if size_multiple > 1:
+            target = (target // size_multiple) * size_multiple
+        if compaction != "never" and remaining > 0 and 0 < len(pos) <= target:
             if compaction == "always":
                 worth_it = True
             else:
-                saved_s = (
-                    remaining * (exec_ema or 0.0) * (1.0 - len(pos) / len(rows))
+                saved_s = remaining * (exec_ema or 0.0) * 0.5
+                # Price the recompile pessimistically: the HALVED size may
+                # never have been compiled anywhere, so use the worst single
+                # backend compile this process has paid (not just the last
+                # delta, which is ~0 after a persistent-cache hit).
+                cost_s = max(
+                    compile_cost_s or 0.0, tracker.max_backend_compile_s()
                 )
-                # No compile observed yet (everything cache-hit) -> treat the
-                # recompile as ~free; otherwise require the savings to beat
-                # the last compile actually paid.
-                worth_it = saved_s > (compile_cost_s or 0.0)
+                worth_it = saved_s > cost_s
             if worth_it:
-                sel = jnp.asarray(pos)
+                # Compact to EXACTLY half (padding with already-stopped rows
+                # if survivors undershoot): sizes walk the fixed ladder
+                # K, K/2, K/4, ..., so every sweep with the same K reuses the
+                # same compiled programs — across chunks AND across runs via
+                # the persistent cache.
+                pad = [i for i in range(len(rows)) if i not in set(pos)]
+                keep = sorted(pos + pad[: target - len(pos)])
+                sel = jnp.asarray(keep)
                 params, opt_state, batch_stats = jax.tree.map(
                     lambda a: a[sel], (params, opt_state, batch_stats)
                 )
                 base_keys = base_keys[sel]
-                rows = [rows[i] for i in pos]
+                rows = [rows[i] for i in keep]
                 log(
-                    f"compacted population -> {len(rows)} survivors at epoch "
-                    f"{epoch} (FLOPs now scale with survivors)"
+                    f"compacted population -> {len(rows)} rows "
+                    f"({len(pos)} live) at epoch {epoch}"
                 )
 
     now = time.time()

@@ -102,6 +102,7 @@ class CompileTimeTracker:
         self._seconds: Dict[int, float] = {}
         self._hits: Dict[int, int] = {}
         self._backend_seconds: Dict[int, float] = {}
+        self._max_backend_s: float = 0.0
 
     # -- listener callbacks (run on the compiling thread) -------------------
 
@@ -115,6 +116,7 @@ class CompileTimeTracker:
                 self._backend_seconds[ident] = (
                     self._backend_seconds.get(ident, 0.0) + duration
                 )
+                self._max_backend_s = max(self._max_backend_s, duration)
 
     def _on_event(self, event: str, **_kw):
         if event != _CACHE_HIT_EVENT:
@@ -150,6 +152,12 @@ class CompileTimeTracker:
     def total_cache_hits(self) -> int:
         with self._lock:
             return sum(self._hits.values())
+
+    def max_backend_compile_s(self) -> float:
+        """Longest single XLA backend compile seen in this process — the
+        pessimistic price of compiling a program no cache has seen."""
+        with self._lock:
+            return self._max_backend_s
 
 
 _tracker: Optional[CompileTimeTracker] = None
