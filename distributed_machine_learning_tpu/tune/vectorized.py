@@ -153,7 +153,7 @@ class _GroupProgram:
     """The vmapped init/train/eval programs for one static-signature group."""
 
     def __init__(self, static_cfg: Dict[str, Any], train_data: Dataset,
-                 val_data: Dataset):
+                 val_data: Dataset, pop_sharding=None):
         cfg = static_cfg
         self.loss_name = str(cfg.get("loss_function", "mse"))
         self.num_epochs = int(cfg.get("num_epochs", 20))
@@ -209,7 +209,13 @@ class _GroupProgram:
             forward, self.loss_name, data.n_val_blocks, data.eval_bs
         )
 
-        self.init_population = jax.jit(jax.vmap(init_one))
+        # With a population mesh, init materializes DIRECTLY in the sharded
+        # layout — device 0 never has to hold (or scatter) the whole
+        # population's params/optimizer state.
+        self.init_population = jax.jit(
+            jax.vmap(init_one),
+            out_shardings=None if pop_sharding is None else pop_sharding,
+        )
         # Data is shared across the population: in_axes=None for x/y.
         self.train_epoch = jax.jit(
             jax.vmap(epoch_one, in_axes=(0, 0, 0, None, None, 0)),
@@ -235,6 +241,7 @@ def run_vectorized(
     name: Optional[str] = None,
     seed: int = 0,
     device=None,
+    devices: Optional[List] = None,
     verbose: int = 1,
     compile_cache_dir: Optional[str] = "auto",
     compaction: str = "auto",
@@ -244,7 +251,19 @@ def run_vectorized(
     Same observable contract as ``tune.run`` (per-epoch results with
     ``training_iteration``/``time_total_s``, experiment store on disk,
     ``ExperimentAnalysis`` with ``best_config``) but executed as one program
-    per static-signature group per chunk, on a single device.
+    per static-signature group per chunk.
+
+    ``devices``: pass >1 devices (this process's — e.g.
+    ``jax.local_devices()``) to shard the POPULATION AXIS over a 1-D
+    ``jax.sharding.Mesh`` — trials are independent, so XLA partitions the
+    vmapped program with zero cross-device communication and N chips train
+    N slices of the population in parallel.  The BASELINE.md "256 concurrent
+    trials on v5e-256" shape is one such sweep per pod host over its local
+    chips (cross-host needs no collectives either; coordination above that
+    is ``tune.cluster``'s job).  Data is replicated; population sizes are
+    padded to a multiple of ``n_devices`` (x8 sublane alignment on TPU), so
+    keep ``max_batch_trials >= size multiple`` or dummy pad rows dominate.
+    ``device``: run on one explicit device (mutually exclusive).
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
@@ -261,6 +280,18 @@ def run_vectorized(
     if compaction not in ("auto", "always", "never"):
         raise ValueError(
             f"compaction must be 'auto', 'always' or 'never', got {compaction!r}"
+        )
+    if device is not None and devices:
+        raise ValueError("pass either device or devices, not both")
+    if devices and any(
+        d.process_index != jax.process_index() for d in devices
+    ):
+        raise ValueError(
+            "run_vectorized shards the population over devices addressable "
+            "by THIS process; for a multi-host pod run one run_vectorized "
+            "per host over jax.local_devices() (population sharding needs "
+            "no cross-host collectives), or use tune.cluster for a "
+            "driver/worker topology"
         )
     space = (
         param_space if isinstance(param_space, SearchSpace)
@@ -288,13 +319,26 @@ def run_vectorized(
         if verbose:
             print(f"[tune.vectorized] {msg}", flush=True)
 
+    mesh = pop_sharding = repl_sharding = None
+    if devices and len(devices) > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.asarray(devices), ("pop",))
+        pop_sharding = NamedSharding(mesh, P("pop"))
+        repl_sharding = NamedSharding(mesh, P())
+        device = devices[0]
+    elif devices:
+        device = devices[0]
     device = device or jax.devices()[0]
     # Population sizes stay multiples of 8 on accelerators: the sublane-
     # aligned sizes are the ones XLA:TPU tiles cleanly (empirically, this
     # backend kernel-faults on some ragged population sizes — 25/26/28 crash
     # while 8/16/24/32/40/50 run; aligned targets sidestep the fault and
-    # tile better anyway).
+    # tile better anyway).  With a mesh, sizes must also divide evenly over
+    # the population axis.
     size_multiple = 1 if device.platform == "cpu" else 8
+    if mesh is not None:
+        size_multiple *= len(devices)
     trials: List[Trial] = []
     programs: Dict[Tuple, _GroupProgram] = {}
     next_index = 0
@@ -331,13 +375,15 @@ def run_vectorized(
                 program = programs.get(sig)
                 if program is None:
                     program = programs[sig] = _GroupProgram(
-                        dict(members[0].config), train_data, val_data
+                        dict(members[0].config), train_data, val_data,
+                        pop_sharding,
                     )
                 compile_before = tracker.thread_seconds()
                 t_pop = time.time()
                 row_epochs += _run_population(
                     program, members, sched, searcher, store, metric, mode,
                     log, tracker, compaction, size_multiple,
+                    pop_sharding, repl_sharding,
                 )
                 compile_s = tracker.thread_seconds() - compile_before
                 if compile_s > 0.05:
@@ -356,6 +402,7 @@ def run_vectorized(
             "device_utilization": 1.0,
             "vectorized": True,
             "row_epochs_computed": row_epochs,
+            "population_sharded_over": len(devices) if mesh is not None else 1,
             # This RUN's compile seconds (tracker counts are process-wide).
             "compile_time_total_s": round(
                 tracker.total_seconds() - compile_s_at_start, 3
@@ -388,6 +435,8 @@ def _run_population(
     tracker,
     compaction: str = "auto",
     size_multiple: int = 1,
+    pop_sharding=None,
+    repl_sharding=None,
 ) -> int:
     """Train one population of K same-shape trials to completion.
 
@@ -414,6 +463,12 @@ def _run_population(
     # ragged-size kernel fault (see run_vectorized).
     pad_rows = (-k) % size_multiple
     if pad_rows:
+        if pad_rows >= k:
+            log(
+                f"population of {k} padded to {k + pad_rows} for size "
+                f"alignment — most rows are dummies; use chunks of at "
+                f"least {size_multiple} trials to avoid the waste"
+            )
         seeds = np.concatenate([seeds, seeds[:1] + 1 + np.arange(pad_rows,
                                 dtype=np.uint32) * 7919])
         lrs = np.concatenate([lrs, np.repeat(lrs[:1], pad_rows)])
@@ -422,6 +477,17 @@ def _run_population(
     params, opt_state, batch_stats = program.init_population(
         base_keys, jnp.asarray(lrs), jnp.asarray(wds)
     )
+    if pop_sharding is not None:
+        # init_population already materialized params/opt_state sharded over
+        # the mesh (out_shardings); keys are tiny, so placing them too just
+        # saves XLA a reshard in the first epoch.
+        base_keys = jax.device_put(base_keys, pop_sharding)
+        if not getattr(program, "_data_replicated", False):
+            d = program.data
+            for field in ("x_train", "y_train", "x_val", "y_val", "val_mask"):
+                setattr(d, field, jax.device_put(getattr(d, field),
+                                                 repl_sharding))
+            program._data_replicated = True
 
     data = program.data
     active = [True] * k
@@ -540,6 +606,11 @@ def _run_population(
                     lambda a: a[sel], (params, opt_state, batch_stats)
                 )
                 base_keys = base_keys[sel]
+                if pop_sharding is not None:
+                    params, opt_state, batch_stats, base_keys = jax.device_put(
+                        (params, opt_state, batch_stats, base_keys),
+                        pop_sharding,
+                    )
                 rows = [rows[i] for i in keep]
                 log(
                     f"compacted population -> {len(rows)} rows "

@@ -1,0 +1,109 @@
+"""Population-sharded vectorized HPO: the population axis over a device mesh.
+
+The BASELINE.md north-star shape ("256 concurrent trials on v5e-256"):
+trials are independent, so sharding the vmapped population axis over a 1-D
+mesh partitions the program with zero collectives.  Verified here on the
+8-virtual-device CPU mesh (SURVEY.md §4 fake-cluster strategy).
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu import tune
+from distributed_machine_learning_tpu.data import Dataset
+from distributed_machine_learning_tpu.tune.vectorized import run_vectorized
+
+
+@pytest.fixture(scope="module")
+def tiny_data():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(128, 8, 4)).astype(np.float32)
+    w = rng.normal(size=(4,)).astype(np.float32)
+    y = (x.mean(axis=1) @ w)[:, None].astype(np.float32)
+    return Dataset(x[:96], y[:96]), Dataset(x[96:], y[96:])
+
+
+SPACE = {
+    "model": "mlp",
+    "hidden_sizes": (16, 8),
+    "learning_rate": tune.loguniform(1e-3, 1e-1),
+    "weight_decay": tune.loguniform(1e-6, 1e-3),
+    "seed": tune.randint(0, 10_000),
+    "num_epochs": 3,
+    "batch_size": 16,
+    "loss_function": "mse",
+}
+
+
+def test_sharded_population_completes_and_records_mesh(tiny_data, tmp_path):
+    train, val = tiny_data
+    analysis = run_vectorized(
+        SPACE, train_data=train, val_data=val,
+        metric="validation_mse", mode="min", num_samples=16,
+        devices=jax.devices(),  # 8 virtual CPU devices -> pop sharded 8-way
+        storage_path=str(tmp_path), name="sharded16", seed=5, verbose=0,
+    )
+    assert analysis.num_terminated() == 16
+    state = json.load(
+        open(os.path.join(analysis.root, "experiment_state.json"))
+    )
+    assert state["population_sharded_over"] == 8
+    for t in analysis.trials:
+        assert np.isfinite(t.results[-1]["validation_mse"])
+
+
+def test_sharded_matches_single_device(tiny_data, tmp_path):
+    """Sharding the population must not change any trial's trajectory."""
+    train, val = tiny_data
+    kw = dict(
+        train_data=train, val_data=val, metric="validation_mse", mode="min",
+        num_samples=8, seed=11, verbose=0,
+    )
+    sharded = run_vectorized(
+        SPACE, devices=jax.devices(),
+        storage_path=str(tmp_path / "s"), **kw,
+    )
+    single = run_vectorized(
+        SPACE, device=jax.devices()[0],
+        storage_path=str(tmp_path / "u"), **kw,
+    )
+    for ts, tu in zip(sharded.trials, single.trials):
+        assert ts.config == tu.config
+        a = ts.results[-1]["validation_mse"]
+        b = tu.results[-1]["validation_mse"]
+        assert a == pytest.approx(b, rel=1e-4), (ts.trial_id, a, b)
+
+
+def test_sharded_with_asha_compaction(tiny_data, tmp_path):
+    """Compaction over a mesh keeps sizes divisible by the device count."""
+    train, val = tiny_data
+    analysis = run_vectorized(
+        dict(SPACE, num_epochs=8), train_data=train, val_data=val,
+        metric="validation_mse", mode="min", num_samples=16,
+        devices=jax.devices(),
+        scheduler=tune.ASHAScheduler(
+            max_t=8, grace_period=1, reduction_factor=2
+        ),
+        compaction="always",
+        storage_path=str(tmp_path), seed=5, verbose=0,
+    )
+    assert analysis.num_terminated() == 16
+    survivor = max(analysis.trials, key=lambda t: len(t.results))
+    sizes = {r["population_size"] for r in survivor.results}
+    assert all(s % 8 == 0 for s in sizes), sizes
+    assert min(sizes) < 16  # compaction actually happened
+
+
+def test_device_and_devices_mutually_exclusive(tiny_data, tmp_path):
+    train, val = tiny_data
+    with pytest.raises(ValueError, match="not both"):
+        run_vectorized(
+            SPACE, train_data=train, val_data=val,
+            metric="validation_mse", num_samples=2,
+            device=jax.devices()[0], devices=jax.devices(),
+            storage_path=str(tmp_path), verbose=0,
+        )
