@@ -339,11 +339,22 @@ def run_vectorized(
     size_multiple = 1 if device.platform == "cpu" else 8
     if mesh is not None:
         size_multiple *= len(devices)
+    if max_batch_trials < size_multiple:
+        # A chunk smaller than the alignment multiple would be mostly dummy
+        # pad rows — raise the chunk size so every padded row can carry a
+        # real trial (chunks still cap at num_samples when fewer remain).
+        log(
+            f"max_batch_trials raised {max_batch_trials} -> {size_multiple} "
+            f"to match the population size multiple "
+            f"({len(devices) if mesh is not None else 1} device(s))"
+        )
+        max_batch_trials = size_multiple
     trials: List[Trial] = []
     programs: Dict[Tuple, _GroupProgram] = {}
     next_index = 0
     exhausted = False
     row_epochs = 0  # trial-epochs actually computed (compaction shrinks this)
+    exec_total_s = 0.0  # device-execute seconds across all populations
 
     with jax.default_device(device):
         # Chunked suggest->train loop: adaptive searchers observe all results
@@ -380,11 +391,13 @@ def run_vectorized(
                     )
                 compile_before = tracker.thread_seconds()
                 t_pop = time.time()
-                row_epochs += _run_population(
+                pop_rows, pop_exec_s = _run_population(
                     program, members, sched, searcher, store, metric, mode,
                     log, tracker, compaction, size_multiple,
                     pop_sharding, repl_sharding,
                 )
+                row_epochs += pop_rows
+                exec_total_s += pop_exec_s
                 compile_s = tracker.thread_seconds() - compile_before
                 if compile_s > 0.05:
                     log(
@@ -395,11 +408,19 @@ def run_vectorized(
                     )
 
     wall = time.time() - start_time
+    # MEASURED duty cycle: device-execute seconds (train+eval dispatch to
+    # sync, compile excluded) over wall clock — not the old hardcoded 1.0.
+    # With a population mesh every device computes its slice concurrently,
+    # so the fraction applies to all of them alike.
+    utilization = (
+        round(min(exec_total_s / wall, 1.0), 4) if wall > 0 else 0.0
+    )
     store.write_state(
         trials,
         extra={
             "wall_clock_s": wall,
-            "device_utilization": 1.0,
+            "device_utilization": utilization,
+            "device_exec_s": round(exec_total_s, 3),
             "vectorized": True,
             "row_epochs_computed": row_epochs,
             "population_sharded_over": len(devices) if mesh is not None else 1,
@@ -414,11 +435,12 @@ def run_vectorized(
     store.close()
     analysis = ExperimentAnalysis(
         trials, metric=metric, mode=mode, root=store.root, wall_clock_s=wall,
-        device_utilization=1.0,
+        device_utilization=utilization,
     )
     log(
         f"experiment {name}: {analysis.num_terminated()}/{len(trials)} trials in "
-        f"{wall:.1f}s ({analysis.trials_per_hour():.1f} trials/hour, vectorized)"
+        f"{wall:.1f}s ({analysis.trials_per_hour():.1f} trials/hour, "
+        f"{100 * utilization:.0f}% measured device duty cycle, vectorized)"
     )
     return analysis
 
@@ -437,11 +459,12 @@ def _run_population(
     size_multiple: int = 1,
     pop_sharding=None,
     repl_sharding=None,
-) -> int:
+) -> Tuple[int, float]:
     """Train one population of K same-shape trials to completion.
 
-    Returns the number of trial-epochs actually computed (rows x epochs),
-    the honest FLOP-cost denominator under compaction."""
+    Returns ``(row_epochs, exec_seconds)``: trial-epochs actually computed
+    (rows x epochs — the honest FLOP-cost denominator under compaction) and
+    device-execute wall seconds (the utilization numerator)."""
     k = len(batch)
     now = time.time()
     for t in batch:
@@ -497,6 +520,7 @@ def _run_population(
     # everything per-trial (keys, lr/wd, records) is looked up through it.
     rows = list(range(k)) + [-1] * pad_rows
     row_epochs = 0
+    exec_total_s = 0.0  # device-execute seconds (utilization numerator)
     exec_ema = None  # measured per-epoch execute seconds at the current size
     compile_cost_s = None  # most recent substantial compile observed
     for epoch in range(program.num_epochs):
@@ -521,6 +545,7 @@ def _run_population(
         if compile_delta > 0.05:
             compile_cost_s = compile_delta
         exec_ema = exec_s if exec_ema is None else 0.5 * (exec_ema + exec_s)
+        exec_total_s += exec_s
         row_epochs += len(rows)
         step_count = (epoch + 1) * program.steps_per_epoch
         # Trial-independent: evaluate once per epoch, not once per trial.
@@ -626,4 +651,4 @@ def _run_population(
             searcher.on_trial_complete(
                 trial.trial_id, trial.config, trial.last_result, metric, mode
             )
-    return row_epochs
+    return row_epochs, exec_total_s

@@ -206,6 +206,25 @@ def test_vectorized_compaction_never(tiny_data, tmp_path):
     assert all(r["population_size"] == 8 for r in survivor.results)
 
 
+def test_vectorized_utilization_is_measured(tiny_data, tmp_path):
+    """device_utilization is a measured duty cycle (exec/wall), not the old
+    hardcoded 1.0 — compile time alone guarantees it lands strictly below 1."""
+    import json, os
+
+    train, val = tiny_data
+    analysis = run_vectorized(
+        MLP_SPACE, train_data=train, val_data=val,
+        metric="validation_mse", mode="min", num_samples=4,
+        storage_path=str(tmp_path), verbose=0,
+    )
+    state = json.load(
+        open(os.path.join(analysis.root, "experiment_state.json"))
+    )
+    assert 0.0 < state["device_utilization"] < 1.0
+    assert state["device_exec_s"] > 0
+    assert analysis.device_utilization == state["device_utilization"]
+
+
 def test_vectorized_rejects_pbt(tiny_data, tmp_path):
     train, val = tiny_data
     with pytest.raises(ValueError, match="vectorized"):
