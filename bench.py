@@ -56,11 +56,10 @@ SEQ = 96  # glucose windows are interval=96
 DFF = D_MODEL * 2
 TORCH_STEPS_MEASURED = 30
 
-# Peak MXU throughput used for the MFU denominator, by platform.
-PEAK_FLOPS = {
-    "tpu": 9.85e13,   # v5e, fp32-precision matmuls on the MXU (~bf16 peak / 2)
-    "cpu": None,      # MFU is not meaningful on the host CPU
-}
+# The MFU denominator comes from ops.flops.device_peak_flops, reported by
+# the "ours" child (which can see the device); this is only the fallback
+# when an older child result lacks the field.
+FALLBACK_PEAK_FLOPS = {"tpu": 9.85e13, "cpu": None}
 
 
 # ---------------------------------------------------------------------------
@@ -133,17 +132,15 @@ def _parse_result(out: str):
 
 
 def transformer_fwd_flops(batch: int, seq: int) -> float:
-    """Analytic forward FLOPs of the bench transformer (matmuls only)."""
-    d, dff, layers, feats = D_MODEL, DFF, LAYERS, FEATURES
-    f = 2.0 * batch * seq * feats * d                 # input projection
-    per_layer = (
-        4 * 2.0 * batch * seq * d * d                 # Q,K,V,O projections
-        + 2 * 2.0 * batch * seq * seq * d             # scores + apply
-        + 2 * 2.0 * batch * seq * d * dff             # FF in + out
+    """Analytic forward FLOPs of the bench transformer — delegates to the
+    framework's estimator (ops.flops) so there is ONE formula to maintain."""
+    from distributed_machine_learning_tpu.ops.flops import forward_flops
+
+    return forward_flops(
+        {"model": "transformer", "d_model": D_MODEL, "num_layers": LAYERS,
+         "dim_feedforward": DFF},
+        batch, seq, FEATURES,
     )
-    f += layers * per_layer
-    f += 2.0 * batch * (d * 128 + 128 * 64 + 64 * 32 + 32 * 16 + 16)  # head
-    return f
 
 
 def sweep_total_flops(num_trials: int, num_epochs: int, steps_per_epoch: int,
@@ -247,7 +244,10 @@ def child_ours(scale: dict) -> None:
 
     import jax
 
+    from distributed_machine_learning_tpu.ops.flops import device_peak_flops
+
     result["platform"] = jax.devices()[0].platform
+    result["peak_flops"] = device_peak_flops(jax.devices()[0])
     print(json.dumps(result))
 
 
@@ -421,7 +421,7 @@ def main() -> None:
         })
         return
 
-    peak = PEAK_FLOPS.get(backend)
+    peak = ours.get("peak_flops") or FALLBACK_PEAK_FLOPS.get(backend)
     mfu = (ours["flops"] / ours["wall_s"] / peak) if peak else None
     vs = (ours["trials_per_hour"] / torch_res["trials_per_hour"]
           if torch_res else None)

@@ -1,0 +1,97 @@
+"""Analytic FLOP estimates + device peaks -> per-trial MFU.
+
+BASELINE.md's ">=90% chip utilization" target needs a *measurement*, not the
+lease-fraction proxy: MFU = achieved matmul FLOP/s over the chip's peak.
+The trainable times each epoch's device execution and divides by the
+estimates here (matmul terms only — elementwise/softmax omitted, so the
+numbers are slightly conservative, the standard MFU convention).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+# Peak DENSE bf16 matmul throughput per chip, by `device_kind` substring
+# (public spec sheets; fp32 runs the MXU at ~half these rates).
+_PEAK_BF16 = (
+    ("v6", 918e12),      # Trillium
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),  # v5e reports device_kind "TPU v5 lite"
+    ("v5e", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+)
+
+
+def device_peak_flops(device, compute_dtype: str = "float32") -> Optional[float]:
+    """Peak matmul FLOP/s of ``device`` for the given compute dtype
+    (None when unknown — e.g. the CPU test platform)."""
+    if device is None or device.platform != "tpu":
+        return None
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    for key, bf16_peak in _PEAK_BF16:
+        if key in kind:
+            return bf16_peak if compute_dtype == "bfloat16" else bf16_peak / 2
+    return None
+
+
+def _mlp_forward_flops(hidden_sizes, batch: int, seq: int, features: int) -> float:
+    # models.mlp flattens (seq, features) then stacks Dense layers + scalar out.
+    dims = [seq * features] + [int(h) for h in hidden_sizes] + [1]
+    return sum(2.0 * batch * a * b for a, b in zip(dims, dims[1:]))
+
+
+def _transformer_forward_flops(
+    cfg: Dict[str, Any], batch: int, seq: int, features: int
+) -> float:
+    # Key resolution MUST mirror models/__init__.py's builders exactly
+    # (num_encoder_layers alias, dim_feedforward defaulting to d_model*2 for
+    # 'transformer' and 256 for 'simple_transformer') or the reported MFU is
+    # silently wrong for non-default configs.
+    family = str(cfg.get("model", "transformer"))
+    d = int(cfg.get("d_model", 64))
+    layers = int(
+        cfg.get("num_encoder_layers", cfg.get("num_layers", 2))
+        if family == "transformer"
+        else cfg.get("num_layers", 2)
+    )
+    dff = int(cfg.get("dim_feedforward",
+                      d * 2 if family == "transformer" else 256))
+    f = 2.0 * batch * seq * features * d  # input projection
+    per_layer = (
+        4 * 2.0 * batch * seq * d * d      # Q, K, V, O projections
+        + 2 * 2.0 * batch * seq * seq * d  # scores + apply (softmax attn)
+        + 2 * 2.0 * batch * seq * d * dff  # FF in + out
+    )
+    f += layers * per_layer
+    if family == "transformer":  # reference fc1..fc5 MLP head
+        head = [d] + [int(h) for h in cfg.get("head_hidden_sizes",
+                                              (128, 64, 32, 16))] + [1]
+    else:  # simple_transformer: single Linear head (reference C12)
+        head = [d, 1]
+    f += sum(2.0 * batch * a * b for a, b in zip(head, head[1:]))
+    return f
+
+
+def forward_flops(
+    config: Dict[str, Any], batch: int, seq: int, features: int
+) -> Optional[float]:
+    """Analytic forward matmul FLOPs for one batch, or None for model
+    families without an estimate (cnn1d, resnet18)."""
+    family = str(config.get("model", "transformer"))
+    if family in ("transformer", "simple_transformer"):
+        return _transformer_forward_flops(config, batch, seq, features)
+    if family == "mlp":
+        return _mlp_forward_flops(
+            config.get("hidden_sizes", (128, 64)), batch, seq, features
+        )
+    return None
+
+
+def train_step_flops(
+    config: Dict[str, Any], batch: int, seq: int, features: int
+) -> Optional[float]:
+    """Forward + backward ~= 3x forward (the standard estimate)."""
+    fwd = forward_flops(config, batch, seq, features)
+    return None if fwd is None else 3.0 * fwd

@@ -421,6 +421,7 @@ def run_distributed(
     seed: int = 0,
     max_failures: int = 0,
     time_budget_s: Optional[float] = None,
+    time_limit_per_trial_s: Optional[float] = None,
     verbose: int = 1,
     shutdown_workers: bool = False,
     keep_checkpoints_num: int = 0,
@@ -494,6 +495,10 @@ def run_distributed(
         max_failures=max_failures,
         time_budget_s=time_budget_s,
         keep_checkpoints_num=keep_checkpoints_num,
+        # Soft enforcement only: the limit takes effect at report boundaries
+        # (worker trials run in supervisor threads; hard preemption needs
+        # the local process executor, runner.py).
+        time_limit_per_trial_s=time_limit_per_trial_s,
         log=log,
     )
     trials = lifecycle.trials

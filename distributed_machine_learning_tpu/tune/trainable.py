@@ -50,7 +50,13 @@ from distributed_machine_learning_tpu.tune._regression_program import (
     per_example_losses,
     stage_data,
 )
+from distributed_machine_learning_tpu.ops.flops import (
+    device_peak_flops,
+    forward_flops,
+    train_step_flops,
+)
 from distributed_machine_learning_tpu.tune.checkpoint import restore_into
+from distributed_machine_learning_tpu.utils.compile_cache import get_tracker
 from distributed_machine_learning_tpu.utils.seeding import fold_seed
 
 # Back-compat aliases (vectorized.py and external users imported these names).
@@ -131,9 +137,31 @@ def train_regressor(
 
     checkpoint_freq = int(config.get("checkpoint_freq", 1))
 
+    # ---- per-epoch MFU accounting (BASELINE.md utilization target) ---------
+    x_shape = data.x_train.shape
+    seq_len = int(x_shape[1]) if len(x_shape) == 3 else 1
+    feats = int(x_shape[-1])
+    step_flops = train_step_flops(config, data.batch_size, seq_len, feats)
+    eval_flops = forward_flops(config, int(data.x_val.shape[0]), seq_len, feats)
+    epoch_flops = (
+        step_flops * steps_per_epoch + (eval_flops or 0.0)
+        if step_flops is not None
+        else None
+    )
+    devices = session.get_devices()
+    peak = device_peak_flops(
+        devices[0] if devices else jax.devices()[0],
+        str(config.get("compute_dtype", "float32")),
+    )
+    tracker = get_tracker()
+
+    import time as _time
+
     # ---- epoch loop: host-driven so the scheduler can interrupt ------------
     for epoch in range(start_epoch, num_epochs):
         epoch_key = jax.random.key(fold_seed(seed, "epoch", epoch))
+        c0 = tracker.thread_seconds()
+        t0 = _time.time()
         params, opt_state, batch_stats, train_loss = train_epoch(
             params, opt_state, batch_stats, data.x_train, data.y_train, epoch_key
         )
@@ -148,6 +176,16 @@ def train_regressor(
             "steps": step_count,
             **{k: float(v) for k, v in metrics.items()},
         }
+        # The float() conversions above synced both programs; wall minus
+        # this thread's compile seconds is device-execute time.
+        exec_s = max(
+            _time.time() - t0 - (tracker.thread_seconds() - c0), 1e-9
+        )
+        record["epoch_time_s"] = round(exec_s, 4)
+        if epoch_flops is not None:
+            record["epoch_flops"] = epoch_flops
+            if peak:
+                record["mfu"] = round(epoch_flops / exec_s / peak, 5)
         checkpoint = None
         if checkpoint_freq and (epoch + 1) % checkpoint_freq == 0:
             checkpoint = {

@@ -221,6 +221,25 @@ def test_worker_death_requeues_trials(tmp_path):
         p.terminate()
 
 
+def test_trial_time_limit_over_cluster(worker_pool, tmp_path):
+    """Per-trial time limits apply to cluster trials at report boundaries."""
+    analysis = run_distributed(
+        "cluster_trainables:slow_trial",
+        {"epochs": 30, "sleep_s": 0.2},
+        metric="loss",
+        mode="min",
+        num_samples=2,
+        workers=worker_pool,
+        time_limit_per_trial_s=1.0,
+        storage_path=str(tmp_path),
+        name="dist_tl",
+        verbose=0,
+    )
+    for t in analysis.trials:
+        assert t.status.value == "TERMINATED"
+        assert 1 <= t.training_iteration < 30
+
+
 def test_jax_runs_on_worker(worker_pool, tmp_path):
     analysis = run_distributed(
         "cluster_trainables:jax_device_trial",

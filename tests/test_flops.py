@@ -1,0 +1,66 @@
+"""Analytic FLOPs + MFU accounting (BASELINE.md utilization measurement)."""
+
+import jax
+import pytest
+
+from distributed_machine_learning_tpu import tune
+from distributed_machine_learning_tpu.data import dummy_regression_data
+from distributed_machine_learning_tpu.ops.flops import (
+    device_peak_flops,
+    forward_flops,
+    train_step_flops,
+)
+
+
+def test_transformer_flops_monotonic_in_width():
+    small = forward_flops({"model": "transformer", "d_model": 64}, 32, 96, 16)
+    large = forward_flops({"model": "transformer", "d_model": 128}, 32, 96, 16)
+    assert small and large and large > small
+    assert train_step_flops(
+        {"model": "transformer", "d_model": 64}, 32, 96, 16
+    ) == pytest.approx(3 * small)
+
+
+def test_mlp_flops_and_unknown_family():
+    mlp = forward_flops({"model": "mlp", "hidden_sizes": (64, 32)}, 16, 8, 4)
+    assert mlp and mlp > 0
+    assert forward_flops({"model": "cnn1d"}, 16, 8, 4) is None
+
+
+def test_device_peak_flops():
+    assert device_peak_flops(jax.devices()[0]) is None  # CPU test platform
+
+    class FakeTpu:
+        platform = "tpu"
+        device_kind = "TPU v5 lite"
+
+    fp32 = device_peak_flops(FakeTpu())
+    bf16 = device_peak_flops(FakeTpu(), "bfloat16")
+    assert fp32 == pytest.approx(197e12 / 2)
+    assert bf16 == pytest.approx(197e12)
+
+    class UnknownTpu:
+        platform = "tpu"
+        device_kind = "TPU v99"
+
+    assert device_peak_flops(UnknownTpu()) is None
+
+
+def test_trainable_reports_epoch_time_and_flops(tmp_path):
+    train, val = dummy_regression_data(
+        num_samples=120, seq_len=8, num_features=4
+    )
+    analysis = tune.run(
+        tune.with_parameters(tune.train_regressor, train_data=train,
+                             val_data=val),
+        {"model": "mlp", "hidden_sizes": (16,), "learning_rate": 0.01,
+         "num_epochs": 2, "batch_size": 32, "lr_schedule": "constant"},
+        metric="validation_loss",
+        num_samples=1,
+        storage_path=str(tmp_path),
+        verbose=0,
+    )
+    r = analysis.trials[0].last_result
+    assert r["epoch_time_s"] > 0
+    assert r["epoch_flops"] > 0
+    assert "mfu" not in r  # no TPU peak on the CPU test platform
