@@ -36,7 +36,16 @@ default) applies a measured cost model — compact only when
 observed compile cost — so a cold compile cache never turns the FLOP saving
 into a wall-clock loss ("always"/"never" override it).  Per-trial PRNG keys
 travel with their rows, so a surviving trial's trajectory is independent of
-who else is still in the population.  PBT (REQUEUE) is not supported here.
+who else is still in the population.
+
+**Vectorized PBT**: with a ``PopulationBasedTraining`` scheduler, the vmapped
+batch IS the PBT population — exploit is one device-side gather
+(bottom-quantile rows adopt top-quantile rows' params and optimizer state)
+and explore rewrites per-row learning_rate/weight_decay in the injected
+optimizer hyperparams.  No stop-and-respawn, no checkpoint round-trip, no
+recompile: a whole PBT generation costs one gather.  Only optimizer-state
+hyperparams can mutate (static keys change the program — use ``tune.run``'s
+respawn PBT for those).  Other REQUEUE-style schedulers are unsupported.
 
 The jittable program bodies are shared with the per-trial trainable via
 ``tune/_regression_program.py``.
@@ -69,6 +78,7 @@ from distributed_machine_learning_tpu.tune.experiment import (
     ExperimentStore,
 )
 from distributed_machine_learning_tpu.tune.schedulers.base import (
+    CONTINUE,
     FIFOScheduler,
     REQUEUE,
     STOP,
@@ -77,6 +87,7 @@ from distributed_machine_learning_tpu.tune.schedulers.base import (
 from distributed_machine_learning_tpu.tune.search.base import RandomSearch, Searcher
 from distributed_machine_learning_tpu.tune.search_space import SearchSpace
 from distributed_machine_learning_tpu.tune.trial import Trial, TrialStatus
+from distributed_machine_learning_tpu.utils.seeding import rng_from
 
 # Hyperparameters that vary across trials *within* one vmapped program.
 VECTOR_KEYS = ("learning_rate", "weight_decay", "seed")
@@ -304,11 +315,23 @@ def run_vectorized(
         PopulationBasedTraining,
     )
 
+    pbt: Optional[PopulationBasedTraining] = None
     if isinstance(sched, PopulationBasedTraining):
-        raise ValueError(
-            "PBT/requeue schedulers are not supported in vectorized mode; "
-            "use tune.run for population-based training"
-        )
+        # Vectorized PBT: the population IS the vmapped batch, so exploit is
+        # a device-side row gather (bottom-quantile rows copy top-quantile
+        # rows' params + optimizer state in one program) and explore rewrites
+        # the per-row lr/wd in the injected optimizer hyperparams — no
+        # stop-and-respawn, no checkpoint round-trip.  Only hyperparams that
+        # are optimizer STATE can mutate here; static keys change the traced
+        # program and need tune.run's respawn PBT.
+        bad = set(sched.mutations) - {"learning_rate", "weight_decay"}
+        if bad:
+            raise ValueError(
+                f"vectorized PBT can only mutate learning_rate/weight_decay "
+                f"(optimizer-state hyperparams); {sorted(bad)} change the "
+                f"compiled program — use tune.run for those"
+            )
+        pbt = sched
     sched.set_experiment(metric, mode)
 
     name = name or f"vexp_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:6]}"
@@ -394,7 +417,7 @@ def run_vectorized(
                 pop_rows, pop_exec_s = _run_population(
                     program, members, sched, searcher, store, metric, mode,
                     log, tracker, compaction, size_multiple,
-                    pop_sharding, repl_sharding,
+                    pop_sharding, repl_sharding, pbt,
                 )
                 row_epochs += pop_rows
                 exec_total_s += pop_exec_s
@@ -459,6 +482,7 @@ def _run_population(
     size_multiple: int = 1,
     pop_sharding=None,
     repl_sharding=None,
+    pbt=None,
 ) -> Tuple[int, float]:
     """Train one population of K same-shape trials to completion.
 
@@ -519,6 +543,7 @@ def _run_population(
     # slices stopped rows out of the pytrees and shrinks this mapping;
     # everything per-trial (keys, lr/wd, records) is looked up through it.
     rows = list(range(k)) + [-1] * pad_rows
+    pbt_notes: Dict[int, str] = {}  # trial index -> donor id, for the record
     row_epochs = 0
     exec_total_s = 0.0  # device-execute seconds (utilization numerator)
     exec_ema = None  # measured per-epoch execute seconds at the current size
@@ -572,16 +597,27 @@ def _run_population(
                 "population_size": len(rows),
                 **{key: float(v[i]) for key, v in metrics_np.items()},
             }
+            note = pbt_notes.pop(r, None)
+            if note is not None:
+                record["pbt_exploited_from"] = note
             trial.results.append(record)
+            # Keep Trial.training_iteration live (== epochs completed), the
+            # same contract the threaded executor maintains via report().
+            trial.reports_since_restart += 1
             store.append_result(trial, record)
-            decision = sched.on_trial_result(trial, record)
+            # PBT never stops trials and its REQUEUE protocol is replaced by
+            # the in-population gather below, so the scheduler is bypassed.
+            decision = (
+                CONTINUE if pbt is not None
+                else sched.on_trial_result(trial, record)
+            )
             searcher.on_trial_result(
                 trial.trial_id, dict(trial.config), record, metric, mode
             )
             if decision == REQUEUE:
                 raise ValueError(
-                    "PBT/requeue schedulers are not supported in vectorized "
-                    "mode; use tune.run for population-based training"
+                    "requeue schedulers are not supported in vectorized "
+                    "mode; use tune.run"
                 )
             if decision == STOP:
                 active[r] = False
@@ -591,6 +627,86 @@ def _run_population(
                 searcher.on_trial_complete(
                     trial.trial_id, trial.config, trial.last_result, metric, mode
                 )
+        # ---- vectorized PBT: exploit = one gather over the population ------
+        if (
+            pbt is not None
+            and (epoch + 1) % pbt.interval == 0
+            and epoch + 1 < program.num_epochs
+        ):
+            if pbt.metric in metrics_np:
+                scores = metrics_np[pbt.metric]
+            elif pbt.metric == "train_loss":
+                scores = train_losses
+            else:
+                raise ValueError(
+                    f"PBT metric {pbt.metric!r} is not produced by this "
+                    f"trainable (have: train_loss, "
+                    f"{', '.join(sorted(metrics_np))})"
+                )
+            sign = 1.0 if pbt.mode == "min" else -1.0
+
+            def rank_key(value: float) -> float:
+                # Non-finite rows must never donate (a NaN donor would
+                # corrupt healthy trials wholesale) and should be first in
+                # line for rescue — rank them strictly worst.
+                v = sign * value
+                return v if np.isfinite(v) else np.inf
+
+            live = sorted(
+                (rank_key(float(scores[i])), i, r)
+                for i, r in enumerate(rows)
+                if r >= 0
+            )
+            if len(live) >= 4 and np.isfinite(live[0][0]):
+                q = max(1, int(len(live) * pbt.quantile))
+                # Donors must be finite (fewer than q finite rows -> smaller
+                # donor pool, never an inf-ranked one).
+                top = [t for t in live[:q] if np.isfinite(t[0])]
+                bottom = live[-q:]
+                src = np.arange(len(rows))
+                exploited = []
+                for _, i, r in bottom:
+                    rng = rng_from(
+                        "vpbt", pbt.seed, batch[r].trial_id, epoch + 1
+                    )
+                    _, di, dr = top[int(rng.integers(len(top)))]
+                    src[i] = di
+                    donor, lagger = batch[dr], batch[r]
+                    # Explore: mutate the donor's hyperparams; the laggard
+                    # keeps its own identity/seed (its PRNG row stays put).
+                    new_cfg = pbt._mutate(dict(donor.config), rng)
+                    new_cfg["seed"] = lagger.config.get("seed", 0)
+                    lagger.config = new_cfg
+                    lrs[r] = float(new_cfg["learning_rate"])
+                    wds[r] = float(new_cfg.get("weight_decay", 0.0))
+                    pbt_notes[r] = donor.trial_id
+                    exploited.append((lagger.trial_id, donor.trial_id))
+                    pbt._num_perturbations += 1
+                if exploited:
+                    sel = jnp.asarray(src)
+                    # Exploit: bottom rows adopt donor rows' weights AND
+                    # optimizer state in one device-side gather.
+                    params, opt_state, batch_stats = jax.tree.map(
+                        lambda a: a[sel], (params, opt_state, batch_stats)
+                    )
+                    # Explore lands in the optimizer state: per-row lr/wd
+                    # live in the injected hyperparams arrays.
+                    opt_state = _set_hyperparams(
+                        opt_state,
+                        jnp.asarray([lrs[r] if r >= 0 else float(lrs[0])
+                                     for r in rows], jnp.float32),
+                        jnp.asarray([wds[r] if r >= 0 else float(wds[0])
+                                     for r in rows], jnp.float32),
+                    )
+                    if pop_sharding is not None:
+                        params, opt_state, batch_stats = jax.device_put(
+                            (params, opt_state, batch_stats), pop_sharding
+                        )
+                    log(
+                        f"PBT epoch {epoch}: "
+                        + ", ".join(f"{a}<-{b}" for a, b in exploited)
+                    )
+
         if not any(active[r] for r in rows if r >= 0):
             log(f"population fully early-stopped at epoch {epoch}")
             break

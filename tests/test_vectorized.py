@@ -225,18 +225,18 @@ def test_vectorized_utilization_is_measured(tiny_data, tmp_path):
     assert analysis.device_utilization == state["device_utilization"]
 
 
-def test_vectorized_rejects_pbt(tiny_data, tmp_path):
+def test_vectorized_rejects_static_key_pbt_mutations(tiny_data, tmp_path):
+    """PBT is supported vectorized, but only for optimizer-state hyperparams;
+    mutating a program-shaping key must fail loudly."""
     train, val = tiny_data
-    with pytest.raises(ValueError, match="vectorized"):
+    with pytest.raises(ValueError, match="learning_rate/weight_decay"):
         run_vectorized(
             dict(MLP_SPACE, num_epochs=4),
             train_data=train, val_data=val,
             metric="validation_mse", mode="min", num_samples=4,
             scheduler=tune.PopulationBasedTraining(
                 perturbation_interval=1,
-                hyperparam_mutations={
-                    "learning_rate": tune.loguniform(1e-4, 1e-1)
-                },
+                hyperparam_mutations={"batch_size": [16, 32]},
             ),
             storage_path=str(tmp_path), verbose=0,
         )
