@@ -236,6 +236,33 @@ class _GroupProgram:
             jax.vmap(eval_one, in_axes=(0, 0, None, None, None))
         )
 
+        # Multi-epoch dispatch: scan train+eval over E epochs INSIDE one
+        # program, so a chunk of epochs costs one host->device round trip
+        # instead of 2E (dispatch latency dominates small models, doubly so
+        # over a remote-TPU tunnel).  Per-epoch losses/metrics come back
+        # stacked along a trailing epoch axis.
+        def run_epochs(params, opt_state, batch_stats, base_key,
+                       x, y, xv, yv, mask, epoch_ids):
+            def body(carry, e):
+                p, o, b = carry
+                key = jax.random.fold_in(base_key, e)
+                p, o, b, tl = epoch_one(p, o, b, x, y, key)
+                m = eval_one(p, b, xv, yv, mask)
+                return (p, o, b), (tl, m)
+
+            (p, o, b), (tls, ms) = jax.lax.scan(
+                body, (params, opt_state, batch_stats), epoch_ids
+            )
+            return p, o, b, tls, ms
+
+        self.train_epochs = jax.jit(
+            jax.vmap(
+                run_epochs,
+                in_axes=(0, 0, 0, 0, None, None, None, None, None, None),
+            ),
+            donate_argnums=(0, 1, 2),
+        )
+
 
 def run_vectorized(
     param_space: Union[Dict[str, Any], SearchSpace],
@@ -256,6 +283,7 @@ def run_vectorized(
     verbose: int = 1,
     compile_cache_dir: Optional[str] = "auto",
     compaction: str = "auto",
+    epochs_per_dispatch: int = 1,
 ) -> ExperimentAnalysis:
     """Run an HPO sweep with trials batched into vmapped populations.
 
@@ -275,6 +303,15 @@ def run_vectorized(
     padded to a multiple of ``n_devices`` (x8 sublane alignment on TPU), so
     keep ``max_batch_trials >= size multiple`` or dummy pad rows dominate.
     ``device``: run on one explicit device (mutually exclusive).
+
+    ``epochs_per_dispatch``: scan E epochs (train+eval each) inside ONE
+    jitted program, cutting host->device round trips from 2E to 1 — the big
+    lever when dispatch latency dominates (small models, remote TPU).  The
+    per-epoch result stream is unchanged (the program returns per-epoch
+    losses/metrics stacked), but scheduler stops, PBT perturbations, and
+    compaction act at dispatch boundaries, so mid-chunk stops save
+    reporting, not FLOPs — pick E to match the scheduler's cadence (e.g.
+    ASHA's grace_period, PBT's perturbation_interval).
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
@@ -417,7 +454,7 @@ def run_vectorized(
                 pop_rows, pop_exec_s = _run_population(
                     program, members, sched, searcher, store, metric, mode,
                     log, tracker, compaction, size_multiple,
-                    pop_sharding, repl_sharding, pbt,
+                    pop_sharding, repl_sharding, pbt, epochs_per_dispatch,
                 )
                 row_epochs += pop_rows
                 exec_total_s += pop_exec_s
@@ -468,6 +505,64 @@ def run_vectorized(
     return analysis
 
 
+def _emit_epoch_records(
+    batch, rows, active, lrs, epoch, step_count, shape_val, now,
+    train_losses, metrics_np, pbt_notes, pbt, sched, searcher, store,
+    metric, mode,
+):
+    """Append one epoch's records for every live trial and route them through
+    the scheduler/searcher (the vectorized analogue of ``session.report``)."""
+    for i, r in enumerate(rows):
+        if r < 0:  # dummy pad row
+            continue
+        trial = batch[r]
+        if not active[r]:
+            continue
+        record = {
+            "epoch": epoch,
+            "training_iteration": epoch + 1,
+            "train_loss": float(train_losses[i]),
+            "steps": step_count,
+            "lr": float(lrs[r]) * shape_val,
+            "trial_id": trial.trial_id,
+            "timestamp": now,
+            "time_total_s": now - trial.started_at,
+            "population_size": len(rows),
+            **{key: float(v[i]) for key, v in metrics_np.items()},
+        }
+        note = pbt_notes.pop(r, None)
+        if note is not None:
+            record["pbt_exploited_from"] = note
+        trial.results.append(record)
+        # Keep Trial.training_iteration live (== epochs completed), the
+        # same contract the threaded executor maintains via report().
+        trial.reports_since_restart += 1
+        store.append_result(trial, record)
+        # PBT never stops trials and its REQUEUE protocol is replaced by
+        # the in-population gather at the dispatch boundary, so the
+        # scheduler is bypassed.
+        decision = (
+            CONTINUE if pbt is not None
+            else sched.on_trial_result(trial, record)
+        )
+        searcher.on_trial_result(
+            trial.trial_id, dict(trial.config), record, metric, mode
+        )
+        if decision == REQUEUE:
+            raise ValueError(
+                "requeue schedulers are not supported in vectorized mode; "
+                "use tune.run"
+            )
+        if decision == STOP:
+            active[r] = False
+            trial.status = TrialStatus.TERMINATED
+            trial.finished_at = time.time()
+            sched.on_trial_complete(trial)
+            searcher.on_trial_complete(
+                trial.trial_id, trial.config, trial.last_result, metric, mode
+            )
+
+
 def _run_population(
     program: _GroupProgram,
     batch: List[Trial],
@@ -483,6 +578,7 @@ def _run_population(
     pop_sharding=None,
     repl_sharding=None,
     pbt=None,
+    epochs_per_dispatch: int = 1,
 ) -> Tuple[int, float]:
     """Train one population of K same-shape trials to completion.
 
@@ -548,90 +644,108 @@ def _run_population(
     exec_total_s = 0.0  # device-execute seconds (utilization numerator)
     exec_ema = None  # measured per-epoch execute seconds at the current size
     compile_cost_s = None  # most recent substantial compile observed
-    for epoch in range(program.num_epochs):
-        epoch_keys = jax.vmap(lambda key: jax.random.fold_in(key, epoch))(
-            base_keys
+    dispatch = max(int(epochs_per_dispatch), 1)
+    if pbt is not None and dispatch > pbt.interval:
+        # One state gather can happen per dispatch boundary, so a chunk
+        # larger than the perturbation interval would silently DROP
+        # perturbations, not delay them.  Clamp so every interval fires.
+        log(
+            f"epochs_per_dispatch clamped {dispatch} -> {pbt.interval} to "
+            f"match the PBT perturbation interval"
         )
+        dispatch = pbt.interval
+    if dispatch > 1 and program.num_epochs % dispatch:
+        # A ragged final chunk is a second full XLA program (different scan
+        # trip count) — in the dispatch-latency regime this feature targets,
+        # that compile can cost more than the round trips saved.  Round down
+        # to the largest divisor of num_epochs so every chunk shares one
+        # compiled program.
+        d = dispatch
+        while program.num_epochs % d:
+            d -= 1
+        log(
+            f"epochs_per_dispatch rounded {dispatch} -> {d} "
+            f"(largest divisor of num_epochs={program.num_epochs}; avoids a "
+            f"second compile for a ragged final chunk)"
+        )
+        dispatch = d
+
+    epoch0 = 0
+    while epoch0 < program.num_epochs:
+        chunk = min(dispatch, program.num_epochs - epoch0)
         c0 = tracker.thread_seconds()
         t0 = time.time()
-        params, opt_state, batch_stats, train_losses = program.train_epoch(
-            params, opt_state, batch_stats, data.x_train, data.y_train,
-            epoch_keys,
-        )
-        metrics_k = program.eval_population(
-            params, batch_stats, data.x_val, data.y_val, data.val_mask
-        )
-        train_losses = np.asarray(train_losses)
-        # Materialize eval BEFORE reading the clocks: eval execution is part
-        # of the per-epoch cost the compaction model weighs.
-        metrics_np = {key: np.asarray(v) for key, v in metrics_k.items()}
+        if chunk == 1:
+            epoch_keys = jax.vmap(
+                lambda key: jax.random.fold_in(key, epoch0)
+            )(base_keys)
+            params, opt_state, batch_stats, tl = program.train_epoch(
+                params, opt_state, batch_stats, data.x_train, data.y_train,
+                epoch_keys,
+            )
+            metrics_k = program.eval_population(
+                params, batch_stats, data.x_val, data.y_val, data.val_mask
+            )
+            tl_chunk = np.asarray(tl)[:, None]  # (K, 1)
+            metrics_chunk = {
+                key: np.asarray(v)[:, None] for key, v in metrics_k.items()
+            }
+        else:
+            params, opt_state, batch_stats, tls, ms = program.train_epochs(
+                params, opt_state, batch_stats, base_keys,
+                data.x_train, data.y_train,
+                data.x_val, data.y_val, data.val_mask,
+                jnp.arange(epoch0, epoch0 + chunk),
+            )
+            # vmap(scan) stacks as (K, E)
+            tl_chunk = np.asarray(tls)
+            metrics_chunk = {key: np.asarray(v) for key, v in ms.items()}
+        # Materialize BEFORE reading the clocks: eval execution is part of
+        # the per-epoch cost the compaction model weighs (np.asarray above
+        # synced everything).
         compile_delta = tracker.thread_seconds() - c0
         exec_s = max(time.time() - t0 - compile_delta, 0.0)
         if compile_delta > 0.05:
             compile_cost_s = compile_delta
-        exec_ema = exec_s if exec_ema is None else 0.5 * (exec_ema + exec_s)
-        exec_total_s += exec_s
-        row_epochs += len(rows)
-        step_count = (epoch + 1) * program.steps_per_epoch
-        # Trial-independent: evaluate once per epoch, not once per trial.
-        shape_val = float(
-            program.shape_schedule(min(step_count, program.total_steps))
+        per_epoch_exec = exec_s / chunk
+        exec_ema = (
+            per_epoch_exec if exec_ema is None
+            else 0.5 * (exec_ema + per_epoch_exec)
         )
-        now = time.time()
+        exec_total_s += exec_s
+        row_epochs += len(rows) * chunk
 
-        for i, r in enumerate(rows):
-            if r < 0:  # dummy pad row
-                continue
-            trial = batch[r]
-            if not active[r]:
-                continue
-            record = {
-                "epoch": epoch,
-                "training_iteration": epoch + 1,
-                "train_loss": float(train_losses[i]),
-                "steps": step_count,
-                "lr": float(lrs[r]) * shape_val,
-                "trial_id": trial.trial_id,
-                "timestamp": now,
-                "time_total_s": now - trial.started_at,
-                "population_size": len(rows),
-                **{key: float(v[i]) for key, v in metrics_np.items()},
-            }
-            note = pbt_notes.pop(r, None)
-            if note is not None:
-                record["pbt_exploited_from"] = note
-            trial.results.append(record)
-            # Keep Trial.training_iteration live (== epochs completed), the
-            # same contract the threaded executor maintains via report().
-            trial.reports_since_restart += 1
-            store.append_result(trial, record)
-            # PBT never stops trials and its REQUEUE protocol is replaced by
-            # the in-population gather below, so the scheduler is bypassed.
-            decision = (
-                CONTINUE if pbt is not None
-                else sched.on_trial_result(trial, record)
+        t_end = time.time()
+        for e_off in range(chunk):
+            epoch = epoch0 + e_off
+            train_losses = tl_chunk[:, e_off]
+            metrics_np = {key: v[:, e_off] for key, v in metrics_chunk.items()}
+            step_count = (epoch + 1) * program.steps_per_epoch
+            # Trial-independent: evaluate once per epoch, not per trial.
+            shape_val = float(
+                program.shape_schedule(min(step_count, program.total_steps))
             )
-            searcher.on_trial_result(
-                trial.trial_id, dict(trial.config), record, metric, mode
+            # Per-epoch completion time is interpolated across the chunk so
+            # timestamp/time_total_s stay monotone and ~epoch-granular (the
+            # device finished epoch e_off at roughly this point).
+            now = t0 + (e_off + 1) * (t_end - t0) / chunk
+            _emit_epoch_records(
+                batch, rows, active, lrs, epoch, step_count, shape_val, now,
+                train_losses, metrics_np, pbt_notes, pbt, sched, searcher,
+                store, metric, mode,
             )
-            if decision == REQUEUE:
-                raise ValueError(
-                    "requeue schedulers are not supported in vectorized "
-                    "mode; use tune.run"
-                )
-            if decision == STOP:
-                active[r] = False
-                trial.status = TrialStatus.TERMINATED
-                trial.finished_at = time.time()
-                sched.on_trial_complete(trial)
-                searcher.on_trial_complete(
-                    trial.trial_id, trial.config, trial.last_result, metric, mode
-                )
+        epoch0 += chunk
+        epoch = epoch0 - 1  # last completed epoch (PBT/compaction below)
+        train_losses = tl_chunk[:, -1]
+        metrics_np = {key: v[:, -1] for key, v in metrics_chunk.items()}
+
         # ---- vectorized PBT: exploit = one gather over the population ------
+        # A chunk may cross interval boundaries; fire when it did (at worst
+        # the perturbation lands chunk-1 epochs late — document, don't drop).
         if (
             pbt is not None
-            and (epoch + 1) % pbt.interval == 0
-            and epoch + 1 < program.num_epochs
+            and (epoch0 // pbt.interval) > ((epoch0 - chunk) // pbt.interval)
+            and epoch0 < program.num_epochs
         ):
             if pbt.metric in metrics_np:
                 scores = metrics_np[pbt.metric]

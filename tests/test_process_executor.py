@@ -112,7 +112,10 @@ def test_killed_trial_retry_gets_fresh_clock(tmp_path):
         metric="validation_loss",
         num_samples=1,
         trial_executor="process",
-        time_limit_per_trial_s=4.0,
+        # Generous limit: under full-suite load on a 1-core host, child
+        # startup alone can take several seconds — the retry incarnation
+        # must be able to finish within the limit or this test flakes.
+        time_limit_per_trial_s=8.0,
         max_failures=1,
         storage_path=str(tmp_path),
         verbose=0,

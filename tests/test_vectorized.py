@@ -206,6 +206,48 @@ def test_vectorized_compaction_never(tiny_data, tmp_path):
     assert all(r["population_size"] == 8 for r in survivor.results)
 
 
+def test_multi_epoch_dispatch_matches_per_epoch(tiny_data, tmp_path):
+    """epochs_per_dispatch scans E epochs in one program; the per-epoch
+    result stream must be numerically identical to per-epoch dispatch."""
+    train, val = tiny_data
+    kw = dict(
+        train_data=train, val_data=val, metric="validation_mse", mode="min",
+        num_samples=4, seed=13, verbose=0,
+    )
+    one = run_vectorized(
+        dict(MLP_SPACE, num_epochs=6), storage_path=str(tmp_path / "e1"), **kw
+    )
+    four = run_vectorized(
+        dict(MLP_SPACE, num_epochs=6), storage_path=str(tmp_path / "e4"),
+        epochs_per_dispatch=4, **kw
+    )
+    for ta, tb in zip(one.trials, four.trials):
+        assert ta.config == tb.config
+        assert len(ta.results) == len(tb.results) == 6
+        for ra, rb in zip(ta.results, tb.results):
+            assert ra["validation_mse"] == pytest.approx(
+                rb["validation_mse"], rel=1e-5
+            )
+            assert ra["train_loss"] == pytest.approx(rb["train_loss"], rel=1e-5)
+
+
+def test_multi_epoch_dispatch_with_asha(tiny_data, tmp_path):
+    """Stops land at dispatch boundaries; winners still run to max_t."""
+    train, val = tiny_data
+    analysis = run_vectorized(
+        dict(MLP_SPACE, num_epochs=8), train_data=train, val_data=val,
+        metric="validation_mse", mode="min", num_samples=8,
+        scheduler=tune.ASHAScheduler(
+            max_t=8, grace_period=2, reduction_factor=2
+        ),
+        epochs_per_dispatch=2,
+        storage_path=str(tmp_path), seed=13, verbose=0,
+    )
+    assert analysis.num_terminated() == 8
+    lengths = sorted(len(t.results) for t in analysis.trials)
+    assert lengths[0] < 8 and lengths[-1] == 8
+
+
 def test_vectorized_utilization_is_measured(tiny_data, tmp_path):
     """device_utilization is a measured duty cycle (exec/wall), not the old
     hardcoded 1.0 — compile time alone guarantees it lands strictly below 1."""

@@ -100,6 +100,21 @@ def test_vectorized_pbt_exploit_adopts_good_weights(tiny_data, tmp_path):
     assert checked > 0
 
 
+def test_vectorized_pbt_with_multi_epoch_dispatch(tiny_data, tmp_path):
+    """Perturbations still fire when dispatch chunks cross interval
+    boundaries (at the boundary, at worst chunk-1 epochs late)."""
+    train, val = tiny_data
+    pbt = _pbt()
+    analysis = run_vectorized(
+        SPACE, train_data=train, val_data=val,
+        metric="validation_mse", mode="min", num_samples=8,
+        scheduler=pbt, epochs_per_dispatch=4,
+        storage_path=str(tmp_path), seed=2, verbose=0,
+    )
+    assert all(t.training_iteration == 8 for t in analysis.trials)
+    assert pbt.debug_state()["num_perturbations"] > 0
+
+
 def test_vectorized_pbt_unknown_metric_raises(tiny_data, tmp_path):
     train, val = tiny_data
     sched = tune.PopulationBasedTraining(
