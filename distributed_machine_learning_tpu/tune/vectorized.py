@@ -53,6 +53,8 @@ The jittable program bodies are shared with the per-trial trainable via
 
 from __future__ import annotations
 
+import json
+import os
 import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple, Union
@@ -284,6 +286,8 @@ def run_vectorized(
     compile_cache_dir: Optional[str] = "auto",
     compaction: str = "auto",
     epochs_per_dispatch: int = 1,
+    checkpoint_every_epochs: int = 0,
+    resume: bool = False,
 ) -> ExperimentAnalysis:
     """Run an HPO sweep with trials batched into vmapped populations.
 
@@ -312,6 +316,17 @@ def run_vectorized(
     compaction act at dispatch boundaries, so mid-chunk stops save
     reporting, not FLOPs — pick E to match the scheduler's cadence (e.g.
     ASHA's grace_period, PBT's perturbation_interval).
+
+    ``checkpoint_every_epochs``: preemption tolerance for long sweeps — at
+    matching dispatch boundaries the WHOLE population (params, optimizer
+    state, PRNG keys, row mapping, PBT-mutated lr/wd) is checkpointed to
+    ``<experiment>/population.ckpt``.  ``resume=True`` (requires ``name``)
+    reopens the experiment, replays the stored per-epoch records into the
+    scheduler/searcher, restores the population, and continues from the
+    checkpointed epoch — bit-identical to an uninterrupted run.  Supported
+    for single-chunk sweeps (``num_samples <= max_batch_trials``, one
+    static-signature group): the "one big population" shape that long
+    preemptible-TPU sweeps use.
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
@@ -371,6 +386,13 @@ def run_vectorized(
         pbt = sched
     sched.set_experiment(metric, mode)
 
+    if resume and not name:
+        raise ValueError("resume=True requires name= of the prior run")
+    if resume and num_samples > max_batch_trials:
+        raise ValueError(
+            "resume supports single-chunk sweeps "
+            "(num_samples <= max_batch_trials)"
+        )
     name = name or f"vexp_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:6]}"
     store = ExperimentStore(storage_path, name)
     start_time = time.time()
@@ -416,22 +438,49 @@ def run_vectorized(
     row_epochs = 0  # trial-epochs actually computed (compaction shrinks this)
     exec_total_s = 0.0  # device-execute seconds across all populations
 
+    ckpt_path = (
+        os.path.join(store.root, "population.ckpt")
+        if checkpoint_every_epochs else None
+    )
+    if ckpt_path and num_samples > max_batch_trials:
+        # A multi-chunk sweep would overwrite the single population
+        # checkpoint chunk after chunk, leaving a file resume categorically
+        # rejects — don't write a trap.
+        log(
+            "population checkpointing supports single-chunk sweeps only "
+            f"(num_samples={num_samples} > max_batch_trials="
+            f"{max_batch_trials}); checkpoints disabled"
+        )
+        ckpt_path = None
+    resume_state = None
+    if resume:
+        resume_state, resumed_trials = _load_resume_state(
+            store.root, metric, mode, sched, searcher, pbt
+        )
+        trials = resumed_trials
+        next_index = num_samples  # nothing left to suggest
+
     with jax.default_device(device):
         # Chunked suggest->train loop: adaptive searchers observe all results
         # from earlier chunks before proposing the next one.
-        while next_index < num_samples and not exhausted:
-            chunk: List[Trial] = []
-            while len(chunk) < max_batch_trials and next_index < num_samples:
-                config = searcher.suggest(next_index)
-                if config is None:
-                    exhausted = True
-                    break
-                trial = Trial(trial_id=f"trial_{next_index:05d}", config=config)
-                next_index += 1
-                trials.append(trial)
-                chunk.append(trial)
-                sched.on_trial_add(trial)
-                store.write_params(trial)
+        while (next_index < num_samples and not exhausted) or resume_state:
+            if resume_state is not None:
+                chunk = list(trials)
+            else:
+                chunk = []
+                while len(chunk) < max_batch_trials and next_index < num_samples:
+                    config = searcher.suggest(next_index)
+                    if config is None:
+                        exhausted = True
+                        break
+                    trial = Trial(
+                        trial_id=f"trial_{next_index:05d}", config=config
+                    )
+                    next_index += 1
+                    trials.append(trial)
+                    chunk.append(trial)
+                    sched.on_trial_add(trial)
+                    store.write_params(trial)
             if not chunk:
                 break
 
@@ -442,6 +491,13 @@ def run_vectorized(
                 f"chunk of {len(chunk)} trials in {len(groups)} static "
                 f"group(s) [{len(trials)}/{num_samples} suggested]"
             )
+            group_ckpt_path = ckpt_path
+            if ckpt_path and len(groups) > 1:
+                log(
+                    "population checkpointing needs a single static group; "
+                    f"this chunk has {len(groups)} — checkpoints disabled"
+                )
+                group_ckpt_path = None
             for sig, members in groups.items():
                 program = programs.get(sig)
                 if program is None:
@@ -455,7 +511,9 @@ def run_vectorized(
                     program, members, sched, searcher, store, metric, mode,
                     log, tracker, compaction, size_multiple,
                     pop_sharding, repl_sharding, pbt, epochs_per_dispatch,
+                    checkpoint_every_epochs, group_ckpt_path, resume_state,
                 )
+                resume_state = None  # consumed by the first (only) group
                 row_epochs += pop_rows
                 exec_total_s += pop_exec_s
                 compile_s = tracker.thread_seconds() - compile_before
@@ -503,6 +561,105 @@ def run_vectorized(
         f"{100 * utilization:.0f}% measured device duty cycle, vectorized)"
     )
     return analysis
+
+
+def _load_resume_state(
+    root: str,
+    metric: str,
+    mode: str,
+    sched: TrialScheduler,
+    searcher: Searcher,
+    pbt,
+) -> Tuple[Dict[str, Any], List[Trial]]:
+    """Rehydrate an interrupted single-chunk sweep: load the population
+    checkpoint, rebuild Trial objects from the on-disk store, and replay
+    their per-epoch records through the scheduler/searcher so rung/model
+    state matches the moment of interruption."""
+    from distributed_machine_learning_tpu.tune import checkpoint as ckpt_lib
+
+    ck = ckpt_lib.load_checkpoint(os.path.join(root, "population.ckpt"))
+    if ck is None:
+        raise ValueError(
+            f"resume=True but no population checkpoint under {root} "
+            f"(was the run started with checkpoint_every_epochs > 0?)"
+        )
+    prior = ExperimentAnalysis.from_directory(root, metric, mode)
+    batch = sorted(prior.trials, key=lambda t: t.trial_id)
+    if not batch:
+        raise ValueError(f"no trials found under {root}")
+    active = [bool(a) for a in np.asarray(ck["active"])]
+    lrs = np.asarray(ck["lrs"], np.float32)
+    wds = np.asarray(ck["wds"], np.float32)
+    epoch0 = int(ck["epoch0"])
+    if len(batch) != len(active):
+        raise ValueError(
+            f"checkpoint population size ({len(active)}) does not match the "
+            f"{len(batch)} trials stored under {root} — the checkpoint is "
+            f"not from this (single-chunk) sweep"
+        )
+    now = time.time()
+    for trial in batch:
+        # The crash may have landed mid-epoch: some trials carry records
+        # BEYOND the checkpoint. Those epochs re-run on resume, so drop the
+        # stale records (memory and file) or they would double-count.
+        kept = [
+            r for r in trial.results
+            if int(r.get("training_iteration", 0)) <= epoch0
+        ]
+        if len(kept) != len(trial.results):
+            trial.results = kept
+            with open(
+                os.path.join(root, trial.trial_id, "result.jsonl"), "w"
+            ) as f:
+                for r in kept:
+                    f.write(json.dumps(r) + "\n")
+    for idx, trial in enumerate(batch):
+        trial.config = dict(trial.config)
+        # PBT may have mutated lr/wd since params.json was written.
+        trial.config["learning_rate"] = float(lrs[idx])
+        if "weight_decay" in trial.config:
+            trial.config["weight_decay"] = float(wds[idx])
+        sched.on_trial_add(trial)
+        # Keep time_total_s continuous across the interruption.
+        last = trial.results[-1] if trial.results else None
+        trial.started_at = now - float(last["time_total_s"]) if last else now
+        trial.reports_since_restart = len(trial.results)
+        trial.status = (
+            TrialStatus.RUNNING if active[idx] else TrialStatus.TERMINATED
+        )
+        if not active[idx]:
+            # Freeze the stopped trial's clock at its recorded runtime, or
+            # runtime_s() keeps growing for the resumed run's duration.
+            trial.finished_at = trial.started_at + (
+                float(last["time_total_s"]) if last else 0.0
+            )
+    # Replay in epoch-major order — the order the live loop produced them.
+    max_len = max(len(t.results) for t in batch)
+    for e in range(max_len):
+        for trial in batch:
+            if e < len(trial.results):
+                record = trial.results[e]
+                if pbt is None:
+                    sched.on_trial_result(trial, record)
+                searcher.on_trial_result(
+                    trial.trial_id, dict(trial.config), record, metric, mode
+                )
+    for idx, trial in enumerate(batch):
+        if not active[idx]:
+            sched.on_trial_complete(trial)
+            searcher.on_trial_complete(
+                trial.trial_id, trial.config, trial.last_result, metric, mode
+            )
+    resume_state = {
+        "state_dict": ck["state"],
+        "key_data": np.asarray(ck["key_data"]),
+        "rows": [int(r) for r in np.asarray(ck["rows"])],
+        "active": active,
+        "lrs": lrs,
+        "wds": wds,
+        "epoch0": int(ck["epoch0"]),
+    }
+    return resume_state, batch
 
 
 def _emit_epoch_records(
@@ -579,6 +736,9 @@ def _run_population(
     repl_sharding=None,
     pbt=None,
     epochs_per_dispatch: int = 1,
+    ckpt_every: int = 0,
+    ckpt_path: Optional[str] = None,
+    resume_state: Optional[Dict[str, Any]] = None,
 ) -> Tuple[int, float]:
     """Train one population of K same-shape trials to completion.
 
@@ -586,45 +746,97 @@ def _run_population(
     (rows x epochs — the honest FLOP-cost denominator under compaction) and
     device-execute wall seconds (the utilization numerator)."""
     k = len(batch)
-    now = time.time()
-    for t in batch:
-        t.status = TrialStatus.RUNNING
-        t.started_at = now
+    from distributed_machine_learning_tpu.tune import checkpoint as ckpt_lib
 
-    seeds = np.asarray(
-        [int(t.config.get("seed", 0)) for t in batch], np.uint32
-    )
-    lrs = np.asarray(
-        [float(t.config["learning_rate"]) for t in batch], np.float32
-    )
-    wds = np.asarray(
-        [float(t.config.get("weight_decay", 0.0)) for t in batch], np.float32
-    )
-    # Pad the population up to the platform's size multiple with dummy rows
-    # (row 0's hyperparams, distinct seeds).  On TPU the sublane padding
-    # makes these rows nearly free, and aligned sizes avoid the backend's
-    # ragged-size kernel fault (see run_vectorized).
-    pad_rows = (-k) % size_multiple
-    if pad_rows:
-        if pad_rows >= k:
-            log(
-                f"population of {k} padded to {k + pad_rows} for size "
-                f"alignment — most rows are dummies; use chunks of at "
-                f"least {size_multiple} trials to avoid the waste"
-            )
-        seeds = np.concatenate([seeds, seeds[:1] + 1 + np.arange(pad_rows,
-                                dtype=np.uint32) * 7919])
-        lrs = np.concatenate([lrs, np.repeat(lrs[:1], pad_rows)])
-        wds = np.concatenate([wds, np.repeat(wds[:1], pad_rows)])
-    base_keys = jax.vmap(jax.random.key)(jnp.asarray(seeds))
-    params, opt_state, batch_stats = program.init_population(
-        base_keys, jnp.asarray(lrs), jnp.asarray(wds)
-    )
+    now = time.time()
+    epoch_start = 0
+    if resume_state is not None:
+        # Restore the interrupted population: rebuild a template at the
+        # checkpointed row count (compaction may have shrunk it), then pour
+        # the saved state into it.
+        lrs = np.asarray(resume_state["lrs"], np.float32)
+        wds = np.asarray(resume_state["wds"], np.float32)
+        rows = list(resume_state["rows"])
+        active = list(resume_state["active"])
+        epoch_start = int(resume_state["epoch0"])
+        base_keys = jax.random.wrap_key_data(
+            jnp.asarray(resume_state["key_data"])
+        )
+        row_lr = jnp.asarray(
+            [lrs[r] if r >= 0 else float(lrs[0]) for r in rows], jnp.float32
+        )
+        row_wd = jnp.asarray(
+            [wds[r] if r >= 0 else float(wds[0]) for r in rows], jnp.float32
+        )
+        # eval_shape: the template only provides structure/dtypes for the
+        # msgpack restore — no compile, no device allocation of a population
+        # that the next line would throw away.
+        template = jax.eval_shape(
+            program.init_population, base_keys, row_lr, row_wd
+        )
+        restored = ckpt_lib.restore_into(
+            {"params": template[0], "opt_state": template[1],
+             "batch_stats": template[2]},
+            resume_state["state_dict"],
+        )
+        params = restored["params"]
+        opt_state = restored["opt_state"]
+        batch_stats = restored["batch_stats"]
+        log(
+            f"resumed population of {len(rows)} rows at epoch {epoch_start}"
+        )
+    else:
+        for t in batch:
+            t.status = TrialStatus.RUNNING
+            t.started_at = now
+
+        seeds = np.asarray(
+            [int(t.config.get("seed", 0)) for t in batch], np.uint32
+        )
+        lrs = np.asarray(
+            [float(t.config["learning_rate"]) for t in batch], np.float32
+        )
+        wds = np.asarray(
+            [float(t.config.get("weight_decay", 0.0)) for t in batch],
+            np.float32,
+        )
+        # Pad the population up to the platform's size multiple with dummy
+        # rows (row 0's hyperparams, distinct seeds).  On TPU the sublane
+        # padding makes these rows nearly free, and aligned sizes avoid the
+        # backend's ragged-size kernel fault (see run_vectorized).
+        pad_rows = (-k) % size_multiple
+        if pad_rows:
+            if pad_rows >= k:
+                log(
+                    f"population of {k} padded to {k + pad_rows} for size "
+                    f"alignment — most rows are dummies; use chunks of at "
+                    f"least {size_multiple} trials to avoid the waste"
+                )
+            seeds = np.concatenate([seeds, seeds[:1] + 1 + np.arange(
+                pad_rows, dtype=np.uint32) * 7919])
+            lrs = np.concatenate([lrs, np.repeat(lrs[:1], pad_rows)])
+            wds = np.concatenate([wds, np.repeat(wds[:1], pad_rows)])
+        base_keys = jax.vmap(jax.random.key)(jnp.asarray(seeds))
+        params, opt_state, batch_stats = program.init_population(
+            base_keys, jnp.asarray(lrs), jnp.asarray(wds)
+        )
+        active = [True] * k
+        # ``rows[i]`` = index into ``batch`` of the trial living at
+        # population row i (-1 for dummy pad rows, which are never
+        # reported).  Compaction slices stopped rows out of the pytrees and
+        # shrinks this mapping; everything per-trial (keys, lr/wd, records)
+        # is looked up through it.
+        rows = list(range(k)) + [-1] * pad_rows
     if pop_sharding is not None:
         # init_population already materialized params/opt_state sharded over
         # the mesh (out_shardings); keys are tiny, so placing them too just
-        # saves XLA a reshard in the first epoch.
+        # saves XLA a reshard in the first epoch.  A restored state came
+        # back as host arrays, so it needs placing too.
         base_keys = jax.device_put(base_keys, pop_sharding)
+        if resume_state is not None:
+            params, opt_state, batch_stats = jax.device_put(
+                (params, opt_state, batch_stats), pop_sharding
+            )
         if not getattr(program, "_data_replicated", False):
             d = program.data
             for field in ("x_train", "y_train", "x_val", "y_val", "val_mask"):
@@ -633,12 +845,6 @@ def _run_population(
             program._data_replicated = True
 
     data = program.data
-    active = [True] * k
-    # ``rows[i]`` = index into ``batch`` of the trial living at population
-    # row i (-1 for dummy pad rows, which are never reported).  Compaction
-    # slices stopped rows out of the pytrees and shrinks this mapping;
-    # everything per-trial (keys, lr/wd, records) is looked up through it.
-    rows = list(range(k)) + [-1] * pad_rows
     pbt_notes: Dict[int, str] = {}  # trial index -> donor id, for the record
     row_epochs = 0
     exec_total_s = 0.0  # device-execute seconds (utilization numerator)
@@ -670,7 +876,7 @@ def _run_population(
         )
         dispatch = d
 
-    epoch0 = 0
+    epoch0 = epoch_start
     while epoch0 < program.num_epochs:
         chunk = min(dispatch, program.num_epochs - epoch0)
         c0 = tracker.thread_seconds()
@@ -871,6 +1077,29 @@ def _run_population(
                     f"compacted population -> {len(rows)} rows "
                     f"({len(pos)} live) at epoch {epoch}"
                 )
+
+        # Population checkpoint (preemption tolerance): save AFTER PBT and
+        # compaction so the state on disk matches the row mapping.
+        if (
+            ckpt_every
+            and ckpt_path
+            and epoch0 < program.num_epochs
+            and (epoch0 // ckpt_every) > ((epoch0 - chunk) // ckpt_every)
+        ):
+            ckpt_lib.save_checkpoint(ckpt_path, {
+                "state": {
+                    "params": params,
+                    "opt_state": opt_state,
+                    "batch_stats": batch_stats,
+                },
+                "key_data": np.asarray(jax.random.key_data(base_keys)),
+                "rows": np.asarray(rows, np.int64),
+                "active": np.asarray(active, np.bool_),
+                "lrs": np.asarray(lrs, np.float32),
+                "wds": np.asarray(wds, np.float32),
+                "epoch0": epoch0,
+            })
+            log(f"population checkpoint at epoch {epoch0}")
 
     now = time.time()
     for i, trial in enumerate(batch):
