@@ -21,6 +21,19 @@ from distributed_machine_learning_tpu.utils.logging import (
 )
 
 
+def dispatch_safely(callbacks, hook: str, *args, log=lambda msg: None):
+    """Invoke ``hook`` on every callback, isolating observer failures.
+
+    Shared by the threaded and vectorized drivers: a raising callback is
+    logged and dropped for that event, never fatal to the sweep (a trial
+    thread may be blocked waiting on the event loop that runs observers)."""
+    for cb in callbacks:
+        try:
+            getattr(cb, hook)(*args)
+        except Exception as exc:  # noqa: BLE001 - observer isolation
+            log(f"{type(cb).__name__}.{hook} raised: {exc!r}")
+
+
 class Callback:
     """Base class; override any subset of hooks.
 

@@ -158,14 +158,11 @@ def run(
     start_time = lifecycle.start_time
 
     def safe_cb(hook: str, *args):
-        """Observers must never wedge the sweep: a raising callback is logged
-        and dropped for that event (the trial thread may be blocked in
-        ``report`` waiting on this loop — see executor.ResultEvent)."""
-        for cb in callbacks:
-            try:
-                getattr(cb, hook)(*args)
-            except Exception as exc:  # noqa: BLE001 - observer isolation
-                log(f"{type(cb).__name__}.{hook} raised: {exc!r}")
+        from distributed_machine_learning_tpu.tune.callbacks import (
+            dispatch_safely,
+        )
+
+        dispatch_safely(callbacks, hook, *args, log=log)
 
     def launch_ready():
         while pending and len(running) < max_concurrent:

@@ -288,6 +288,7 @@ def run_vectorized(
     epochs_per_dispatch: int = 1,
     checkpoint_every_epochs: int = 0,
     resume: bool = False,
+    callbacks: Optional[List] = None,
 ) -> ExperimentAnalysis:
     """Run an HPO sweep with trials batched into vmapped populations.
 
@@ -401,6 +402,15 @@ def run_vectorized(
         if verbose:
             print(f"[tune.vectorized] {msg}", flush=True)
 
+    callbacks = list(callbacks or [])
+
+    def safe_cb(hook: str, *cb_args):
+        from distributed_machine_learning_tpu.tune.callbacks import (
+            dispatch_safely,
+        )
+
+        dispatch_safely(callbacks, hook, *cb_args, log=log)
+
     mesh = pop_sharding = repl_sharding = None
     if devices and len(devices) > 1:
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -460,97 +470,116 @@ def run_vectorized(
         trials = resumed_trials
         next_index = num_samples  # nothing left to suggest
 
-    with jax.default_device(device):
-        # Chunked suggest->train loop: adaptive searchers observe all results
-        # from earlier chunks before proposing the next one.
-        while (next_index < num_samples and not exhausted) or resume_state:
-            if resume_state is not None:
-                chunk = list(trials)
-            else:
-                chunk = []
-                while len(chunk) < max_batch_trials and next_index < num_samples:
-                    config = searcher.suggest(next_index)
-                    if config is None:
-                        exhausted = True
-                        break
-                    trial = Trial(
-                        trial_id=f"trial_{next_index:05d}", config=config
-                    )
-                    next_index += 1
-                    trials.append(trial)
-                    chunk.append(trial)
-                    sched.on_trial_add(trial)
-                    store.write_params(trial)
-            if not chunk:
-                break
-
-            groups: Dict[Tuple, List[Trial]] = {}
-            for t in chunk:
-                groups.setdefault(_static_signature(t.config), []).append(t)
-            log(
-                f"chunk of {len(chunk)} trials in {len(groups)} static "
-                f"group(s) [{len(trials)}/{num_samples} suggested]"
+    def _teardown():
+        """Always runs (exceptions, Ctrl-C): persist state, close the store,
+        and let callbacks see experiment end (ProfilerCallback must stop the
+        process-global trace; JsonlCallback must close its file) — the same
+        guarantee tune.run makes."""
+        wall = time.time() - start_time
+        # MEASURED duty cycle: device-execute seconds (train+eval dispatch
+        # to sync, compile excluded) over wall clock — not a hardcoded 1.0.
+        # With a population mesh every device computes its slice
+        # concurrently, so the fraction applies to all of them alike.
+        utilization = (
+            round(min(exec_total_s / wall, 1.0), 4) if wall > 0 else 0.0
+        )
+        try:
+            store.write_state(
+                trials,
+                extra={
+                    "wall_clock_s": wall,
+                    "device_utilization": utilization,
+                    "device_exec_s": round(exec_total_s, 3),
+                    "vectorized": True,
+                    "row_epochs_computed": row_epochs,
+                    "population_sharded_over": (
+                        len(devices) if mesh is not None else 1
+                    ),
+                    # This RUN's compile seconds (tracker is process-wide).
+                    "compile_time_total_s": round(
+                        tracker.total_seconds() - compile_s_at_start, 3
+                    ),
+                    "compile_cache_hits": tracker.total_cache_hits(),
+                    "compile_cache_entries": cc.cache_entry_count(),
+                },
             )
-            group_ckpt_path = ckpt_path
-            if ckpt_path and len(groups) > 1:
-                log(
-                    "population checkpointing needs a single static group; "
-                    f"this chunk has {len(groups)} — checkpoints disabled"
-                )
-                group_ckpt_path = None
-            for sig, members in groups.items():
-                program = programs.get(sig)
-                if program is None:
-                    program = programs[sig] = _GroupProgram(
-                        dict(members[0].config), train_data, val_data,
-                        pop_sharding,
-                    )
-                compile_before = tracker.thread_seconds()
-                t_pop = time.time()
-                pop_rows, pop_exec_s = _run_population(
-                    program, members, sched, searcher, store, metric, mode,
-                    log, tracker, compaction, size_multiple,
-                    pop_sharding, repl_sharding, pbt, epochs_per_dispatch,
-                    checkpoint_every_epochs, group_ckpt_path, resume_state,
-                )
-                resume_state = None  # consumed by the first (only) group
-                row_epochs += pop_rows
-                exec_total_s += pop_exec_s
-                compile_s = tracker.thread_seconds() - compile_before
-                if compile_s > 0.05:
-                    log(
-                        f"group of {len(members)}: "
-                        f"{time.time() - t_pop - compile_s:.1f}s execute + "
-                        f"{compile_s:.1f}s compile "
-                        f"({tracker.thread_cache_hits()} cache hits so far)"
-                    )
+            store.close()
+        except Exception as exc:  # noqa: BLE001 - callbacks still tear down
+            log(f"experiment store teardown failed: {exc!r}")
+        safe_cb("on_experiment_end", trials, wall)
+        return wall, utilization
 
-    wall = time.time() - start_time
-    # MEASURED duty cycle: device-execute seconds (train+eval dispatch to
-    # sync, compile excluded) over wall clock — not the old hardcoded 1.0.
-    # With a population mesh every device computes its slice concurrently,
-    # so the fraction applies to all of them alike.
-    utilization = (
-        round(min(exec_total_s / wall, 1.0), 4) if wall > 0 else 0.0
-    )
-    store.write_state(
-        trials,
-        extra={
-            "wall_clock_s": wall,
-            "device_utilization": utilization,
-            "device_exec_s": round(exec_total_s, 3),
-            "vectorized": True,
-            "row_epochs_computed": row_epochs,
-            "population_sharded_over": len(devices) if mesh is not None else 1,
-            # This RUN's compile seconds (tracker counts are process-wide).
-            "compile_time_total_s": round(
-                tracker.total_seconds() - compile_s_at_start, 3
-            ),
-            "compile_cache_hits": tracker.total_cache_hits(),
-            "compile_cache_entries": cc.cache_entry_count(),
-        },
-    )
-    store.close()
+    try:
+        for cb in callbacks:
+            cb.setup(store.root, metric, mode)
+        with jax.default_device(device):
+            # Chunked suggest->train loop: adaptive searchers observe all results
+            # from earlier chunks before proposing the next one.
+            while (next_index < num_samples and not exhausted) or resume_state:
+                if resume_state is not None:
+                    chunk = list(trials)
+                else:
+                    chunk = []
+                    while len(chunk) < max_batch_trials and next_index < num_samples:
+                        config = searcher.suggest(next_index)
+                        if config is None:
+                            exhausted = True
+                            break
+                        trial = Trial(
+                            trial_id=f"trial_{next_index:05d}", config=config
+                        )
+                        next_index += 1
+                        trials.append(trial)
+                        chunk.append(trial)
+                        sched.on_trial_add(trial)
+                        store.write_params(trial)
+                if not chunk:
+                    break
+
+                groups: Dict[Tuple, List[Trial]] = {}
+                for t in chunk:
+                    groups.setdefault(_static_signature(t.config), []).append(t)
+                log(
+                    f"chunk of {len(chunk)} trials in {len(groups)} static "
+                    f"group(s) [{len(trials)}/{num_samples} suggested]"
+                )
+                group_ckpt_path = ckpt_path
+                if ckpt_path and len(groups) > 1:
+                    log(
+                        "population checkpointing needs a single static group; "
+                        f"this chunk has {len(groups)} — checkpoints disabled"
+                    )
+                    group_ckpt_path = None
+                for sig, members in groups.items():
+                    program = programs.get(sig)
+                    if program is None:
+                        program = programs[sig] = _GroupProgram(
+                            dict(members[0].config), train_data, val_data,
+                            pop_sharding,
+                        )
+                    compile_before = tracker.thread_seconds()
+                    t_pop = time.time()
+                    pop_rows, pop_exec_s = _run_population(
+                        program, members, sched, searcher, store, metric, mode,
+                        log, tracker, compaction, size_multiple,
+                        pop_sharding, repl_sharding, pbt, epochs_per_dispatch,
+                        checkpoint_every_epochs, group_ckpt_path, resume_state,
+                        safe_cb,
+                    )
+                    resume_state = None  # consumed by the first (only) group
+                    row_epochs += pop_rows
+                    exec_total_s += pop_exec_s
+                    compile_s = tracker.thread_seconds() - compile_before
+                    if compile_s > 0.05:
+                        log(
+                            f"group of {len(members)}: "
+                            f"{time.time() - t_pop - compile_s:.1f}s execute + "
+                            f"{compile_s:.1f}s compile "
+                            f"({tracker.thread_cache_hits()} cache hits so far)"
+                        )
+    finally:
+        wall, utilization = _teardown()
+
     analysis = ExperimentAnalysis(
         trials, metric=metric, mode=mode, root=store.root, wall_clock_s=wall,
         device_utilization=utilization,
@@ -665,7 +694,7 @@ def _load_resume_state(
 def _emit_epoch_records(
     batch, rows, active, lrs, epoch, step_count, shape_val, now,
     train_losses, metrics_np, pbt_notes, pbt, sched, searcher, store,
-    metric, mode,
+    metric, mode, safe_cb=lambda *a: None,
 ):
     """Append one epoch's records for every live trial and route them through
     the scheduler/searcher (the vectorized analogue of ``session.report``)."""
@@ -695,6 +724,7 @@ def _emit_epoch_records(
         # same contract the threaded executor maintains via report().
         trial.reports_since_restart += 1
         store.append_result(trial, record)
+        safe_cb("on_trial_result", trial, record)
         # PBT never stops trials and its REQUEUE protocol is replaced by
         # the in-population gather at the dispatch boundary, so the
         # scheduler is bypassed.
@@ -718,6 +748,7 @@ def _emit_epoch_records(
             searcher.on_trial_complete(
                 trial.trial_id, trial.config, trial.last_result, metric, mode
             )
+            safe_cb("on_trial_complete", trial)
 
 
 def _run_population(
@@ -739,6 +770,7 @@ def _run_population(
     ckpt_every: int = 0,
     ckpt_path: Optional[str] = None,
     resume_state: Optional[Dict[str, Any]] = None,
+    safe_cb=lambda *a: None,
 ) -> Tuple[int, float]:
     """Train one population of K same-shape trials to completion.
 
@@ -789,6 +821,7 @@ def _run_population(
         for t in batch:
             t.status = TrialStatus.RUNNING
             t.started_at = now
+            safe_cb("on_trial_start", t)
 
         seeds = np.asarray(
             [int(t.config.get("seed", 0)) for t in batch], np.uint32
@@ -938,12 +971,15 @@ def _run_population(
             _emit_epoch_records(
                 batch, rows, active, lrs, epoch, step_count, shape_val, now,
                 train_losses, metrics_np, pbt_notes, pbt, sched, searcher,
-                store, metric, mode,
+                store, metric, mode, safe_cb,
             )
         epoch0 += chunk
         epoch = epoch0 - 1  # last completed epoch (PBT/compaction below)
         train_losses = tl_chunk[:, -1]
         metrics_np = {key: v[:, -1] for key, v in metrics_chunk.items()}
+        # One heartbeat per dispatch: ProfilerCallback bounds its trace
+        # window on this hook (callbacks.py), same as tune.run's event loop.
+        safe_cb("on_heartbeat")
 
         # ---- vectorized PBT: exploit = one gather over the population ------
         # A chunk may cross interval boundaries; fire when it did (at worst
@@ -1110,4 +1146,5 @@ def _run_population(
             searcher.on_trial_complete(
                 trial.trial_id, trial.config, trial.last_result, metric, mode
             )
+            safe_cb("on_trial_complete", trial)
     return row_epochs, exec_total_s

@@ -248,6 +248,86 @@ def test_multi_epoch_dispatch_with_asha(tiny_data, tmp_path):
     assert lengths[0] < 8 and lengths[-1] == 8
 
 
+def test_vectorized_callbacks_fire(tiny_data, tmp_path):
+    """Observability parity with tune.run: callbacks see the vectorized
+    sweep's lifecycle, and a raising callback never wedges it."""
+    from distributed_machine_learning_tpu.tune.callbacks import (
+        Callback,
+        JsonlCallback,
+    )
+
+    events = []
+
+    class Recorder(Callback):
+        def setup(self, root, metric, mode):
+            events.append(("setup", root))
+
+        def on_trial_start(self, trial):
+            events.append(("start", trial.trial_id))
+
+        def on_trial_result(self, trial, result):
+            events.append(("result", trial.trial_id,
+                           result["training_iteration"]))
+
+        def on_trial_complete(self, trial):
+            events.append(("complete", trial.trial_id))
+
+        def on_experiment_end(self, trials, wall):
+            events.append(("end", len(trials)))
+
+    class Broken(Callback):
+        def on_trial_result(self, trial, result):
+            raise RuntimeError("observer bug")
+
+    jsonl_path = str(tmp_path / "events.jsonl")
+    train, val = tiny_data
+    analysis = run_vectorized(
+        dict(MLP_SPACE, num_epochs=3), train_data=train, val_data=val,
+        metric="validation_mse", mode="min", num_samples=4,
+        storage_path=str(tmp_path), verbose=0,
+        callbacks=[Recorder(), Broken(), JsonlCallback(jsonl_path)],
+    )
+    assert analysis.num_terminated() == 4
+    kinds = [e[0] for e in events]
+    assert kinds.count("setup") == 1
+    assert kinds.count("start") == 4
+    assert kinds.count("result") == 12  # 4 trials x 3 epochs
+    assert kinds.count("complete") == 4
+    assert kinds.count("end") == 1
+    import os
+
+    assert os.path.getsize(jsonl_path) > 0
+
+
+def test_vectorized_callback_teardown_on_crash(tiny_data, tmp_path):
+    """on_experiment_end fires even when the sweep raises mid-flight, so
+    ProfilerCallback/JsonlCallback can always release their resources."""
+    from distributed_machine_learning_tpu.tune.callbacks import Callback
+    from distributed_machine_learning_tpu.tune.schedulers.base import (
+        FIFOScheduler,
+    )
+
+    seen = []
+
+    class Recorder(Callback):
+        def on_experiment_end(self, trials, wall):
+            seen.append(len(trials))
+
+    class Dies(FIFOScheduler):
+        def on_trial_result(self, trial, result):
+            raise RuntimeError("boom")
+
+    train, val = tiny_data
+    with pytest.raises(RuntimeError, match="boom"):
+        run_vectorized(
+            dict(MLP_SPACE, num_epochs=2), train_data=train, val_data=val,
+            metric="validation_mse", mode="min", num_samples=2,
+            scheduler=Dies(), storage_path=str(tmp_path), verbose=0,
+            callbacks=[Recorder()],
+        )
+    assert seen == [2]
+
+
 def test_vectorized_utilization_is_measured(tiny_data, tmp_path):
     """device_utilization is a measured duty cycle (exec/wall), not the old
     hardcoded 1.0 — compile time alone guarantees it lands strictly below 1."""
