@@ -11,6 +11,7 @@ from typing import Any, Dict
 
 from distributed_machine_learning_tpu.models.cnn import CNN1DRegressor
 from distributed_machine_learning_tpu.models.mlp import MLPRegressor
+from distributed_machine_learning_tpu.models.moe import MoEFF
 from distributed_machine_learning_tpu.models.resnet import (
     ResNet18Regressor,
     ResNetRegressor,
@@ -58,6 +59,11 @@ def _build_transformer(config: Dict[str, Any]):
         depthwise_separable_conv=config.get("depthwise_separable_conv", False),
         attn_kernel_size=config.get("attn_kernel_size", 3),
         stochastic_depth_rate=config.get("stochastic_depth_rate", 0.0),
+        feedforward_type=config.get("feedforward_type"),
+        num_experts=config.get("num_experts", 8),
+        expert_top_k=config.get("expert_top_k", 2),
+        capacity_factor=config.get("capacity_factor", 1.25),
+        moe_aux_coef=config.get("moe_aux_coef", 1e-2),
         shared_weights=config.get("shared_weights", False),
         max_seq_length=config.get("max_seq_length", 2000),
         out_features=config.get("out_features", 1),
@@ -94,6 +100,7 @@ __all__ = [
     "models",
     "build_model",
     "MLPRegressor",
+    "MoEFF",
     "CNN1DRegressor",
     "TransformerRegressor",
     "SimpleTransformerRegressor",

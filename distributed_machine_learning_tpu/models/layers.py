@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from distributed_machine_learning_tpu.models.moe import MoEFF
 from distributed_machine_learning_tpu.ops.attention import (
     blockwise_attention,
     dot_product_attention,
@@ -282,6 +283,14 @@ class EncoderLayer(nn.Module):
     depthwise_separable_conv: bool = False
     attn_kernel_size: int = 3
     stochastic_depth_rate: float = 0.0
+    # Feed-forward selector: "linear" | "depthwise_separable" | "moe".
+    # None defers to the legacy `depthwise_separable_conv` bool (the
+    # reference's knob, `ray-tune-hpo-regression.py:148-155`).
+    feedforward_type: Optional[str] = None
+    num_experts: int = 8
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    moe_aux_coef: float = 1e-2
     seq_axis: Optional[str] = None
     batch_axis: Optional[str] = "dp"
     head_axis: Optional[str] = "tp"
@@ -304,17 +313,35 @@ class EncoderLayer(nn.Module):
         attn = StochasticDepth(self.stochastic_depth_rate)(attn, deterministic)
         x = nn.LayerNorm(name="norm1")(x + attn)
 
-        if self.depthwise_separable_conv:
+        ff_type = self.feedforward_type or (
+            "depthwise_separable" if self.depthwise_separable_conv else "linear"
+        )
+        if ff_type == "depthwise_separable":
             ff = DepthwiseSeparableFF(
                 d_model=self.d_model,
                 dim_feedforward=self.dim_feedforward,
                 kernel_size=self.attn_kernel_size,
                 name="ff",
             )(x)
-        else:
+        elif ff_type == "moe":
+            ff = MoEFF(
+                d_model=self.d_model,
+                dim_feedforward=self.dim_feedforward,
+                num_experts=self.num_experts,
+                top_k=self.expert_top_k,
+                capacity_factor=self.capacity_factor,
+                aux_loss_coef=self.moe_aux_coef,
+                name="ff",
+            )(x)
+        elif ff_type == "linear":
             ff = LinearFF(
                 d_model=self.d_model, dim_feedforward=self.dim_feedforward, name="ff"
             )(x)
+        else:
+            raise ValueError(
+                f"Unknown feedforward_type {ff_type!r}; expected "
+                f"'linear', 'depthwise_separable', or 'moe'"
+            )
         ff = nn.Dropout(self.dropout_rate)(ff, deterministic=deterministic)
         ff = StochasticDepth(self.stochastic_depth_rate)(ff, deterministic)
         return nn.LayerNorm(name="norm2")(x + ff)

@@ -65,6 +65,15 @@ class TransformerRegressor(nn.Module):
     depthwise_separable_conv: bool = False
     attn_kernel_size: int = 3
     stochastic_depth_rate: float = 0.0
+    # Feed-forward family: "linear" | "depthwise_separable" | "moe" (None =
+    # legacy depthwise_separable_conv bool). "moe" makes every block's FF a
+    # top-k routed expert mixture (models/moe.py) whose stacked expert
+    # params shard over the 'ep' mesh axis.
+    feedforward_type: Optional[str] = None
+    num_experts: int = 8
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    moe_aux_coef: float = 1e-2
     shared_weights: bool = False
     max_seq_length: int = 2000
     head_hidden_sizes: Sequence[int] = (128, 64, 32, 16)
@@ -96,6 +105,11 @@ class TransformerRegressor(nn.Module):
             depthwise_separable_conv=self.depthwise_separable_conv,
             attn_kernel_size=self.attn_kernel_size,
             stochastic_depth_rate=self.stochastic_depth_rate,
+            feedforward_type=self.feedforward_type,
+            num_experts=self.num_experts,
+            expert_top_k=self.expert_top_k,
+            capacity_factor=self.capacity_factor,
+            moe_aux_coef=self.moe_aux_coef,
             seq_axis=self.seq_axis,
             batch_axis=self.batch_axis,
             head_axis=self.head_axis,
@@ -115,6 +129,9 @@ class TransformerRegressor(nn.Module):
             ScanLayer = nn.scan(
                 _ScanEncoderBody,
                 variable_broadcast="params",
+                # Sown per-layer values (e.g. the MoE aux loss) stack along
+                # the scan dimension instead of erroring inside nn.scan.
+                variable_axes={"moe": 0},
                 split_rngs={"params": False, "dropout": True},
                 length=self.num_layers,
                 in_axes=(nn.broadcast,),

@@ -10,6 +10,7 @@ Axis conventions used across the framework:
   ``dp`` — data parallel (batch dimension)
   ``sp`` — sequence parallel (sequence dimension of activations)
   ``tp`` — tensor parallel (hidden/heads dimensions of params+activations)
+  ``ep`` — expert parallel (the expert dimension of MoE parameter stacks)
 """
 
 from __future__ import annotations
@@ -41,13 +42,22 @@ def make_mesh(
     return Mesh(arr, tuple(sizes.keys()))
 
 
-def auto_mesh(n_devices: Optional[int] = None, *, tp: int = 1, sp: int = 1) -> Mesh:
-    """A mesh over the first n devices: dp fills whatever tp/sp don't use."""
+def auto_mesh(
+    n_devices: Optional[int] = None, *, tp: int = 1, sp: int = 1, ep: int = 1
+) -> Mesh:
+    """A mesh over the first n devices: dp fills whatever tp/sp/ep don't use.
+
+    All four axes are always present (size 1 when unused) so shardings that
+    name them — P("tp", ...), P("ep", ...) — stay valid for any auto_mesh.
+    """
     devices = list(jax.devices())
     n = n_devices or len(devices)
-    if n % (tp * sp) != 0:
-        raise ValueError(f"{n} devices not divisible by tp*sp={tp * sp}")
-    return make_mesh({"dp": n // (tp * sp), "sp": sp, "tp": tp}, devices[:n])
+    used = tp * sp * ep
+    if n % used != 0:
+        raise ValueError(f"{n} devices not divisible by tp*sp*ep={used}")
+    return make_mesh(
+        {"dp": n // used, "sp": sp, "ep": ep, "tp": tp}, devices[:n]
+    )
 
 
 def batch_sharding(mesh: Mesh, *, shard_seq: bool = False) -> NamedSharding:

@@ -39,6 +39,15 @@ TRANSFORMER_TP_RULES: Tuple[Tuple[str, P], ...] = (
     (r".*ff/pointwise/bias$", P("tp")),
     (r".*ff/out_proj/kernel$", P("tp", None)),
     (r".*ff/out_proj/bias$", P()),
+    # MoE expert stacks (models/moe.py): expert dim over 'ep', and the
+    # per-expert matmul dims over 'tp' (column-parallel in, row-parallel
+    # out) — experts and attention-head groups shard over different axes,
+    # so ep x tp runs expert-parallel and tensor-parallel together.
+    (r".*ff/w_in$", P("ep", None, "tp")),
+    (r".*ff/b_in$", P("ep", "tp")),
+    (r".*ff/w_out$", P("ep", "tp", None)),
+    (r".*ff/b_out$", P("ep", None)),
+    (r".*ff/router/.*", P()),  # router is tiny; replicate
     (r".*", P()),  # everything else replicated
 )
 

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_machine_learning_tpu.models.moe import collect_aux
 from distributed_machine_learning_tpu.parallel.sharding import (
     TRANSFORMER_TP_RULES,
     opt_state_shardings,
@@ -70,13 +71,17 @@ def make_sharded_train_step(
         x = jax.lax.with_sharding_constraint(x, x_sharding)
 
         def loss_of(p):
-            preds = model.apply(
+            # mutable=["moe"]: collect the MoE load-balance aux terms (sown
+            # by models/moe.py, pre-scaled); without it flax silently drops
+            # the sow and the router would get no balancing gradient.
+            preds, mut = model.apply(
                 {"params": p},
                 x,
                 rngs={"dropout": rng},
+                mutable=["moe"],
                 **{flag_name: False if flag_name == "deterministic" else True},
             )
-            return loss_fn(preds.astype(jnp.float32), y)
+            return loss_fn(preds.astype(jnp.float32), y) + collect_aux(mut)
 
         loss, grads = jax.value_and_grad(loss_of)(params)
         updates, new_opt = tx.update(grads, opt_state, params)

@@ -26,8 +26,13 @@ def make_forward(model, flag_name: str, has_bn: bool) -> Callable:
     """Unified apply() over the zoo's two call conventions.
 
     Returns ``forward(params, batch_stats, x, dropout_key, train) ->
-    (preds, new_batch_stats)``.
+    (preds, new_batch_stats, aux_loss)``.  ``aux_loss`` collects everything
+    the model sowed into the ``"moe"`` collection (the MoE load-balance
+    terms, already scaled by their coefficient — models/moe.py); it is 0.0
+    for dense models and is added to the training objective only.
     """
+
+    from distributed_machine_learning_tpu.models.moe import collect_aux
 
     def forward(params, batch_stats, x, dropout_key, train: bool):
         vs = {"params": params}
@@ -35,13 +40,10 @@ def make_forward(model, flag_name: str, has_bn: bool) -> Callable:
             vs["batch_stats"] = batch_stats
         kwargs = {flag_name: (not train) if flag_name == "deterministic" else train}
         rngs = {"dropout": dropout_key} if train else None
-        if has_bn and train:
-            out, mut = model.apply(
-                vs, x, rngs=rngs, mutable=["batch_stats"], **kwargs
-            )
-            return out, mut["batch_stats"]
-        out = model.apply(vs, x, rngs=rngs, **kwargs)
-        return out, batch_stats
+        mutable = ["moe"] + (["batch_stats"] if has_bn and train else [])
+        out, mut = model.apply(vs, x, rngs=rngs, mutable=mutable, **kwargs)
+        new_bs = mut["batch_stats"] if (has_bn and train) else batch_stats
+        return out, new_bs, collect_aux(mut)
 
     return forward
 
@@ -80,8 +82,8 @@ def make_epoch_fn(
             xb, yb = x_all[idx], y_all[idx]
 
             def loss_of(p):
-                preds, new_bs = forward(p, batch_stats, xb, dkey, train=True)
-                return loss_fn(preds.astype(jnp.float32), yb), new_bs
+                preds, new_bs, aux = forward(p, batch_stats, xb, dkey, train=True)
+                return loss_fn(preds.astype(jnp.float32), yb) + aux, new_bs
 
             (loss, new_bs), grads = jax.value_and_grad(loss_of, has_aux=True)(
                 params
@@ -112,7 +114,7 @@ def make_eval_fn(
 
         def step(_, batch):
             x, y, m = batch
-            preds, _ = forward(
+            preds, _, _ = forward(
                 params, batch_stats, x, jax.random.key(0), train=False
             )
             preds = preds.astype(jnp.float32)

@@ -147,8 +147,8 @@ def train_sharded_regressor(
             key = jax.random.fold_in(epoch_key, i)
 
             def loss_of(p):
-                preds, new_bs = forward(p, batch_stats, x, key, True)
-                return loss_fn(preds.astype(jnp.float32), y), new_bs
+                preds, new_bs, aux = forward(p, batch_stats, x, key, True)
+                return loss_fn(preds.astype(jnp.float32), y) + aux, new_bs
 
             (loss, new_bs), grads = jax.value_and_grad(
                 loss_of, has_aux=True
@@ -179,7 +179,7 @@ def train_sharded_regressor(
     mask_np = (np.arange(len(xv_np)) < n_val).astype(np.float32)
 
     def eval_fn(params, batch_stats, xv, yv, mask):
-        preds, _ = forward(params, batch_stats, xv, jax.random.key(0), False)
+        preds, _, _ = forward(params, batch_stats, xv, jax.random.key(0), False)
         se, ae, ape = per_example_losses(preds.astype(jnp.float32), yv)
         denom = mask.sum()
         return {
