@@ -68,6 +68,7 @@ def _build_transformer(config: Dict[str, Any]):
         max_seq_length=config.get("max_seq_length", 2000),
         out_features=config.get("out_features", 1),
         seq_axis=config.get("seq_axis"),
+        seq_parallel_mode=config.get("seq_parallel_mode", "ring"),
         batch_axis=config.get("batch_axis", "dp"),
         head_axis=config.get("head_axis", "tp"),
         mesh=config.get("mesh"),

@@ -120,10 +120,14 @@ class MultiHeadAttention(nn.Module):
     dropout_rate: float = 0.0
     causal: bool = False
     block_size: int = 128
-    # Sequence parallelism: when set (with a mesh), softmax attention runs as
-    # ring attention sharded over this mesh axis — the long-context path.
+    # Sequence parallelism: when set (with a mesh), softmax attention runs
+    # sequence-sharded over this mesh axis — the long-context path.
     # Requires the surrounding jit to shard x's sequence dim over `seq_axis`.
+    # `seq_parallel_mode` picks the strategy: "ring" (ppermute K/V rotation,
+    # parallel/ring_attention.py) or "ulysses" (all_to_all head/seq
+    # reshuffle, parallel/ulysses.py — needs divisible head counts).
     seq_axis: Optional[str] = None
+    seq_parallel_mode: str = "ring"
     batch_axis: Optional[str] = "dp"
     head_axis: Optional[str] = "tp"
     mesh: Optional[Mesh] = None
@@ -167,12 +171,22 @@ class MultiHeadAttention(nn.Module):
                     f"sequence-parallel: ring attention implements softmax "
                     f"attention only. Drop seq_axis or use a softmax variant."
                 )
-            from distributed_machine_learning_tpu.parallel.ring_attention import (
-                ring_attention,
-            )
+            if self.seq_parallel_mode == "ulysses":
+                from distributed_machine_learning_tpu.parallel.ulysses import (
+                    ulysses_attention as seq_parallel_attention,
+                )
+            elif self.seq_parallel_mode == "ring":
+                from distributed_machine_learning_tpu.parallel.ring_attention import (
+                    ring_attention as seq_parallel_attention,
+                )
+            else:
+                raise ValueError(
+                    f"Unknown seq_parallel_mode {self.seq_parallel_mode!r}; "
+                    f"expected 'ring' or 'ulysses'"
+                )
 
             scale = float(head_dim) ** (-self.key_dim_scaling)
-            out = ring_attention(
+            out = seq_parallel_attention(
                 q, k, v,
                 mesh=self.mesh,
                 axis_name=self.seq_axis,
@@ -292,6 +306,7 @@ class EncoderLayer(nn.Module):
     capacity_factor: float = 1.25
     moe_aux_coef: float = 1e-2
     seq_axis: Optional[str] = None
+    seq_parallel_mode: str = "ring"
     batch_axis: Optional[str] = "dp"
     head_axis: Optional[str] = "tp"
     mesh: Optional[Mesh] = None
@@ -305,6 +320,7 @@ class EncoderLayer(nn.Module):
             key_dim_scaling=self.key_dim_scaling,
             dropout_rate=self.dropout_rate,
             seq_axis=self.seq_axis,
+            seq_parallel_mode=self.seq_parallel_mode,
             batch_axis=self.batch_axis,
             head_axis=self.head_axis,
             mesh=self.mesh,

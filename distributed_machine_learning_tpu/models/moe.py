@@ -83,13 +83,13 @@ class MoEFF(nn.Module):
         # Static per-expert capacity per group, with headroom for imbalance.
         capacity = max(int(self.capacity_factor * K * g / E), 1)
 
-        w_in = self.param(
-            "w_in", nn.initializers.lecun_normal(), (E, D, F), jnp.float32
-        )
+        # batch_axis=0: the expert dim is a batch of independent MLPs, not
+        # receptive field — without it variance_scaling counts fan_in = E*D
+        # and every expert starts sqrt(E) under-scaled.
+        expert_init = nn.initializers.lecun_normal(batch_axis=0)
+        w_in = self.param("w_in", expert_init, (E, D, F), jnp.float32)
         b_in = self.param("b_in", nn.initializers.zeros, (E, F), jnp.float32)
-        w_out = self.param(
-            "w_out", nn.initializers.lecun_normal(), (E, F, D), jnp.float32
-        )
+        w_out = self.param("w_out", expert_init, (E, F, D), jnp.float32)
         b_out = self.param("b_out", nn.initializers.zeros, (E, D), jnp.float32)
 
         toks = x.reshape(G, g, D)

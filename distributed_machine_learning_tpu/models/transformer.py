@@ -79,10 +79,12 @@ class TransformerRegressor(nn.Module):
     head_hidden_sizes: Sequence[int] = (128, 64, 32, 16)
     out_features: int = 1
     # Long-context sequence parallelism: with a mesh + seq_axis, every
-    # attention block runs as ring attention over that mesh axis
-    # (parallel/ring_attention.py) while the rest of the model stays under
-    # GSPMD — sequence length then scales with the mesh, not per-chip HBM.
+    # attention block runs sequence-sharded over that mesh axis while the
+    # rest of the model stays under GSPMD — sequence length then scales with
+    # the mesh, not per-chip HBM. seq_parallel_mode picks "ring"
+    # (parallel/ring_attention.py) or "ulysses" (parallel/ulysses.py).
     seq_axis: Optional[str] = None
+    seq_parallel_mode: str = "ring"
     batch_axis: Optional[str] = "dp"
     head_axis: Optional[str] = "tp"
     mesh: Optional[Mesh] = None
@@ -111,6 +113,7 @@ class TransformerRegressor(nn.Module):
             capacity_factor=self.capacity_factor,
             moe_aux_coef=self.moe_aux_coef,
             seq_axis=self.seq_axis,
+            seq_parallel_mode=self.seq_parallel_mode,
             batch_axis=self.batch_axis,
             head_axis=self.head_axis,
             mesh=self.mesh,
