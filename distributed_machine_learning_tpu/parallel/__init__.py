@@ -1,1 +1,49 @@
-"""Parallelism: device meshes, sharded train steps, multi-core trials."""
+"""Parallelism: device meshes, sharded train steps, multi-core trials.
+
+Strategies (SURVEY.md §2c — every row the reference lacks, built TPU-first):
+data (``dp``), tensor (``tp``, `sharding.py`), sequence (``sp`` — ring in
+`ring_attention.py`, all-to-all in `ulysses.py`), expert (``ep``,
+`models/moe.py` + `sharding.py`), and pipeline (``pp``, `pipeline.py`).
+"""
+
+from distributed_machine_learning_tpu.parallel.mesh import (
+    auto_mesh,
+    batch_sharding,
+    make_mesh,
+    mesh_devices,
+    replicated,
+)
+from distributed_machine_learning_tpu.parallel.pipeline import (
+    make_stacked_stage_fn,
+    pipeline_apply,
+    stage_param_shardings,
+)
+from distributed_machine_learning_tpu.parallel.ring_attention import ring_attention
+from distributed_machine_learning_tpu.parallel.sharding import (
+    TRANSFORMER_TP_RULES,
+    param_shardings,
+    shard_params,
+)
+from distributed_machine_learning_tpu.parallel.train_step import (
+    make_data_parallel_eval,
+    make_sharded_train_step,
+)
+from distributed_machine_learning_tpu.parallel.ulysses import ulysses_attention
+
+__all__ = [
+    "auto_mesh",
+    "batch_sharding",
+    "make_mesh",
+    "mesh_devices",
+    "replicated",
+    "make_stacked_stage_fn",
+    "pipeline_apply",
+    "stage_param_shardings",
+    "ring_attention",
+    "ulysses_attention",
+    "TRANSFORMER_TP_RULES",
+    "param_shardings",
+    "shard_params",
+    "make_data_parallel_eval",
+    "make_sharded_train_step",
+]

@@ -11,6 +11,7 @@ Axis conventions used across the framework:
   ``sp`` — sequence parallel (sequence dimension of activations)
   ``tp`` — tensor parallel (hidden/heads dimensions of params+activations)
   ``ep`` — expert parallel (the expert dimension of MoE parameter stacks)
+  ``pp`` — pipeline parallel (layer stages; parallel/pipeline.py)
 """
 
 from __future__ import annotations
