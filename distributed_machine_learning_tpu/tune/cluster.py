@@ -302,54 +302,106 @@ def serve_worker(
     while True:
         sock, peer = server.accept()
         dbg(f"accepted driver {peer}")
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        state = _WorkerState(sock, secret)
-        _send(
-            sock,
-            state.send_lock,
-            {
-                "type": "hello",
-                "slots": slots,
-                "host": socket.gethostname(),
-                "num_devices": len(devices),
-            },
-            secret,
-        )
-        shutdown = False
-        while True:
-            msg = _recv(sock, secret)
-            if msg is None:
-                dbg("driver EOF")
-                break  # driver went away; await a new one
-            mtype = msg.get("type")
-            dbg(f"recv {mtype} {msg.get('trial_id', '')}")
-            if mtype == "run_trial":
-                # Round-robin device assignment by slot index keeps concurrent
-                # trials on distinct cores.
-                slot = int(msg.get("slot", 0))
-                dev = [devices[slot % len(devices)]]
-                threading.Thread(
-                    target=_worker_run_trial,
-                    args=(state, msg, dev),
-                    name=f"trial-{msg['trial_id']}",
-                    daemon=True,
-                ).start()
-            elif mtype == "decision":
-                with state.dec_lock:
-                    dq = state.decisions.get(msg["trial_id"])
-                if dq is not None:
-                    dq.put(msg["decision"])
-            elif mtype == "shutdown":
-                shutdown = True
-                break
-        # Unblock any trials still waiting on decisions so threads exit.
-        with state.dec_lock:
-            for dq in state.decisions.values():
-                dq.put("stop")
-        sock.close()
+        shutdown = _serve_driver_connection(sock, secret, devices, slots, dbg)
         if shutdown:
             break
     server.close()
+
+
+def _serve_driver_connection(
+    sock: socket.socket,
+    secret: Optional[bytes],
+    devices: List,
+    slots: int,
+    dbg: Callable[[str], None],
+) -> bool:
+    """Serve one driver over an established socket (either direction: a
+    connection the supervisor accepted, or one ``join_driver`` dialed).
+    Sends the hello, runs trials until driver EOF or shutdown; returns
+    True when the driver requested shutdown."""
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    state = _WorkerState(sock, secret)
+    _send(
+        sock,
+        state.send_lock,
+        {
+            "type": "hello",
+            "slots": slots,
+            "host": socket.gethostname(),
+            "num_devices": len(devices),
+        },
+        secret,
+    )
+    shutdown = False
+    while True:
+        msg = _recv(sock, secret)
+        if msg is None:
+            dbg("driver EOF")
+            break  # driver went away
+        mtype = msg.get("type")
+        dbg(f"recv {mtype} {msg.get('trial_id', '')}")
+        if mtype == "run_trial":
+            # Round-robin device assignment by slot index keeps concurrent
+            # trials on distinct cores.
+            slot = int(msg.get("slot", 0))
+            dev = [devices[slot % len(devices)]]
+            threading.Thread(
+                target=_worker_run_trial,
+                args=(state, msg, dev),
+                name=f"trial-{msg['trial_id']}",
+                daemon=True,
+            ).start()
+        elif mtype == "decision":
+            with state.dec_lock:
+                dq = state.decisions.get(msg["trial_id"])
+            if dq is not None:
+                dq.put(msg["decision"])
+        elif mtype == "shutdown":
+            shutdown = True
+            break
+    # Unblock any trials still waiting on decisions so threads exit.
+    with state.dec_lock:
+        for dq in state.decisions.values():
+            dq.put("stop")
+    sock.close()
+    return shutdown
+
+
+def join_driver(
+    driver_address: str,
+    slots: Optional[int] = None,
+    secret: Optional[bytes] = None,
+) -> bool:
+    """Elastically join a running driver (the reverse of ``serve_worker``).
+
+    The worker dials the driver's ``elastic_listen`` endpoint and serves the
+    same protocol over that connection — how capacity is ADDED to a live
+    experiment (a freshly provisioned/recovered TPU host joins mid-run; the
+    driver immediately starts dispatching queued trials to it).  Dialing
+    out also suits hosts behind NAT where the driver can't dial in.
+    Blocks until the driver disconnects or shuts the worker down; returns
+    True on an explicit shutdown (callers looping for driver restarts can
+    stop then)."""
+    secret = secret if secret is not None else _cluster_secret()
+    host, port = driver_address.rsplit(":", 1)
+    sock = socket.create_connection((host, int(port)), timeout=30)
+    # Clear the connect timeout: it would otherwise persist on every recv,
+    # and a >30s gap between driver frames (idle worker, long epoch) would
+    # be misread as driver EOF, tearing the worker down mid-run.
+    sock.settimeout(None)
+
+    import jax
+
+    devices = list(jax.devices())
+    slots = slots or len(devices)
+
+    debug = bool(os.environ.get("DML_CLUSTER_DEBUG"))
+
+    def dbg(msg: str):
+        if debug:
+            print(f"[worker->{driver_address}] {msg}", flush=True)
+
+    return _serve_driver_connection(sock, secret, devices, slots, dbg)
 
 
 # --------------------------------------------------------------------------
@@ -365,6 +417,25 @@ class RemoteWorker:
         self.secret = secret if secret is not None else _cluster_secret()
         host, port = address.rsplit(":", 1)
         self.sock = socket.create_connection((host, int(port)), timeout=30)
+        self._handshake()
+
+    @classmethod
+    def from_socket(
+        cls,
+        sock: socket.socket,
+        address: str,
+        secret: Optional[bytes] = None,
+    ) -> "RemoteWorker":
+        """Wrap a connection the DRIVER accepted (elastic join): the worker
+        dialed us via ``join_driver`` and speaks the same protocol."""
+        self = cls.__new__(cls)
+        self.address = address
+        self.secret = secret if secret is not None else _cluster_secret()
+        self.sock = sock
+        self._handshake()
+        return self
+
+    def _handshake(self):
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.send_lock = threading.Lock()
         # The hello frame waits on the worker's jax cold-import; give it time.
@@ -372,9 +443,11 @@ class RemoteWorker:
         hello = _recv(self.sock, self.secret)
         self.sock.settimeout(None)
         if not hello or hello.get("type") != "hello":
-            raise ConnectionError(f"Bad hello from worker {address}: {hello!r}")
+            raise ConnectionError(
+                f"Bad hello from worker {self.address}: {hello!r}"
+            )
         self.slots: int = int(hello["slots"])
-        self.hostname: str = hello.get("host", address)
+        self.hostname: str = hello.get("host", self.address)
         self.running: Dict[str, int] = {}  # trial_id -> slot
         self.alive = True
 
@@ -426,6 +499,7 @@ def run_distributed(
     shutdown_workers: bool = False,
     keep_checkpoints_num: int = 0,
     checkpoint_storage: Optional[str] = None,
+    elastic_listen: Union[str, socket.socket, None] = None,
 ) -> ExperimentAnalysis:
     """``tune.run`` across multiple host supervisors (see module docstring).
 
@@ -434,11 +508,20 @@ def run_distributed(
     ``workers``: list of ``"host:port"`` supervisor addresses. Supervisors
     outlive the experiment (they re-accept the next driver) unless
     ``shutdown_workers=True``.
+
+    ``elastic_listen``: a ``"host:port"`` endpoint (or an already-bound
+    listening socket) on which the driver accepts workers joining mid-run
+    via ``join_driver`` — elastic scale-up: queued trials dispatch to a
+    joiner the moment its hello lands, and ``workers`` may be empty (the
+    driver then waits for the first joiner instead of failing).
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
-    if not workers:
-        raise ValueError("run_distributed needs at least one worker address")
+    if not workers and elastic_listen is None:
+        raise ValueError(
+            "run_distributed needs at least one worker address "
+            "(or elastic_listen for join-based capacity)"
+        )
     if checkpoint_storage and checkpoint_storage.startswith("mem://"):
         raise ValueError(
             "checkpoint_storage='mem://...' is process-local (a test fake): "
@@ -462,28 +545,92 @@ def run_distributed(
 
     events: "queue.Queue[Tuple]" = queue.Queue()
     pool: List[RemoteWorker] = []
-    for addr in workers:
-        w = RemoteWorker(addr)
-        pool.append(w)
-
-        def reader(worker: RemoteWorker):
-            while True:
-                msg = _recv(worker.sock, worker.secret)
-                if msg is None:
-                    events.put(("worker_dead", worker))
-                    return
-                events.put(("msg", worker, msg))
-
-        threading.Thread(
-            target=reader, args=(w,), name=f"reader-{addr}", daemon=True
-        ).start()
-
-    trainable_spec: Any = trainable
-    assignment: Dict[str, RemoteWorker] = {}
 
     def log(msg: str):
         if verbose:
             print(f"[tune.cluster] {msg}", flush=True)
+
+    def reader(worker: RemoteWorker):
+        while True:
+            msg = _recv(worker.sock, worker.secret)
+            if msg is None:
+                events.put(("worker_dead", worker))
+                return
+            events.put(("msg", worker, msg))
+
+    def add_worker(w: RemoteWorker):
+        pool.append(w)
+        threading.Thread(
+            target=reader, args=(w,), name=f"reader-{w.address}", daemon=True
+        ).start()
+
+    for addr in workers:
+        add_worker(RemoteWorker(addr))
+
+    # Elastic scale-up: accept join_driver workers for the whole run. The
+    # accept thread only performs the handshake and queues the worker; the
+    # single-threaded main loop adds it to the pool (no pool races).
+    elastic_server: Optional[socket.socket] = None
+    if elastic_listen is not None:
+        if isinstance(elastic_listen, socket.socket):
+            elastic_server = elastic_listen
+        else:
+            ehost, eport = elastic_listen.rsplit(":", 1)
+            elastic_server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            elastic_server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            elastic_server.bind((ehost, int(eport)))
+            elastic_server.listen(8)
+        try:
+            bind_host = elastic_server.getsockname()[0]
+        except OSError:
+            bind_host = "?"
+        if bind_host not in ("127.0.0.1", "::1") and not _cluster_secret():
+            # Same trust model (and warning) as serve_worker: hellos are
+            # pickled frames, so a routable bind without a shared secret
+            # means anyone who can reach the port runs code on the DRIVER.
+            log(
+                f"WARNING: elastic_listen bound to a routable interface "
+                f"({bind_host}) without DML_CLUSTER_SECRET — any host that "
+                f"can reach the port can execute code on this driver. Set a "
+                f"shared secret or bind loopback/private networks."
+            )
+
+        def handshake_joiner(sock: socket.socket, peer):
+            # Per-connection thread: one stalled or garbage-sending client
+            # must neither kill the accept loop nor block other joiners.
+            try:
+                w = RemoteWorker.from_socket(sock, f"{peer[0]}:{peer[1]}")
+            except Exception as exc:  # noqa: BLE001 - bad frame, bad pickle,...
+                log(f"rejected joining worker {peer}: {exc!r}")
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
+            events.put(("worker_joined", w))
+
+        def accept_joiners(server: socket.socket):
+            while True:
+                try:
+                    sock, peer = server.accept()
+                except OSError:
+                    return  # server closed at teardown
+                threading.Thread(
+                    target=handshake_joiner,
+                    args=(sock, peer),
+                    name=f"elastic-handshake-{peer[1]}",
+                    daemon=True,
+                ).start()
+
+        threading.Thread(
+            target=accept_joiners,
+            args=(elastic_server,),
+            name="elastic-accept",
+            daemon=True,
+        ).start()
+
+    trainable_spec: Any = trainable
+    assignment: Dict[str, RemoteWorker] = {}
 
     lifecycle = TrialLifecycle(
         searcher=searcher,
@@ -557,11 +704,19 @@ def run_distributed(
 
             active = bool(pending) or any(w.running for w in pool)
             if not active:
-                if lifecycle.exhausted() or not any(w.alive for w in pool):
+                # (With elastic_listen, pending only stays empty once the
+                # sample budget is exhausted — trial creation above refills
+                # it — so waiting for joiners happens in the common
+                # events.get below, not here.)
+                if lifecycle.exhausted():
+                    break
+                if not any(w.alive for w in pool) and elastic_server is None:
                     break
                 continue
-            if pending and not any(w.alive for w in pool):
-                # Cluster died with work outstanding.
+            if pending and not any(w.alive for w in pool) and (
+                elastic_server is None
+            ):
+                # Cluster died with work outstanding and no way to regrow.
                 for trial in list(pending):
                     pending.remove(trial)
                     trial.error = "no live workers"
@@ -571,6 +726,13 @@ def run_distributed(
             try:
                 event = events.get(timeout=0.5)
             except queue.Empty:
+                continue
+
+            if event[0] == "worker_joined":
+                add_worker(event[1])
+                log(f"worker {event[1].address} joined "
+                    f"({event[1].slots} slots)")
+                launch_ready()
                 continue
 
             if event[0] == "worker_dead":
@@ -629,7 +791,24 @@ def run_distributed(
                 store.write_state(trials)
     finally:
         wall = time.time() - start_time
+        if elastic_server is not None:
+            try:
+                elastic_server.close()  # unblocks the accept thread
+            except OSError:
+                pass
+            # Workers whose join was queued but never pooled: close them so
+            # their join_driver returns (EOF) instead of blocking forever.
+            while True:
+                try:
+                    event = events.get_nowait()
+                except queue.Empty:
+                    break
+                if event[0] == "worker_joined":
+                    event[1].close()
         for w in pool:
+            # Plain close for joined workers unless shutdown was requested:
+            # their join_driver returns on EOF, and an operator loop around
+            # it can then re-join the next driver.
             w.close(shutdown=shutdown_workers)
         try:
             store.write_state(trials, extra={"wall_clock_s": wall})
@@ -642,7 +821,9 @@ def run_distributed(
     )
     log(
         f"experiment {name}: {analysis.num_terminated()}/{len(trials)} trials "
-        f"terminated in {wall:.1f}s across {len(workers)} workers"
+        f"terminated in {wall:.1f}s across {len(pool)} workers"
+        + (f" ({len(pool) - len(workers)} joined elastically)"
+           if len(pool) > len(workers) else "")
     )
     return analysis
 
@@ -725,8 +906,30 @@ def _main(argv: Optional[Sequence[str]] = None):
     parser.add_argument("--port", type=int, default=7711)
     parser.add_argument("--slots", type=int, default=None)
     parser.add_argument("--ready-file", default=None)
+    parser.add_argument(
+        "--join", default=None, metavar="DRIVER_HOST:PORT",
+        help="instead of listening, dial a driver's elastic_listen endpoint "
+        "and serve it (elastic scale-up); re-dials until the driver sends "
+        "shutdown",
+    )
+    parser.add_argument(
+        "--join-retry-s", type=float, default=5.0,
+        help="with --join: seconds between re-dial attempts",
+    )
     args = parser.parse_args(argv)
-    serve_worker(args.host, args.port, slots=args.slots, ready_file=args.ready_file)
+    if args.join:
+        while True:
+            try:
+                if join_driver(args.join, slots=args.slots):
+                    break  # explicit shutdown
+            except (ConnectionError, OSError) as exc:
+                print(f"[worker] driver unreachable ({exc}); retrying",
+                      flush=True)
+            time.sleep(args.join_retry_s)
+    else:
+        serve_worker(
+            args.host, args.port, slots=args.slots, ready_file=args.ready_file
+        )
 
 
 if __name__ == "__main__":
