@@ -58,3 +58,99 @@ features: List[str] = (
 )
 
 LABEL_COLUMN = "Historic Glucose mg/dL"
+
+SENSOR_CHANNELS = ("heart_rate", "sleep", "intensity", "steps")
+
+
+def compute_rolling_features(df, channels=SENSOR_CHANNELS,
+                             minutes_per_step: int = 1):
+    """Add the rolling mean/std feature columns to a raw sensor DataFrame.
+
+    The reference's data FILES carry these columns precomputed (its
+    `config.py:2-78` only names them); this computes them from the raw
+    streams — trailing windows of ``ROLLING_WINDOWS_MIN`` minutes
+    (pandas ``rolling(min_periods=1)`` semantics, population std) via the
+    native prefix-sum kernel (`native/window_ops.cpp: dml_rolling_stats`).
+    ``minutes_per_step`` converts the window grid to row counts for data
+    sampled at other cadences. Returns a new DataFrame; input is unchanged.
+    """
+    import pandas as pd
+
+    from distributed_machine_learning_tpu.data import native as _native
+
+    if minutes_per_step <= 0:
+        raise ValueError(f"minutes_per_step must be positive: {minutes_per_step}")
+    bad = [w for w in ROLLING_WINDOWS_MIN if w % minutes_per_step != 0]
+    if bad:
+        # Refuse rather than silently mislabel: a '15min' column computed
+        # over a different time span would feed the model wrong features.
+        raise ValueError(
+            f"sampling cadence {minutes_per_step}min does not divide "
+            f"window(s) {bad} — the '{{w}}min' column names would lie"
+        )
+    steps = [w // minutes_per_step for w in ROLLING_WINDOWS_MIN]
+    new_cols = {}
+    for base in channels:
+        if base not in df.columns:
+            raise KeyError(f"raw channel {base!r} not in DataFrame columns")
+        stats = _native.rolling_stats(
+            df[base].to_numpy(dtype=float), steps
+        )
+        for j, w in enumerate(ROLLING_WINDOWS_MIN):
+            new_cols[f"{base}_mean_{w}min"] = stats[:, j * 2]
+            new_cols[f"{base}_std_{w}min"] = stats[:, j * 2 + 1]
+    # One concat, not 64 inserts: avoids pandas block fragmentation.
+    return pd.concat(
+        [df.copy(), pd.DataFrame(new_cols, index=df.index)], axis=1
+    )
+
+
+def compute_temporal_features(df, timestamp_column: str = None):
+    """Add the sin/cos temporal encoding columns from timestamps.
+
+    Uses ``timestamp_column`` if given, else the DataFrame's DatetimeIndex.
+    Encodings: minute-of-day / 1440, day-of-week / 7, day-of-month / 31,
+    month / 12, each as (sin, cos) of the phase — the cyclic form the
+    reference's `temporal_features` names (`config.py`).
+    """
+    import numpy as np
+    import pandas as pd
+
+    # DatetimeIndex either way: a converted Series would need the .dt
+    # accessor for .hour/.dayofweek, a DatetimeIndex exposes them directly.
+    ts = pd.DatetimeIndex(
+        pd.to_datetime(df[timestamp_column])
+        if timestamp_column
+        else pd.to_datetime(df.index)
+    )
+    phases = {
+        "minute_of_day": (ts.hour * 60 + ts.minute) / 1440.0,
+        "day_of_week": ts.dayofweek / 7.0,
+        "day_of_month": (ts.day - 1) / 31.0,
+        "month": (ts.month - 1) / 12.0,
+    }
+    out = df.copy()
+    for unit, phase in phases.items():
+        angle = 2.0 * np.pi * np.asarray(phase, dtype=np.float64)
+        out[f"{unit}_sin"] = np.sin(angle).astype(np.float32)
+        out[f"{unit}_cos"] = np.cos(angle).astype(np.float32)
+    return out
+
+
+def build_feature_frame(raw_df, channels=SENSOR_CHANNELS,
+                        minutes_per_step: int = 1,
+                        timestamp_column: str = None):
+    """Raw sensor streams -> the full `features` column surface.
+
+    One call takes a DataFrame of raw channels (+ timestamps) to the
+    ``len(features)``-column frame (76: 4 channels x (raw + 8 windows x
+    mean/std) + 8 temporal encodings) the reference's pipeline selects
+    (`ray-tune-hpo-regression.py:18-19,442`), ready for
+    ``make_regression_dataset``. Columns are returned in `features` order.
+    """
+    out = compute_rolling_features(raw_df, channels, minutes_per_step)
+    out = compute_temporal_features(out, timestamp_column)
+    missing = [c for c in features if c not in out.columns]
+    if missing:
+        raise KeyError(f"feature columns missing after assembly: {missing}")
+    return out[features]

@@ -80,6 +80,8 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     lib.dml_column_stats.restype = i64
     lib.dml_standardize.argtypes = [f32p, i64, i64, f64p, f64p, ctypes.c_double]
     lib.dml_standardize.restype = i64
+    lib.dml_rolling_stats.argtypes = [f32p, i64, i64p, i64, f32p]
+    lib.dml_rolling_stats.restype = i64
     return lib
 
 
@@ -175,6 +177,49 @@ def gather(x: np.ndarray, idx: np.ndarray) -> np.ndarray:
     lib.dml_gather(x.reshape(len(x), -1) if x.ndim > 1 else x[:, None],
                    len(x), max(row_elems, 1), idx, len(idx),
                    out.reshape(len(idx), -1) if out.ndim > 1 else out[:, None])
+    return out
+
+
+def rolling_stats(series: np.ndarray, windows) -> np.ndarray:
+    """Trailing rolling mean/std of a 1-D series over several windows.
+
+    Returns [n, len(windows)*2], columns (mean_w0, std_w0, mean_w1, ...).
+    Semantics match ``pandas.rolling(w, min_periods=1)`` with population
+    std, including NaN handling: NaN entries are skipped per-window (sensor
+    gaps), and a window with no finite entries yields NaN. Both paths
+    compute through the same double prefix sums, so results agree to
+    float32 rounding with or without the C++ toolchain.
+    """
+    x = np.ascontiguousarray(np.asarray(series).reshape(-1), dtype=np.float32)
+    ws = np.ascontiguousarray(np.asarray(list(windows)), dtype=np.int64)
+    n, k = len(x), len(ws)
+    if n == 0 or k == 0:
+        return np.empty((n, k * 2), dtype=np.float32)
+    if (ws <= 0).any():
+        raise ValueError(f"window lengths must be positive: {ws}")
+    lib = _get_lib()
+    if lib is not None:
+        out = np.empty((n, k * 2), dtype=np.float32)
+        rc = lib.dml_rolling_stats(x, n, ws, k, out)
+        if rc != n:  # pragma: no cover
+            raise RuntimeError(f"dml_rolling_stats failed: rc={rc}")
+        return out
+    xd = x.astype(np.float64)
+    ok = np.isfinite(xd)
+    xz = np.where(ok, xd, 0.0)
+    s1 = np.concatenate([[0.0], np.cumsum(xz)])
+    s2 = np.concatenate([[0.0], np.cumsum(xz * xz)])
+    sc = np.concatenate([[0.0], np.cumsum(ok.astype(np.float64))])
+    idx = np.arange(n)
+    out = np.empty((n, k * 2), dtype=np.float32)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        for j, w in enumerate(ws):
+            lo = np.maximum(idx - int(w) + 1, 0)
+            cnt = sc[idx + 1] - sc[lo]
+            mu = np.where(cnt > 0, (s1[idx + 1] - s1[lo]) / cnt, np.nan)
+            var = np.maximum((s2[idx + 1] - s2[lo]) / cnt - mu * mu, 0.0)
+            out[:, j * 2] = mu
+            out[:, j * 2 + 1] = np.sqrt(var)
     return out
 
 

@@ -15,6 +15,7 @@
 #include <cstdint>
 #include <cstring>
 #include <cmath>
+#include <limits>
 
 #ifdef _OPENMP
 #include <omp.h>
@@ -114,6 +115,62 @@ int64_t dml_standardize(float* x, int64_t n, int64_t m, const double* mean,
     }
   }
   return n * m;
+}
+
+// Trailing rolling mean/std of a 1-D series over several window lengths at
+// once: x [n] -> out [n, n_windows*2] row-major, columns ordered
+// (mean_w0, std_w0, mean_w1, std_w1, ...). Window semantics match pandas
+// rolling(w, min_periods=1): position i aggregates x[max(0, i-w+1) .. i],
+// std is population (ddof=0), and NaN entries are skipped per-window (a
+// window with no finite entries yields NaN) — sensor streams have gaps, and
+// raw prefix sums would otherwise poison every window after the first gap.
+// O(n) per window via double prefix sums over (value, value^2, valid-count),
+// parallel over windows. This computes the reference's precomputed rolling
+// feature columns (`config.py:2-78` names like heart_rate_mean_15min) from
+// the raw sensor stream — the step upstream of the reference's data files.
+int64_t dml_rolling_stats(const float* x, int64_t n, const int64_t* windows,
+                          int64_t n_windows, float* out) {
+  if (n <= 0 || n_windows <= 0) return -1;
+  for (int64_t k = 0; k < n_windows; ++k) {
+    if (windows[k] <= 0) return -2;
+  }
+  double* s1 = new double[static_cast<size_t>(n) + 1];
+  double* s2 = new double[static_cast<size_t>(n) + 1];
+  double* sc = new double[static_cast<size_t>(n) + 1];
+  s1[0] = 0.0;
+  s2[0] = 0.0;
+  sc[0] = 0.0;
+  for (int64_t i = 0; i < n; ++i) {
+    const double v = static_cast<double>(x[i]);
+    const bool ok = std::isfinite(v);
+    s1[i + 1] = s1[i] + (ok ? v : 0.0);
+    s2[i + 1] = s2[i] + (ok ? v * v : 0.0);
+    sc[i + 1] = sc[i] + (ok ? 1.0 : 0.0);
+  }
+#pragma omp parallel for schedule(static)
+  for (int64_t k = 0; k < n_windows; ++k) {
+    const int64_t w = windows[k];
+    for (int64_t i = 0; i < n; ++i) {
+      const int64_t lo = i - w + 1 > 0 ? i - w + 1 : 0;
+      const double cnt = sc[i + 1] - sc[lo];
+      float mu_f, sd_f;
+      if (cnt <= 0.0) {
+        mu_f = sd_f = std::numeric_limits<float>::quiet_NaN();
+      } else {
+        const double mu = (s1[i + 1] - s1[lo]) / cnt;
+        double var = (s2[i + 1] - s2[lo]) / cnt - mu * mu;
+        if (var < 0.0) var = 0.0;  // float cancellation guard
+        mu_f = static_cast<float>(mu);
+        sd_f = static_cast<float>(std::sqrt(var));
+      }
+      out[i * n_windows * 2 + k * 2] = mu_f;
+      out[i * n_windows * 2 + k * 2 + 1] = sd_f;
+    }
+  }
+  delete[] s1;
+  delete[] s2;
+  delete[] sc;
+  return n;
 }
 
 }  // extern "C"
