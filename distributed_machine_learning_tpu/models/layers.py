@@ -119,7 +119,10 @@ class MultiHeadAttention(nn.Module):
     key_dim_scaling: float = 0.5
     dropout_rate: float = 0.0
     causal: bool = False
-    block_size: int = 128
+    # None = let each kernel pick its measured-fastest block size (the
+    # Pallas flash kernel defaults to large 1024 tiles; the lax.scan
+    # blockwise path to 128). An explicit value pins both.
+    block_size: Optional[int] = None
     # Sequence parallelism: when set (with a mesh), softmax attention runs
     # sequence-sharded over this mesh axis — the long-context path.
     # Requires the surrounding jit to shard x's sequence dim over `seq_axis`.
@@ -201,20 +204,22 @@ class MultiHeadAttention(nn.Module):
             # Hand-written Pallas MXU kernel on TPU; off-TPU the same math
             # runs through the lax.scan blockwise path (Mosaic kernels only
             # compile for TPU backends).
-            bs = min(self.block_size, S)
-            while S % bs:
-                bs -= 1
             scale = float(head_dim) ** (-self.key_dim_scaling)
             if _on_tpu():
                 from distributed_machine_learning_tpu.ops.pallas_attention import (
                     flash_attention,
                 )
 
+                # Block clamping/divisor adjustment happens inside
+                # flash_attention (None = its measured-fastest defaults).
                 out = flash_attention(
                     q, k, v, scale=scale, causal=self.causal,
-                    block_q=bs, block_k=bs,
+                    block_q=self.block_size, block_k=self.block_size,
                 )
             else:
+                bs = min(self.block_size or 128, S)
+                while S % bs:
+                    bs -= 1
                 q_scaled = q * (scale / (float(head_dim) ** -0.5))
                 out = blockwise_attention(
                     q_scaled, k, v, block_size=bs, causal=self.causal
@@ -222,7 +227,7 @@ class MultiHeadAttention(nn.Module):
         elif self.attention_type == "blockwise":
             # Largest divisor of S not exceeding the configured block size, so
             # any static sequence length works.
-            bs = min(self.block_size, S)
+            bs = min(self.block_size or 128, S)
             while S % bs:
                 bs -= 1
             out = blockwise_attention(q, k, v, block_size=bs, causal=self.causal)

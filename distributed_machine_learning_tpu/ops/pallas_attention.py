@@ -193,6 +193,26 @@ def _flash_forward(
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
 
 
+def _default_blocks(S: int, D: int, block_q, block_k):
+    """Resolve block sizes: as large as VMEM comfortably allows.
+
+    Measured on a v5e chip (seq 4096, B8 H8 D64, 2026-07-30): 128x128 blocks
+    ran 54ms vs XLA's fused attention at 24ms — the grid overhead and tiny
+    MXU matmuls dominated; 1024x1024 blocks ran 19ms, ~20% FASTER than XLA.
+    Default to 1024 (capped by S), which keeps the f32 logits block at 4MB
+    of VMEM plus the q/k/v/acc blocks — comfortably inside the ~16MB budget
+    for head dims up to 256.
+    """
+    # Clamp by head dim so the per-step VMEM working set (f32 logits/p
+    # blocks ~2*bq*bk*4 bytes + q/k/v/acc casts ~4*bk*D*4 bytes, plus
+    # Pallas double-buffering) stays inside the ~16MB budget: D<=256 fits
+    # 1024 tiles (<=12MB); larger head dims step the tiles down.
+    cap = 1024 if D <= 256 else (512 if D <= 512 else 256)
+    bq = min(cap, S) if block_q is None else min(block_q, S)
+    bk = min(cap, S) if block_k is None else min(block_k, S)
+    return bq, bk
+
+
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
 )
@@ -202,23 +222,27 @@ def flash_attention(
     v: jnp.ndarray,
     scale: Optional[float] = None,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Flash softmax attention. q, k, v: [B, S, H, D] -> [B, S, H, D].
 
     ``scale`` defaults to 1/sqrt(D) (override = the reference's intended
-    ``key_dim_scaling`` knob, SURVEY.md §2 C19). ``interpret=True`` runs the
-    kernel in the Pallas interpreter (CPU tests); on TPU leave it False.
+    ``key_dim_scaling`` knob, SURVEY.md §2 C19). Block sizes default to the
+    measured-fastest large tiles (``_default_blocks``). ``interpret=True``
+    runs the kernel in the Pallas interpreter (CPU tests); on TPU leave it
+    False.
     """
     s = (q.shape[-1] ** -0.5) if scale is None else scale
-    return _flash_forward(q, k, v, s, causal, block_q, block_k, interpret)
+    bq, bk = _default_blocks(q.shape[1], q.shape[-1], block_q, block_k)
+    return _flash_forward(q, k, v, s, causal, bq, bk, interpret)
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     s = (q.shape[-1] ** -0.5) if scale is None else scale
-    out = _flash_forward(q, k, v, s, causal, block_q, block_k, interpret)
+    bq, bk = _default_blocks(q.shape[1], q.shape[-1], block_q, block_k)
+    out = _flash_forward(q, k, v, s, causal, bq, bk, interpret)
     return out, (q, k, v)
 
 
@@ -234,7 +258,10 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
 
     def ref_fn(q_, k_, v_):
         S = q_.shape[1]
-        bs = min(block_k, S)
+        # Backward recompute block: bounded at 512 — the scan materializes
+        # [B, H, bs, bs] logits per step under autodiff, so the forward's
+        # 1024-tile default would be memory-heavy here.
+        bs = min(block_k or 512, 512, S)
         while S % bs:
             bs -= 1
         # blockwise_attention uses 1/sqrt(D); fold any custom scale in by

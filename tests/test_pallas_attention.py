@@ -34,6 +34,23 @@ def test_matches_dense_softmax_attention(qkv):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+def test_default_blocks_path(qkv):
+    """block_q/block_k=None — the production default (_default_blocks picks
+    the tile size, clamped by S and head dim)."""
+    q, k, v = qkv
+    out = flash_attention(q, k, v, interpret=True)
+    ref = dot_product_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    # Gradient through the default path too (custom_vjp default resolution).
+    g = jax.grad(
+        lambda q: jnp.sum(flash_attention(q, k, v, interpret=True) ** 2)
+    )(q)
+    g_ref = jax.grad(
+        lambda q: jnp.sum(dot_product_attention(q, k, v) ** 2)
+    )(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-4)
+
+
 def test_causal_matches_masked_dense(qkv):
     q, k, v = qkv
     out = flash_attention(
