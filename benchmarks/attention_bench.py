@@ -118,8 +118,11 @@ def main(argv=None):
                         "error": repr(exc)[:200],
                     }), flush=True)
                     continue
-                # Softmax attention fwd FLOPs: 2 matmuls of 2*B*H*S^2*D.
+                # Softmax attention fwd FLOPs: 2 matmuls of 2*B*H*S^2*D;
+                # causal does ~half (kernels skip fully-masked blocks).
                 flops = 4.0 * args.batch * args.heads * S * S * args.head_dim
+                if args.causal:
+                    flops *= 0.5
                 print(json.dumps({
                     "kernel": kernel, "seq": S, "dtype": dtype_name,
                     "platform": platform, "causal": args.causal,

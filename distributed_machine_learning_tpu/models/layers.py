@@ -31,6 +31,7 @@ from distributed_machine_learning_tpu.models.moe import MoEFF
 from distributed_machine_learning_tpu.ops.attention import (
     blockwise_attention,
     dot_product_attention,
+    largest_divisor_block,
     linear_attention,
 )
 
@@ -217,19 +218,13 @@ class MultiHeadAttention(nn.Module):
                     block_q=self.block_size, block_k=self.block_size,
                 )
             else:
-                bs = min(self.block_size or 128, S)
-                while S % bs:
-                    bs -= 1
+                bs = largest_divisor_block(S, self.block_size or 128)
                 q_scaled = q * (scale / (float(head_dim) ** -0.5))
                 out = blockwise_attention(
                     q_scaled, k, v, block_size=bs, causal=self.causal
                 )
         elif self.attention_type == "blockwise":
-            # Largest divisor of S not exceeding the configured block size, so
-            # any static sequence length works.
-            bs = min(self.block_size or 128, S)
-            while S % bs:
-                bs -= 1
+            bs = largest_divisor_block(S, self.block_size or 128)
             out = blockwise_attention(q, k, v, block_size=bs, causal=self.causal)
         else:
             scale = float(head_dim) ** (-self.key_dim_scaling)

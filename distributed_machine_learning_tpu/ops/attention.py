@@ -88,6 +88,16 @@ def linear_attention(
     return out / (z[..., None] + eps)
 
 
+def largest_divisor_block(S: int, target: int) -> int:
+    """Largest divisor of S not exceeding ``target`` — THE block-size
+    adjustment used by every blocked attention path (blockwise scan, flash
+    kernel, layer plumbing), so the policy lives in one place."""
+    bs = min(max(int(target), 1), S)
+    while S % bs:
+        bs -= 1
+    return bs
+
+
 @partial(jax.jit, static_argnames=("block_size", "causal"))
 def blockwise_attention(
     q: jnp.ndarray,
