@@ -51,6 +51,16 @@ def _on_tpu() -> bool:
         return False
 
 
+def _route_softmax_to_flash(seq_len: int, head_dim: int) -> bool:
+    """Whether a plain softmax attention call should run the Pallas flash
+    kernel instead: same exact math (online softmax), measured faster on
+    chip from ~1k sequence length (benchmarks/RESULTS.md: fwd ~20%,
+    fwd+bwd up to 2.9x at seq 4096), while short sequences stay on XLA's
+    fused attention where the kernel's grid overhead isn't amortized.
+    Head dims above the measured VMEM-validated range keep the XLA path."""
+    return _on_tpu() and seq_len >= 1024 and head_dim <= 256
+
+
 def sincos_position_table(max_len: int, d_model: int) -> np.ndarray:
     """Classic transformer sin/cos positional table, shape [max_len, d_model]."""
     position = np.arange(max_len, dtype=np.float32)[:, None]
@@ -228,10 +238,24 @@ class MultiHeadAttention(nn.Module):
             out = blockwise_attention(q, k, v, block_size=bs, causal=self.causal)
         else:
             scale = float(head_dim) ** (-self.key_dim_scaling)
-            mask = None
-            if self.causal:
-                mask = jnp.tril(jnp.ones((S, S), bool))[None, None, :, :]
-            out = dot_product_attention(q, k, v, mask=mask, scale=scale)
+            if _route_softmax_to_flash(S, head_dim):
+                # Exact same softmax math through the measured-faster
+                # Pallas kernel (long sequences on TPU only). Blocks stay
+                # None — the kernel's measured-fastest tiles; block_size
+                # here is the blockwise-scan knob, and a small value would
+                # turn the fast path into the measured-slow 128-tile one.
+                from distributed_machine_learning_tpu.ops.pallas_attention import (
+                    flash_attention,
+                )
+
+                out = flash_attention(
+                    q, k, v, scale=scale, causal=self.causal,
+                )
+            else:
+                mask = None
+                if self.causal:
+                    mask = jnp.tril(jnp.ones((S, S), bool))[None, None, :, :]
+                out = dot_product_attention(q, k, v, mask=mask, scale=scale)
 
         out = nn.DenseGeneral(
             features=self.d_model, axis=(-2, -1), name="out"

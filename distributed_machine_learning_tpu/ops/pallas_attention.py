@@ -224,6 +224,30 @@ def _flash_forward(
     return (out, lse) if with_lse else out
 
 
+def _bwd_recompute(q, k, v, do, lse, delta, q_start, k_start, scale, causal):
+    """Shared backward block math: recompute P from the forward's logsumexp
+    and form dS — used identically by both backward kernels.
+
+    Returns (p, ds): p = exp(logits - lse) [bq, bk] with masked/fully-masked
+    rows zeroed; ds = p * (dO V^T - delta) * scale."""
+    logits = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                      # [bq, bk]
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0) + q_start
+        cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1) + k_start
+        logits = jnp.where(rows >= cols, logits, NEG_INF)
+    p = jnp.where(jnp.isfinite(lse), jnp.exp(logits - lse), 0.0)
+    p = jnp.where(jnp.isfinite(logits), p, 0.0)
+    dp = jax.lax.dot_general(
+        do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                              # [bq, bk]
+    ds = p * (dp - delta) * scale
+    return p, ds
+
+
 def _bwd_dkdv_kernel(
     q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
     dk_ref, dv_ref,
@@ -255,28 +279,13 @@ def _bwd_dkdv_kernel(
         k = k_ref[0].astype(jnp.float32)          # [bk, d]
         v = v_ref[0].astype(jnp.float32)          # [bk, d]
 
-        logits = jax.lax.dot_general(
-            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale                                  # [bq, bk]
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0) + q_start
-            cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1) + k_start
-            logits = jnp.where(rows >= cols, logits, NEG_INF)
-        p = jnp.where(
-            jnp.isfinite(lse), jnp.exp(logits - lse), 0.0
-        )                                          # [bq, bk]
-        p = jnp.where(jnp.isfinite(logits), p, 0.0)
-
+        p, ds = _bwd_recompute(
+            q, k, v, do, lse, delta, q_start, k_start, scale, causal
+        )
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p, do, dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                          # [bk, d]
-        dp = jax.lax.dot_general(
-            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )                                          # [bq, bk]
-        ds = p * (dp - delta) * scale              # [bq, bk]
         dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
             ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -323,21 +332,9 @@ def _bwd_dq_kernel(
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
 
-        logits = jax.lax.dot_general(
-            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0) + q_start
-            cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1) + k_start
-            logits = jnp.where(rows >= cols, logits, NEG_INF)
-        p = jnp.where(jnp.isfinite(lse), jnp.exp(logits - lse), 0.0)
-        p = jnp.where(jnp.isfinite(logits), p, 0.0)
-        dp = jax.lax.dot_general(
-            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        _, ds = _bwd_recompute(
+            q, k, v, do, lse, delta, q_start, k_start, scale, causal
         )
-        ds = p * (dp - delta) * scale
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
             ds, k, dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -448,9 +445,15 @@ def _default_blocks(S: int, D: int, block_q, block_k, backward: bool = False):
     1024 tile size.
     """
     if backward:
+        # The backward cap binds EXPLICIT blocks too (the pre-kernel
+        # backward enforced a hard 512 ceiling the same way): a user-tuned
+        # forward tile must not push the backward's ~2x-larger working set
+        # past VMEM.
         cap = 1024 if D <= 64 else (512 if D <= 256 else 256)
-    else:
-        cap = 1024 if D <= 256 else (512 if D <= 512 else 256)
+        bq = min(cap, S) if block_q is None else min(block_q, cap, S)
+        bk = min(cap, S) if block_k is None else min(block_k, cap, S)
+        return bq, bk
+    cap = 1024 if D <= 256 else (512 if D <= 512 else 256)
     bq = min(cap, S) if block_q is None else min(block_q, S)
     bk = min(cap, S) if block_k is None else min(block_k, S)
     return bq, bk

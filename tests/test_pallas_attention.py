@@ -123,6 +123,70 @@ def test_model_layer_flash_attention_type(qkv):
             )
 
 
+@pytest.mark.parametrize("bq,bk", [(16, 8), (8, 16)])
+def test_gradients_mismatched_blocks(qkv, bq, bk):
+    """The two backward kernels have independent per-axis block logic
+    (separate causal live-conditions, opposite grid orderings) — exercised
+    with block_q != block_k, causal."""
+    q, k, v = qkv
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bk, interpret=True
+            ) ** 2
+        )
+
+    def loss_ref(q, k, v):
+        mask = jnp.tril(jnp.ones((S, S), bool))[None, None]
+        return jnp.sum(dot_product_attention(q, k, v, mask=mask) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_gradients_bfloat16(qkv):
+    """The backward kernels' bf16 cast path produces usable gradients."""
+    q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
+
+    g = jax.grad(
+        lambda q: jnp.sum(
+            flash_attention(
+                q, k, v, block_q=BQ, block_k=BK, interpret=True
+            ).astype(jnp.float32) ** 2
+        )
+    )(q)
+    assert g.dtype == jnp.bfloat16
+    g_ref = jax.grad(
+        lambda q: jnp.sum(
+            dot_product_attention(
+                q.astype(jnp.float32),
+                k.astype(jnp.float32),
+                v.astype(jnp.float32),
+            ) ** 2
+        )
+    )(q.astype(jnp.float32))
+    np.testing.assert_allclose(
+        np.asarray(g, np.float32), np.asarray(g_ref), atol=5e-2
+    )
+
+
+def test_softmax_to_flash_routing_gate(monkeypatch):
+    """Long-sequence softmax attention on TPU reroutes to the flash kernel
+    (same math); short sequences, big heads, and non-TPU backends don't."""
+    from distributed_machine_learning_tpu.models import layers
+
+    monkeypatch.setattr(layers, "_on_tpu", lambda: True)
+    assert layers._route_softmax_to_flash(1024, 64)
+    assert layers._route_softmax_to_flash(4096, 256)
+    assert not layers._route_softmax_to_flash(512, 64)   # short: XLA wins
+    assert not layers._route_softmax_to_flash(2048, 512)  # unvalidated head dim
+    monkeypatch.setattr(layers, "_on_tpu", lambda: False)
+    assert not layers._route_softmax_to_flash(4096, 64)
+
+
 def test_bfloat16_inputs(qkv):
     q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
     out = flash_attention(q, k, v, block_q=BQ, block_k=BK, interpret=True)
