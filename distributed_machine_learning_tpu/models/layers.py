@@ -54,11 +54,12 @@ def _on_tpu() -> bool:
 def _route_softmax_to_flash(seq_len: int, head_dim: int) -> bool:
     """Whether a plain softmax attention call should run the Pallas flash
     kernel instead: same exact math (online softmax), measured faster on
-    chip from ~1k sequence length (benchmarks/RESULTS.md: fwd ~20%,
-    fwd+bwd up to 2.9x at seq 4096), while short sequences stay on XLA's
-    fused attention where the kernel's grid overhead isn't amortized.
-    Head dims above the measured VMEM-validated range keep the XLA path."""
-    return _on_tpu() and seq_len >= 1024 and head_dim <= 256
+    chip from ~1k sequence length at head_dim <= 64 (benchmarks/RESULTS.md:
+    fwd ~20%, fwd+bwd up to 2.9x at seq 4096). Gated to that measured-win
+    regime: at D=128 the flash FORWARD measured 2x slower than XLA (only
+    the grad path won), and this route also serves eval — configs wanting
+    flash at bigger head dims select attention_type='flash' explicitly."""
+    return _on_tpu() and seq_len >= 1024 and head_dim <= 64
 
 
 def sincos_position_table(max_len: int, d_model: int) -> np.ndarray:
