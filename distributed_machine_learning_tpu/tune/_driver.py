@@ -102,6 +102,106 @@ class TrialLifecycle:
         self.store.write_params(trial)
         return trial
 
+    def restore_experiment(self, resources=None) -> Dict[str, int]:
+        """Resume an interrupted experiment from its directory (Ray's
+        ``tune.run(resume=True)`` semantics, which the reference relied on
+        implicitly by re-running its driver against the same ``local_dir``).
+
+        For every persisted trial: rebuild the Trial from params.json +
+        result.jsonl, replay its metric stream through the scheduler and
+        searcher (rung tables and model-based search see the full history;
+        nothing is re-persisted), then either keep it finished
+        (TERMINATED/ERROR) or requeue it from its newest checkpoint
+        (PENDING/RUNNING/PAUSED at the interruption). Sampling continues
+        afterwards until ``num_samples``.
+        """
+        from distributed_machine_learning_tpu.tune import checkpoint as ckpt_lib
+        from distributed_machine_learning_tpu.tune.experiment import (
+            iter_trial_records,
+        )
+
+        counts = {"finished": 0, "requeued": 0}
+        for entry, config, records, meta in iter_trial_records(self.store.root):
+            kwargs = {"resources": resources} if resources is not None else {}
+            trial = Trial(trial_id=entry, config=config, **kwargs)
+            self.trials.append(trial)
+            self.by_id[entry] = trial
+            try:
+                self.next_index = max(
+                    self.next_index, int(entry.rsplit("_", 1)[-1]) + 1
+                )
+            except ValueError:
+                self.next_index = max(self.next_index, len(self.trials))
+            self.scheduler.on_trial_add(trial)
+
+            # A trial ABSENT from the state file was mid-flight when the
+            # driver died (state snapshots are written on every completion,
+            # so finished trials are always present): treat as interrupted,
+            # never as finished — worst case a finished trial whose final
+            # snapshot raced the crash re-runs from its last checkpoint.
+            status = meta.get("status", "PENDING") if meta else "PENDING"
+            finished = status in ("TERMINATED", "ERROR")
+            ck_path, ck_it = ckpt_lib.find_latest_checkpoint(
+                self.store.checkpoint_dir(trial)
+            )
+            if not finished:
+                # The re-run re-reports everything after the restore point;
+                # drop the replayed tail past the checkpoint so the result
+                # stream (and searcher observations) hold each epoch once —
+                # on disk too, or the orphan tail would duplicate there.
+                kept = [
+                    r for r in records
+                    if int(r.get("training_iteration", 0)) <= ck_it
+                ]
+                if len(kept) < len(records):
+                    import json
+                    import os
+
+                    path = os.path.join(
+                        self.store.trial_dir(trial), "result.jsonl"
+                    )
+                    with open(path, "w") as f:
+                        for r in kept:
+                            f.write(json.dumps(r) + "\n")
+                records = kept
+
+            # Replay: config snapshot guards against schedulers that mutate
+            # on REQUEUE decisions during replay (PBT exploit) — replay must
+            # only rebuild observer state, not re-run decisions.
+            config_snapshot = dict(trial.config)
+            for rec in records:
+                trial.results.append(rec)
+                trial.reports_since_restart += 1
+                self.scheduler.on_trial_result(trial, rec)
+                self.searcher.on_trial_result(
+                    entry, config_snapshot, rec, self.metric, self.mode
+                )
+            trial.config = config_snapshot
+            # Clear anything replayed scheduler decisions left behind.
+            trial._requeue_on_complete = False
+            trial.restore_path = None
+            trial.restore_base = 0
+            trial.reports_since_restart = len(trial.results)
+            if ck_path:
+                trial.latest_checkpoint = ck_path
+                trial.latest_checkpoint_iteration = ck_it
+
+            if finished:
+                trial.error = (meta or {}).get("error")
+                self.finish(trial, TrialStatus(status))
+                if status == "ERROR":
+                    self.scheduler.on_trial_error(trial)
+                counts["finished"] += 1
+            else:
+                # Interrupted mid-flight: rewind to the newest checkpoint
+                # (training_iteration = restore_base once requeued).
+                if ck_path:
+                    trial.restore_path = ck_path
+                    trial.restore_base = ck_it
+                self.requeue(trial)
+                counts["requeued"] += 1
+        return counts
+
     # -- results -----------------------------------------------------------
 
     def process_result(

@@ -59,6 +59,19 @@ def checkpoint_path(directory: str, iteration: int) -> str:
     return backend.join(d, f"ckpt_{iteration:06d}.msgpack")
 
 
+def find_latest_checkpoint(directory: str):
+    """(path, iteration) of the newest ``ckpt_*.msgpack`` under ``directory``
+    (any storage backend), or (None, 0) when there is none — how a resumed
+    experiment rediscovers each trial's restore point."""
+    backend, d = get_storage(directory)
+    best_path, best_it = None, 0
+    for name in backend.listdir(d):
+        m = _CKPT_RE.match(name)
+        if m and int(m.group(1)) >= best_it:
+            best_path, best_it = backend.join(d, name), int(m.group(1))
+    return best_path, best_it
+
+
 def prune_checkpoints(directory: str, keep: int, protect=None) -> int:
     """Keep only the ``keep`` newest ``ckpt_*.msgpack`` files in ``directory``.
 

@@ -108,6 +108,37 @@ class ExperimentStore:
                 f.close()
 
 
+def iter_trial_records(root: str):
+    """Yield ``(trial_id, config, records, state_meta)`` for every persisted
+    trial under an experiment directory — THE parser of the on-disk layout,
+    shared by ``ExperimentAnalysis.from_directory`` and experiment resume
+    (`tune/_driver.py`) so the format lives in one place.
+
+    ``state_meta`` is the trial's entry from experiment_state.json (dict) or
+    None when the trial never made it into a state snapshot (e.g. the
+    driver died before any trial completed).
+    """
+    state_path = os.path.join(root, "experiment_state.json")
+    state: Dict[str, Any] = {}
+    if os.path.exists(state_path):
+        with open(state_path) as f:
+            state = json.load(f)
+    by_id = {t["trial_id"]: t for t in state.get("trials", [])}
+    for entry in sorted(os.listdir(root)):
+        tdir = os.path.join(root, entry)
+        params_path = os.path.join(tdir, "params.json")
+        if not os.path.isdir(tdir) or not os.path.exists(params_path):
+            continue
+        with open(params_path) as f:
+            config = json.load(f)
+        records: List[Dict[str, Any]] = []
+        results_path = os.path.join(tdir, "result.jsonl")
+        if os.path.exists(results_path):
+            with open(results_path) as f:
+                records = [json.loads(l) for l in f if l.strip()]
+        yield entry, config, records, by_id.get(entry)
+
+
 class ExperimentAnalysis:
     """Query interface over a finished (or in-flight) experiment.
 
@@ -192,30 +223,13 @@ class ExperimentAnalysis:
     def from_directory(cls, root: str, metric: str, mode: str = "min"):
         """Rehydrate an analysis from an experiment directory on disk."""
         trials: List[Trial] = []
-        state_path = os.path.join(root, "experiment_state.json")
-        state = {}
-        if os.path.exists(state_path):
-            with open(state_path) as f:
-                state = json.load(f)
-        by_id = {t["trial_id"]: t for t in state.get("trials", [])}
-        for entry in sorted(os.listdir(root)):
-            tdir = os.path.join(root, entry)
-            if not os.path.isdir(tdir):
-                continue
-            params_path = os.path.join(tdir, "params.json")
-            config = {}
-            if os.path.exists(params_path):
-                with open(params_path) as f:
-                    config = json.load(f)
-            trial = Trial(trial_id=entry, config=config)
-            results_path = os.path.join(tdir, "result.jsonl")
-            if os.path.exists(results_path):
-                with open(results_path) as f:
-                    trial.results = [json.loads(line) for line in f if line.strip()]
-            meta = by_id.get(entry)
+        for trial_id, config, records, meta in iter_trial_records(root):
+            trial = Trial(trial_id=trial_id, config=config)
+            trial.results = records
             if meta:
                 trial.status = TrialStatus(meta.get("status", "TERMINATED"))
-            elif trial.results:
+                trial.error = meta.get("error")
+            elif records:
                 trial.status = TrialStatus.TERMINATED
             trials.append(trial)
         return cls(trials, metric=metric, mode=mode, root=root)

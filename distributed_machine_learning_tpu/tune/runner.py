@@ -68,6 +68,7 @@ def run(
     compile_cache_dir: Optional[str] = "auto",
     time_limit_per_trial_s: Optional[float] = None,
     trial_executor: str = "thread",
+    resume: bool = False,
 ) -> ExperimentAnalysis:
     """Run an HPO experiment; see module docstring.
 
@@ -95,9 +96,29 @@ def run(
     ``trial_executor``: "thread" (default; lowest overhead, no preemption) or
     "process" (one OS process per trial with per-process device visibility;
     requires picklable trainables).
+    ``resume``: continue an interrupted experiment (requires an explicit
+    ``name`` pointing at its directory): finished trials are kept and their
+    metric streams replayed into the scheduler/searcher, interrupted trials
+    re-run from their newest checkpoint, and sampling continues to
+    ``num_samples`` — driver-crash / preemption recovery for the whole
+    experiment, not just single trials.
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+    if resume:
+        import os
+
+        if not name:
+            raise ValueError(
+                "resume=True needs the explicit experiment `name` to resume"
+            )
+        _root = os.path.join(os.path.expanduser(storage_path), name)
+        if not os.path.isdir(_root):
+            # A typo'd name would otherwise silently start (and pay for) a
+            # fresh experiment while claiming to resume.
+            raise FileNotFoundError(
+                f"resume=True but no experiment directory at {_root}"
+            )
     if compile_cache_dir is not None:
         from distributed_machine_learning_tpu.utils.compile_cache import (
             enable_persistent_cache,
@@ -156,6 +177,13 @@ def run(
     trials = lifecycle.trials
     pending = lifecycle.pending
     start_time = lifecycle.start_time
+
+    if resume:
+        counts = lifecycle.restore_experiment(resources=resources)
+        log(
+            f"resumed {name}: {counts['finished']} finished trials kept, "
+            f"{counts['requeued']} interrupted trials requeued"
+        )
 
     def safe_cb(hook: str, *args):
         from distributed_machine_learning_tpu.tune.callbacks import (

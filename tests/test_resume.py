@@ -1,0 +1,193 @@
+"""Experiment resume: tune.run(resume=True) after a driver interruption.
+
+Ray's resume semantics (the reference's implicit recovery story): finished
+trials stay finished, their metric streams replay into scheduler/searcher,
+interrupted trials re-run from their newest checkpoint, and sampling
+continues to num_samples. The interruption is simulated by rewriting
+experiment_state.json exactly as a crashed driver leaves it (a trial
+stuck at status RUNNING).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from distributed_machine_learning_tpu import tune
+from distributed_machine_learning_tpu.tune.trial import TrialStatus
+
+
+def checkpointing_trainable(config):
+    """Reports + checkpoints every epoch; resumes from a restored epoch."""
+    restored = tune.get_checkpoint()
+    start = int(restored["epoch"]) if restored else 0
+    for epoch in range(start + 1, int(config.get("num_epochs", 4)) + 1):
+        tune.report(
+            {"validation_loss": float(config["x"]) / epoch, "epoch": epoch},
+            checkpoint={"epoch": epoch},
+        )
+
+
+def _run(tmp_path, name, num_samples, resume=False):
+    return tune.run(
+        checkpointing_trainable,
+        {"x": tune.uniform(1.0, 2.0), "num_epochs": 4},
+        metric="validation_loss",
+        mode="min",
+        num_samples=num_samples,
+        storage_path=str(tmp_path),
+        name=name,
+        seed=7,
+        verbose=0,
+        resume=resume,
+    )
+
+
+def _truncate_results(root, trial_id, keep_records):
+    results_path = os.path.join(root, trial_id, "result.jsonl")
+    with open(results_path) as f:
+        lines = [l for l in f if l.strip()]
+    with open(results_path, "w") as f:
+        f.writelines(lines[:keep_records])
+
+
+def _mark_interrupted(root, trial_id, keep_records):
+    """Rewrite the state file + result stream as a crashed driver leaves
+    them: the trial mid-flight (RUNNING), its last records unwritten."""
+    state_path = os.path.join(root, "experiment_state.json")
+    with open(state_path) as f:
+        state = json.load(f)
+    for t in state["trials"]:
+        if t["trial_id"] == trial_id:
+            t["status"] = "RUNNING"
+    with open(state_path, "w") as f:
+        json.dump(state, f)
+    _truncate_results(root, trial_id, keep_records)
+
+
+def test_resume_requires_name(tmp_path):
+    import pytest
+
+    with pytest.raises(ValueError, match="name"):
+        _run(tmp_path, None, 1, resume=True)
+
+
+def test_resume_missing_directory_raises(tmp_path):
+    """A typo'd name must not silently start a fresh experiment."""
+    import pytest
+
+    with pytest.raises(FileNotFoundError, match="no experiment directory"):
+        _run(tmp_path, "never_ran", 1, resume=True)
+
+
+def test_resume_without_state_file_requeues_everything(tmp_path):
+    """Driver died before ANY trial completed: no experiment_state.json.
+    Every persisted trial must be treated as interrupted (re-run), never
+    silently finished with partial results."""
+    first = _run(tmp_path, "nostate", num_samples=2)
+    root = first.root
+    os.unlink(os.path.join(root, "experiment_state.json"))
+    # Make the streams partial so a wrong TERMINATED default is detectable.
+    for tid in ("trial_00000", "trial_00001"):
+        _truncate_results(root, tid, keep_records=2)
+
+    resumed = _run(tmp_path, "nostate", num_samples=2, resume=True)
+    for t in resumed.trials:
+        assert t.status == TrialStatus.TERMINATED
+        assert t.training_iteration == 4  # full budget, not partial
+
+
+def test_resume_deduplicates_rerun_epochs(tmp_path):
+    """Records past the restore checkpoint are dropped (memory AND disk) so
+    each epoch appears once after the re-run re-reports it."""
+    first = _run(tmp_path, "dedup", num_samples=1)
+    root = first.root
+    _mark_interrupted(root, "trial_00000", keep_records=3)
+    # Newest checkpoint is epoch 4 from the first run; records show 1..3.
+    # Delete the epoch-3+ checkpoints so the restore point is epoch 2:
+    ckdir = os.path.join(root, "trial_00000", "checkpoints")
+    for name in sorted(os.listdir(ckdir))[2:]:
+        os.unlink(os.path.join(ckdir, name))
+
+    resumed = _run(tmp_path, "dedup", num_samples=1, resume=True)
+    trial = resumed.trials[0]
+    epochs = [r["epoch"] for r in trial.results]
+    assert epochs == [1, 2, 3, 4], epochs  # no duplicate epoch 3
+    with open(os.path.join(root, "trial_00000", "result.jsonl")) as f:
+        on_disk = [json.loads(l)["epoch"] for l in f if l.strip()]
+    assert on_disk == [1, 2, 3, 4], on_disk
+
+
+def test_resume_reruns_interrupted_and_continues_sampling(tmp_path):
+    first = _run(tmp_path, "resumable", num_samples=2)
+    assert all(t.status == TrialStatus.TERMINATED for t in first.trials)
+    root = first.root
+    # Simulate the driver dying while trial_00001 was at epoch 2.
+    _mark_interrupted(root, "trial_00001", keep_records=2)
+
+    resumed = _run(tmp_path, "resumable", num_samples=3, resume=True)
+    by_id = {t.trial_id: t for t in resumed.trials}
+    assert set(by_id) == {"trial_00000", "trial_00001", "trial_00002"}
+    assert all(
+        t.status == TrialStatus.TERMINATED for t in resumed.trials
+    ), [(t.trial_id, t.status) for t in resumed.trials]
+
+    # The finished trial was NOT re-run: its stream has exactly 4 records.
+    assert len(by_id["trial_00000"].results) == 4
+    # The interrupted one resumed from its newest checkpoint. Here the
+    # epoch-4 checkpoint survived the "crash", so there was nothing left to
+    # re-run — its restorable progress is the full budget either way.
+    assert by_id["trial_00001"].training_iteration == 4
+    # Sampling continued: the new trial ran its whole budget fresh.
+    assert len(by_id["trial_00002"].results) == 4
+    # Same seed + same index => the restored searcher stream stays aligned:
+    # trial_00002's config came from suggest(index=2), not a restart at 0.
+    assert by_id["trial_00002"].config["x"] != by_id["trial_00000"].config["x"]
+
+
+def test_resume_restores_from_truncated_checkpoint(tmp_path):
+    """Interrupted trial whose checkpoints were pruned back: it restores
+    from the newest REMAINING checkpoint and re-runs the tail."""
+    first = _run(tmp_path, "resumable2", num_samples=1)
+    root = first.root
+    _mark_interrupted(root, "trial_00000", keep_records=1)
+    # Delete the later checkpoints, keep epoch 2's.
+    ckdir = os.path.join(root, "trial_00000", "checkpoints")
+    for name in sorted(os.listdir(ckdir))[2:]:
+        os.unlink(os.path.join(ckdir, name))
+
+    resumed = _run(tmp_path, "resumable2", num_samples=1, resume=True)
+    trial = resumed.trials[0]
+    assert trial.status == TrialStatus.TERMINATED
+    # Replayed record (epoch 1) + re-run epochs 3..4 from the epoch-2 ckpt.
+    epochs = [r["epoch"] for r in trial.results]
+    assert epochs[0] == 1 and epochs[-1] == 4
+    assert 3 in epochs and 4 in epochs
+
+
+def test_resume_with_asha_replays_rungs(tmp_path):
+    """Scheduler state rebuilds from the replayed streams: a resumed ASHA
+    experiment still early-stops new trials against restored rungs."""
+    sched = lambda: tune.ASHAScheduler(
+        max_t=4, grace_period=1, reduction_factor=2
+    )
+    first = tune.run(
+        checkpointing_trainable,
+        {"x": tune.uniform(1.0, 2.0), "num_epochs": 4},
+        metric="validation_loss", mode="min", num_samples=4,
+        scheduler=sched(), storage_path=str(tmp_path), name="resumable3",
+        seed=3, verbose=0,
+    )
+    _mark_interrupted(first.root, "trial_00003", keep_records=1)
+    resumed = tune.run(
+        checkpointing_trainable,
+        {"x": tune.uniform(1.0, 2.0), "num_epochs": 4},
+        metric="validation_loss", mode="min", num_samples=6,
+        scheduler=sched(), storage_path=str(tmp_path), name="resumable3",
+        seed=3, verbose=0, resume=True,
+    )
+    assert len(resumed.trials) == 6
+    assert all(t.status == TrialStatus.TERMINATED for t in resumed.trials)
+    assert np.isfinite(resumed.best_result["validation_loss"])
