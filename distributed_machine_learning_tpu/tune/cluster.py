@@ -500,6 +500,7 @@ def run_distributed(
     keep_checkpoints_num: int = 0,
     checkpoint_storage: Optional[str] = None,
     elastic_listen: Union[str, socket.socket, None] = None,
+    resume: bool = False,
 ) -> ExperimentAnalysis:
     """``tune.run`` across multiple host supervisors (see module docstring).
 
@@ -514,9 +515,30 @@ def run_distributed(
     via ``join_driver`` — elastic scale-up: queued trials dispatch to a
     joiner the moment its hello lands, and ``workers`` may be empty (the
     driver then waits for the first joiner instead of failing).
+
+    ``resume``: continue an interrupted distributed experiment (requires an
+    explicit ``name``) — same semantics as ``tune.run(resume=True)``:
+    finished trials kept and replayed, interrupted trials redispatched from
+    their newest shared-storage checkpoint, sampling continued.
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+    if resume:
+        from distributed_machine_learning_tpu.tune.runner import _validate_resume
+
+        _validate_resume(storage_path, name)
+        if checkpoint_storage is None:
+            # On a real multi-host pool, workers checkpoint to THEIR local
+            # filesystems; the resuming driver would find nothing and re-run
+            # interrupted trials from scratch (discarding their progress).
+            print(
+                "[tune.cluster] WARNING: resume=True without "
+                "checkpoint_storage — restore points are only found if the "
+                "checkpoint paths are on a filesystem this driver shares "
+                "with the workers (true on one host; NOT true across hosts: "
+                "use checkpoint_storage='gs://...' or another shared path).",
+                flush=True,
+            )
     if not workers and elastic_listen is None:
         raise ValueError(
             "run_distributed needs at least one worker address "
@@ -652,6 +674,13 @@ def run_distributed(
     by_id = lifecycle.by_id
     pending = lifecycle.pending
     start_time = lifecycle.start_time
+
+    if resume:
+        counts = lifecycle.restore_experiment()
+        log(
+            f"resumed {name}: {counts['finished']} finished trials kept, "
+            f"{counts['requeued']} interrupted trials requeued"
+        )
 
     def dispatch(trial: Trial, worker: RemoteWorker):
         slot = next(

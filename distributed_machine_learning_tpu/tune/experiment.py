@@ -35,13 +35,19 @@ def _jsonable(value):
 class ExperimentStore:
     """Writes trial configs, per-epoch results, and experiment state to disk."""
 
+    @staticmethod
+    def root_for(storage_path: str, name: str) -> str:
+        """THE experiment-root path rule (one place; the drivers' resume
+        existence checks must agree with where the store actually writes)."""
+        return os.path.join(os.path.expanduser(storage_path), name)
+
     def __init__(
         self,
         storage_path: str,
         name: str,
         checkpoint_storage: Optional[str] = None,
     ):
-        self.root = os.path.join(os.path.expanduser(storage_path), name)
+        self.root = self.root_for(storage_path, name)
         os.makedirs(self.root, exist_ok=True)
         # Checkpoints may live elsewhere than the metrics store — on a pod,
         # shared storage (gs://bucket/...) so any worker can restore any

@@ -43,6 +43,23 @@ from distributed_machine_learning_tpu.tune.trial import (
 DEFAULT_STORAGE = "~/dml_tpu_results"
 
 
+def _validate_resume(storage_path: str, name: Optional[str]) -> None:
+    """Shared resume precondition for both drivers: an explicit name whose
+    experiment directory actually exists — a typo'd name must not silently
+    start (and pay for) a fresh experiment while claiming to resume."""
+    import os
+
+    if not name:
+        raise ValueError(
+            "resume=True needs the explicit experiment `name` to resume"
+        )
+    root = ExperimentStore.root_for(storage_path, name)
+    if not os.path.isdir(root):
+        raise FileNotFoundError(
+            f"resume=True but no experiment directory at {root}"
+        )
+
+
 def run(
     trainable: Callable,
     param_space: Union[Dict[str, Any], SearchSpace],
@@ -106,19 +123,7 @@ def run(
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
     if resume:
-        import os
-
-        if not name:
-            raise ValueError(
-                "resume=True needs the explicit experiment `name` to resume"
-            )
-        _root = os.path.join(os.path.expanduser(storage_path), name)
-        if not os.path.isdir(_root):
-            # A typo'd name would otherwise silently start (and pay for) a
-            # fresh experiment while claiming to resume.
-            raise FileNotFoundError(
-                f"resume=True but no experiment directory at {_root}"
-            )
+        _validate_resume(storage_path, name)
     if compile_cache_dir is not None:
         from distributed_machine_learning_tpu.utils.compile_cache import (
             enable_persistent_cache,

@@ -24,6 +24,20 @@ def quadratic_trial(config):
         )
 
 
+def resumable_quadratic_trial(config):
+    """quadratic_trial that honors checkpoints — resumes at the restored
+    epoch instead of re-reporting from 1 (the contract restore_base assumes)."""
+    x = float(config["x"])
+    restored = tune.get_checkpoint()
+    start = int(restored["epoch"]) if restored else 0
+    for epoch in range(start + 1, int(config.get("epochs", 5)) + 1):
+        loss = (x - 3.0) ** 2 + 1.0 / epoch
+        tune.report(
+            {"loss": loss, "epoch": epoch},
+            checkpoint={"x": x, "epoch": epoch},
+        )
+
+
 def crash_once_trial(config):
     """Fails on its first attempt, succeeds after restart (retry-path test).
 
