@@ -168,3 +168,38 @@ def test_sharded_checkpoint_restore_after_crash(data, tmp_path):
     # epochs 0,1 pre-crash; restore resumes at 2 (not 0)
     assert epochs[:2] == [0, 1]
     assert epochs[2] == 2 and epochs[-1] == 3
+
+
+def test_resnet18_four_device_trial(tmp_path):
+    """BASELINE.json config 5 verbatim: ResNet-18 regression head, one trial
+    spanning 4 cores (dp-sharded batch; BatchNorm stats reduce across the
+    shards under GSPMD)."""
+    from distributed_machine_learning_tpu.data.loader import Dataset
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 16, 16, 3)).astype(np.float32)
+    y = x.mean(axis=(1, 2, 3), keepdims=False)[:, None].astype(np.float32)
+    train, val = Dataset(x[:96], y[:96]), Dataset(x[96:], y[96:])
+
+    analysis = tune.run(
+        tune.with_parameters(
+            tune.train_sharded_regressor, train_data=train, val_data=val
+        ),
+        {
+            "model": "resnet18",
+            "learning_rate": 1e-3,
+            "num_epochs": 2,
+            "batch_size": 32,
+            "lr_schedule": "constant",
+            "seed": 0,
+        },
+        metric="validation_loss",
+        num_samples=1,
+        storage_path=str(tmp_path),
+        resources_per_trial={"devices": 4},
+        verbose=0,
+    )
+    t = analysis.trials[0]
+    assert t.status == TrialStatus.TERMINATED
+    assert t.last_result["num_devices"] == 4
+    assert np.isfinite(t.last_result["validation_loss"])

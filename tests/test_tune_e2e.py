@@ -194,3 +194,41 @@ def test_trial_error_retry_and_report(small_data, tmp_results):
     )
     assert all(t.status == TrialStatus.ERROR for t in analysis2.trials)
     assert "nope" in analysis2.trials[0].error
+
+
+def test_baseline_config1_mlp_california_housing(tmp_path, monkeypatch):
+    """BASELINE.json config 1 verbatim: MLP regression on California Housing
+    (synthetic-tabular fallback), 4 trials on CPU devices. The sklearn
+    download is blocked so the test is hermetic — no network, no retries,
+    same data in every environment."""
+    import sys
+
+    from distributed_machine_learning_tpu.data import california_housing_data
+
+    monkeypatch.setitem(sys.modules, "sklearn.datasets", None)
+    train, val = california_housing_data()
+    assert train.x.ndim == 2 and train.y.shape[1] == 1
+    # Keep the smoke minute-scale: subsample.
+    from distributed_machine_learning_tpu.data.loader import Dataset
+
+    train = Dataset(train.x[:2000], train.y[:2000])
+    val = Dataset(val.x[:500], val.y[:500])
+    analysis = tune.run(
+        tune.with_parameters(
+            tune.train_regressor, train_data=train, val_data=val
+        ),
+        {
+            "model": "mlp",
+            "hidden_sizes": tune.choice([(32,), (64, 32)]),
+            "learning_rate": tune.loguniform(1e-4, 1e-2),
+            "num_epochs": 3,
+            "batch_size": 64,
+        },
+        metric="validation_loss",
+        mode="min",
+        num_samples=4,
+        storage_path=str(tmp_path),
+        verbose=0,
+    )
+    assert analysis.num_terminated() == 4
+    assert np.isfinite(analysis.best_result["validation_loss"])
