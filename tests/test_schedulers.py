@@ -151,8 +151,6 @@ def test_baseline_config3_pbt_cnn1d(tmp_path):
     """BASELINE.json config 3 shape: PBT on the 1D-CNN regressor, exercising
     checkpoint mutate/restore through the tune API (population scaled down
     to minutes on the CPU mesh)."""
-    import numpy as np
-
     from distributed_machine_learning_tpu.data import dummy_regression_data
     from distributed_machine_learning_tpu.tune.trial import TrialStatus
 
@@ -199,13 +197,13 @@ def test_baseline_config3_pbt_cnn1d(tmp_path):
     # donor-budget guard (pbt.py) refuses donors whose checkpoints ran
     # ahead of the laggard, so a skewed completion order can legitimately
     # yield zero perturbations in one sweep. Retry a bounded number of
-    # times — the mutate/restore path MUST be exercised within 3 sweeps
+    # times — the mutate/restore path MUST be exercised within 4 sweeps
     # (observed: fires in ~4 of 5), so a never-perturbs regression still
     # fails loudly instead of silently skipping the core check.
-    for attempt in range(3):
+    for attempt in range(4):
         analysis, perturbations = sweep(attempt)
         if perturbations:
             break
-    assert perturbations > 0, "PBT never perturbed across 3 sweeps"
+    assert perturbations > 0, "PBT never perturbed across 4 sweeps"
     restored = [t for t in analysis.trials if t.restore_path]
     assert restored, "perturbation recorded but no trial restored a donor"
