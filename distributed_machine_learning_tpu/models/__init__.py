@@ -12,6 +12,7 @@ from typing import Any, Dict
 from distributed_machine_learning_tpu.models.cnn import CNN1DRegressor
 from distributed_machine_learning_tpu.models.mlp import MLPRegressor
 from distributed_machine_learning_tpu.models.moe import MoEFF
+from distributed_machine_learning_tpu.models.rnn import RNNRegressor
 from distributed_machine_learning_tpu.models.resnet import (
     ResNet18Regressor,
     ResNetRegressor,
@@ -92,6 +93,18 @@ def _build_resnet18(config: Dict[str, Any]):
     return ResNet18Regressor(out_features=config.get("out_features", 1))
 
 
+@models.register("rnn")
+def _build_rnn(config: Dict[str, Any]):
+    return RNNRegressor(
+        hidden_size=config.get("hidden_size", 64),
+        num_layers=config.get("num_layers", 1),
+        cell_type=config.get("cell_type", "lstm"),
+        dropout_rate=config.get("dropout", 0.0),
+        head_hidden_sizes=tuple(config.get("head_hidden_sizes", (64,))),
+        out_features=config.get("out_features", 1),
+    )
+
+
 def build_model(config: Dict[str, Any]):
     """Construct a model from a trial config; ``config['model']`` picks the family."""
     return models.get(config.get("model", "transformer"))(config)
@@ -107,4 +120,5 @@ __all__ = [
     "SimpleTransformerRegressor",
     "ResNetRegressor",
     "ResNet18Regressor",
+    "RNNRegressor",
 ]

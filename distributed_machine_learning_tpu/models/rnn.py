@@ -1,0 +1,61 @@
+"""Recurrent regressors (LSTM / GRU) for windowed time series.
+
+Beyond-parity model family (the reference's zoo is transformer-only,
+`/root/reference/ray-tune-hpo-regression.py:183-240`): classic recurrent
+baselines the same search spaces can sweep against the transformer.
+
+TPU shape: the recurrence runs as ONE ``lax.scan`` over time via
+``flax.linen.RNN`` — a rolled loop XLA compiles once (cheap compiles, the
+HPO-critical property) whose per-step matmuls batch over the full
+minibatch. Sequences here are short windows (96 steps in the reference's
+pipeline), so a scan is the right tool; for long sequences the
+transformer + ring/Ulysses path is the scalable one.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class RNNRegressor(nn.Module):
+    """Stacked LSTM/GRU encoder + MLP regression head.
+
+    ``cell_type``: "lstm" | "gru". Layers stack with inter-layer dropout;
+    the last time step's top-layer hidden state feeds the head (the same
+    last-token pooling the transformer family uses,
+    `ray-tune-hpo-regression.py:235`).
+    """
+
+    hidden_size: int = 64
+    num_layers: int = 1
+    cell_type: str = "lstm"
+    dropout_rate: float = 0.0
+    head_hidden_sizes: Sequence[int] = (64,)
+    out_features: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        if self.cell_type == "lstm":
+            make_cell = lambda i: nn.LSTMCell(self.hidden_size, name=f"lstm_{i}")
+        elif self.cell_type == "gru":
+            make_cell = lambda i: nn.GRUCell(self.hidden_size, name=f"gru_{i}")
+        else:
+            raise ValueError(
+                f"Unknown cell_type {self.cell_type!r}; expected 'lstm' or 'gru'"
+            )
+        if x.ndim == 2:  # tabular input: one-step sequence (family contract)
+            x = x[:, None, :]
+        h = x
+        for i in range(self.num_layers):
+            h = nn.RNN(make_cell(i), name=f"rnn_{i}")(h)
+            if i < self.num_layers - 1:
+                h = nn.Dropout(self.dropout_rate)(
+                    h, deterministic=deterministic
+                )
+        h = h[:, -1, :]  # last-step pooling
+        for j, width in enumerate(self.head_hidden_sizes):
+            h = nn.relu(nn.Dense(width, name=f"head_{j}")(h))
+        return nn.Dense(self.out_features, name="out")(h)
