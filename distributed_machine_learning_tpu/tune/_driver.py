@@ -200,6 +200,9 @@ class TrialLifecycle:
                     trial.restore_base = ck_it
                 self.requeue(trial)
                 counts["requeued"] += 1
+        # Searchers with suggest-side state (GridSearch's cursor) advance
+        # past the prefix of the space the prior run already proposed.
+        self.searcher.fast_forward(self.next_index)
         return counts
 
     # -- results -----------------------------------------------------------

@@ -20,6 +20,14 @@ class Searcher:
         """Propose a config for trial #``trial_index``; None when exhausted."""
         raise NotImplementedError
 
+    def fast_forward(self, num_trials: int) -> None:
+        """Called on experiment resume with the number of trials already
+        created in the prior run. Index-seeded searchers (random, TPE,
+        BayesOpt) need nothing — suggest(i) is deterministic per index —
+        but searchers with suggest-side state (GridSearch's cursor) must
+        advance past configs already proposed or resume would re-propose
+        the covered prefix of the space."""
+
     def _effective_score(self, result: Optional[Dict[str, Any]], metric: str,
                          mode: str) -> Optional[float]:
         """Resolve searcher-level metric/mode overrides against the experiment
@@ -86,6 +94,13 @@ class GridSearch(Searcher):
             return cfg
         self._cursor = cursor
         return None
+
+    def fast_forward(self, num_trials: int) -> None:
+        # Re-walk the cursor over the already-proposed prefix (identical
+        # feasibility skipping), discarding the configs.
+        for i in range(num_trials):
+            if self.suggest(i) is None:
+                break
 
     @property
     def num_points(self) -> int:

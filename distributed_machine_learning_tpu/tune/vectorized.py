@@ -319,15 +319,17 @@ def run_vectorized(
     ASHA's grace_period, PBT's perturbation_interval).
 
     ``checkpoint_every_epochs``: preemption tolerance for long sweeps — at
-    matching dispatch boundaries the WHOLE population (params, optimizer
-    state, PRNG keys, row mapping, PBT-mutated lr/wd) is checkpointed to
-    ``<experiment>/population.ckpt``.  ``resume=True`` (requires ``name``)
-    reopens the experiment, replays the stored per-epoch records into the
-    scheduler/searcher, restores the population, and continues from the
-    checkpointed epoch — bit-identical to an uninterrupted run.  Supported
-    for single-chunk sweeps (``num_samples <= max_batch_trials``, one
-    static-signature group): the "one big population" shape that long
-    preemptible-TPU sweeps use.
+    matching dispatch boundaries the WHOLE in-flight population (params,
+    optimizer state, PRNG keys, row mapping, PBT-mutated lr/wd, and its
+    trial ids) is checkpointed to ``<experiment>/population.ckpt``.
+    ``resume=True`` (requires ``name``) reopens the experiment: chunks
+    that finished before the interruption replay from disk into the
+    scheduler/searcher, the in-flight chunk restores its device state and
+    continues from the checkpointed epoch — bit-identical to an
+    uninterrupted run — and sampling then continues toward
+    ``num_samples``.  (Chunks spanning multiple static-signature groups
+    disable the population checkpoint for that chunk; the common
+    fixed-architecture sweep is single-group.)
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
@@ -389,11 +391,6 @@ def run_vectorized(
 
     if resume and not name:
         raise ValueError("resume=True requires name= of the prior run")
-    if resume and num_samples > max_batch_trials:
-        raise ValueError(
-            "resume supports single-chunk sweeps "
-            "(num_samples <= max_batch_trials)"
-        )
     name = name or f"vexp_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:6]}"
     store = ExperimentStore(storage_path, name)
     start_time = time.time()
@@ -452,23 +449,21 @@ def run_vectorized(
         os.path.join(store.root, "population.ckpt")
         if checkpoint_every_epochs else None
     )
-    if ckpt_path and num_samples > max_batch_trials:
-        # A multi-chunk sweep would overwrite the single population
-        # checkpoint chunk after chunk, leaving a file resume categorically
-        # rejects — don't write a trap.
-        log(
-            "population checkpointing supports single-chunk sweeps only "
-            f"(num_samples={num_samples} > max_batch_trials="
-            f"{max_batch_trials}); checkpoints disabled"
-        )
-        ckpt_path = None
     resume_state = None
+    unstarted: List[Trial] = []
     if resume:
-        resume_state, resumed_trials = _load_resume_state(
-            store.root, metric, mode, sched, searcher, pbt
+        # The checkpoint records its population's trial_ids, so a
+        # multi-chunk sweep resumes too: finished chunks replay from disk,
+        # the in-flight chunk restores its device state, and sampling
+        # continues toward num_samples afterwards.
+        resume_state, finished_trials, live_batch, unstarted = (
+            _load_resume_state(store.root, metric, mode, sched, searcher, pbt)
         )
-        trials = resumed_trials
-        next_index = num_samples  # nothing left to suggest
+        trials = sorted(
+            finished_trials + live_batch + unstarted, key=lambda t: t.trial_id
+        )
+        next_index = len(trials)
+        searcher.fast_forward(next_index)
 
     def _teardown():
         """Always runs (exceptions, Ctrl-C): persist state, close the store,
@@ -515,9 +510,18 @@ def run_vectorized(
         with jax.default_device(device):
             # Chunked suggest->train loop: adaptive searchers observe all results
             # from earlier chunks before proposing the next one.
-            while (next_index < num_samples and not exhausted) or resume_state:
+            while (
+                (next_index < num_samples and not exhausted)
+                or resume_state
+                or unstarted
+            ):
                 if resume_state is not None:
-                    chunk = list(trials)
+                    chunk = list(resume_state["batch"])
+                elif unstarted:
+                    # Trials created but never run before the interruption
+                    # (crash between their params.json writes and their
+                    # chunk's first checkpoint): run them as their own chunk.
+                    chunk, unstarted = list(unstarted), []
                 else:
                     chunk = []
                     while len(chunk) < max_batch_trials and next_index < num_samples:
@@ -599,11 +603,19 @@ def _load_resume_state(
     sched: TrialScheduler,
     searcher: Searcher,
     pbt,
-) -> Tuple[Dict[str, Any], List[Trial]]:
-    """Rehydrate an interrupted single-chunk sweep: load the population
-    checkpoint, rebuild Trial objects from the on-disk store, and replay
-    their per-epoch records through the scheduler/searcher so rung/model
-    state matches the moment of interruption."""
+) -> Tuple[Dict[str, Any], List[Trial], List[Trial]]:
+    """Rehydrate an interrupted sweep: load the population checkpoint,
+    rebuild Trial objects from the on-disk store, and replay their
+    per-epoch records through the scheduler/searcher so rung/model state
+    matches the moment of interruption.
+
+    Multi-chunk sweeps work too: the checkpoint's ``trial_ids`` name the
+    in-flight chunk; other stored trials with records belong to chunks
+    that already finished and replay as TERMINATED (no device state
+    needed); record-less ones were created but never started (a crash in
+    the window between a chunk's params.json writes and its
+    start-of-chunk checkpoint) and re-run from scratch. Returns
+    ``(resume_state, finished_trials, live_batch, unstarted)``."""
     from distributed_machine_learning_tpu.tune import checkpoint as ckpt_lib
 
     ck = ckpt_lib.load_checkpoint(os.path.join(root, "population.ckpt"))
@@ -613,20 +625,64 @@ def _load_resume_state(
             f"(was the run started with checkpoint_every_epochs > 0?)"
         )
     prior = ExperimentAnalysis.from_directory(root, metric, mode)
-    batch = sorted(prior.trials, key=lambda t: t.trial_id)
-    if not batch:
+    all_trials = sorted(prior.trials, key=lambda t: t.trial_id)
+    if not all_trials:
         raise ValueError(f"no trials found under {root}")
     active = [bool(a) for a in np.asarray(ck["active"])]
     lrs = np.asarray(ck["lrs"], np.float32)
     wds = np.asarray(ck["wds"], np.float32)
     epoch0 = int(ck["epoch0"])
+    raw_ids = ck.get("trial_ids")
+    if raw_ids is None:
+        ck_ids = None
+    elif isinstance(raw_ids, dict):
+        # flax msgpack round-trips python lists as index-keyed state dicts.
+        ck_ids = [str(raw_ids[k]) for k in sorted(raw_ids, key=int)]
+    else:
+        ck_ids = [str(i) for i in raw_ids]
+    unstarted: List[Trial] = []
+    if ck_ids is None:
+        # Checkpoint from before trial_ids were recorded: single-chunk only.
+        batch, finished = all_trials, []
+    else:
+        by_id = {t.trial_id: t for t in all_trials}
+        missing = [i for i in ck_ids if i not in by_id]
+        if missing:
+            raise ValueError(
+                f"population checkpoint names trials missing from {root}: "
+                f"{missing}"
+            )
+        batch = [by_id[i] for i in ck_ids]
+        others = [t for t in all_trials if t.trial_id not in set(ck_ids)]
+        finished = [t for t in others if t.results]
+        unstarted = [t for t in others if not t.results]
+        for trial in unstarted:
+            trial.config = dict(trial.config)
+            sched.on_trial_add(trial)
     if len(batch) != len(active):
         raise ValueError(
-            f"checkpoint population size ({len(active)}) does not match the "
-            f"{len(batch)} trials stored under {root} — the checkpoint is "
-            f"not from this (single-chunk) sweep"
+            f"checkpoint population size ({len(active)}) does not match its "
+            f"{len(batch)} trials under {root}"
         )
     now = time.time()
+
+    # Chunks that finished before the interruption: full replay, terminal.
+    for trial in finished:
+        trial.config = dict(trial.config)
+        sched.on_trial_add(trial)
+        last = trial.results[-1]
+        trial.started_at = now - float(last.get("time_total_s", 0.0))
+        trial.reports_since_restart = len(trial.results)
+        trial.status = TrialStatus.TERMINATED
+        trial.finished_at = trial.started_at + float(
+            last.get("time_total_s", 0.0)
+        )
+    _replay_records(finished, sched, searcher, pbt, metric, mode)
+    for trial in finished:
+        sched.on_trial_complete(trial)
+        searcher.on_trial_complete(
+            trial.trial_id, trial.config, trial.last_result, metric, mode
+        )
     for trial in batch:
         # The crash may have landed mid-epoch: some trials carry records
         # BEYOND the checkpoint. Those epochs re-run on resume, so drop the
@@ -662,17 +718,7 @@ def _load_resume_state(
             trial.finished_at = trial.started_at + (
                 float(last["time_total_s"]) if last else 0.0
             )
-    # Replay in epoch-major order — the order the live loop produced them.
-    max_len = max(len(t.results) for t in batch)
-    for e in range(max_len):
-        for trial in batch:
-            if e < len(trial.results):
-                record = trial.results[e]
-                if pbt is None:
-                    sched.on_trial_result(trial, record)
-                searcher.on_trial_result(
-                    trial.trial_id, dict(trial.config), record, metric, mode
-                )
+    _replay_records(batch, sched, searcher, pbt, metric, mode)
     for idx, trial in enumerate(batch):
         if not active[idx]:
             sched.on_trial_complete(trial)
@@ -687,8 +733,25 @@ def _load_resume_state(
         "lrs": lrs,
         "wds": wds,
         "epoch0": int(ck["epoch0"]),
+        "batch": batch,
     }
-    return resume_state, batch
+    return resume_state, finished, batch, unstarted
+
+
+def _replay_records(trial_list, sched, searcher, pbt, metric, mode):
+    """Route stored per-epoch records back through the scheduler/searcher in
+    epoch-major order — the order the live loop produced them. (Vectorized
+    PBT skips the scheduler: exploit/explore state is device-side.)"""
+    max_len = max((len(t.results) for t in trial_list), default=0)
+    for e in range(max_len):
+        for trial in trial_list:
+            if e < len(trial.results):
+                record = trial.results[e]
+                if pbt is None:
+                    sched.on_trial_result(trial, record)
+                searcher.on_trial_result(
+                    trial.trial_id, dict(trial.config), record, metric, mode
+                )
 
 
 def _emit_epoch_records(
@@ -876,6 +939,34 @@ def _run_population(
                 setattr(d, field, jax.device_put(getattr(d, field),
                                                  repl_sharding))
             program._data_replicated = True
+
+    def save_population(at_epoch: int):
+        ckpt_lib.save_checkpoint(ckpt_path, {
+            "state": {
+                "params": params,
+                "opt_state": opt_state,
+                "batch_stats": batch_stats,
+            },
+            "key_data": np.asarray(jax.random.key_data(base_keys)),
+            "rows": np.asarray(rows, np.int64),
+            "active": np.asarray(active, np.bool_),
+            "lrs": np.asarray(lrs, np.float32),
+            "wds": np.asarray(wds, np.float32),
+            "epoch0": at_epoch,
+            # Which trials form THIS population — lets resume tell the
+            # in-flight chunk apart from chunks that already finished
+            # (multi-chunk sweeps overwrite this file chunk by chunk).
+            "trial_ids": [t.trial_id for t in batch],
+        })
+        log(f"population checkpoint at epoch {at_epoch}")
+
+    if ckpt_every and ckpt_path and resume_state is None:
+        # Start-of-chunk checkpoint: from this moment the file on disk names
+        # the chunk that is actually running. Without it, a crash before
+        # this chunk's first periodic checkpoint would leave the PREVIOUS
+        # chunk's stale checkpoint in place and resume would misclassify
+        # this chunk's trials as finished (or unresumable).
+        save_population(0)
 
     data = program.data
     pbt_notes: Dict[int, str] = {}  # trial index -> donor id, for the record
@@ -1122,20 +1213,7 @@ def _run_population(
             and epoch0 < program.num_epochs
             and (epoch0 // ckpt_every) > ((epoch0 - chunk) // ckpt_every)
         ):
-            ckpt_lib.save_checkpoint(ckpt_path, {
-                "state": {
-                    "params": params,
-                    "opt_state": opt_state,
-                    "batch_stats": batch_stats,
-                },
-                "key_data": np.asarray(jax.random.key_data(base_keys)),
-                "rows": np.asarray(rows, np.int64),
-                "active": np.asarray(active, np.bool_),
-                "lrs": np.asarray(lrs, np.float32),
-                "wds": np.asarray(wds, np.float32),
-                "epoch0": epoch0,
-            })
-            log(f"population checkpoint at epoch {epoch0}")
+            save_population(epoch0)
 
     now = time.time()
     for i, trial in enumerate(batch):

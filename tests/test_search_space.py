@@ -130,3 +130,27 @@ def test_grid_search_skips_infeasible_points():
     # (100, 8) is infeasible and must be skipped, not crash.
     assert len(configs) == 3
     assert all(c["d_model"] % c["num_heads"] == 0 for c in configs)
+
+
+def test_gridsearch_fast_forward_resumes_cursor():
+    """Experiment resume advances GridSearch past the already-proposed
+    prefix instead of re-proposing it (suggest-side cursor state)."""
+    from distributed_machine_learning_tpu import tune
+    from distributed_machine_learning_tpu.tune.search.base import GridSearch
+    from distributed_machine_learning_tpu.tune.search_space import SearchSpace
+
+    space = SearchSpace({"a": tune.choice([1, 2, 3]), "b": tune.choice([10, 20])})
+
+    fresh = GridSearch()
+    fresh.set_search_space(space, seed=0)
+    all_points = [fresh.suggest(i) for i in range(6)]
+    assert fresh.suggest(6) is None  # exhausted after 3*2 points
+
+    resumed = GridSearch()
+    resumed.set_search_space(space, seed=0)
+    resumed.fast_forward(4)  # 4 trials restored from the prior run
+    tail = [resumed.suggest(i) for i in (4, 5)]
+    assert [(p["a"], p["b"]) for p in tail] == [
+        (p["a"], p["b"]) for p in all_points[4:]
+    ]
+    assert resumed.suggest(6) is None

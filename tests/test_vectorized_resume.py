@@ -103,6 +103,108 @@ def test_resume_without_checkpoint_raises(tiny_data, tmp_path):
         )
 
 
+def test_multichunk_resume(tiny_data, tmp_path):
+    """A MULTI-chunk sweep resumes: finished chunks replay from disk, the
+    in-flight chunk restores its device state (matched by the checkpoint's
+    trial_ids), and sampling continues to num_samples afterwards."""
+    train, val = tiny_data
+    kw = dict(
+        train_data=train, val_data=val, metric="validation_mse", mode="min",
+        num_samples=6, max_batch_trials=2, seed=11, verbose=0,
+        checkpoint_every_epochs=2,
+    )
+
+    ref = run_vectorized(SPACE, storage_path=str(tmp_path), name="mref", **kw)
+
+    class _DiesInChunk(FIFOScheduler):
+        """Dies once trial_00002 (chunk 2 of 3) reaches epoch 5."""
+
+        def on_trial_result(self, trial, result):
+            if (
+                trial.trial_id == "trial_00002"
+                and result["training_iteration"] >= 5
+            ):
+                raise RuntimeError("simulated preemption")
+            return super().on_trial_result(trial, result)
+
+    with pytest.raises(RuntimeError, match="simulated preemption"):
+        run_vectorized(
+            SPACE, storage_path=str(tmp_path), name="mcrash",
+            scheduler=_DiesInChunk(), **kw
+        )
+
+    # Honesty guard: the on-disk checkpoint must describe one 2-trial CHUNK,
+    # not the whole sweep — otherwise (e.g. if max_batch_trials got raised by
+    # a platform size multiple) this would silently degrade to a
+    # single-chunk test and never exercise the multi-chunk paths.
+    import os
+
+    from distributed_machine_learning_tpu.tune import checkpoint as ckpt_lib
+
+    ck = ckpt_lib.load_checkpoint(
+        os.path.join(str(tmp_path), "mcrash", "population.ckpt")
+    )
+    assert len(ck["trial_ids"]) == 2, ck["trial_ids"]
+
+    resumed = run_vectorized(
+        SPACE, storage_path=str(tmp_path), name="mcrash", resume=True, **kw
+    )
+    assert len(resumed.trials) == 6
+    assert all(t.status == TrialStatus.TERMINATED for t in resumed.trials)
+    assert all(t.training_iteration == 8 for t in resumed.trials)
+    # Bit-identical to the uninterrupted sweep across ALL chunks: the first
+    # chunk replayed, the interrupted chunk restored mid-flight, and the
+    # remaining chunks were freshly sampled with the searcher stream intact.
+    for tr, tu in zip(
+        sorted(resumed.trials, key=lambda t: t.trial_id),
+        sorted(ref.trials, key=lambda t: t.trial_id),
+    ):
+        assert tr.config["seed"] == tu.config["seed"], tr.trial_id
+        a = tr.results[-1]["validation_mse"]
+        b = tu.results[-1]["validation_mse"]
+        assert a == pytest.approx(b, rel=1e-6), (tr.trial_id, a, b)
+
+
+def test_resume_reruns_unstarted_trials(tiny_data, tmp_path):
+    """Crash in the window between a chunk's params.json writes and its
+    start-of-chunk checkpoint: those trials have no records and no device
+    state — resume re-runs them as their own chunk instead of erroring or
+    silently marking them finished."""
+    import json
+    import os
+
+    train, val = tiny_data
+    kw = dict(
+        train_data=train, val_data=val, metric="validation_mse", mode="min",
+        num_samples=4, max_batch_trials=2, seed=13, verbose=0,
+        checkpoint_every_epochs=2,
+    )
+    with pytest.raises(RuntimeError, match="simulated preemption"):
+        run_vectorized(
+            SPACE, storage_path=str(tmp_path), name="ucrash",
+            scheduler=_DiesAtEpoch(5), **kw
+        )
+    # Simulate the window: a created-but-never-started trial (params.json
+    # only, no result.jsonl).
+    root = os.path.join(str(tmp_path), "ucrash")
+    ghost = os.path.join(root, "trial_00099")
+    os.makedirs(ghost)
+    with open(os.path.join(root, "trial_00000", "params.json")) as f:
+        cfg = json.load(f)
+    with open(os.path.join(ghost, "params.json"), "w") as f:
+        json.dump(cfg, f)
+
+    resumed = run_vectorized(
+        SPACE, storage_path=str(tmp_path), name="ucrash", resume=True, **kw
+    )
+    by_id = {t.trial_id: t for t in resumed.trials}
+    assert "trial_00099" in by_id
+    ghost_trial = by_id["trial_00099"]
+    assert ghost_trial.status == TrialStatus.TERMINATED
+    assert ghost_trial.training_iteration == 8  # ran its full budget
+    assert all(t.status == TrialStatus.TERMINATED for t in resumed.trials)
+
+
 def test_resume_with_asha_rung_state(tiny_data, tmp_path):
     """ASHA rung statistics are replayed on resume: stopped trials stay
     stopped and survivors finish the full budget."""
