@@ -447,9 +447,13 @@ def _default_blocks(S: int, D: int, block_q, block_k, backward: bool = False):
     if backward:
         # The backward cap binds EXPLICIT blocks too (the pre-kernel
         # backward enforced a hard 512 ceiling the same way): a user-tuned
-        # forward tile must not push the backward's ~2x-larger working set
-        # past VMEM.
-        cap = 1024 if D <= 64 else (512 if D <= 256 else 256)
+        # forward tile must not push the backward's larger working set past
+        # VMEM. 512 max: the dK/dV kernel holds FOUR [bq, bk] f32
+        # intermediates (logits, p, dp, ds), and at 1024 tiles Mosaic's
+        # scoped-vmem stack measured 16.69MB against the 16MB limit inside
+        # a real model's backward (OOM observed on v5e at D=64, seq 2048 —
+        # the standalone microbench sat just under the line).
+        cap = 512 if D <= 256 else 256
         bq = min(cap, S) if block_q is None else min(block_q, cap, S)
         bk = min(cap, S) if block_k is None else min(block_k, cap, S)
         return bq, bk
