@@ -55,10 +55,11 @@ def _route_softmax_to_flash(seq_len: int, head_dim: int) -> bool:
     """Whether a plain softmax attention call should run the Pallas flash
     kernel instead: same exact math (online softmax), measured faster on
     chip from ~1k sequence length at head_dim <= 64 (benchmarks/RESULTS.md:
-    fwd ~20%, fwd+bwd up to 2.9x at seq 4096). Gated to that measured-win
-    regime: at D=128 the flash FORWARD measured 2x slower than XLA (only
-    the grad path won), and this route also serves eval — configs wanting
-    flash at bigger head dims select attention_type='flash' explicitly."""
+    fwd ~20%, fwd+bwd 2.0x at seq 4096, full train step 1.48x at seq
+    2048). Gated to that measured-win regime: at D=128 the flash FORWARD
+    measured 2x slower than XLA (only the grad path won), and this route
+    also serves eval — configs wanting flash at bigger head dims select
+    attention_type='flash' explicitly."""
     return _on_tpu() and seq_len >= 1024 and head_dim <= 64
 
 

@@ -433,16 +433,16 @@ def _flash_backward(
 def _default_blocks(S: int, D: int, block_q, block_k, backward: bool = False):
     """Resolve block sizes: as large as VMEM comfortably allows.
 
-    Measured on a v5e chip (seq 4096, B8 H8 D64, 2026-07-30): 128x128 blocks
-    ran 54ms vs XLA's fused attention at 24ms — the grid overhead and tiny
-    MXU matmuls dominated; 1024x1024 blocks ran 19ms forward (~20% faster
-    than XLA) and 25ms forward+backward (2.9x faster). The cap clamps by
-    head dim to keep the per-step VMEM working set (f32 logits/p blocks
-    ~2*bq*bk*4 bytes + streamed q/k/v/acc blocks ~4*bk*D*4 bytes, plus
-    Pallas double-buffering) inside the ~16MB budget; the backward holds
-    roughly twice the [bq, bk] intermediates (logits, p, dp, ds), so its
-    caps step down one size earlier — only D=64 has been measured at the
-    1024 tile size.
+    Measured on a v5e chip (2026-07-30, benchmarks/RESULTS.md): 128x128
+    blocks ran 54ms forward vs XLA's fused attention at 24ms (seq 4096,
+    D=64) — grid overhead and tiny MXU matmuls dominated; 1024-tile
+    forwards run ~20% faster than XLA, and with the 512-tile backward the
+    fwd+bwd pair is 2.0x faster. The caps clamp by head dim to keep the
+    per-step VMEM working set (f32 [bq, bk] intermediates + streamed
+    blocks + Pallas double-buffering) inside the ~16MB scoped budget:
+    1024-tile forwards fail Mosaic compilation at D=256 (measured), and
+    1024-tile backwards fail inside real models even at D=64 (stack
+    measured 16.69MB vs the 16MB limit).
     """
     if backward:
         # The backward cap binds EXPLICIT blocks too (the pre-kernel
@@ -457,7 +457,7 @@ def _default_blocks(S: int, D: int, block_q, block_k, backward: bool = False):
         bq = min(cap, S) if block_q is None else min(block_q, cap, S)
         bk = min(cap, S) if block_k is None else min(block_k, cap, S)
         return bq, bk
-    cap = 1024 if D <= 256 else (512 if D <= 512 else 256)
+    cap = 1024 if D <= 128 else (512 if D <= 512 else 256)
     bq = min(cap, S) if block_q is None else min(block_q, S)
     bk = min(cap, S) if block_k is None else min(block_k, S)
     return bq, bk
