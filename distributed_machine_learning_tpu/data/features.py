@@ -63,16 +63,19 @@ SENSOR_CHANNELS = ("heart_rate", "sleep", "intensity", "steps")
 
 
 def compute_rolling_features(df, channels=SENSOR_CHANNELS,
-                             minutes_per_step: int = 1):
+                             minutes_per_step: int = 1, ddof: int = 0):
     """Add the rolling mean/std feature columns to a raw sensor DataFrame.
 
     The reference's data FILES carry these columns precomputed (its
     `config.py:2-78` only names them); this computes them from the raw
     streams — trailing windows of ``ROLLING_WINDOWS_MIN`` minutes
-    (pandas ``rolling(min_periods=1)`` semantics, population std) via the
-    native prefix-sum kernel (`native/window_ops.cpp: dml_rolling_stats`).
-    ``minutes_per_step`` converts the window grid to row counts for data
-    sampled at other cadences. Returns a new DataFrame; input is unchanged.
+    (pandas ``rolling(min_periods=1)`` semantics) via the native
+    prefix-sum kernel (`native/window_ops.cpp: dml_rolling_stats`).
+    ``ddof=0`` (default) is population std; pass ``ddof=1`` to match
+    pandas' ``.rolling().std()`` default if the precomputed data files
+    were generated that way. ``minutes_per_step`` converts the window
+    grid to row counts for data sampled at other cadences. Returns a new
+    DataFrame; input is unchanged.
     """
     import pandas as pd
 
@@ -94,7 +97,7 @@ def compute_rolling_features(df, channels=SENSOR_CHANNELS,
         if base not in df.columns:
             raise KeyError(f"raw channel {base!r} not in DataFrame columns")
         stats = _native.rolling_stats(
-            df[base].to_numpy(dtype=float), steps
+            df[base].to_numpy(dtype=float), steps, ddof=ddof
         )
         for j, w in enumerate(ROLLING_WINDOWS_MIN):
             new_cols[f"{base}_mean_{w}min"] = stats[:, j * 2]

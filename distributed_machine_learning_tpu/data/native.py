@@ -180,19 +180,25 @@ def gather(x: np.ndarray, idx: np.ndarray) -> np.ndarray:
     return out
 
 
-def rolling_stats(series: np.ndarray, windows) -> np.ndarray:
+def rolling_stats(series: np.ndarray, windows, ddof: int = 0) -> np.ndarray:
     """Trailing rolling mean/std of a 1-D series over several windows.
 
     Returns [n, len(windows)*2], columns (mean_w0, std_w0, mean_w1, ...).
-    Semantics match ``pandas.rolling(w, min_periods=1)`` with population
-    std, including NaN handling: NaN entries are skipped per-window (sensor
-    gaps), and a window with no finite entries yields NaN. Both paths
-    compute through the same double prefix sums, so results agree to
-    float32 rounding with or without the C++ toolchain.
+    Semantics match ``pandas.rolling(w, min_periods=1)``, including NaN
+    handling: NaN entries are skipped per-window (sensor gaps), and a
+    window with no finite entries yields NaN. ``ddof=0`` (default) is
+    population std; ``ddof=1`` matches pandas' ``.rolling().std()``
+    default (NaN wherever the finite count is <= ddof) — the reference's
+    precomputed '*_std_*min' data columns may use either convention, so
+    both are exposed. Both paths compute through the same double prefix
+    sums, so results agree to float32 rounding with or without the C++
+    toolchain.
     """
     x = np.ascontiguousarray(np.asarray(series).reshape(-1), dtype=np.float32)
     ws = np.ascontiguousarray(np.asarray(list(windows)), dtype=np.int64)
     n, k = len(x), len(ws)
+    if ddof < 0:
+        raise ValueError(f"ddof must be >= 0: {ddof}")
     if n == 0 or k == 0:
         return np.empty((n, k * 2), dtype=np.float32)
     if (ws <= 0).any():
@@ -203,7 +209,7 @@ def rolling_stats(series: np.ndarray, windows) -> np.ndarray:
         rc = lib.dml_rolling_stats(x, n, ws, k, out)
         if rc != n:  # pragma: no cover
             raise RuntimeError(f"dml_rolling_stats failed: rc={rc}")
-        return out
+        return _apply_ddof(out, x, ws, ddof)
     xd = x.astype(np.float64)
     ok = np.isfinite(xd)
     xz = np.where(ok, xd, 0.0)
@@ -219,7 +225,40 @@ def rolling_stats(series: np.ndarray, windows) -> np.ndarray:
             mu = np.where(cnt > 0, (s1[idx + 1] - s1[lo]) / cnt, np.nan)
             var = np.maximum((s2[idx + 1] - s2[lo]) / cnt - mu * mu, 0.0)
             out[:, j * 2] = mu
-            out[:, j * 2 + 1] = np.sqrt(var)
+            out[:, j * 2 + 1] = np.sqrt(var) * _ddof_factor(cnt, ddof)
+    return out
+
+
+def _ddof_factor(cnt: np.ndarray, ddof: int) -> np.ndarray:
+    """Population-std -> ddof-std rescale per window: sqrt(cnt/(cnt-ddof)),
+    NaN where cnt <= ddof (pandas convention). 1.0 at ddof=0."""
+    if ddof == 0:
+        return np.ones_like(cnt)
+    return np.sqrt(
+        np.where(cnt > ddof, cnt / np.maximum(cnt - ddof, 1e-300), np.nan)
+    )
+
+
+def _apply_ddof(out: np.ndarray, x: np.ndarray, ws: np.ndarray,
+                ddof: int) -> np.ndarray:
+    """Rescale the native kernel's population-std columns to ``ddof``
+    freedom. The per-window finite counts come from one prefix sum over
+    the finite mask — O(n*k) numpy, so the native kernel stays a single
+    population-stats entry point."""
+    if ddof == 0:
+        return out
+    n = len(x)
+    sc = np.concatenate(
+        [[0.0], np.cumsum(np.isfinite(x).astype(np.float64))]
+    )
+    idx = np.arange(n)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        for j, w in enumerate(ws):
+            lo = np.maximum(idx - int(w) + 1, 0)
+            cnt = sc[idx + 1] - sc[lo]
+            out[:, j * 2 + 1] = (
+                out[:, j * 2 + 1].astype(np.float64) * _ddof_factor(cnt, ddof)
+            ).astype(np.float32)
     return out
 
 

@@ -34,9 +34,22 @@ and GSPMD):
 
 from __future__ import annotations
 
+import math
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+
+
+def expert_capacity(capacity_factor: float, top_k: int, group: int,
+                    num_experts: int) -> int:
+    """Static per-expert slot count per routing group.
+
+    Ceil of ``capacity_factor * top_k * group / num_experts`` (the
+    GShard/Switch convention), floored at 1 slot so every expert is
+    addressable even in degenerate tiny-group configs.
+    """
+    return max(math.ceil(capacity_factor * top_k * group / num_experts), 1)
 
 
 def collect_aux(mutated_collections) -> jnp.ndarray:
@@ -81,7 +94,7 @@ class MoEFF(nn.Module):
             g -= 1
         G = T // g
         # Static per-expert capacity per group, with headroom for imbalance.
-        capacity = max(int(self.capacity_factor * K * g / E), 1)
+        capacity = expert_capacity(self.capacity_factor, K, g, E)
 
         # batch_axis=0: the expert dim is a batch of independent MLPs, not
         # receptive field — without it variance_scaling counts fan_in = E*D

@@ -457,9 +457,12 @@ def _default_blocks(S: int, D: int, block_q, block_k, backward: bool = False):
         bq = min(cap, S) if block_q is None else min(block_q, cap, S)
         bk = min(cap, S) if block_k is None else min(block_k, cap, S)
         return bq, bk
+    # The cap binds EXPLICIT blocks too (same policy as the backward):
+    # 1024-tile forwards fail Mosaic compilation at D=256 (measured), so a
+    # user-pinned block_q=1024 there would be a compile error, not a knob.
     cap = 1024 if D <= 128 else (512 if D <= 512 else 256)
-    bq = min(cap, S) if block_q is None else min(block_q, S)
-    bk = min(cap, S) if block_k is None else min(block_k, S)
+    bq = min(cap, S) if block_q is None else min(block_q, cap, S)
+    bk = min(cap, S) if block_k is None else min(block_k, cap, S)
     return bq, bk
 
 

@@ -88,6 +88,12 @@ def _cluster_secret() -> Optional[bytes]:
     return s.encode() if s else None
 
 
+def _is_loopback(host: str) -> bool:
+    """Whether ``host`` stays on this machine — the one predicate behind
+    every no-secret pickle-trust warning, so the sites can't drift."""
+    return host in ("127.0.0.1", "localhost", "::1")
+
+
 # --------------------------------------------------------------------------
 # framing
 # --------------------------------------------------------------------------
@@ -270,7 +276,7 @@ def serve_worker(
     # tens of seconds, and the driver's connect queues in the backlog while
     # device enumeration finishes (it blocks on the hello frame, not connect).
     secret = secret if secret is not None else _cluster_secret()
-    if host not in ("127.0.0.1", "localhost", "::1") and not secret:
+    if not _is_loopback(host) and not secret:
         print(
             "[cluster] WARNING: supervisor bound to a routable interface "
             f"({host}) without DML_CLUSTER_SECRET — anyone who can reach the "
@@ -384,6 +390,17 @@ def join_driver(
     stop then)."""
     secret = secret if secret is not None else _cluster_secret()
     host, port = driver_address.rsplit(":", 1)
+    if not _is_loopback(host) and not secret:
+        # Same trust model (and warning) as the listening endpoints, inverse
+        # direction: frames FROM the dialed driver are pickled too, so an
+        # unauthenticated non-loopback driver can run code on this worker.
+        print(
+            "[cluster] WARNING: dialing a non-loopback driver "
+            f"({host}) without DML_CLUSTER_SECRET — a spoofed or compromised "
+            "driver can run code on this host (pickled control frames). Set "
+            "a shared secret or join drivers on loopback/private networks.",
+            flush=True,
+        )
     sock = socket.create_connection((host, int(port)), timeout=30)
     # Clear the connect timeout: it would otherwise persist on every recv,
     # and a >30s gap between driver frames (idle worker, long epoch) would
@@ -606,7 +623,7 @@ def run_distributed(
             bind_host = elastic_server.getsockname()[0]
         except OSError:
             bind_host = "?"
-        if bind_host not in ("127.0.0.1", "::1") and not _cluster_secret():
+        if not _is_loopback(bind_host) and not _cluster_secret():
             # Same trust model (and warning) as serve_worker: hellos are
             # pickled frames, so a routable bind without a shared secret
             # means anyone who can reach the port runs code on the DRIVER.

@@ -40,6 +40,21 @@ class TestMoEFF:
         aux = jax.tree_util.tree_leaves(mut["moe"])
         assert aux and float(aux[0]) > 0.0
 
+    def test_capacity_uses_ceil(self):
+        """GShard/Switch capacity convention (ADVICE r2): ceil, not
+        truncate — at factor 1.0 a non-integer K*g/E must round UP so the
+        factor keeps the tokens it promised."""
+        from distributed_machine_learning_tpu.models.moe import (
+            expert_capacity,
+        )
+
+        # 1.0 * 2 * 100 / 3 = 66.67 -> 67 (int() would give 66)
+        assert expert_capacity(1.0, 2, 100, 3) == 67
+        # exact division unchanged
+        assert expert_capacity(1.0, 2, 96, 4) == 48
+        # floor at one slot
+        assert expert_capacity(0.01, 1, 4, 8) == 1
+
     def test_single_expert_equals_dense(self):
         """E=1/top_k=1 with ample capacity degenerates to the expert's MLP."""
         x = jax.random.normal(jax.random.key(2), (2, 8, 8))

@@ -51,6 +51,26 @@ def test_default_blocks_path(qkv):
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-4)
 
 
+def test_explicit_blocks_clamped_to_measured_caps():
+    """User-pinned tiles are clamped to the measured Mosaic-compilable caps
+    in BOTH directions (ADVICE r2): block 1024 at D=256 fails Mosaic on the
+    forward, so an explicit 1024 must come back as the 512 cap, not a
+    compile error at trace time."""
+    from distributed_machine_learning_tpu.ops.pallas_attention import (
+        _default_blocks,
+    )
+
+    # Forward: D=256 caps at 512 even when the user asks for 1024.
+    assert _default_blocks(4096, 256, 1024, 1024) == (512, 512)
+    # D<=128 honors an explicit 1024.
+    assert _default_blocks(4096, 64, 1024, 1024) == (1024, 1024)
+    # Backward holds its own (smaller) caps against explicit blocks.
+    assert _default_blocks(4096, 64, 1024, 1024, backward=True) == (512, 512)
+    assert _default_blocks(4096, 512, 1024, 1024, backward=True) == (256, 256)
+    # Sequence length still bounds everything.
+    assert _default_blocks(128, 64, 1024, None) == (128, 128)
+
+
 def test_causal_matches_masked_dense(qkv):
     q, k, v = qkv
     out = flash_attention(

@@ -41,6 +41,42 @@ def test_matches_pandas_rolling(series):
         np.testing.assert_allclose(out[:, j * 2 + 1], std_ref, atol=1e-3)
 
 
+def test_ddof1_matches_pandas_default(series):
+    """ddof=1 reproduces pandas' .rolling().std() default, including the
+    NaN at count==1 — the convention the reference's precomputed
+    '*_std_*min' columns most plausibly used (ADVICE r2)."""
+    windows = [3, 15, 60]
+    out = native.rolling_stats(series, windows, ddof=1)
+    s = pd.Series(series.astype(np.float64))
+    for j, w in enumerate(windows):
+        std_ref = s.rolling(w, min_periods=1).std().to_numpy()  # ddof=1
+        np.testing.assert_allclose(
+            out[:, j * 2 + 1], std_ref, atol=1e-3, equal_nan=True
+        )
+    assert np.isnan(out[0, 1])  # count==1 -> NaN, like pandas
+
+
+def test_ddof1_with_nan_gaps(series, monkeypatch):
+    x = series[:300].copy()
+    x[10] = np.nan
+    x[40:70] = np.nan
+    a = native.rolling_stats(x, [5, 30], ddof=1)
+    s = pd.Series(x.astype(np.float64))
+    for j, w in enumerate([5, 30]):
+        std_ref = s.rolling(w, min_periods=1).std().to_numpy()
+        np.testing.assert_allclose(
+            a[:, j * 2 + 1], std_ref, atol=1e-3, equal_nan=True
+        )
+    monkeypatch.setattr(native, "_get_lib", lambda: None)
+    b = native.rolling_stats(x, [5, 30], ddof=1)
+    np.testing.assert_allclose(a, b, atol=1e-4, equal_nan=True)
+
+
+def test_negative_ddof_rejected(series):
+    with pytest.raises(ValueError, match="ddof"):
+        native.rolling_stats(series, [5], ddof=-1)
+
+
 def test_native_and_fallback_agree(series, monkeypatch):
     windows = list(ROLLING_WINDOWS_MIN)
     a = native.rolling_stats(series, windows)
