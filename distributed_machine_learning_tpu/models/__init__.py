@@ -9,6 +9,8 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
+import jax.numpy as jnp
+
 from distributed_machine_learning_tpu.models.cnn import CNN1DRegressor
 from distributed_machine_learning_tpu.models.mlp import MLPRegressor
 from distributed_machine_learning_tpu.models.moe import MoEFF
@@ -25,6 +27,30 @@ from distributed_machine_learning_tpu.utils.registry import Registry
 
 models: Registry = Registry("model")
 
+_DTYPE_NAMES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "f32": jnp.float32,
+    "bf16": jnp.bfloat16,
+}
+
+
+def compute_dtype_of(config: Dict[str, Any]):
+    """Resolve ``config["compute_dtype"]`` to a jnp dtype (None = float32
+    promotion, flax's default). One lookup shared by every family builder
+    AND the train loops' input staging, so the model's matmul dtype and the
+    staged data dtype can never disagree."""
+    cd = config.get("compute_dtype")
+    if cd is None or not isinstance(cd, str):
+        return cd
+    try:
+        return _DTYPE_NAMES[cd]
+    except KeyError:
+        raise ValueError(
+            f"Unknown compute_dtype {cd!r}; expected one of "
+            f"{sorted(_DTYPE_NAMES)}"
+        ) from None
+
 
 @models.register("mlp")
 def _build_mlp(config: Dict[str, Any]):
@@ -32,6 +58,7 @@ def _build_mlp(config: Dict[str, Any]):
         hidden_sizes=tuple(config.get("hidden_sizes", (128, 64))),
         dropout_rate=config.get("dropout", 0.0),
         out_features=config.get("out_features", 1),
+        dtype=compute_dtype_of(config),
     )
 
 
@@ -43,6 +70,7 @@ def _build_cnn(config: Dict[str, Any]):
         dropout_rate=config.get("dropout", 0.0),
         head_hidden=config.get("head_hidden", 64),
         out_features=config.get("out_features", 1),
+        dtype=compute_dtype_of(config),
     )
 
 
@@ -73,6 +101,7 @@ def _build_transformer(config: Dict[str, Any]):
         batch_axis=config.get("batch_axis", "dp"),
         head_axis=config.get("head_axis", "tp"),
         mesh=config.get("mesh"),
+        dtype=compute_dtype_of(config),
     )
 
 
@@ -85,12 +114,16 @@ def _build_simple_transformer(config: Dict[str, Any]):
         dim_feedforward=config.get("dim_feedforward", 256),
         dropout_rate=config.get("dropout", 0.1),
         max_seq_length=config.get("max_seq_length", 2000),
+        dtype=compute_dtype_of(config),
     )
 
 
 @models.register("resnet18")
 def _build_resnet18(config: Dict[str, Any]):
-    return ResNet18Regressor(out_features=config.get("out_features", 1))
+    return ResNet18Regressor(
+        out_features=config.get("out_features", 1),
+        dtype=compute_dtype_of(config),
+    )
 
 
 @models.register("rnn")
@@ -102,6 +135,7 @@ def _build_rnn(config: Dict[str, Any]):
         dropout_rate=config.get("dropout", 0.0),
         head_hidden_sizes=tuple(config.get("head_hidden_sizes", (64,))),
         out_features=config.get("out_features", 1),
+        dtype=compute_dtype_of(config),
     )
 
 
@@ -113,6 +147,7 @@ def build_model(config: Dict[str, Any]):
 __all__ = [
     "models",
     "build_model",
+    "compute_dtype_of",
     "MLPRegressor",
     "MoEFF",
     "CNN1DRegressor",

@@ -2,7 +2,7 @@
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Optional, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -16,15 +16,19 @@ class CNN1DRegressor(nn.Module):
     dropout_rate: float = 0.0
     head_hidden: int = 64
     out_features: int = 1
+    dtype: Optional[jnp.dtype] = None  # compute dtype; params stay float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
         if x.ndim == 2:  # tabular -> single-step sequence
             x = x[:, None, :]
         for ch in self.channels:
-            x = nn.Conv(int(ch), kernel_size=(self.kernel_size,), padding="SAME")(x)
+            x = nn.Conv(
+                int(ch), kernel_size=(self.kernel_size,), padding="SAME",
+                dtype=self.dtype,
+            )(x)
             x = nn.relu(x)
             x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
         x = x.mean(axis=1)  # global average pool over sequence
-        x = nn.relu(nn.Dense(self.head_hidden)(x))
-        return nn.Dense(self.out_features)(x)
+        x = nn.relu(nn.Dense(self.head_hidden, dtype=self.dtype)(x))
+        return nn.Dense(self.out_features, dtype=self.dtype)(x)

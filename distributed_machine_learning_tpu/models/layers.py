@@ -90,7 +90,9 @@ class PositionalEncoding(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
         table = jnp.asarray(sincos_position_table(self.max_len, self.d_model))
-        x = x + table[None, : x.shape[1], :]
+        # Match x's dtype: under bf16 compute an f32 table would promote the
+        # whole residual stream back to f32, silently undoing mixed precision.
+        x = x + table[None, : x.shape[1], :].astype(x.dtype)
         return nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
 
 
@@ -147,6 +149,10 @@ class MultiHeadAttention(nn.Module):
     batch_axis: Optional[str] = "dp"
     head_axis: Optional[str] = "tp"
     mesh: Optional[Mesh] = None
+    # Compute dtype for projections (params stay float32). The attention
+    # kernels themselves already run their softmax/accumulation in float32
+    # and cast back to q.dtype (ops/attention.py, ops/pallas_attention.py).
+    dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
@@ -164,7 +170,8 @@ class MultiHeadAttention(nn.Module):
 
         def proj(name):
             return nn.DenseGeneral(
-                features=(self.num_heads, head_dim), axis=-1, name=name
+                features=(self.num_heads, head_dim), axis=-1, name=name,
+                dtype=self.dtype,
             )(x)
 
         q, k, v = proj("query"), proj("key"), proj("value")
@@ -260,7 +267,8 @@ class MultiHeadAttention(nn.Module):
                 out = dot_product_attention(q, k, v, mask=mask, scale=scale)
 
         out = nn.DenseGeneral(
-            features=self.d_model, axis=(-2, -1), name="out"
+            features=self.d_model, axis=(-2, -1), name="out",
+            dtype=self.dtype,
         )(out)
         return nn.Dropout(self.dropout_rate)(out, deterministic=deterministic)
 
@@ -270,12 +278,13 @@ class LinearFF(nn.Module):
 
     d_model: int
     dim_feedforward: int
+    dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        x = nn.Dense(self.dim_feedforward)(x)
+        x = nn.Dense(self.dim_feedforward, dtype=self.dtype)(x)
         x = nn.relu(x)
-        return nn.Dense(self.d_model)(x)
+        return nn.Dense(self.d_model, dtype=self.dtype)(x)
 
 
 class DepthwiseSeparableFF(nn.Module):
@@ -290,6 +299,7 @@ class DepthwiseSeparableFF(nn.Module):
     d_model: int
     dim_feedforward: int
     kernel_size: int = 3
+    dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -299,10 +309,14 @@ class DepthwiseSeparableFF(nn.Module):
             padding="SAME",
             feature_group_count=self.d_model,
             name="depthwise",
+            dtype=self.dtype,
         )(x)
-        x = nn.Conv(features=self.dim_feedforward, kernel_size=(1,), name="pointwise")(x)
+        x = nn.Conv(
+            features=self.dim_feedforward, kernel_size=(1,), name="pointwise",
+            dtype=self.dtype,
+        )(x)
         x = nn.relu(x)
-        return nn.Dense(self.d_model, name="out_proj")(x)
+        return nn.Dense(self.d_model, name="out_proj", dtype=self.dtype)(x)
 
 
 class EncoderLayer(nn.Module):
@@ -336,6 +350,11 @@ class EncoderLayer(nn.Module):
     batch_axis: Optional[str] = "dp"
     head_axis: Optional[str] = "tp"
     mesh: Optional[Mesh] = None
+    # Compute dtype for the whole block (params stay float32). LayerNorm
+    # gets it too: its scale/offset params are f32, statistics are computed
+    # through flax's f32 promotion internally, and the output lands back in
+    # this dtype so the residual stream stays narrow.
+    dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
@@ -350,10 +369,11 @@ class EncoderLayer(nn.Module):
             batch_axis=self.batch_axis,
             head_axis=self.head_axis,
             mesh=self.mesh,
+            dtype=self.dtype,
             name="attention",
         )(x, deterministic=deterministic)
         attn = StochasticDepth(self.stochastic_depth_rate)(attn, deterministic)
-        x = nn.LayerNorm(name="norm1")(x + attn)
+        x = nn.LayerNorm(name="norm1", dtype=self.dtype)(x + attn)
 
         ff_type = self.feedforward_type or (
             "depthwise_separable" if self.depthwise_separable_conv else "linear"
@@ -363,9 +383,11 @@ class EncoderLayer(nn.Module):
                 d_model=self.d_model,
                 dim_feedforward=self.dim_feedforward,
                 kernel_size=self.attn_kernel_size,
+                dtype=self.dtype,
                 name="ff",
             )(x)
         elif ff_type == "moe":
+            # MoEFF follows its input's dtype (router pinned f32 inside).
             ff = MoEFF(
                 d_model=self.d_model,
                 dim_feedforward=self.dim_feedforward,
@@ -377,7 +399,8 @@ class EncoderLayer(nn.Module):
             )(x)
         elif ff_type == "linear":
             ff = LinearFF(
-                d_model=self.d_model, dim_feedforward=self.dim_feedforward, name="ff"
+                d_model=self.d_model, dim_feedforward=self.dim_feedforward,
+                dtype=self.dtype, name="ff"
             )(x)
         else:
             raise ValueError(
@@ -386,4 +409,4 @@ class EncoderLayer(nn.Module):
             )
         ff = nn.Dropout(self.dropout_rate)(ff, deterministic=deterministic)
         ff = StochasticDepth(self.stochastic_depth_rate)(ff, deterministic)
-        return nn.LayerNorm(name="norm2")(x + ff)
+        return nn.LayerNorm(name="norm2", dtype=self.dtype)(x + ff)

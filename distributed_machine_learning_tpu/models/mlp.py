@@ -6,7 +6,7 @@ Flattens sequence inputs if present, so it is drop-in on both tabular
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Optional, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -16,12 +16,13 @@ class MLPRegressor(nn.Module):
     hidden_sizes: Sequence[int] = (128, 64)
     dropout_rate: float = 0.0
     out_features: int = 1
+    dtype: Optional[jnp.dtype] = None  # compute dtype; params stay float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
         if x.ndim > 2:
             x = x.reshape(x.shape[0], -1)
         for width in self.hidden_sizes:
-            x = nn.relu(nn.Dense(int(width))(x))
+            x = nn.relu(nn.Dense(int(width), dtype=self.dtype)(x))
             x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
-        return nn.Dense(self.out_features)(x)
+        return nn.Dense(self.out_features, dtype=self.dtype)(x)

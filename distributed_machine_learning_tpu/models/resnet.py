@@ -9,7 +9,7 @@ variant wraps time-series inputs as [B, S, 1, C].
 from __future__ import annotations
 
 from functools import partial
-from typing import Sequence
+from typing import Optional, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -18,21 +18,28 @@ import jax.numpy as jnp
 class BasicBlock(nn.Module):
     features: int
     strides: int = 1
+    # Compute dtype for convs (params stay float32). BatchNorm gets it too;
+    # its batch statistics are still accumulated in float32 internally
+    # (flax upcasts for mean/var), only the normalized output is narrow.
+    dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
-        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, dtype=self.dtype)
         residual = x
         y = nn.Conv(self.features, (3, 3), strides=(self.strides, self.strides),
-                    padding="SAME", use_bias=False)(x)
+                    padding="SAME", use_bias=False, dtype=self.dtype)(x)
         y = norm()(y)
         y = nn.relu(y)
-        y = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False)(y)
+        y = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(y)
         y = norm()(y)
         if residual.shape != y.shape:
             residual = nn.Conv(self.features, (1, 1),
                                strides=(self.strides, self.strides),
-                               use_bias=False, name="proj")(residual)
+                               use_bias=False, dtype=self.dtype,
+                               name="proj")(residual)
             residual = norm(name="proj_bn")(residual)
         return nn.relu(y + residual)
 
@@ -43,25 +50,29 @@ class ResNetRegressor(nn.Module):
     stage_sizes: Sequence[int] = (2, 2, 2, 2)
     width: int = 64
     out_features: int = 1
+    dtype: Optional[jnp.dtype] = None  # compute dtype; params stay float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         if x.ndim == 3:  # [B, S, F] time series -> pseudo-image [B, S, 1, F]
             x = x[:, :, None, :]
         x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding="SAME",
-                    use_bias=False, name="stem")(x)
+                    use_bias=False, dtype=self.dtype, name="stem")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         name="stem_bn")(x)
+                         dtype=self.dtype, name="stem_bn")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 strides = 2 if (i > 0 and j == 0) else 1
                 x = BasicBlock(self.width * (2 ** i), strides=strides,
+                               dtype=self.dtype,
                                name=f"stage{i}_block{j}")(x, train=train)
         x = x.mean(axis=(1, 2))  # global average pool
-        return nn.Dense(self.out_features, name="head")(x)
+        return nn.Dense(self.out_features, dtype=self.dtype, name="head")(x)
 
 
-def ResNet18Regressor(out_features: int = 1) -> ResNetRegressor:
-    return ResNetRegressor(stage_sizes=(2, 2, 2, 2), out_features=out_features)
+def ResNet18Regressor(out_features: int = 1,
+                      dtype: Optional[jnp.dtype] = None) -> ResNetRegressor:
+    return ResNetRegressor(stage_sizes=(2, 2, 2, 2), out_features=out_features,
+                           dtype=dtype)

@@ -14,7 +14,7 @@ transformer + ring/Ulysses path is the scalable one.
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Optional, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -35,13 +35,21 @@ class RNNRegressor(nn.Module):
     dropout_rate: float = 0.0
     head_hidden_sizes: Sequence[int] = (64,)
     out_features: int = 1
+    # Compute dtype (params stay float32). Note: the recurrence compounds
+    # rounding across time steps, so bf16 here trades more precision than
+    # in feed-forward families — fine for short windows, opt-in always.
+    dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
         if self.cell_type == "lstm":
-            make_cell = lambda i: nn.LSTMCell(self.hidden_size, name=f"lstm_{i}")
+            make_cell = lambda i: nn.LSTMCell(
+                self.hidden_size, dtype=self.dtype, name=f"lstm_{i}"
+            )
         elif self.cell_type == "gru":
-            make_cell = lambda i: nn.GRUCell(self.hidden_size, name=f"gru_{i}")
+            make_cell = lambda i: nn.GRUCell(
+                self.hidden_size, dtype=self.dtype, name=f"gru_{i}"
+            )
         else:
             raise ValueError(
                 f"Unknown cell_type {self.cell_type!r}; expected 'lstm' or 'gru'"
@@ -57,5 +65,5 @@ class RNNRegressor(nn.Module):
                 )
         h = h[:, -1, :]  # last-step pooling
         for j, width in enumerate(self.head_hidden_sizes):
-            h = nn.relu(nn.Dense(width, name=f"head_{j}")(h))
-        return nn.Dense(self.out_features, name="out")(h)
+            h = nn.relu(nn.Dense(width, dtype=self.dtype, name=f"head_{j}")(h))
+        return nn.Dense(self.out_features, dtype=self.dtype, name="out")(h)

@@ -46,12 +46,13 @@ class RegressionHead(nn.Module):
 
     hidden_sizes: Sequence[int] = (128, 64, 32, 16)
     out_features: int = 1
+    dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         for width in self.hidden_sizes:
-            x = nn.relu(nn.Dense(width)(x))
-        return nn.Dense(self.out_features)(x)
+            x = nn.relu(nn.Dense(width, dtype=self.dtype)(x))
+        return nn.Dense(self.out_features, dtype=self.dtype)(x)
 
 
 class TransformerRegressor(nn.Module):
@@ -88,6 +89,11 @@ class TransformerRegressor(nn.Module):
     batch_axis: Optional[str] = "dp"
     head_axis: Optional[str] = "tp"
     mesh: Optional[Mesh] = None
+    # Mixed precision: compute dtype for every matmul/conv in the model
+    # (params stay float32; losses and attention softmax stay float32).
+    # jnp.bfloat16 doubles MXU throughput and halves activation HBM traffic
+    # on TPU. Wired from config["compute_dtype"] by models.build_model.
+    dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
@@ -98,6 +104,7 @@ class TransformerRegressor(nn.Module):
         pipeline — SURVEY.md §3.3 note).
         """
         layer_kwargs = dict(
+            dtype=self.dtype,
             d_model=self.d_model,
             num_heads=self.num_heads,
             dim_feedforward=self.dim_feedforward,
@@ -119,7 +126,7 @@ class TransformerRegressor(nn.Module):
             mesh=self.mesh,
         )
 
-        x = nn.Dense(self.d_model, name="input_projection")(x)
+        x = nn.Dense(self.d_model, name="input_projection", dtype=self.dtype)(x)
         x = PositionalEncoding(
             d_model=self.d_model,
             dropout_rate=self.dropout_rate,
@@ -152,6 +159,7 @@ class TransformerRegressor(nn.Module):
         return RegressionHead(
             hidden_sizes=tuple(self.head_hidden_sizes),
             out_features=self.out_features,
+            dtype=self.dtype,
             name="head",
         )(x)
 
@@ -168,10 +176,11 @@ class SimpleTransformerRegressor(nn.Module):
     dim_feedforward: int = 256
     dropout_rate: float = 0.1
     max_seq_length: int = 2000
+    dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
-        x = nn.Dense(self.d_model, name="input_projection")(x)
+        x = nn.Dense(self.d_model, name="input_projection", dtype=self.dtype)(x)
         x = PositionalEncoding(
             d_model=self.d_model,
             dropout_rate=self.dropout_rate,
@@ -183,6 +192,7 @@ class SimpleTransformerRegressor(nn.Module):
                 num_heads=self.num_heads,
                 dim_feedforward=self.dim_feedforward,
                 dropout_rate=self.dropout_rate,
+                dtype=self.dtype,
                 name=f"layer_{i}",
             )(x, deterministic=deterministic)
-        return nn.Dense(1, name="head")(x[:, -1, :])
+        return nn.Dense(1, name="head", dtype=self.dtype)(x[:, -1, :])

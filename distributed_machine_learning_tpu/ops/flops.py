@@ -26,13 +26,19 @@ _PEAK_BF16 = (
 
 def device_peak_flops(device, compute_dtype: str = "float32") -> Optional[float]:
     """Peak matmul FLOP/s of ``device`` for the given compute dtype
-    (None when unknown — e.g. the CPU test platform)."""
+    (None when unknown — e.g. the CPU test platform).
+
+    ``compute_dtype`` takes every alias ``models.compute_dtype_of`` accepts
+    ("bfloat16"/"bf16"): the MFU denominator must track the dtype the model
+    actually computes in, or a bf16 run reports ~2x-inflated MFU.
+    """
     if device is None or device.platform != "tpu":
         return None
     kind = (getattr(device, "device_kind", "") or "").lower()
+    is_bf16 = str(compute_dtype) in ("bfloat16", "bf16")
     for key, bf16_peak in _PEAK_BF16:
         if key in kind:
-            return bf16_peak if compute_dtype == "bfloat16" else bf16_peak / 2
+            return bf16_peak if is_bf16 else bf16_peak / 2
     return None
 
 

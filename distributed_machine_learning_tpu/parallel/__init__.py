@@ -13,6 +13,7 @@ from distributed_machine_learning_tpu.parallel.mesh import (
     mesh_devices,
     replicated,
 )
+from distributed_machine_learning_tpu.parallel import multihost
 from distributed_machine_learning_tpu.parallel.pipeline import (
     make_stacked_stage_fn,
     pipeline_apply,
@@ -32,6 +33,7 @@ from distributed_machine_learning_tpu.parallel.ulysses import ulysses_attention
 
 __all__ = [
     "auto_mesh",
+    "multihost",
     "batch_sharding",
     "make_mesh",
     "mesh_devices",

@@ -20,6 +20,7 @@ from distributed_machine_learning_tpu.tune.callbacks import (
     JsonlCallback,
     LoggerCallback,
     ProfilerCallback,
+    TensorBoardCallback,
 )
 from distributed_machine_learning_tpu.tune.experiment import (
     ExperimentAnalysis,
@@ -102,6 +103,7 @@ __all__ = [
     "LoggerCallback",
     "JsonlCallback",
     "ProfilerCallback",
+    "TensorBoardCallback",
     "Resources",
     "Trial",
     "TrialStatus",

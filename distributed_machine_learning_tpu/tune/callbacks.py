@@ -148,6 +148,76 @@ class JsonlCallback(Callback):
         self._log.close()
 
 
+class TensorBoardCallback(Callback):
+    """Per-trial TensorBoard scalar logging (Ray Tune's default TB surface).
+
+    One run directory per trial under ``<root>/tensorboard/<trial_id>/`` —
+    the layout TensorBoard's run selector expects (each trial is a run, so
+    sweeps overlay as curve families).  Every numeric field of every
+    ``tune.report`` lands as a scalar at ``step=training_iteration``; the
+    trial's hyperparameters are stamped once as ``config/<key>`` scalars so
+    runs are identifiable in TB without opening params.json.  Writes need no
+    tensorflow/tensorboardX: the event-file format is hand-encoded
+    (utils/tensorboard.py).
+    """
+
+    def __init__(self, logdir: Optional[str] = None):
+        self._logdir = logdir
+        self._writers: Dict[str, Any] = {}
+
+    def setup(self, experiment_root: str, metric: str, mode: str):
+        self._root = self._logdir or os.path.join(
+            experiment_root, "tensorboard"
+        )
+
+    def _writer(self, trial: Trial):
+        w = self._writers.get(trial.trial_id)
+        if w is None:
+            from distributed_machine_learning_tpu.utils.tensorboard import (
+                SummaryWriter,
+            )
+
+            w = SummaryWriter(os.path.join(self._root, trial.trial_id))
+            self._writers[trial.trial_id] = w
+            for key, val in (trial.config or {}).items():
+                if isinstance(val, bool) or not isinstance(
+                    val, (int, float)
+                ):
+                    continue
+                w.add_scalar(f"config/{key}", float(val), step=0)
+        return w
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]):
+        step = int(result.get("training_iteration", len(trial.results)) or 0)
+        scalars = [
+            (key, float(val))
+            for key, val in result.items()
+            if not isinstance(val, bool) and isinstance(val, (int, float))
+        ]
+        if scalars:
+            self._writer(trial).add_scalars(scalars, step=step)
+
+    def _close(self, trial_id: str):
+        w = self._writers.pop(trial_id, None)
+        if w is not None:
+            w.close()
+
+    def on_trial_complete(self, trial: Trial):
+        # Close (not just flush): one open fd per live trial, not per trial
+        # ever started — a 1000+-trial sweep would exhaust the fd limit. A
+        # retried/requeued trial that reports again just gets a fresh event
+        # file in the same run dir; TensorBoard merges them.
+        self._close(trial.trial_id)
+
+    def on_trial_error(self, trial: Trial, error: str):
+        self._close(trial.trial_id)
+
+    def on_experiment_end(self, trials: List[Trial], wall_clock_s: float):
+        for w in self._writers.values():
+            w.close()
+        self._writers.clear()
+
+
 class ProfilerCallback(Callback):
     """Capture a ``jax.profiler`` trace of the experiment.
 

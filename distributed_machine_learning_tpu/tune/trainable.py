@@ -26,7 +26,9 @@ Config keys (all optional unless noted): ``model`` family; model arch keys
 (see models.build_model); ``optimizer``, ``learning_rate`` (required),
 ``weight_decay``, ``momentum``, ``gradient_clipping``; ``loss_function``;
 ``lr_schedule``, ``warmup_steps``, ``total_steps``; ``batch_size``;
-``num_epochs``; ``seed``; ``compute_dtype`` ("bfloat16" casts inputs).
+``num_epochs``; ``seed``; ``compute_dtype`` ("bfloat16" = real mixed
+precision: bf16 matmuls/activations via the model's flax dtype, float32
+params/optimizer/losses — models.compute_dtype_of).
 """
 
 from __future__ import annotations
@@ -76,9 +78,11 @@ def train_regressor(
     num_epochs = int(config.get("num_epochs", 20))
     seed = int(config.get("seed", 0))
     loss_name = str(config.get("loss_function", "mse"))
-    compute_dtype = (
-        jnp.bfloat16 if config.get("compute_dtype") == "bfloat16" else jnp.float32
-    )
+    # One resolver for both the staged-input dtype and (inside build_model)
+    # the model's matmul dtype — they must agree or mixed precision is a lie.
+    from distributed_machine_learning_tpu.models import compute_dtype_of
+
+    compute_dtype = compute_dtype_of(config) or jnp.float32
 
     data = stage_data(
         train_data, val_data, int(config.get("batch_size", 32)), compute_dtype

@@ -170,9 +170,9 @@ class _GroupProgram:
         cfg = static_cfg
         self.loss_name = str(cfg.get("loss_function", "mse"))
         self.num_epochs = int(cfg.get("num_epochs", 20))
-        compute_dtype = (
-            jnp.bfloat16 if cfg.get("compute_dtype") == "bfloat16" else jnp.float32
-        )
+        from distributed_machine_learning_tpu.models import compute_dtype_of
+
+        compute_dtype = compute_dtype_of(cfg) or jnp.float32
 
         self.data = data = stage_data(
             train_data, val_data, int(cfg.get("batch_size", 32)), compute_dtype

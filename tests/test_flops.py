@@ -38,6 +38,10 @@ def test_device_peak_flops():
     bf16 = device_peak_flops(FakeTpu(), "bfloat16")
     assert fp32 == pytest.approx(197e12 / 2)
     assert bf16 == pytest.approx(197e12)
+    # Every alias compute_dtype_of accepts must hit the bf16 peak — a raw
+    # config string "bf16" dividing by the f32 peak would inflate MFU 2x.
+    assert device_peak_flops(FakeTpu(), "bf16") == pytest.approx(197e12)
+    assert device_peak_flops(FakeTpu(), "f32") == pytest.approx(197e12 / 2)
 
     class UnknownTpu:
         platform = "tpu"
