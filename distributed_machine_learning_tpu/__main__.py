@@ -1,0 +1,74 @@
+"""Package CLI: ``python -m distributed_machine_learning_tpu <command>``.
+
+The reference's launch surface is ``python <script>.py`` (SURVEY.md §1 L5);
+the framework keeps that for experiment drivers (your script calls
+``tune.run``) and adds the operational commands a multi-host deployment
+needs:
+
+* ``worker`` — start a host trial supervisor (or ``--join`` a driver
+  elastically); forwards to ``tune.cluster``'s CLI.
+* ``info`` — print the jax backend/device/mesh view of THIS process, the
+  first thing to check when a pod host misbehaves.
+
+Note on startup cost: ``python -m`` imports the package ``__init__`` (and
+with it jax/flax/optax) before this module runs, so even ``--help`` pays
+the framework import — the in-function imports below are for readability,
+not deferral; there is no way to dodge an eager package ``__init__``
+under ``-m``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _info() -> None:
+    import jax
+
+    devs = jax.devices()
+    out = {
+        "backend": jax.default_backend(),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": len(devs),
+        "device_kinds": sorted({d.device_kind for d in devs}),
+    }
+    try:
+        from distributed_machine_learning_tpu.ops.flops import (
+            device_peak_flops,
+        )
+
+        out["peak_flops_f32"] = device_peak_flops(devs[0])
+        out["peak_flops_bf16"] = device_peak_flops(devs[0], "bfloat16")
+    except Exception:  # noqa: BLE001 - info must print what it can
+        pass
+    print(json.dumps(out, indent=2))
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    usage = (
+        "usage: python -m distributed_machine_learning_tpu "
+        "{worker|info} [args]\n"
+        "  worker  host trial supervisor (see 'worker --help')\n"
+        "  info    jax backend/device summary for this process"
+    )
+    if not argv or argv[0] in ("-h", "--help"):
+        print(usage)
+        return
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "worker":
+        from distributed_machine_learning_tpu.tune.cluster import _main
+
+        _main(rest)
+    elif cmd == "info":
+        _info()
+    else:
+        print(usage, file=sys.stderr)
+        raise SystemExit(2)
+
+
+if __name__ == "__main__":
+    main()

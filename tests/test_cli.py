@@ -1,0 +1,48 @@
+"""Package CLI (`python -m distributed_machine_learning_tpu`)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=120):
+    # Overwriting PYTHONPATH with the repo root also drops the image's
+    # .axon_site entry, so the child never claims the TPU tunnel.
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+    })
+    return subprocess.run(
+        [sys.executable, "-m", "distributed_machine_learning_tpu"] + args,
+        env=env, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def test_info_prints_device_summary():
+    proc = _run(["info"])
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["backend"] == "cpu"
+    assert out["local_devices"] == 4
+    assert out["process_count"] == 1
+
+
+def test_help_and_unknown_command():
+    proc = _run(["--help"], timeout=30)
+    assert proc.returncode == 0
+    assert "worker" in proc.stdout and "info" in proc.stdout
+    proc = _run(["frobnicate"], timeout=30)
+    assert proc.returncode == 2
+
+
+def test_worker_help_forwards_to_cluster_cli():
+    proc = _run(["worker", "--help"], timeout=30)
+    assert proc.returncode == 0
+    assert "--join" in proc.stdout and "--port" in proc.stdout
