@@ -78,15 +78,24 @@ def make_optimizer(
     weight_decay: float = 0.0,
     momentum: float = 0.0,
     gradient_clipping: Optional[float] = None,
+    accumulate_grad_batches: int = 1,
 ) -> optax.GradientTransformation:
     """Build an optax transformation from config values.
 
     ``gradient_clipping`` > 0 prepends global-norm clipping, matching the
     reference's ``clip_grad_norm_`` guard (`:338-339`).
+    ``accumulate_grad_batches`` > 1 wraps the whole chain in
+    ``optax.MultiSteps``: k micro-batch gradients average into one
+    optimizer step — k× the effective batch without k× the activation
+    memory (the standard big-model knob on HBM-bound TPUs). Clipping sits
+    inside the wrapper, so it applies to the ACCUMULATED gradient, and the
+    lr schedule advances once per real update, not per micro-batch.
     """
     tx = optimizers.get(name)(
         learning_rate, weight_decay=weight_decay, momentum=momentum
     )
     if gradient_clipping and gradient_clipping > 0:
         tx = optax.chain(optax.clip_by_global_norm(float(gradient_clipping)), tx)
+    if accumulate_grad_batches and int(accumulate_grad_batches) > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=int(accumulate_grad_batches))
     return tx

@@ -41,6 +41,7 @@ from distributed_machine_learning_tpu.tune.search import (
     RandomSearch,
     Searcher,
     TPESearch,
+    WarmStartSearcher,
 )
 from distributed_machine_learning_tpu.tune.search_space import (
     Constraint,
@@ -96,6 +97,7 @@ __all__ = [
     "GridSearch",
     "BayesOptSearch",
     "TPESearch",
+    "WarmStartSearcher",
     "Searcher",
     "ExperimentAnalysis",
     "ExperimentStore",

@@ -11,7 +11,10 @@ fake, selected purely by the path's scheme.
 
 from __future__ import annotations
 
+import queue
 import re
+import threading
+import time
 from typing import Any, Dict, Optional
 
 import jax
@@ -70,6 +73,152 @@ def find_latest_checkpoint(directory: str):
         if m and int(m.group(1)) >= best_it:
             best_path, best_it = backend.join(d, name), int(m.group(1))
     return best_path, best_it
+
+
+class AsyncCheckpointWriter:
+    """Overlap checkpoint writes with training (orbax-style async save).
+
+    ``submit(path, tree)`` returns immediately; the device->host transfer,
+    msgpack serialization, and storage write run on ONE background thread,
+    in submission order. The trial thread goes straight back to training —
+    at real checkpoint sizes the epoch that used to stall behind the write
+    now runs concurrently with it.
+
+    Correctness contract (why this is safe in-process):
+    * ``submit`` snapshots EVERY array leaf: jax arrays get a device-side
+      copy (cheap — HBM bandwidth; the D2H transfer stays on the writer
+      thread), because the caller's train step donates its buffers
+      (``donate_argnums``) and the next step would delete the submitted
+      arrays out from under the serializer ("Array has been deleted" —
+      donation is a no-op on CPU, so only real TPU runs hit it). Mutable
+      numpy leaves are host-copied for the same reason.
+    * A reader who might race a pending write (retry restore, PBT exploit
+      of a peer's checkpoint) calls ``wait(path)`` first; the threaded
+      executor routes every restore through it. Cross-process restores
+      (cluster workers) keep synchronous saves instead — a remote reader
+      cannot wait on this process's queue.
+    * Write errors re-raise on ``wait``; ``close`` logs any unclaimed
+      errors through ``log`` (or re-raises with ``raise_errors=True``) —
+      never a silent drop.
+    """
+
+    def __init__(self, log=None):
+        self._q: "queue.Queue" = queue.Queue()
+        self._lock = threading.Lock()
+        self._pending: Dict[str, threading.Event] = {}
+        self._errors: Dict[str, BaseException] = {}
+        self._log = log or (lambda msg: print(
+            f"[checkpoint] {msg}", flush=True
+        ))
+        self._thread = threading.Thread(
+            target=self._worker, name="ckpt-writer", daemon=True
+        )
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            path, tree, done = item
+            try:
+                save_checkpoint(path, tree)
+            except BaseException as exc:  # noqa: BLE001 - surfaced on wait
+                with self._lock:
+                    self._errors[path] = exc
+            finally:
+                with self._lock:
+                    self._pending.pop(path, None)
+                done.set()
+
+    @staticmethod
+    def _snapshot_leaf(x):
+        # jax.Array.copy() is a device-side copy: donation of the original
+        # cannot delete it, and the D2H read stays on the writer thread.
+        if isinstance(x, (jax.Array, np.ndarray)):
+            return x.copy()
+        return x
+
+    def submit(self, path: str, tree: Dict[str, Any]) -> str:
+        """Enqueue a write; returns ``path`` immediately."""
+        snapshot = jax.tree.map(self._snapshot_leaf, tree)
+        done = threading.Event()
+        with self._lock:
+            self._pending[path] = done
+        self._q.put((path, snapshot, done))
+        return path
+
+    def wait(self, path: Optional[str] = None,
+             timeout: Optional[float] = None) -> bool:
+        """Block until ``path`` (or every pending write) is durable; re-raise
+        its write error if one occurred. Returns False if ``timeout``
+        expired with writes still pending."""
+        deadline = None if timeout is None else time.time() + timeout
+        if path is None:
+            with self._lock:
+                events = list(self._pending.values())
+            for ev in events:
+                left = None if deadline is None else deadline - time.time()
+                if left is not None and left <= 0:
+                    return False
+                if not ev.wait(left):
+                    return False
+            with self._lock:
+                errors = list(self._errors.values())
+            if errors:
+                raise errors[0]
+            return True
+        with self._lock:
+            ev = self._pending.get(path)
+        if ev is not None and not ev.wait(
+            None if deadline is None else max(deadline - time.time(), 0.0)
+        ):
+            return False
+        with self._lock:
+            err = self._errors.get(path)
+        if err is not None:
+            raise err
+        return True
+
+    def close(self, raise_errors: bool = False,
+              timeout: Optional[float] = 30.0) -> None:
+        """Flush pending writes (bounded by ``timeout``) and stop the worker.
+
+        Unclaimed write errors are logged (or re-raised when
+        ``raise_errors``); a write still hung at the deadline is abandoned
+        with a log line rather than blocking teardown forever.
+        """
+        if not self._thread.is_alive():
+            return
+        flushed = True
+        try:
+            flushed = self.wait(timeout=timeout)
+        except BaseException:
+            if raise_errors:
+                self._q.put(None)
+                self._thread.join(timeout=10)
+                raise
+        if not flushed:
+            with self._lock:
+                stuck = list(self._pending)
+            self._log(
+                f"WARNING: abandoning {len(stuck)} hung checkpoint "
+                f"write(s) at teardown: {stuck[:3]}"
+            )
+        with self._lock:
+            errors = dict(self._errors)
+        if errors and not raise_errors:
+            first_path, first_err = next(iter(errors.items()))
+            self._log(
+                f"WARNING: {len(errors)} checkpoint write(s) failed and "
+                f"were never waited on; first: {first_path}: {first_err!r}"
+            )
+        self._q.put(None)
+        # Only wait for the worker when the queue actually drained — a hung
+        # write would pin this join for its full timeout, and the thread is
+        # a daemon, so abandoning it is safe.
+        if flushed:
+            self._thread.join(timeout=10)
 
 
 def prune_checkpoints(directory: str, keep: int, protect=None) -> int:

@@ -69,7 +69,11 @@ from distributed_machine_learning_tpu.tune.schedulers.base import (
     FIFOScheduler,
     TrialScheduler,
 )
-from distributed_machine_learning_tpu.tune.search.base import RandomSearch, Searcher
+from distributed_machine_learning_tpu.tune.search.base import (
+    RandomSearch,
+    Searcher,
+    maybe_warm_start,
+)
 from distributed_machine_learning_tpu.tune.search_space import SearchSpace
 from distributed_machine_learning_tpu.tune.session import (
     PauseTrial,
@@ -518,6 +522,7 @@ def run_distributed(
     checkpoint_storage: Optional[str] = None,
     elastic_listen: Union[str, socket.socket, None] = None,
     resume: bool = False,
+    points_to_evaluate: Optional[Sequence[Dict[str, Any]]] = None,
 ) -> ExperimentAnalysis:
     """``tune.run`` across multiple host supervisors (see module docstring).
 
@@ -574,7 +579,7 @@ def run_distributed(
         if isinstance(param_space, SearchSpace)
         else SearchSpace(param_space)
     )
-    searcher = search_alg or RandomSearch()
+    searcher = maybe_warm_start(search_alg or RandomSearch(), points_to_evaluate)
     searcher.set_search_space(space, seed)
     sched = scheduler or FIFOScheduler()
     sched.set_experiment(metric, mode)

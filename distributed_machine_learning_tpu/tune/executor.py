@@ -169,6 +169,11 @@ class ThreadTrialExecutor:
         self.store = store
         self.events = event_queue
         self._threads: Dict[str, threading.Thread] = {}
+        # Async checkpoint writes: trials resume training while the D2H
+        # transfer + serialization + IO run on the writer thread. Safe
+        # in-process because every restore below waits on the path first
+        # (ckpt_lib.AsyncCheckpointWriter's contract).
+        self._ckpt_writer = ckpt_lib.AsyncCheckpointWriter()
 
     def start_trial(self, trial: Trial, trainable: Callable, leased_devices: List):
         devices = [d for _, d in leased_devices]
@@ -192,6 +197,9 @@ class ThreadTrialExecutor:
         deadline = time.time() + timeout
         for t in self._threads.values():
             t.join(timeout=max(deadline - time.time(), 0.0))
+        # Flush pending checkpoint writes so the experiment directory is
+        # complete (resume reads it) before the runner returns.
+        self._ckpt_writer.close()
 
     # -- trial thread body ---------------------------------------------------
     def _run(self, trial: Trial, trainable: Callable, devices: List,
@@ -215,7 +223,7 @@ class ThreadTrialExecutor:
                 path = ckpt_lib.checkpoint_path(
                     self.store.checkpoint_dir(trial), count
                 )
-                ckpt_lib.save_checkpoint(path, checkpoint)
+                self._ckpt_writer.submit(path, checkpoint)
                 trial.latest_checkpoint = path
                 trial.latest_checkpoint_iteration = count
             event = ResultEvent(trial, metrics, incarnation)
@@ -224,6 +232,11 @@ class ThreadTrialExecutor:
             return event.decision
 
         def checkpoint_loader():
+            # The restore target may still be in flight on the writer
+            # thread (fast PBT exploit, immediate retry) — wait for THAT
+            # path to be durable before reading it.
+            if trial.restore_path:
+                self._ckpt_writer.wait(trial.restore_path)
             return ckpt_lib.load_checkpoint(trial.restore_path)
 
         set_session(Session(trial, report_fn, checkpoint_loader, devices))

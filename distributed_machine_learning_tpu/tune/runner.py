@@ -32,7 +32,11 @@ from distributed_machine_learning_tpu.tune.schedulers.base import (
     FIFOScheduler,
     TrialScheduler,
 )
-from distributed_machine_learning_tpu.tune.search.base import RandomSearch, Searcher
+from distributed_machine_learning_tpu.tune.search.base import (
+    RandomSearch,
+    Searcher,
+    maybe_warm_start,
+)
 from distributed_machine_learning_tpu.tune.search_space import SearchSpace
 from distributed_machine_learning_tpu.tune.trial import (
     Resources,
@@ -86,8 +90,14 @@ def run(
     time_limit_per_trial_s: Optional[float] = None,
     trial_executor: str = "thread",
     resume: bool = False,
+    points_to_evaluate: Optional[List[Dict[str, Any]]] = None,
 ) -> ExperimentAnalysis:
     """Run an HPO experiment; see module docstring.
+
+    ``points_to_evaluate``: configs (possibly partial — missing keys are
+    sampled) run as the first trials before the searcher proposes its own;
+    model-based searchers observe their results (Ray's knob of the same
+    name).
 
     ``stop``: dict of result-key -> threshold; a trial stops once any key's
     reported value reaches the threshold (e.g. ``{"training_iteration": 20}``).
@@ -137,7 +147,7 @@ def run(
         if isinstance(param_space, SearchSpace)
         else SearchSpace(param_space)
     )
-    searcher = search_alg or RandomSearch()
+    searcher = maybe_warm_start(search_alg or RandomSearch(), points_to_evaluate)
     searcher.set_search_space(space, seed)
     sched = scheduler or FIFOScheduler()
     sched.set_experiment(metric, mode)

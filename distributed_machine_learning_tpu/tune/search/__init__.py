@@ -2,8 +2,10 @@ from distributed_machine_learning_tpu.tune.search.base import (
     GridSearch,
     RandomSearch,
     Searcher,
+    WarmStartSearcher,
 )
 from distributed_machine_learning_tpu.tune.search.bayesopt import BayesOptSearch
 from distributed_machine_learning_tpu.tune.search.tpe import TPESearch
 
-__all__ = ["Searcher", "RandomSearch", "GridSearch", "BayesOptSearch", "TPESearch"]
+__all__ = ["Searcher", "RandomSearch", "GridSearch", "BayesOptSearch",
+           "TPESearch", "WarmStartSearcher"]

@@ -51,6 +51,50 @@ class Searcher:
         pass
 
 
+class WarmStartSearcher(Searcher):
+    """Evaluate given configs first, then delegate to the wrapped searcher.
+
+    Ray's ``points_to_evaluate``: known-good or must-check configs (a
+    previous sweep's best, a paper's setting) run as the first trials.
+    Points may be PARTIAL configs — missing keys are sampled from the
+    space, fixed keys are honored exactly (constraints still apply). The
+    inner searcher sees a shifted trial index, so its proposal sequence is
+    identical to a run without warm-start points, and it observes the
+    point-trials' results through the usual hooks (model-based searchers
+    learn from them).
+    """
+
+    def __init__(self, inner: Searcher, points):
+        self.inner = inner
+        self.points = [dict(p) for p in points]
+
+    def set_search_space(self, space: SearchSpace, seed: int):
+        super().set_search_space(space, seed)
+        self.inner.set_search_space(space, seed)
+
+    def suggest(self, trial_index: int) -> Optional[Dict[str, Any]]:
+        if trial_index < len(self.points):
+            return self.space.with_overrides(
+                **self.points[trial_index]
+            ).sample(("point", self.seed, trial_index))
+        return self.inner.suggest(trial_index - len(self.points))
+
+    def fast_forward(self, num_trials: int) -> None:
+        self.inner.fast_forward(max(0, num_trials - len(self.points)))
+
+    def on_trial_result(self, trial_id, config, result, metric, mode):
+        self.inner.on_trial_result(trial_id, config, result, metric, mode)
+
+    def on_trial_complete(self, trial_id, config, result, metric, mode):
+        self.inner.on_trial_complete(trial_id, config, result, metric, mode)
+
+
+def maybe_warm_start(searcher: Searcher, points) -> Searcher:
+    """The runners' shared ``points_to_evaluate`` hook: wrap when points
+    are given, pass through otherwise."""
+    return WarmStartSearcher(searcher, points) if points else searcher
+
+
 class RandomSearch(Searcher):
     """Seeded i.i.d. sampling of the search space (Ray's default variant
     generator)."""

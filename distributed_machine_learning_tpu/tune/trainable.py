@@ -88,7 +88,14 @@ def train_regressor(
         train_data, val_data, int(config.get("batch_size", 32)), compute_dtype
     )
     steps_per_epoch = data.num_batches
-    total_steps = int(config.get("total_steps", num_epochs * steps_per_epoch))
+    accum = max(int(config.get("accumulate_grad_batches", 1)), 1)
+    # The schedule advances once per OPTIMIZER step; with accumulation that
+    # is steps_per_epoch // accum per epoch, not per micro-batch.
+    total_steps = int(
+        config.get(
+            "total_steps", num_epochs * max(steps_per_epoch // accum, 1)
+        )
+    )
     schedule = get_schedule(
         str(config.get("lr_schedule", "warmup_linear_decay")),
         learning_rate=float(config["learning_rate"]),
@@ -101,6 +108,7 @@ def train_regressor(
         weight_decay=float(config.get("weight_decay", 0.0)),
         momentum=float(config.get("momentum", 0.0)),
         gradient_clipping=float(config.get("gradient_clipping", 0.0)),
+        accumulate_grad_batches=accum,
     )
 
     model = build_model(config)
@@ -173,10 +181,14 @@ def train_regressor(
             params, batch_stats, data.x_val, data.y_val, data.val_mask
         )
         step_count = (epoch + 1) * steps_per_epoch
+        # The schedule is indexed by OPTIMIZER steps; with accumulation
+        # that is micro-steps // accum, or the logged lr would decay
+        # ``accum`` times faster than the one the optimizer actually used.
+        opt_steps = (epoch + 1) * max(steps_per_epoch // accum, 1)
         record = {
             "epoch": epoch,
             "train_loss": float(train_loss),
-            "lr": float(schedule(min(step_count, total_steps))),
+            "lr": float(schedule(min(opt_steps, total_steps))),
             "steps": step_count,
             **{k: float(v) for k, v in metrics.items()},
         }

@@ -93,7 +93,12 @@ def train_sharded_regressor(
     num_batches = n_train // global_batch
     steps_per_epoch = num_batches
 
-    total_steps = int(config.get("total_steps", num_epochs * steps_per_epoch))
+    accum = max(int(config.get("accumulate_grad_batches", 1)), 1)
+    total_steps = int(
+        config.get(
+            "total_steps", num_epochs * max(steps_per_epoch // accum, 1)
+        )
+    )
     schedule = get_schedule(
         str(config.get("lr_schedule", "warmup_linear_decay")),
         learning_rate=float(config["learning_rate"]),
@@ -106,6 +111,7 @@ def train_sharded_regressor(
         weight_decay=float(config.get("weight_decay", 0.0)),
         momentum=float(config.get("momentum", 0.0)),
         gradient_clipping=float(config.get("gradient_clipping", 0.0)),
+        accumulate_grad_batches=accum,
     )
     loss_fn = get_loss(loss_name)
 
@@ -236,10 +242,12 @@ def train_sharded_regressor(
         )
         metrics = evaluate(params, batch_stats, xv, yv, mask)
         step_count = (epoch + 1) * steps_per_epoch
+        # Schedule is indexed by optimizer steps (micro-steps // accum).
+        opt_steps = (epoch + 1) * max(steps_per_epoch // accum, 1)
         record = {
             "epoch": epoch,
             "train_loss": float(train_loss),
-            "lr": float(schedule(min(step_count, total_steps))),
+            "lr": float(schedule(min(opt_steps, total_steps))),
             "steps": step_count,
             "num_devices": len(devices),
             **{k: float(v) for k, v in metrics.items()},

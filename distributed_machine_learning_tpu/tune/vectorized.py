@@ -86,7 +86,11 @@ from distributed_machine_learning_tpu.tune.schedulers.base import (
     STOP,
     TrialScheduler,
 )
-from distributed_machine_learning_tpu.tune.search.base import RandomSearch, Searcher
+from distributed_machine_learning_tpu.tune.search.base import (
+    RandomSearch,
+    Searcher,
+    maybe_warm_start,
+)
 from distributed_machine_learning_tpu.tune.search_space import SearchSpace
 from distributed_machine_learning_tpu.tune.trial import Trial, TrialStatus
 from distributed_machine_learning_tpu.utils.seeding import rng_from
@@ -289,6 +293,7 @@ def run_vectorized(
     checkpoint_every_epochs: int = 0,
     resume: bool = False,
     callbacks: Optional[List] = None,
+    points_to_evaluate: Optional[List[Dict[str, Any]]] = None,
 ) -> ExperimentAnalysis:
     """Run an HPO sweep with trials batched into vmapped populations.
 
@@ -363,7 +368,7 @@ def run_vectorized(
         param_space if isinstance(param_space, SearchSpace)
         else SearchSpace(param_space)
     )
-    searcher = search_alg or RandomSearch()
+    searcher = maybe_warm_start(search_alg or RandomSearch(), points_to_evaluate)
     searcher.set_search_space(space, seed)
     sched = scheduler or FIFOScheduler()
     from distributed_machine_learning_tpu.tune.schedulers.pbt import (

@@ -154,3 +154,112 @@ def test_gridsearch_fast_forward_resumes_cursor():
         (p["a"], p["b"]) for p in all_points[4:]
     ]
     assert resumed.suggest(6) is None
+
+
+def test_warm_start_points_run_first():
+    """points_to_evaluate: exact values honored, partial keys sampled,
+    then the inner searcher takes over with an unshifted sequence."""
+    from distributed_machine_learning_tpu import tune
+    from distributed_machine_learning_tpu.tune.search.base import (
+        RandomSearch,
+        WarmStartSearcher,
+    )
+    from distributed_machine_learning_tpu.tune.search_space import SearchSpace
+
+    mk_space = lambda: SearchSpace({
+        "lr": tune.loguniform(1e-4, 1e-1),
+        "depth": tune.choice([2, 4, 8]),
+    })
+    points = [{"lr": 3e-3, "depth": 4}, {"depth": 8}]  # second is partial
+
+    ws = WarmStartSearcher(RandomSearch(), points)
+    ws.set_search_space(mk_space(), seed=7)
+    c0, c1 = ws.suggest(0), ws.suggest(1)
+    assert c0["lr"] == 3e-3 and c0["depth"] == 4
+    assert c1["depth"] == 8 and 1e-4 <= c1["lr"] <= 1e-1  # lr sampled
+
+    # The wrapped searcher's own proposals are the SAME sequence a plain
+    # RandomSearch would produce — warm points shift, not perturb, it.
+    plain = RandomSearch()
+    plain.set_search_space(mk_space(), seed=7)
+    for i in (2, 3, 4):
+        assert ws.suggest(i) == plain.suggest(i - len(points))
+
+
+def test_warm_start_respects_constraints():
+    from distributed_machine_learning_tpu import tune
+    from distributed_machine_learning_tpu.tune.search.base import (
+        RandomSearch,
+        WarmStartSearcher,
+    )
+    from distributed_machine_learning_tpu.tune.search_space import (
+        Constraint,
+        SearchSpace,
+    )
+    import pytest as _pytest
+
+    space = SearchSpace(
+        {"d_model": tune.choice([64, 100]), "num_heads": tune.choice([4, 8])},
+        [Constraint(lambda c: c["d_model"] % c["num_heads"] == 0)],
+    )
+    ws = WarmStartSearcher(RandomSearch(), [{"d_model": 100, "num_heads": 8}])
+    ws.set_search_space(space, seed=0)
+    with _pytest.raises(RuntimeError):
+        ws.suggest(0)  # infeasible point must fail loudly, not run silently
+
+
+def test_warm_start_fast_forward_shifts_inner():
+    """Resume: the inner GridSearch cursor advances by resumed-trials minus
+    warm points, so the tail continues exactly where the prior run stopped."""
+    from distributed_machine_learning_tpu import tune
+    from distributed_machine_learning_tpu.tune.search.base import (
+        GridSearch,
+        WarmStartSearcher,
+    )
+    from distributed_machine_learning_tpu.tune.search_space import SearchSpace
+
+    mk = lambda: SearchSpace(
+        {"a": tune.choice([1, 2, 3]), "b": tune.choice([10, 20])}
+    )
+    points = [{"a": 2, "b": 20}]
+
+    fresh = WarmStartSearcher(GridSearch(), points)
+    fresh.set_search_space(mk(), seed=0)
+    full = [fresh.suggest(i) for i in range(7)]
+    assert fresh.suggest(7) is None  # 1 point + 6 grid cells
+
+    resumed = WarmStartSearcher(GridSearch(), points)
+    resumed.set_search_space(mk(), seed=0)
+    resumed.fast_forward(4)  # 4 trials existed: the point + 3 grid cells
+    tail = [resumed.suggest(i) for i in (4, 5, 6)]
+    assert [(p["a"], p["b"]) for p in tail] == [
+        (p["a"], p["b"]) for p in full[4:]
+    ]
+
+
+def test_points_to_evaluate_through_tune_run(tmp_path):
+    from distributed_machine_learning_tpu import tune
+    from distributed_machine_learning_tpu.data import dummy_regression_data
+
+    train, val = dummy_regression_data(
+        num_samples=128, seq_len=8, num_features=4
+    )
+    known_good = {"learning_rate": 5e-3, "hidden_sizes": (16,)}
+    analysis = tune.run(
+        tune.with_parameters(
+            tune.train_regressor, train_data=train, val_data=val
+        ),
+        {"model": "mlp", "hidden_sizes": tune.choice([(8,), (16,)]),
+         "learning_rate": tune.loguniform(1e-4, 1e-1),
+         "num_epochs": 1, "batch_size": 32},
+        metric="validation_loss",
+        num_samples=3,
+        points_to_evaluate=[known_good],
+        storage_path=str(tmp_path),
+        name="warm",
+        verbose=0,
+    )
+    first = analysis.trials[0].config
+    assert first["learning_rate"] == 5e-3
+    assert tuple(first["hidden_sizes"]) == (16,)
+    assert analysis.num_terminated() == 3
