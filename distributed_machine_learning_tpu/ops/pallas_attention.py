@@ -354,22 +354,33 @@ def _bwd_dq_kernel(
 
 
 def _flash_backward(
-    q, k, v, out, lse, do, scale, causal, block_q, block_k, interpret
+    q, k, v, out, lse, do, scale, causal, block_q, block_k, interpret,
+    *, q_side=None,
 ):
     """Flash backward via two Pallas kernels (dK/dV, then dQ).
 
     delta = rowsum(dO * O) is the standard precomputed correction; the
     kernels recompute P from the forward's logsumexp, so backward memory is
-    O(block) like the forward — no S x S materialization."""
+    O(block) like the forward — no S x S materialization.
+
+    ``q_side``: optional precomputed ``(qb, dob, delta)`` in [B*H, ...]
+    layout — callers that invoke this per k/v chunk with the SAME q side
+    (the flash ring's backward scan) hoist the loop-invariant transposes
+    and the delta reduction out of their loop.
+    """
     B, S, H, D = q.shape
     block_q, block_k = _adjust_blocks(S, block_q, block_k)
     nq, nk = S // block_q, S // block_k
 
-    qb, kb, vb = _to_bh(q), _to_bh(k), _to_bh(v)
-    dob, ob = _to_bh(do), _to_bh(out)
-    delta = jnp.sum(
-        dob.astype(jnp.float32) * ob.astype(jnp.float32), axis=-1
-    )[:, None, :]  # [B*H, 1, S], same layout as lse
+    kb, vb = _to_bh(k), _to_bh(v)
+    if q_side is None:
+        qb, dob = _to_bh(q), _to_bh(do)
+        ob = _to_bh(out)
+        delta = jnp.sum(
+            dob.astype(jnp.float32) * ob.astype(jnp.float32), axis=-1
+        )[:, None, :]  # [B*H, 1, S], same layout as lse
+    else:
+        qb, dob, delta = q_side
 
     q_spec = pl.BlockSpec((1, block_q, D), lambda bh, a, b: (bh, a, 0))
     q_vec = pl.BlockSpec((1, 1, block_q), lambda bh, a, b: (bh, 0, a))
