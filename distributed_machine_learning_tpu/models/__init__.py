@@ -102,6 +102,7 @@ def _build_transformer(config: Dict[str, Any]):
         head_axis=config.get("head_axis", "tp"),
         mesh=config.get("mesh"),
         dtype=compute_dtype_of(config),
+        position_encoding=config.get("position_encoding", "sincos"),
     )
 
 

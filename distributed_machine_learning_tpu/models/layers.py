@@ -96,6 +96,35 @@ class PositionalEncoding(nn.Module):
         return nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
 
 
+def apply_rope(x: jnp.ndarray, base: float = 10000.0,
+               positions: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Rotary position embedding over the head dim of [B, S, H, D].
+
+    Rotate-half convention: pairs (x[..., :D/2], x[..., D/2:]) rotate by
+    position-dependent angles, so q·k depends only on RELATIVE distance —
+    the long-context-friendly alternative to the additive sin/cos table
+    (no max_len table, extrapolates past training lengths, and composes
+    with sequence sharding: the rotation is elementwise per position, so
+    GSPMD shards it with the activations). Math in f32, cast back.
+    """
+    B, S, H, D = x.shape
+    if D % 2:
+        raise ValueError(f"RoPE needs an even head dim, got {D}")
+    half = D // 2
+    pos = (jnp.arange(S, dtype=jnp.float32)
+           if positions is None else positions.astype(jnp.float32))
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    angles = pos[:, None] * freqs[None, :]            # [S, half]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+    return rotated.astype(x.dtype)
+
+
 class StochasticDepth(nn.Module):
     """Drops an entire residual branch per sample with prob ``rate`` at train time."""
 
@@ -153,6 +182,10 @@ class MultiHeadAttention(nn.Module):
     # kernels themselves already run their softmax/accumulation in float32
     # and cast back to q.dtype (ops/attention.py, ops/pallas_attention.py).
     dtype: Optional[jnp.dtype] = None
+    # Rotary position embedding on q/k (relative positions inside the
+    # attention scores — the long-context alternative to the model-level
+    # additive sin/cos table; see TransformerRegressor.position_encoding).
+    rope: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
@@ -175,6 +208,12 @@ class MultiHeadAttention(nn.Module):
             )(x)
 
         q, k, v = proj("query"), proj("key"), proj("value")
+        if self.rope:
+            # Applied to the GLOBAL [B, S, H, D] arrays before any
+            # sequence-parallel entry — elementwise per position, so GSPMD
+            # shards it with the activations and every downstream kernel
+            # (dense/flash/ring/ulysses) sees already-rotated q/k.
+            q, k = apply_rope(q), apply_rope(k)
 
         if self.seq_axis is not None:
             if self.mesh is None:
@@ -355,6 +394,7 @@ class EncoderLayer(nn.Module):
     # through flax's f32 promotion internally, and the output lands back in
     # this dtype so the residual stream stays narrow.
     dtype: Optional[jnp.dtype] = None
+    rope: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
@@ -370,6 +410,7 @@ class EncoderLayer(nn.Module):
             head_axis=self.head_axis,
             mesh=self.mesh,
             dtype=self.dtype,
+            rope=self.rope,
             name="attention",
         )(x, deterministic=deterministic)
         attn = StochasticDepth(self.stochastic_depth_rate)(attn, deterministic)

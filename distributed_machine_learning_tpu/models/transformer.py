@@ -94,6 +94,11 @@ class TransformerRegressor(nn.Module):
     # jnp.bfloat16 doubles MXU throughput and halves activation HBM traffic
     # on TPU. Wired from config["compute_dtype"] by models.build_model.
     dtype: Optional[jnp.dtype] = None
+    # Position information: "sincos" (the reference's additive table,
+    # fixed and capped at max_seq_length), "rope" (rotary embedding on
+    # q/k inside every attention block — relative positions, no length
+    # cap, the long-context default), or "none".
+    position_encoding: str = "sincos"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
@@ -103,8 +108,14 @@ class TransformerRegressor(nn.Module):
         reference's hard-coded ``input_size=10`` (`:271` vs its 81-column
         pipeline — SURVEY.md §3.3 note).
         """
+        if self.position_encoding not in ("sincos", "rope", "none"):
+            raise ValueError(
+                f"Unknown position_encoding {self.position_encoding!r}; "
+                f"expected 'sincos', 'rope', or 'none'"
+            )
         layer_kwargs = dict(
             dtype=self.dtype,
+            rope=self.position_encoding == "rope",
             d_model=self.d_model,
             num_heads=self.num_heads,
             dim_feedforward=self.dim_feedforward,
@@ -127,11 +138,15 @@ class TransformerRegressor(nn.Module):
         )
 
         x = nn.Dense(self.d_model, name="input_projection", dtype=self.dtype)(x)
-        x = PositionalEncoding(
-            d_model=self.d_model,
-            dropout_rate=self.dropout_rate,
-            max_len=self.max_seq_length,
-        )(x, deterministic=deterministic)
+        if self.position_encoding == "sincos":
+            x = PositionalEncoding(
+                d_model=self.d_model,
+                dropout_rate=self.dropout_rate,
+                max_len=self.max_seq_length,
+            )(x, deterministic=deterministic)
+        else:
+            # Keep the input-dropout regularization the sincos path applies.
+            x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
 
         if self.shared_weights:
             # ALBERT-style: one EncoderLayer parameter set applied num_layers

@@ -72,6 +72,14 @@ def adafactor(learning_rate: ScalarOrSchedule, weight_decay: float = 0.0, **_):
     return optax.adafactor(learning_rate, weight_decay_rate=weight_decay or None)
 
 
+@optimizers.register("lion")
+def lion(learning_rate: ScalarOrSchedule, weight_decay: float = 0.0, **_):
+    # Sign-momentum optimizer: half the optimizer memory of Adam (one
+    # moment), decoupled decay like adamw — a good fit for big-model
+    # memory budgets on HBM-bound TPUs.
+    return optax.lion(learning_rate, weight_decay=weight_decay)
+
+
 def make_optimizer(
     name: str,
     learning_rate: ScalarOrSchedule,

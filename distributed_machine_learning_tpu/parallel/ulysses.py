@@ -49,6 +49,8 @@ def _ulysses_local(
     axis_name: str,
     causal: bool,
     scale: Optional[float],
+    use_flash="auto",
+    flash_interpret: bool = False,
 ) -> jnp.ndarray:
     """Per-device body; q, k, v are local [B, S/n, H_local, D] shards."""
     D = q.shape[-1]
@@ -64,17 +66,33 @@ def _ulysses_local(
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)   # [B, S, H/n, D]
     S = qh.shape[1]
 
-    logits = jnp.einsum(
-        "bqhd,bkhd->bqhk",
-        qh.astype(jnp.float32) * s,
-        kh.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
+    from distributed_machine_learning_tpu.parallel.ring_attention import (
+        _use_flash_inner,
     )
-    if causal:
-        cmask = jnp.tril(jnp.ones((S, S), bool))[None, :, None, :]
-        logits = jnp.where(cmask, logits, -jnp.inf)
-    p = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bqhk,bkhd->bqhd", p, vh.astype(jnp.float32))
+
+    if _use_flash_inner(use_flash, S, S, D):
+        # After the reshuffle this is plain full-sequence attention — the
+        # Pallas flash kernel (with its custom VJP) drops straight in; no
+        # merge bookkeeping needed. Same measured-win gate as the ring.
+        from distributed_machine_learning_tpu.ops.pallas_attention import (
+            flash_attention,
+        )
+
+        out = flash_attention(
+            qh, kh, vh, scale=s, causal=causal, interpret=flash_interpret
+        )
+    else:
+        logits = jnp.einsum(
+            "bqhd,bkhd->bqhk",
+            qh.astype(jnp.float32) * s,
+            kh.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            cmask = jnp.tril(jnp.ones((S, S), bool))[None, :, None, :]
+            logits = jnp.where(cmask, logits, -jnp.inf)
+        p = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bqhk,bkhd->bqhd", p, vh.astype(jnp.float32))
 
     # head-sharded -> seq-sharded: the inverse reshuffle.
     return jax.lax.all_to_all(
@@ -92,6 +110,8 @@ def ulysses_attention(
     head_axis: Optional[str] = None,
     causal: bool = False,
     scale: Optional[float] = None,
+    use_flash="auto",
+    flash_interpret: bool = False,
 ) -> jnp.ndarray:
     """Exact softmax attention with the sequence sharded over ``axis_name``.
 
@@ -100,6 +120,10 @@ def ulysses_attention(
     over ``batch_axis``/``head_axis``; returns [B, S, H, D] with the same
     sharding.  Additionally requires H divisible by (sequence-axis size x
     head-axis size), since the all_to_all re-shards heads.
+
+    ``use_flash``: run the per-device full-sequence attention through the
+    Pallas flash kernel ("auto" = the kernel's measured-win regime on TPU;
+    see ``ring_attention``); ``flash_interpret`` for CPU tests.
     """
     if axis_name not in mesh.axis_names:
         raise ValueError(f"mesh has no axis {axis_name!r}: {mesh.axis_names}")
@@ -117,7 +141,9 @@ def ulysses_attention(
         )
     spec = P(baxis, axis_name, haxis, None)
     fn = _shard_map(
-        partial(_ulysses_local, axis_name=axis_name, causal=causal, scale=scale),
+        partial(_ulysses_local, axis_name=axis_name, causal=causal,
+                scale=scale, use_flash=use_flash,
+                flash_interpret=flash_interpret),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -43,6 +43,11 @@ from distributed_machine_learning_tpu.tune.search import (
     TPESearch,
     WarmStartSearcher,
 )
+from distributed_machine_learning_tpu.tune.stoppers import (
+    MaximumIterationStopper,
+    Stopper,
+    TrialPlateauStopper,
+)
 from distributed_machine_learning_tpu.tune.search_space import (
     Constraint,
     SearchSpace,
@@ -98,6 +103,9 @@ __all__ = [
     "BayesOptSearch",
     "TPESearch",
     "WarmStartSearcher",
+    "Stopper",
+    "TrialPlateauStopper",
+    "MaximumIterationStopper",
     "Searcher",
     "ExperimentAnalysis",
     "ExperimentStore",

@@ -233,11 +233,18 @@ class TrialLifecycle:
         self.searcher.on_trial_result(
             trial.trial_id, reported_config, metrics, self.metric, self.mode
         )
-        if self.stop_rules and any(
-            k in metrics and float(metrics[k]) >= v
-            for k, v in self.stop_rules.items()
-        ):
-            decision = STOP if decision == CONTINUE else decision
+        if self.stop_rules:
+            # Dict of key->threshold, or a callable/Stopper
+            # (tune/stoppers.py) judging this trial's own trajectory.
+            if callable(self.stop_rules):
+                hit = bool(self.stop_rules(trial.trial_id, metrics))
+            else:
+                hit = any(
+                    k in metrics and float(metrics[k]) >= v
+                    for k, v in self.stop_rules.items()
+                )
+            if hit:
+                decision = STOP if decision == CONTINUE else decision
         if trial.stop_requested or self.budget_exceeded():
             decision = STOP
         if (
@@ -273,8 +280,11 @@ class TrialLifecycle:
         protected.add(trial.latest_checkpoint)
         directory = self.store.checkpoint_dir(trial)
         try:
+            # latest may still be in the async writer's queue: count it as
+            # present so retention converges to exactly k files, not k+1.
             ckpt_lib.prune_checkpoints(
-                directory, self.keep_checkpoints_num, protect=protected
+                directory, self.keep_checkpoints_num, protect=protected,
+                pending_latest=trial.latest_checkpoint,
             )
         except Exception as e:  # retention must never kill a run
             self.log(f"checkpoint pruning failed for {trial.trial_id}: {e}")

@@ -11,6 +11,7 @@ fake, selected purely by the path's scheme.
 
 from __future__ import annotations
 
+import os
 import queue
 import re
 import threading
@@ -221,11 +222,16 @@ class AsyncCheckpointWriter:
             self._thread.join(timeout=10)
 
 
-def prune_checkpoints(directory: str, keep: int, protect=None) -> int:
+def prune_checkpoints(directory: str, keep: int, protect=None,
+                      pending_latest: Optional[str] = None) -> int:
     """Keep only the ``keep`` newest ``ckpt_*.msgpack`` files in ``directory``.
 
     ``protect`` (a full path, or an iterable of them) is never deleted even if
     old — e.g. a checkpoint another trial's PBT exploit is about to restore.
+    ``pending_latest``: a checkpoint path submitted to the async writer but
+    possibly not on disk yet — counted as the (present, newest) file so the
+    retained set is exactly ``keep`` once the write lands, instead of
+    ``keep``+1 (async writes race the per-result prune otherwise).
     Returns the number of files deleted.
     """
     if keep <= 0:
@@ -243,8 +249,19 @@ def prune_checkpoints(directory: str, keep: int, protect=None) -> int:
         if m:
             found.append((int(m.group(1)), name))
     found.sort()
+    if pending_latest is not None and os.path.basename(
+        pending_latest
+    ) not in {name for _, name in found}:
+        keep -= 1  # one retention slot is spoken for by the in-flight write
+    if keep > 0:
+        excess = found[:-keep] if len(found) > keep else []
+    else:
+        # keep went to 0 (keep_checkpoints_num=1 with the newest still in
+        # flight): every on-disk file is excess — found[:-0] would be []
+        # and silently disable retention.
+        excess = found
     deleted = 0
-    for _, name in found[:-keep] if len(found) > keep else []:
+    for _, name in excess:
         full = backend.join(d, name)
         if full in protected:
             continue

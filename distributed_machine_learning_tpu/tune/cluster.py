@@ -523,6 +523,7 @@ def run_distributed(
     elastic_listen: Union[str, socket.socket, None] = None,
     resume: bool = False,
     points_to_evaluate: Optional[Sequence[Dict[str, Any]]] = None,
+    stop=None,
 ) -> ExperimentAnalysis:
     """``tune.run`` across multiple host supervisors (see module docstring).
 
@@ -579,6 +580,9 @@ def run_distributed(
         if isinstance(param_space, SearchSpace)
         else SearchSpace(param_space)
     )
+    from distributed_machine_learning_tpu.tune.stoppers import resolve_stop
+
+    stop = resolve_stop(stop)  # validate dict/callable/Stopper up front
     searcher = maybe_warm_start(search_alg or RandomSearch(), points_to_evaluate)
     searcher.set_search_space(space, seed)
     sched = scheduler or FIFOScheduler()
@@ -684,6 +688,7 @@ def run_distributed(
         mode=mode,
         num_samples=num_samples,
         max_failures=max_failures,
+        stop_rules=stop,
         time_budget_s=time_budget_s,
         keep_checkpoints_num=keep_checkpoints_num,
         # Soft enforcement only: the limit takes effect at report boundaries

@@ -223,9 +223,32 @@ class ThreadTrialExecutor:
                 path = ckpt_lib.checkpoint_path(
                     self.store.checkpoint_dir(trial), count
                 )
-                self._ckpt_writer.submit(path, checkpoint)
-                trial.latest_checkpoint = path
-                trial.latest_checkpoint_iteration = count
+                # Depth-1 write pipeline per trial: wait for the PREVIOUS
+                # epoch's write before queueing this one. Epoch N+1's
+                # training still overlaps write N, and at most one path per
+                # trial is ever in flight — which is what makes the
+                # retention prune's pending-latest accounting exact.
+                # A write ERROR re-raises here (the synchronous-save failure
+                # semantics: the trial fails and retries); a HUNG write must
+                # not deadlock the trial — bounded wait, then this epoch's
+                # checkpoint is dropped with a warning (training continues;
+                # teardown abandons the stuck write).
+                skip = False
+                if trial.latest_checkpoint:
+                    if not self._ckpt_writer.wait(
+                        trial.latest_checkpoint, timeout=120.0
+                    ):
+                        print(
+                            f"[executor] WARNING: checkpoint write for "
+                            f"{trial.trial_id} still hung after 120s; "
+                            f"dropping the epoch-{count} checkpoint",
+                            flush=True,
+                        )
+                        skip = True
+                if not skip:
+                    self._ckpt_writer.submit(path, checkpoint)
+                    trial.latest_checkpoint = path
+                    trial.latest_checkpoint_iteration = count
             event = ResultEvent(trial, metrics, incarnation)
             self.events.put(("result", event))
             event.done.wait()
@@ -234,9 +257,18 @@ class ThreadTrialExecutor:
         def checkpoint_loader():
             # The restore target may still be in flight on the writer
             # thread (fast PBT exploit, immediate retry) — wait for THAT
-            # path to be durable before reading it.
-            if trial.restore_path:
-                self._ckpt_writer.wait(trial.restore_path)
+            # path to be durable before reading it. Bounded: a hung write
+            # degrades to a from-scratch restart, never a deadlocked trial.
+            if trial.restore_path and not self._ckpt_writer.wait(
+                trial.restore_path, timeout=120.0
+            ):
+                print(
+                    f"[executor] WARNING: restore target for "
+                    f"{trial.trial_id} still being written after 120s; "
+                    f"restarting without it",
+                    flush=True,
+                )
+                return None
             return ckpt_lib.load_checkpoint(trial.restore_path)
 
         set_session(Session(trial, report_fn, checkpoint_loader, devices))

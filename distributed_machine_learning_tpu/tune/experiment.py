@@ -201,6 +201,35 @@ class ExperimentAnalysis:
     def best_checkpoint(self) -> Optional[str]:
         return self.best_trial.latest_checkpoint
 
+    def best_model(self):
+        """Reconstruct the winning model: ``(model, variables)``.
+
+        ``model`` is built from the best trial's config
+        (``models.build_model``); ``variables`` is ``{"params": ...}``
+        (plus ``"batch_stats"`` for BatchNorm families) restored from the
+        trial's newest checkpoint — ready for
+        ``model.apply(variables, x, deterministic=True)``. The deployment
+        end of the HPO loop: sweep, pick, reload, predict.
+        """
+        from distributed_machine_learning_tpu.models import build_model
+        from distributed_machine_learning_tpu.tune.checkpoint import (
+            load_checkpoint,
+        )
+
+        trial = self.best_trial
+        path = trial.latest_checkpoint
+        ckpt = load_checkpoint(path) if path else None
+        if ckpt is None or "params" not in ckpt:
+            raise ValueError(
+                f"best trial {trial.trial_id} has no restorable checkpoint "
+                f"(path={path!r}); run with checkpointing enabled "
+                f"(the built-in trainables checkpoint each epoch by default)"
+            )
+        variables = {"params": ckpt["params"]}
+        if ckpt.get("batch_stats"):
+            variables["batch_stats"] = ckpt["batch_stats"]
+        return build_model(trial.config), variables
+
     def dataframe(self):
         """Last-result-per-trial table (pandas if available, else list of dicts)."""
         rows = []

@@ -79,7 +79,7 @@ def run(
     name: Optional[str] = None,
     seed: int = 0,
     max_failures: int = 0,
-    stop: Optional[Dict[str, float]] = None,
+    stop=None,
     time_budget_s: Optional[float] = None,
     devices: Optional[List] = None,
     verbose: int = 1,
@@ -99,8 +99,11 @@ def run(
     model-based searchers observe their results (Ray's knob of the same
     name).
 
-    ``stop``: dict of result-key -> threshold; a trial stops once any key's
-    reported value reaches the threshold (e.g. ``{"training_iteration": 20}``).
+    ``stop``: dict of result-key -> threshold (a trial stops once any key's
+    reported value reaches it, e.g. ``{"training_iteration": 20}``), a
+    callable ``(trial_id, result) -> bool``, or a ``tune.Stopper``
+    (``TrialPlateauStopper``, ``MaximumIterationStopper`` —
+    tune/stoppers.py).
     ``max_failures``: per-trial retry budget; retries restore from the trial's
     latest checkpoint when one exists (preemption tolerance, SURVEY.md §5).
     ``keep_checkpoints_num``: retention — keep only the newest k checkpoints
@@ -147,6 +150,9 @@ def run(
         if isinstance(param_space, SearchSpace)
         else SearchSpace(param_space)
     )
+    from distributed_machine_learning_tpu.tune.stoppers import resolve_stop
+
+    stop = resolve_stop(stop)  # validate dict/callable/Stopper up front
     searcher = maybe_warm_start(search_alg or RandomSearch(), points_to_evaluate)
     searcher.set_search_space(space, seed)
     sched = scheduler or FIFOScheduler()

@@ -201,3 +201,56 @@ class TestAsyncCheckpointWriter:
         assert time.time() - t0 < 5
         assert any("abandoning" in m for m in logged), logged
         slow.set()
+
+
+def test_prune_counts_pending_latest(tmp_path):
+    """Retention with an async in-flight newest: the pending path counts as
+    present, so the on-disk survivors + the landing write converge to
+    exactly `keep` files (not keep+1 — the race the full suite caught)."""
+    from distributed_machine_learning_tpu.tune.checkpoint import (
+        checkpoint_path,
+        prune_checkpoints,
+        save_checkpoint,
+    )
+
+    d = str(tmp_path)
+    for i in range(1, 5):
+        save_checkpoint(checkpoint_path(d, i), {"i": i})
+    pending = checkpoint_path(d, 5)  # submitted, not yet written
+    deleted = prune_checkpoints(d, keep=2, protect={pending},
+                                pending_latest=pending)
+    assert deleted == 3  # keep slot 4 on disk + the pending 5
+    import os as _os
+
+    left = sorted(p for p in _os.listdir(d))
+    assert left == ["ckpt_000004.msgpack"]
+    save_checkpoint(pending, {"i": 5})  # the write lands
+    assert len(_os.listdir(d)) == 2  # exactly keep
+
+    # When the latest is already on disk, behavior is unchanged.
+    deleted = prune_checkpoints(d, keep=2, pending_latest=pending)
+    assert deleted == 0
+
+
+def test_prune_keep_one_with_pending(tmp_path):
+    """keep_checkpoints_num=1 with the newest write still in flight: every
+    on-disk file is excess (found[:-0] would silently keep everything —
+    code review r3)."""
+    from distributed_machine_learning_tpu.tune.checkpoint import (
+        checkpoint_path,
+        prune_checkpoints,
+        save_checkpoint,
+    )
+
+    d = str(tmp_path)
+    for i in range(1, 4):
+        save_checkpoint(checkpoint_path(d, i), {"i": i})
+    pending = checkpoint_path(d, 4)
+    deleted = prune_checkpoints(d, keep=1, protect={pending},
+                                pending_latest=pending)
+    assert deleted == 3  # the pending file IS the single survivor
+    import os as _os
+
+    assert _os.listdir(d) == []
+    save_checkpoint(pending, {"i": 4})
+    assert len(_os.listdir(d)) == 1  # exactly keep
