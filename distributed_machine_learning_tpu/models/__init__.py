@@ -103,6 +103,7 @@ def _build_transformer(config: Dict[str, Any]):
         mesh=config.get("mesh"),
         dtype=compute_dtype_of(config),
         position_encoding=config.get("position_encoding", "sincos"),
+        num_kv_heads=config.get("num_kv_heads"),
     )
 
 

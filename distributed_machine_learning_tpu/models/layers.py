@@ -186,6 +186,15 @@ class MultiHeadAttention(nn.Module):
     # attention scores — the long-context alternative to the model-level
     # additive sin/cos table; see TransformerRegressor.position_encoding).
     rope: bool = False
+    # Grouped-query attention: project k/v to this many heads (must divide
+    # num_heads) and share each kv head across a query group. None = full
+    # MHA; 1 = multi-query. Cuts k/v PROJECTION params/FLOPs by
+    # num_heads/num_kv_heads on every path; the attention kernels
+    # themselves still see full-head k/v (broadcast below), so kv
+    # activation memory shrinks only where XLA fuses the repeat (the dense
+    # einsum path) — the Pallas flash custom call materializes repeated
+    # k/v, and ring attention rotates them at full size.
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
@@ -198,16 +207,37 @@ class MultiHeadAttention(nn.Module):
             raise ValueError(
                 f"d_model={self.d_model} not divisible by num_heads={self.num_heads}"
             )
+        kv_heads = self.num_kv_heads if self.num_kv_heads is not None else self.num_heads
+        if kv_heads <= 0 or self.num_heads % kv_heads != 0:
+            # Explicit > 0 check: 0 would silently mean full MHA via
+            # truthiness, and negatives pass Python's sign-following modulo
+            # (4 % -2 == 0) into an opaque DenseGeneral shape error.
+            raise ValueError(
+                f"num_kv_heads={kv_heads} must be a positive divisor of "
+                f"num_heads={self.num_heads}"
+            )
         head_dim = self.d_model // self.num_heads
         B, S, _ = x.shape
 
-        def proj(name):
+        def proj(name, heads):
             return nn.DenseGeneral(
-                features=(self.num_heads, head_dim), axis=-1, name=name,
+                features=(heads, head_dim), axis=-1, name=name,
                 dtype=self.dtype,
             )(x)
 
-        q, k, v = proj("query"), proj("key"), proj("value")
+        q = proj("query", self.num_heads)
+        k = proj("key", kv_heads)
+        v = proj("value", kv_heads)
+        if kv_heads != self.num_heads:
+            # Broadcast each kv head over its query group BEFORE the
+            # kernels: every downstream path (dense/flash/ring/ulysses)
+            # then sees ordinary per-head attention. The dense einsum path
+            # fuses the repeat; the Pallas/ring paths materialize it —
+            # GQA's guaranteed saving here is the projection params/FLOPs,
+            # not kernel-side kv memory (see attribute comment).
+            group = self.num_heads // kv_heads
+            k = jnp.repeat(k, group, axis=2)
+            v = jnp.repeat(v, group, axis=2)
         if self.rope:
             # Applied to the GLOBAL [B, S, H, D] arrays before any
             # sequence-parallel entry — elementwise per position, so GSPMD
@@ -395,6 +425,7 @@ class EncoderLayer(nn.Module):
     # this dtype so the residual stream stays narrow.
     dtype: Optional[jnp.dtype] = None
     rope: bool = False
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
@@ -411,6 +442,7 @@ class EncoderLayer(nn.Module):
             mesh=self.mesh,
             dtype=self.dtype,
             rope=self.rope,
+            num_kv_heads=self.num_kv_heads,
             name="attention",
         )(x, deterministic=deterministic)
         attn = StochasticDepth(self.stochastic_depth_rate)(attn, deterministic)

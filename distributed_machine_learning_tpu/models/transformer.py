@@ -99,6 +99,9 @@ class TransformerRegressor(nn.Module):
     # q/k inside every attention block — relative positions, no length
     # cap, the long-context default), or "none".
     position_encoding: str = "sincos"
+    # Grouped-query attention: kv heads per block (None = num_heads; 1 =
+    # multi-query). See models/layers.py MultiHeadAttention.
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
@@ -116,6 +119,7 @@ class TransformerRegressor(nn.Module):
         layer_kwargs = dict(
             dtype=self.dtype,
             rope=self.position_encoding == "rope",
+            num_kv_heads=self.num_kv_heads,
             d_model=self.d_model,
             num_heads=self.num_heads,
             dim_feedforward=self.dim_feedforward,

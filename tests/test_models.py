@@ -213,3 +213,42 @@ def test_rnn_trains_under_tune(tmp_path):
     assert analysis.num_terminated() == 2
     losses = [t.results[-1]["validation_loss"] for t in analysis.trials]
     assert all(np.isfinite(l) for l in losses)
+
+
+def test_grouped_query_attention():
+    """num_kv_heads: k/v project to fewer heads and broadcast across query
+    groups — param count shrinks, output stays head-correct."""
+    import jax
+
+    from distributed_machine_learning_tpu.models import build_model
+
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(2, 12, 6)), jnp.float32
+    )
+    cfg = {"model": "transformer", "d_model": 16, "num_heads": 4,
+           "num_layers": 1, "dim_feedforward": 32, "dropout": 0.0}
+
+    def n_params(c):
+        m = build_model(c)
+        vs = m.init({"params": jax.random.key(0),
+                     "dropout": jax.random.key(1)}, x, deterministic=True)
+        return sum(l.size for l in jax.tree_util.tree_leaves(vs["params"])), m, vs
+
+    full, _, _ = n_params(cfg)
+    gqa, model, vs = n_params(dict(cfg, num_kv_heads=2))
+    mqa, _, _ = n_params(dict(cfg, num_kv_heads=1))
+    assert mqa < gqa < full  # k/v projections shrink with kv head count
+
+    out = model.apply(vs, x, deterministic=True)
+    assert out.shape == (2, 1)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+    # kv head shape is the contract: key kernel [d_model, kv_heads, head_dim]
+    key_kernel = vs["params"]["layer_0"]["attention"]["key"]["kernel"]
+    assert key_kernel.shape == (16, 2, 4)
+
+    for bad in (3, 0, -2):
+        with pytest.raises(ValueError, match="positive divisor"):
+            build_model(dict(cfg, num_kv_heads=bad)).init(
+                {"params": jax.random.key(0)}, x, deterministic=True
+            )
