@@ -236,14 +236,9 @@ class TrialLifecycle:
         if self.stop_rules:
             # Dict of key->threshold, or a callable/Stopper
             # (tune/stoppers.py) judging this trial's own trajectory.
-            if callable(self.stop_rules):
-                hit = bool(self.stop_rules(trial.trial_id, metrics))
-            else:
-                hit = any(
-                    k in metrics and float(metrics[k]) >= v
-                    for k, v in self.stop_rules.items()
-                )
-            if hit:
+            from distributed_machine_learning_tpu.tune.stoppers import stop_hit
+
+            if stop_hit(self.stop_rules, trial.trial_id, metrics):
                 decision = STOP if decision == CONTINUE else decision
         if trial.stop_requested or self.budget_exceeded():
             decision = STOP

@@ -93,6 +93,19 @@ class TrialPlateauStopper(Stopper):
         return math.sqrt(var) < self.std
 
 
+def stop_hit(stop, trial_id: str, result: Dict[str, Any]) -> bool:
+    """Apply a resolved ``stop`` (dict / callable / Stopper / None) to one
+    result — THE dispatch both drivers share, so their stop semantics
+    cannot diverge."""
+    if stop is None:
+        return False
+    if callable(stop):
+        return bool(stop(trial_id, result))
+    return any(
+        k in result and float(result[k]) >= v for k, v in stop.items()
+    )
+
+
 def resolve_stop(stop) -> Optional[object]:
     """Normalize tune.run's ``stop`` argument: dict / callable / Stopper /
     None all become something _driver.process_result can apply."""

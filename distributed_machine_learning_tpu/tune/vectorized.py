@@ -294,6 +294,7 @@ def run_vectorized(
     resume: bool = False,
     callbacks: Optional[List] = None,
     points_to_evaluate: Optional[List[Dict[str, Any]]] = None,
+    stop=None,
 ) -> ExperimentAnalysis:
     """Run an HPO sweep with trials batched into vmapped populations.
 
@@ -368,6 +369,9 @@ def run_vectorized(
         param_space if isinstance(param_space, SearchSpace)
         else SearchSpace(param_space)
     )
+    from distributed_machine_learning_tpu.tune.stoppers import resolve_stop
+
+    stop = resolve_stop(stop)  # validate dict/callable/Stopper up front
     searcher = maybe_warm_start(search_alg or RandomSearch(), points_to_evaluate)
     searcher.set_search_space(space, seed)
     sched = scheduler or FIFOScheduler()
@@ -573,7 +577,7 @@ def run_vectorized(
                         log, tracker, compaction, size_multiple,
                         pop_sharding, repl_sharding, pbt, epochs_per_dispatch,
                         checkpoint_every_epochs, group_ckpt_path, resume_state,
-                        safe_cb,
+                        safe_cb, stop_rules=stop,
                     )
                     resume_state = None  # consumed by the first (only) group
                     row_epochs += pop_rows
@@ -762,7 +766,7 @@ def _replay_records(trial_list, sched, searcher, pbt, metric, mode):
 def _emit_epoch_records(
     batch, rows, active, lrs, epoch, step_count, shape_val, now,
     train_losses, metrics_np, pbt_notes, pbt, sched, searcher, store,
-    metric, mode, safe_cb=lambda *a: None,
+    metric, mode, safe_cb=lambda *a: None, stop_rules=None,
 ):
     """Append one epoch's records for every live trial and route them through
     the scheduler/searcher (the vectorized analogue of ``session.report``)."""
@@ -808,6 +812,13 @@ def _emit_epoch_records(
                 "requeue schedulers are not supported in vectorized mode; "
                 "use tune.run"
             )
+        if decision == CONTINUE and stop_rules is not None:
+            # Same stop surface as tune.run — one shared dispatch
+            # (stoppers.stop_hit) so the drivers cannot diverge.
+            from distributed_machine_learning_tpu.tune.stoppers import stop_hit
+
+            if stop_hit(stop_rules, trial.trial_id, record):
+                decision = STOP
         if decision == STOP:
             active[r] = False
             trial.status = TrialStatus.TERMINATED
@@ -839,6 +850,7 @@ def _run_population(
     ckpt_path: Optional[str] = None,
     resume_state: Optional[Dict[str, Any]] = None,
     safe_cb=lambda *a: None,
+    stop_rules=None,
 ) -> Tuple[int, float]:
     """Train one population of K same-shape trials to completion.
 
@@ -1067,7 +1079,7 @@ def _run_population(
             _emit_epoch_records(
                 batch, rows, active, lrs, epoch, step_count, shape_val, now,
                 train_losses, metrics_np, pbt_notes, pbt, sched, searcher,
-                store, metric, mode, safe_cb,
+                store, metric, mode, safe_cb, stop_rules,
             )
         epoch0 += chunk
         epoch = epoch0 - 1  # last completed epoch (PBT/compaction below)
@@ -1104,10 +1116,14 @@ def _run_population(
                 v = sign * value
                 return v if np.isfinite(v) else np.inf
 
+            # active[r]: a stopper (stop=) can now terminate rows under
+            # PBT — a TERMINATED row must neither donate (its metrics
+            # stopped being meaningful) nor be "rescued" (mutating a
+            # completed trial's config after on_trial_complete consumed it).
             live = sorted(
                 (rank_key(float(scores[i])), i, r)
                 for i, r in enumerate(rows)
-                if r >= 0
+                if r >= 0 and active[r]
             )
             if len(live) >= 4 and np.isfinite(live[0][0]):
                 q = max(1, int(len(live) * pbt.quantile))

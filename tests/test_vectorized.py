@@ -411,3 +411,29 @@ def test_vectorized_transformer_smoke(tiny_data, tmp_path):
     assert np.isfinite(
         analysis.best_result["validation_mape"]
     )
+
+
+def test_vectorized_stop_rules_and_stopper(tmp_path, tiny_data):
+    """stop= has the same surface as tune.run in vectorized mode: dict
+    thresholds and Stopper objects cut trials mid-sweep."""
+    train, val = tiny_data
+    space = {
+        "model": "mlp", "hidden_sizes": (8,),
+        "learning_rate": tune.loguniform(1e-3, 1e-2),
+        "num_epochs": 6, "batch_size": 32,
+    }
+    analysis = tune.run_vectorized(
+        space, train_data=train, val_data=val,
+        metric="validation_loss", mode="min", num_samples=4,
+        stop={"training_iteration": 2},
+        storage_path=str(tmp_path), name="vstop", seed=1, verbose=0,
+    )
+    assert all(len(t.results) == 2 for t in analysis.trials)
+
+    analysis = tune.run_vectorized(
+        space, train_data=train, val_data=val,
+        metric="validation_loss", mode="min", num_samples=2,
+        stop=tune.MaximumIterationStopper(3),
+        storage_path=str(tmp_path), name="vstop2", seed=1, verbose=0,
+    )
+    assert all(len(t.results) == 3 for t in analysis.trials)

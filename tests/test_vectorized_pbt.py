@@ -182,3 +182,35 @@ def test_vectorized_pbt_lifts_stuck_trials(tiny_data, tmp_path):
     )
     # The stuck half of the FIFO population never improves; PBT rescues it.
     assert np.median(pbt_finals) < np.median(fifo_finals)
+
+
+def test_stopper_terminated_rows_excluded_from_pbt(tiny_data, tmp_path):
+    """A stopper can now terminate rows mid-population under PBT (code
+    review r3): TERMINATED trials must neither donate nor be 'rescued' —
+    their config must never mutate after on_trial_complete fired."""
+    train, val = tiny_data
+
+    class StopTwoEarly(tune.Stopper):
+        """Deterministically stop two specific trials at iteration 2."""
+
+        def __call__(self, trial_id, result):
+            return (trial_id in ("trial_00000", "trial_00001")
+                    and result["training_iteration"] >= 2)
+
+    analysis = tune.run_vectorized(
+        SPACE, train_data=train, val_data=val,
+        metric="validation_loss", mode="min",
+        num_samples=8, scheduler=_pbt(), stop=StopTwoEarly(),
+        storage_path=str(tmp_path), name="vpbt_stop", seed=5, verbose=0,
+    )
+    stopped = [t for t in analysis.trials
+               if t.trial_id in ("trial_00000", "trial_00001")]
+    assert all(len(t.results) == 2 for t in stopped)
+    for t in stopped:
+        # Config frozen at termination: no post-mortem PBT mutation — the
+        # config on record is the one that produced the stored results.
+        assert t.config["learning_rate"] in (3e-2, 1e-7)
+        assert not any("pbt_exploited_from" in r for r in t.results[2:])
+    # Survivors ran the full budget and PBT still worked among them.
+    survivors = [t for t in analysis.trials if t not in stopped]
+    assert all(len(t.results) == 8 for t in survivors)
