@@ -20,6 +20,7 @@ from distributed_machine_learning_tpu.tune.schedulers.base import (
     REQUEUE,
     STOP,
 )
+from distributed_machine_learning_tpu.tune.stoppers import stop_hit
 from distributed_machine_learning_tpu.tune.trial import Trial, TrialStatus
 
 
@@ -176,6 +177,11 @@ class TrialLifecycle:
                 self.searcher.on_trial_result(
                     entry, config_snapshot, rec, self.metric, self.mode
                 )
+                if self.stop_rules is not None and callable(self.stop_rules):
+                    # Warm STATEFUL stoppers (plateau windows/counters) with
+                    # the replayed history; the returned decision is ignored
+                    # — replay rebuilds observer state, it never re-decides.
+                    stop_hit(self.stop_rules, trial.trial_id, rec)
             trial.config = config_snapshot
             # Clear anything replayed scheduler decisions left behind.
             trial._requeue_on_complete = False
@@ -236,8 +242,6 @@ class TrialLifecycle:
         if self.stop_rules:
             # Dict of key->threshold, or a callable/Stopper
             # (tune/stoppers.py) judging this trial's own trajectory.
-            from distributed_machine_learning_tpu.tune.stoppers import stop_hit
-
             if stop_hit(self.stop_rules, trial.trial_id, metrics):
                 decision = STOP if decision == CONTINUE else decision
         if trial.stop_requested or self.budget_exceeded():

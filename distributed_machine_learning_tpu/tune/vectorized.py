@@ -92,6 +92,7 @@ from distributed_machine_learning_tpu.tune.search.base import (
     maybe_warm_start,
 )
 from distributed_machine_learning_tpu.tune.search_space import SearchSpace
+from distributed_machine_learning_tpu.tune.stoppers import resolve_stop, stop_hit
 from distributed_machine_learning_tpu.tune.trial import Trial, TrialStatus
 from distributed_machine_learning_tpu.utils.seeding import rng_from
 
@@ -369,8 +370,6 @@ def run_vectorized(
         param_space if isinstance(param_space, SearchSpace)
         else SearchSpace(param_space)
     )
-    from distributed_machine_learning_tpu.tune.stoppers import resolve_stop
-
     stop = resolve_stop(stop)  # validate dict/callable/Stopper up front
     searcher = maybe_warm_start(search_alg or RandomSearch(), points_to_evaluate)
     searcher.set_search_space(space, seed)
@@ -466,7 +465,8 @@ def run_vectorized(
         # the in-flight chunk restores its device state, and sampling
         # continues toward num_samples afterwards.
         resume_state, finished_trials, live_batch, unstarted = (
-            _load_resume_state(store.root, metric, mode, sched, searcher, pbt)
+            _load_resume_state(store.root, metric, mode, sched,
+                               searcher, pbt, stop_rules=stop)
         )
         trials = sorted(
             finished_trials + live_batch + unstarted, key=lambda t: t.trial_id
@@ -612,6 +612,7 @@ def _load_resume_state(
     sched: TrialScheduler,
     searcher: Searcher,
     pbt,
+    stop_rules=None,
 ) -> Tuple[Dict[str, Any], List[Trial], List[Trial]]:
     """Rehydrate an interrupted sweep: load the population checkpoint,
     rebuild Trial objects from the on-disk store, and replay their
@@ -686,7 +687,8 @@ def _load_resume_state(
         trial.finished_at = trial.started_at + float(
             last.get("time_total_s", 0.0)
         )
-    _replay_records(finished, sched, searcher, pbt, metric, mode)
+    _replay_records(finished, sched, searcher, pbt, metric, mode,
+                    stop_rules)
     for trial in finished:
         sched.on_trial_complete(trial)
         searcher.on_trial_complete(
@@ -727,7 +729,8 @@ def _load_resume_state(
             trial.finished_at = trial.started_at + (
                 float(last["time_total_s"]) if last else 0.0
             )
-    _replay_records(batch, sched, searcher, pbt, metric, mode)
+    _replay_records(batch, sched, searcher, pbt, metric, mode,
+                    stop_rules)
     for idx, trial in enumerate(batch):
         if not active[idx]:
             sched.on_trial_complete(trial)
@@ -747,10 +750,14 @@ def _load_resume_state(
     return resume_state, finished, batch, unstarted
 
 
-def _replay_records(trial_list, sched, searcher, pbt, metric, mode):
+def _replay_records(trial_list, sched, searcher, pbt, metric, mode,
+                    stop_rules=None):
     """Route stored per-epoch records back through the scheduler/searcher in
     epoch-major order — the order the live loop produced them. (Vectorized
-    PBT skips the scheduler: exploit/explore state is device-side.)"""
+    PBT skips the scheduler: exploit/explore state is device-side.)
+    Stateful stoppers (plateau windows) are warmed too, decisions ignored
+    — a resumed sweep must stop trials at the same point a fresh one
+    would."""
     max_len = max((len(t.results) for t in trial_list), default=0)
     for e in range(max_len):
         for trial in trial_list:
@@ -761,6 +768,8 @@ def _replay_records(trial_list, sched, searcher, pbt, metric, mode):
                 searcher.on_trial_result(
                     trial.trial_id, dict(trial.config), record, metric, mode
                 )
+                if callable(stop_rules):
+                    stop_hit(stop_rules, trial.trial_id, record)
 
 
 def _emit_epoch_records(
@@ -815,8 +824,6 @@ def _emit_epoch_records(
         if decision == CONTINUE and stop_rules is not None:
             # Same stop surface as tune.run — one shared dispatch
             # (stoppers.stop_hit) so the drivers cannot diverge.
-            from distributed_machine_learning_tpu.tune.stoppers import stop_hit
-
             if stop_hit(stop_rules, trial.trial_id, record):
                 decision = STOP
         if decision == STOP:
