@@ -543,6 +543,8 @@ def run_distributed(
     explicit ``name``) — same semantics as ``tune.run(resume=True)``:
     finished trials kept and replayed, interrupted trials redispatched from
     their newest shared-storage checkpoint, sampling continued.
+    ``stop`` / ``points_to_evaluate``: same surface as ``tune.run`` (dict /
+    callable / Stopper; warm-start configs run first).
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
