@@ -353,3 +353,23 @@ def test_hmac_authenticated_control_plane(tmp_path, monkeypatch):
                 p.wait(timeout=10)
             except Exception:
                 p.kill()
+
+
+def test_distributed_stop_rules(worker_pool, tmp_path):
+    """stop= has the tune.run surface on the cluster driver too: trials cut
+    at the threshold across the control plane."""
+    analysis = run_distributed(
+        "cluster_trainables:quadratic_trial",
+        {"x": tune.uniform(0.0, 6.0), "epochs": 6},
+        metric="loss",
+        mode="min",
+        num_samples=3,
+        workers=worker_pool,
+        stop={"training_iteration": 2},
+        storage_path=str(tmp_path),
+        name="dist_stop",
+        seed=11,
+        verbose=0,
+    )
+    assert analysis.num_terminated() == 3
+    assert all(len(t.results) == 2 for t in analysis.trials)
