@@ -9,6 +9,8 @@ needs:
   elastically); forwards to ``tune.cluster``'s CLI.
 * ``info`` — print the jax backend/device/mesh view of THIS process, the
   first thing to check when a pod host misbehaves.
+* ``export-orbax <ckpt.msgpack> <out_dir>`` — convert a framework
+  checkpoint to an orbax StandardCheckpoint for orbax-consuming stacks.
 
 Note on startup cost: ``python -m`` imports the package ``__init__`` (and
 with it jax/flax/optax) before this module runs, so even ``--help`` pays
@@ -51,9 +53,11 @@ def main(argv=None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
     usage = (
         "usage: python -m distributed_machine_learning_tpu "
-        "{worker|info} [args]\n"
-        "  worker  host trial supervisor (see 'worker --help')\n"
-        "  info    jax backend/device summary for this process"
+        "{worker|info|export-orbax} [args]\n"
+        "  worker        host trial supervisor (see 'worker --help')\n"
+        "  info          jax backend/device summary for this process\n"
+        "  export-orbax  <ckpt.msgpack> <out_dir>: framework checkpoint\n"
+        "                -> orbax StandardCheckpoint"
     )
     if not argv or argv[0] in ("-h", "--help"):
         print(usage)
@@ -65,6 +69,27 @@ def main(argv=None) -> None:
         _main(rest)
     elif cmd == "info":
         _info()
+    elif cmd == "export-orbax":
+        if len(rest) != 2:
+            print(usage, file=sys.stderr)
+            raise SystemExit(2)
+        from distributed_machine_learning_tpu.tune.checkpoint import (
+            export_orbax,
+        )
+
+        try:
+            out = export_orbax(rest[0], rest[1])
+        except ImportError:
+            print("error: orbax-checkpoint is not installed "
+                  "(pip install 'distributed-machine-learning-tpu[orbax]')",
+                  file=sys.stderr)
+            raise SystemExit(1) from None
+        except (FileNotFoundError, ValueError) as exc:
+            # The predictable misuses (missing checkpoint, out_dir already
+            # exists) get a one-liner, not a stack dump.
+            print(f"error: {exc}", file=sys.stderr)
+            raise SystemExit(1) from None
+        print(f"exported {rest[0]} -> {out}")
     else:
         print(usage, file=sys.stderr)
         raise SystemExit(2)

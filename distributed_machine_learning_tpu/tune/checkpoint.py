@@ -76,6 +76,44 @@ def find_latest_checkpoint(directory: str):
     return best_path, best_it
 
 
+def _abspath_unless_remote(path: str) -> str:
+    """abspath local paths only — os.path.abspath would mangle gs://-style
+    URLs into '<cwd>/gs:/...' (orbax handles remote schemes itself)."""
+    if re.match(r"^[a-z0-9]+://", path):
+        return path
+    return os.path.abspath(path)
+
+
+def export_orbax(checkpoint_path: str, out_dir: str) -> str:
+    """Convert a framework checkpoint to an orbax StandardCheckpoint.
+
+    Interop bridge OUT of the framework: the msgpack pytree (params /
+    opt_state / batch_stats / scalars) becomes a directory any
+    orbax-consuming JAX stack restores directly — handing a tuned model
+    to a separate serving/fine-tuning codebase without importing this
+    package. Returns ``out_dir``. Raises ImportError if orbax is absent
+    (it is an optional dependency).
+    """
+    import orbax.checkpoint as ocp
+
+    tree = load_checkpoint(checkpoint_path)
+    if tree is None:
+        raise FileNotFoundError(f"no checkpoint at {checkpoint_path!r}")
+    out_dir = _abspath_unless_remote(out_dir)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(out_dir, tree)
+    return out_dir
+
+
+def import_orbax(src_dir: str) -> Dict[str, Any]:
+    """Restore an orbax StandardCheckpoint into a raw pytree dict —
+    the inverse bridge (``restore_into`` then shapes it to a template)."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(_abspath_unless_remote(src_dir))
+
+
 class AsyncCheckpointWriter:
     """Overlap checkpoint writes with training (orbax-style async save).
 

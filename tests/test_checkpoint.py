@@ -254,3 +254,34 @@ def test_prune_keep_one_with_pending(tmp_path):
     assert _os.listdir(d) == []
     save_checkpoint(pending, {"i": 4})
     assert len(_os.listdir(d)) == 1  # exactly keep
+
+
+def test_orbax_export_import_round_trip(tmp_path):
+    """Interop bridge: framework msgpack checkpoint -> orbax
+    StandardCheckpoint -> raw pytree, values intact (the hand-off path to
+    orbax-consuming serving/fine-tuning stacks)."""
+    pytest.importorskip("orbax.checkpoint")
+    from distributed_machine_learning_tpu.tune.checkpoint import (
+        checkpoint_path,
+        export_orbax,
+        import_orbax,
+        save_checkpoint,
+    )
+
+    src = checkpoint_path(str(tmp_path / "ck"), 3)
+    tree = {
+        "params": {"dense": {"kernel": np.arange(6.0).reshape(2, 3),
+                             "bias": np.zeros(3)}},
+        "epoch": 3,
+    }
+    save_checkpoint(src, tree)
+    out = export_orbax(src, str(tmp_path / "orbax_ck"))
+    restored = import_orbax(out)
+    np.testing.assert_array_equal(
+        restored["params"]["dense"]["kernel"],
+        tree["params"]["dense"]["kernel"],
+    )
+    assert int(restored["epoch"]) == 3
+
+    with pytest.raises(FileNotFoundError):
+        export_orbax(str(tmp_path / "nope.msgpack"), str(tmp_path / "x"))

@@ -46,3 +46,37 @@ def test_worker_help_forwards_to_cluster_cli():
     proc = _run(["worker", "--help"], timeout=30)
     assert proc.returncode == 0
     assert "--join" in proc.stdout and "--port" in proc.stdout
+
+
+def test_export_orbax_subcommand(tmp_path):
+    import numpy as np
+    import pytest
+
+    pytest.importorskip("orbax.checkpoint")  # optional dependency
+
+    from distributed_machine_learning_tpu.tune.checkpoint import (
+        checkpoint_path,
+        save_checkpoint,
+    )
+
+    src = checkpoint_path(str(tmp_path), 1)
+    save_checkpoint(src, {"params": {"w": np.ones(3)}})
+    out_dir = str(tmp_path / "orbax_out")
+    proc = _run(["export-orbax", src, out_dir])
+    assert proc.returncode == 0, proc.stderr
+    assert "exported" in proc.stdout
+    assert os.path.isdir(out_dir)
+
+    proc = _run(["export-orbax", "only-one-arg"], timeout=60)
+    assert proc.returncode == 2
+
+
+def test_export_orbax_friendly_errors(tmp_path):
+    import pytest
+
+    pytest.importorskip("orbax.checkpoint")
+    # Missing checkpoint: one-line error, exit 1, no traceback.
+    proc = _run(["export-orbax", str(tmp_path / "nope.msgpack"),
+                 str(tmp_path / "o")], timeout=60)
+    assert proc.returncode == 1
+    assert "error:" in proc.stderr and "Traceback" not in proc.stderr
