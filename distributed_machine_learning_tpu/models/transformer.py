@@ -102,6 +102,12 @@ class TransformerRegressor(nn.Module):
     # Grouped-query attention: kv heads per block (None = num_heads; 1 =
     # multi-query). See models/layers.py MultiHeadAttention.
     num_kv_heads: Optional[int] = None
+    # Rematerialization (jax.checkpoint): drop each encoder block's
+    # activations in the forward and recompute them in the backward —
+    # activation memory goes from O(num_layers) to O(1) blocks at ~1/3
+    # extra FLOPs. The knob that fits long-context/big-batch configs into
+    # HBM; numerics are identical (tested).
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
@@ -152,11 +158,19 @@ class TransformerRegressor(nn.Module):
             # Keep the input-dropout regularization the sincos path applies.
             x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
 
+        # nn.remat wraps the MODULE CLASS: each block's forward re-runs
+        # inside the backward instead of keeping its activations live.
+        # deterministic is argnum 2 (self counts) and must be STATIC —
+        # Dropout branches on it in Python, which a traced bool would break.
         if self.shared_weights:
             # ALBERT-style: one EncoderLayer parameter set applied num_layers
             # times, rolled with nn.scan so XLA compiles the body once.
+            body = (
+                nn.remat(_ScanEncoderBody, static_argnums=(2,))
+                if self.remat else _ScanEncoderBody
+            )
             ScanLayer = nn.scan(
-                _ScanEncoderBody,
+                body,
                 variable_broadcast="params",
                 # Sown per-layer values (e.g. the MoE aux loss) stack along
                 # the scan dimension instead of erroring inside nn.scan.
@@ -169,9 +183,15 @@ class TransformerRegressor(nn.Module):
                 x, deterministic
             )
         else:
+            Layer = (
+                nn.remat(EncoderLayer, static_argnums=(2,))
+                if self.remat else EncoderLayer
+            )
             for i in range(self.num_layers):
-                x = EncoderLayer(name=f"layer_{i}", **layer_kwargs)(
-                    x, deterministic=deterministic
+                # Positional: jax.checkpoint's static_argnums cover
+                # positionals only.
+                x = Layer(name=f"layer_{i}", **layer_kwargs)(
+                    x, deterministic
                 )
 
         x = x[:, -1, :]  # last-token pooling (`:235`)
