@@ -279,8 +279,9 @@ class TrialLifecycle:
         protected.add(trial.latest_checkpoint)
         directory = self.store.checkpoint_dir(trial)
         try:
-            # latest may still be in the async writer's queue: count it as
-            # present so retention converges to exactly k files, not k+1.
+            # latest may still be in the async writer's queue: the newest k
+            # DURABLE files are retained against it (transiently k+1 once
+            # the write lands; the next prune converges back to k).
             ckpt_lib.prune_checkpoints(
                 directory, self.keep_checkpoints_num, protect=protected,
                 pending_latest=trial.latest_checkpoint,

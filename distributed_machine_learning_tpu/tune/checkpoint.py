@@ -203,9 +203,15 @@ class AsyncCheckpointWriter:
                 if not ev.wait(left):
                     return False
             with self._lock:
-                errors = list(self._errors.values())
-            if errors:
-                raise errors[0]
+                # Pop only what we surface: the raised error is claimed —
+                # re-raising it on every later wait() (and re-logging at
+                # close()) turns one bad write into a permanent poison
+                # (advisor r3). Other paths' errors stay claimable so they
+                # are never silently dropped.
+                first = next(iter(self._errors), None)
+                err = self._errors.pop(first, None) if first else None
+            if err is not None:
+                raise err
             return True
         with self._lock:
             ev = self._pending.get(path)
@@ -214,7 +220,7 @@ class AsyncCheckpointWriter:
         ):
             return False
         with self._lock:
-            err = self._errors.get(path)
+            err = self._errors.pop(path, None)
         if err is not None:
             raise err
         return True
@@ -232,11 +238,17 @@ class AsyncCheckpointWriter:
         flushed = True
         try:
             flushed = self.wait(timeout=timeout)
-        except BaseException:
+        except BaseException as exc:
             if raise_errors:
                 self._q.put(None)
                 self._thread.join(timeout=10)
                 raise
+            # wait() popped (claimed) the error it raised; surface it here
+            # so an unclaimed failure is never silently dropped.
+            self._log(
+                "WARNING: checkpoint write(s) failed and were never "
+                f"waited on; first: {exc!r}"
+            )
         if not flushed:
             with self._lock:
                 stuck = list(self._pending)
@@ -244,8 +256,11 @@ class AsyncCheckpointWriter:
                 f"WARNING: abandoning {len(stuck)} hung checkpoint "
                 f"write(s) at teardown: {stuck[:3]}"
             )
+        # Errors for writes that completed while wait() was timing out on a
+        # different pending path can still be unclaimed — log those too.
         with self._lock:
             errors = dict(self._errors)
+            self._errors.clear()
         if errors and not raise_errors:
             first_path, first_err = next(iter(errors.items()))
             self._log(
@@ -267,9 +282,14 @@ def prune_checkpoints(directory: str, keep: int, protect=None,
     ``protect`` (a full path, or an iterable of them) is never deleted even if
     old — e.g. a checkpoint another trial's PBT exploit is about to restore.
     ``pending_latest``: a checkpoint path submitted to the async writer but
-    possibly not on disk yet — counted as the (present, newest) file so the
-    retained set is exactly ``keep`` once the write lands, instead of
-    ``keep``+1 (async writes race the per-result prune otherwise).
+    possibly not on disk yet — behaviorally an alias for a ``protect`` entry,
+    kept as the call-site's declaration of an in-flight write.  While it is
+    in flight the newest ``keep`` DURABLE files are all retained — deleting
+    them against a write that may still fail (crash, preemption, storage
+    error) could leave the trial with zero restorable checkpoints, exactly
+    the scenario checkpointing covers.  The set is transiently ``keep``+1
+    once the write lands; the next prune (pending now on disk) converges it
+    back to ``keep``.
     Returns the number of files deleted.
     """
     if keep <= 0:
@@ -280,6 +300,8 @@ def prune_checkpoints(directory: str, keep: int, protect=None,
         protected = {protect}
     else:
         protected = set(protect)
+    if pending_latest is not None:
+        protected.add(pending_latest)
     backend, d = get_storage(directory)
     found = []
     for name in backend.listdir(d):
@@ -287,17 +309,7 @@ def prune_checkpoints(directory: str, keep: int, protect=None,
         if m:
             found.append((int(m.group(1)), name))
     found.sort()
-    if pending_latest is not None and os.path.basename(
-        pending_latest
-    ) not in {name for _, name in found}:
-        keep -= 1  # one retention slot is spoken for by the in-flight write
-    if keep > 0:
-        excess = found[:-keep] if len(found) > keep else []
-    else:
-        # keep went to 0 (keep_checkpoints_num=1 with the newest still in
-        # flight): every on-disk file is excess — found[:-0] would be []
-        # and silently disable retention.
-        excess = found
+    excess = found[:-keep] if len(found) > keep else []
     deleted = 0
     for _, name in excess:
         full = backend.join(d, name)

@@ -210,6 +210,11 @@ class ThreadTrialExecutor:
         compile_base = tracker.thread_seconds()
         hits_base = tracker.thread_cache_hits()
 
+        writer_hung = [False]  # one hung write wedges the single writer
+        # thread for good — every later submit would queue behind it, so
+        # after the first 120s timeout this incarnation stops checkpointing
+        # instead of stalling +120s per epoch forever (advisor r3).
+
         def report_fn(metrics: Dict, checkpoint) -> str:
             metrics.setdefault(
                 "compile_time_s",
@@ -218,6 +223,8 @@ class ThreadTrialExecutor:
             metrics.setdefault(
                 "compile_cache_hits", tracker.thread_cache_hits() - hits_base
             )
+            if checkpoint is not None and writer_hung[0]:
+                checkpoint = None
             if checkpoint is not None:
                 count = trial.training_iteration + 1
                 path = ckpt_lib.checkpoint_path(
@@ -241,9 +248,11 @@ class ThreadTrialExecutor:
                         print(
                             f"[executor] WARNING: checkpoint write for "
                             f"{trial.trial_id} still hung after 120s; "
-                            f"dropping the epoch-{count} checkpoint",
+                            f"disabling checkpointing for the rest of this "
+                            f"incarnation (epoch-{count} checkpoint dropped)",
                             flush=True,
                         )
+                        writer_hung[0] = True
                         skip = True
                 if not skip:
                     self._ckpt_writer.submit(path, checkpoint)

@@ -137,6 +137,24 @@ class TestAsyncCheckpointWriter:
             w.wait(bad)
         w.close()  # errors already surfaced; close must not hang
 
+    def test_wait_claims_error_once(self, tmp_path):
+        """A raised write error is CLAIMED: later waits on the same path
+        succeed instead of re-raising forever, and close() does not re-log
+        it as 'never waited on' (advisor r3)."""
+        from distributed_machine_learning_tpu.tune.checkpoint import (
+            AsyncCheckpointWriter,
+        )
+
+        logged = []
+        w = AsyncCheckpointWriter(log=logged.append)
+        bad = str(tmp_path / "ckpt_000001.msgpack")
+        w.submit(bad, {"x": np.array([object()])})
+        with pytest.raises(Exception):
+            w.wait(bad)
+        assert w.wait(bad) is True  # claimed — no poison re-raise
+        w.close()
+        assert not any("failed" in m for m in logged), logged
+
     def test_waiting_unknown_path_is_noop(self):
         from distributed_machine_learning_tpu.tune.checkpoint import (
             AsyncCheckpointWriter,
@@ -203,10 +221,12 @@ class TestAsyncCheckpointWriter:
         slow.set()
 
 
-def test_prune_counts_pending_latest(tmp_path):
-    """Retention with an async in-flight newest: the pending path counts as
-    present, so the on-disk survivors + the landing write converge to
-    exactly `keep` files (not keep+1 — the race the full suite caught)."""
+def test_prune_keeps_durable_files_while_write_pending(tmp_path):
+    """Retention with an async in-flight newest NEVER deletes the last
+    ``keep`` durable files against it — the in-flight write may still fail
+    (crash/preemption), and deleting first would leave zero restorable
+    checkpoints (advisor r3, medium). The set is keep+1 transiently; the
+    next prune (pending landed) converges to exactly keep."""
     from distributed_machine_learning_tpu.tune.checkpoint import (
         checkpoint_path,
         prune_checkpoints,
@@ -219,23 +239,25 @@ def test_prune_counts_pending_latest(tmp_path):
     pending = checkpoint_path(d, 5)  # submitted, not yet written
     deleted = prune_checkpoints(d, keep=2, protect={pending},
                                 pending_latest=pending)
-    assert deleted == 3  # keep slot 4 on disk + the pending 5
+    assert deleted == 2  # newest 2 DURABLE files (3, 4) survive
     import os as _os
 
     left = sorted(p for p in _os.listdir(d))
-    assert left == ["ckpt_000004.msgpack"]
-    save_checkpoint(pending, {"i": 5})  # the write lands
-    assert len(_os.listdir(d)) == 2  # exactly keep
-
-    # When the latest is already on disk, behavior is unchanged.
+    assert left == ["ckpt_000003.msgpack", "ckpt_000004.msgpack"]
+    save_checkpoint(pending, {"i": 5})  # the write lands -> keep+1
+    assert len(_os.listdir(d)) == 3
+    # Next result's prune converges back to exactly keep.
     deleted = prune_checkpoints(d, keep=2, pending_latest=pending)
-    assert deleted == 0
+    assert deleted == 1
+    assert sorted(_os.listdir(d)) == [
+        "ckpt_000004.msgpack", "ckpt_000005.msgpack"
+    ]
 
 
-def test_prune_keep_one_with_pending(tmp_path):
-    """keep_checkpoints_num=1 with the newest write still in flight: every
-    on-disk file is excess (found[:-0] would silently keep everything —
-    code review r3)."""
+def test_prune_keep_one_with_pending_preserves_durable(tmp_path):
+    """keep_checkpoints_num=1 with the newest write still in flight: the
+    newest DURABLE file must survive — a crash during the in-flight window
+    must leave a restorable checkpoint (advisor r3, medium)."""
     from distributed_machine_learning_tpu.tune.checkpoint import (
         checkpoint_path,
         prune_checkpoints,
@@ -248,12 +270,14 @@ def test_prune_keep_one_with_pending(tmp_path):
     pending = checkpoint_path(d, 4)
     deleted = prune_checkpoints(d, keep=1, protect={pending},
                                 pending_latest=pending)
-    assert deleted == 3  # the pending file IS the single survivor
+    assert deleted == 2  # ckpt 3 survives as the durable restore point
     import os as _os
 
-    assert _os.listdir(d) == []
+    assert _os.listdir(d) == ["ckpt_000003.msgpack"]
     save_checkpoint(pending, {"i": 4})
-    assert len(_os.listdir(d)) == 1  # exactly keep
+    deleted = prune_checkpoints(d, keep=1, pending_latest=pending)
+    assert deleted == 1
+    assert _os.listdir(d) == ["ckpt_000004.msgpack"]
 
 
 def test_orbax_export_import_round_trip(tmp_path):

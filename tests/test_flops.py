@@ -21,6 +21,23 @@ def test_transformer_flops_monotonic_in_width():
     ) == pytest.approx(3 * small)
 
 
+def test_gqa_and_remat_flops_accounting():
+    """Advisor r3: K/V projections scale by num_kv_heads/num_heads under
+    GQA, and remat's backward recompute makes a step ~4x forward."""
+    cfg = {"model": "transformer", "d_model": 128, "num_heads": 8,
+           "num_encoder_layers": 2}
+    full = forward_flops(dict(cfg), 8, 64, 16)
+    gqa = forward_flops(dict(cfg, num_kv_heads=2), 8, 64, 16)
+    assert gqa < full
+    # Exactly the K/V projection savings: 2*(1 - 2/8) * 2*B*S*d*d per layer.
+    saved = 2 * (1 - 2 / 8) * 2.0 * 8 * 64 * 128 * 128 * 2
+    assert full - gqa == pytest.approx(saved)
+    assert train_step_flops(dict(cfg), 8, 64, 16) == pytest.approx(3 * full)
+    assert train_step_flops(dict(cfg, remat=True), 8, 64, 16) == pytest.approx(
+        4 * full
+    )
+
+
 def test_mlp_flops_and_unknown_family():
     mlp = forward_flops({"model": "mlp", "hidden_sizes": (64, 32)}, 16, 8, 4)
     assert mlp and mlp > 0
