@@ -148,6 +148,7 @@ def make_regression_dataset(
     val_fraction: float = 0.3,
     seed: int = 42,
     standardize: bool = False,
+    nan_policy: str = "zero",
 ) -> Tuple[Dataset, Dataset]:
     """The reference's `get_data_loaders` pipeline (`:423-459`), DataFrame -> Datasets.
 
@@ -156,7 +157,16 @@ def make_regression_dataset(
     glucose value, and splits 70/30. ``standardize=True`` z-scores the feature
     columns first (native one-pass Welford kernel) — a capability the reference
     lacked entirely (its raw sensor scales went straight into the model).
+
+    ``nan_policy``: pandas-generated rolling-std columns carry NaN where the
+    window had <= ddof samples (every real precomputed file's row 0), and one
+    NaN feature turns the whole training loss NaN.  "zero" (default) replaces
+    non-finite feature values with 0; "keep" passes them through.  Windows
+    whose LABEL is non-finite are dropped under either policy — zeroing a
+    target would silently train toward garbage.
     """
+    if nan_policy not in ("zero", "keep"):
+        raise ValueError(f"unknown nan_policy {nan_policy!r}")
     if feature_columns is not None:
         cols = [c for c in dict.fromkeys(feature_columns) if c in features_df.columns]
         features_df = features_df[cols]
@@ -164,6 +174,8 @@ def make_regression_dataset(
 
     x = features_df.to_numpy(dtype=np.float32)
     y = labels_df[label_column].to_numpy(dtype=np.float32)
+    if nan_policy == "zero":
+        x = np.nan_to_num(x, nan=0.0, posinf=0.0, neginf=0.0)
     if standardize:
         from distributed_machine_learning_tpu.data import native as _native
 
@@ -171,6 +183,9 @@ def make_regression_dataset(
 
     xw = split_into_intervals(x, interval, stride)
     yw = split_into_intervals(y, interval, stride)[:, -1, 0:1]  # last-step label
+    finite = np.isfinite(yw[:, 0])
+    if not finite.all():
+        xw, yw = xw[finite], yw[finite]
     return train_val_split(xw, yw, val_fraction=val_fraction, seed=seed)
 
 
@@ -188,5 +203,23 @@ def get_dataset(
     fdf = load_dataframe_from_npy(os.path.join(data_dir, f"{patient_id}_features.npy"))
     ldf = load_dataframe_from_npy(os.path.join(data_dir, f"{patient_id}_labels.npy"))
     if feature_columns is None:
-        feature_columns = F.features
+        # Schema auto-detection (VERDICT r3 next #3): a file using the
+        # reference's literal column names (`/root/reference/config.py:2-78`,
+        # selected at `ray-tune-hpo-regression.py:442`) selects the
+        # reference's 81-column feature list; canonical frames get ours.
+        if F.is_reference_format(fdf.columns):
+            feature_columns = F.reference_features
+            # Fail loudly on a partial/mixed-schema file: the selection
+            # filter below silently drops absent columns, and training on
+            # a drastically reduced feature set must not look like success.
+            missing = [c for c in feature_columns if c not in fdf.columns]
+            if missing:
+                raise KeyError(
+                    f"reference-format file for {patient_id!r} is missing "
+                    f"{len(missing)}/81 expected columns (first: "
+                    f"{missing[:4]}); pass feature_columns= explicitly to "
+                    f"train on a subset"
+                )
+        else:
+            feature_columns = F.features
     return make_regression_dataset(fdf, ldf, feature_columns=feature_columns, **kwargs)
