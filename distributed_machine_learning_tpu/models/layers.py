@@ -189,11 +189,13 @@ class MultiHeadAttention(nn.Module):
     # Grouped-query attention: project k/v to this many heads (must divide
     # num_heads) and share each kv head across a query group. None = full
     # MHA; 1 = multi-query. Cuts k/v PROJECTION params/FLOPs by
-    # num_heads/num_kv_heads on every path; the attention kernels
-    # themselves still see full-head k/v (broadcast below), so kv
-    # activation memory shrinks only where XLA fuses the repeat (the dense
-    # einsum path) — the Pallas flash custom call materializes repeated
-    # k/v, and ring attention rotates them at full size.
+    # num_heads/num_kv_heads on every path. The Pallas flash kernel (both
+    # the explicit "flash" type and the softmax->flash auto-route) and ring
+    # attention consume kv at kv_heads NATIVELY — k/v stay grouped in
+    # HBM/VMEM and around the ring, with the grouped dK/dV reduction inside
+    # the backward kernel (ops/pallas_attention.py). Paths without grouped
+    # support (dense einsum, blockwise scan, linear, ulysses) broadcast
+    # just before the kernel.
     num_kv_heads: Optional[int] = None
 
     @nn.compact
@@ -228,16 +230,16 @@ class MultiHeadAttention(nn.Module):
         q = proj("query", self.num_heads)
         k = proj("key", kv_heads)
         v = proj("value", kv_heads)
-        if kv_heads != self.num_heads:
-            # Broadcast each kv head over its query group BEFORE the
-            # kernels: every downstream path (dense/flash/ring/ulysses)
-            # then sees ordinary per-head attention. The dense einsum path
-            # fuses the repeat; the Pallas/ring paths materialize it —
-            # GQA's guaranteed saving here is the projection params/FLOPs,
-            # not kernel-side kv memory (see attribute comment).
-            group = self.num_heads // kv_heads
-            k = jnp.repeat(k, group, axis=2)
-            v = jnp.repeat(v, group, axis=2)
+
+        def full_kv(k, v):
+            # Broadcast each kv head over its query group for paths WITHOUT
+            # native grouped-kv support; the flash and ring paths below skip
+            # this and stream kv at kv_heads (see attribute comment).
+            if kv_heads != self.num_heads:
+                group = self.num_heads // kv_heads
+                return jnp.repeat(k, group, axis=2), jnp.repeat(v, group, axis=2)
+            return k, v
+
         if self.rope:
             # Applied to the GLOBAL [B, S, H, D] arrays before any
             # sequence-parallel entry — elementwise per position, so GSPMD
@@ -267,10 +269,27 @@ class MultiHeadAttention(nn.Module):
                 from distributed_machine_learning_tpu.parallel.ulysses import (
                     ulysses_attention as seq_parallel_attention,
                 )
+
+                # Ulysses all-to-alls redistribute HEADS over the sp axis;
+                # grouped kv would need Hkv % sp == 0 and a second spec —
+                # broadcast instead (the ring path keeps kv grouped).
+                k, v = full_kv(k, v)
             elif self.seq_parallel_mode == "ring":
                 from distributed_machine_learning_tpu.parallel.ring_attention import (
                     ring_attention as seq_parallel_attention,
                 )
+                # Ring attention takes kv at kv_heads natively: chunks
+                # rotate the ring at the grouped size (ICI payload / group).
+                # UNLESS tensor parallelism shards the head axis and the kv
+                # head count doesn't divide over it (e.g. MQA's 1 kv head on
+                # tp=2) — then grouped kv cannot be laid out on the mesh and
+                # the broadcast is required for correctness.
+                if (
+                    self.head_axis
+                    and self.head_axis in self.mesh.axis_names
+                    and kv_heads % self.mesh.shape[self.head_axis] != 0
+                ):
+                    k, v = full_kv(k, v)
             else:
                 raise ValueError(
                     f"Unknown seq_parallel_mode {self.seq_parallel_mode!r}; "
@@ -288,6 +307,7 @@ class MultiHeadAttention(nn.Module):
                 scale=scale,
             )
         elif self.attention_type == "linear_attention":
+            k, v = full_kv(k, v)
             out = linear_attention(q, k, v, causal=self.causal)
         elif self.attention_type == "flash":
             # Hand-written Pallas MXU kernel on TPU; off-TPU the same math
@@ -300,7 +320,8 @@ class MultiHeadAttention(nn.Module):
                 )
 
                 # Block clamping/divisor adjustment happens inside
-                # flash_attention (None = its measured-fastest defaults).
+                # flash_attention (None = its measured-fastest defaults);
+                # kv stays at kv_heads — the kernel streams it grouped.
                 out = flash_attention(
                     q, k, v, scale=scale, causal=self.causal,
                     block_q=self.block_size, block_k=self.block_size,
@@ -308,11 +329,13 @@ class MultiHeadAttention(nn.Module):
             else:
                 bs = largest_divisor_block(S, self.block_size or 128)
                 q_scaled = q * (scale / (float(head_dim) ** -0.5))
+                kf, vf = full_kv(k, v)
                 out = blockwise_attention(
-                    q_scaled, k, v, block_size=bs, causal=self.causal
+                    q_scaled, kf, vf, block_size=bs, causal=self.causal
                 )
         elif self.attention_type == "blockwise":
             bs = largest_divisor_block(S, self.block_size or 128)
+            k, v = full_kv(k, v)
             out = blockwise_attention(q, k, v, block_size=bs, causal=self.causal)
         else:
             scale = float(head_dim) ** (-self.key_dim_scaling)
@@ -330,6 +353,7 @@ class MultiHeadAttention(nn.Module):
                     q, k, v, scale=scale, causal=self.causal,
                 )
             else:
+                k, v = full_kv(k, v)
                 mask = None
                 if self.causal:
                     mask = jnp.tril(jnp.ones((S, S), bool))[None, None, :, :]

@@ -158,6 +158,21 @@ def _from_bh(x, B, H):
     return x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
 
 
+def _kv_row_map(H: int, Hkv: int):
+    """Grid-row -> kv-tensor row for grouped-query attention.
+
+    The q side enumerates rows ``bh = b*H + h``; with ``Hkv`` kv heads the
+    matching kv row is ``b*Hkv + h // group`` (``group = H // Hkv``) — k/v
+    stay at kv_heads in HBM/VMEM and are STREAMED once per q head instead of
+    being ``jnp.repeat``-ed into a full-H tensor first (VERDICT r3 next #4:
+    the repeat materialization is pure HBM traffic + memory, which is most
+    of GQA's cost at long context)."""
+    if Hkv == H:
+        return lambda bh: bh
+    group = H // Hkv
+    return lambda bh: (bh // H) * Hkv + (bh % H) // group
+
+
 def _flash_forward(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -171,11 +186,22 @@ def _flash_forward(
     with_lse: bool = False,
 ):
     B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    if k.shape != v.shape or k.shape[0] != B or k.shape[1] != S \
+            or k.shape[3] != D:
+        raise ValueError(
+            f"k/v shapes {k.shape}/{v.shape} incompatible with q {q.shape}"
+        )
+    if H % Hkv != 0:
+        raise ValueError(
+            f"num_heads {H} must be a multiple of kv heads {Hkv}"
+        )
     block_q, block_k = _adjust_blocks(S, block_q, block_k)
     nq, nk = S // block_q, S // block_k
 
     # [B, S, H, D] -> [B*H, S, D]: one grid row per (batch, head).
     qb, kb, vb = _to_bh(q), _to_bh(k), _to_bh(v)
+    kv_row = _kv_row_map(H, Hkv)
 
     kernel = functools.partial(
         _flash_kernel,
@@ -201,8 +227,10 @@ def _flash_forward(
         grid=(B * H, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D),
+                         lambda bh, qi, ki: (kv_row(bh), ki, 0)),
+            pl.BlockSpec((1, block_k, D),
+                         lambda bh, qi, ki: (kv_row(bh), ki, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
@@ -252,18 +280,24 @@ def _bwd_dkdv_kernel(
     q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
     dk_ref, dv_ref,
     dk_acc, dv_acc,
-    *, scale: float, block_q: int, block_k: int, causal: bool,
+    *, scale: float, block_q: int, block_k: int, causal: bool, nq: int,
 ):
-    """dK/dV for one kv block: grid (bh, kv_block, q_block), q innermost.
+    """dK/dV for one kv block: grid (b*kv_head, kv_block, q_stream).
 
     Streams q/do/lse/delta blocks past a resident kv block, recomputing
     P = exp(logits - lse) from the forward's logsumexp, accumulating
-    dV += P^T dO and dK += dS^T Q in VMEM scratch."""
-    q_idx = pl.program_id(2)
+    dV += P^T dO and dK += dS^T Q in VMEM scratch.
+
+    Under grouped-query attention the innermost axis streams ``nq`` q
+    blocks for EACH of the group's q heads (length nq*group): the grouped
+    dK/dV reduction happens in the accumulator, so gradients never
+    materialize at full num_heads."""
+    pid = pl.program_id(2)
+    q_idx = pid % nq  # q block within the current group head's stream
     kv_idx = pl.program_id(1)
     num_q = pl.num_programs(2)
 
-    @pl.when(q_idx == 0)
+    @pl.when(pid == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -300,7 +334,7 @@ def _bwd_dkdv_kernel(
     else:
         _compute()
 
-    @pl.when(q_idx == num_q - 1)
+    @pl.when(pid == num_q - 1)
     def _finalize():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -369,8 +403,11 @@ def _flash_backward(
     and the delta reduction out of their loop.
     """
     B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    group = H // Hkv
     block_q, block_k = _adjust_blocks(S, block_q, block_k)
     nq, nk = S // block_q, S // block_k
+    kv_row = _kv_row_map(H, Hkv)
 
     kb, vb = _to_bh(k), _to_bh(v)
     if q_side is None:
@@ -382,31 +419,39 @@ def _flash_backward(
     else:
         qb, dob, delta = q_side
 
-    q_spec = pl.BlockSpec((1, block_q, D), lambda bh, a, b: (bh, a, 0))
-    q_vec = pl.BlockSpec((1, 1, block_q), lambda bh, a, b: (bh, 0, a))
-    # dkdv grid: (bh, kv, q) — q innermost; q-side blocks index with the
-    # LAST grid axis, kv-side with the middle one.
+    # dkdv grid: (b*kv_head, kv, q-stream) — the innermost axis streams the
+    # nq q blocks of EACH of the group's q heads past the resident kv block
+    # (length nq*group), so grouped dK/dV accumulate in scratch and the
+    # outputs stay at kv_heads rows.
+    def _q_row(r, j):
+        # r = b*Hkv + kv_head; j = head_in_group*nq + q_block.
+        return (r // Hkv) * H + (r % Hkv) * group + j // nq
+
     dkdv = pl.pallas_call(
         functools.partial(
             _bwd_dkdv_kernel, scale=scale, block_q=block_q,
-            block_k=block_k, causal=causal,
+            block_k=block_k, causal=causal, nq=nq,
         ),
-        grid=(B * H, nk, nq),
+        grid=(B * Hkv, nk, nq * group),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi)),
-            pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi)),
-            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, D),
+                         lambda r, ki, j: (_q_row(r, j), j % nq, 0)),
+            pl.BlockSpec((1, block_q, D),
+                         lambda r, ki, j: (_q_row(r, j), j % nq, 0)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda r, ki, j: (_q_row(r, j), 0, j % nq)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda r, ki, j: (_q_row(r, j), 0, j % nq)),
+            pl.BlockSpec((1, block_k, D), lambda r, ki, j: (r, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda r, ki, j: (r, ki, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda r, ki, j: (r, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda r, ki, j: (r, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, S, D), v.dtype),
+            jax.ShapeDtypeStruct((B * Hkv, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B * Hkv, S, D), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
@@ -416,6 +461,8 @@ def _flash_backward(
     )
     dk, dv = dkdv(qb, dob, lse, delta, kb, vb)
 
+    q_spec = pl.BlockSpec((1, block_q, D), lambda bh, a, b: (bh, a, 0))
+    q_vec = pl.BlockSpec((1, 1, block_q), lambda bh, a, b: (bh, 0, a))
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, block_q=block_q,
@@ -423,8 +470,10 @@ def _flash_backward(
         ),
         grid=(B * H, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D),
+                         lambda bh, qi, ki: (kv_row(bh), ki, 0)),
+            pl.BlockSpec((1, block_k, D),
+                         lambda bh, qi, ki: (kv_row(bh), ki, 0)),
             q_spec,
             q_spec,
             q_vec,
@@ -437,7 +486,7 @@ def _flash_backward(
     )(kb, vb, qb, dob, lse, delta)
 
     return (
-        _from_bh(dq, B, H), _from_bh(dk, B, H), _from_bh(dv, B, H)
+        _from_bh(dq, B, H), _from_bh(dk, B, Hkv), _from_bh(dv, B, Hkv)
     )
 
 
@@ -490,8 +539,11 @@ def flash_attention(
     block_k: Optional[int] = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Flash softmax attention. q, k, v: [B, S, H, D] -> [B, S, H, D].
+    """Flash softmax attention. q: [B, S, H, D] -> [B, S, H, D].
 
+    k, v: [B, S, Hkv, D] with ``H % Hkv == 0`` — grouped-query attention is
+    native: kv tensors stay at Hkv heads end to end (HBM, VMEM streaming,
+    and the dK/dV gradients), no ``jnp.repeat`` materialization anywhere.
     ``scale`` defaults to 1/sqrt(D) (override = the reference's intended
     ``key_dim_scaling`` knob, SURVEY.md §2 C19). Block sizes default to the
     measured-fastest large tiles (``_default_blocks``). ``interpret=True``

@@ -127,10 +127,18 @@ def multihost_mesh(
     from jax.experimental import mesh_utils
 
     ici_dp = per_host // used
+    n_slices = len({getattr(d, "slice_index", None) for d in devices})
+    # Granule choice: by default create_hybrid_device_mesh groups devices
+    # by slice_index; when slices don't map 1:1 to processes (single-slice
+    # multi-host pods, and multi-process CPU test clusters where every
+    # device reports slice 0 — caught by the 2-process CPU test), group by
+    # process instead. Either way the helper keeps the ICI-topology-aware
+    # device ordering within each granule.
     arr = mesh_utils.create_hybrid_device_mesh(
-        mesh_shape=(ici_dp, sp, ep, tp),          # within a host (ICI)
-        dcn_mesh_shape=(n_procs, 1, 1, 1),        # across hosts (DCN)
+        mesh_shape=(ici_dp, sp, ep, tp),          # within a granule (ICI)
+        dcn_mesh_shape=(n_procs, 1, 1, 1),        # across granules (DCN)
         devices=devices,
+        process_is_granule=(n_slices != n_procs),
     )
     return Mesh(arr.reshape(dp, sp, ep, tp), axis_names)
 

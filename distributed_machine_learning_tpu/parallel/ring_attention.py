@@ -55,11 +55,14 @@ def _ring_local(
     causal: bool,
     scale: Optional[float],
 ) -> jnp.ndarray:
-    """Per-device body; q, k, v are the local [B, S/n, H, D] shards."""
+    """Per-device body; q: local [B, S/n, H, D] shard, k/v: [B, S/n, Hkv, D]
+    (Hkv < H = grouped-query attention; kv chunks ROTATE at kv_heads, so the
+    per-step ICI payload shrinks by the group factor — the broadcast to full
+    heads happens only inside each step's local compute)."""
     n = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     B, Sq, H, D = q.shape
-    Sk = k.shape[1]
+    Sk, Hkv = k.shape[1], k.shape[2]
     s = (D ** -0.5) if scale is None else scale
 
     qf = q.astype(jnp.float32) * s
@@ -74,10 +77,16 @@ def _ring_local(
         src = (my_idx + i) % n
         k_pos = src * Sk + jnp.arange(Sk)
 
+        if Hkv != H:
+            k_loc = jnp.repeat(k_cur, H // Hkv, axis=2)
+            v_loc = jnp.repeat(v_cur, H // Hkv, axis=2)
+        else:
+            k_loc, v_loc = k_cur, v_cur
+
         logits = jnp.einsum(
             "bqhd,bkhd->bqhk",
             qf,
-            k_cur.astype(jnp.float32),
+            k_loc.astype(jnp.float32),
             preferred_element_type=jnp.float32,
         )
         if causal:
@@ -91,7 +100,7 @@ def _ring_local(
         corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
         l_new = l * corr + p.sum(axis=-1)
         acc_new = acc * corr[..., None] + jnp.einsum(
-            "bqhk,bkhd->bqhd", p, v_cur.astype(jnp.float32)
+            "bqhk,bkhd->bqhd", p, v_loc.astype(jnp.float32)
         )
 
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
@@ -358,7 +367,12 @@ def ring_attention(
 ) -> jnp.ndarray:
     """Exact softmax attention with the sequence sharded over ``axis_name``.
 
-    q, k, v: [B, S, H, D] global arrays (S divisible by the axis size).
+    q: [B, S, H, D]; k, v: [B, S, Hkv, D] with ``H % Hkv == 0`` —
+    grouped-query attention is native on BOTH inner paths: kv chunks rotate
+    the ring at kv_heads (per-step ICI payload shrinks by the group factor);
+    the dense path broadcasts only inside each step's local compute, and the
+    flash path streams grouped kv straight through the Pallas kernels.
+    Global arrays (S divisible by the axis size).
     ``batch_axis`` optionally shards batch over a second mesh axis (dp);
     ``head_axis`` optionally shards heads over a third (tp) — heads are
     independent, so tensor parallelism composes with the ring for free.

@@ -1,0 +1,79 @@
+"""Child process body for the 2-process jax.distributed CPU test.
+
+Launched by tests/test_multihost.py with a sanitized CPU env. Each process
+joins the distributed runtime via parallel/multihost.py's own initialize()
+(the non-degenerate path single-process tests can't reach), then exercises
+barrier / broadcast / multihost_mesh / global_batch_array across the two
+processes and writes its observations as JSON for the parent to assert.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    idx, nproc, port, outfile = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
+    result = {}
+    try:
+        import jax
+
+        try:
+            # Cross-process CPU collectives need a backend; gloo ships in
+            # jaxlib. Older/newer jax spell the knob differently.
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception as exc:  # pragma: no cover - version drift
+            result["collectives_note"] = repr(exc)
+
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import PartitionSpec as P
+
+        from distributed_machine_learning_tpu.parallel import multihost
+
+        active = multihost.initialize(
+            coordinator_address=f"127.0.0.1:{port}",
+            num_processes=nproc,
+            process_id=idx,
+        )
+        result.update(multihost.describe(), active=bool(active))
+        result["is_coordinator"] = multihost.is_coordinator()
+
+        multihost.barrier("phase-1")
+
+        # Coordinator's value must win on every process.
+        seed = {"x": np.arange(3.0) + (0 if idx == 0 else 99)}
+        got = multihost.broadcast_from_coordinator(seed)
+        result["broadcast_x"] = np.asarray(got["x"]).tolist()
+
+        mesh = multihost.multihost_mesh()
+        result["mesh_shape"] = {k: int(v) for k, v in mesh.shape.items()}
+
+        # Host-local shard -> global array -> a jitted cross-process
+        # reduction (the collective rides the distributed runtime).
+        local = np.full((2, 4), float(idx), np.float32)
+        garr = multihost.global_batch_array(local, mesh, P("dp"))
+        result["global_shape"] = list(garr.shape)
+        total = jax.jit(jnp.sum)(garr)
+        result["total"] = float(total)
+
+        multihost.barrier("phase-2")
+        result["ok"] = True
+    except Exception:  # noqa: BLE001 - parent decides skip vs fail
+        import traceback
+
+        result["ok"] = False
+        result["error"] = traceback.format_exc()[-2000:]
+    with open(outfile, "w") as f:
+        json.dump(result, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -220,3 +220,72 @@ class TestRingFlashInner:
             _use_flash_inner("false", 8, 8, 8)  # string typo must not force
         with pytest.raises(ValueError, match="equal q/kv"):
             _use_flash_inner(True, 8, 16, 8)  # cross-length needs dense
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+def test_gqa_kv_rotate_grouped(qkv, sp):
+    """Grouped-query attention through the ring: kv shards rotate at
+    kv_heads (ICI payload / group) and the result matches the dense
+    full-head reference exactly (VERDICT r3 next #4)."""
+    q, k, v = qkv  # H=2 query heads
+    kg, vg = k[:, :, :1], v[:, :, :1]  # 1 kv head shared by both
+    out = ring_attention(q, kg, vg, mesh=_mesh(1, sp))
+    ref = dot_product_attention(
+        q, jnp.repeat(kg, H, axis=2), jnp.repeat(vg, H, axis=2)
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_gqa_causal_and_gradients(qkv):
+    q, k, v = qkv
+    kg, vg = k[:, :, :1], v[:, :, :1]
+    mesh = _mesh(1, 4)
+    mask = jnp.tril(jnp.ones((S, S), bool))[None, None]
+
+    def loss_ring(q, kg, vg):
+        return jnp.sum(ring_attention(q, kg, vg, mesh=mesh, causal=True) ** 2)
+
+    def loss_ref(q, kg, vg):
+        return jnp.sum(dot_product_attention(
+            q, jnp.repeat(kg, H, axis=2), jnp.repeat(vg, H, axis=2), mask=mask
+        ) ** 2)
+
+    g = jax.grad(loss_ring, argnums=(0, 1, 2))(q, kg, vg)
+    r = jax.grad(loss_ref, argnums=(0, 1, 2))(q, kg, vg)
+    assert g[1].shape == kg.shape  # gradients stay at kv_heads
+    for a, b in zip(g, r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_mqa_with_tp_head_sharding_falls_back_to_broadcast():
+    """MQA (1 kv head) + head axis sharded over tp=2: grouped kv cannot be
+    laid out on the mesh (1 % 2 != 0), so the layer must broadcast before
+    entering the ring — a config that trained before native GQA must keep
+    training (code review r4)."""
+    import optax
+
+    from distributed_machine_learning_tpu.models import build_model
+    from distributed_machine_learning_tpu.parallel.mesh import make_mesh
+    from distributed_machine_learning_tpu.parallel.train_step import (
+        make_sharded_train_step,
+    )
+
+    mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2}, jax.devices()[:8])
+    model = build_model({
+        "model": "transformer", "d_model": 32, "num_heads": 4,
+        "num_kv_heads": 1, "num_layers": 1, "dim_feedforward": 64,
+        "dropout": 0.0, "max_seq_length": 32, "seq_axis": "sp",
+        "batch_axis": "dp", "head_axis": "tp", "mesh": mesh,
+    })
+    init_fn, step_fn = make_sharded_train_step(
+        model, optax.adam(1e-3), lambda p, t: jnp.mean((p - t) ** 2), mesh
+    )
+    x = np.random.default_rng(0).normal(size=(4, 32, 6)).astype(np.float32)
+    y = np.ones((4, 1), np.float32)
+    with mesh:
+        # init with a dp-divisible batch: the ring body runs under
+        # shard_map, which requires exact divisibility (same as the dryrun).
+        params, opt_state = init_fn(jax.random.key(0), jnp.asarray(x))
+        _, _, loss = step_fn(params, opt_state, jnp.asarray(x),
+                             jnp.asarray(y), jax.random.key(1))
+    assert np.isfinite(float(loss))
