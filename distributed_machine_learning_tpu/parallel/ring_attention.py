@@ -388,6 +388,12 @@ def ring_attention(
         raise ValueError(f"mesh has no axis {axis_name!r}: {mesh.axis_names}")
     baxis = batch_axis if (batch_axis and batch_axis in mesh.axis_names) else None
     haxis = head_axis if (head_axis and head_axis in mesh.axis_names) else None
+    if haxis and k.shape[2] % mesh.shape[haxis] != 0:
+        raise ValueError(
+            f"grouped kv ({k.shape[2]} heads) cannot shard over head axis "
+            f"{haxis!r} (size {mesh.shape[haxis]}); broadcast kv to full "
+            f"heads first (models/layers.py does this automatically)"
+        )
     spec = P(baxis, axis_name, haxis, None)
     n_shards = mesh.shape[axis_name]
     local_S, D = q.shape[1] // n_shards, q.shape[-1]

@@ -74,6 +74,7 @@ def _ulysses_local(
         # After the reshuffle this is plain full-sequence attention — the
         # Pallas flash kernel (with its custom VJP) drops straight in; no
         # merge bookkeeping needed. Same measured-win gate as the ring.
+        # Grouped kv (kh/vh at Hkv/n heads < qh's H/n) passes natively.
         from distributed_machine_learning_tpu.ops.pallas_attention import (
             flash_attention,
         )
@@ -82,6 +83,12 @@ def _ulysses_local(
             qh, kh, vh, scale=s, causal=causal, interpret=flash_interpret
         )
     else:
+        if kh.shape[2] != qh.shape[2]:
+            # Grouped kv rode the all_to_all at kv_heads (the comm saving);
+            # the dense einsum needs full heads — a LOCAL repeat, no comm.
+            g = qh.shape[2] // kh.shape[2]
+            kh = jnp.repeat(kh, g, axis=2)
+            vh = jnp.repeat(vh, g, axis=2)
         logits = jnp.einsum(
             "bqhd,bkhd->bqhk",
             qh.astype(jnp.float32) * s,
@@ -138,6 +145,13 @@ def ulysses_attention(
             f"seq-axis size x head-axis size ({n}x{t}); use "
             f"seq_parallel_mode='ring' for head counts the all_to_all "
             f"cannot split"
+        )
+    Hkv = k.shape[2]
+    if Hkv != H and Hkv % (n * t) != 0:
+        raise ValueError(
+            f"grouped kv ({Hkv} heads) must also divide by {n}x{t} to ride "
+            f"the all_to_all; broadcast kv to full heads first "
+            f"(models/layers.py does this automatically)"
         )
     spec = P(baxis, axis_name, haxis, None)
     fn = _shard_map(

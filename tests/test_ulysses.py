@@ -167,3 +167,37 @@ class TestUlyssesFlashLocal:
                 np.asarray(a), np.asarray(b), atol=2e-4,
                 err_msg=f"d{name} mismatch",
             )
+
+
+def test_gqa_kv_ride_all_to_all_grouped(qkv):
+    """Grouped kv through ulysses: when kv_heads divides the sp split, kv
+    rides the all_to_all at kv_heads (payload / group) and matches the
+    dense full-head reference."""
+    q, k, v = qkv  # H heads
+    Hq = q.shape[2]
+    kg, vg = k[:, :, :2], v[:, :, :2]  # 2 kv heads; sp=2 divides
+    out = ulysses_attention(q, kg, vg, mesh=_mesh(1, 2))
+    ref = dot_product_attention(
+        q, jnp.repeat(kg, Hq // 2, axis=2), jnp.repeat(vg, Hq // 2, axis=2)
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_gqa_indivisible_kv_heads_rejected(qkv):
+    q, k, v = qkv
+    kg, vg = k[:, :, :1], v[:, :, :1]  # 1 kv head cannot split over sp=2
+    with pytest.raises(ValueError, match="grouped kv"):
+        ulysses_attention(q, kg, vg, mesh=_mesh(1, 2))
+
+
+def test_gqa_flash_local_matches_dense(qkv):
+    """Grouped kv through the ulysses FLASH local path (kernel consumes
+    kv at Hkv/n heads after the all_to_all)."""
+    q, k, v = qkv
+    kg, vg = k[:, :, :4], v[:, :, :4]  # 4 kv heads over sp=2 -> 2 local
+    out = ulysses_attention(q, kg, vg, mesh=_mesh(1, 2),
+                            use_flash=True, flash_interpret=True)
+    ref = dot_product_attention(
+        q, jnp.repeat(kg, 2, axis=2), jnp.repeat(vg, 2, axis=2)
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
