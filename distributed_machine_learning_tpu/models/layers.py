@@ -273,17 +273,16 @@ class MultiHeadAttention(nn.Module):
                 # Ulysses all-to-alls redistribute HEADS over the sp (and
                 # tp) axes; grouped kv rides them at kv_heads (all-to-all
                 # payload / group) when the split divides, else broadcast.
-                # seq_axis membership is validated by ulysses_attention —
-                # only compute the split when it exists.
-                if self.seq_axis in self.mesh.axis_names:
-                    n_split = self.mesh.shape[self.seq_axis] * (
-                        self.mesh.shape[self.head_axis]
-                        if self.head_axis
-                        and self.head_axis in self.mesh.axis_names
-                        else 1
-                    )
-                    if kv_heads % n_split != 0:
-                        k, v = full_kv(k, v)
+                # head_split is ulysses' own rule — one definition, no
+                # drift; seq_axis membership is validated downstream.
+                from distributed_machine_learning_tpu.parallel.ulysses import (
+                    head_split,
+                )
+
+                if kv_heads % head_split(
+                    self.mesh, self.seq_axis, self.head_axis
+                ) != 0:
+                    k, v = full_kv(k, v)
             elif self.seq_parallel_mode == "ring":
                 from distributed_machine_learning_tpu.parallel.ring_attention import (
                     ring_attention as seq_parallel_attention,

@@ -388,7 +388,12 @@ def ring_attention(
         raise ValueError(f"mesh has no axis {axis_name!r}: {mesh.axis_names}")
     baxis = batch_axis if (batch_axis and batch_axis in mesh.axis_names) else None
     haxis = head_axis if (head_axis and head_axis in mesh.axis_names) else None
-    if haxis and k.shape[2] % mesh.shape[haxis] != 0:
+    if (
+        haxis
+        and k.shape[2] != q.shape[2]  # grouped kv only; full-head q and kv
+        # failing to divide the axis is the ordinary sharding error
+        and k.shape[2] % mesh.shape[haxis] != 0
+    ):
         raise ValueError(
             f"grouped kv ({k.shape[2]} heads) cannot shard over head axis "
             f"{haxis!r} (size {mesh.shape[haxis]}); broadcast kv to full "

@@ -107,6 +107,20 @@ def _ulysses_local(
     )
 
 
+def head_split(mesh: Mesh, axis_name: str, head_axis: Optional[str]) -> int:
+    """The factor the all-to-alls split the head dim by (sp size x tp
+    size). ONE definition — models/layers.py uses it to decide whether
+    grouped kv can ride the reshuffle, so the rule cannot drift from the
+    validation below."""
+    t = (
+        mesh.shape[head_axis]
+        if head_axis and head_axis in mesh.axis_names
+        else 1
+    )
+    n = mesh.shape[axis_name] if axis_name in mesh.axis_names else 1
+    return n * t
+
+
 def ulysses_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -147,11 +161,11 @@ def ulysses_attention(
             f"cannot split"
         )
     Hkv = k.shape[2]
-    if Hkv != H and Hkv % (n * t) != 0:
+    if Hkv != H and (H % Hkv != 0 or Hkv % (n * t) != 0):
         raise ValueError(
-            f"grouped kv ({Hkv} heads) must also divide by {n}x{t} to ride "
-            f"the all_to_all; broadcast kv to full heads first "
-            f"(models/layers.py does this automatically)"
+            f"grouped kv ({Hkv} heads) must divide num_heads ({H}) and "
+            f"divide by {n}x{t} to ride the all_to_all; broadcast kv to "
+            f"full heads first (models/layers.py does this automatically)"
         )
     spec = P(baxis, axis_name, haxis, None)
     fn = _shard_map(

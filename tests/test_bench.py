@@ -1,0 +1,202 @@
+"""bench.py orchestration logic (no TPU, no children — helpers + parent
+flow with _run_child stubbed).
+
+The bench JSON is the round's driver-captured artifact; a logic bug here
+forfeits the round's perf evidence (VERDICT r3: the probe fragility did
+exactly that), so the probe schedule, fallback ordering, and emit fields
+get unit coverage.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_BENCH_PY = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py"
+)
+spec = importlib.util.spec_from_file_location("bench", _BENCH_PY)
+bench = importlib.util.module_from_spec(spec)
+sys.modules.setdefault("bench", bench)
+spec.loader.exec_module(bench)
+
+
+def test_parse_result_takes_last_json_line():
+    out = "noise\n{\"a\": 1}\nmore noise\n{\"b\": 2}\n"
+    assert bench._parse_result(out) == {"b": 2}
+    assert bench._parse_result("no json at all") is None
+    assert bench._parse_result("{broken\n") is None
+
+
+def test_variant_scales_cover_baseline_configs():
+    assert set(bench.VARIANT_SCALES) == {
+        "pbt_cnn", "bohb_transformer", "sharded_resnet"
+    }
+    for name, scales in bench.VARIANT_SCALES.items():
+        assert set(scales) == {"full", "small"}, name
+
+
+def test_probe_records_every_attempt_and_cause(monkeypatch):
+    calls = []
+
+    def fake_run_child(args, env, timeout_s):
+        calls.append((tuple(args), timeout_s))
+        return 124, "", "backend hung", True  # timeout, child exited
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    info = {"attempts": []}
+    ok, tunnel_ok = bench._probe_tpu(lambda m: None, info,
+                                     ((5, 0), (5, 1), (10, 2)))
+    assert ok is False and tunnel_ok is True
+    assert len(info["attempts"]) == 3
+    assert all(a["rc"] == 124 for a in info["attempts"])
+    assert all(a["cause"] for a in info["attempts"])
+    assert [a["timeout_s"] for a in info["attempts"]] == [5, 5, 10]
+
+
+def test_probe_stops_on_zombie_claimant(monkeypatch):
+    def fake_run_child(args, env, timeout_s):
+        return 124, "", "still running", False  # child did NOT exit
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    info = {"attempts": []}
+    ok, tunnel_ok = bench._probe_tpu(lambda m: None, info,
+                                     ((5, 0), (5, 0), (5, 0)))
+    assert ok is False and tunnel_ok is False  # no second claimant ever
+    assert len(info["attempts"]) == 1
+    assert info.get("zombie_claimant") is True
+
+
+def test_probe_succeeds_midway(monkeypatch):
+    rcs = iter([124, 0])
+
+    def fake_run_child(args, env, timeout_s):
+        return next(rcs), "probe OK", "", True
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    info = {"attempts": []}
+    ok, tunnel_ok = bench._probe_tpu(lambda m: None, info,
+                                     ((5, 0), (5, 1), (5, 1)))
+    assert ok is True and tunnel_ok is True
+    assert len(info["attempts"]) == 2  # stopped at first success
+
+
+def test_main_cpu_fallback_emit_fields(monkeypatch, capsys):
+    """Parent flow with every child stubbed: no tunnel -> CPU sweep +
+    torch baseline -> ONE JSON line with the diagnosis fields the verdict
+    asked for (phases, probe causes, warm/cold walls, duty cycle)."""
+    ours = {
+        "trials_per_hour": 1200.0, "wall_s": 24.0, "cold_wall_s": 30.0,
+        "trials_per_hour_cold": 960.0, "warm_walls_s": [24.0],
+        "wall_spread_s": [24.0, 24.0], "compile_s": 5.0,
+        "device_utilization": 0.86, "device_exec_s": 20.6,
+        "done": 8, "flops": 1e12, "best_mape": 12.0,
+        "platform": "cpu", "compute_dtype": "float32", "peak_flops": None,
+    }
+    torch_res = {"trials_per_hour": 1800.0}
+
+    def fake_run_child(args, env, timeout_s):
+        if args[:2] == ["--child", "ours"]:
+            return 0, json.dumps(ours), "", True
+        if args[:2] == ["--child", "torch"]:
+            return 0, json.dumps(torch_res), "", True
+        raise AssertionError(f"unexpected child {args}")
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.delenv("DML_TUNNEL_PYTHONPATH", raising=False)
+    bench.main()
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["backend"] == "cpu"
+    assert line["value"] == 1200.0
+    assert line["vs_baseline"] == pytest.approx(1200 / 1800, abs=0.01)
+    assert line["vs_baseline_cold"] == pytest.approx(960 / 1800, abs=0.01)
+    assert line["device_utilization"] == 0.86
+    assert line["cold_wall_s"] == 30.0
+    assert "cpu_note" in line
+    assert line["probe"]["skipped"]
+    assert "cpu_sweep_s" in line["phases"] and "torch_s" in line["phases"]
+
+
+def test_main_tpu_path_includes_flagship(monkeypatch, capsys):
+    """Probe OK -> both-dtype sweeps + flagship child run; flagship lands
+    in the emit; headline is the faster dtype."""
+    def sweep(dtype, tph):
+        return {
+            "trials_per_hour": tph, "wall_s": 20.0, "cold_wall_s": 35.0,
+            "trials_per_hour_cold": tph / 2, "warm_walls_s": [20.0],
+            "wall_spread_s": [19.0, 21.0], "compile_s": 12.0,
+            "device_utilization": 0.9, "done": 50, "flops": 5e15,
+            "best_mape": 9.0, "platform": "tpu", "compute_dtype": dtype,
+            "peak_flops": 9.85e13,
+        }
+
+    flagship = {"step_s": 0.03, "mfu": 0.35, "platform": "tpu"}
+
+    def fake_run_child(args, env, timeout_s):
+        if args == ["--child", "probe"]:
+            return 0, "probe OK: 1 x tpu", "", True
+        if args[:2] == ["--child", "ours"] and args[2] == "full":
+            tph = 9000.0 if args[3] == "float32" else 7000.0
+            return 0, json.dumps(sweep(args[3], tph)), "", True
+        if args == ["--child", "flagship"]:
+            return 0, json.dumps(flagship), "", True
+        if args[:2] == ["--child", "torch"]:
+            return 0, json.dumps({"trials_per_hour": 70.0}), "", True
+        raise AssertionError(f"unexpected child {args}")
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setenv("DML_TUNNEL_PYTHONPATH", "/fake/.axon_site")
+    bench.main()
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["backend"] == "tpu"
+    assert line["value"] == 9000.0  # faster dtype headlines
+    assert line["compute_dtype"] == "float32"
+    assert line["flagship"]["mfu"] == 0.35
+    assert "alt_bfloat16" in line
+    assert line["mfu"] is not None
+    assert "cpu_note" not in line
+
+
+def test_main_late_reprobe_recovers_tpu(monkeypatch, capsys):
+    """First probe window fails, CPU fallback runs, the LATE re-probe
+    succeeds -> the TPU suite still runs and headlines the round."""
+    state = {"probes": 0}
+
+    def fake_run_child(args, env, timeout_s):
+        if args == ["--child", "probe"]:
+            state["probes"] += 1
+            ok = state["probes"] > 3  # the 3-attempt window fails; late OK
+            return (0 if ok else 124), ("probe OK" if ok else ""), "hung", True
+        if args[:2] == ["--child", "ours"] and args[2] == "small":
+            return 0, json.dumps({
+                "trials_per_hour": 1000.0, "wall_s": 20.0, "done": 8,
+                "flops": 1e12, "best_mape": 20.0, "platform": "cpu",
+                "compute_dtype": "float32", "peak_flops": None,
+            }), "", True
+        if args[:2] == ["--child", "ours"] and args[2] == "full":
+            return 0, json.dumps({
+                "trials_per_hour": 8000.0, "wall_s": 22.0, "done": 50,
+                "flops": 5e15, "best_mape": 9.0, "platform": "tpu",
+                "compute_dtype": args[3], "peak_flops": 9.85e13,
+            }), "", True
+        if args == ["--child", "flagship"]:
+            return 0, json.dumps({"step_s": 0.03, "mfu": 0.4}), "", True
+        if args[:2] == ["--child", "torch"]:
+            return 0, json.dumps({"trials_per_hour": 70.0}), "", True
+        raise AssertionError(f"unexpected child {args}")
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setenv("DML_TUNNEL_PYTHONPATH", "/fake/.axon_site")
+    bench.main()
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["backend"] == "tpu"
+    assert line["value"] == 8000.0
+    assert line["probe"]["late_retry"] is True
+    assert "late_probe_s" in line["phases"]
