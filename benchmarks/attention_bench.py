@@ -20,6 +20,20 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# A CPU smoke run must not claim the single TPU tunnel: the .axon_site
+# sitecustomize on PYTHONPATH claims it at interpreter start (and a dead
+# tunnel then hangs this process before main() runs). Re-exec clean.
+if (
+    os.environ.get("JAX_PLATFORMS") == "cpu"
+    and ".axon_site" in os.environ.get("PYTHONPATH", "")
+):
+    _env = dict(os.environ)
+    _env["PYTHONPATH"] = os.pathsep.join(
+        p for p in _env["PYTHONPATH"].split(os.pathsep)
+        if p and ".axon_site" not in p
+    )
+    os.execve(sys.executable, [sys.executable] + sys.argv, _env)
+
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
