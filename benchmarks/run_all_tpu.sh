@@ -1,0 +1,44 @@
+#!/bin/bash
+# One-shot TPU measurement session: run every benchmark sequentially (the
+# tunnel admits ONE claimant at a time; see memory/axon-tunnel-environment)
+# and tee outputs under /tmp/tpu_capture_<ts>/. Run from the repo root with
+# the default (tunnel) environment:
+#
+#   bash benchmarks/run_all_tpu.sh
+#
+# Each child python process claims and releases the tunnel itself
+# (bench.py re-execs sanitized and spawns tunnel children; the micro-
+# benches claim directly). If a step hangs, it is SIGTERMed — never
+# SIGKILL, which can take the relay down.
+set -u
+ts=$(date +%H%M%S)
+out="/tmp/tpu_capture_${ts}"
+mkdir -p "$out"
+cd "$(dirname "$0")/.."
+
+run() {
+  name=$1; shift
+  echo "=== $name: $* (log: $out/$name.log)" | tee -a "$out/summary.txt"
+  timeout --signal=TERM --kill-after=0 "$TIMEOUT" "$@" \
+    > "$out/$name.log" 2>&1
+  rc=$?
+  tail -3 "$out/$name.log" | tee -a "$out/summary.txt"
+  echo "--- $name rc=$rc" | tee -a "$out/summary.txt"
+}
+
+# Headline bench first (the driver artifact path): probes, both-dtype
+# sweeps with warm repeats, flagship MFU, torch baseline.
+TIMEOUT=3600 run bench python bench.py
+
+# GQA kv-bandwidth: native grouped kv vs repeat, fwd and fwd+bwd.
+TIMEOUT=1800 run gqa python benchmarks/gqa_bench.py
+
+# Attention kernel sweep (regression-diffable vs RESULTS.md).
+TIMEOUT=1800 run attn python benchmarks/attention_bench.py
+
+# BASELINE configs 3-5 at full scale.
+TIMEOUT=2400 run variant_pbt python bench.py --variant pbt_cnn
+TIMEOUT=2400 run variant_bohb python bench.py --variant bohb_transformer
+TIMEOUT=2400 run variant_resnet python bench.py --variant sharded_resnet
+
+echo "capture complete: $out" | tee -a "$out/summary.txt"
