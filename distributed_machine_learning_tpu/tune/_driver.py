@@ -265,6 +265,14 @@ class TrialLifecycle:
             decision = STOP
         return "stop" if decision == STOP else "continue"
 
+    def final_prune(self) -> None:
+        """End-of-run retention pass over every trial. Call AFTER the
+        executor's writer has drained (join_all): writes that landed after
+        a trial's last in-run prune (the depth-2 pipeline keeps up to 2 in
+        flight) converge to exactly ``keep_checkpoints_num`` on disk."""
+        for trial in self.trials:
+            self._prune_checkpoints(trial)
+
     def _prune_checkpoints(self, trial: Trial):
         """Retention: keep the last k checkpoints of ``trial``, never deleting
         one that any trial's pending restore (PBT exploit / retry) points at.
@@ -280,8 +288,9 @@ class TrialLifecycle:
         directory = self.store.checkpoint_dir(trial)
         try:
             # latest may still be in the async writer's queue: the newest k
-            # DURABLE files are retained against it (transiently k+1 once
-            # the write lands; the next prune converges back to k).
+            # DURABLE files are retained against it (transient overshoot up
+            # to k + the executor's write-pipeline depth while writes land;
+            # later prunes and final_prune converge back to k).
             ckpt_lib.prune_checkpoints(
                 directory, self.keep_checkpoints_num, protect=protected,
                 pending_latest=trial.latest_checkpoint,

@@ -287,9 +287,11 @@ def prune_checkpoints(directory: str, keep: int, protect=None,
     in flight the newest ``keep`` DURABLE files are all retained — deleting
     them against a write that may still fail (crash, preemption, storage
     error) could leave the trial with zero restorable checkpoints, exactly
-    the scenario checkpointing covers.  The set is transiently ``keep``+1
-    once the write lands; the next prune (pending now on disk) converges it
-    back to ``keep``.
+    the scenario checkpointing covers.  The on-disk set transiently
+    overshoots by up to the executor's write-pipeline depth (``keep``+2
+    with the depth-2 pipeline) while writes land; later prunes — and the
+    runner's final retention pass after the writer drains — converge it
+    back to exactly ``keep``.
     Returns the number of files deleted.
     """
     if keep <= 0:

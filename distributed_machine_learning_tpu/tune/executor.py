@@ -26,6 +26,7 @@ import sys
 import threading
 import time
 import traceback
+from collections import deque
 from typing import Callable, Dict, List, Optional
 
 import jax
@@ -214,6 +215,7 @@ class ThreadTrialExecutor:
         # thread for good — every later submit would queue behind it, so
         # after the first 120s timeout this incarnation stops checkpointing
         # instead of stalling +120s per epoch forever (advisor r3).
+        pending_writes = deque()  # this incarnation's in-flight ckpt paths
 
         def report_fn(metrics: Dict, checkpoint) -> str:
             metrics.setdefault(
@@ -230,32 +232,36 @@ class ThreadTrialExecutor:
                 path = ckpt_lib.checkpoint_path(
                     self.store.checkpoint_dir(trial), count
                 )
-                # Depth-1 write pipeline per trial: wait for the PREVIOUS
-                # epoch's write before queueing this one. Epoch N+1's
-                # training still overlaps write N, and at most one path per
-                # trial is ever in flight — which is what makes the
-                # retention prune's pending-latest accounting exact.
-                # A write ERROR re-raises here (the synchronous-save failure
-                # semantics: the trial fails and retries); a HUNG write must
-                # not deadlock the trial — bounded wait, then this epoch's
-                # checkpoint is dropped with a warning (training continues;
-                # teardown abandons the stuck write).
+                # Depth-2 write pipeline per trial: before queueing this
+                # write, drain down to one in-flight by waiting on the
+                # OLDEST pending path — one occasionally-slow write
+                # overlaps TWO epochs of training instead of stalling the
+                # trial thread (depth 1 stalled whenever write time
+                # exceeded epoch time).  FIFO waits keep the synchronous-
+                # save error semantics: a write ERROR re-raises here (one
+                # epoch later than it occurred; the trial fails and
+                # retries), and a HUNG write never deadlocks the trial —
+                # bounded wait, then checkpointing is disabled for this
+                # incarnation (the single writer thread is wedged for
+                # good; teardown abandons the stuck write).
                 skip = False
-                if trial.latest_checkpoint:
-                    if not self._ckpt_writer.wait(
-                        trial.latest_checkpoint, timeout=120.0
-                    ):
+                while len(pending_writes) >= 2:
+                    oldest = pending_writes.popleft()
+                    if not self._ckpt_writer.wait(oldest, timeout=120.0):
                         print(
                             f"[executor] WARNING: checkpoint write for "
                             f"{trial.trial_id} still hung after 120s; "
                             f"disabling checkpointing for the rest of this "
-                            f"incarnation (epoch-{count} checkpoint dropped)",
+                            f"incarnation (epoch-{count} checkpoint "
+                            f"dropped)",
                             flush=True,
                         )
                         writer_hung[0] = True
                         skip = True
+                        break
                 if not skip:
                     self._ckpt_writer.submit(path, checkpoint)
+                    pending_writes.append(path)
                     trial.latest_checkpoint = path
                     trial.latest_checkpoint_iteration = count
             event = ResultEvent(trial, metrics, incarnation)

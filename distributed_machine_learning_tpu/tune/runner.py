@@ -371,6 +371,10 @@ def run(
             executor.join_all(timeout=5.0)
         except Exception as exc:  # noqa: BLE001
             log(f"executor teardown failed: {exc!r}")
+        # Final retention pass: join_all flushed the async writer, so
+        # writes that landed AFTER each trial's last in-run prune now
+        # converge to exactly keep_checkpoints_num on disk.
+        lifecycle.final_prune()
         utilization = device_mgr.utilization(wall)
         from distributed_machine_learning_tpu.utils import compile_cache as cc
 

@@ -309,3 +309,91 @@ def test_orbax_export_import_round_trip(tmp_path):
 
     with pytest.raises(FileNotFoundError):
         export_orbax(str(tmp_path / "nope.msgpack"), str(tmp_path / "x"))
+
+
+def test_depth2_write_pipeline_overlaps_slow_write(tmp_path, monkeypatch):
+    """Thread executor's checkpoint pipeline is depth 2: one slow write
+    overlaps TWO epochs of training. The first write blocks on a gate the
+    TRAINABLE releases only at epoch 3 — reaching epoch 3 proves epoch 2's
+    report did not stall behind the in-flight write (depth 1 would sit in
+    a 120s bounded wait instead)."""
+    import threading as _threading
+    import time as _time
+
+    from distributed_machine_learning_tpu import tune
+    from distributed_machine_learning_tpu.tune import checkpoint as cl
+
+    gate = _threading.Event()
+    progressed = []
+    real_save = cl.save_checkpoint
+
+    def gated_save(path, tree):
+        if "ckpt_000001" in path:
+            assert gate.wait(60), "gate never released"
+        return real_save(path, tree)
+
+    monkeypatch.setattr(cl, "save_checkpoint", gated_save)
+
+    def trainable(config):
+        for epoch in range(3):
+            if epoch == 2:
+                # Write 1 is still gated; getting here means report(1) and
+                # report(2)'s submits didn't block behind it.
+                progressed.append(not gate.is_set())
+                gate.set()
+            tune.report({"validation_loss": 1.0}, checkpoint={"e": epoch})
+
+    t0 = _time.time()
+    analysis = tune.run(
+        trainable,
+        {"num_epochs": 3},
+        metric="validation_loss",
+        num_samples=1,
+        storage_path=str(tmp_path),
+        keep_checkpoints_num=10,
+        verbose=0,
+    )
+    assert progressed == [True]
+    assert _time.time() - t0 < 60  # no 120s hung-write stall
+    t = analysis.trials[0]
+    assert t.latest_checkpoint and t.latest_checkpoint.endswith(
+        "ckpt_000003.msgpack"
+    )
+
+
+def test_final_retention_converges_with_inflight_writes(tmp_path, monkeypatch):
+    """keep_checkpoints_num=1 with slow writes: the runner's end-of-run
+    retention pass (after the writer drains) leaves EXACTLY one file per
+    trial — writes landing after a trial's last in-run prune must not
+    inflate the on-disk set (code review r4)."""
+    import os
+    import time as _time
+
+    from distributed_machine_learning_tpu import tune
+    from distributed_machine_learning_tpu.tune import checkpoint as cl
+
+    real_save = cl.save_checkpoint
+
+    def slow_save(path, tree):
+        _time.sleep(0.15)  # every write outlives its epoch
+        return real_save(path, tree)
+
+    monkeypatch.setattr(cl, "save_checkpoint", slow_save)
+
+    def trainable(config):
+        for epoch in range(4):
+            tune.report({"validation_loss": 1.0}, checkpoint={"e": epoch})
+
+    analysis = tune.run(
+        trainable,
+        {"num_epochs": 4},
+        metric="validation_loss",
+        num_samples=2,
+        storage_path=str(tmp_path),
+        keep_checkpoints_num=1,
+        verbose=0,
+    )
+    for t in analysis.trials:
+        d = os.path.dirname(t.latest_checkpoint)
+        files = sorted(os.listdir(d))
+        assert files == ["ckpt_000004.msgpack"], files
