@@ -190,12 +190,13 @@ class MultiHeadAttention(nn.Module):
     # num_heads) and share each kv head across a query group. None = full
     # MHA; 1 = multi-query. Cuts k/v PROJECTION params/FLOPs by
     # num_heads/num_kv_heads on every path. The Pallas flash kernel (both
-    # the explicit "flash" type and the softmax->flash auto-route) and ring
-    # attention consume kv at kv_heads NATIVELY — k/v stay grouped in
-    # HBM/VMEM and around the ring, with the grouped dK/dV reduction inside
-    # the backward kernel (ops/pallas_attention.py). Paths without grouped
-    # support (dense einsum, blockwise scan, linear, ulysses) broadcast
-    # just before the kernel.
+    # the explicit "flash" type and the softmax->flash auto-route), the
+    # blockwise scan (grouped einsums), ring attention (kv rotates the ring
+    # grouped), and Ulysses (when the head split divides) consume kv at
+    # kv_heads NATIVELY, with the grouped dK/dV reduction inside the flash
+    # backward kernel (ops/pallas_attention.py). Only the dense einsum and
+    # linear paths broadcast, just before the kernel (XLA fuses the dense
+    # repeat).
     num_kv_heads: Optional[int] = None
 
     @nn.compact
@@ -338,13 +339,12 @@ class MultiHeadAttention(nn.Module):
             else:
                 bs = largest_divisor_block(S, self.block_size or 128)
                 q_scaled = q * (scale / (float(head_dim) ** -0.5))
-                kf, vf = full_kv(k, v)
+                # blockwise consumes grouped kv natively (grouped einsums).
                 out = blockwise_attention(
-                    q_scaled, kf, vf, block_size=bs, causal=self.causal
+                    q_scaled, k, v, block_size=bs, causal=self.causal
                 )
         elif self.attention_type == "blockwise":
             bs = largest_divisor_block(S, self.block_size or 128)
-            k, v = full_kv(k, v)
             out = blockwise_attention(q, k, v, block_size=bs, causal=self.causal)
         else:
             scale = float(head_dim) ** (-self.key_dim_scaling)

@@ -112,16 +112,24 @@ def blockwise_attention(
     statistics, so peak memory is O(S * block) rather than O(S^2).  This is the
     long-sequence path; for lengths where the dense form fits, XLA's fused
     softmax attention is typically faster.
+
+    k, v may carry fewer heads than q (``H % Hkv == 0`` — grouped-query
+    attention): the score and value products run as grouped einsums, so kv
+    never materializes at full heads here either.
     """
     B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    if H % Hkv != 0:
+        raise ValueError(f"num_heads {H} must be a multiple of kv heads {Hkv}")
+    group = H // Hkv
     if S % block_size != 0:
         raise ValueError(f"seq len {S} must be a multiple of block_size {block_size}")
     nb = S // block_size
     scale = D ** -0.5
 
     qb = q.reshape(B, nb, block_size, H, D)
-    kb = k.reshape(B, nb, block_size, H, D)
-    vb = v.reshape(B, nb, block_size, H, D)
+    kb = k.reshape(B, nb, block_size, Hkv, D)
+    vb = v.reshape(B, nb, block_size, Hkv, D)
 
     q_idx = jnp.arange(S).reshape(nb, block_size)
 
@@ -134,10 +142,13 @@ def blockwise_attention(
         def inner(carry, kv):
             m, l, acc = carry
             k_block, v_block, k_block_ids = kv
-            logits = (
-                jnp.einsum("bqhd,bkhd->bqhk", q_block, k_block).astype(jnp.float32)
-                * scale
-            )
+            # One grouped formulation for every group size: with group==1
+            # the (B, q, Hkv, 1, D) reshape is free metadata under XLA and
+            # the contraction is identical to the plain per-head einsum.
+            qg = q_block.reshape(B, block_size, Hkv, group, D)
+            logits = jnp.einsum(
+                "bqhgd,bkhd->bqhgk", qg, k_block
+            ).astype(jnp.float32).reshape(B, block_size, H, -1) * scale
             if causal:
                 cmask = q_block_ids[None, :, None, None] >= k_block_ids[None, None, None, :]
                 logits = jnp.where(cmask, logits, -jnp.inf)
@@ -148,9 +159,12 @@ def blockwise_attention(
             p = jnp.where(jnp.isfinite(logits), p, 0.0)
             corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
             l_new = l * corr + p.sum(axis=-1)
-            acc_new = acc * corr[..., None] + jnp.einsum(
-                "bqhk,bkhd->bqhd", p, v_block.astype(jnp.float32)
-            )
+            pv = jnp.einsum(
+                "bqhgk,bkhd->bqhgd",
+                p.reshape(B, block_size, Hkv, group, -1),
+                v_block.astype(jnp.float32),
+            ).reshape(B, block_size, H, D)
+            acc_new = acc * corr[..., None] + pv
             return (m_new, l_new, acc_new), None
 
         (m, l, acc), _ = jax.lax.scan(
