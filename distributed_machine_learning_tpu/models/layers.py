@@ -194,9 +194,9 @@ class MultiHeadAttention(nn.Module):
     # blockwise scan (grouped einsums), ring attention (kv rotates the ring
     # grouped), and Ulysses (when the head split divides) consume kv at
     # kv_heads NATIVELY, with the grouped dK/dV reduction inside the flash
-    # backward kernel (ops/pallas_attention.py). Only the dense einsum and
-    # linear paths broadcast, just before the kernel (XLA fuses the dense
-    # repeat).
+    # backward kernel (ops/pallas_attention.py); linear attention shares
+    # per-kv-head state across each query group. Only the dense einsum
+    # path broadcasts, just before the kernel (XLA fuses that repeat).
     num_kv_heads: Optional[int] = None
 
     @nn.compact
@@ -317,7 +317,8 @@ class MultiHeadAttention(nn.Module):
                 scale=scale,
             )
         elif self.attention_type == "linear_attention":
-            k, v = full_kv(k, v)
+            # linear attention consumes grouped kv natively (per-kv-head
+            # state shared across each query group).
             out = linear_attention(q, k, v, causal=self.causal)
         elif self.attention_type == "flash":
             # Hand-written Pallas MXU kernel on TPU; off-TPU the same math

@@ -68,14 +68,25 @@ def linear_attention(
 
     out_i = phi(q_i) . sum_j phi(k_j) v_j^T / (phi(q_i) . sum_j phi(k_j)).
     Replaces the reference's O(n^2) relu(QK^T)V "linear" attention (`:116-117`)
-    with the kernel trick it was named after.  q,k,v: [B, S, H, D].
+    with the kernel trick it was named after.  q: [B, S, H, D]; k, v may
+    carry fewer heads (``H % Hkv == 0`` — grouped-query attention): the
+    per-kv-head state is computed once at Hkv and shared across each query
+    group via grouped einsums, never materializing full-head kv.
     """
-    qf = _elu_feature_map(q)
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    if H % Hkv != 0:
+        raise ValueError(f"num_heads {H} must be a multiple of kv heads {Hkv}")
+    g = H // Hkv
+    qf = _elu_feature_map(q).reshape(B, S, Hkv, g, D)
     kf = _elu_feature_map(k)
+    E = v.shape[-1]
     if not causal:
-        kv = jnp.einsum("bshd,bshe->bhde", kf, v)          # [B,H,D,E]
-        z = jnp.einsum("bshd,bhd->bsh", qf, kf.sum(axis=1))  # [B,S,H]
-        out = jnp.einsum("bshd,bhde->bshe", qf, kv)
+        kv = jnp.einsum("bshd,bshe->bhde", kf, v)            # [B,Hkv,D,E]
+        z = jnp.einsum(
+            "bshgd,bhd->bshg", qf, kf.sum(axis=1)
+        ).reshape(B, S, H)
+        out = jnp.einsum("bshgd,bhde->bshge", qf, kv).reshape(B, S, H, E)
         return out / (z[..., None] + eps)
 
     # Causal: prefix-sum the kv outer products with an associative scan —
@@ -83,8 +94,10 @@ def linear_attention(
     kv_terms = jnp.einsum("bshd,bshe->bshde", kf, v)
     kv_prefix = jax.lax.associative_scan(jnp.add, kv_terms, axis=1)
     k_prefix = jax.lax.associative_scan(jnp.add, kf, axis=1)
-    z = jnp.einsum("bshd,bshd->bsh", qf, k_prefix)
-    out = jnp.einsum("bshd,bshde->bshe", qf, kv_prefix)
+    z = jnp.einsum("bshgd,bshd->bshg", qf, k_prefix).reshape(B, S, H)
+    out = jnp.einsum(
+        "bshgd,bshde->bshge", qf, kv_prefix
+    ).reshape(B, S, H, E)
     return out / (z[..., None] + eps)
 
 

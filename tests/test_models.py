@@ -321,3 +321,38 @@ def test_blockwise_gqa_matches_repeat(causal):
     assert g[1].shape == k.shape
     for a, b in zip(g, r):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_linear_attention_gqa_matches_repeat(causal):
+    """linear_attention shares per-kv-head state across query groups:
+    exact vs the full-head broadcast, forward and gradients."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_machine_learning_tpu.ops.attention import (
+        linear_attention,
+    )
+
+    rng = np.random.default_rng(9)
+    q = jnp.asarray(rng.normal(size=(2, 32, 4, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 32, 2, 8)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 32, 2, 8)), jnp.float32)
+
+    def gqa(q, k, v):
+        return linear_attention(q, k, v, causal=causal)
+
+    def rep(q, k, v):
+        return linear_attention(
+            q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2),
+            causal=causal,
+        )
+
+    np.testing.assert_allclose(
+        np.asarray(gqa(q, k, v)), np.asarray(rep(q, k, v)), atol=1e-5
+    )
+    g = jax.grad(lambda *a: jnp.sum(jnp.sin(gqa(*a))), argnums=(0, 1, 2))(q, k, v)
+    r = jax.grad(lambda *a: jnp.sum(jnp.sin(rep(*a))), argnums=(0, 1, 2))(q, k, v)
+    assert g[1].shape == k.shape
+    for a, b in zip(g, r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
