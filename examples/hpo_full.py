@@ -54,6 +54,15 @@ def build_search_space(args) -> tune.SearchSpace:
             ["scaled_dot_product", "multi_head_attention", "linear_attention"]
         ),
         "key_dim_scaling": tune.choice([1.0, 0.5, 0.25]),
+        # Beyond the reference's 20: grouped-query attention (kv heads per
+        # query group — the kernels consume grouped kv natively) and rotary
+        # vs additive positions. kv_divider picks a divisor of num_heads so
+        # every sample is valid.
+        "num_kv_heads": tune.sample_from(
+            lambda cfg: max(1, cfg["num_heads"] // cfg["kv_divider"])
+        ),
+        "kv_divider": tune.choice([1, 2, 4]),
+        "position_encoding": tune.choice(["sincos", "rope"]),
         "attn_kernel_size": tune.choice([3, 5, 7]),
         "depthwise_separable_conv": tune.choice([True, False]),
         "shared_weights": tune.choice([True, False]),

@@ -263,3 +263,30 @@ def test_points_to_evaluate_through_tune_run(tmp_path):
     assert first["learning_rate"] == 5e-3
     assert tuple(first["hidden_sizes"]) == (16,)
     assert analysis.num_terminated() == 3
+
+
+def test_hpo_full_space_samples_are_valid():
+    """The flagship example's 20+-hp space: every sample satisfies its own
+    constraints, num_kv_heads always divides num_heads (GQA validity), and
+    dim_feedforward resolves to d_model * ff_multiplier (the reference's
+    `:383` sample_from bug, fixed semantics)."""
+    import argparse
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "hpo_full",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "examples", "hpo_full.py"),
+    )
+    hpo_full = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(hpo_full)
+
+    args = argparse.Namespace(fast=False, num_epochs=20)
+    space = hpo_full.build_search_space(args)
+    for i in range(100):
+        cfg = space.sample(["hpo_full_validity", i])
+        assert cfg["d_model"] % cfg["num_heads"] == 0
+        assert cfg["num_heads"] % cfg["num_kv_heads"] == 0
+        assert cfg["dim_feedforward"] == cfg["d_model"] * cfg["ff_multiplier"]
+        assert cfg["position_encoding"] in ("sincos", "rope")
