@@ -169,3 +169,33 @@ def test_rolling_default_ddof_matches_pandas_convention():
     np.testing.assert_allclose(
         got[1:], expected[1:], rtol=1e-6, atol=1e-8
     )  # row 0: single sample -> pandas NaN, kernel 0; both "undefined"
+
+
+def test_reference_file_through_vectorized_runner(tmp_path):
+    """A reference-format .npy pair drives run_vectorized (the TPU-shaped
+    sweep path) end to end: C1 interop x the vectorized runner."""
+    from distributed_machine_learning_tpu import tune
+    from distributed_machine_learning_tpu.tune.vectorized import run_vectorized
+
+    rows = 96 * 6
+    frame = F.build_feature_frame(_reference_raw_frame(rows), schema="reference")
+    labels = pd.DataFrame({
+        F.LABEL_COLUMN: 100 + 20 * np.random.RandomState(3).rand(rows)
+    })
+    for df, name in ((frame, "features"), (labels, "labels")):
+        np.save(tmp_path / f"P2_{name}.npy",
+                {"columns": list(df.columns),
+                 "data": df.to_numpy(dtype=np.float32)})
+
+    train, val = get_dataset("P2", str(tmp_path))
+    analysis = run_vectorized(
+        {"model": "mlp", "hidden_sizes": (8,),
+         "learning_rate": tune.loguniform(1e-3, 1e-1),
+         "seed": tune.randint(0, 1000), "num_epochs": 2, "batch_size": 2,
+         "loss_function": "mse", "lr_schedule": "constant"},
+        train_data=train, val_data=val,
+        metric="validation_mse", mode="min", num_samples=4,
+        storage_path=str(tmp_path / "results"), seed=5, verbose=0,
+    )
+    assert analysis.num_terminated() == 4
+    assert np.isfinite(analysis.best_result["validation_mse"])
