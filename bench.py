@@ -323,17 +323,42 @@ def child_torch(scale: dict) -> None:
     )
 
     class Baseline(nn.Module):
-        def __init__(self, in_features):
+        """The reference's TransformerModel, faithfully: input projection,
+        sin/cos positional encoding + dropout, N encoder layers, last-token
+        pooling, and the fc1..fc5 ReLU regression head
+        (`ray-tune-hpo-regression.py:183-240`) — the same work the JAX side
+        trains, so vs_baseline compares models, not a lighter proxy."""
+
+        def __init__(self, in_features, max_len=512):
             super().__init__()
             self.proj = nn.Linear(in_features, D_MODEL)
+            pos = torch.zeros(max_len, D_MODEL)
+            position = torch.arange(max_len, dtype=torch.float32)[:, None]
+            div = torch.exp(
+                torch.arange(0, D_MODEL, 2, dtype=torch.float32)
+                * (-np.log(10000.0) / D_MODEL)
+            )
+            pos[:, 0::2] = torch.sin(position * div)
+            pos[:, 1::2] = torch.cos(position * div)
+            self.register_buffer("pe", pos)
+            self.pe_dropout = nn.Dropout(0.1)
             enc = nn.TransformerEncoderLayer(
                 d_model=D_MODEL, nhead=HEADS, dim_feedforward=DFF,
                 dropout=0.1, batch_first=True)
             self.encoder = nn.TransformerEncoder(enc, num_layers=LAYERS)
-            self.head = nn.Linear(D_MODEL, 1)
+            # The reference's 5-layer ReLU head (fc1..fc5, `:217-221`).
+            self.head = nn.Sequential(
+                nn.Linear(D_MODEL, 128), nn.ReLU(),
+                nn.Linear(128, 64), nn.ReLU(),
+                nn.Linear(64, 32), nn.ReLU(),
+                nn.Linear(32, 16), nn.ReLU(),
+                nn.Linear(16, 1),
+            )
 
         def forward(self, x):
-            h = self.encoder(self.proj(x))
+            h = self.proj(x)
+            h = self.pe_dropout(h + self.pe[: h.shape[1]][None])
+            h = self.encoder(h)
             return self.head(h[:, -1, :])
 
     x = torch.from_numpy(train.x)
