@@ -878,8 +878,12 @@ def _run_population(
         rows = list(resume_state["rows"])
         active = list(resume_state["active"])
         epoch_start = int(resume_state["epoch0"])
+        # Re-wrap with the population's rng_impl: rbg key data is wider
+        # than threefry's, so wrapping under the wrong impl fails (or,
+        # worse, silently changes streams).
         base_keys = jax.random.wrap_key_data(
-            jnp.asarray(resume_state["key_data"])
+            jnp.asarray(resume_state["key_data"]),
+            impl=batch[0].config.get("rng_impl") or None,
         )
         row_lr = jnp.asarray(
             [lrs[r] if r >= 0 else float(lrs[0]) for r in rows], jnp.float32
@@ -936,7 +940,13 @@ def _run_population(
                 pad_rows, dtype=np.uint32) * 7919])
             lrs = np.concatenate([lrs, np.repeat(lrs[:1], pad_rows)])
             wds = np.concatenate([wds, np.repeat(wds[:1], pad_rows)])
-        base_keys = jax.vmap(jax.random.key)(jnp.asarray(seeds))
+        # rng_impl (static; part of the group signature via the static
+        # config): "rbg" = hardware RNG, cheaper than threefry on TPU at
+        # the sweep's small shapes. Opt-in — streams differ.
+        rng_impl = batch[0].config.get("rng_impl")
+        base_keys = jax.vmap(
+            lambda s: jax.random.key(s, impl=rng_impl)
+        )(jnp.asarray(seeds))
         params, opt_state, batch_stats = program.init_population(
             base_keys, jnp.asarray(lrs), jnp.asarray(wds)
         )

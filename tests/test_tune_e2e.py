@@ -232,3 +232,61 @@ def test_baseline_config1_mlp_california_housing(tmp_path, monkeypatch):
     )
     assert analysis.num_terminated() == 4
     assert np.isfinite(analysis.best_result["validation_loss"])
+
+
+def test_rng_impl_rbg_trains_and_resumes(tmp_path):
+    """config rng_impl='rbg' (hardware-RNG dropout streams — the cheap
+    path on TPU at sweep shapes) trains finitely through BOTH runners,
+    and the vectorized population checkpoint round-trips rbg key data
+    (wider than threefry's — wrap must use the same impl)."""
+    from distributed_machine_learning_tpu.data import dummy_regression_data
+    from distributed_machine_learning_tpu.tune.vectorized import run_vectorized
+
+    train, val = dummy_regression_data(
+        num_samples=96, seq_len=8, num_features=4
+    )
+    space = {
+        "model": "simple_transformer", "d_model": 16, "num_heads": 2,
+        "num_layers": 1, "dim_feedforward": 32, "dropout": 0.2,
+        "learning_rate": 0.01, "seed": tune.randint(0, 1000),
+        "num_epochs": 3, "batch_size": 32, "loss_function": "mse",
+        "lr_schedule": "constant", "rng_impl": "rbg",
+    }
+    analysis = tune.run(
+        tune.with_parameters(tune.train_regressor, train_data=train,
+                             val_data=val),
+        dict(space), metric="validation_mse", num_samples=1,
+        storage_path=str(tmp_path / "run"), verbose=0,
+    )
+    assert np.isfinite(analysis.best_result["validation_mse"])
+
+    # Vectorized, interrupted MID-SWEEP (simulated preemption at epoch 2 of
+    # 3), then resumed: the continuation trains real epochs from restored
+    # rbg keys — the impl-sensitive fold_in/train path after wrap_key_data.
+    from distributed_machine_learning_tpu.tune.schedulers import FIFOScheduler
+
+    class DiesAtEpoch(FIFOScheduler):
+        def __init__(self, fatal_iteration):
+            self.fatal_iteration = fatal_iteration
+
+        def on_trial_result(self, trial, result):
+            if result["training_iteration"] >= self.fatal_iteration:
+                raise RuntimeError("simulated preemption")
+            return super().on_trial_result(trial, result)
+
+    with pytest.raises(RuntimeError, match="simulated preemption"):
+        run_vectorized(
+            dict(space), train_data=train, val_data=val,
+            metric="validation_mse", mode="min", num_samples=2,
+            storage_path=str(tmp_path), name="rbg_v", seed=3, verbose=0,
+            checkpoint_every_epochs=1, scheduler=DiesAtEpoch(2),
+        )
+    v2 = run_vectorized(
+        dict(space), train_data=train, val_data=val,
+        metric="validation_mse", mode="min", num_samples=2,
+        storage_path=str(tmp_path), name="rbg_v", seed=3, verbose=0,
+        checkpoint_every_epochs=1, resume=True,
+    )
+    assert v2.num_terminated() == 2
+    # Every trial reached full depth through the post-resume epochs.
+    assert all(t.training_iteration == 3 for t in v2.trials)
