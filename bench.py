@@ -190,6 +190,12 @@ def child_ours(scale: dict, compute_dtype: str = "float32") -> None:
         "loss_function": "mse",
         "compute_dtype": compute_dtype,
     }
+    # Optional dropout-PRNG override (DML_BENCH_RNG_IMPL=rbg): measure the
+    # hardware-RNG stream path against the default threefry on the chip.
+    rng_impl = os.environ.get("DML_BENCH_RNG_IMPL")
+    if rng_impl:
+        space["rng_impl"] = rng_impl
+
     def sweep(tag, scheduler=None, epochs_per_dispatch=1):
         t0 = time.time()
         analysis = tune.run_vectorized(
