@@ -11,6 +11,13 @@ needs:
   first thing to check when a pod host misbehaves.
 * ``export-orbax <ckpt.msgpack> <out_dir>`` — convert a framework
   checkpoint to an orbax StandardCheckpoint for orbax-consuming stacks.
+* ``probe [--timeout S]`` — bounded accelerator health check in a CHILD
+  process (a wedged backend times out instead of hanging this shell; the
+  child is SIGTERMed, never SIGKILLed — a killed tunnel-holder can take
+  shared relays down with it). Exit 0 = an accelerator executed a real
+  computation; 1 = healthy but CPU-only; 2 = the probe child crashed
+  (broken install/plugin); 124 = backend hung (the JSON records whether
+  the wedged child actually exited).
 
 Note on startup cost: ``python -m`` imports the package ``__init__`` (and
 with it jax/flax/optax) before this module runs, so even ``--help`` pays
@@ -49,13 +56,71 @@ def _info() -> None:
     print(json.dumps(out, indent=2))
 
 
+def _probe(rest) -> None:
+    import argparse
+    import signal
+    import subprocess
+
+    p = argparse.ArgumentParser(prog="probe")
+    p.add_argument("--timeout", type=float, default=120.0)
+    args = p.parse_args(rest)
+    code = (
+        "import jax, jax.numpy as jnp, json\n"
+        "d = jax.devices()[0]\n"
+        "ok = float(jnp.ones((8, 8)).sum()) == 64.0\n"
+        "print(json.dumps({'platform': d.platform,\n"
+        "                  'device_kind': getattr(d, 'device_kind', None),\n"
+        "                  'devices': jax.device_count(),\n"
+        "                  'executed': ok}))\n"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=args.timeout)
+    except subprocess.TimeoutExpired:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.send_signal(signal.SIGINT)
+            try:
+                proc.communicate(timeout=15)
+            except subprocess.TimeoutExpired:
+                pass
+        # A child wedged in native code can survive both signals — report
+        # whether it is actually gone: a still-running orphan keeps holding
+        # the accelerator claim, and every later probe hangs against it.
+        print(json.dumps({
+            "error": f"backend init/execute hung past {args.timeout}s "
+                     f"(SIGTERMed; never SIGKILL a tunnel holder)",
+            "child_exited": proc.poll() is not None,
+            "child_pid": proc.pid,
+        }))
+        raise SystemExit(124)
+    line = (out.strip().splitlines() or [""])[-1]
+    try:
+        res = json.loads(line)
+    except json.JSONDecodeError:
+        # Distinct from "healthy CPU-only host" (exit 1): the child CRASHED
+        # (broken install, bad plugin) — a pod-health script must not read
+        # that as fine-but-no-accelerator.
+        print(json.dumps({"error": (err or out)[-400:]}))
+        raise SystemExit(2) from None
+    print(json.dumps(res))
+    healthy_accel = res.get("platform") != "cpu" and res.get("executed") is True
+    raise SystemExit(0 if healthy_accel else 1)
+
+
 def main(argv=None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
     usage = (
         "usage: python -m distributed_machine_learning_tpu "
-        "{worker|info|export-orbax} [args]\n"
+        "{worker|info|probe|export-orbax} [args]\n"
         "  worker        host trial supervisor (see 'worker --help')\n"
         "  info          jax backend/device summary for this process\n"
+        "  probe         bounded accelerator health check (child process)\n"
         "  export-orbax  <ckpt.msgpack> <out_dir>: framework checkpoint\n"
         "                -> orbax StandardCheckpoint"
     )
@@ -69,6 +134,8 @@ def main(argv=None) -> None:
         _main(rest)
     elif cmd == "info":
         _info()
+    elif cmd == "probe":
+        _probe(rest)
     elif cmd == "export-orbax":
         if len(rest) != 2:
             print(usage, file=sys.stderr)

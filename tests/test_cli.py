@@ -80,3 +80,74 @@ def test_export_orbax_friendly_errors(tmp_path):
                  str(tmp_path / "o")], timeout=60)
     assert proc.returncode == 1
     assert "error:" in proc.stderr and "Traceback" not in proc.stderr
+
+
+def test_probe_subcommand_cpu():
+    """probe: bounded accelerator health check. On the CPU test platform it
+    reports an executed computation and exits 1 (no accelerator)."""
+    import json
+
+    proc = _run(["probe", "--timeout", "90"])
+    assert proc.returncode == 1, proc.stderr
+    res = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert res["platform"] == "cpu" and res["executed"] is True
+
+
+def test_probe_times_out_on_wedged_backend():
+    """A backend that hangs at init must yield exit 124 within the bound,
+    not a hung shell (the failure mode bench.py's probe exists for)."""
+    import json
+    import subprocess
+
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"})
+    # Wedge the child deterministically: a sitecustomize that sleeps at
+    # interpreter start stands in for a dead tunnel claim.
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        with open(os.path.join(d, "sitecustomize.py"), "w") as f:
+            # Sleep only in `python -c` children (the probe's worker), not
+            # in the `-m` CLI parent that shares this PYTHONPATH.
+            f.write(
+                "import sys, time\n"
+                "if sys.argv and sys.argv[0] == '-c':\n"
+                "    time.sleep(120)\n"
+            )
+        env["PYTHONPATH"] = d + os.pathsep + REPO
+        proc = subprocess.run(
+            [sys.executable, "-m", "distributed_machine_learning_tpu",
+             "probe", "--timeout", "5"],
+            env=env, capture_output=True, text=True, timeout=120,
+        )
+    assert proc.returncode == 124, (proc.stdout, proc.stderr)
+    res = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "hung" in res["error"]
+
+
+def test_probe_crashed_child_is_not_cpu_only():
+    """A crashing probe child exits 2 — distinct from 'healthy CPU-only'
+    (1), so pod-health scripts can't misread a broken env (code review
+    r4)."""
+    import subprocess
+    import tempfile
+
+    env = dict(os.environ)
+    with tempfile.TemporaryDirectory() as d:
+        with open(os.path.join(d, "sitecustomize.py"), "w") as f:
+            # site.py swallows ordinary exceptions from sitecustomize;
+            # os._exit reliably kills the child like a hard crash would.
+            f.write(
+                "import sys, os\n"
+                "if sys.argv and sys.argv[0] == '-c':\n"
+                "    sys.stderr.write('broken backend install\\n')\n"
+                "    os._exit(17)\n"
+            )
+        env["PYTHONPATH"] = d + os.pathsep + REPO
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "-m", "distributed_machine_learning_tpu",
+             "probe", "--timeout", "60"],
+            env=env, capture_output=True, text=True, timeout=120,
+        )
+    assert proc.returncode == 2, (proc.stdout, proc.stderr)
