@@ -43,12 +43,22 @@ def train_steps(mode: str, mesh, x, y, steps: int, args):
         "model": "transformer",
         "d_model": args.d_model,
         "num_heads": args.num_heads,
+        # Grouped-query attention: kv stays at num_kv_heads through the
+        # kernels and around the ring (per-hop payload / group factor).
+        # Ulysses also rides grouped when num_kv_heads divides the sp
+        # split (the default 4 over sp=4 does); otherwise it broadcasts.
+        "num_kv_heads": args.num_kv_heads,
         "num_layers": args.num_layers,
         "dim_feedforward": args.d_model * 2,
         "max_seq_length": args.seq_len,
+        # Rotary positions: relative, no PE-table length cap.
+        "position_encoding": "rope",
         "seq_axis": "sp",
         "seq_parallel_mode": mode,
         "mesh": mesh,
+        "compute_dtype": "bfloat16" if args.bf16 else None,
+        "remat": args.remat,
+        "dropout": 0.0,
     })
     tx = make_optimizer("adamw", learning_rate=1e-3, weight_decay=1e-4)
     init_fn, step_fn = make_sharded_train_step(
@@ -72,6 +82,11 @@ def main(argv=None):
     parser.add_argument("--batch", type=int, default=4)
     parser.add_argument("--d-model", type=int, default=64)
     parser.add_argument("--num-heads", type=int, default=8)
+    parser.add_argument("--num-kv-heads", type=int, default=4)
+    parser.add_argument("--bf16", action="store_true")
+    parser.add_argument("--remat", action="store_true",
+                        help="recompute encoder blocks in the backward "
+                             "(memory for FLOPs — longer contexts fit)")
     parser.add_argument("--num-layers", type=int, default=2)
     parser.add_argument("--dp", type=int, default=2)
     parser.add_argument("--sp", type=int, default=4)
