@@ -777,11 +777,28 @@ def _probe_tpu(log, probe_info, schedule) -> tuple:
 
 
 def _run_tpu_suite(log, phases):
-    """Both-precision sweeps + the flagship measurement, sequentially (ONE
-    tunnel claimant at a time).  Returns (ours, others, flagship, tunnel_ok)
-    — ours=None means every sweep failed."""
-    candidates = []
+    """Flagship measurement + both-precision sweeps, sequentially (ONE
+    tunnel claimant at a time).  The flagship runs FIRST: it is the
+    shortest child and carries the round's MFU evidence, so a tunnel that
+    dies mid-suite forfeits the least.  Returns (ours, others, flagship,
+    tunnel_ok) — ours=None means every sweep failed."""
     tunnel_ok = True
+    log(f"running flagship MXU-bound step measurement: {FLAGSHIP}")
+    t0 = time.time()
+    rc, out, err, exited = _run_child(
+        ["--child", "flagship"], _tpu_env(), 600
+    )
+    phases["flagship_s"] = round(time.time() - t0, 1)
+    flagship = _parse_result(out) if rc == 0 else None
+    if flagship is None:
+        log(f"flagship failed rc={rc}; tail: {err[-500:]}")
+        flagship = {"error": (err or "no output")[-400:]}
+    if not exited:
+        # A wedged child still holds the tunnel; starting another
+        # tunnel-env child would deadlock against it.
+        log("flagship child still running; no more TPU children")
+        return None, [], flagship, False
+    candidates = []
     for dtype in ("float32", "bfloat16"):
         log(f"running sweep on TPU ({dtype}): {FULL}")
         t0 = time.time()
@@ -795,25 +812,9 @@ def _run_tpu_suite(log, phases):
         else:
             log(f"TPU sweep ({dtype}) failed rc={rc}; tail: {err[-500:]}")
         if not exited:
-            # A wedged child still holds the tunnel; starting another
-            # tunnel-env child would deadlock against it.
             log("sweep child still running; no more TPU children")
             tunnel_ok = False
             break
-    flagship = None
-    if tunnel_ok:
-        log(f"running flagship MXU-bound step measurement: {FLAGSHIP}")
-        t0 = time.time()
-        rc, out, err, exited = _run_child(
-            ["--child", "flagship"], _tpu_env(), 600
-        )
-        phases["flagship_s"] = round(time.time() - t0, 1)
-        flagship = _parse_result(out) if rc == 0 else None
-        if flagship is None:
-            log(f"flagship failed rc={rc}; tail: {err[-500:]}")
-            flagship = {"error": (err or "no output")[-400:]}
-        if not exited:
-            tunnel_ok = False
     candidates.sort(key=lambda r: -r["trials_per_hour"])
     ours = candidates[0] if candidates else None
     return ours, candidates[1:], flagship, tunnel_ok
