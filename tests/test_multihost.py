@@ -167,3 +167,9 @@ def test_two_process_distributed_cpu(tmp_path):
         # Global sum over both hosts' shards: host0 contributes 0s, host1
         # contributes eight 1s.
         assert r["total"] == 8.0
+        # The cross-process GSPMD train step ran and learned.
+        assert len(r["train_losses"]) == 3
+        assert r["learns"] is True
+    # SPMD consistency: both processes observed the SAME losses — the
+    # gradient all-reduce crossed the process boundary correctly.
+    assert results[0]["train_losses"] == results[1]["train_losses"]
