@@ -128,6 +128,11 @@ def _run_child(args, env, timeout_s: float):
         return 124, out, err, True
 
 
+def _median(walls):
+    ordered = sorted(walls)
+    return ordered[len(ordered) // 2]
+
+
 def _parse_result(out: str):
     for line in reversed(out.strip().splitlines()):
         line = line.strip()
@@ -171,9 +176,43 @@ def child_ours(scale: dict, compute_dtype: str = "float32") -> None:
     from distributed_machine_learning_tpu import tune
     from distributed_machine_learning_tpu.data import glucose_like_data
 
+    # Phase-progress notes go to stderr so the parent's log shows WHERE a
+    # stalled child stopped (a bare rc=124 with silent stderr is
+    # undiagnosable — the 2026-07-31 tunnel stall taught that the hard way).
+    t_child0 = time.time()
+
+    def note(msg: str) -> None:
+        print(f"[child {time.time() - t_child0:6.1f}s] {msg}",
+              file=sys.stderr, flush=True)
+
+    # Best-effort partial results: after every completed phase the current
+    # result snapshot lands in DML_BENCH_PARTIAL_PATH, so a child killed at
+    # its timeout still delivers the phases that DID finish (the parent
+    # falls back to this file when rc != 0).
+    partial_path = os.environ.get("DML_BENCH_PARTIAL_PATH")
+
+    def checkpoint_partial(snapshot: dict) -> None:
+        if not partial_path:
+            return
+        tmp = partial_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(snapshot, f)
+        os.replace(tmp, partial_path)
+
+    # Time budget (seconds, from the parent = child timeout minus margin):
+    # optional phases (warm repeats, ASHA) are skipped when the projected
+    # cost would overrun it, so the child exits cleanly with what it has
+    # instead of being SIGTERMed mid-phase.
+    budget_s = float(os.environ.get("DML_BENCH_CHILD_BUDGET_S", "0") or 0)
+
+    def remaining_s() -> float:
+        return (budget_s - (time.time() - t_child0)) if budget_s else 1e9
+
+    note(f"generating data (steps={scale['data_steps']})")
     train, val = glucose_like_data(
         num_steps=scale["data_steps"], num_features=FEATURES
     )
+    note(f"data ready: train {train.x.shape}, val {val.x.shape}")
     space = {
         "model": "transformer",
         "d_model": D_MODEL,
@@ -197,6 +236,7 @@ def child_ours(scale: dict, compute_dtype: str = "float32") -> None:
         space["rng_impl"] = rng_impl
 
     def sweep(tag, scheduler=None, epochs_per_dispatch=1):
+        note(f"sweep '{tag}' start (epochs_per_dispatch={epochs_per_dispatch})")
         t0 = time.time()
         analysis = tune.run_vectorized(
             space,
@@ -214,6 +254,7 @@ def child_ours(scale: dict, compute_dtype: str = "float32") -> None:
             epochs_per_dispatch=epochs_per_dispatch,
         )
         wall = time.time() - t0
+        note(f"sweep '{tag}' done in {wall:.1f}s")
         with open(os.path.join(analysis.root, "experiment_state.json")) as f:
             state = json.load(f)
         return analysis, wall, state
@@ -221,29 +262,60 @@ def child_ours(scale: dict, compute_dtype: str = "float32") -> None:
     # FIFO dispatches the whole per-trial budget as ONE scanned program:
     # measured on the chip (2026-07-30), one 20-epoch program beats
     # quarter-sweep chunks cold (33.6s vs 42.2s total — one compile instead
-    # of chunk+remainder programs) and matches them warm.
-    analysis, wall, fifo_state = sweep(
-        "fifo", epochs_per_dispatch=scale["num_epochs"]
-    )
+    # of chunk+remainder programs) and matches them warm.  On a degraded
+    # tunnel the big program's compile can stall past the child timeout;
+    # DML_BENCH_EPD overrides the dispatch size (smaller programs, partial
+    # progress) without editing the file.
+    epd = int(os.environ.get("DML_BENCH_EPD") or scale["num_epochs"])
+    analysis, wall, fifo_state = sweep("fifo", epochs_per_dispatch=epd)
     done = analysis.num_terminated()
     steps_per_epoch = len(train.x) // BATCH
     flops = sweep_total_flops(
         done, scale["num_epochs"], steps_per_epoch, len(val.x)
     )
+    import jax
+
+    from distributed_machine_learning_tpu.ops.flops import device_peak_flops
+
+    partial = {
+        "trials_per_hour": done * 3600.0 / wall,
+        "wall_s": wall, "cold_wall_s": wall,
+        "trials_per_hour_cold": done * 3600.0 / wall,
+        "compile_s": fifo_state.get("compile_time_total_s"),
+        "device_utilization": fifo_state.get("device_utilization"),
+        "done": done, "flops": flops, "compute_dtype": compute_dtype,
+        "best_mape": float(analysis.best_result.get("validation_mape", -1)),
+        # platform/peak travel WITH the partial: a recovered bf16 result
+        # must not have its MFU computed against the f32 fallback peak.
+        "platform": jax.devices()[0].platform,
+        "peak_flops": device_peak_flops(
+            jax.devices()[0], compute_dtype=compute_dtype
+        ),
+        "partial": True,
+    }
+    checkpoint_partial(partial)
     # Warm repeats: same sweep re-run in this process (compile cache hot).
     # Headline = median warm wall; cold wall + spread recorded alongside.
     cold_state = fifo_state
     warm_walls = []
     for i in range(int(scale.get("warm_repeats", 0))):
+        if remaining_s() < 1.5 * wall:
+            note(f"skipping warm repeats {i}.. (remaining {remaining_s():.0f}s"
+                 f" < 1.5x cold wall {wall:.0f}s)")
+            partial["warm_skipped_after"] = i
+            break
         _, w_wall, fifo_state = sweep(
-            f"fifo_warm{i}", epochs_per_dispatch=scale["num_epochs"]
+            f"fifo_warm{i}", epochs_per_dispatch=epd
         )
         warm_walls.append(w_wall)
-    if warm_walls:
-        ordered = sorted(warm_walls)
-        headline_wall = ordered[len(ordered) // 2]
-    else:
-        headline_wall = wall
+        med = _median(warm_walls)
+        partial.update({
+            "wall_s": med, "trials_per_hour": done * 3600.0 / med,
+            "warm_walls_s": [round(w, 2) for w in warm_walls],
+            "device_utilization": fifo_state.get("device_utilization"),
+        })
+        checkpoint_partial(partial)
+    headline_wall = _median(warm_walls) if warm_walls else wall
     result = {
         "trials_per_hour": done * 3600.0 / headline_wall,
         "wall_s": headline_wall,
@@ -264,10 +336,21 @@ def child_ours(scale: dict, compute_dtype: str = "float32") -> None:
         "flops": flops,
         "best_mape": float(analysis.best_result.get("validation_mape", -1)),
     }
+    if "warm_skipped_after" in partial:
+        result["warm_skipped_after"] = partial["warm_skipped_after"]
+    if epd != scale["num_epochs"]:
+        result["epochs_per_dispatch"] = epd
+
+    checkpoint_partial(dict(result, partial=True))
 
     # Same budget under ASHA: early stopping + population compaction should
     # finish the sweep in less wall-clock (fewer total epochs executed).
     try:
+        if remaining_s() < 1.5 * wall:
+            raise RuntimeError(
+                f"skipped: deadline (remaining {remaining_s():.0f}s "
+                f"< 1.5x cold wall {wall:.0f}s)"
+            )
         grace = max(1, scale["num_epochs"] // 4)
         asha = tune.ASHAScheduler(
             max_t=scale["num_epochs"],
@@ -802,11 +885,30 @@ def _run_tpu_suite(log, phases):
     for dtype in ("float32", "bfloat16"):
         log(f"running sweep on TPU ({dtype}): {FULL}")
         t0 = time.time()
+        timeout_s = 900
+        partial_path = f"/tmp/bench_partial_{dtype}_{os.getpid()}.json"
+        try:  # a stale file from a previous run must not masquerade as
+            os.unlink(partial_path)  # this run's recovered result
+        except OSError:
+            pass
+        env = dict(_tpu_env(),
+                   DML_BENCH_PARTIAL_PATH=partial_path,
+                   DML_BENCH_CHILD_BUDGET_S=str(timeout_s - 60))
         rc, out, err, exited = _run_child(
-            ["--child", "ours", "full", dtype], _tpu_env(), 900
+            ["--child", "ours", "full", dtype], env, timeout_s
         )
         phases[f"tpu_sweep_{dtype}_s"] = round(time.time() - t0, 1)
         res = _parse_result(out) if rc == 0 else None
+        if res is None and os.path.exists(partial_path):
+            # The child died mid-suite; use the phases that DID complete
+            # (marked partial=true) rather than forfeiting the TPU number.
+            try:
+                with open(partial_path) as f:
+                    res = json.load(f)
+                log(f"TPU sweep ({dtype}) rc={rc}; recovered partial result "
+                    f"({res.get('wall_s', '?')}s wall)")
+            except (OSError, json.JSONDecodeError):
+                res = None
         if res is not None:
             candidates.append(res)
         else:
@@ -936,6 +1038,11 @@ def main() -> None:
         "phases": phases,
         "total_s": round(time.time() - t_start, 1),
     }
+    # Honesty flags: a recovered-partial or repeat-skipping run must be
+    # distinguishable from a full suite in the ONE emitted line.
+    for flag in ("partial", "warm_skipped_after", "epochs_per_dispatch"):
+        if flag in ours:
+            extra[flag] = ours[flag]
     if flagship is not None:
         extra["flagship"] = flagship
     for other in others:

@@ -163,6 +163,45 @@ def test_main_tpu_path_includes_flagship(monkeypatch, capsys):
     assert "cpu_note" not in line
 
 
+def test_tpu_suite_recovers_partial_sweep(monkeypatch):
+    """A sweep child killed at its timeout (rc=124, no stdout JSON) still
+    contributes the phases it completed: the parent reads the partial-result
+    file the child checkpoints after every phase (2026-07-31 tunnel stall)."""
+    def fake_run_child(args, env, timeout_s):
+        if args == ["--child", "flagship"]:
+            return 0, json.dumps({"step_s": 0.03, "mfu": 0.4}), "", True
+        if args[:2] == ["--child", "ours"]:
+            # Child "dies" at its timeout — but it checkpointed a partial
+            # result (cold sweep done, warm repeats lost) before the kill.
+            assert env["DML_BENCH_CHILD_BUDGET_S"] == "840"
+            if args[3] == "float32":
+                with open(env["DML_BENCH_PARTIAL_PATH"], "w") as f:
+                    json.dump({
+                        "trials_per_hour": 4000.0, "wall_s": 45.0,
+                        "cold_wall_s": 45.0, "done": 50, "flops": 5e15,
+                        "best_mape": 10.0, "compute_dtype": "float32",
+                        "partial": True,
+                    }, f)
+            return 124, "", "SIGTERMed", True
+        raise AssertionError(f"unexpected child {args}")
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    try:
+        ours, others, flagship, tunnel_ok = bench._run_tpu_suite(
+            lambda m: None, {}
+        )
+    finally:
+        for dtype in ("float32", "bfloat16"):
+            path = f"/tmp/bench_partial_{dtype}_{os.getpid()}.json"
+            if os.path.exists(path):
+                os.unlink(path)
+    assert tunnel_ok is True
+    assert ours is not None and ours["partial"] is True
+    assert ours["trials_per_hour"] == 4000.0  # recovered, not forfeited
+    assert others == []  # bf16 child had no partial file -> dropped
+    assert flagship["mfu"] == 0.4
+
+
 def test_main_late_reprobe_recovers_tpu(monkeypatch, capsys):
     """First probe window fails, CPU fallback runs, the LATE re-probe
     succeeds -> the TPU suite still runs and headlines the round."""
