@@ -202,6 +202,43 @@ def test_tpu_suite_recovers_partial_sweep(monkeypatch):
     assert flagship["mfu"] == 0.4
 
 
+def test_tpu_suite_chunked_retry_after_empty_failure(monkeypatch):
+    """A sweep child that produces NOTHING (no stdout, no partial — the
+    whole-budget program never finished its cold sweep) is retried once
+    with chunked dispatch; once chunked gets through, the other dtype goes
+    straight to chunked mode."""
+    calls = []
+
+    def fake_run_child(args, env, timeout_s):
+        if args == ["--child", "flagship"]:
+            return 0, json.dumps({"step_s": 0.03, "mfu": 0.4}), "", True
+        if args[:2] == ["--child", "ours"]:
+            calls.append((args[3], env.get("DML_BENCH_EPD")))
+            if env.get("DML_BENCH_EPD") == "5":  # chunked gets through
+                return 0, json.dumps({
+                    "trials_per_hour": 3000.0, "wall_s": 60.0, "done": 50,
+                    "flops": 5e15, "best_mape": 11.0,
+                    "compute_dtype": args[3], "epochs_per_dispatch": 5,
+                }), "", True
+            return 124, "", "stalled", True  # whole-budget never finishes
+        raise AssertionError(f"unexpected child {args}")
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    phases = {}
+    ours, others, flagship, tunnel_ok = bench._run_tpu_suite(
+        lambda m: None, phases
+    )
+    assert tunnel_ok is True
+    assert calls == [
+        ("float32", None),   # whole-budget stalls
+        ("float32", "5"),    # chunked retry succeeds
+        ("bfloat16", "5"),   # bf16 skips straight to chunked
+    ]
+    assert ours is not None and ours["trials_per_hour"] == 3000.0
+    assert len(others) == 1  # both dtypes landed via chunked dispatch
+    assert "tpu_sweep_float32_chunked_s" in phases
+
+
 def test_main_late_reprobe_recovers_tpu(monkeypatch, capsys):
     """First probe window fails, CPU fallback runs, the LATE re-probe
     succeeds -> the TPU suite still runs and headlines the round."""
