@@ -173,6 +173,10 @@ def sweep_total_flops(num_trials: int, num_epochs: int, steps_per_epoch: int,
 
 
 def child_ours(scale: dict, compute_dtype: str = "float32") -> None:
+    # Runner-internal phase narration (trace/compile/execute boundaries) on
+    # stderr — the stall forensics the 2026-07-31 tunnel day lacked.
+    os.environ.setdefault("DML_TUNE_PROGRESS", "1")
+
     from distributed_machine_learning_tpu import tune
     from distributed_machine_learning_tpu.data import glucose_like_data
 
@@ -931,9 +935,30 @@ def _run_tpu_suite(log, phases):
         if res is None and exited and not chunked_mode:
             hard_fails += 1
             # The whole-budget program never finished its cold sweep
-            # (2026-07-31 stall mode). Retry once with quarter-budget
-            # dispatch programs: ~4x smaller compile, reused 4x, and the
-            # partial file catches whatever completes.
+            # (2026-07-31 stall mode). Before retrying, a cheap probe
+            # distinguishes "big program stalls" from "tunnel wedged
+            # post-SIGTERM" (the same postmortem records the backend
+            # ignoring even jax.devices() for a while after a child is
+            # killed) — retrying against a wedged tunnel burns 15 min
+            # and falsely discredits chunked dispatch.
+            rc_p, _, _, p_exited = _run_child(
+                ["--child", "probe"], _tpu_env(), 120
+            )
+            if not p_exited:
+                log("post-stall probe wedged; no more TPU children")
+                tunnel_ok = False
+                break
+            if rc_p != 0:
+                log("tunnel unresponsive after stalled sweep; "
+                    "skipping chunked retry")
+                phases[f"tpu_sweep_{dtype}_retry_skipped"] = (
+                    "post-stall probe failed"
+                )
+                hard_fails += 1
+                continue
+            # Retry once with quarter-budget dispatch programs: ~4x
+            # smaller compile, reused 4x, and the partial file catches
+            # whatever completes.
             log(f"retrying {dtype} sweep chunked (DML_BENCH_EPD=5)")
             res, exited = run_sweep_child(
                 dtype, extra_env={"DML_BENCH_EPD": "5"}

@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple, Union
@@ -837,6 +838,20 @@ def _emit_epoch_records(
             safe_cb("on_trial_complete", trial)
 
 
+def _progress_note(msg: str) -> None:
+    """Stderr heartbeat, on when ``DML_TUNE_PROGRESS`` is set (bench
+    children set it). jit work is silent from the host side — on a remote
+    backend a stalled trace/compile/execute is indistinguishable from a
+    dead tunnel without these boundary notes (2026-07-31 stall: a sweep
+    died at its timeout with no way to tell WHICH phase hung)."""
+    if (os.environ.get("DML_TUNE_PROGRESS") or "0") != "0":
+        print(f"[tune.progress +{time.monotonic() - _PROGRESS_T0:.1f}s] {msg}",
+              file=sys.stderr, flush=True)
+
+
+_PROGRESS_T0 = time.monotonic()
+
+
 def _run_population(
     program: _GroupProgram,
     batch: List[Trial],
@@ -947,9 +962,13 @@ def _run_population(
         base_keys = jax.vmap(
             lambda s: jax.random.key(s, impl=rng_impl)
         )(jnp.asarray(seeds))
+        _progress_note(
+            f"init_population rows={len(seeds)} (trace+compile on first use)"
+        )
         params, opt_state, batch_stats = program.init_population(
             base_keys, jnp.asarray(lrs), jnp.asarray(wds)
         )
+        _progress_note("init_population returned")
         active = [True] * k
         # ``rows[i]`` = index into ``batch`` of the trial living at
         # population row i (-1 for dummy pad rows, which are never
@@ -1037,6 +1056,10 @@ def _run_population(
     epoch0 = epoch_start
     while epoch0 < program.num_epochs:
         chunk = min(dispatch, program.num_epochs - epoch0)
+        _progress_note(
+            f"dispatch epochs {epoch0}..{epoch0 + chunk} over "
+            f"{len(rows)} rows (first dispatch of a shape traces+compiles)"
+        )
         c0 = tracker.thread_seconds()
         t0 = time.time()
         if chunk == 1:
@@ -1069,6 +1092,10 @@ def _run_population(
         # synced everything).
         compile_delta = tracker.thread_seconds() - c0
         exec_s = max(time.time() - t0 - compile_delta, 0.0)
+        _progress_note(
+            f"dispatch synced: {exec_s:.1f}s execute + "
+            f"{compile_delta:.1f}s compile"
+        )
         if compile_delta > 0.05:
             compile_cost_s = compile_delta
         per_epoch_exec = exec_s / chunk

@@ -170,6 +170,8 @@ def test_tpu_suite_recovers_partial_sweep(monkeypatch):
     def fake_run_child(args, env, timeout_s):
         if args == ["--child", "flagship"]:
             return 0, json.dumps({"step_s": 0.03, "mfu": 0.4}), "", True
+        if args == ["--child", "probe"]:
+            return 0, "probe OK: 1 x tpu", "", True  # post-stall probe
         if args[:2] == ["--child", "ours"]:
             # Child "dies" at its timeout — but it checkpointed a partial
             # result (cold sweep done, warm repeats lost) before the kill.
@@ -212,6 +214,9 @@ def test_tpu_suite_chunked_retry_after_empty_failure(monkeypatch):
     def fake_run_child(args, env, timeout_s):
         if args == ["--child", "flagship"]:
             return 0, json.dumps({"step_s": 0.03, "mfu": 0.4}), "", True
+        if args == ["--child", "probe"]:
+            calls.append(("probe", None))
+            return 0, "probe OK: 1 x tpu", "", True  # post-stall probe
         if args[:2] == ["--child", "ours"]:
             calls.append((args[3], env.get("DML_BENCH_EPD")))
             if env.get("DML_BENCH_EPD") == "5":  # chunked gets through
@@ -231,12 +236,75 @@ def test_tpu_suite_chunked_retry_after_empty_failure(monkeypatch):
     assert tunnel_ok is True
     assert calls == [
         ("float32", None),   # whole-budget stalls
+        ("probe", None),     # post-stall probe: tunnel alive
         ("float32", "5"),    # chunked retry succeeds
         ("bfloat16", "5"),   # bf16 skips straight to chunked
     ]
     assert ours is not None and ours["trials_per_hour"] == 3000.0
     assert len(others) == 1  # both dtypes landed via chunked dispatch
     assert "tpu_sweep_float32_chunked_s" in phases
+
+
+def test_tpu_suite_two_empty_failures_skip_remaining(monkeypatch):
+    """Whole-budget AND chunked-retry children both produce nothing ->
+    the bfloat16 sweep is skipped entirely (bounded bench wall on a dead
+    tunnel) with the skip recorded in phases; the flagship still stands."""
+    calls = []
+
+    def fake_run_child(args, env, timeout_s):
+        if args == ["--child", "flagship"]:
+            return 0, json.dumps({"step_s": 0.03, "mfu": 0.4}), "", True
+        if args == ["--child", "probe"]:
+            calls.append(("probe", None))
+            return 0, "probe OK: 1 x tpu", "", True  # tunnel answers...
+        if args[:2] == ["--child", "ours"]:
+            calls.append((args[3], env.get("DML_BENCH_EPD")))
+            return 124, "", "stalled", True  # ...but sweeps never finish
+        raise AssertionError(f"unexpected child {args}")
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    phases = {}
+    ours, others, flagship, tunnel_ok = bench._run_tpu_suite(
+        lambda m: None, phases
+    )
+    assert calls == [
+        ("float32", None),   # whole-budget stalls empty
+        ("probe", None),     # post-stall probe says tunnel is alive
+        ("float32", "5"),    # chunked retry also stalls empty
+    ]                        # bfloat16 never launched
+    assert ours is None and others == []
+    assert phases["tpu_sweep_bfloat16_skipped"] == "tunnel not moving sweeps"
+    assert flagship["mfu"] == 0.4 and tunnel_ok is True
+
+
+def test_tpu_suite_skips_retry_when_tunnel_wedged(monkeypatch):
+    """If the post-stall probe fails, the chunked retry is NOT burned
+    against a wedged tunnel; both its skip and the bfloat16 skip land in
+    phases."""
+    calls = []
+
+    def fake_run_child(args, env, timeout_s):
+        if args == ["--child", "flagship"]:
+            return 0, json.dumps({"step_s": 0.03, "mfu": 0.4}), "", True
+        if args == ["--child", "probe"]:
+            calls.append("probe")
+            return 124, "", "hung", True  # post-SIGTERM wedge
+        if args[:2] == ["--child", "ours"]:
+            calls.append(args[3])
+            return 124, "", "stalled", True
+        raise AssertionError(f"unexpected child {args}")
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    phases = {}
+    ours, others, flagship, tunnel_ok = bench._run_tpu_suite(
+        lambda m: None, phases
+    )
+    assert calls == ["float32", "probe"]  # no retry, no bfloat16
+    assert ours is None
+    assert phases["tpu_sweep_float32_retry_skipped"] == (
+        "post-stall probe failed"
+    )
+    assert phases["tpu_sweep_bfloat16_skipped"] == "tunnel not moving sweeps"
 
 
 def test_main_late_reprobe_recovers_tpu(monkeypatch, capsys):
