@@ -98,6 +98,28 @@ def _tpu_env() -> dict:
     return env
 
 
+# The claim-release race is a property of the SHARED tunnel, not of one
+# bench.py process: capture sessions run several tools back-to-back, so
+# the last-release stamp lives in a file every claimant process sees.
+_TUNNEL_STAMP = "/tmp/dml_tunnel_last_release"
+
+
+def _last_tunnel_release() -> float:
+    try:
+        with open(_TUNNEL_STAMP) as f:
+            return float(f.read().strip() or 0.0)
+    except (OSError, ValueError):
+        return 0.0
+
+
+def _stamp_tunnel_release() -> None:
+    try:
+        with open(_TUNNEL_STAMP, "w") as f:
+            f.write(repr(time.time()))
+    except OSError:
+        pass
+
+
 def _run_child(args, env, timeout_s: float):
     """Run a child; returns (rc, out, err, exited).
 
@@ -106,26 +128,43 @@ def _run_child(args, env, timeout_s: float):
     ``exited=False`` means the child survived both signals and is STILL
     RUNNING (still holding the tunnel if it claimed it); the caller must not
     start another tunnel-env child while that is the case — two concurrent
-    claimants deadlock."""
-    proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__)] + args,
-        env=env, cwd=_REPO_ROOT,
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-    )
+    claimants deadlock.
+
+    Consecutive tunnel-env children are separated by INTER_CHILD_GAP_S
+    (tracked in a cross-process stamp file): the far side releases a dead
+    child's claim with some lag, and a claim started against a still-held
+    grant can wedge permanently (2026-07-31)."""
+    is_tunnel = ".axon_site" in (env.get("PYTHONPATH") or "")
+    if is_tunnel:
+        last = _last_tunnel_release()
+        gap = INTER_CHILD_GAP_S - (time.time() - last)
+        if last and gap > 0:
+            time.sleep(gap)
     try:
-        out, err = proc.communicate(timeout=timeout_s)
-        return proc.returncode, out, err, True
-    except subprocess.TimeoutExpired:
-        proc.send_signal(signal.SIGTERM)
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)] + args,
+            env=env, cwd=_REPO_ROOT,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
         try:
-            out, err = proc.communicate(timeout=30)
+            out, err = proc.communicate(timeout=timeout_s)
+            return proc.returncode, out, err, True
         except subprocess.TimeoutExpired:
-            proc.send_signal(signal.SIGINT)
+            proc.send_signal(signal.SIGTERM)
             try:
                 out, err = proc.communicate(timeout=30)
             except subprocess.TimeoutExpired:
-                return 124, "", "child survived SIGTERM+SIGINT; left running", False
-        return 124, out, err, True
+                proc.send_signal(signal.SIGINT)
+                try:
+                    out, err = proc.communicate(timeout=30)
+                except subprocess.TimeoutExpired:
+                    return (124, "",
+                            "child survived SIGTERM+SIGINT; left running",
+                            False)
+            return 124, out, err, True
+    finally:
+        if is_tunnel:
+            _stamp_tunnel_release()
 
 
 def _median(walls):
@@ -823,6 +862,13 @@ def emit(value: float, vs_baseline, backend: str, extra: dict) -> None:
 # round's TPU number; plus one LATE re-probe after the CPU fallback runs.
 PROBE_SCHEDULE = ((120, 0), (120, 30), (180, 60))
 LATE_PROBE_TIMEOUT = 180
+# Gap between consecutive tunnel-claiming children: the far side releases
+# a dead child's claim with some lag, and a claim that starts against a
+# still-held grant can wedge permanently (2026-07-31: probe+flagship ran
+# clean, then the sweep child hung at backend init ~60s after the
+# flagship exited, and stayed hung). 15s of idle per child is cheap
+# against a 900s timeout burned on a wedged claim.
+INTER_CHILD_GAP_S = 15.0
 
 
 def _probe_tpu(log, probe_info, schedule) -> tuple:
@@ -930,7 +976,7 @@ def _run_tpu_suite(log, phases):
         log(f"running sweep on TPU ({dtype}): {FULL}"
             + (" [chunked]" if chunked_mode else ""))
         res, exited = run_sweep_child(
-            dtype, extra_env={"DML_BENCH_EPD": "5"} if chunked_mode else None
+            dtype, extra_env={"DML_BENCH_EPD": "1"} if chunked_mode else None
         )
         if res is None and exited and not chunked_mode:
             hard_fails += 1
@@ -956,12 +1002,17 @@ def _run_tpu_suite(log, phases):
                 )
                 hard_fails += 1
                 continue
-            # Retry once with quarter-budget dispatch programs: ~4x
-            # smaller compile, reused 4x, and the partial file catches
-            # whatever completes.
-            log(f"retrying {dtype} sweep chunked (DML_BENCH_EPD=5)")
+            # Retry once with PER-EPOCH dispatch: 2026-07-31 forensics
+            # (the cached 10MB jit_run_epochs executable, compiled one
+            # minute into a child that then hung 14 more) showed the
+            # whole-budget program compiles fine but its single long
+            # device call never returns on a degraded tunnel, while
+            # short dispatches (probe, flagship steps) keep working.
+            # Per-epoch dispatch is 40 short calls instead of one long
+            # one, and the partial file catches whatever completes.
+            log(f"retrying {dtype} sweep chunked (DML_BENCH_EPD=1)")
             res, exited = run_sweep_child(
-                dtype, extra_env={"DML_BENCH_EPD": "5"}
+                dtype, extra_env={"DML_BENCH_EPD": "1"}
             )
             if res is not None:
                 chunked_mode = True  # bf16 goes straight to chunked

@@ -24,6 +24,10 @@ run() {
   rc=$?
   tail -3 "$out/$name.log" | tee -a "$out/summary.txt"
   echo "--- $name rc=$rc" | tee -a "$out/summary.txt"
+  # Give the far side time to release the previous claimant's grant
+  # before the next step claims (claims raced against a lagging release
+  # can wedge — 2026-07-31 postmortem in ../benchmarks/RESULTS.md).
+  sleep 15
 }
 
 # Headline bench first (the driver artifact path): probes, both-dtype

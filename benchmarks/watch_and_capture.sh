@@ -17,11 +17,16 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
   echo "[watch] probe at $(date +%H:%M:%S)"
   # probe exits 0 only when an accelerator executed a computation. The
   # outer bound must exceed the probe's own worst case (80s child timeout
-  # + 15s SIGTERM + 15s SIGINT grace) or we'd kill the probe mid-
-  # escalation and orphan a tunnel-holding grandchild.
-  if timeout --signal=TERM 130 python -m distributed_machine_learning_tpu \
+  # + 15s SIGTERM + 15s SIGINT grace, PLUS cold package import before the
+  # probe even starts) or we'd kill the probe mid-escalation and orphan a
+  # tunnel-holding grandchild.
+  if timeout --signal=TERM 180 python -m distributed_machine_learning_tpu \
       probe --timeout 80 >/dev/null 2>&1; then
     echo "[watch] tunnel is back at $(date +%H:%M:%S); starting capture"
+    # Let the far side release the probe's claim before the capture's
+    # first child claims (a claim raced against a lagging release can
+    # wedge — the very failure this script exists to recover from).
+    sleep 15
     exec bash benchmarks/run_all_tpu.sh
   fi
   sleep 150

@@ -219,11 +219,11 @@ def test_tpu_suite_chunked_retry_after_empty_failure(monkeypatch):
             return 0, "probe OK: 1 x tpu", "", True  # post-stall probe
         if args[:2] == ["--child", "ours"]:
             calls.append((args[3], env.get("DML_BENCH_EPD")))
-            if env.get("DML_BENCH_EPD") == "5":  # chunked gets through
+            if env.get("DML_BENCH_EPD") == "1":  # chunked gets through
                 return 0, json.dumps({
                     "trials_per_hour": 3000.0, "wall_s": 60.0, "done": 50,
                     "flops": 5e15, "best_mape": 11.0,
-                    "compute_dtype": args[3], "epochs_per_dispatch": 5,
+                    "compute_dtype": args[3], "epochs_per_dispatch": 1,
                 }), "", True
             return 124, "", "stalled", True  # whole-budget never finishes
         raise AssertionError(f"unexpected child {args}")
@@ -237,8 +237,8 @@ def test_tpu_suite_chunked_retry_after_empty_failure(monkeypatch):
     assert calls == [
         ("float32", None),   # whole-budget stalls
         ("probe", None),     # post-stall probe: tunnel alive
-        ("float32", "5"),    # chunked retry succeeds
-        ("bfloat16", "5"),   # bf16 skips straight to chunked
+        ("float32", "1"),    # chunked retry succeeds
+        ("bfloat16", "1"),   # bf16 skips straight to chunked
     ]
     assert ours is not None and ours["trials_per_hour"] == 3000.0
     assert len(others) == 1  # both dtypes landed via chunked dispatch
@@ -270,7 +270,7 @@ def test_tpu_suite_two_empty_failures_skip_remaining(monkeypatch):
     assert calls == [
         ("float32", None),   # whole-budget stalls empty
         ("probe", None),     # post-stall probe says tunnel is alive
-        ("float32", "5"),    # chunked retry also stalls empty
+        ("float32", "1"),    # chunked retry also stalls empty
     ]                        # bfloat16 never launched
     assert ours is None and others == []
     assert phases["tpu_sweep_bfloat16_skipped"] == "tunnel not moving sweeps"
@@ -305,6 +305,33 @@ def test_tpu_suite_skips_retry_when_tunnel_wedged(monkeypatch):
         "post-stall probe failed"
     )
     assert phases["tpu_sweep_bfloat16_skipped"] == "tunnel not moving sweeps"
+
+
+def test_tpu_suite_zombie_post_stall_probe_stops_suite(monkeypatch):
+    """A post-stall probe whose child survives the signals (exited=False)
+    means a zombie still holds the tunnel: no retry, no bfloat16, and
+    tunnel_ok=False so main() won't launch further tunnel children."""
+    calls = []
+
+    def fake_run_child(args, env, timeout_s):
+        if args == ["--child", "flagship"]:
+            return 0, json.dumps({"step_s": 0.03, "mfu": 0.4}), "", True
+        if args == ["--child", "probe"]:
+            calls.append("probe")
+            return 124, "", "still running", False  # zombie claimant
+        if args[:2] == ["--child", "ours"]:
+            calls.append(args[3])
+            return 124, "", "stalled", True
+        raise AssertionError(f"unexpected child {args}")
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    ours, others, flagship, tunnel_ok = bench._run_tpu_suite(
+        lambda m: None, {}
+    )
+    assert calls == ["float32", "probe"]  # nothing launched past the zombie
+    assert ours is None and others == []
+    assert tunnel_ok is False
+    assert flagship["mfu"] == 0.4
 
 
 def test_main_late_reprobe_recovers_tpu(monkeypatch, capsys):
