@@ -307,6 +307,30 @@ def test_tpu_suite_skips_retry_when_tunnel_wedged(monkeypatch):
     assert phases["tpu_sweep_bfloat16_skipped"] == "tunnel not moving sweeps"
 
 
+def test_tpu_suite_recovers_flagship_printed_before_timeout(monkeypatch):
+    """The flagship child prints its MHA result before attempting the GQA
+    variant; if the variant hangs the child to rc=124, the parent still
+    recovers the printed result and marks it partial."""
+    def fake_run_child(args, env, timeout_s):
+        if args == ["--child", "flagship"]:
+            return 124, json.dumps({"step_s": 0.03, "mfu": 0.41}) + "\n", \
+                "gqa variant hung", True
+        if args[:2] == ["--child", "ours"]:
+            return 0, json.dumps({
+                "trials_per_hour": 9000.0, "wall_s": 20.0, "done": 50,
+                "flops": 5e15, "best_mape": 9.0, "platform": "tpu",
+                "compute_dtype": args[3], "peak_flops": 9.85e13,
+            }), "", True
+        raise AssertionError(f"unexpected child {args}")
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    ours, others, flagship, tunnel_ok = bench._run_tpu_suite(
+        lambda m: None, {}
+    )
+    assert flagship["mfu"] == 0.41 and flagship["partial"] is True
+    assert ours is not None and tunnel_ok is True
+
+
 def test_tpu_suite_zombie_post_stall_probe_stops_suite(monkeypatch):
     """A post-stall probe whose child survives the signals (exited=False)
     means a zombie still holds the tunnel: no retry, no bfloat16, and
