@@ -20,6 +20,7 @@ from distributed_machine_learning_tpu.tune.callbacks import (
     JsonlCallback,
     LoggerCallback,
     ProfilerCallback,
+    ProgressReporter,
     TensorBoardCallback,
 )
 from distributed_machine_learning_tpu.tune.experiment import (
@@ -113,6 +114,7 @@ __all__ = [
     "LoggerCallback",
     "JsonlCallback",
     "ProfilerCallback",
+    "ProgressReporter",
     "TensorBoardCallback",
     "Resources",
     "Trial",

@@ -218,6 +218,184 @@ class TensorBoardCallback(Callback):
         self._writers.clear()
 
 
+class ProgressReporter(Callback):
+    """Live console status table — parity with Ray Tune's ``CLIReporter``.
+
+    The reference's only live feedback was Ray's built-in trial table; the
+    runner's ``verbose`` one-liner carries counts but no per-trial state.
+    This callback renders, at most every ``interval_s`` seconds and only when
+    something changed, a compact table of running trials (iteration, latest
+    metric, runtime) plus status counts, the best value so far, and measured
+    throughput (terminated trials/hour — the BASELINE.md metric, computed the
+    same way ``bench.py`` reports it).  A final summary with the best trial's
+    config always prints at experiment end.
+
+    Pass ``file`` to redirect (e.g. a log file); default is stdout, matching
+    the runner's own ``[tune]`` lines.
+    """
+
+    def __init__(self, interval_s: float = 15.0, max_rows: int = 12,
+                 file=None):
+        self._interval_s = interval_s
+        self._max_rows = max_rows
+        self._file = file
+        self._trials: Dict[str, Trial] = {}
+        self._best_value: Optional[float] = None
+        self._best_trial_id: Optional[str] = None
+        self._last_print = 0.0
+        self._dirty = False
+        self._start = time.time()
+
+    def setup(self, experiment_root: str, metric: str, mode: str):
+        self._metric = metric
+        self._mode = mode
+        # Full reset: a reporter reused across tune.run calls must not carry
+        # the previous experiment's trials/best into the new run's output.
+        self._trials = {}
+        self._best_value = None
+        self._best_trial_id = None
+        self._dirty = False
+        self._start = time.time()
+        self._last_print = 0.0  # first event after setup prints immediately
+
+    # -- event tracking ----------------------------------------------------
+
+    def _touch(self, trial: Trial):
+        self._trials[trial.trial_id] = trial
+        self._dirty = True
+
+    def on_trial_start(self, trial: Trial):
+        self._touch(trial)
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]):
+        self._touch(trial)
+        val = result.get(self._metric)
+        if (isinstance(val, (int, float)) and not isinstance(val, bool)
+                and val == val):  # NaN (diverged trial) never becomes best
+            better = (
+                self._best_value is None
+                or (self._mode == "min" and val < self._best_value)
+                or (self._mode == "max" and val > self._best_value)
+            )
+            if better:
+                self._best_value = float(val)
+                self._best_trial_id = trial.trial_id
+        self._maybe_render()
+
+    def on_trial_complete(self, trial: Trial):
+        self._touch(trial)
+        self._maybe_render()
+
+    def on_trial_error(self, trial: Trial, error: str):
+        self._touch(trial)
+        self._maybe_render()
+
+    def on_heartbeat(self):
+        # Time-based refresh so runtime columns advance on quiet sweeps:
+        # running trials make the table inherently dirty (their time_s
+        # column is live), so render on interval whenever any trial runs.
+        if any(t.status.value == "RUNNING" for t in self._trials.values()):
+            self._dirty = True
+        self._maybe_render()
+
+    def on_experiment_end(self, trials: List[Trial], wall_clock_s: float):
+        for t in trials:
+            self._trials[t.trial_id] = t
+        self._render(final=True, wall_clock_s=wall_clock_s)
+
+    # -- rendering ---------------------------------------------------------
+
+    def _numeric_history(self, trial: Trial) -> List[float]:
+        """The trial's plottable metric values: numbers only (a trainable
+        may report None/strings — TensorBoardCallback guards the same way),
+        NaN dropped (a diverged epoch must not rank or display)."""
+        return [
+            v for v in trial.metric_history(self._metric)
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+            and v == v
+        ]
+
+    def _maybe_render(self):
+        if self._dirty and time.time() - self._last_print >= self._interval_s:
+            self._render()
+
+    def _render(self, final: bool = False, wall_clock_s: float = None):
+        import sys
+
+        self._last_print = time.time()
+        self._dirty = False
+        out = self._file or sys.stdout
+        trials = list(self._trials.values())
+        counts: Dict[str, int] = {}
+        for t in trials:
+            counts[t.status.value] = counts.get(t.status.value, 0) + 1
+        elapsed = wall_clock_s if wall_clock_s is not None else (
+            time.time() - self._start
+        )
+        done = counts.get("TERMINATED", 0)
+        tph = done / (elapsed / 3600.0) if elapsed > 0 and done else 0.0
+        status = ", ".join(f"{k}: {v}" for k, v in sorted(counts.items()))
+        lines = [
+            f"== {'Final result' if final else 'Status'} "
+            f"({elapsed:.0f}s) == {status or 'no trials yet'}"
+            + (f" | {tph:.0f} trials/h" if done else "")
+        ]
+        if self._best_value is not None:
+            lines.append(
+                f"   best {self._metric}: {self._best_value:.6g} "
+                f"({self._best_trial_id})"
+            )
+            best = self._trials.get(self._best_trial_id)
+            if final and best is not None:
+                lines.append(f"   best config: {best.config}")
+        # Running trials first (what a live table is for); at the end, the
+        # top finishers by metric instead.
+        if final:
+            def key(t):
+                # Rank by best-in-history so the table agrees with the
+                # "best" line (a trial can end worse than its best epoch);
+                # non-numeric/NaN-only histories sort last.
+                hist = self._numeric_history(t)
+                if not hist:
+                    return float("inf")
+                return min(hist) if self._mode == "min" else -max(hist)
+            rows = sorted(trials, key=key)[: self._max_rows]
+        else:
+            rows = [t for t in trials if t.status.value == "RUNNING"]
+            rows.sort(key=lambda t: -t.training_iteration)
+            rows = rows[: self._max_rows]
+        if rows:
+            header = ("trial", "status", "iter", self._metric, "time_s")
+            table = [header]
+            for t in rows:
+                hist = self._numeric_history(t)
+                # Final table shows each trial's BEST value (what it's
+                # ranked by); the live table shows the latest.
+                if hist and final:
+                    shown = min(hist) if self._mode == "min" else max(hist)
+                elif hist:
+                    shown = hist[-1]
+                table.append((
+                    t.trial_id,
+                    t.status.value,
+                    str(t.training_iteration),
+                    f"{shown:.6g}" if hist else "-",
+                    f"{t.runtime_s():.1f}",
+                ))
+            widths = [max(len(r[i]) for r in table)
+                      for i in range(len(header))]
+            for row in table:
+                lines.append("   " + "  ".join(
+                    c.ljust(w) for c, w in zip(row, widths)
+                ).rstrip())
+            hidden = (len(trials) if final else
+                      sum(1 for t in trials
+                          if t.status.value == "RUNNING")) - len(rows)
+            if hidden > 0:
+                lines.append(f"   ... and {hidden} more")
+        print("\n".join(lines), file=out, flush=True)
+
+
 class ProfilerCallback(Callback):
     """Capture a ``jax.profiler`` trace of the experiment.
 

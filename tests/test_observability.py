@@ -181,3 +181,164 @@ def test_get_logger_namespacing_and_file(tmp_path):
     assert "hello structured world" in content
     assert "INFO" in content
     assert "after removal" not in content
+
+
+def test_progress_reporter_unit():
+    """Drive the reporter's hooks directly: table rendering, best tracking,
+    throughput line, and the always-printed final summary."""
+    import io
+
+    from distributed_machine_learning_tpu.tune.trial import (
+        Trial,
+        TrialStatus,
+    )
+
+    buf = io.StringIO()
+    rep = tune.ProgressReporter(interval_s=0.0, max_rows=2, file=buf)
+    rep.setup("/tmp/x", "loss", "min")
+    trials = [Trial(trial_id=f"t{i}", config={"x": i}) for i in range(4)]
+    for i, t in enumerate(trials):
+        t.status = TrialStatus.RUNNING
+        t.started_at = time.time()
+        rep.on_trial_start(t)
+        t.results.append({"loss": float(10 - i), "training_iteration": 1})
+        t.reports_since_restart = 1
+        rep.on_trial_result(t, t.results[-1])
+    out = buf.getvalue()
+    assert "RUNNING: 4" in out
+    assert "best loss: 7" in out  # 10-3, min mode tracked incrementally
+    assert "... and 2 more" in out  # max_rows=2 of 4 running
+
+    for t in trials:
+        t.status = TrialStatus.TERMINATED
+        t.finished_at = time.time()
+        rep.on_trial_complete(t)
+    rep.on_experiment_end(trials, wall_clock_s=3600.0)
+    final = buf.getvalue()[len(out):]
+    assert "Final result" in final
+    assert "TERMINATED: 4" in final
+    assert "4 trials/h" in final  # 4 done in exactly one hour
+    # final table keeps the top max_rows finishers by metric: t3 (loss 7)
+    # and t2 make the cut, t0/t1 fold into the "more" line
+    assert "t3" in final and "t2" in final
+    assert "\n   t0" not in final
+    assert "... and 2 more" in final
+
+
+def test_progress_reporter_in_real_sweep(tmp_results):
+    """End-to-end through tune.run: the reporter prints at least one live
+    status and the final summary, without perturbing results."""
+    import io
+
+    buf = io.StringIO()
+    analysis = tune.run(
+        _trainable,
+        {"x": tune.uniform(-1, 1)},
+        metric="loss", mode="min", num_samples=3,
+        storage_path=tmp_results, name="progress_e2e", verbose=0,
+        callbacks=[tune.ProgressReporter(interval_s=0.0, file=buf)],
+    )
+    out = buf.getvalue()
+    assert analysis.num_terminated() == 3
+    assert "Final result" in out
+    assert "best loss:" in out
+    assert "trials/h" in out
+
+
+def test_progress_reporter_final_config_and_heartbeat_refresh():
+    """Review findings: the final summary must include the best config, and
+    heartbeats must refresh the table while trials run (runtime is live)."""
+    import io
+
+    from distributed_machine_learning_tpu.tune.trial import (
+        Trial,
+        TrialStatus,
+    )
+
+    buf = io.StringIO()
+    rep = tune.ProgressReporter(interval_s=0.0, file=buf)
+    rep.setup("/tmp/x", "loss", "min")
+    t = Trial(trial_id="t0", config={"lr": 0.1})
+    t.status = TrialStatus.RUNNING
+    t.started_at = time.time()
+    rep.on_trial_start(t)
+    t.results.append({"loss": 1.0, "training_iteration": 1})
+    rep.on_trial_result(t, t.results[-1])
+    mark = len(buf.getvalue())
+    rep.on_heartbeat()  # RUNNING trial -> table re-renders on interval
+    assert "== Status" in buf.getvalue()[mark:]
+    t.status = TrialStatus.TERMINATED
+    rep.on_experiment_end([t], wall_clock_s=10.0)
+    assert "best config: {'lr': 0.1}" in buf.getvalue()
+
+
+def test_progress_reporter_nan_and_best_ranking():
+    """Review findings: NaN never becomes 'best', and the final table ranks
+    by best-in-history so it always contains the announced best trial."""
+    import io
+
+    from distributed_machine_learning_tpu.tune.trial import (
+        Trial,
+        TrialStatus,
+    )
+
+    buf = io.StringIO()
+    rep = tune.ProgressReporter(interval_s=0.0, max_rows=1, file=buf)
+    rep.setup("/tmp/x", "loss", "min")
+    diverged = Trial(trial_id="bad", config={})
+    comeback = Trial(trial_id="peak", config={})
+    for t, hist in ((diverged, [float("nan")]), (comeback, [0.1, 5.0])):
+        t.status = TrialStatus.RUNNING
+        t.started_at = time.time()
+        rep.on_trial_start(t)
+        for i, v in enumerate(hist):
+            t.results.append({"loss": v, "training_iteration": i + 1})
+            rep.on_trial_result(t, t.results[-1])
+        t.status = TrialStatus.TERMINATED
+    rep.on_experiment_end([diverged, comeback], wall_clock_s=10.0)
+    out = buf.getvalue()
+    final = out[out.index("Final result"):]
+    assert "best loss: 0.1 (peak)" in final  # NaN skipped, best-ever kept
+    # max_rows=1: the single table row must be the announced best trial,
+    # ranked and shown by its best-in-history value, not its last (5.0)
+    assert "\n   peak" in final and "0.1" in final
+    assert "\n   bad" not in final
+
+
+def test_progress_reporter_non_numeric_metric_and_reuse():
+    """Review findings: a None/string metric must not crash rendering, and a
+    reporter reused across experiments starts clean at setup()."""
+    import io
+
+    from distributed_machine_learning_tpu.tune.trial import (
+        Trial,
+        TrialStatus,
+    )
+
+    buf = io.StringIO()
+    rep = tune.ProgressReporter(interval_s=0.0, file=buf)
+    rep.setup("/tmp/x", "loss", "min")
+    t = Trial(trial_id="warmup", config={})
+    t.status = TrialStatus.RUNNING
+    t.started_at = time.time()
+    rep.on_trial_start(t)
+    t.results.append({"loss": None, "training_iteration": 1})
+    rep.on_trial_result(t, t.results[-1])  # must not raise
+    t.results.append({"loss": 0.5, "training_iteration": 2})
+    rep.on_trial_result(t, t.results[-1])
+    t.status = TrialStatus.TERMINATED
+    rep.on_experiment_end([t], wall_clock_s=5.0)
+    out = buf.getvalue()
+    assert "best loss: 0.5" in out and "Final result" in out
+
+    # Reuse across a second experiment: no carry-over of trials or best.
+    rep.setup("/tmp/y", "loss", "min")
+    t2 = Trial(trial_id="fresh", config={"a": 1})
+    t2.status = TrialStatus.TERMINATED
+    t2.results.append({"loss": 9.0, "training_iteration": 1})
+    rep.on_trial_result(t2, t2.results[-1])
+    rep.on_experiment_end([t2], wall_clock_s=5.0)
+    final2 = buf.getvalue()[len(out):]
+    assert "TERMINATED: 1" in final2      # not 2: warmup didn't carry over
+    assert "warmup" not in final2
+    assert "best loss: 9" in final2       # 0.5 from exp A is gone
