@@ -34,9 +34,9 @@ run() {
 # sweeps with warm repeats, flagship MFU, torch baseline.
 TIMEOUT=3600 run bench python bench.py
 
-# Same sweep with hardware-RNG dropout streams (rng_impl='rbg'): measures
-# the threefry tax at the sweep's small shapes.
-TIMEOUT=2400 run bench_rbg env DML_BENCH_RNG_IMPL=rbg python bench.py
+# Same sweep with threefry dropout streams forced: measures the tax the
+# default hardware-RNG ("auto" -> rbg on TPU, ops/rng.py) avoids.
+TIMEOUT=2400 run bench_threefry env DML_BENCH_RNG_IMPL=threefry python bench.py
 
 # GQA kv-bandwidth: native grouped kv vs repeat, fwd and fwd+bwd.
 TIMEOUT=1800 run gqa python benchmarks/gqa_bench.py

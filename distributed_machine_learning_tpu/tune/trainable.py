@@ -28,11 +28,11 @@ Config keys (all optional unless noted): ``model`` family; model arch keys
 ``lr_schedule``, ``warmup_steps``, ``total_steps``; ``batch_size``;
 ``num_epochs``; ``seed``; ``compute_dtype`` ("bfloat16" = real mixed
 precision: bf16 matmuls/activations via the model's flax dtype, float32
-params/optimizer/losses — models.compute_dtype_of); ``rng_impl`` ("rbg"
-routes dropout keys through the hardware RNG — substantially cheaper than
-the default threefry on TPU at small shapes; opt-in because the random
-streams, and therefore trajectories, differ while remaining deterministic
-in the seed).
+params/optimizer/losses — models.compute_dtype_of); ``rng_impl`` ("auto" default:
+hardware RNG on TPU, threefry elsewhere — measured ~1.5x sweep throughput
+on-chip at bench shapes, ops/rng.py; "threefry" forces cross-platform-reproducible
+streams, "rbg" forces hardware RNG — ops/rng.py; all deterministic in the
+seed, but different impls produce different trajectories).
 """
 
 from __future__ import annotations
@@ -46,6 +46,7 @@ from distributed_machine_learning_tpu.data.loader import Dataset
 from distributed_machine_learning_tpu.models import build_model
 from distributed_machine_learning_tpu.ops.losses import get_loss
 from distributed_machine_learning_tpu.ops.optimizers import make_optimizer
+from distributed_machine_learning_tpu.ops.rng import resolve_rng_impl
 from distributed_machine_learning_tpu.ops.schedules import get_schedule
 from distributed_machine_learning_tpu.tune import session
 from distributed_machine_learning_tpu.tune._regression_program import (
@@ -136,9 +137,20 @@ def train_regressor(
     )
 
     # ---- restore (PBT exploit / fault retry) -------------------------------
+    # Dropout PRNG implementation (ops/rng.py): defaults to the hardware
+    # RNG on TPU — threefry key derivation measurably dominates small-shape
+    # sweeps there — threefry elsewhere; rng_impl="threefry"/"rbg"
+    # overrides.  The resolved impl is recorded in every checkpoint and a
+    # restore REUSES the recorded one, so a trial restored on a different
+    # backend keeps the stream family its earlier epochs were drawn from
+    # instead of silently mixing trajectories ("" = jax default).
+    rng_impl = resolve_rng_impl(config)
     start_epoch = 0
     ckpt = session.get_checkpoint()
     if ckpt is not None:
+        saved_impl = ckpt.get("rng_impl") if isinstance(ckpt, dict) else None
+        if saved_impl is not None:
+            rng_impl = saved_impl or None
         template = {
             "params": params,
             "opt_state": opt_state,
@@ -172,12 +184,6 @@ def train_regressor(
     tracker = get_tracker()
 
     import time as _time
-
-    # Dropout PRNG implementation: "rbg" uses the hardware RNG path, which
-    # is substantially cheaper than threefry on TPU at small shapes (the
-    # HPO sweep regime); streams differ from the default but remain
-    # deterministic in the seed. Opt-in: trajectories change.
-    rng_impl = config.get("rng_impl")
 
     # ---- epoch loop: host-driven so the scheduler can interrupt ------------
     for epoch in range(start_epoch, num_epochs):
@@ -221,6 +227,10 @@ def train_regressor(
                 "opt_state": opt_state,
                 "batch_stats": batch_stats,
                 "epoch": epoch,
+                # Stream family the trial's epochs were drawn from; a
+                # restore on another backend must keep it (see restore
+                # above).  Extra key: older restore templates ignore it.
+                "rng_impl": rng_impl or "",
             }
         session.report(record, checkpoint=checkpoint)
 

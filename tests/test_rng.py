@@ -1,0 +1,97 @@
+"""Dropout PRNG impl selection (ops/rng.py): auto-resolution and the
+population checkpoint's record of which impl produced its key data."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu.ops.rng import resolve_rng_impl
+
+
+def test_resolver_explicit_values_win(monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert resolve_rng_impl({"rng_impl": "threefry"}) is None
+    assert resolve_rng_impl({"rng_impl": "rbg"}) == "rbg"
+
+
+def test_resolver_auto_by_backend(monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert resolve_rng_impl({}) == "rbg"
+    assert resolve_rng_impl(None) == "rbg"
+    assert resolve_rng_impl({"rng_impl": "auto"}) == "rbg"
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert resolve_rng_impl({}) is None
+
+
+def test_resolved_impl_wraps_key_data():
+    """The resolver's outputs are valid jax.random.key impls, and key data
+    round-trips through wrap_key_data under the same impl (the population
+    checkpoint/restore contract in tune/vectorized.py)."""
+    for impl in (resolve_rng_impl({"rng_impl": "rbg"}),
+                 resolve_rng_impl({"rng_impl": "threefry"})):
+        key = jax.random.key(7, impl=impl)
+        data = np.asarray(jax.random.key_data(key))
+        rewrapped = jax.random.wrap_key_data(data, impl=impl)
+        a = jax.random.uniform(key, (3,))
+        b = jax.random.uniform(rewrapped, (3,))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_rbg_and_threefry_key_data_shapes_differ():
+    """Why the checkpoint must record the impl: the raw key data of the two
+    impls is not interchangeable."""
+    rbg = np.asarray(jax.random.key_data(jax.random.key(0, impl="rbg")))
+    tf = np.asarray(jax.random.key_data(jax.random.key(0)))
+    assert rbg.shape != tf.shape
+    with pytest.raises(Exception):
+        jax.random.wrap_key_data(
+            np.asarray(tf), impl="rbg"
+        )  # wrong-width data must not silently wrap
+
+
+def test_trainable_checkpoint_records_and_restores_rng_impl(tmp_path):
+    """A trial's checkpoint records the resolved dropout-PRNG impl, and a
+    restore reuses the RECORDED impl even when the restoring config/backend
+    would resolve differently (cross-backend resume must not mix stream
+    families mid-trial)."""
+    from distributed_machine_learning_tpu import tune
+    from distributed_machine_learning_tpu.data import dummy_regression_data
+    from distributed_machine_learning_tpu.tune import session
+    from distributed_machine_learning_tpu.tune.checkpoint import (
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    train, val = dummy_regression_data(
+        num_samples=64, seq_len=6, num_features=3
+    )
+    config = {"model": "mlp", "learning_rate": 1e-3, "num_epochs": 1,
+              "batch_size": 32, "dropout": 0.1, "rng_impl": "rbg",
+              "seed": 3}
+
+    def run(cfg, checkpoint=None):
+        reports = []
+        session.set_session(session.Session(
+            None,
+            lambda rec, ck=None: reports.append((rec, ck)),
+            lambda: checkpoint,
+        ))
+        try:
+            tune.train_regressor(cfg, train_data=train, val_data=val)
+        finally:
+            session.set_session(None)
+        return [c for _, c in reports if c is not None]
+
+    ckpts = run(config)
+    assert ckpts and ckpts[-1]["rng_impl"] == "rbg"
+
+    # Restore under a config whose own resolution differs (rng_impl absent:
+    # auto -> threefry on CPU). The recorded impl must win; the new
+    # checkpoint re-records the inherited impl, and training completes
+    # (rbg-wide epoch keys keep working).
+    path = str(tmp_path / "ck.msgpack")
+    save_checkpoint(path, ckpts[-1])
+    cfg2 = dict(config, num_epochs=2)
+    del cfg2["rng_impl"]
+    ckpts2 = run(cfg2, checkpoint=load_checkpoint(path))
+    assert ckpts2 and ckpts2[-1]["rng_impl"] == "rbg"
