@@ -566,6 +566,15 @@ VARIANT_SCALES = {
 }
 
 
+def _stderr_reporter():
+    """Live trial table on stderr for variant children: a stalled child's
+    captured log then shows exactly how far it got (the 2026-07-31 bohb
+    stall was invisible — 2s CPU, zero output, nothing to diagnose)."""
+    from distributed_machine_learning_tpu import tune
+
+    return tune.ProgressReporter(interval_s=30.0, file=sys.stderr)
+
+
 def child_variant(name: str, scale_name: str) -> None:
     import jax
     import numpy as np
@@ -609,6 +618,7 @@ def child_variant(name: str, scale_name: str) -> None:
             num_samples=scale["trials"], max_batch_trials=scale["trials"],
             scheduler=pbt, storage_path="/tmp/bench_results",
             name=f"variant_pbt_{int(t0)}", seed=11, verbose=0,
+            callbacks=[_stderr_reporter()],
         )
         extra["best_validation_mse"] = float(
             analysis.best_result.get("validation_mse", -1)
@@ -645,6 +655,7 @@ def child_variant(name: str, scale_name: str) -> None:
             storage_path="/tmp/bench_results",
             name=f"variant_bohb_{int(t0)}",
             verbose=0,
+            callbacks=[_stderr_reporter()],
         )
         # The compile-cache-reuse story: one fixed architecture => later
         # trials hit the jit cache instead of recompiling.
@@ -678,6 +689,7 @@ def child_variant(name: str, scale_name: str) -> None:
             storage_path="/tmp/bench_results",
             name=f"variant_resnet_{int(t0)}",
             verbose=0,
+            callbacks=[_stderr_reporter()],
         )
         extra["devices_per_trial"] = n_dev
         extra["best_validation_loss"] = float(

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Remainder of the 2026-07-31 capture: the steps the tunnel wedge ate
+# (bohb/resnet full-scale variants), the flagship batch-size MFU sweep,
+# and one bench.py under the new rng auto default. Same discipline as
+# run_all_tpu.sh: sequential, SIGTERM-only, cool-down between claimants.
+set -u
+ts=$(date +%H%M%S)
+out="/tmp/tpu_remainder_${ts}"
+mkdir -p "$out"
+cd "$(dirname "$0")/.."
+
+run() {
+  name=$1; shift
+  echo "=== $name: $* (log: $out/$name.log)" | tee -a "$out/summary.txt"
+  timeout --signal=TERM --kill-after=0 "$TIMEOUT" "$@" \
+    > "$out/$name.log" 2>&1
+  rc=$?
+  tail -3 "$out/$name.log" | tee -a "$out/summary.txt"
+  echo "--- $name rc=$rc" | tee -a "$out/summary.txt"
+  sleep 15
+}
+
+TIMEOUT=900  run flagship_batch python benchmarks/flagship_batch_sweep.py
+TIMEOUT=1800 run variant_resnet python bench.py --variant sharded_resnet
+TIMEOUT=2400 run variant_bohb python bench.py --variant bohb_transformer
+TIMEOUT=3600 run bench_rbg_default python bench.py
+
+echo "remainder complete: $out" | tee -a "$out/summary.txt"

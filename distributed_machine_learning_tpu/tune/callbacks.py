@@ -21,6 +21,18 @@ from distributed_machine_learning_tpu.utils.logging import (
 )
 
 
+def with_default_reporter(callbacks, verbose: int):
+    """The shared verbose>=2 convention for both runners: a live trial
+    table (Ray Tune's default console surface) unless one is already
+    wired.  Returns a fresh list; never mutates the caller's."""
+    callbacks = list(callbacks or [])
+    if verbose >= 2 and not any(
+        isinstance(cb, ProgressReporter) for cb in callbacks
+    ):
+        callbacks.append(ProgressReporter())
+    return callbacks
+
+
 def dispatch_safely(callbacks, hook: str, *args, log=lambda msg: None):
     """Invoke ``hook`` on every callback, isolating observer failures.
 

@@ -171,7 +171,11 @@ def run(
         raise ValueError(
             f"trial_executor must be 'thread' or 'process', got {trial_executor!r}"
         )
-    callbacks = list(callbacks or [])
+    from distributed_machine_learning_tpu.tune.callbacks import (
+        with_default_reporter,
+    )
+
+    callbacks = with_default_reporter(callbacks, verbose)
 
     max_concurrent = max_concurrent or device_mgr.num_devices
     running: Dict[str, List] = {}  # trial_id -> leased devices

@@ -151,6 +151,12 @@ def train_regressor(
         saved_impl = ckpt.get("rng_impl") if isinstance(ckpt, dict) else None
         if saved_impl is not None:
             rng_impl = saved_impl or None
+        else:
+            # Legacy checkpoint (predates impl recording): its epochs were
+            # drawn under the RAW config value (no auto-resolution then),
+            # so continue with exactly that — resolving anew could switch
+            # stream families mid-trial (same fallback as vectorized.py).
+            rng_impl = config.get("rng_impl") or None
         template = {
             "params": params,
             "opt_state": opt_state,

@@ -409,7 +409,11 @@ def run_vectorized(
         if verbose:
             print(f"[tune.vectorized] {msg}", flush=True)
 
-    callbacks = list(callbacks or [])
+    from distributed_machine_learning_tpu.tune.callbacks import (
+        with_default_reporter,
+    )
+
+    callbacks = with_default_reporter(callbacks, verbose)
 
     def safe_cb(hook: str, *cb_args):
         from distributed_machine_learning_tpu.tune.callbacks import (

@@ -342,3 +342,30 @@ def test_progress_reporter_non_numeric_metric_and_reuse():
     assert "TERMINATED: 1" in final2      # not 2: warmup didn't carry over
     assert "warmup" not in final2
     assert "best loss: 9" in final2       # 0.5 from exp A is gone
+
+
+def test_verbose_2_attaches_progress_reporter(tmp_results, capsys):
+    """verbose>=2 gets the live trial table without wiring a callback (both
+    runners follow the same convention)."""
+    tune.run(
+        _trainable, {"x": tune.uniform(-1, 1)},
+        metric="loss", mode="min", num_samples=2,
+        storage_path=tmp_results, name="verbose2", verbose=2,
+    )
+    out = capsys.readouterr().out
+    assert "Final result" in out and "best loss:" in out
+
+    from distributed_machine_learning_tpu.data import dummy_regression_data
+
+    train, val = dummy_regression_data(
+        num_samples=64, seq_len=6, num_features=3
+    )
+    tune.run_vectorized(
+        {"model": "mlp", "learning_rate": tune.loguniform(1e-3, 1e-1),
+         "num_epochs": 1, "batch_size": 32, "seed": 0},
+        train_data=train, val_data=val,
+        metric="validation_loss", num_samples=2,
+        storage_path=tmp_results, name="verbose2_vec", verbose=2,
+    )
+    out = capsys.readouterr().out
+    assert "Final result" in out and "best validation_loss:" in out
