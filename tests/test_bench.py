@@ -395,3 +395,39 @@ def test_main_late_reprobe_recovers_tpu(monkeypatch, capsys):
     assert line["value"] == 8000.0
     assert line["probe"]["late_retry"] is True
     assert "late_probe_s" in line["phases"]
+
+
+def test_variant_partial_recovers_terminated_trials(tmp_path, monkeypatch):
+    """A dead variant child's experiment_state.json yields a flagged
+    partial result; nothing-terminated and no-experiment-dir yield None."""
+    import time
+
+    monkeypatch.setattr(bench, "BENCH_RESULTS_DIR", str(tmp_path))
+    exp = "variant_bohb_transformer_test"
+    root = tmp_path / exp
+    root.mkdir(parents=True)
+    t_start = time.time() - 120.0
+    state = {
+        "timestamp": t_start + 100.0,
+        "trials": [
+            {"trial_id": "a", "status": "TERMINATED",
+             "last_result": {"validation_mse": 3.5}},
+            {"trial_id": "b", "status": "TERMINATED",
+             "last_result": {"validation_mse": 2.25}},
+            {"trial_id": "c", "status": "RUNNING",
+             "last_result": {"validation_mse": 0.1}},
+        ],
+    }
+    (root / "experiment_state.json").write_text(json.dumps(state))
+    res = bench._variant_partial("bohb_transformer", exp, t_start)
+    assert res["partial"] is True
+    assert res["done"] == 2
+    assert abs(res["trials_per_hour"] - 2 * 36.0) < 0.5  # 2 per 100s
+    assert res["platform"] == "tpu"
+    assert res["best_validation_mse"] == 2.25  # running trial's 0.1 excluded
+
+    state["trials"] = [{"trial_id": "a", "status": "RUNNING"}]
+    (root / "experiment_state.json").write_text(json.dumps(state))
+    assert bench._variant_partial("bohb_transformer", exp, t_start) is None
+    # No experiment dir at all (child died before tune.run created it).
+    assert bench._variant_partial("bohb_transformer", "absent", t_start) is None
