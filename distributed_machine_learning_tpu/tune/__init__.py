@@ -33,6 +33,7 @@ from distributed_machine_learning_tpu.tune.schedulers import (
     FIFOScheduler,
     HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
@@ -97,6 +98,7 @@ __all__ = [
     "HyperBandScheduler",
     "FIFOScheduler",
     "MedianStoppingRule",
+    "PB2",
     "PopulationBasedTraining",
     "TrialScheduler",
     "RandomSearch",

@@ -10,6 +10,7 @@ from distributed_machine_learning_tpu.tune.schedulers.hyperband import (
     HyperBandScheduler,
 )
 from distributed_machine_learning_tpu.tune.schedulers.median import MedianStoppingRule
+from distributed_machine_learning_tpu.tune.schedulers.pb2 import PB2
 from distributed_machine_learning_tpu.tune.schedulers.pbt import PopulationBasedTraining
 
 __all__ = [
@@ -17,6 +18,7 @@ __all__ = [
     "HyperBandScheduler",
     "FIFOScheduler",
     "MedianStoppingRule",
+    "PB2",
     "PopulationBasedTraining",
     "TrialScheduler",
     "CONTINUE",

@@ -1,0 +1,129 @@
+"""PB2 — Population Based Bandits (Parker-Holder et al., NeurIPS 2020).
+
+PBT with a model-based explore step: instead of randomly perturbing
+continuous hyperparameters at exploit time, PB2 fits a Gaussian process to
+the observed *score improvements* as a function of the hyperparameter values
+that produced them, and picks new values by UCB — so the population steers
+its learning-rate/weight-decay schedule toward the settings that have been
+paying off, which matters exactly where PBT's random walk wastes trials
+(small populations).
+
+The reference has neither PBT nor PB2 (no checkpointing at all, SURVEY.md
+§5); this rounds out the scheduler menu a Ray Tune user expects
+(`ray.tune.schedulers.pb2.PB2`).  Exploit, quantile ranking, checkpoint
+budget-preservation, and categorical mutation are inherited from
+``PopulationBasedTraining``; only continuous-key exploration changes.  The
+GP is the same pure-numpy RBF machinery as ``BayesOptSearch`` — no library
+dependency.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from distributed_machine_learning_tpu.tune.schedulers.base import CONTINUE
+from distributed_machine_learning_tpu.tune.schedulers.pbt import (
+    PopulationBasedTraining,
+)
+from distributed_machine_learning_tpu.tune.search.bayesopt import gp_posterior
+from distributed_machine_learning_tpu.tune.search_space import Domain
+from distributed_machine_learning_tpu.tune.trial import Trial
+
+
+class PB2(PopulationBasedTraining):
+    """Drop-in PBT replacement; continuous mutations become GP-UCB choices.
+
+    Extra knobs: ``kappa`` (UCB exploration weight — higher explores more),
+    ``lengthscale``/``noise`` (GP hyperparams on the unit cube),
+    ``num_candidates`` (acquisition grid size).  Continuous keys are the
+    ``hyperparam_mutations`` entries whose spec is a continuous ``Domain``
+    (``tune.uniform``/``tune.loguniform``); everything else mutates exactly
+    as in PBT.
+    """
+
+    def __init__(self, *args, kappa: float = 1.0, lengthscale: float = 0.2,
+                 noise: float = 1e-4, num_candidates: int = 256,
+                 window: int = 512, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.kappa = kappa
+        self.lengthscale = lengthscale
+        self.noise = noise
+        self.num_candidates = num_candidates
+        self.window = window
+        self._cont_keys = [
+            k for k, spec in self.mutations.items()
+            if isinstance(spec, Domain) and spec.is_continuous
+        ]
+        # Observations: (unit-cube hyperparam vector, score improvement it
+        # produced over one reporting step).  Lower score = better, so
+        # improvement = previous - current.  Sliding window (Ray's PB2 fits
+        # a recent time window too): bounds the O(n^3) GP refit AND keeps
+        # late-phase mutations steered by late-phase evidence — early
+        # epochs' big deltas would otherwise dominate the mean forever.
+        self._obs: list = []
+        # trial_id -> (iteration, score) of the last observed report.
+        self._last_score: Dict[str, tuple] = {}
+
+    # -- observe improvements ------------------------------------------------
+    def _encode(self, config: Dict[str, Any]):
+        try:
+            return np.array(
+                [self.mutations[k].to_unit(config[k])
+                 for k in self._cont_keys],
+                dtype=np.float64,
+            )
+        except (KeyError, TypeError, ValueError):
+            return None  # config missing a key / non-numeric: skip this obs
+
+    def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
+        if self.metric in result and self._cont_keys:
+            score = self._score(result)
+            it = int(result.get("training_iteration",
+                                trial.training_iteration))
+            prev = self._last_score.get(trial.trial_id)
+            # A non-monotone iteration means the trial restarted from a
+            # checkpoint WITHOUT a scheduler decision (driver failure-retry
+            # rewinds to the last checkpoint, resume requeues) — a delta
+            # across that boundary would blame the config for the rewound
+            # weights, so it only re-baselines.
+            if prev is not None and it > prev[0]:
+                x = self._encode(trial.config)
+                if x is not None:
+                    self._obs.append((x, prev[1] - score))
+                    if len(self._obs) > self.window:
+                        del self._obs[: -self.window]
+            self._last_score[trial.trial_id] = (it, score)
+        decision = super().on_trial_result(trial, result)
+        if decision != CONTINUE:
+            # The trial restarts from a donor's weights under a new config;
+            # a delta across that boundary would credit the new config with
+            # the donor's head start, so the improvement chain resets.
+            self._last_score.pop(trial.trial_id, None)
+        return decision
+
+    # -- explore (GP-UCB over the continuous keys) ---------------------------
+    def _mutate(self, config: Dict[str, Any],
+                rng: np.random.Generator) -> Dict[str, Any]:
+        new = super()._mutate(config, rng)  # categorical + in-domain clamp
+        if not self._cont_keys or len(self._obs) < 4:
+            return new
+        X = np.stack([x for x, _ in self._obs])
+        y = np.array([dy for _, dy in self._obs])
+        cand = rng.random((self.num_candidates, len(self._cont_keys)))
+        try:
+            mu, sigma, _ = gp_posterior(
+                X, y, cand, self.lengthscale, self.noise
+            )
+        except np.linalg.LinAlgError:
+            return new  # degenerate observations: keep the PBT mutation
+        u = cand[int(np.argmax(mu + self.kappa * sigma))]  # max improvement
+        for k, ui in zip(self._cont_keys, u):
+            new[k] = self.mutations[k].from_unit(float(ui))
+        return new
+
+    def debug_state(self):
+        state = super().debug_state()
+        state["num_observations"] = len(self._obs)
+        return state

@@ -65,9 +65,16 @@ class PopulationBasedTraining(TrialScheduler):
                 if resample:
                     new[key] = spec.sample(rng)
                 elif isinstance(new.get(key), (int, float)) and not isinstance(new[key], bool):
-                    new[key] = type(new[key])(
+                    val = type(new[key])(
                         new[key] * self.factors[int(rng.integers(len(self.factors)))]
                     )
+                    if spec.is_continuous:
+                        # Clamp into the domain: a x1.2 step from near the
+                        # upper bound must not leave it (Ray clamps too).
+                        val = spec.from_unit(
+                            float(np.clip(spec.to_unit(val), 0.0, 1.0))
+                        )
+                    new[key] = val
                 else:
                     new[key] = spec.sample(rng)
             elif isinstance(spec, (list, tuple)):

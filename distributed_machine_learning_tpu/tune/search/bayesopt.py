@@ -25,6 +25,27 @@ def _rbf_kernel(a: np.ndarray, b: np.ndarray, lengthscale: float) -> np.ndarray:
     return np.exp(-0.5 * d2 / lengthscale**2)
 
 
+def gp_posterior(X: np.ndarray, y: np.ndarray, cand: np.ndarray,
+                 lengthscale: float, noise: float):
+    """(mu, sigma) of an RBF-GP posterior at ``cand``, fitted on (X, y).
+
+    y is normalized internally; mu, sigma, and the returned normalized
+    targets ``yn`` share that scale (ranking-equivalent, which is all the
+    acquisitions need).  Shared by ``BayesOptSearch`` (EI) and the PB2
+    scheduler (UCB).  Raises ``np.linalg.LinAlgError`` when the kernel is
+    degenerate — callers fall back to their non-model behavior.
+    """
+    yn = (y - y.mean()) / (y.std() + 1e-9)
+    K = _rbf_kernel(X, X, lengthscale) + noise * np.eye(len(X))
+    L = np.linalg.cholesky(K)
+    alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+    Ks = _rbf_kernel(cand, X, lengthscale)
+    mu = Ks @ alpha
+    v = np.linalg.solve(L, Ks.T)
+    sigma = np.sqrt(np.clip(1.0 - (v**2).sum(axis=0), 1e-12, None))
+    return mu, sigma, yn
+
+
 class BayesOptSearch(Searcher):
     def __init__(
         self,
@@ -73,19 +94,13 @@ class BayesOptSearch(Searcher):
         rng = rng_from("bayesopt-acq", self.seed, trial_index)
         X = np.stack(self._X)
         y = np.array(self._y)
-        y_mean, y_std = y.mean(), y.std() + 1e-9
-        yn = (y - y_mean) / y_std
-
-        K = _rbf_kernel(X, X, self.lengthscale) + self.noise * np.eye(len(X))
-        L = np.linalg.cholesky(K)
-        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
-
         cand = rng.random((self.num_candidates, len(self._cont_keys)))
-        Ks = _rbf_kernel(cand, X, self.lengthscale)
-        mu = Ks @ alpha
-        v = np.linalg.solve(L, Ks.T)
-        var = np.clip(1.0 - (v**2).sum(axis=0), 1e-12, None)
-        sigma = np.sqrt(var)
+        try:
+            mu, sigma, yn = gp_posterior(
+                X, y, cand, self.lengthscale, self.noise
+            )
+        except np.linalg.LinAlgError:
+            return base  # degenerate kernel: stay with the random sample
 
         # Expected improvement (minimization of normalized score).
         best = yn.min()
