@@ -77,30 +77,40 @@ class PB2(PopulationBasedTraining):
         except (KeyError, TypeError, ValueError):
             return None  # config missing a key / non-numeric: skip this obs
 
+    def observe_result(self, trial: Trial, result: Dict[str, Any]) -> None:
+        """One improvement observation per consecutive-report pair; also the
+        hook run_vectorized calls directly (it bypasses on_trial_result for
+        the PBT family — the gather replaces REQUEUE)."""
+        if self.metric not in result or not self._cont_keys:
+            return
+        score = self._score(result)
+        it = int(result.get("training_iteration",
+                            trial.training_iteration))
+        prev = self._last_score.get(trial.trial_id)
+        # A non-monotone iteration means the trial restarted from a
+        # checkpoint WITHOUT a scheduler decision (driver failure-retry
+        # rewinds to the last checkpoint, resume requeues) — a delta
+        # across that boundary would blame the config for the rewound
+        # weights, so it only re-baselines.
+        if prev is not None and it > prev[0]:
+            x = self._encode(trial.config)
+            if x is not None:
+                self._obs.append((x, prev[1] - score))
+                if len(self._obs) > self.window:
+                    del self._obs[: -self.window]
+        self._last_score[trial.trial_id] = (it, score)
+
+    def reset_improvement_chain(self, trial_id: str) -> None:
+        self._last_score.pop(trial_id, None)
+
     def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
-        if self.metric in result and self._cont_keys:
-            score = self._score(result)
-            it = int(result.get("training_iteration",
-                                trial.training_iteration))
-            prev = self._last_score.get(trial.trial_id)
-            # A non-monotone iteration means the trial restarted from a
-            # checkpoint WITHOUT a scheduler decision (driver failure-retry
-            # rewinds to the last checkpoint, resume requeues) — a delta
-            # across that boundary would blame the config for the rewound
-            # weights, so it only re-baselines.
-            if prev is not None and it > prev[0]:
-                x = self._encode(trial.config)
-                if x is not None:
-                    self._obs.append((x, prev[1] - score))
-                    if len(self._obs) > self.window:
-                        del self._obs[: -self.window]
-            self._last_score[trial.trial_id] = (it, score)
+        self.observe_result(trial, result)
         decision = super().on_trial_result(trial, result)
         if decision != CONTINUE:
             # The trial restarts from a donor's weights under a new config;
             # a delta across that boundary would credit the new config with
             # the donor's head start, so the improvement chain resets.
-            self._last_score.pop(trial.trial_id, None)
+            self.reset_improvement_chain(trial.trial_id)
         return decision
 
     # -- explore (GP-UCB over the continuous keys) ---------------------------

@@ -65,16 +65,17 @@ class PopulationBasedTraining(TrialScheduler):
                 if resample:
                     new[key] = spec.sample(rng)
                 elif isinstance(new.get(key), (int, float)) and not isinstance(new[key], bool):
-                    val = type(new[key])(
-                        new[key] * self.factors[int(rng.integers(len(self.factors)))]
-                    )
-                    if spec.is_continuous:
+                    val = new[key] * self.factors[int(rng.integers(len(self.factors)))]
+                    lo = getattr(spec, "low", None)
+                    hi = getattr(spec, "high", None)
+                    if lo is not None and hi is not None:
                         # Clamp into the domain: a x1.2 step from near the
                         # upper bound must not leave it (Ray clamps too).
-                        val = spec.from_unit(
-                            float(np.clip(spec.to_unit(val), 0.0, 1.0))
-                        )
-                    new[key] = val
+                        # Direct min/max — no to_unit round-trip, which
+                        # would log(0)-crash on a zero value under
+                        # loguniform and float-ify int hyperparams.
+                        val = min(max(val, lo), hi)
+                    new[key] = type(new[key])(val)
                 else:
                     new[key] = spec.sample(rng)
             elif isinstance(spec, (list, tuple)):
@@ -146,6 +147,19 @@ class PopulationBasedTraining(TrialScheduler):
         trial.config = self._mutate(dict(donor.config), rng)
         self._num_perturbations += 1
         return REQUEUE
+
+    # -- vectorized-runner surface -------------------------------------------
+    # run_vectorized replaces the REQUEUE protocol with a device-side gather
+    # and bypasses on_trial_result entirely; these hooks let model-based
+    # subclasses (PB2) keep learning from the per-epoch stream anyway.
+
+    def observe_result(self, trial: Trial, result: Dict[str, Any]) -> None:
+        """Record whatever the explore model learns from one report
+        (no decision).  Base PBT learns nothing."""
+
+    def reset_improvement_chain(self, trial_id: str) -> None:
+        """The trial's weights were just replaced (exploit): any
+        cross-boundary score delta is meaningless.  Base PBT keeps none."""
 
     def on_trial_add(self, trial: Trial):
         self._trials = getattr(self, "_trials", {})

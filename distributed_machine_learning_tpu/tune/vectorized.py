@@ -45,7 +45,9 @@ and explore rewrites per-row learning_rate/weight_decay in the injected
 optimizer hyperparams.  No stop-and-respawn, no checkpoint round-trip, no
 recompile: a whole PBT generation costs one gather.  Only optimizer-state
 hyperparams can mutate (static keys change the program — use ``tune.run``'s
-respawn PBT for those).  Other REQUEUE-style schedulers are unsupported.
+respawn PBT for those).  PB2 composes: its GP observes every report via
+``observe_result`` and its UCB choice rides the same gather.  Other
+REQUEUE-style schedulers are unsupported.
 
 The jittable program bodies are shared with the per-trial trainable via
 ``tune/_regression_program.py``.
@@ -779,6 +781,15 @@ def _replay_records(trial_list, sched, searcher, pbt, metric, mode,
                 )
                 if callable(stop_rules):
                     stop_hit(stop_rules, trial.trial_id, record)
+    if pbt is not None:
+        # Re-baseline the model-based explore (PB2) on each trial's LAST
+        # record only: replaying full histories would attribute every old
+        # delta to the trial's FINAL (possibly exploit-mutated) config.
+        # Deltas resume from the first post-restore report; observations
+        # from before the interruption are accepted as lost.
+        for trial in trial_list:
+            if trial.results:
+                pbt.observe_result(trial, trial.results[-1])
 
 
 def _emit_epoch_records(
@@ -817,11 +828,13 @@ def _emit_epoch_records(
         safe_cb("on_trial_result", trial, record)
         # PBT never stops trials and its REQUEUE protocol is replaced by
         # the in-population gather at the dispatch boundary, so the
-        # scheduler is bypassed.
-        decision = (
-            CONTINUE if pbt is not None
-            else sched.on_trial_result(trial, record)
-        )
+        # scheduler's DECISION surface is bypassed — but model-based
+        # explores (PB2) still learn from every report.
+        if pbt is not None:
+            pbt.observe_result(trial, record)
+            decision = CONTINUE
+        else:
+            decision = sched.on_trial_result(trial, record)
         searcher.on_trial_result(
             trial.trial_id, dict(trial.config), record, metric, mode
         )
@@ -1210,6 +1223,10 @@ def _run_population(
                     new_cfg = pbt._mutate(dict(donor.config), rng)
                     new_cfg["seed"] = lagger.config.get("seed", 0)
                     lagger.config = new_cfg
+                    # The laggard's weights are about to be replaced by the
+                    # donor's: a score delta across that boundary would
+                    # credit the new config with the donor's head start.
+                    pbt.reset_improvement_chain(lagger.trial_id)
                     lrs[r] = float(new_cfg["learning_rate"])
                     wds[r] = float(new_cfg.get("weight_decay", 0.0))
                     pbt_notes[r] = donor.trial_id

@@ -169,3 +169,52 @@ def test_pbt_perturbation_clamped_into_domain():
     for _ in range(40):
         new = s._mutate({"learning_rate": 0.09}, rng)
         assert 1e-4 <= new["learning_rate"] <= 1e-1 + 1e-12
+
+
+def test_pb2_vectorized_learns_and_perturbs(tmp_results):
+    """PB2 in run_vectorized: the decision surface is bypassed (gather
+    replaces REQUEUE) but observe_result still feeds the GP, exploit
+    resets the laggard's improvement chain, and mutations stay in-domain."""
+    from distributed_machine_learning_tpu.data import dummy_regression_data
+
+    train, val = dummy_regression_data(
+        num_samples=128, seq_len=8, num_features=3, seed=3
+    )
+    pb2 = tune.PB2(
+        perturbation_interval=2,
+        hyperparam_mutations={"learning_rate": tune.loguniform(1e-4, 1e-1)},
+        quantile_fraction=0.25,
+        seed=6,
+    )
+    analysis = tune.run_vectorized(
+        {"model": "mlp", "learning_rate": tune.loguniform(1e-4, 1e-1),
+         "num_epochs": 8, "batch_size": 32, "seed": tune.randint(0, 10_000)},
+        train_data=train, val_data=val,
+        metric="validation_loss", num_samples=8, max_batch_trials=8,
+        scheduler=pb2, storage_path=tmp_results, name="pb2_vec", verbose=0,
+    )
+    assert analysis.num_terminated() == 8
+    state = pb2.debug_state()
+    assert state["num_observations"] > 0      # GP learned from the stream
+    assert state["num_perturbations"] > 0     # exploit fired
+    for t in analysis.trials:
+        assert 1e-4 <= t.config["learning_rate"] <= 1e-1 + 1e-12
+
+
+def test_pbt_mutation_zero_value_and_int_preservation():
+    """Review findings: a 0.0 value under a loguniform mutation must not
+    crash the clamp (log-domain), and int-typed hyperparams stay int."""
+    s = tune.PopulationBasedTraining(
+        metric="loss", mode="min", perturbation_interval=1,
+        hyperparam_mutations={
+            "weight_decay": tune.loguniform(1e-6, 1e-2),
+            "hidden": tune.uniform(32, 256),
+        },
+        resample_probability=0.0,
+    )
+    rng = np.random.default_rng(1)
+    for _ in range(30):
+        new = s._mutate({"weight_decay": 0.0, "hidden": 64}, rng)
+        assert 1e-6 <= new["weight_decay"] <= 1e-2  # 0.0 clamped up, no crash
+        assert isinstance(new["hidden"], int)
+        assert 32 <= new["hidden"] <= 256
