@@ -113,14 +113,90 @@ def _probe(rest) -> None:
     raise SystemExit(0 if healthy_accel else 1)
 
 
+def _analyze(rest) -> None:
+    import argparse
+    import os
+
+    p = argparse.ArgumentParser(prog="analyze")
+    p.add_argument("experiment_dir",
+                   help="an experiment directory (<storage_path>/<name>)")
+    p.add_argument("--metric", default=None,
+                   help="objective (default: the one recorded in "
+                        "experiment_state.json)")
+    p.add_argument("--mode", default=None, choices=("min", "max"))
+    p.add_argument("--rows", type=int, default=10)
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    args = p.parse_args(rest)
+
+    from distributed_machine_learning_tpu.tune.experiment import (
+        ExperimentAnalysis,
+    )
+
+    root = args.experiment_dir
+    state = {}
+    state_path = os.path.join(root, "experiment_state.json")
+    if os.path.exists(state_path):
+        with open(state_path) as f:
+            state = json.load(f)
+    metric = args.metric or state.get("metric")
+    mode = args.mode or state.get("mode") or "min"
+    if not metric:
+        print("error: experiment predates metric recording — pass --metric",
+              file=sys.stderr)
+        raise SystemExit(2)
+    try:
+        analysis = ExperimentAnalysis.from_directory(root, metric, mode)
+    except (FileNotFoundError, NotADirectoryError):
+        print(f"error: no experiment directory at {root}", file=sys.stderr)
+        raise SystemExit(1) from None
+    if not analysis.trials:
+        print(f"error: no trials under {root}", file=sys.stderr)
+        raise SystemExit(1)
+    if args.json:
+        try:
+            best_config, best_result = analysis.best_config, analysis.best_result
+        except ValueError as exc:  # e.g. a typo'd --metric no trial reported
+            print(f"error: {exc}", file=sys.stderr)
+            raise SystemExit(1) from None
+        print(json.dumps({
+            "metric": metric,
+            "mode": mode,
+            "num_trials": len(analysis.trials),
+            "num_terminated": analysis.num_terminated(),
+            "best_config": best_config,
+            "best_result": best_result,
+            **{k: state[k] for k in (
+                "wall_clock_s", "device_utilization",
+                "compile_time_total_s", "compile_cache_hits",
+            ) if k in state},
+        }))
+        return
+    # Human view: reuse the ProgressReporter's final table verbatim.
+    from distributed_machine_learning_tpu.tune.callbacks import (
+        ProgressReporter,
+    )
+
+    # inf interval: no live re-renders while replaying — only the final
+    # summary table prints.
+    rep = ProgressReporter(interval_s=float("inf"), max_rows=args.rows)
+    rep.setup(root, metric, mode)
+    for t in analysis.trials:
+        for r in t.results:
+            rep.on_trial_result(t, r)
+    rep.on_experiment_end(analysis.trials, state.get("wall_clock_s", 0.0))
+
+
 def main(argv=None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
     usage = (
         "usage: python -m distributed_machine_learning_tpu "
-        "{worker|info|probe|export-orbax} [args]\n"
+        "{worker|info|probe|analyze|export-orbax} [args]\n"
         "  worker        host trial supervisor (see 'worker --help')\n"
         "  info          jax backend/device summary for this process\n"
         "  probe         bounded accelerator health check (child process)\n"
+        "  analyze       <experiment_dir>: best config + trial table of a\n"
+        "                finished/interrupted experiment (--json for tools)\n"
         "  export-orbax  <ckpt.msgpack> <out_dir>: framework checkpoint\n"
         "                -> orbax StandardCheckpoint"
     )
@@ -136,6 +212,8 @@ def main(argv=None) -> None:
         _info()
     elif cmd == "probe":
         _probe(rest)
+    elif cmd == "analyze":
+        _analyze(rest)
     elif cmd == "export-orbax":
         if len(rest) != 2:
             print(usage, file=sys.stderr)

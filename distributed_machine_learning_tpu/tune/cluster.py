@@ -592,6 +592,7 @@ def run_distributed(
 
     name = name or f"dist_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:6]}"
     store = ExperimentStore(storage_path, name, checkpoint_storage)
+    store.set_context(metric, mode)
 
     events: "queue.Queue[Tuple]" = queue.Queue()
     pool: List[RemoteWorker] = []

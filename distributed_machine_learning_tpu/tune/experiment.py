@@ -85,8 +85,15 @@ class ExperimentStore:
         f.write(json.dumps(_jsonable(result)) + "\n")
         f.flush()
 
+    def set_context(self, metric: str, mode: str):
+        """Record the experiment's objective so the directory is
+        self-describing (``analyze`` CLI / ``from_directory`` without
+        re-supplying the metric)."""
+        self._context = {"metric": metric, "mode": mode}
+
     def write_state(self, trials: List[Trial], extra: Optional[Dict] = None):
         state = {
+            **getattr(self, "_context", {}),
             "timestamp": time.time(),
             "trials": [
                 {

@@ -161,6 +161,7 @@ def run(
 
     name = name or f"exp_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:6]}"
     store = ExperimentStore(storage_path, name, checkpoint_storage)
+    store.set_context(metric, mode)
     device_mgr = DeviceManager(devices)
     events: "queue.Queue" = queue.Queue()
     if trial_executor == "thread":

@@ -74,6 +74,14 @@ class PopulationBasedTraining(TrialScheduler):
                         # Direct min/max — no to_unit round-trip, which
                         # would log(0)-crash on a zero value under
                         # loguniform and float-ify int hyperparams.
+                        # RandInt's high is EXCLUSIVE (numpy convention,
+                        # search_space.py): its top legal value is high-1.
+                        from distributed_machine_learning_tpu.tune.search_space import (  # noqa: E501 - local to avoid cycle at import time
+                            RandInt,
+                        )
+
+                        if isinstance(spec, RandInt):
+                            hi = hi - 1
                         val = min(max(val, lo), hi)
                     new[key] = type(new[key])(val)
                 else:

@@ -405,6 +405,7 @@ def run_vectorized(
         raise ValueError("resume=True requires name= of the prior run")
     name = name or f"vexp_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:6]}"
     store = ExperimentStore(storage_path, name)
+    store.set_context(metric, mode)
     start_time = time.time()
 
     def log(msg: str):

@@ -151,3 +151,47 @@ def test_probe_crashed_child_is_not_cpu_only():
             env=env, capture_output=True, text=True, timeout=120,
         )
     assert proc.returncode == 2, (proc.stdout, proc.stderr)
+
+
+def test_analyze_subcommand(tmp_path):
+    """`analyze` rehydrates a finished experiment: recorded metric/mode are
+    picked up from experiment_state.json, --json is machine-readable, and
+    the human view prints the final table."""
+    from distributed_machine_learning_tpu import tune
+
+    def trainable(config):
+        for _ in range(2):
+            tune.report(loss=config["x"] ** 2)
+
+    tune.run(
+        trainable, {"x": tune.uniform(1.0, 2.0)},
+        metric="loss", mode="min", num_samples=3,
+        storage_path=str(tmp_path), name="cli_exp", verbose=0,
+    )
+    root = os.path.join(str(tmp_path), "cli_exp")
+
+    proc = _run(["analyze", root, "--json"])
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["metric"] == "loss" and out["mode"] == "min"  # recorded
+    assert out["num_terminated"] == 3
+    assert 1.0 <= out["best_config"]["x"] <= 2.0
+    assert "wall_clock_s" in out and "device_utilization" in out
+
+    proc = _run(["analyze", root])
+    assert proc.returncode == 0, proc.stderr
+    assert "Final result" in proc.stdout
+    assert "best loss:" in proc.stdout
+    assert "best config:" in proc.stdout
+
+    proc = _run(["analyze", str(tmp_path / "nope")])
+    assert proc.returncode == 2  # no state, no --metric: friendly error
+    assert "pass --metric" in proc.stderr
+
+    proc = _run(["analyze", str(tmp_path / "nope"), "--metric", "loss"])
+    assert proc.returncode == 1  # missing dir: friendly, no traceback
+    assert "no experiment directory" in proc.stderr
+
+    proc = _run(["analyze", root, "--metric", "typo_metric", "--json"])
+    assert proc.returncode == 1
+    assert "typo_metric" in proc.stderr and "Traceback" not in proc.stderr

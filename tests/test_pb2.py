@@ -218,3 +218,18 @@ def test_pbt_mutation_zero_value_and_int_preservation():
         assert 1e-6 <= new["weight_decay"] <= 1e-2  # 0.0 clamped up, no crash
         assert isinstance(new["hidden"], int)
         assert 32 <= new["hidden"] <= 256
+
+
+def test_pbt_randint_clamp_respects_exclusive_high():
+    """RandInt's high is exclusive: a x1.2 perturbation from the top legal
+    value must clamp to high-1, not high."""
+    s = tune.PopulationBasedTraining(
+        metric="loss", mode="min", perturbation_interval=1,
+        hyperparam_mutations={"layers": tune.randint(1, 10)},
+        resample_probability=0.0,
+    )
+    rng = np.random.default_rng(2)
+    for _ in range(30):
+        new = s._mutate({"layers": 9}, rng)
+        assert 1 <= new["layers"] <= 9
+        assert isinstance(new["layers"], int)
