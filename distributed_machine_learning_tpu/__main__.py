@@ -134,6 +134,9 @@ def _analyze(rest) -> None:
     )
 
     root = args.experiment_dir
+    if not os.path.isdir(root):  # diagnose a typo'd path FIRST
+        print(f"error: no experiment directory at {root}", file=sys.stderr)
+        raise SystemExit(1)
     state = {}
     state_path = os.path.join(root, "experiment_state.json")
     if os.path.exists(state_path):
@@ -145,13 +148,13 @@ def _analyze(rest) -> None:
         print("error: experiment predates metric recording — pass --metric",
               file=sys.stderr)
         raise SystemExit(2)
-    try:
-        analysis = ExperimentAnalysis.from_directory(root, metric, mode)
-    except (FileNotFoundError, NotADirectoryError):
-        print(f"error: no experiment directory at {root}", file=sys.stderr)
-        raise SystemExit(1) from None
+    analysis = ExperimentAnalysis.from_directory(root, metric, mode)
     if not analysis.trials:
         print(f"error: no trials under {root}", file=sys.stderr)
+        raise SystemExit(1)
+    if not any(metric in r for t in analysis.trials for r in t.results):
+        print(f"error: no trial reported metric {metric!r} under {root}",
+              file=sys.stderr)
         raise SystemExit(1)
     if args.json:
         try:

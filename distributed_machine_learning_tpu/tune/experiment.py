@@ -268,9 +268,20 @@ class ExperimentAnalysis:
         for trial_id, config, records, meta in iter_trial_records(root):
             trial = Trial(trial_id=trial_id, config=config)
             trial.results = records
+            # Restore progress/runtime so consumers (analyze's table,
+            # training_iteration comparisons) see real values, not zeros.
+            trial.reports_since_restart = len(records)
             if meta:
                 trial.status = TrialStatus(meta.get("status", "TERMINATED"))
                 trial.error = meta.get("error")
+                if "training_iteration" in meta:
+                    trial.restore_base = (
+                        int(meta["training_iteration"]) - len(records)
+                    )
+                runtime = meta.get("runtime_s")
+                if runtime is not None:
+                    trial.started_at = trial.created_at
+                    trial.finished_at = trial.created_at + float(runtime)
             elif records:
                 trial.status = TrialStatus.TERMINATED
             trials.append(trial)

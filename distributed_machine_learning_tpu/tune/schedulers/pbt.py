@@ -21,7 +21,7 @@ from distributed_machine_learning_tpu.tune.schedulers.base import (
     REQUEUE,
     TrialScheduler,
 )
-from distributed_machine_learning_tpu.tune.search_space import Domain
+from distributed_machine_learning_tpu.tune.search_space import Domain, RandInt
 from distributed_machine_learning_tpu.tune.trial import Trial
 from distributed_machine_learning_tpu.utils.seeding import rng_from
 
@@ -76,10 +76,6 @@ class PopulationBasedTraining(TrialScheduler):
                         # loguniform and float-ify int hyperparams.
                         # RandInt's high is EXCLUSIVE (numpy convention,
                         # search_space.py): its top legal value is high-1.
-                        from distributed_machine_learning_tpu.tune.search_space import (  # noqa: E501 - local to avoid cycle at import time
-                            RandInt,
-                        )
-
                         if isinstance(spec, RandInt):
                             hi = hi - 1
                         val = min(max(val, lo), hi)

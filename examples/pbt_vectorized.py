@@ -1,4 +1,4 @@
-"""Vectorized PBT driver: one population, one chip, exploit = one gather.
+"""Vectorized PBT/PB2 driver: one population, one chip, exploit = one gather.
 
 BASELINE.json config 3 requires PBT exercising checkpoint mutate/restore;
 ``tune.run`` covers the stop-and-respawn variant.  This driver shows the
@@ -8,7 +8,9 @@ single device-side gather, and explore rewrites per-row learning-rate /
 weight-decay inside the injected optimizer hyperparams — no respawns, no
 checkpoint round-trips, no recompiles.  Combined here with multi-epoch
 dispatch (one round trip per perturbation interval) and population
-checkpointing (``resume=True`` continues after a preemption).
+checkpointing (``resume=True`` continues after a preemption).  Pass
+``--scheduler pb2`` to swap PBT's random perturbation for PB2's GP-UCB
+explore (its GP observes every epoch via the same population stream).
 
 Run (CPU virtual devices):
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -42,6 +44,10 @@ def main(argv=None):
     parser.add_argument("--devices", default="one",
                         choices=["one", "all"],
                         help="'all' shards the population over local devices")
+    parser.add_argument("--scheduler", default="pbt",
+                        choices=["pbt", "pb2"],
+                        help="pb2 = GP-UCB explore (Population Based "
+                             "Bandits) instead of PBT's random walk")
     args = parser.parse_args(argv)
 
     import jax
@@ -62,7 +68,10 @@ def main(argv=None):
         "max_seq_length": 128,
         "loss_function": "mse",
     }
-    pbt = tune.PopulationBasedTraining(
+    sched_cls = tune.PB2 if args.scheduler == "pb2" else (
+        tune.PopulationBasedTraining
+    )
+    pbt = sched_cls(
         perturbation_interval=args.perturbation_interval,
         hyperparam_mutations={
             "learning_rate": tune.loguniform(1e-5, 1e-2),

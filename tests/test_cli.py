@@ -183,15 +183,21 @@ def test_analyze_subcommand(tmp_path):
     assert "Final result" in proc.stdout
     assert "best loss:" in proc.stdout
     assert "best config:" in proc.stdout
+    # Progress/runtime restored from the state file, not zeroed.
+    for line in proc.stdout.splitlines():
+        if line.strip().startswith("trial_"):
+            cols = line.split()
+            assert cols[2] == "2", line   # iter column: 2 reports
 
+    # Typo'd PATH is diagnosed first — never "pass --metric" advice.
     proc = _run(["analyze", str(tmp_path / "nope")])
-    assert proc.returncode == 2  # no state, no --metric: friendly error
-    assert "pass --metric" in proc.stderr
-
-    proc = _run(["analyze", str(tmp_path / "nope"), "--metric", "loss"])
-    assert proc.returncode == 1  # missing dir: friendly, no traceback
+    assert proc.returncode == 1
     assert "no experiment directory" in proc.stderr
 
-    proc = _run(["analyze", root, "--metric", "typo_metric", "--json"])
-    assert proc.returncode == 1
-    assert "typo_metric" in proc.stderr and "Traceback" not in proc.stderr
+    # Typo'd METRIC errors in both output modes (exit 0 with an all-dash
+    # table would pass scripted `analyze && ...` checks silently).
+    for extra in (["--json"], []):
+        proc = _run(["analyze", root, "--metric", "typo_metric"] + extra)
+        assert proc.returncode == 1, extra
+        assert "typo_metric" in proc.stderr
+        assert "Traceback" not in proc.stderr
