@@ -517,6 +517,7 @@ def run_distributed(
     time_budget_s: Optional[float] = None,
     time_limit_per_trial_s: Optional[float] = None,
     verbose: int = 1,
+    callbacks: Optional[List] = None,
     shutdown_workers: bool = False,
     keep_checkpoints_num: int = 0,
     checkpoint_storage: Optional[str] = None,
@@ -545,6 +546,10 @@ def run_distributed(
     their newest shared-storage checkpoint, sampling continued.
     ``stop`` / ``points_to_evaluate``: same surface as ``tune.run`` (dict /
     callable / Stopper; warm-start configs run first).
+    ``callbacks`` / ``verbose=2``: the same observer surface as ``tune.run``
+    (LoggerCallback, JsonlCallback, TensorBoardCallback, ProgressReporter —
+    verbose>=2 auto-attaches the live trial table); hooks run on the
+    driver's single event-loop thread.
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
@@ -600,6 +605,16 @@ def run_distributed(
     def log(msg: str):
         if verbose:
             print(f"[tune.cluster] {msg}", flush=True)
+
+    from distributed_machine_learning_tpu.tune.callbacks import (
+        dispatch_safely,
+        with_default_reporter,
+    )
+
+    callbacks = with_default_reporter(callbacks, verbose)
+
+    def safe_cb(hook: str, *args):
+        dispatch_safely(callbacks, hook, *args, log=log)
 
     def reader(worker: RemoteWorker):
         while True:
@@ -719,6 +734,7 @@ def run_distributed(
         worker.running[trial.trial_id] = slot
         assignment[trial.trial_id] = worker
         lifecycle.mark_running(trial)
+        safe_cb("on_trial_start", trial)
         try:
             worker.send(
                 {
@@ -753,6 +769,12 @@ def run_distributed(
 
     # ---- main loop ----
     try:
+        # Inside the try so every setup is paired with on_experiment_end in
+        # the finally (a ProfilerCallback's process-global trace must stop
+        # even when the loop dies early); setup errors propagate, matching
+        # tune.run — a misconfigured observer should fail loudly up front.
+        for cb in callbacks:
+            cb.setup(store.root, metric, mode)
         while True:
             while not lifecycle.exhausted() and len(pending) < sum(
                 max(w.free_slots, 0) for w in pool
@@ -779,12 +801,14 @@ def run_distributed(
                 for trial in list(pending):
                     pending.remove(trial)
                     trial.error = "no live workers"
+                    safe_cb("on_trial_error", trial, trial.error)
                     lifecycle.finish(trial, TrialStatus.ERROR)
                 break
 
             try:
                 event = events.get(timeout=0.5)
             except queue.Empty:
+                safe_cb("on_heartbeat")
                 continue
 
             if event[0] == "worker_joined":
@@ -807,7 +831,9 @@ def run_distributed(
                 )
                 for trial in lost:
                     release(trial)
-                    lifecycle.fail_trial(trial, f"worker {worker.address} died")
+                    err = f"worker {worker.address} died"
+                    safe_cb("on_trial_error", trial, err)
+                    lifecycle.fail_trial(trial, err)
                 continue
 
             _, worker, msg = event
@@ -827,6 +853,9 @@ def run_distributed(
                 decision = lifecycle.process_result(
                     trial, msg["metrics"], extra={"hostname": worker.hostname}
                 )
+                # Decision frame FIRST: the worker's report() blocks on it,
+                # so a slow observer must never sit between a result and
+                # its decision (same rule as runner.py's trial threads).
                 try:
                     worker.send(
                         {
@@ -837,15 +866,21 @@ def run_distributed(
                     )
                 except OSError:
                     worker.alive = False  # reader will requeue its trials
+                safe_cb("on_trial_result", trial, trial.last_result)
 
             elif mtype == "complete":
                 release(trial)
-                lifecycle.complete_trial(trial)
+                # complete_trial returns True when the scheduler REQUEUEs
+                # (PBT exploit): the trial keeps living, so no completion
+                # event — same guard as tune.run.
+                if not lifecycle.complete_trial(trial):
+                    safe_cb("on_trial_complete", trial)
                 store.write_state(trials)
 
             elif mtype == "error":
                 trial.error = msg.get("traceback", "unknown error")
                 release(trial)
+                safe_cb("on_trial_error", trial, trial.error)
                 lifecycle.fail_trial(trial, trial.error)
                 store.write_state(trials)
     finally:
@@ -874,6 +909,7 @@ def run_distributed(
             store.close()
         except Exception as exc:  # noqa: BLE001
             log(f"store teardown failed: {exc!r}")
+        safe_cb("on_experiment_end", trials, wall)
 
     analysis = ExperimentAnalysis(
         trials, metric=metric, mode=mode, root=store.root, wall_clock_s=wall

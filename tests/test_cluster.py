@@ -373,3 +373,63 @@ def test_distributed_stop_rules(worker_pool, tmp_path):
     )
     assert analysis.num_terminated() == 3
     assert all(len(t.results) == 2 for t in analysis.trials)
+
+
+def test_distributed_callbacks_and_reporter(worker_pool, tmp_path, capsys):
+    """run_distributed exposes the same observer surface as tune.run: every
+    lifecycle hook fires on the driver thread, and verbose=2 attaches the
+    live trial table."""
+
+    class Recording(tune.Callback):
+        def __init__(self):
+            self.events = []
+
+        def setup(self, root, metric, mode):
+            self.events.append(("setup", metric, mode))
+
+        def on_trial_start(self, trial):
+            self.events.append(("start", trial.trial_id))
+
+        def on_trial_result(self, trial, result):
+            self.events.append(("result", trial.trial_id,
+                                result.get("training_iteration")))
+
+        def on_trial_complete(self, trial):
+            self.events.append(("complete", trial.trial_id))
+
+        def on_trial_error(self, trial, error):
+            self.events.append(("error", trial.trial_id))
+
+        def on_experiment_end(self, trials, wall):
+            self.events.append(("end", len(trials)))
+
+    cb = Recording()
+    analysis = run_distributed(
+        "cluster_trainables:quadratic_trial",
+        {"x": tune.uniform(0.0, 6.0), "epochs": 3},
+        metric="loss", mode="min", num_samples=4,
+        workers=worker_pool,
+        storage_path=str(tmp_path), name="dist_cb", seed=5,
+        verbose=2,
+    )
+    assert analysis.num_terminated() == 4
+    out = capsys.readouterr().out
+    assert "Final result" in out and "best loss:" in out  # verbose=2 table
+
+    # The explicit-callback path sees the full lifecycle.
+    cb2 = Recording()
+    analysis = run_distributed(
+        "cluster_trainables:quadratic_trial",
+        {"x": tune.uniform(0.0, 6.0), "epochs": 2},
+        metric="loss", mode="min", num_samples=3,
+        workers=worker_pool,
+        storage_path=str(tmp_path), name="dist_cb2", seed=6,
+        verbose=0, callbacks=[cb2],
+    )
+    assert analysis.num_terminated() == 3
+    kinds = [e[0] for e in cb2.events]
+    assert kinds[0] == "setup" and cb2.events[0] == ("setup", "loss", "min")
+    assert kinds[-1] == "end"
+    assert kinds.count("start") == 3
+    assert kinds.count("complete") == 3
+    assert kinds.count("result") == 6  # 3 trials x 2 epochs
