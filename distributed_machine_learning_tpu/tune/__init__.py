@@ -39,6 +39,7 @@ from distributed_machine_learning_tpu.tune.schedulers import (
 )
 from distributed_machine_learning_tpu.tune.search import (
     BayesOptSearch,
+    Repeater,
     GridSearch,
     RandomSearch,
     Searcher,
@@ -104,6 +105,7 @@ __all__ = [
     "RandomSearch",
     "GridSearch",
     "BayesOptSearch",
+    "Repeater",
     "TPESearch",
     "WarmStartSearcher",
     "Stopper",

@@ -356,6 +356,15 @@ class TrialLifecycle:
             self.searcher.on_trial_complete(
                 trial.trial_id, trial.config, trial.last_result, self.metric, self.mode
             )
+        else:
+            # Errored trials complete with result=None: model-based
+            # searchers skip the observation (their None-score guard), but
+            # WRAPPING searchers still see the completion — a Repeater
+            # group with a crashed member must dispatch its mean instead of
+            # stalling forever on a report that will never come.
+            self.searcher.on_trial_complete(
+                trial.trial_id, trial.config, None, self.metric, self.mode
+            )
         self.scheduler.on_trial_complete(trial)
 
     def requeue(self, trial: Trial):

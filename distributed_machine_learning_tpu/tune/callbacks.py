@@ -13,6 +13,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from distributed_machine_learning_tpu.tune.trial import Trial
+from distributed_machine_learning_tpu.utils.numeric import finite_number
 from distributed_machine_learning_tpu.utils.logging import (
     JsonlEventLog,
     add_file_handler,
@@ -281,16 +282,15 @@ class ProgressReporter(Callback):
 
     def on_trial_result(self, trial: Trial, result: Dict[str, Any]):
         self._touch(trial)
-        val = result.get(self._metric)
-        if (isinstance(val, (int, float)) and not isinstance(val, bool)
-                and val == val):  # NaN (diverged trial) never becomes best
+        val = finite_number(result.get(self._metric))
+        if val is not None:  # NaN/inf (diverged trial) never becomes best
             better = (
                 self._best_value is None
                 or (self._mode == "min" and val < self._best_value)
                 or (self._mode == "max" and val > self._best_value)
             )
             if better:
-                self._best_value = float(val)
+                self._best_value = val
                 self._best_trial_id = trial.trial_id
         self._maybe_render()
 
@@ -322,9 +322,8 @@ class ProgressReporter(Callback):
         may report None/strings — TensorBoardCallback guards the same way),
         NaN dropped (a diverged epoch must not rank or display)."""
         return [
-            v for v in trial.metric_history(self._metric)
-            if isinstance(v, (int, float)) and not isinstance(v, bool)
-            and v == v
+            f for f in map(finite_number, trial.metric_history(self._metric))
+            if f is not None
         ]
 
     def _maybe_render(self):

@@ -5,7 +5,8 @@ from distributed_machine_learning_tpu.tune.search.base import (
     WarmStartSearcher,
 )
 from distributed_machine_learning_tpu.tune.search.bayesopt import BayesOptSearch
+from distributed_machine_learning_tpu.tune.search.repeater import Repeater
 from distributed_machine_learning_tpu.tune.search.tpe import TPESearch
 
 __all__ = ["Searcher", "RandomSearch", "GridSearch", "BayesOptSearch",
-           "TPESearch", "WarmStartSearcher"]
+           "TPESearch", "WarmStartSearcher", "Repeater"]

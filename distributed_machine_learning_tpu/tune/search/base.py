@@ -91,8 +91,27 @@ class WarmStartSearcher(Searcher):
 
 def maybe_warm_start(searcher: Searcher, points) -> Searcher:
     """The runners' shared ``points_to_evaluate`` hook: wrap when points
-    are given, pass through otherwise."""
-    return WarmStartSearcher(searcher, points) if points else searcher
+    are given, pass through otherwise.
+
+    A ``Repeater`` must stay OUTERMOST: it maps completed trial ids back to
+    repeat groups by index, and a WarmStartSearcher above it would shift
+    the suggest indices without shifting the ids (groups would misalign and
+    means would mix configs).  Composing the warm start INSIDE instead
+    means each point config is itself repeated — the natural semantics for
+    a noisy objective."""
+    if not points:
+        return searcher
+    from distributed_machine_learning_tpu.tune.search.repeater import (
+        Repeater,
+    )
+
+    if isinstance(searcher, Repeater):
+        return Repeater(
+            WarmStartSearcher(searcher.inner, points),
+            repeat=searcher.repeat,
+            seed_key=searcher.seed_key,
+        )
+    return WarmStartSearcher(searcher, points)
 
 
 class RandomSearch(Searcher):

@@ -1,0 +1,106 @@
+"""Repeater: evaluate each suggested config several times, learn from means.
+
+Ray Tune parity (``ray.tune.search.Repeater``): with a noisy objective —
+dropout/init/shuffle randomness at small data sizes — a single trial's
+validation score is a high-variance draw, and a model-based searcher
+(BayesOpt/TPE) fitted on single draws chases noise.  The Repeater wraps any
+searcher: every config it proposes runs ``repeat`` times under different
+seeds, and the wrapped searcher observes ONE completion per config with the
+averaged score, so its model fits the mean objective.
+
+`tune.report`'s per-trial records are unchanged (each repeat is an ordinary
+trial in the experiment directory); only what the wrapped searcher learns is
+aggregated.  Relies on the framework-wide trial naming contract
+``trial_{index:05d}`` with ids minted in suggest order (tune/_driver.py:96,
+vectorized.py, cluster worker protocol) to map completions back to repeat
+groups.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+from distributed_machine_learning_tpu.tune.search.base import Searcher
+from distributed_machine_learning_tpu.tune.search_space import SearchSpace
+from distributed_machine_learning_tpu.utils.numeric import finite_number
+from distributed_machine_learning_tpu.utils.seeding import fold_seed
+
+_TRIAL_ID_RE = re.compile(r"(\d+)$")
+
+
+class Repeater(Searcher):
+    """Wrap ``inner`` so each of its configs runs ``repeat`` times.
+
+    ``seed_key``: the config key the repeats vary (default ``"seed"`` — the
+    trainable's data-shuffle/init/dropout seed).  Repeat #0 keeps the
+    proposed seed; later repeats fold the repeat number into it, so a
+    Repeater sweep is deterministic in the experiment seed.
+    """
+
+    def __init__(self, inner: Searcher, repeat: int = 3,
+                 seed_key: str = "seed"):
+        if repeat < 1:
+            raise ValueError(f"repeat must be >= 1, got {repeat}")
+        self.inner = inner
+        self.repeat = int(repeat)
+        self.seed_key = seed_key
+        self._group_configs: Dict[int, Dict[str, Any]] = {}
+        self._group_scores: Dict[int, List[Optional[float]]] = {}
+
+    def set_search_space(self, space: SearchSpace, seed: int):
+        super().set_search_space(space, seed)
+        self.inner.set_search_space(space, seed)
+
+    def suggest(self, trial_index: int) -> Optional[Dict[str, Any]]:
+        group, k = divmod(trial_index, self.repeat)
+        if group not in self._group_configs:
+            base = self.inner.suggest(group)
+            if base is None:
+                return None
+            self._group_configs[group] = dict(base)
+        config = dict(self._group_configs[group])
+        if k > 0:
+            base_seed = config.get(self.seed_key, 0)
+            config[self.seed_key] = fold_seed(
+                int(base_seed) if base_seed is not None else 0, "repeat", k
+            )
+        return config
+
+    def fast_forward(self, num_trials: int) -> None:
+        # Floor: fully-created groups advance the inner searcher's cursor;
+        # a partially-created group is re-suggested fresh (its members that
+        # DID finish replay through on_trial_complete as usual).
+        self.inner.fast_forward(num_trials // self.repeat)
+
+    def on_trial_result(self, trial_id, config, result, metric, mode):
+        # Intentionally not forwarded: per-epoch values of a single repeat
+        # are exactly the noise the averaging exists to remove.
+        pass
+
+    def on_trial_complete(self, trial_id, config, result, metric, mode):
+        m = _TRIAL_ID_RE.search(trial_id or "")
+        if not m:  # foreign id (not a framework trial): nothing to map
+            return
+        group = int(m.group(1)) // self.repeat
+        eff_metric = getattr(self.inner, "metric", None) or metric
+        value = (
+            finite_number(result.get(eff_metric))
+            if result is not None else None
+        )
+        scores = self._group_scores.setdefault(group, [])
+        scores.append(value)
+        if len(scores) < self.repeat:
+            return
+        finite = [v for v in scores if v is not None]
+        base = self._group_configs.get(group, dict(config))
+        # One completion per GROUP reaches the wrapped searcher: the mean
+        # over the repeats that produced a score (None = errored repeat),
+        # or an errored completion when every repeat failed.
+        mean_result = (
+            {eff_metric: sum(finite) / len(finite)} if finite else None
+        )
+        self.inner.on_trial_complete(
+            f"repeat_group_{group:05d}", base, mean_result, metric, mode
+        )
+        del self._group_scores[group]

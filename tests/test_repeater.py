@@ -1,0 +1,161 @@
+"""Repeater searcher wrapper: noisy objectives evaluated as seed-varied
+repeats, wrapped searcher learns from the group mean
+(ray.tune.search.Repeater parity)."""
+
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu import tune
+from distributed_machine_learning_tpu.tune.search.base import Searcher
+from distributed_machine_learning_tpu.tune.search_space import SearchSpace
+
+
+class SpySearcher(Searcher):
+    """Deterministic inner searcher that records what it observes."""
+
+    def __init__(self):
+        self.suggested = []
+        self.completed = []
+
+    def suggest(self, trial_index):
+        if trial_index >= 3:
+            return None
+        cfg = {"x": float(trial_index), "seed": 100 + trial_index}
+        self.suggested.append(trial_index)
+        return cfg
+
+    def on_trial_complete(self, trial_id, config, result, metric, mode):
+        self.completed.append((trial_id, dict(config), result))
+
+
+def _space():
+    return SearchSpace({"x": tune.uniform(0, 1), "seed": 0})
+
+
+def test_repeater_groups_and_seed_variation():
+    inner = SpySearcher()
+    rep = tune.Repeater(inner, repeat=3)
+    rep.set_search_space(_space(), seed=0)
+    configs = [rep.suggest(i) for i in range(9)]
+    # 3 groups of 3; inner asked exactly once per group.
+    assert inner.suggested == [0, 1, 2]
+    for g in range(3):
+        group = configs[g * 3:(g + 1) * 3]
+        assert all(c["x"] == float(g) for c in group)
+        seeds = [c["seed"] for c in group]
+        assert seeds[0] == 100 + g          # repeat 0 keeps the base seed
+        assert len(set(seeds)) == 3         # later repeats vary it
+    # Inner exhaustion propagates at the group boundary.
+    assert rep.suggest(9) is None
+
+
+def test_repeater_feeds_mean_to_inner():
+    inner = SpySearcher()
+    rep = tune.Repeater(inner, repeat=3)
+    rep.set_search_space(_space(), seed=0)
+    for i in range(3):
+        rep.suggest(i)
+    losses = [2.0, 4.0, 9.0]
+    for i, loss in enumerate(losses):
+        rep.on_trial_complete(
+            f"trial_{i:05d}", {"x": 0.0}, {"loss": loss}, "loss", "min"
+        )
+    assert len(inner.completed) == 1
+    tid, cfg, result = inner.completed[0]
+    assert tid == "repeat_group_00000"
+    assert cfg["x"] == 0.0 and cfg["seed"] == 100  # the BASE config
+    assert result["loss"] == pytest.approx(np.mean(losses))
+
+
+def test_repeater_errored_repeats():
+    """Errored repeats (result None / NaN) are excluded from the mean; a
+    fully-failed group completes the inner searcher with result=None."""
+    inner = SpySearcher()
+    rep = tune.Repeater(inner, repeat=2)
+    rep.set_search_space(_space(), seed=0)
+    rep.suggest(0), rep.suggest(1), rep.suggest(2), rep.suggest(3)
+    rep.on_trial_complete("trial_00000", {}, None, "loss", "min")
+    rep.on_trial_complete("trial_00001", {}, {"loss": 6.0}, "loss", "min")
+    assert inner.completed[-1][2] == {"loss": 6.0}  # mean over survivors
+    rep.on_trial_complete("trial_00002", {}, None, "loss", "min")
+    rep.on_trial_complete("trial_00003", {}, {"loss": float("nan")},
+                          "loss", "min")
+    assert inner.completed[-1][2] is None  # nothing finite: errored group
+
+
+def test_repeater_e2e_with_bayesopt(tmp_results):
+    """Through tune.run: 2x repeats over a noisy quadratic; the experiment
+    runs every repeat as its own trial and the wrapped GP still learns."""
+
+    def noisy(config):
+        rng = np.random.default_rng(config["seed"])
+        loss = (config["x"] - 0.3) ** 2 + 0.05 * rng.standard_normal()
+        tune.report(loss=float(loss))
+
+    inner = tune.BayesOptSearch(random_search_steps=2)
+    analysis = tune.run(
+        noisy, {"x": tune.uniform(0.0, 1.0), "seed": 7},
+        metric="loss", mode="min", num_samples=8,
+        search_alg=tune.Repeater(inner, repeat=2),
+        storage_path=tmp_results, name="repeater_e2e", verbose=0,
+    )
+    assert analysis.num_terminated() == 8
+    # 4 groups of 2: consecutive trials share x but not seeds.
+    xs = [t.config["x"] for t in analysis.trials]
+    seeds = [t.config["seed"] for t in analysis.trials]
+    for g in range(4):
+        assert xs[2 * g] == xs[2 * g + 1]
+        assert seeds[2 * g] != seeds[2 * g + 1]
+    # The GP observed group means: one completion per group.
+    assert len(inner._y) == 4
+
+
+def test_repeater_group_with_crashed_member_still_dispatches(tmp_results):
+    """An ERRORed repeat completes to the searcher with result=None
+    (tune/_driver.py finish), so the group dispatches its mean over the
+    survivors instead of stalling forever."""
+
+    def flaky(config):
+        # SpySearcher's base seeds are 100+group; folded repeat seeds differ
+        # — so exactly the non-first repeat of every group crashes.
+        if config["seed"] not in (100, 101):
+            raise RuntimeError("boom")
+        tune.report(loss=float(config["x"]))
+
+    inner = SpySearcher()
+    tune.run(
+        flaky, {"x": tune.uniform(0.0, 1.0), "seed": 7},
+        metric="loss", mode="min", num_samples=4,
+        search_alg=tune.Repeater(inner, repeat=2),
+        storage_path=tmp_results, name="repeater_flaky", verbose=0,
+    )
+    # Both groups dispatched despite one crashed member each.
+    assert len(inner.completed) == 2
+    for _, cfg, result in inner.completed:
+        assert result == {"loss": pytest.approx(cfg["x"])}
+
+
+def test_repeater_composes_with_points_to_evaluate(tmp_results):
+    """maybe_warm_start keeps the Repeater OUTERMOST (warm start moves
+    inside): the point config is itself repeated, and group/id alignment
+    holds so means map to the right configs."""
+
+    def quadratic(config):
+        tune.report(loss=float((config["x"] - 0.25) ** 2))
+
+    inner = SpySearcher()
+    analysis = tune.run(
+        quadratic, {"x": tune.uniform(0.0, 1.0), "seed": 3},
+        metric="loss", mode="min", num_samples=6,
+        search_alg=tune.Repeater(inner, repeat=2),
+        points_to_evaluate=[{"x": 0.5}],
+        storage_path=tmp_results, name="repeater_points", verbose=0,
+    )
+    assert analysis.num_terminated() == 6
+    xs = [t.config["x"] for t in analysis.trials]
+    assert xs[0] == 0.5 and xs[1] == 0.5  # the point ran `repeat` times
+    # Inner saw one mean per group, each matching that group's config.
+    assert len(inner.completed) == 3
+    for (tid, cfg, result), g in zip(inner.completed, range(3)):
+        assert result["loss"] == pytest.approx((cfg["x"] - 0.25) ** 2)
+    assert inner.completed[0][1]["x"] == 0.5
