@@ -57,8 +57,12 @@ from distributed_machine_learning_tpu.tune.search_space import (
     choice,
     constant,
     loguniform,
+    lograndint,
+    qloguniform,
+    qrandint,
     quniform,
     randint,
+    randn,
     sample_from,
     uniform,
 )
@@ -90,7 +94,11 @@ __all__ = [
     "uniform",
     "loguniform",
     "quniform",
+    "qloguniform",
     "randint",
+    "qrandint",
+    "lograndint",
+    "randn",
     "sample_from",
     "constant",
     "Constraint",

@@ -83,7 +83,15 @@ class Repeater(Searcher):
         if not m:  # foreign id (not a framework trial): nothing to map
             return
         group = int(m.group(1)) // self.repeat
-        eff_metric = getattr(self.inner, "metric", None) or metric
+        # Resolve a searcher-level metric override through WRAPPER layers
+        # (maybe_warm_start may interpose a WarmStartSearcher between this
+        # Repeater and the model-based searcher that owns the override).
+        owner = self.inner
+        while getattr(owner, "metric", None) is None and hasattr(
+            owner, "inner"
+        ):
+            owner = owner.inner
+        eff_metric = getattr(owner, "metric", None) or metric
         value = (
             finite_number(result.get(eff_metric))
             if result is not None else None
@@ -104,3 +112,6 @@ class Repeater(Searcher):
             f"repeat_group_{group:05d}", base, mean_result, metric, mode
         )
         del self._group_scores[group]
+        # A dispatched group can never be suggested or completed again
+        # (indices are monotonic) — don't cache its config forever.
+        self._group_configs.pop(group, None)

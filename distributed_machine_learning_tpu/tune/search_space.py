@@ -168,12 +168,63 @@ class QUniform(Domain):
 
 
 @dataclass(frozen=True)
+class QLogUniform(Domain):
+    low: float
+    high: float
+    q: float
+
+    def __post_init__(self):
+        if self.low <= 0:
+            raise ValueError("qloguniform() requires low > 0")
+
+    def sample(self, rng):
+        v = np.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+        return float(np.clip(np.round(v / self.q) * self.q,
+                             self.low, self.high))
+
+
+@dataclass(frozen=True)
+class Randn(Domain):
+    mean: float = 0.0
+    sd: float = 1.0
+
+    def sample(self, rng):
+        return float(rng.normal(self.mean, self.sd))
+
+
+@dataclass(frozen=True)
 class RandInt(Domain):
     low: int
     high: int  # exclusive, numpy convention
 
     def sample(self, rng):
         return int(rng.integers(self.low, self.high))
+
+
+@dataclass(frozen=True)
+class QRandInt(Domain):
+    low: int
+    high: int  # INCLUSIVE (Ray's convention for qrandint)
+    q: int
+
+    def sample(self, rng):
+        v = rng.integers(self.low, self.high + 1)
+        return int(np.clip(int(round(v / self.q)) * self.q,
+                           self.low, self.high))
+
+
+@dataclass(frozen=True)
+class LogRandInt(Domain):
+    low: int
+    high: int  # exclusive, matching randint
+
+    def __post_init__(self):
+        if self.low <= 0:
+            raise ValueError("lograndint() requires low > 0")
+
+    def sample(self, rng):
+        v = np.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+        return int(np.clip(int(v), self.low, self.high - 1))
 
 
 @dataclass(frozen=True)
@@ -209,8 +260,26 @@ def quniform(low: float, high: float, q: float) -> QUniform:
     return QUniform(low, high, q)
 
 
+def qloguniform(low: float, high: float, q: float) -> QLogUniform:
+    return QLogUniform(low, high, q)
+
+
+def randn(mean: float = 0.0, sd: float = 1.0) -> Randn:
+    return Randn(mean, sd)
+
+
 def randint(low: int, high: int) -> RandInt:
     return RandInt(low, high)
+
+
+def qrandint(low: int, high: int, q: int = 1) -> QRandInt:
+    """Quantized integer; ``high`` INCLUSIVE (Ray's qrandint convention,
+    unlike randint's exclusive numpy convention)."""
+    return QRandInt(low, high, q)
+
+
+def lograndint(low: int, high: int) -> LogRandInt:
+    return LogRandInt(low, high)
 
 
 def sample_from(fn: Callable[[Dict[str, Any]], Any]) -> SampleFrom:

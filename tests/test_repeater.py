@@ -159,3 +159,33 @@ def test_repeater_composes_with_points_to_evaluate(tmp_results):
     for (tid, cfg, result), g in zip(inner.completed, range(3)):
         assert result["loss"] == pytest.approx((cfg["x"] - 0.25) ** 2)
     assert inner.completed[0][1]["x"] == 0.5
+
+
+def test_repeater_metric_override_through_warmstart():
+    """A searcher-level metric override is found through wrapper layers
+    (the warm-start composition interposes a WarmStartSearcher), and
+    dispatched group state is released."""
+    from distributed_machine_learning_tpu.tune.search.base import (
+        maybe_warm_start,
+    )
+
+    class OverrideSpy(SpySearcher):
+        metric = "val_acc"
+        mode = "max"
+
+    inner = OverrideSpy()
+    rep = maybe_warm_start(tune.Repeater(inner, repeat=2),
+                           [{"x": 0.9, "seed": 1}])
+    rep.set_search_space(_space(), seed=0)
+    for i in range(2):
+        rep.suggest(i)
+    for i, acc in enumerate((0.6, 0.8)):
+        rep.on_trial_complete(
+            f"trial_{i:05d}", {"x": 0.9},
+            {"loss": 99.0, "val_acc": acc}, "loss", "min"
+        )
+    assert len(inner.completed) == 1
+    # The group mean is keyed by the OVERRIDE metric, so the inner
+    # searcher's own _effective_score can consume it.
+    assert inner.completed[0][2] == {"val_acc": pytest.approx(0.7)}
+    assert rep._group_configs == {} and rep._group_scores == {}

@@ -290,3 +290,25 @@ def test_hpo_full_space_samples_are_valid():
         assert cfg["num_heads"] % cfg["num_kv_heads"] == 0
         assert cfg["dim_feedforward"] == cfg["d_model"] * cfg["ff_multiplier"]
         assert cfg["position_encoding"] in ("sincos", "rope")
+
+
+def test_extended_domain_menu():
+    """Ray-parity domains beyond the reference's usage: qloguniform, randn,
+    qrandint (INCLUSIVE high, Ray's convention), lograndint."""
+    import numpy as np
+
+    rng = np.random.default_rng(3)
+    for _ in range(200):
+        v = tune.qloguniform(1e-4, 1e-1, 1e-4).sample(rng)
+        assert 1e-4 <= v <= 1e-1
+        assert abs(v / 1e-4 - round(v / 1e-4)) < 1e-6  # quantized
+        q = tune.qrandint(8, 64, 8).sample(rng)
+        assert 8 <= q <= 64 and q % 8 == 0 and isinstance(q, int)
+        li = tune.lograndint(1, 100).sample(rng)
+        assert 1 <= li <= 99 and isinstance(li, int)
+    draws = [tune.randn(5.0, 2.0).sample(rng) for _ in range(500)]
+    assert abs(np.mean(draws) - 5.0) < 0.3
+    assert abs(np.std(draws) - 2.0) < 0.3
+    # log-spread: lograndint mass concentrates at small values
+    lis = [tune.lograndint(1, 1000).sample(rng) for _ in range(500)]
+    assert np.median(lis) < 100
