@@ -21,7 +21,11 @@ from distributed_machine_learning_tpu.tune.schedulers.base import (
     REQUEUE,
     TrialScheduler,
 )
-from distributed_machine_learning_tpu.tune.search_space import Domain, RandInt
+from distributed_machine_learning_tpu.tune.search_space import (
+    Domain,
+    LogRandInt,
+    RandInt,
+)
 from distributed_machine_learning_tpu.tune.trial import Trial
 from distributed_machine_learning_tpu.utils.seeding import rng_from
 
@@ -74,9 +78,9 @@ class PopulationBasedTraining(TrialScheduler):
                         # Direct min/max — no to_unit round-trip, which
                         # would log(0)-crash on a zero value under
                         # loguniform and float-ify int hyperparams.
-                        # RandInt's high is EXCLUSIVE (numpy convention,
-                        # search_space.py): its top legal value is high-1.
-                        if isinstance(spec, RandInt):
+                        # RandInt/LogRandInt highs are EXCLUSIVE (numpy
+                        # convention): their top legal value is high-1.
+                        if isinstance(spec, (RandInt, LogRandInt)):
                             hi = hi - 1
                         val = min(max(val, lo), hi)
                     new[key] = type(new[key])(val)

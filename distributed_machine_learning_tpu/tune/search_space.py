@@ -156,15 +156,33 @@ class LogUniform(Domain):
         return float(math.exp(lo + float(np.clip(u, 0.0, 1.0)) * (hi - lo)))
 
 
+def _q_bounds(low: float, high: float, q: float):
+    """The smallest/largest multiples of q inside [low, high]; raises when
+    no multiple fits (a quantized domain must be able to honor its
+    contract — clipping to a raw bound would silently emit non-multiples,
+    e.g. qrandint(8, 60, 8) yielding 60)."""
+    lo = math.ceil(low / q - 1e-9) * q
+    hi = math.floor(high / q + 1e-9) * q
+    if lo > hi:
+        raise ValueError(
+            f"no multiple of q={q} inside [{low}, {high}]"
+        )
+    return lo, hi
+
+
 @dataclass(frozen=True)
 class QUniform(Domain):
     low: float
     high: float
     q: float
 
+    def __post_init__(self):
+        _q_bounds(self.low, self.high, self.q)
+
     def sample(self, rng):
+        lo, hi = _q_bounds(self.low, self.high, self.q)
         v = rng.uniform(self.low, self.high)
-        return float(np.clip(np.round(v / self.q) * self.q, self.low, self.high))
+        return float(np.clip(np.round(v / self.q) * self.q, lo, hi))
 
 
 @dataclass(frozen=True)
@@ -176,11 +194,12 @@ class QLogUniform(Domain):
     def __post_init__(self):
         if self.low <= 0:
             raise ValueError("qloguniform() requires low > 0")
+        _q_bounds(self.low, self.high, self.q)
 
     def sample(self, rng):
+        lo, hi = _q_bounds(self.low, self.high, self.q)
         v = np.exp(rng.uniform(math.log(self.low), math.log(self.high)))
-        return float(np.clip(np.round(v / self.q) * self.q,
-                             self.low, self.high))
+        return float(np.clip(np.round(v / self.q) * self.q, lo, hi))
 
 
 @dataclass(frozen=True)
@@ -207,10 +226,13 @@ class QRandInt(Domain):
     high: int  # INCLUSIVE (Ray's convention for qrandint)
     q: int
 
+    def __post_init__(self):
+        _q_bounds(self.low, self.high, self.q)
+
     def sample(self, rng):
+        lo, hi = _q_bounds(self.low, self.high, self.q)
         v = rng.integers(self.low, self.high + 1)
-        return int(np.clip(int(round(v / self.q)) * self.q,
-                           self.low, self.high))
+        return int(np.clip(int(round(v / self.q)) * self.q, lo, hi))
 
 
 @dataclass(frozen=True)
@@ -221,6 +243,8 @@ class LogRandInt(Domain):
     def __post_init__(self):
         if self.low <= 0:
             raise ValueError("lograndint() requires low > 0")
+        if self.high <= self.low:  # same contract as randint/rng.integers
+            raise ValueError("lograndint() requires high > low")
 
     def sample(self, rng):
         v = np.exp(rng.uniform(math.log(self.low), math.log(self.high)))

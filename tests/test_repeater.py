@@ -189,3 +189,28 @@ def test_repeater_metric_override_through_warmstart():
     # searcher's own _effective_score can consume it.
     assert inner.completed[0][2] == {"val_acc": pytest.approx(0.7)}
     assert rep._group_configs == {} and rep._group_scores == {}
+
+
+def test_repeater_in_vectorized_runner(tmp_results):
+    """Repeats share the static config, so a Repeater group vmaps into one
+    population program — seeds are exactly the vectorized axis."""
+    from distributed_machine_learning_tpu.data import dummy_regression_data
+
+    train, val = dummy_regression_data(
+        num_samples=96, seq_len=6, num_features=3, seed=4
+    )
+    inner = tune.BayesOptSearch(random_search_steps=1)
+    analysis = tune.run_vectorized(
+        {"model": "mlp", "learning_rate": tune.loguniform(1e-3, 1e-1),
+         "num_epochs": 2, "batch_size": 32, "seed": 11},
+        train_data=train, val_data=val,
+        metric="validation_loss", num_samples=6, max_batch_trials=6,
+        search_alg=tune.Repeater(inner, repeat=3),
+        storage_path=tmp_results, name="repeater_vec", verbose=0,
+    )
+    assert analysis.num_terminated() == 6
+    lrs = [t.config["learning_rate"] for t in analysis.trials]
+    seeds = [t.config["seed"] for t in analysis.trials]
+    assert lrs[0] == lrs[1] == lrs[2] and lrs[3] == lrs[4] == lrs[5]
+    assert len(set(seeds[:3])) == 3  # the repeats vary only the seed
+    assert len(inner._y) == 2        # one observation per group

@@ -312,3 +312,40 @@ def test_extended_domain_menu():
     # log-spread: lograndint mass concentrates at small values
     lis = [tune.lograndint(1, 1000).sample(rng) for _ in range(500)]
     assert np.median(lis) < 100
+
+
+def test_quantized_domains_unaligned_bounds_and_degenerate_ranges():
+    """Review findings: quantized domains always emit multiples of q even
+    at unaligned bounds; impossible quantized ranges and degenerate
+    lograndint ranges raise at construction."""
+    import numpy as np
+    import pytest
+
+    rng = np.random.default_rng(5)
+    for _ in range(300):
+        v = tune.qrandint(8, 60, 8).sample(rng)   # 60 is not a multiple
+        assert v % 8 == 0 and 8 <= v <= 56
+        u = tune.quniform(0.15, 1.0, 0.1).sample(rng)
+        assert abs(u / 0.1 - round(u / 0.1)) < 1e-9 and 0.2 <= u <= 1.0
+        w = tune.qloguniform(3e-4, 1e-1, 1e-3).sample(rng)
+        assert abs(w / 1e-3 - round(w / 1e-3)) < 1e-9 and 1e-3 <= w <= 0.1
+    with pytest.raises(ValueError):
+        tune.qrandint(9, 15, 8)     # no multiple of 8 in [9, 15]
+    with pytest.raises(ValueError):
+        tune.lograndint(5, 5)       # degenerate, like randint(5, 5)
+
+
+def test_pbt_lograndint_clamp_respects_exclusive_high():
+    from distributed_machine_learning_tpu import tune as t
+
+    s = t.PopulationBasedTraining(
+        metric="loss", mode="min", perturbation_interval=1,
+        hyperparam_mutations={"units": t.lograndint(16, 256)},
+        resample_probability=0.0,
+    )
+    import numpy as np
+
+    rng = np.random.default_rng(3)
+    for _ in range(30):
+        new = s._mutate({"units": 240}, rng)
+        assert 16 <= new["units"] <= 255 and isinstance(new["units"], int)
