@@ -83,6 +83,14 @@ class PopulationBasedTraining(TrialScheduler):
                         if isinstance(spec, (RandInt, LogRandInt)):
                             hi = hi - 1
                         val = min(max(val, lo), hi)
+                        q = getattr(spec, "q", None)
+                        if q:
+                            # Quantized domains: a multiplied-then-clamped
+                            # value must snap back onto the q grid inside
+                            # the domain (sample() guarantees multiples;
+                            # explore must not reintroduce non-multiples).
+                            val = min(max(round(val / q) * q, spec._lo),
+                                      spec._hi)
                     new[key] = type(new[key])(val)
                 else:
                     new[key] = spec.sample(rng)

@@ -160,9 +160,14 @@ def _q_bounds(low: float, high: float, q: float):
     """The smallest/largest multiples of q inside [low, high]; raises when
     no multiple fits (a quantized domain must be able to honor its
     contract — clipping to a raw bound would silently emit non-multiples,
-    e.g. qrandint(8, 60, 8) yielding 60)."""
-    lo = math.ceil(low / q - 1e-9) * q
-    hi = math.floor(high / q + 1e-9) * q
+    e.g. qrandint(8, 60, 8) yielding 60).
+
+    Float noise is absorbed RELATIVELY (rounding the low/q ratio), so a
+    tiny positive low under a much larger q still maps to the first
+    positive multiple instead of collapsing to 0 (qloguniform must never
+    emit 0 from a low > 0 domain)."""
+    lo = math.ceil(round(low / q, 9)) * q
+    hi = math.floor(round(high / q, 9)) * q
     if lo > hi:
         raise ValueError(
             f"no multiple of q={q} inside [{low}, {high}]"
@@ -177,12 +182,14 @@ class QUniform(Domain):
     q: float
 
     def __post_init__(self):
-        _q_bounds(self.low, self.high, self.q)
+        lo, hi = _q_bounds(self.low, self.high, self.q)
+        object.__setattr__(self, "_lo", lo)
+        object.__setattr__(self, "_hi", hi)
 
     def sample(self, rng):
-        lo, hi = _q_bounds(self.low, self.high, self.q)
         v = rng.uniform(self.low, self.high)
-        return float(np.clip(np.round(v / self.q) * self.q, lo, hi))
+        return float(np.clip(np.round(v / self.q) * self.q,
+                             self._lo, self._hi))
 
 
 @dataclass(frozen=True)
@@ -194,12 +201,14 @@ class QLogUniform(Domain):
     def __post_init__(self):
         if self.low <= 0:
             raise ValueError("qloguniform() requires low > 0")
-        _q_bounds(self.low, self.high, self.q)
+        lo, hi = _q_bounds(self.low, self.high, self.q)
+        object.__setattr__(self, "_lo", max(lo, self.q))  # never 0 from log
+        object.__setattr__(self, "_hi", hi)
 
     def sample(self, rng):
-        lo, hi = _q_bounds(self.low, self.high, self.q)
         v = np.exp(rng.uniform(math.log(self.low), math.log(self.high)))
-        return float(np.clip(np.round(v / self.q) * self.q, lo, hi))
+        return float(np.clip(np.round(v / self.q) * self.q,
+                             self._lo, self._hi))
 
 
 @dataclass(frozen=True)
@@ -227,12 +236,14 @@ class QRandInt(Domain):
     q: int
 
     def __post_init__(self):
-        _q_bounds(self.low, self.high, self.q)
+        lo, hi = _q_bounds(self.low, self.high, self.q)
+        object.__setattr__(self, "_lo", int(lo))
+        object.__setattr__(self, "_hi", int(hi))
 
     def sample(self, rng):
-        lo, hi = _q_bounds(self.low, self.high, self.q)
         v = rng.integers(self.low, self.high + 1)
-        return int(np.clip(int(round(v / self.q)) * self.q, lo, hi))
+        return int(np.clip(int(round(v / self.q)) * self.q,
+                           self._lo, self._hi))
 
 
 @dataclass(frozen=True)

@@ -183,9 +183,9 @@ def train_regressor(
         else None
     )
     devices = session.get_devices()
+    device = devices[0] if devices else jax.devices()[0]
     peak = device_peak_flops(
-        devices[0] if devices else jax.devices()[0],
-        str(config.get("compute_dtype", "float32")),
+        device, str(config.get("compute_dtype", "float32"))
     )
     tracker = get_tracker()
 
@@ -222,6 +222,15 @@ def train_regressor(
             _time.time() - t0 - (tracker.thread_seconds() - c0), 1e-9
         )
         record["epoch_time_s"] = round(exec_s, 4)
+        # Device-memory watermark (TPU HBM; None on CPU): catches per-epoch
+        # memory creep — leaked buffers, donation regressions — in the
+        # ordinary metric stream where TB/analyze can plot it.
+        try:
+            stats = device.memory_stats()
+            if stats and "bytes_in_use" in stats:
+                record["device_bytes_in_use"] = int(stats["bytes_in_use"])
+        except Exception:  # noqa: BLE001 - never fail an epoch on telemetry
+            pass
         if epoch_flops is not None:
             record["epoch_flops"] = epoch_flops
             if peak:

@@ -349,3 +349,24 @@ def test_pbt_lograndint_clamp_respects_exclusive_high():
     for _ in range(30):
         new = s._mutate({"units": 240}, rng)
         assert 16 <= new["units"] <= 255 and isinstance(new["units"], int)
+
+
+def test_qloguniform_tiny_low_never_emits_zero_and_pbt_snaps_to_grid():
+    """Review findings: a tiny positive low under a larger q maps to the
+    first positive multiple (never 0.0); PBT explores stay on the q grid."""
+    import numpy as np
+
+    rng = np.random.default_rng(6)
+    dom = tune.qloguniform(1e-12, 1e-1, 1e-3)
+    vals = [dom.sample(rng) for _ in range(300)]
+    assert min(vals) >= 1e-3  # log-mass at tiny v snaps UP, not to 0
+
+    s = tune.PopulationBasedTraining(
+        metric="loss", mode="min", perturbation_interval=1,
+        hyperparam_mutations={"bs": tune.qrandint(8, 60, 8)},
+        resample_probability=0.0,
+    )
+    for _ in range(40):
+        new = s._mutate({"bs": 56}, rng)
+        assert new["bs"] % 8 == 0 and 8 <= new["bs"] <= 56
+        assert isinstance(new["bs"], int)
