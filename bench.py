@@ -851,11 +851,13 @@ def child_flagship() -> None:
     }
     peak = device_peak_flops(jax.devices()[0], compute_dtype="bfloat16")
 
-    def measure(cfg: dict) -> dict:
+    def measure(cfg: dict, batch: int = B) -> dict:
         model = build_model(dict(cfg))
         rng = jax.random.PRNGKey(0)
-        x = jnp.asarray(np.random.RandomState(0).randn(B, S, F), jnp.float32)
-        y = jnp.asarray(np.random.RandomState(1).randn(B, 1), jnp.float32)
+        x = jnp.asarray(np.random.RandomState(0).randn(batch, S, F),
+                        jnp.float32)
+        y = jnp.asarray(np.random.RandomState(1).randn(batch, 1),
+                        jnp.float32)
         params = model.init({"params": rng, "dropout": rng}, x,
                             deterministic=True)["params"]
         tx = optax.adam(1e-3)
@@ -889,7 +891,7 @@ def child_flagship() -> None:
             cell_s.append((time.time() - t0) / steps_per_cell)
         step_s = _median(cell_s)
         cell_s.sort()
-        flops = train_step_flops(cfg, B, S, F)
+        flops = train_step_flops(cfg, batch, S, F)
         return {
             "step_s": round(step_s, 5),
             "step_s_spread": [round(cell_s[0], 5), round(cell_s[-1], 5)],
@@ -925,7 +927,26 @@ def child_flagship() -> None:
         out["gqa_kv2"] = gqa
     except Exception as exc:  # noqa: BLE001 - MHA number still stands
         out["gqa_kv2"] = {"error": repr(exc)[-300:]}
-    print(json.dumps(out))
+    print(json.dumps(out), flush=True)
+    # Batch scaling: the MXU's utilization rises with the M dimension; a
+    # B16 variant often beats B8's MFU at this shape.  Measured last (its
+    # own compile), printed incrementally, and PROMOTED to the headline
+    # step/MFU when it wins — the artifact self-selects the best honest
+    # single-chip number (config recorded either way).
+    try:
+        b2 = FLAGSHIP["batch"] * 2
+        bx2 = measure(base_cfg, batch=b2)
+        bx2["batch"] = b2
+        out["batch_x2"] = bx2
+        if bx2["mfu"] and out["mfu"] and bx2["mfu"] > out["mfu"]:
+            # Promote EVERY per-run field the variant shares with the base
+            # record (a hand-picked subset would mix two configs' numbers
+            # under one config), then stamp the winning batch.
+            out.update({k: v for k, v in bx2.items() if k in out})
+            out["config"] = dict(out["config"], batch=b2)
+    except Exception as exc:  # noqa: BLE001 - base result still stands
+        out["batch_x2"] = {"error": repr(exc)[-300:]}
+    print(json.dumps(out), flush=True)
 
 
 # ---------------------------------------------------------------------------
