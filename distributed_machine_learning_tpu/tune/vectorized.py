@@ -865,7 +865,19 @@ def _progress_note(msg: str) -> None:
     children set it). jit work is silent from the host side — on a remote
     backend a stalled trace/compile/execute is indistinguishable from a
     dead tunnel without these boundary notes (2026-07-31 stall: a sweep
-    died at its timeout with no way to tell WHICH phase hung)."""
+    died at its timeout with no way to tell WHICH phase hung).
+
+    When ``DML_BENCH_HEARTBEAT_PATH`` is set (bench suite children), every
+    dispatch boundary also refreshes that file's mtime: the bench parent
+    kills a child on heartbeat staleness, and a chunked sweep making real
+    per-epoch progress must register as alive between its phase notes."""
+    hb = os.environ.get("DML_BENCH_HEARTBEAT_PATH")
+    if hb:
+        try:
+            with open(hb, "w") as f:
+                f.write(repr(time.time()))
+        except OSError:
+            pass
     if (os.environ.get("DML_TUNE_PROGRESS") or "0") != "0":
         print(f"[tune.progress +{time.monotonic() - _PROGRESS_T0:.1f}s] {msg}",
               file=sys.stderr, flush=True)

@@ -123,33 +123,41 @@ def test_main_cpu_fallback_emit_fields(monkeypatch, capsys):
     assert "cpu_sweep_s" in line["phases"] and "torch_s" in line["phases"]
 
 
-def test_main_tpu_path_includes_flagship(monkeypatch, capsys):
-    """Probe OK -> both-dtype sweeps + flagship child run; flagship lands
-    in the emit; headline is the faster dtype."""
-    def sweep(dtype, tph):
-        return {
-            "trials_per_hour": tph, "wall_s": 20.0, "cold_wall_s": 35.0,
-            "trials_per_hour_cold": tph / 2, "warm_walls_s": [20.0],
-            "wall_spread_s": [19.0, 21.0], "compile_s": 12.0,
-            "device_utilization": 0.9, "done": 50, "flops": 5e15,
-            "best_mape": 9.0, "platform": "tpu", "compute_dtype": dtype,
-            "peak_flops": 9.85e13,
-        }
+def _sweep_stub(dtype, tph):
+    return {
+        "trials_per_hour": tph, "wall_s": 20.0, "cold_wall_s": 35.0,
+        "trials_per_hour_cold": tph / 2, "warm_walls_s": [20.0],
+        "wall_spread_s": [19.0, 21.0], "compile_s": 12.0,
+        "device_utilization": 0.9, "done": 50, "flops": 5e15,
+        "best_mape": 9.0, "platform": "tpu", "compute_dtype": dtype,
+        "peak_flops": 9.85e13,
+    }
 
-    flagship = {"step_s": 0.03, "mfu": 0.35, "platform": "tpu"}
+
+def test_main_tpu_path_includes_flagship(monkeypatch, capsys):
+    """Probe OK -> ONE monitored suite child carries flagship + both
+    sweeps; flagship lands in the emit; headline is the faster dtype."""
+    suite = {
+        "flagship": {"step_s": 0.03, "mfu": 0.35, "platform": "tpu"},
+        "sweeps": {
+            "float32": _sweep_stub("float32", 9000.0),
+            "bfloat16": _sweep_stub("bfloat16", 7000.0),
+        },
+    }
+
+    def fake_monitored(args, env, timeout_s, hb_path, stale_s):
+        assert args == ["--child", "suite", "full"]
+        assert env["DML_BENCH_HEARTBEAT_PATH"] == hb_path
+        return 0, json.dumps(suite), "", True
 
     def fake_run_child(args, env, timeout_s):
         if args == ["--child", "probe"]:
             return 0, "probe OK: 1 x tpu", "", True
-        if args[:2] == ["--child", "ours"] and args[2] == "full":
-            tph = 9000.0 if args[3] == "float32" else 7000.0
-            return 0, json.dumps(sweep(args[3], tph)), "", True
-        if args == ["--child", "flagship"]:
-            return 0, json.dumps(flagship), "", True
         if args[:2] == ["--child", "torch"]:
             return 0, json.dumps({"trials_per_hour": 70.0}), "", True
         raise AssertionError(f"unexpected child {args}")
 
+    monkeypatch.setattr(bench, "_run_child_monitored", fake_monitored)
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
     monkeypatch.setenv("DML_TUNNEL_PYTHONPATH", "/fake/.axon_site")
     bench.main()
@@ -161,207 +169,182 @@ def test_main_tpu_path_includes_flagship(monkeypatch, capsys):
     assert "alt_bfloat16" in line
     assert line["mfu"] is not None
     assert "cpu_note" not in line
+    assert "tpu_suite_s" in line["phases"]
 
 
-def test_tpu_suite_recovers_partial_sweep(monkeypatch):
-    """A sweep child killed at its timeout (rc=124, no stdout JSON) still
-    contributes the phases it completed: the parent reads the partial-result
-    file the child checkpoints after every phase (2026-07-31 tunnel stall)."""
-    def fake_run_child(args, env, timeout_s):
-        if args == ["--child", "flagship"]:
-            return 0, json.dumps({"step_s": 0.03, "mfu": 0.4}), "", True
-        if args == ["--child", "probe"]:
-            return 0, "probe OK: 1 x tpu", "", True  # post-stall probe
-        if args[:2] == ["--child", "ours"]:
-            # Child "dies" at its timeout — but it checkpointed a partial
-            # result (cold sweep done, warm repeats lost) before the kill.
-            assert env["DML_BENCH_CHILD_BUDGET_S"] == "840"
-            if args[3] == "float32":
-                with open(env["DML_BENCH_PARTIAL_PATH"], "w") as f:
-                    json.dump({
-                        "trials_per_hour": 4000.0, "wall_s": 45.0,
-                        "cold_wall_s": 45.0, "done": 50, "flops": 5e15,
-                        "best_mape": 10.0, "compute_dtype": "float32",
-                        "partial": True,
-                    }, f)
-            return 124, "", "SIGTERMed", True
-        raise AssertionError(f"unexpected child {args}")
+def test_tpu_suite_resumes_after_stall_with_partial(monkeypatch):
+    """A suite child killed at heartbeat-staleness (rc=124, no stdout)
+    leaves flagship + the f32 sweep in the partial file; the post-stall
+    probe answers, and the chunked resume child finishes bf16 — the final
+    result carries all three phases (2026-07-31 single-claim redesign)."""
+    calls = []
 
-    monkeypatch.setattr(bench, "_run_child", fake_run_child)
-    try:
-        ours, others, flagship, tunnel_ok = bench._run_tpu_suite(
-            lambda m: None, {}
+    def fake_monitored(args, env, timeout_s, hb_path, stale_s):
+        calls.append(("suite", env.get("DML_BENCH_EPD")))
+        partial = env["DML_BENCH_PARTIAL_PATH"]
+        if env.get("DML_BENCH_EPD") is None:
+            # First child: flagship + f32 landed, then the bf16 cold
+            # dispatch hung -> killed stale; partial survives.
+            with open(partial, "w") as f:
+                json.dump({
+                    "flagship": {"step_s": 0.03, "mfu": 0.4},
+                    "sweeps": {"float32": _sweep_stub("float32", 9000.0)},
+                }, f)
+            return 124, "", "heartbeat stale", True
+        # Resume child: reads the partial, skips done phases, adds bf16.
+        with open(partial) as f:
+            suite = json.load(f)
+        assert sorted(suite["sweeps"]) == ["float32"]
+        suite["sweeps"]["bfloat16"] = dict(
+            _sweep_stub("bfloat16", 5000.0), epochs_per_dispatch=1
         )
-    finally:
-        for dtype in ("float32", "bfloat16"):
-            path = f"/tmp/bench_partial_{dtype}_{os.getpid()}.json"
-            if os.path.exists(path):
-                os.unlink(path)
+        return 0, json.dumps(suite), "", True
+
+    def fake_run_child(args, env, timeout_s):
+        assert args == ["--child", "probe"]
+        calls.append(("probe", None))
+        return 0, "probe OK: 1 x tpu", "", True
+
+    monkeypatch.setattr(bench, "_run_child_monitored", fake_monitored)
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    phases = {}
+    ours, others, flagship, tunnel_ok = bench._run_tpu_suite(
+        lambda m: None, phases
+    )
+    assert calls == [("suite", None), ("probe", None), ("suite", "1")]
     assert tunnel_ok is True
-    assert ours is not None and ours["partial"] is True
-    assert ours["trials_per_hour"] == 4000.0  # recovered, not forfeited
-    assert others == []  # bf16 child had no partial file -> dropped
     assert flagship["mfu"] == 0.4
+    assert ours["trials_per_hour"] == 9000.0
+    assert len(others) == 1 and others[0]["compute_dtype"] == "bfloat16"
+    assert "tpu_suite_s" in phases and "tpu_suite_chunked_s" in phases
 
 
-def test_tpu_suite_chunked_retry_after_empty_failure(monkeypatch):
-    """A sweep child that produces NOTHING (no stdout, no partial — the
-    whole-budget program never finished its cold sweep) is retried once
-    with chunked dispatch; once chunked gets through, the other dtype goes
-    straight to chunked mode."""
+def test_tpu_suite_keeps_flagship_when_resume_also_stalls(monkeypatch):
+    """Both the first suite child AND the chunked resume produce no sweeps
+    (dead tunnel day): the flagship recovered from the partial file still
+    carries the round's TPU evidence; ours=None so main() falls to CPU."""
     calls = []
 
-    def fake_run_child(args, env, timeout_s):
-        if args == ["--child", "flagship"]:
-            return 0, json.dumps({"step_s": 0.03, "mfu": 0.4}), "", True
-        if args == ["--child", "probe"]:
-            calls.append(("probe", None))
-            return 0, "probe OK: 1 x tpu", "", True  # post-stall probe
-        if args[:2] == ["--child", "ours"]:
-            calls.append((args[3], env.get("DML_BENCH_EPD")))
-            if env.get("DML_BENCH_EPD") == "1":  # chunked gets through
-                return 0, json.dumps({
-                    "trials_per_hour": 3000.0, "wall_s": 60.0, "done": 50,
-                    "flops": 5e15, "best_mape": 11.0,
-                    "compute_dtype": args[3], "epochs_per_dispatch": 1,
-                }), "", True
-            return 124, "", "stalled", True  # whole-budget never finishes
-        raise AssertionError(f"unexpected child {args}")
-
-    monkeypatch.setattr(bench, "_run_child", fake_run_child)
-    phases = {}
-    ours, others, flagship, tunnel_ok = bench._run_tpu_suite(
-        lambda m: None, phases
-    )
-    assert tunnel_ok is True
-    assert calls == [
-        ("float32", None),   # whole-budget stalls
-        ("probe", None),     # post-stall probe: tunnel alive
-        ("float32", "1"),    # chunked retry succeeds
-        ("bfloat16", "1"),   # bf16 skips straight to chunked
-    ]
-    assert ours is not None and ours["trials_per_hour"] == 3000.0
-    assert len(others) == 1  # both dtypes landed via chunked dispatch
-    assert "tpu_sweep_float32_chunked_s" in phases
-
-
-def test_tpu_suite_two_empty_failures_skip_remaining(monkeypatch):
-    """Whole-budget AND chunked-retry children both produce nothing ->
-    the bfloat16 sweep is skipped entirely (bounded bench wall on a dead
-    tunnel) with the skip recorded in phases; the flagship still stands."""
-    calls = []
+    def fake_monitored(args, env, timeout_s, hb_path, stale_s):
+        calls.append(("suite", env.get("DML_BENCH_EPD")))
+        if env.get("DML_BENCH_EPD") is None:
+            with open(env["DML_BENCH_PARTIAL_PATH"], "w") as f:
+                json.dump({"flagship": {"step_s": 0.03, "mfu": 0.4},
+                           "sweeps": {}}, f)
+        return 124, "", "heartbeat stale", True
 
     def fake_run_child(args, env, timeout_s):
-        if args == ["--child", "flagship"]:
-            return 0, json.dumps({"step_s": 0.03, "mfu": 0.4}), "", True
-        if args == ["--child", "probe"]:
-            calls.append(("probe", None))
-            return 0, "probe OK: 1 x tpu", "", True  # tunnel answers...
-        if args[:2] == ["--child", "ours"]:
-            calls.append((args[3], env.get("DML_BENCH_EPD")))
-            return 124, "", "stalled", True  # ...but sweeps never finish
-        raise AssertionError(f"unexpected child {args}")
+        assert args == ["--child", "probe"]
+        calls.append(("probe", None))
+        return 0, "probe OK: 1 x tpu", "", True
 
+    monkeypatch.setattr(bench, "_run_child_monitored", fake_monitored)
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
-    phases = {}
-    ours, others, flagship, tunnel_ok = bench._run_tpu_suite(
-        lambda m: None, phases
-    )
-    assert calls == [
-        ("float32", None),   # whole-budget stalls empty
-        ("probe", None),     # post-stall probe says tunnel is alive
-        ("float32", "1"),    # chunked retry also stalls empty
-    ]                        # bfloat16 never launched
-    assert ours is None and others == []
-    assert phases["tpu_sweep_bfloat16_skipped"] == "tunnel not moving sweeps"
-    assert flagship["mfu"] == 0.4 and tunnel_ok is True
-
-
-def test_tpu_suite_skips_retry_when_tunnel_wedged(monkeypatch):
-    """If the post-stall probe fails, the chunked retry is NOT burned
-    against a wedged tunnel; both its skip and the bfloat16 skip land in
-    phases."""
-    calls = []
-
-    def fake_run_child(args, env, timeout_s):
-        if args == ["--child", "flagship"]:
-            return 0, json.dumps({"step_s": 0.03, "mfu": 0.4}), "", True
-        if args == ["--child", "probe"]:
-            calls.append("probe")
-            return 124, "", "hung", True  # post-SIGTERM wedge
-        if args[:2] == ["--child", "ours"]:
-            calls.append(args[3])
-            return 124, "", "stalled", True
-        raise AssertionError(f"unexpected child {args}")
-
-    monkeypatch.setattr(bench, "_run_child", fake_run_child)
-    phases = {}
-    ours, others, flagship, tunnel_ok = bench._run_tpu_suite(
-        lambda m: None, phases
-    )
-    assert calls == ["float32", "probe"]  # no retry, no bfloat16
-    assert ours is None
-    assert phases["tpu_sweep_float32_retry_skipped"] == (
-        "post-stall probe failed"
-    )
-    assert phases["tpu_sweep_bfloat16_skipped"] == "tunnel not moving sweeps"
-
-
-def test_tpu_suite_recovers_flagship_printed_before_timeout(monkeypatch):
-    """The flagship child prints its MHA result before attempting the GQA
-    variant; if the variant hangs the child to rc=124, the parent still
-    recovers the printed result and marks it partial."""
-    def fake_run_child(args, env, timeout_s):
-        if args == ["--child", "flagship"]:
-            return 124, json.dumps({"step_s": 0.03, "mfu": 0.41}) + "\n", \
-                "gqa variant hung", True
-        if args[:2] == ["--child", "ours"]:
-            return 0, json.dumps({
-                "trials_per_hour": 9000.0, "wall_s": 20.0, "done": 50,
-                "flops": 5e15, "best_mape": 9.0, "platform": "tpu",
-                "compute_dtype": args[3], "peak_flops": 9.85e13,
-            }), "", True
-        raise AssertionError(f"unexpected child {args}")
-
-    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     ours, others, flagship, tunnel_ok = bench._run_tpu_suite(
         lambda m: None, {}
     )
-    assert flagship["mfu"] == 0.41 and flagship["partial"] is True
-    assert ours is not None and tunnel_ok is True
+    assert calls == [("suite", None), ("probe", None), ("suite", "1")]
+    assert ours is None and others == []
+    assert flagship["mfu"] == 0.4  # recovered from the partial, twice
+    assert tunnel_ok is True
+
+
+def test_tpu_suite_skips_resume_when_tunnel_wedged(monkeypatch):
+    """If the post-stall probe fails, the chunked resume is NOT burned
+    against a wedged tunnel; the skip lands in phases and the partial's
+    phases still count."""
+    calls = []
+
+    def fake_monitored(args, env, timeout_s, hb_path, stale_s):
+        calls.append("suite")
+        with open(env["DML_BENCH_PARTIAL_PATH"], "w") as f:
+            json.dump({
+                "flagship": {"step_s": 0.03, "mfu": 0.4},
+                "sweeps": {"float32": _sweep_stub("float32", 8000.0)},
+            }, f)
+        return 124, "", "heartbeat stale", True
+
+    def fake_run_child(args, env, timeout_s):
+        assert args == ["--child", "probe"]
+        calls.append("probe")
+        return 124, "", "hung", True  # post-SIGTERM wedge
+
+    monkeypatch.setattr(bench, "_run_child_monitored", fake_monitored)
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    phases = {}
+    ours, others, flagship, tunnel_ok = bench._run_tpu_suite(
+        lambda m: None, phases
+    )
+    assert calls == ["suite", "probe"]  # no resume against a wedge
+    assert phases["tpu_suite_resume_skipped"] == "post-stall probe failed"
+    assert ours["trials_per_hour"] == 8000.0  # partial f32 still counts
+    assert flagship["mfu"] == 0.4
+    assert tunnel_ok is True
 
 
 def test_tpu_suite_zombie_post_stall_probe_stops_suite(monkeypatch):
     """A post-stall probe whose child survives the signals (exited=False)
-    means a zombie still holds the tunnel: no retry, no bfloat16, and
-    tunnel_ok=False so main() won't launch further tunnel children."""
+    means a zombie still holds the tunnel: no resume, and tunnel_ok=False
+    so main() won't launch further tunnel children."""
     calls = []
 
-    def fake_run_child(args, env, timeout_s):
-        if args == ["--child", "flagship"]:
-            return 0, json.dumps({"step_s": 0.03, "mfu": 0.4}), "", True
-        if args == ["--child", "probe"]:
-            calls.append("probe")
-            return 124, "", "still running", False  # zombie claimant
-        if args[:2] == ["--child", "ours"]:
-            calls.append(args[3])
-            return 124, "", "stalled", True
-        raise AssertionError(f"unexpected child {args}")
+    def fake_monitored(args, env, timeout_s, hb_path, stale_s):
+        calls.append("suite")
+        return 124, "", "nothing at all", True
 
+    def fake_run_child(args, env, timeout_s):
+        assert args == ["--child", "probe"]
+        calls.append("probe")
+        return 124, "", "still running", False  # zombie claimant
+
+    monkeypatch.setattr(bench, "_run_child_monitored", fake_monitored)
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    ours, others, flagship, tunnel_ok = bench._run_tpu_suite(
+        lambda m: None, {}
+    )
+    assert calls == ["suite", "probe"]  # nothing launched past the zombie
+    assert ours is None and others == [] and flagship is None
+    assert tunnel_ok is False
+
+
+def test_tpu_suite_zombie_suite_child_stops_everything(monkeypatch):
+    """A suite child that survives SIGTERM+SIGINT (exited=False) still
+    holds the tunnel: no probe, no resume, tunnel_ok=False — but the
+    partial it checkpointed is kept."""
+    def fake_monitored(args, env, timeout_s, hb_path, stale_s):
+        with open(env["DML_BENCH_PARTIAL_PATH"], "w") as f:
+            json.dump({"flagship": {"mfu": 0.39}, "sweeps": {}}, f)
+        return 124, "", "survived signals", False
+
+    def fake_run_child(args, env, timeout_s):
+        raise AssertionError("no more children after a zombie suite")
+
+    monkeypatch.setattr(bench, "_run_child_monitored", fake_monitored)
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
     ours, others, flagship, tunnel_ok = bench._run_tpu_suite(
         lambda m: None, {}
     )
-    assert calls == ["float32", "probe"]  # nothing launched past the zombie
-    assert ours is None and others == []
     assert tunnel_ok is False
-    assert flagship["mfu"] == 0.4
+    assert ours is None and flagship["mfu"] == 0.39
 
 
 def test_main_late_reprobe_recovers_tpu(monkeypatch, capsys):
     """First probe window fails, CPU fallback runs, the LATE re-probe
     succeeds -> the TPU suite still runs and headlines the round."""
     state = {"probes": 0}
+
+    def fake_monitored(args, env, timeout_s, hb_path, stale_s):
+        assert args == ["--child", "suite", "full"]
+        return 0, json.dumps({
+            "flagship": {"step_s": 0.03, "mfu": 0.4},
+            "sweeps": {"float32": dict(
+                _sweep_stub("float32", 8000.0), wall_s=22.0
+            )},
+        }), "", True
 
     def fake_run_child(args, env, timeout_s):
         if args == ["--child", "probe"]:
@@ -374,18 +357,11 @@ def test_main_late_reprobe_recovers_tpu(monkeypatch, capsys):
                 "flops": 1e12, "best_mape": 20.0, "platform": "cpu",
                 "compute_dtype": "float32", "peak_flops": None,
             }), "", True
-        if args[:2] == ["--child", "ours"] and args[2] == "full":
-            return 0, json.dumps({
-                "trials_per_hour": 8000.0, "wall_s": 22.0, "done": 50,
-                "flops": 5e15, "best_mape": 9.0, "platform": "tpu",
-                "compute_dtype": args[3], "peak_flops": 9.85e13,
-            }), "", True
-        if args == ["--child", "flagship"]:
-            return 0, json.dumps({"step_s": 0.03, "mfu": 0.4}), "", True
         if args[:2] == ["--child", "torch"]:
             return 0, json.dumps({"trials_per_hour": 70.0}), "", True
         raise AssertionError(f"unexpected child {args}")
 
+    monkeypatch.setattr(bench, "_run_child_monitored", fake_monitored)
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     monkeypatch.setenv("DML_TUNNEL_PYTHONPATH", "/fake/.axon_site")
@@ -431,6 +407,41 @@ def test_variant_partial_recovers_terminated_trials(tmp_path, monkeypatch):
     assert bench._variant_partial("bohb_transformer", exp, t_start) is None
     # No experiment dir at all (child died before tune.run created it).
     assert bench._variant_partial("bohb_transformer", "absent", t_start) is None
+
+
+def test_child_suite_end_to_end_tiny(monkeypatch, tmp_path, capsys):
+    """child_suite for real at tiny shapes on CPU: one process produces
+    flagship + both-dtype sweeps, checkpoints the partial, heartbeats —
+    and a second (resume) invocation skips every completed phase."""
+    monkeypatch.setattr(bench, "FLAGSHIP", dict(
+        d_model=16, num_heads=2, num_layers=1, dim_feedforward=32,
+        seq=16, batch=2, features=4,
+    ))
+    monkeypatch.setattr(bench, "SMALL", dict(
+        num_trials=2, num_epochs=1, data_steps=10_000, warm_repeats=0,
+    ))
+    partial = tmp_path / "suite.json"
+    hb = tmp_path / "hb"
+    monkeypatch.setenv("DML_BENCH_PARTIAL_PATH", str(partial))
+    monkeypatch.setenv("DML_BENCH_HEARTBEAT_PATH", str(hb))
+    bench.child_suite("small")
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert set(out["sweeps"]) == {"float32", "bfloat16"}
+    assert out["flagship"].get("step_s"), out["flagship"]
+    for res in out["sweeps"].values():
+        assert res["trials_per_hour"] > 0 and res["done"] == 2
+    assert hb.exists() and partial.exists()
+    saved = json.loads(partial.read_text())
+    assert set(saved["sweeps"]) == {"float32", "bfloat16"}
+
+    # Resume run: every phase already in the partial -> all skipped, the
+    # printed suite is identical (no recomputation).
+    bench.child_suite("small")
+    out2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out2["sweeps"]["float32"]["trials_per_hour"] == (
+        out["sweeps"]["float32"]["trials_per_hour"]
+    )
+    assert out2["flagship"]["step_s"] == out["flagship"]["step_s"]
 
 
 def test_child_flagship_tiny_shapes(monkeypatch, capsys):
