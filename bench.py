@@ -272,15 +272,14 @@ def _touch_heartbeat() -> None:
     """Progress heartbeat for the monitored parent: every phase-boundary
     note refreshes the file's mtime, so a child whose device call hangs
     (mtime goes stale) is distinguishable from one that is slow but moving
-    — the 915s silent-stall burn of 2026-07-31 bounded to minutes."""
-    path = os.environ.get("DML_BENCH_HEARTBEAT_PATH")
-    if not path:
-        return
-    try:
-        with open(path, "w") as f:
-            f.write(repr(time.time()))
-    except OSError:
-        pass
+    — the 915s silent-stall burn of 2026-07-31 bounded to minutes.
+    Shared protocol with the vectorized runner's dispatch-boundary beats:
+    utils/heartbeat.py."""
+    from distributed_machine_learning_tpu.utils.heartbeat import (
+        touch_heartbeat,
+    )
+
+    touch_heartbeat()
 
 
 def _make_note(t0: float):
@@ -1017,24 +1016,34 @@ def _flagship_result(progress_cb) -> dict:
     except Exception as exc:  # noqa: BLE001 - MHA number still stands
         out["gqa_kv2"] = {"error": repr(exc)[-300:]}
     progress_cb(out)
-    # Batch scaling: the MXU's utilization rises with the M dimension; a
-    # B16 variant often beats B8's MFU at this shape.  Measured last (its
-    # own compile), printed incrementally, and PROMOTED to the headline
-    # step/MFU when it wins — the artifact self-selects the best honest
-    # single-chip number (config recorded either way).
-    try:
-        b2 = FLAGSHIP["batch"] * 2
-        bx2 = measure(base_cfg, batch=b2)
-        bx2["batch"] = b2
-        out["batch_x2"] = bx2
-        if bx2["mfu"] and out["mfu"] and bx2["mfu"] > out["mfu"]:
-            # Promote EVERY per-run field the variant shares with the base
-            # record (a hand-picked subset would mix two configs' numbers
-            # under one config), then stamp the winning batch.
-            out.update({k: v for k, v in bx2.items() if k in out})
-            out["config"] = dict(out["config"], batch=b2)
-    except Exception as exc:  # noqa: BLE001 - base result still stands
-        out["batch_x2"] = {"error": repr(exc)[-300:]}
+    # Batch scaling: the MXU's utilization rises with the M dimension —
+    # measured 0.243 MFU at B8 vs 0.284 at B16 on the v5e chip — so climb
+    # the doublings (B -> 2B -> 4B) while they keep winning.  Each variant
+    # is measured in its own compile, printed incrementally, and PROMOTED
+    # to the headline step/MFU when it wins — the artifact self-selects
+    # the best honest single-chip number (config recorded either way).
+    # The climb stops at the first non-improving doubling (a losing 2B
+    # means 4B would pay another compile to lose harder) or on error
+    # (e.g. activation HBM exhaustion at the biggest batch).
+    for mult in (2, 4):
+        key = f"batch_x{mult}"
+        try:
+            bx = FLAGSHIP["batch"] * mult
+            var = measure(base_cfg, batch=bx)
+            var["batch"] = bx
+            out[key] = var
+            if var["mfu"] and out["mfu"] and var["mfu"] > out["mfu"]:
+                # Promote EVERY per-run field the variant shares with the
+                # base record (a hand-picked subset would mix two configs'
+                # numbers under one config), then stamp the winning batch.
+                out.update({k: v for k, v in var.items() if k in out})
+                out["config"] = dict(out["config"], batch=bx)
+            else:
+                break
+        except Exception as exc:  # noqa: BLE001 - base result still stands
+            out[key] = {"error": repr(exc)[-300:]}
+            break
+        progress_cb(out)
     # Every sub-phase ran (possibly recording its error): intermediate
     # snapshots recovered from a killed child lack this marker, and the
     # parent turns its absence into the `partial` honesty flag.
@@ -1227,7 +1236,13 @@ def _probe_tpu(log, probe_info, schedule) -> tuple:
     return probe_ok, tunnel_ok
 
 
-SUITE_TIMEOUT_S = 1800
+# Budget arithmetic: worst case = probe window (~8 min) + suite + resume +
+# torch (600s) + settle/gaps must stay inside the ~4000s a capture-session
+# step allows (run_all_tpu.sh TIMEOUT=4200) or the whole emit is lost to
+# the outer SIGTERM. 1500 + 900 + 600 + ~500 of probes/settle ≈ 3500s.
+# Healthy-path suites measure ~700-900s, so 1500 is slack, not a squeeze.
+SUITE_TIMEOUT_S = 1500
+RESUME_TIMEOUT_S = 900
 HEARTBEAT_STALE_S = 300
 POST_STALL_SETTLE_S = 45.0
 
@@ -1291,7 +1306,7 @@ def _run_tpu_suite(log, phases):
         # nothing stalled); the partial file makes it skip done phases.
         log(f"suite exited cleanly with sweeps {sorted(sweeps_of(res))}; "
             f"resuming for the remainder")
-        res2, exited, _rc2 = launch("_resume", timeout_s=1200)
+        res2, exited, _rc2 = launch("_resume", timeout_s=RESUME_TIMEOUT_S)
         tunnel_ok = exited
         if res2 is not None:
             res = res2
@@ -1317,17 +1332,18 @@ def _run_tpu_suite(log, phases):
         else:
             log("resuming suite chunked (DML_BENCH_EPD=1)")
             res2, exited, _rc2 = launch("_chunked", {"DML_BENCH_EPD": "1"},
-                                        timeout_s=1200)
+                                        timeout_s=RESUME_TIMEOUT_S)
             tunnel_ok = exited
             if res2 is not None:
                 res = res2  # partial file accumulates: includes phase 1
     elif not exited:
         log("suite child still running; no more TPU children")
 
-    try:
-        os.unlink(partial_path)
-    except OSError:
-        pass
+    for path in (partial_path, hb_path):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
     if res is None:
         return None, [], None, tunnel_ok
     flagship = res.get("flagship")

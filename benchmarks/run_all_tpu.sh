@@ -50,13 +50,16 @@ gate() {
   return 1
 }
 
-# Headline bench first (the driver artifact path): probes, both-dtype
-# sweeps with warm repeats, flagship MFU, torch baseline.
-TIMEOUT=3600 run bench python bench.py
+# Headline bench first (the driver artifact path): probes, single-claim
+# suite (flagship MFU + both-dtype sweeps with warm repeats), torch
+# baseline. 4200 > bench.py's own worst case (~3500s: probe window +
+# SUITE_TIMEOUT_S + RESUME_TIMEOUT_S + torch + settle/gaps) so a slow
+# run emits its JSON instead of dying to this outer SIGTERM.
+TIMEOUT=4200 run bench python bench.py
 
 # Same sweep with threefry dropout streams forced: measures the tax the
 # default hardware-RNG ("auto" -> rbg on TPU, ops/rng.py) avoids.
-TIMEOUT=2400 run bench_threefry env DML_BENCH_RNG_IMPL=threefry python bench.py
+TIMEOUT=4200 run bench_threefry env DML_BENCH_RNG_IMPL=threefry python bench.py
 
 # GQA kv-bandwidth: native grouped kv vs repeat, fwd and fwd+bwd.
 gate gqa && TIMEOUT=1800 run gqa python benchmarks/gqa_bench.py

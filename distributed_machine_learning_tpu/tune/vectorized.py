@@ -72,6 +72,7 @@ from distributed_machine_learning_tpu.models import build_model
 from distributed_machine_learning_tpu.ops.losses import get_loss
 from distributed_machine_learning_tpu.ops.rng import resolve_rng_impl
 from distributed_machine_learning_tpu.ops.schedules import get_schedule
+from distributed_machine_learning_tpu.utils.heartbeat import touch_heartbeat
 from distributed_machine_learning_tpu.tune._regression_program import (
     detect_call_convention,
     make_epoch_fn,
@@ -871,13 +872,7 @@ def _progress_note(msg: str) -> None:
     dispatch boundary also refreshes that file's mtime: the bench parent
     kills a child on heartbeat staleness, and a chunked sweep making real
     per-epoch progress must register as alive between its phase notes."""
-    hb = os.environ.get("DML_BENCH_HEARTBEAT_PATH")
-    if hb:
-        try:
-            with open(hb, "w") as f:
-                f.write(repr(time.time()))
-        except OSError:
-            pass
+    touch_heartbeat()
     if (os.environ.get("DML_TUNE_PROGRESS") or "0") != "0":
         print(f"[tune.progress +{time.monotonic() - _PROGRESS_T0:.1f}s] {msg}",
               file=sys.stderr, flush=True)

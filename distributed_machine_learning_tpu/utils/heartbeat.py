@@ -1,0 +1,28 @@
+"""Progress-heartbeat protocol shared by bench children and the runners.
+
+A monitored parent (bench.py ``_run_child_monitored``) kills a child whose
+heartbeat file goes stale: real progress — phase boundaries, vectorized
+dispatch boundaries — must refresh the file's mtime, while a hung device
+call must NOT (which is why this is called at progress points, never from
+a liveness thread). The file path travels in ``DML_BENCH_HEARTBEAT_PATH``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+ENV_VAR = "DML_BENCH_HEARTBEAT_PATH"
+
+
+def touch_heartbeat() -> None:
+    """Refresh the heartbeat file named by ``DML_BENCH_HEARTBEAT_PATH``;
+    no-op (never raises) when unset or unwritable."""
+    path = os.environ.get(ENV_VAR)
+    if not path:
+        return
+    try:
+        with open(path, "w") as f:
+            f.write(repr(time.time()))
+    except OSError:
+        pass

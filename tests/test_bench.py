@@ -492,3 +492,7 @@ def test_child_flagship_promotes_winning_batch(monkeypatch, capsys):
             assert final["compile_plus_first_step_s"] == (
                 bx2["compile_plus_first_step_s"]
             )
+        if final["config"]["batch"] >= 4:
+            # x2 won -> the climb must have attempted the x4 doubling
+            # (measured or recorded its error) before settling.
+            assert "batch_x4" in final
