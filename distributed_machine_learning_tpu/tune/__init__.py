@@ -77,11 +77,15 @@ from distributed_machine_learning_tpu.tune.trainable import train_regressor
 from distributed_machine_learning_tpu.tune.trainable_sharded import (
     train_sharded_regressor,
 )
-from distributed_machine_learning_tpu.tune.vectorized import run_vectorized
+from distributed_machine_learning_tpu.tune.vectorized import (
+    clear_program_cache,
+    run_vectorized,
+)
 from distributed_machine_learning_tpu.tune.trial import Resources, Trial, TrialStatus
 
 __all__ = [
     "run",
+    "clear_program_cache",
     "run_vectorized",
     "report",
     "get_checkpoint",

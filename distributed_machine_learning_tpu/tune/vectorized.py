@@ -178,6 +178,7 @@ class _GroupProgram:
     def __init__(self, static_cfg: Dict[str, Any], train_data: Dataset,
                  val_data: Dataset, pop_sharding=None):
         cfg = static_cfg
+        self._static_cfg = dict(static_cfg)
         self.loss_name = str(cfg.get("loss_function", "mse"))
         self.num_epochs = int(cfg.get("num_epochs", 20))
         from distributed_machine_learning_tpu.models import compute_dtype_of
@@ -187,6 +188,7 @@ class _GroupProgram:
         self.data = data = stage_data(
             train_data, val_data, int(cfg.get("batch_size", 32)), compute_dtype
         )
+        self._data_sums = _data_checksums(train_data, val_data)
         self.steps_per_epoch = data.num_batches
         total_steps = int(
             cfg.get("total_steps", self.num_epochs * data.num_batches)
@@ -274,6 +276,98 @@ class _GroupProgram:
             ),
             donate_argnums=(0, 1, 2),
         )
+
+    def rebind_data(self, train_data: Dataset, val_data: Dataset) -> None:
+        """Point this (possibly cache-reused) program at fresh data.
+
+        Every jitted program takes the data as ARGUMENTS, so a program
+        traced once serves any data of the same staged shapes; only
+        ``init_one``'s baked ``sample_x`` constant is from the original
+        data, and flax init consumes it for shapes alone (param values
+        come from the rngs).  Unchanged content (sampled checksum — object
+        identity alone would miss in-place mutation like
+        ``train.y[:] = new``) -> keep the staged device buffers (no
+        re-upload); changed -> re-stage.
+        """
+        sums = _data_checksums(train_data, val_data)
+        if sums == self._data_sums:
+            return
+        from distributed_machine_learning_tpu.models import compute_dtype_of
+
+        cfg = self._static_cfg
+        self.data = stage_data(
+            train_data, val_data, int(cfg.get("batch_size", 32)),
+            compute_dtype_of(cfg) or jnp.float32,
+        )
+        self._data_sums = sums
+        self._data_replicated = False
+
+    def staged_nbytes(self) -> int:
+        return sum(
+            int(getattr(a, "nbytes", 0))
+            for a in (self.data.x_train, self.data.y_train,
+                      self.data.x_val, self.data.y_val)
+        )
+
+
+# Cross-call program cache: repeated ``run_vectorized`` calls with the same
+# static config and data shapes (bench warm repeats; users iterating on a
+# sweep in one process) reuse the traced jit callables instead of paying a
+# full retrace + staged re-upload per call — host seconds that land
+# directly in the measured sweep wall (the duty-cycle gap vs BASELINE.md's
+# >=90% target).  Single-device only: mesh identity is not part of the key.
+# Entries pin their staged splits in device memory; eviction is LRU by
+# count AND total staged bytes, and ``clear_program_cache`` frees it all.
+_PROGRAM_CACHE: Dict[Tuple, "_GroupProgram"] = {}
+_PROGRAM_CACHE_MAX = 4
+_PROGRAM_CACHE_MAX_BYTES = 256 * 1024 * 1024
+
+
+def clear_program_cache() -> None:
+    """Drop every cached group program (frees their staged device data)."""
+    _PROGRAM_CACHE.clear()
+
+
+def _data_fingerprint(train_data: Dataset, val_data: Dataset) -> Tuple:
+    return tuple(
+        (tuple(a.shape), str(a.dtype))
+        for a in (train_data.x, train_data.y, val_data.x, val_data.y)
+    )
+
+
+def _data_checksums(train_data: Dataset, val_data: Dataset) -> Tuple:
+    """Cheap content fingerprint: strided-sample sums (<= ~64k elements per
+    array, ~ms on the biggest realistic splits).  Realistic in-place edits
+    (new targets, rescaling, renormalization) shift these sums; exotic
+    sum-preserving point swaps are out of scope and documented so."""
+    sums = []
+    for a in (train_data.x, train_data.y, val_data.x, val_data.y):
+        flat = np.ravel(a)
+        stride = max(1, flat.size // 65536)
+        sums.append((flat.size, float(np.sum(flat[::stride], dtype=np.float64))))
+    return tuple(sums)
+
+
+def _group_program_for(sig: Tuple, static_cfg: Dict[str, Any],
+                       train_data: Dataset, val_data: Dataset,
+                       pop_sharding, log) -> "_GroupProgram":
+    if pop_sharding is not None:
+        return _GroupProgram(static_cfg, train_data, val_data, pop_sharding)
+    key = (sig, _data_fingerprint(train_data, val_data))
+    prog = _PROGRAM_CACHE.pop(key, None)
+    if prog is not None:
+        prog.rebind_data(train_data, val_data)
+        log("program cache hit: reusing traced group program")
+    else:
+        prog = _GroupProgram(static_cfg, train_data, val_data, None)
+    _PROGRAM_CACHE[key] = prog  # re-insert = LRU touch (dicts are ordered)
+    while len(_PROGRAM_CACHE) > 1 and (
+        len(_PROGRAM_CACHE) > _PROGRAM_CACHE_MAX
+        or sum(p.staged_nbytes() for p in _PROGRAM_CACHE.values())
+        > _PROGRAM_CACHE_MAX_BYTES
+    ):
+        _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
+    return prog
 
 
 def run_vectorized(
@@ -576,9 +670,9 @@ def run_vectorized(
                 for sig, members in groups.items():
                     program = programs.get(sig)
                     if program is None:
-                        program = programs[sig] = _GroupProgram(
-                            dict(members[0].config), train_data, val_data,
-                            pop_sharding,
+                        program = programs[sig] = _group_program_for(
+                            sig, dict(members[0].config), train_data,
+                            val_data, pop_sharding, log,
                         )
                     compile_before = tracker.thread_seconds()
                     t_pop = time.time()

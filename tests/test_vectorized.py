@@ -437,3 +437,92 @@ def test_vectorized_stop_rules_and_stopper(tmp_path, tiny_data):
         storage_path=str(tmp_path), name="vstop2", seed=1, verbose=0,
     )
     assert all(len(t.results) == 3 for t in analysis.trials)
+
+
+def test_program_cache_reuses_traced_programs(tiny_data, tmp_path):
+    """Repeated same-config sweeps (bench warm repeats) hit the cross-call
+    program cache — no second _GroupProgram construction — and produce
+    IDENTICAL results for identical seeds (the cached trace is the same
+    computation, and rebind keeps the same staged buffers)."""
+    import distributed_machine_learning_tpu.tune.vectorized as vec
+
+    train, val = tiny_data
+    vec._PROGRAM_CACHE.clear()
+    builds = []
+    orig_init = vec._GroupProgram.__init__
+
+    def counting_init(self, *a, **kw):
+        builds.append(1)
+        return orig_init(self, *a, **kw)
+
+    vec._GroupProgram.__init__ = counting_init
+    try:
+        def sweep(name):
+            return run_vectorized(
+                MLP_SPACE, train_data=train, val_data=val,
+                metric="validation_mse", mode="min", num_samples=4,
+                storage_path=str(tmp_path), name=name, seed=7, verbose=0,
+            )
+
+        a1 = sweep("cache_a")
+        n_first = len(builds)
+        a2 = sweep("cache_b")
+        assert len(builds) == n_first  # second run: pure cache hits
+        r1 = sorted(t.last_result["validation_mse"] for t in a1.trials)
+        r2 = sorted(t.last_result["validation_mse"] for t in a2.trials)
+        assert r1 == r2  # same seed through the cached program
+    finally:
+        vec._GroupProgram.__init__ = orig_init
+        vec._PROGRAM_CACHE.clear()
+
+
+def test_program_cache_rebinds_new_data(tiny_data, tmp_path):
+    """A cache HIT with different data (same shapes) re-stages: results
+    must reflect the new data, not the buffers the program was traced
+    with — including data mutated IN PLACE through the same Dataset
+    objects (object identity alone must not skip the rebind)."""
+    import distributed_machine_learning_tpu.tune.vectorized as vec
+
+    train, val = tiny_data
+    vec._PROGRAM_CACHE.clear()
+    builds = []
+    orig_init = vec._GroupProgram.__init__
+
+    def counting_init(self, *a, **kw):
+        builds.append(1)
+        return orig_init(self, *a, **kw)
+
+    vec._GroupProgram.__init__ = counting_init
+    try:
+        def sweep(name, tr, vl):
+            return run_vectorized(
+                MLP_SPACE, train_data=tr, val_data=vl,
+                metric="validation_mse", mode="min", num_samples=3,
+                storage_path=str(tmp_path), name=name, seed=3, verbose=0,
+            )
+
+        # Mutable copies so the in-place leg can't corrupt the fixture.
+        train = Dataset(train.x.copy(), train.y.copy())
+        val = Dataset(val.x.copy(), val.y.copy())
+        a1 = sweep("rebind_a", train, val)
+        n_first = len(builds)
+        # Same shapes, different content: zero targets make validation mse
+        # collapse toward the prediction magnitude — clearly different.
+        train2 = Dataset(train.x.copy(), np.zeros_like(train.y))
+        val2 = Dataset(val.x.copy(), np.zeros_like(val.y))
+        a2 = sweep("rebind_b", train2, val2)
+        assert len(builds) == n_first  # cache HIT: rebind, not rebuild
+        r1 = sorted(t.last_result["validation_mse"] for t in a1.trials)
+        r2 = sorted(t.last_result["validation_mse"] for t in a2.trials)
+        assert r1 != r2
+
+        # In-place mutation through the SAME objects must also re-stage.
+        val2.y[:] = val.y
+        train2.y[:] = train.y
+        a3 = sweep("rebind_c", train2, val2)
+        assert len(builds) == n_first
+        r3 = sorted(t.last_result["validation_mse"] for t in a3.trials)
+        assert r3 == r1  # back to the original targets' results
+    finally:
+        vec._GroupProgram.__init__ = orig_init
+        vec._PROGRAM_CACHE.clear()
