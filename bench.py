@@ -112,6 +112,55 @@ def _tpu_env() -> dict:
 # the last-release stamp lives in a file every claimant process sees.
 _TUNNEL_STAMP = "/tmp/dml_tunnel_last_release"
 
+# Durable record of the most recent SUCCESSFUL TPU suite (committed to the
+# repo): the tunnel has whole-session bad days, and a bench run that can
+# only reach the CPU fallback attaches this — provenance-stamped, clearly
+# labeled as a previous run — so the artifact still carries the latest
+# real-chip evidence next to the honest fallback number.
+LAST_TPU_CAPTURE_PATH = os.path.join(
+    _REPO_ROOT, "benchmarks", "last_tpu_capture.json"
+)
+
+
+def _record_tpu_capture(suite: dict) -> None:
+    """Persist a suite result that contains real-chip evidence.
+
+    Called AFTER the honesty-flag marking (a flagship snapshot from a
+    killed child carries ``partial: true`` here, so the durable file never
+    presents an intermediate measurement as a finished one). The write is
+    atomic — a SIGTERM mid-write must not truncate the one file that
+    preserves the last good chip evidence."""
+    has_tpu = (
+        (suite.get("flagship") or {}).get("platform") == "tpu"
+        or any((s or {}).get("platform") == "tpu"
+               for s in (suite.get("sweeps") or {}).values())
+    )
+    if not has_tpu:
+        return
+    try:
+        tmp = LAST_TPU_CAPTURE_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "captured_at": time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                ),
+                "note": ("most recent real-chip suite evidence; written by "
+                         "bench.py after every TPU capture (phases carry "
+                         "their own partial/complete honesty flags)"),
+                "suite": suite,
+            }, f, indent=1)
+        os.replace(tmp, LAST_TPU_CAPTURE_PATH)
+    except OSError:
+        pass
+
+
+def _load_last_tpu_capture():
+    try:
+        with open(LAST_TPU_CAPTURE_PATH) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
 
 def _last_tunnel_release() -> float:
     try:
@@ -1357,6 +1406,7 @@ def _run_tpu_suite(log, phases):
         sweeps_of(res).values(),
         key=lambda r: -(r.get("trials_per_hour") or 0),
     )
+    _record_tpu_capture(res)  # after marking: flags travel into the file
     ours = candidates[0] if candidates else None
     return ours, candidates[1:], flagship, tunnel_ok
 
@@ -1428,11 +1478,13 @@ def main() -> None:
         log(f"torch baseline failed rc={rc}; tail: {err[-500:]}")
 
     if ours is None:
+        cap = _load_last_tpu_capture()
         emit(None, None, backend, {
             "error": "benchmark children failed; see stderr",
             "probe": probe_info,
             "phases": phases,
             "total_s": round(time.time() - t_start, 1),
+            **({"last_tpu_capture": cap} if cap else {}),
         })
         return
 
@@ -1477,6 +1529,13 @@ def main() -> None:
         "phases": phases,
         "total_s": round(time.time() - t_start, 1),
     }
+    if backend == "cpu":
+        # On a dead-tunnel day the artifact still carries the most recent
+        # real-chip suite, provenance-stamped with its capture time (the
+        # suite phases inside carry their own partial/complete flags).
+        cap = _load_last_tpu_capture()
+        if cap:
+            extra["last_tpu_capture"] = cap
     # Honesty flags: a recovered-partial or repeat-skipping run must be
     # distinguishable from a full suite in the ONE emitted line.
     for flag in ("partial", "warm_skipped_after", "epochs_per_dispatch"):

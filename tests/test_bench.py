@@ -25,6 +25,15 @@ sys.modules.setdefault("bench", bench)
 spec.loader.exec_module(bench)
 
 
+@pytest.fixture(autouse=True)
+def _capture_file_in_tmp(monkeypatch, tmp_path):
+    """No test may write the repo's durable benchmarks/last_tpu_capture.json
+    (suite stubs carry platform='tpu' and _run_tpu_suite persists them)."""
+    monkeypatch.setattr(
+        bench, "LAST_TPU_CAPTURE_PATH", str(tmp_path / "last_capture.json")
+    )
+
+
 def test_parse_result_takes_last_json_line():
     out = "noise\n{\"a\": 1}\nmore noise\n{\"b\": 2}\n"
     assert bench._parse_result(out) == {"b": 2}
@@ -496,3 +505,63 @@ def test_child_flagship_promotes_winning_batch(monkeypatch, capsys):
             # x2 won -> the climb must have attempted the x4 doubling
             # (measured or recorded its error) before settling.
             assert "batch_x4" in final
+
+
+def test_last_tpu_capture_recorded_and_attached(monkeypatch, tmp_path,
+                                                capsys):
+    """A successful TPU suite is persisted to LAST_TPU_CAPTURE_PATH, and a
+    later CPU-fallback run attaches it (provenance-stamped) to the emit."""
+    cap_path = tmp_path / "last_tpu_capture.json"
+    monkeypatch.setattr(bench, "LAST_TPU_CAPTURE_PATH", str(cap_path))
+
+    # 1) TPU day: suite succeeds -> capture file written.
+    suite = {
+        "flagship": {"step_s": 0.04, "mfu": 0.3, "platform": "tpu",
+                     "complete": True},
+        "sweeps": {"float32": _sweep_stub("float32", 9000.0)},
+    }
+
+    def fake_monitored(args, env, timeout_s, hb_path, stale_s):
+        return 0, json.dumps(suite), "", True
+
+    monkeypatch.setattr(bench, "_run_child_monitored", fake_monitored)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    bench._run_tpu_suite(lambda m: None, {})
+    saved = json.loads(cap_path.read_text())
+    assert saved["suite"]["sweeps"]["float32"]["trials_per_hour"] == 9000.0
+    assert saved["captured_at"]
+
+    # 2) Dead-tunnel day: CPU fallback emit carries the saved capture.
+    def fake_run_child(args, env, timeout_s):
+        if args == ["--child", "probe"]:
+            return 124, "", "hung", True
+        if args[:2] == ["--child", "ours"]:
+            return 0, json.dumps({
+                "trials_per_hour": 1000.0, "wall_s": 20.0, "done": 8,
+                "flops": 1e12, "best_mape": 20.0, "platform": "cpu",
+                "compute_dtype": "float32", "peak_flops": None,
+            }), "", True
+        if args[:2] == ["--child", "torch"]:
+            return 0, json.dumps({"trials_per_hour": 900.0}), "", True
+        raise AssertionError(f"unexpected child {args}")
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setenv("DML_TUNNEL_PYTHONPATH", "/fake/.axon_site")
+    bench.main()
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["backend"] == "cpu"
+    attached = line["last_tpu_capture"]
+    assert attached["suite"]["flagship"]["mfu"] == 0.3
+    assert attached["captured_at"] == saved["captured_at"]
+
+
+def test_cpu_platform_suite_not_recorded(monkeypatch, tmp_path):
+    """A suite whose phases all ran on CPU (no real-chip evidence) must
+    NOT overwrite the durable TPU capture file."""
+    cap_path = tmp_path / "last_tpu_capture.json"
+    monkeypatch.setattr(bench, "LAST_TPU_CAPTURE_PATH", str(cap_path))
+    bench._record_tpu_capture({
+        "flagship": {"step_s": 0.04, "platform": "cpu"},
+        "sweeps": {"float32": {"trials_per_hour": 10.0, "platform": "cpu"}},
+    })
+    assert not cap_path.exists()
