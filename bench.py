@@ -704,10 +704,19 @@ VARIANT_SCALES = {
 def _stderr_reporter():
     """Live trial table on stderr for variant children: a stalled child's
     captured log then shows exactly how far it got (the 2026-07-31 bohb
-    stall was invisible — 2s CPU, zero output, nothing to diagnose)."""
+    stall was invisible — 2s CPU, zero output, nothing to diagnose).
+    Every trial result also refreshes the bench heartbeat, so thread-
+    executor variants (bohb, sharded_resnet — whose dispatches don't pass
+    through the vectorized runner's beats) register progress with the
+    monitored parent."""
     from distributed_machine_learning_tpu import tune
 
-    return tune.ProgressReporter(interval_s=30.0, file=sys.stderr)
+    class _HeartbeatReporter(tune.ProgressReporter):
+        def on_trial_result(self, trial, result):
+            _touch_heartbeat()
+            return super().on_trial_result(trial, result)
+
+    return _HeartbeatReporter(interval_s=30.0, file=sys.stderr)
 
 
 def child_variant(name: str, scale_name: str) -> None:
@@ -905,10 +914,21 @@ def run_variant(name: str) -> None:
     if probe_ok:
         exp_name = f"variant_{name}_{int(time.time())}"
         t_child = time.time()
-        rc, out, err, exited = _run_child(
+        hb_path = f"/tmp/bench_variant_hb_{os.getpid()}"
+        # Heartbeat-monitored (2026-07-31 session-6 bohb stall: ~30 min
+        # blocked in one device call with 2s of CPU): vectorized variants
+        # beat per dispatch, thread-executor variants per trial result,
+        # so a wedged child dies at 300s staleness, not the full timeout.
+        rc, out, err, exited = _run_child_monitored(
             ["--child", "variant", name, "full"],
-            dict(_tpu_env(), DML_BENCH_EXP_NAME=exp_name), 1800
+            dict(_tpu_env(), DML_BENCH_EXP_NAME=exp_name,
+                 DML_BENCH_HEARTBEAT_PATH=hb_path),
+            1800, hb_path, HEARTBEAT_STALE_S,
         )
+        try:
+            os.unlink(hb_path)
+        except OSError:
+            pass
         res = _parse_result(out) if rc == 0 else None
         if res is not None:
             res["backend"] = "tpu"

@@ -565,3 +565,46 @@ def test_cpu_platform_suite_not_recorded(monkeypatch, tmp_path):
         "sweeps": {"float32": {"trials_per_hour": 10.0, "platform": "cpu"}},
     })
     assert not cap_path.exists()
+
+
+def test_run_variant_monitored_with_partial_recovery(monkeypatch, tmp_path,
+                                                     capsys):
+    """The TPU variant child runs under heartbeat monitoring; a stale-kill
+    (rc=124) still yields the terminated trials from the experiment state
+    as a flagged partial, printed with backend=tpu."""
+    import time as _time
+
+    monkeypatch.setattr(bench, "BENCH_RESULTS_DIR", str(tmp_path))
+    seen = {}
+
+    def fake_run_child(args, env, timeout_s):
+        assert args == ["--child", "probe"]
+        return 0, "probe OK: 1 x tpu", "", True
+
+    def fake_monitored(args, env, timeout_s, hb_path, stale_s):
+        assert args == ["--child", "variant", "bohb_transformer", "full"]
+        assert env["DML_BENCH_HEARTBEAT_PATH"] == hb_path
+        seen["stale_s"] = stale_s
+        exp = env["DML_BENCH_EXP_NAME"]
+        root = tmp_path / exp
+        root.mkdir(parents=True)
+        (root / "experiment_state.json").write_text(json.dumps({
+            "timestamp": _time.time(),
+            "trials": [
+                {"trial_id": "a", "status": "TERMINATED",
+                 "last_result": {"validation_mse": 1.5}},
+                {"trial_id": "b", "status": "RUNNING"},
+            ],
+        }))
+        return 124, "", "heartbeat stale", True
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench, "_run_child_monitored", fake_monitored)
+    monkeypatch.setenv("DML_TUNNEL_PYTHONPATH", "/fake/.axon_site")
+    bench.run_variant("bohb_transformer")
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["backend"] == "tpu"
+    assert line["partial"] is True
+    assert line["done"] == 1
+    assert line["best_validation_mse"] == 1.5
+    assert seen["stale_s"] == bench.HEARTBEAT_STALE_S
