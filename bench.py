@@ -122,14 +122,28 @@ LAST_TPU_CAPTURE_PATH = os.path.join(
 )
 
 
+def _unlink_quiet(path) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+def _atomic_json_dump(path: str, obj, **dump_kw) -> None:
+    """Write JSON via tmp + rename: a SIGTERM mid-write (bench children run
+    under kill timeouts) must never leave a truncated file."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, **dump_kw)
+    os.replace(tmp, path)
+
+
 def _record_tpu_capture(suite: dict) -> None:
     """Persist a suite result that contains real-chip evidence.
 
     Called AFTER the honesty-flag marking (a flagship snapshot from a
     killed child carries ``partial: true`` here, so the durable file never
-    presents an intermediate measurement as a finished one). The write is
-    atomic — a SIGTERM mid-write must not truncate the one file that
-    preserves the last good chip evidence."""
+    presents an intermediate measurement as a finished one)."""
     has_tpu = (
         (suite.get("flagship") or {}).get("platform") == "tpu"
         or any((s or {}).get("platform") == "tpu"
@@ -138,18 +152,15 @@ def _record_tpu_capture(suite: dict) -> None:
     if not has_tpu:
         return
     try:
-        tmp = LAST_TPU_CAPTURE_PATH + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({
-                "captured_at": time.strftime(
-                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
-                ),
-                "note": ("most recent real-chip suite evidence; written by "
-                         "bench.py after every TPU capture (phases carry "
-                         "their own partial/complete honesty flags)"),
-                "suite": suite,
-            }, f, indent=1)
-        os.replace(tmp, LAST_TPU_CAPTURE_PATH)
+        _atomic_json_dump(LAST_TPU_CAPTURE_PATH, {
+            "captured_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+            "note": ("most recent real-chip suite evidence; written by "
+                     "bench.py after every TPU capture (phases carry "
+                     "their own partial/complete honesty flags)"),
+            "suite": suite,
+        }, indent=1)
     except OSError:
         pass
 
@@ -214,10 +225,7 @@ def _run_child_monitored(args, env, timeout_s: float, heartbeat_path,
         if last and gap > 0:
             time.sleep(gap)
     if heartbeat_path:
-        try:
-            os.unlink(heartbeat_path)
-        except OSError:
-            pass
+        _unlink_quiet(heartbeat_path)
     with tempfile.TemporaryFile(mode="w+") as fout, \
             tempfile.TemporaryFile(mode="w+") as ferr:
         proc = subprocess.Popen(
@@ -323,7 +331,12 @@ def _touch_heartbeat() -> None:
     (mtime goes stale) is distinguishable from one that is slow but moving
     — the 915s silent-stall burn of 2026-07-31 bounded to minutes.
     Shared protocol with the vectorized runner's dispatch-boundary beats:
-    utils/heartbeat.py."""
+    utils/heartbeat.py.
+
+    The import MUST stay lazy: the package ``__init__`` imports jax, and
+    the bench parent must never import jax (it would claim the tunnel and
+    deadlock its own children — module docstring, process architecture).
+    Only children call this."""
     from distributed_machine_learning_tpu.utils.heartbeat import (
         touch_heartbeat,
     )
@@ -345,12 +358,8 @@ def _make_checkpoint(partial_path):
     file when a child dies rc!=0). Doubles as a heartbeat."""
     def checkpoint_partial(snapshot: dict) -> None:
         _touch_heartbeat()
-        if not partial_path:
-            return
-        tmp = partial_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(snapshot, f)
-        os.replace(tmp, partial_path)
+        if partial_path:
+            _atomic_json_dump(partial_path, snapshot)
     return checkpoint_partial
 
 
@@ -925,10 +934,7 @@ def run_variant(name: str) -> None:
                  DML_BENCH_HEARTBEAT_PATH=hb_path),
             1800, hb_path, HEARTBEAT_STALE_S,
         )
-        try:
-            os.unlink(hb_path)
-        except OSError:
-            pass
+        _unlink_quiet(hb_path)
         res = _parse_result(out) if rc == 0 else None
         if res is not None:
             res["backend"] = "tpu"
@@ -1346,10 +1352,8 @@ def _run_tpu_suite(log, phases):
     landed."""
     partial_path = f"/tmp/bench_suite_partial_{os.getpid()}.json"
     hb_path = f"/tmp/bench_suite_hb_{os.getpid()}"
-    try:  # a stale file from a previous run must not masquerade as ours
-        os.unlink(partial_path)
-    except OSError:
-        pass
+    # A stale file from a previous run must not masquerade as ours.
+    _unlink_quiet(partial_path)
 
     def launch(tag, extra_env=None, timeout_s=SUITE_TIMEOUT_S):
         t0 = time.time()
@@ -1425,10 +1429,7 @@ def _run_tpu_suite(log, phases):
         log("suite child still running; no more TPU children")
 
     for path in (partial_path, hb_path):
-        try:
-            os.unlink(path)
-        except OSError:
-            pass
+        _unlink_quiet(path)
     if res is None:
         return None, [], None, tunnel_ok
     flagship = res.get("flagship")
