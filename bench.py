@@ -151,6 +151,11 @@ def _record_tpu_capture(suite: dict) -> None:
     )
     if not has_tpu:
         return
+    if os.environ.get("DML_BENCH_RNG_IMPL"):
+        # Comparison runs with a forced non-default dropout stream (the
+        # capture session's threefry step) measure a deliberately slower
+        # configuration; they must not clobber the default-config evidence.
+        return
     try:
         _atomic_json_dump(LAST_TPU_CAPTURE_PATH, {
             "captured_at": time.strftime(

@@ -608,3 +608,17 @@ def test_run_variant_monitored_with_partial_recovery(monkeypatch, tmp_path,
     assert line["done"] == 1
     assert line["best_validation_mse"] == 1.5
     assert seen["stale_s"] == bench.HEARTBEAT_STALE_S
+
+
+def test_forced_rng_run_does_not_clobber_capture(monkeypatch, tmp_path):
+    """A comparison run with a forced dropout stream (DML_BENCH_RNG_IMPL)
+    must not overwrite the default-config durable capture."""
+    cap_path = tmp_path / "last_tpu_capture.json"
+    monkeypatch.setattr(bench, "LAST_TPU_CAPTURE_PATH", str(cap_path))
+    suite = {"flagship": {"platform": "tpu", "mfu": 0.2}, "sweeps": {}}
+    monkeypatch.setenv("DML_BENCH_RNG_IMPL", "threefry")
+    bench._record_tpu_capture(suite)
+    assert not cap_path.exists()
+    monkeypatch.delenv("DML_BENCH_RNG_IMPL")
+    bench._record_tpu_capture(suite)
+    assert cap_path.exists()
