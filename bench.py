@@ -1673,6 +1673,14 @@ if __name__ == "__main__":
             child_torch(FULL if argv[2] == "full" else SMALL)
         elif kind == "variant":
             child_variant(argv[2], argv[3])
+        elif kind == "_test_stall":
+            # Test-only: beat once, then hang — a real-process probe of the
+            # monitored parent's staleness kill (tests/test_bench.py).
+            hb = os.environ.get("DML_BENCH_HEARTBEAT_PATH")
+            if hb:
+                with open(hb, "w") as f:
+                    f.write(repr(time.time()))
+            time.sleep(600)
         else:
             raise SystemExit(f"unknown child kind {kind!r}")
     else:

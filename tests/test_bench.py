@@ -622,3 +622,21 @@ def test_forced_rng_run_does_not_clobber_capture(monkeypatch, tmp_path):
     monkeypatch.delenv("DML_BENCH_RNG_IMPL")
     bench._record_tpu_capture(suite)
     assert cap_path.exists()
+
+
+def test_monitored_runner_kills_stale_real_process(tmp_path):
+    """End-to-end staleness kill on a REAL child process: the child beats
+    once then hangs; the monitored parent must SIGTERM it shortly after
+    the heartbeat goes stale — minutes before the wall timeout."""
+    import time as _time
+
+    hb = str(tmp_path / "hb")
+    env = dict(os.environ, DML_BENCH_HEARTBEAT_PATH=hb)
+    env.pop("PYTHONPATH", None)  # never a tunnel env in tests
+    t0 = _time.time()
+    rc, out, err, exited = bench._run_child_monitored(
+        ["--child", "_test_stall"], env, 120, hb, 3.0
+    )
+    elapsed = _time.time() - t0
+    assert rc == 124 and exited
+    assert elapsed < 60, elapsed  # killed at staleness, not the timeout
