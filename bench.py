@@ -48,10 +48,12 @@ _REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 # a single draw hid 12-71s variance in round 3 (VERDICT r3 weak #5, which
 # asks for >=5 repeated cells per measured configuration).
 FULL = dict(num_trials=50, num_epochs=20, data_steps=100_000, warm_repeats=5)
-# Scaled CPU-fallback workload (1-core host; keep it minute-scale). One warm
-# repeat so the headline excludes one-time compile: the r3 CPU fallback
-# "lost" to torch 0.39x mostly on jit compile baked into a single cold wall.
-SMALL = dict(num_trials=8, num_epochs=3, data_steps=30_000, warm_repeats=1)
+# Scaled CPU-fallback workload (1-core host; keep it minute-scale). Warm
+# repeats so the headline excludes one-time compile (the r3 CPU fallback
+# "lost" to torch 0.39x mostly on jit compile baked into a single cold
+# wall) AND is a median with spread — the cross-call program cache makes
+# each repeat cost only the execute wall (~18s here).
+SMALL = dict(num_trials=8, num_epochs=3, data_steps=30_000, warm_repeats=3)
 
 # MXU-bound flagship measurement (VERDICT r3 next #2): the RESULTS.md
 # end-to-end shape — d_model 512, seq 2048, bf16, explicit flash attention
