@@ -28,10 +28,20 @@ spec.loader.exec_module(bench)
 @pytest.fixture(autouse=True)
 def _capture_file_in_tmp(monkeypatch, tmp_path):
     """No test may write the repo's durable benchmarks/last_tpu_capture.json
-    (suite stubs carry platform='tpu' and _run_tpu_suite persists them)."""
+    (suite stubs carry platform='tpu' and _run_tpu_suite persists them),
+    nor the emit's full-evidence sidecar benchmarks/BENCH_DETAIL.json."""
     monkeypatch.setattr(
         bench, "LAST_TPU_CAPTURE_PATH", str(tmp_path / "last_capture.json")
     )
+    monkeypatch.setattr(
+        bench, "BENCH_DETAIL_PATH", str(tmp_path / "detail.json")
+    )
+
+
+def _detail() -> dict:
+    """The full-evidence sidecar written by the last emit() call."""
+    with open(bench.BENCH_DETAIL_PATH) as f:
+        return json.load(f)
 
 
 def test_parse_result_takes_last_json_line():
@@ -120,16 +130,20 @@ def test_main_cpu_fallback_emit_fields(monkeypatch, capsys):
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
     monkeypatch.delenv("DML_TUNNEL_PYTHONPATH", raising=False)
     bench.main()
-    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    raw = capsys.readouterr().out.strip().splitlines()[-1]
+    assert len(raw) < 2000  # the driver captures only a 2 kB stdout tail
+    line = json.loads(raw)
     assert line["backend"] == "cpu"
     assert line["value"] == 1200.0
     assert line["vs_baseline"] == pytest.approx(1200 / 1800, abs=0.01)
     assert line["vs_baseline_cold"] == pytest.approx(960 / 1800, abs=0.01)
     assert line["device_utilization"] == 0.86
-    assert line["cold_wall_s"] == 30.0
-    assert "cpu_note" in line
-    assert line["probe"]["skipped"]
-    assert "cpu_sweep_s" in line["phases"] and "torch_s" in line["phases"]
+    # Diagnosis fields ride in the full-evidence sidecar the line points at.
+    detail = _detail()
+    assert detail["cold_wall_s"] == 30.0
+    assert "cpu_note" in detail
+    assert detail["probe"]["skipped"]
+    assert "cpu_sweep_s" in detail["phases"] and "torch_s" in detail["phases"]
 
 
 def _sweep_stub(dtype, tph):
@@ -170,15 +184,18 @@ def test_main_tpu_path_includes_flagship(monkeypatch, capsys):
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
     monkeypatch.setenv("DML_TUNNEL_PYTHONPATH", "/fake/.axon_site")
     bench.main()
-    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    raw = capsys.readouterr().out.strip().splitlines()[-1]
+    assert len(raw) < 2000
+    line = json.loads(raw)
     assert line["backend"] == "tpu"
     assert line["value"] == 9000.0  # faster dtype headlines
     assert line["compute_dtype"] == "float32"
     assert line["flagship"]["mfu"] == 0.35
-    assert "alt_bfloat16" in line
     assert line["mfu"] is not None
-    assert "cpu_note" not in line
-    assert "tpu_suite_s" in line["phases"]
+    detail = _detail()
+    assert "alt_bfloat16" in detail
+    assert "cpu_note" not in detail
+    assert "tpu_suite_s" in detail["phases"]
 
 
 def test_tpu_suite_resumes_after_stall_with_partial(monkeypatch):
@@ -378,8 +395,9 @@ def test_main_late_reprobe_recovers_tpu(monkeypatch, capsys):
     line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert line["backend"] == "tpu"
     assert line["value"] == 8000.0
-    assert line["probe"]["late_retry"] is True
-    assert "late_probe_s" in line["phases"]
+    detail = _detail()
+    assert detail["probe"]["late_retry"] is True
+    assert "late_probe_s" in detail["phases"]
 
 
 def test_variant_partial_recovers_terminated_trials(tmp_path, monkeypatch):
@@ -550,9 +568,12 @@ def test_last_tpu_capture_recorded_and_attached(monkeypatch, tmp_path,
     bench.main()
     line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert line["backend"] == "cpu"
+    # The LINE carries a provenance summary; the sidecar the full capture.
     attached = line["last_tpu_capture"]
-    assert attached["suite"]["flagship"]["mfu"] == 0.3
+    assert attached["flagship_mfu"] == 0.3
+    assert attached["trials_per_hour"] == 9000.0
     assert attached["captured_at"] == saved["captured_at"]
+    assert _detail()["last_tpu_capture"]["suite"]["flagship"]["mfu"] == 0.3
 
 
 def test_cpu_platform_suite_not_recorded(monkeypatch, tmp_path):
@@ -640,3 +661,150 @@ def test_monitored_runner_kills_stale_real_process(tmp_path):
     elapsed = _time.time() - t0
     assert rc == 124 and exited
     assert elapsed < 60, elapsed  # killed at staleness, not the timeout
+
+
+def test_emit_line_fits_driver_tail_with_worst_case_payload(capsys):
+    """BENCH_r04 regression: the emitted line embedded the whole banked
+    capture and outgrew the driver's 2 kB stdout tail (parsed: null).
+    Worst-case extra -> compact line < 2 kB, full evidence in the sidecar."""
+    flagship = {
+        "step_s": 0.0737, "mfu": 0.284, "tflops_per_s": 55.95,
+        "platform": "tpu", "partial": True,
+        "config": {"batch": 16, "seq": 2048, "d_model": 512,
+                   "compute_dtype": "bfloat16"},
+        "gqa_kv2": {"step_s": 0.07, "speedup_vs_mha": 1.048},
+        "batch_x2": {"step_s": 0.14, "mfu": 0.27},
+    }
+    extra = {
+        "mfu": 0.002, "compute_dtype": "bfloat16",
+        "best_validation_mape": 83.4, "wall_s": 11.7,
+        "device_utilization": 0.54, "vs_baseline_cold": 11.2,
+        "probe": {"attempts": [
+            {"rc": 124, "seconds": 120.0, "timeout_s": 120,
+             "cause": "x" * 240}] * 4},
+        "phases": {"probe_s": 500.0, "tpu_suite_s": 900.0},
+        "last_tpu_capture": {
+            "captured_at": "2026-07-31T10:37:00Z",
+            "suite": {"flagship": flagship,
+                      "sweeps": {"bfloat16": {
+                          "trials_per_hour": 15324.0, "wall_s": 11.7,
+                          "notes": "y" * 4000}}},
+        },
+        "flagship": flagship,
+        "asha": {"wall_s": 5.0, "compile_s": 1.0,
+                 "trials_per_hour": 30000.0, "exec_speedup_vs_fifo": 1.94,
+                 "epochs_run": 330, "fifo_epochs_run": 1000,
+                 "best_validation_mape": 83.2},
+        "quality_at_budget": {"budget_s": 60, "ours_best": 81.2,
+                              "torch_best": 92.3},
+        "total_s": 2400.0,
+    }
+    bench.emit(15324.0, 229.0, "tpu", extra)
+    raw = capsys.readouterr().out.strip().splitlines()[-1]
+    assert len(raw) < 2000, len(raw)
+    line = json.loads(raw)
+    assert line["value"] == 15324.0
+    assert line["flagship"]["mfu"] == 0.284
+    assert line["flagship"]["batch"] == 16
+    assert line["flagship"]["partial"] is True
+    assert line["asha"]["exec_speedup_vs_fifo"] == 1.94
+    assert line["last_tpu_capture"]["trials_per_hour"] == 15324.0
+    assert line["probe_attempts"] == 4
+    detail = _detail()
+    assert detail["last_tpu_capture"]["suite"]["sweeps"]["bfloat16"][
+        "trials_per_hour"] == 15324.0
+    assert detail["probe"]["attempts"][0]["cause"] == "x" * 240
+
+
+def test_emit_trims_optional_blocks_when_oversized(capsys, monkeypatch):
+    """If the compact line somehow outgrows the cap, optional blocks are
+    dropped (flagged truncated) rather than shipping an unparseable tail."""
+    monkeypatch.setattr(bench, "EMIT_MAX_CHARS", 300)
+    bench.emit(100.0, 2.0, "cpu", {
+        "flagship": {"mfu": 0.3, "config": {"batch": 8}},
+        "asha": {"trials_per_hour": 5.0, "exec_speedup_vs_fifo": 1.2},
+        "last_tpu_capture": {"captured_at": "t", "suite": {}},
+    })
+    raw = capsys.readouterr().out.strip().splitlines()[-1]
+    line = json.loads(raw)
+    assert line["value"] == 100.0 and line["truncated"] is True
+
+
+def test_record_tpu_capture_merges_per_phase(monkeypatch, tmp_path):
+    """Advisor r4: a degraded day's PARTIAL phase must not replace a banked
+    COMPLETE one; new complete phases do replace, and new phases merge in."""
+    cap = tmp_path / "cap.json"
+    monkeypatch.setattr(bench, "LAST_TPU_CAPTURE_PATH", str(cap))
+    bench._record_tpu_capture({
+        "flagship": {"mfu": 0.30, "platform": "tpu"},
+        "sweeps": {"float32": {"trials_per_hour": 9000.0,
+                               "platform": "tpu"}},
+    })
+    banked = json.loads(cap.read_text())
+    assert banked["suite"]["flagship"]["mfu"] == 0.30
+    # Degraded re-capture: partial flagship + a NEW bf16 sweep.
+    bench._record_tpu_capture({
+        "flagship": {"mfu": 0.10, "platform": "tpu", "partial": True},
+        "sweeps": {"bfloat16": {"trials_per_hour": 15000.0,
+                                "platform": "tpu"}},
+    })
+    merged = json.loads(cap.read_text())["suite"]
+    assert merged["flagship"]["mfu"] == 0.30  # complete survives partial
+    assert merged["sweeps"]["float32"]["trials_per_hour"] == 9000.0
+    assert merged["sweeps"]["bfloat16"]["trials_per_hour"] == 15000.0
+    # A kept-old phase never inherits the merge time: float32 was banked
+    # by the first capture and must keep (or be stamped with) ITS stamp.
+    first_stamp = banked["captured_at"]
+    assert merged["sweeps"]["float32"]["captured_at"] == first_stamp
+    # An ERROR record never erases measured evidence (review r5): a
+    # flagship that raised must not replace even a banked PARTIAL one.
+    bench._record_tpu_capture({
+        "flagship": {"error": "traceback", "platform": "tpu"},
+        "sweeps": {"bfloat16": {"error": "boom", "platform": "tpu"}},
+    })
+    kept = json.loads(cap.read_text())["suite"]
+    assert kept["flagship"]["mfu"] == 0.30
+    assert kept["sweeps"]["bfloat16"]["trials_per_hour"] == 15000.0
+    # A later COMPLETE flagship does replace the banked one.
+    bench._record_tpu_capture({
+        "flagship": {"mfu": 0.32, "platform": "tpu"}, "sweeps": {},
+    })
+    merged2 = json.loads(cap.read_text())["suite"]
+    assert merged2["flagship"]["mfu"] == 0.32
+    assert merged2["flagship"]["captured_at"]
+    assert merged2["sweeps"]["bfloat16"]["trials_per_hour"] == 15000.0
+
+
+def test_child_suite_reruns_incomplete_flagship(monkeypatch, tmp_path,
+                                                capsys):
+    """Advisor r4: a flagship snapshot killed mid-sub-phase (no 'complete'
+    marker, no 'error') must be RE-RUN by the resume child, not skipped —
+    the GQA/batch-climb evidence is recoverable."""
+    monkeypatch.setattr(bench, "FLAGSHIP", dict(
+        d_model=16, num_heads=2, num_layers=1, dim_feedforward=32,
+        seq=16, batch=2, features=4,
+    ))
+    monkeypatch.setattr(bench, "SMALL", dict(
+        num_trials=2, num_epochs=1, data_steps=10_000, warm_repeats=0,
+    ))
+    partial = tmp_path / "suite.json"
+    partial.write_text(json.dumps({
+        "flagship": {"step_s": 0.5, "platform": "cpu"},  # no 'complete'
+        "sweeps": {
+            "float32": {"trials_per_hour": 111.0, "wall_s": 1.0,
+                        "done": 2, "flops": 1.0, "platform": "cpu",
+                        "compute_dtype": "float32", "peak_flops": None},
+            "bfloat16": {"trials_per_hour": 222.0, "wall_s": 1.0,
+                         "done": 2, "flops": 1.0, "platform": "cpu",
+                         "compute_dtype": "bfloat16", "peak_flops": None},
+        },
+    }))
+    monkeypatch.setenv("DML_BENCH_PARTIAL_PATH", str(partial))
+    monkeypatch.setenv("DML_BENCH_HEARTBEAT_PATH", str(tmp_path / "hb"))
+    bench.child_suite("small")
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # Sweeps were kept (no re-run), the flagship was re-measured fully.
+    assert out["sweeps"]["float32"]["trials_per_hour"] == 111.0
+    assert out["flagship"].get("complete") is True
+    assert out["flagship"]["step_s"] != 0.5
+    assert "gqa_kv2" in out["flagship"]
