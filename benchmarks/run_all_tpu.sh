@@ -77,4 +77,9 @@ gate variant_pbt && TIMEOUT=2400 run variant_pbt python bench.py --variant pbt_c
 gate variant_bohb && TIMEOUT=2400 run variant_bohb python bench.py --variant bohb_transformer
 gate variant_resnet && TIMEOUT=2400 run variant_resnet python bench.py --variant sharded_resnet
 
+# C1 interop on-chip (VERDICT r4 next #8): the full 20-hp driver on a
+# generated reference-format {columns, data} .npy pair — 12 trials x 4
+# epochs, bounded so the multi-architecture compiles fit one window.
+gate refdata && TIMEOUT=1800 run refdata python examples/hpo_reference_data.py
+
 echo "capture complete: $out" | tee -a "$out/summary.txt"

@@ -277,20 +277,23 @@ class _GroupProgram:
             donate_argnums=(0, 1, 2),
         )
 
-    def rebind_data(self, train_data: Dataset, val_data: Dataset) -> None:
+    def rebind_data(self, train_data: Dataset, val_data: Dataset,
+                    force: bool = False) -> None:
         """Point this (possibly cache-reused) program at fresh data.
 
         Every jitted program takes the data as ARGUMENTS, so a program
         traced once serves any data of the same staged shapes; only
         ``init_one``'s baked ``sample_x`` constant is from the original
         data, and flax init consumes it for shapes alone (param values
-        come from the rngs).  Unchanged content (sampled checksum — object
-        identity alone would miss in-place mutation like
-        ``train.y[:] = new``) -> keep the staged device buffers (no
-        re-upload); changed -> re-stage.
+        come from the rngs).  Unchanged content (full crc32 for small
+        arrays, strided sample above _FULL_HASH_BYTES — object identity
+        alone would miss in-place mutation like ``train.y[:] = new``) ->
+        keep the staged device buffers (no re-upload); changed, or
+        ``force=True`` (run_vectorized's force_restage escape) ->
+        re-stage.
         """
         sums = _data_checksums(train_data, val_data)
-        if sums == self._data_sums:
+        if sums == self._data_sums and not force:
             return
         from distributed_machine_learning_tpu.models import compute_dtype_of
 
@@ -335,29 +338,57 @@ def _data_fingerprint(train_data: Dataset, val_data: Dataset) -> Tuple:
     )
 
 
+# Arrays at or below this byte size get an EXACT full-buffer fingerprint;
+# larger ones a strided sample (advisor r4: a sampled checksum alone let an
+# in-place edit confined to non-sampled indices reuse stale staged data).
+# 64 MB covers every realistic HPO split at exact strength for ~10ms.
+_FULL_HASH_BYTES = 64 * 1024 * 1024
+
+
 def _data_checksums(train_data: Dataset, val_data: Dataset) -> Tuple:
-    """Cheap content fingerprint: strided-sample sums (<= ~64k elements per
-    array, ~ms on the biggest realistic splits).  Realistic in-place edits
-    (new targets, rescaling, renormalization) shift these sums; exotic
-    sum-preserving point swaps are out of scope and documented so."""
+    """Content fingerprint for staged-data reuse.
+
+    Arrays <= ``_FULL_HASH_BYTES`` are hashed IN FULL (zlib.crc32 over the
+    raw buffer — any in-place edit changes the fingerprint, bit-exact).
+    Larger arrays fall back to a strided sample (~64k elements: crc32 +
+    float64 sum), which catches realistic whole-array edits (new targets,
+    rescaling, renormalization) but CAN miss an edit confined to
+    non-sampled indices — documented in docs/api.md; pass
+    ``force_restage=True`` (run_vectorized) or ``clear_program_cache()``
+    to override."""
+    import zlib
+
     sums = []
     for a in (train_data.x, train_data.y, val_data.x, val_data.y):
-        flat = np.ravel(a)
-        stride = max(1, flat.size // 65536)
-        sums.append((flat.size, float(np.sum(flat[::stride], dtype=np.float64))))
+        flat = np.ascontiguousarray(np.ravel(a))
+        if flat.nbytes <= _FULL_HASH_BYTES:
+            sums.append((flat.size, "full", zlib.crc32(flat.view(np.uint8))))
+        else:
+            stride = max(1, flat.size // 65536)
+            sample = np.ascontiguousarray(flat[::stride])
+            sums.append((
+                flat.size, "sampled", zlib.crc32(sample.view(np.uint8)),
+                float(np.sum(sample, dtype=np.float64)),
+            ))
     return tuple(sums)
 
 
 def _group_program_for(sig: Tuple, static_cfg: Dict[str, Any],
                        train_data: Dataset, val_data: Dataset,
-                       pop_sharding, log) -> "_GroupProgram":
+                       pop_sharding, device, log,
+                       force_restage: bool = False) -> "_GroupProgram":
     if pop_sharding is not None:
         return _GroupProgram(static_cfg, train_data, val_data, pop_sharding)
-    key = (sig, _data_fingerprint(train_data, val_data))
+    # Device identity is part of the key (advisor r4): on a multi-device
+    # host, a run with a different explicit device= must not silently hit
+    # an entry whose staged buffers and traced programs live elsewhere.
+    dev_id = (getattr(device, "platform", "cpu"), getattr(device, "id", 0))
+    key = (sig, _data_fingerprint(train_data, val_data), dev_id)
     prog = _PROGRAM_CACHE.pop(key, None)
     if prog is not None:
-        prog.rebind_data(train_data, val_data)
-        log("program cache hit: reusing traced group program")
+        prog.rebind_data(train_data, val_data, force=force_restage)
+        log("program cache hit: reusing traced group program"
+            + (" (forced re-stage)" if force_restage else ""))
     else:
         prog = _GroupProgram(static_cfg, train_data, val_data, None)
     _PROGRAM_CACHE[key] = prog  # re-insert = LRU touch (dicts are ordered)
@@ -395,6 +426,7 @@ def run_vectorized(
     callbacks: Optional[List] = None,
     points_to_evaluate: Optional[List[Dict[str, Any]]] = None,
     stop=None,
+    force_restage: bool = False,
 ) -> ExperimentAnalysis:
     """Run an HPO sweep with trials batched into vmapped populations.
 
@@ -436,6 +468,11 @@ def run_vectorized(
     ``num_samples``.  (Chunks spanning multiple static-signature groups
     disable the population checkpoint for that chunk; the common
     fixed-architecture sweep is single-group.)
+
+    ``force_restage``: re-upload the staged data splits even when the
+    content fingerprint matches a cached program's.  Only needed for
+    arrays above the full-hash threshold (64 MB) edited in place at
+    indices the strided sample might miss — see ``_data_checksums``.
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
@@ -672,7 +709,8 @@ def run_vectorized(
                     if program is None:
                         program = programs[sig] = _group_program_for(
                             sig, dict(members[0].config), train_data,
-                            val_data, pop_sharding, log,
+                            val_data, pop_sharding, device, log,
+                            force_restage=force_restage,
                         )
                     compile_before = tracker.thread_seconds()
                     t_pop = time.time()

@@ -36,6 +36,10 @@ def _capture_file_in_tmp(monkeypatch, tmp_path):
     monkeypatch.setattr(
         bench, "BENCH_DETAIL_PATH", str(tmp_path / "detail.json")
     )
+    # Quality-at-budget children are opt-in per test (the dedicated tests
+    # re-enable them); default-off keeps the other parent-flow tests'
+    # child stubs minimal.
+    monkeypatch.setenv("DML_BENCH_QUALITY_BUDGET_S", "0")
 
 
 def _detail() -> dict:
@@ -235,7 +239,7 @@ def test_tpu_suite_resumes_after_stall_with_partial(monkeypatch):
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     phases = {}
-    ours, others, flagship, tunnel_ok = bench._run_tpu_suite(
+    ours, others, flagship, _quality, tunnel_ok = bench._run_tpu_suite(
         lambda m: None, phases
     )
     assert calls == [("suite", None), ("probe", None), ("suite", "1")]
@@ -268,7 +272,7 @@ def test_tpu_suite_keeps_flagship_when_resume_also_stalls(monkeypatch):
     monkeypatch.setattr(bench, "_run_child_monitored", fake_monitored)
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
-    ours, others, flagship, tunnel_ok = bench._run_tpu_suite(
+    ours, others, flagship, _quality, tunnel_ok = bench._run_tpu_suite(
         lambda m: None, {}
     )
     assert calls == [("suite", None), ("probe", None), ("suite", "1")]
@@ -301,7 +305,7 @@ def test_tpu_suite_skips_resume_when_tunnel_wedged(monkeypatch):
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     phases = {}
-    ours, others, flagship, tunnel_ok = bench._run_tpu_suite(
+    ours, others, flagship, _quality, tunnel_ok = bench._run_tpu_suite(
         lambda m: None, phases
     )
     assert calls == ["suite", "probe"]  # no resume against a wedge
@@ -329,7 +333,7 @@ def test_tpu_suite_zombie_post_stall_probe_stops_suite(monkeypatch):
     monkeypatch.setattr(bench, "_run_child_monitored", fake_monitored)
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
-    ours, others, flagship, tunnel_ok = bench._run_tpu_suite(
+    ours, others, flagship, _quality, tunnel_ok = bench._run_tpu_suite(
         lambda m: None, {}
     )
     assert calls == ["suite", "probe"]  # nothing launched past the zombie
@@ -351,7 +355,7 @@ def test_tpu_suite_zombie_suite_child_stops_everything(monkeypatch):
 
     monkeypatch.setattr(bench, "_run_child_monitored", fake_monitored)
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
-    ours, others, flagship, tunnel_ok = bench._run_tpu_suite(
+    ours, others, flagship, _quality, tunnel_ok = bench._run_tpu_suite(
         lambda m: None, {}
     )
     assert tunnel_ok is False
@@ -481,12 +485,15 @@ def test_child_flagship_tiny_shapes(monkeypatch, capsys):
     ))
     bench.child_flagship()
     lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
-    assert len(lines) == 3  # MHA, +gqa, +batch_x2 — crash-safe increments
+    # MHA, +gqa, +seq_x2, final(complete) — crash-safe increments.
+    assert len(lines) == 4
     final = json.loads(lines[-1])
     assert final["config"]["batch"] == 2  # no promotion without peak flops
     assert final["gqa_kv2"].get("step_s") or final["gqa_kv2"].get("error")
     bx2 = final["batch_x2"]
     assert bx2.get("batch") == 4 or bx2.get("error")  # closure saw 2*B
+    sx2 = final["seq_x2"]
+    assert sx2.get("seq") == 32 or sx2.get("error")  # measured at 2*S
 
 
 def test_child_flagship_promotes_winning_batch(monkeypatch, capsys):
@@ -808,3 +815,88 @@ def test_child_suite_reruns_incomplete_flagship(monkeypatch, tmp_path,
     assert out["flagship"].get("complete") is True
     assert out["flagship"]["step_s"] != 0.5
     assert "gqa_kv2" in out["flagship"]
+
+
+def test_main_quality_at_budget_cpu_path(monkeypatch, capsys):
+    """CPU fallback day: both quality children run (ours + torch SHA) and
+    the compact line carries the equal-budget comparison block."""
+    ours = {
+        "trials_per_hour": 1200.0, "wall_s": 24.0, "done": 8,
+        "flops": 1e12, "best_mape": 12.0, "platform": "cpu",
+        "compute_dtype": "float32", "peak_flops": None,
+    }
+
+    def fake_run_child(args, env, timeout_s):
+        if args[:2] == ["--child", "ours"]:
+            return 0, json.dumps(ours), "", True
+        if args[:2] == ["--child", "torch"]:
+            return 0, json.dumps({"trials_per_hour": 1800.0}), "", True
+        if args[:2] == ["--child", "quality"]:
+            return 0, json.dumps({
+                "budget_s": 30.0, "wall_s": 29.0,
+                "best_validation_mape": 80.123, "trials": 32,
+                "sweeps": 2, "platform": "cpu",
+            }), "", True
+        if args[:2] == ["--child", "torch_quality"]:
+            return 0, json.dumps({
+                "budget_s": 30.0, "wall_s": 30.2,
+                "best_validation_mape": 91.456, "trials": 8,
+                "brackets": 1,
+            }), "", True
+        raise AssertionError(f"unexpected child {args}")
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.delenv("DML_TUNNEL_PYTHONPATH", raising=False)
+    monkeypatch.setenv("DML_BENCH_QUALITY_BUDGET_S", "30")
+    bench.main()
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    q = line["quality_at_budget"]
+    assert q["budget_s"] == 30.0
+    assert q["ours_best_mape"] == 80.12
+    assert q["torch_best_mape"] == 91.46
+    assert q["ours_trials"] == 32 and q["torch_trials"] == 8
+    assert q["ours_backend"] == "cpu"
+    assert _detail()["quality_at_budget"] == q
+
+
+def test_main_quality_from_tpu_suite(monkeypatch, capsys):
+    """TPU day: the suite's quality phase is OUR side (no separate CPU
+    quality child); only the torch SHA child runs on CPU."""
+    suite = {
+        "flagship": {"step_s": 0.03, "mfu": 0.35, "platform": "tpu",
+                     "complete": True},
+        "sweeps": {"float32": _sweep_stub("float32", 9000.0),
+                   "bfloat16": _sweep_stub("bfloat16", 7000.0)},
+        "quality": {"budget_s": 30.0, "wall_s": 28.0,
+                    "best_validation_mape": 79.9, "trials": 64,
+                    "sweeps": 4, "platform": "tpu"},
+    }
+    children = []
+
+    def fake_monitored(args, env, timeout_s, hb_path, stale_s):
+        return 0, json.dumps(suite), "", True
+
+    def fake_run_child(args, env, timeout_s):
+        children.append(args[:2])
+        if args == ["--child", "probe"]:
+            return 0, "probe OK: 1 x tpu", "", True
+        if args[:2] == ["--child", "torch"]:
+            return 0, json.dumps({"trials_per_hour": 70.0}), "", True
+        if args[:2] == ["--child", "torch_quality"]:
+            return 0, json.dumps({
+                "budget_s": 30.0, "wall_s": 30.0,
+                "best_validation_mape": 92.0, "trials": 6, "brackets": 1,
+            }), "", True
+        raise AssertionError(f"unexpected child {args}")
+
+    monkeypatch.setattr(bench, "_run_child_monitored", fake_monitored)
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setenv("DML_TUNNEL_PYTHONPATH", "/fake/.axon_site")
+    monkeypatch.setenv("DML_BENCH_QUALITY_BUDGET_S", "30")
+    bench.main()
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    q = line["quality_at_budget"]
+    assert q["ours_backend"] == "tpu"
+    assert q["ours_best_mape"] == 79.9
+    assert q["torch_best_mape"] == 92.0
+    assert ["--child", "quality"] not in children  # suite already ran ours

@@ -526,3 +526,83 @@ def test_program_cache_rebinds_new_data(tiny_data, tmp_path):
     finally:
         vec._GroupProgram.__init__ = orig_init
         vec._PROGRAM_CACHE.clear()
+
+
+def test_program_cache_keyed_by_device_and_force_restage(tiny_data, tmp_path):
+    """Advisor r4: (1) an explicit device= must MISS a cache entry staged
+    on another device (placement is honored, no silent cross-device hit);
+    (2) force_restage=True re-stages on a cache hit even when the content
+    fingerprint is unchanged."""
+    import jax
+
+    import distributed_machine_learning_tpu.tune.vectorized as vec
+
+    train, val = tiny_data
+    vec._PROGRAM_CACHE.clear()
+    builds = []
+    rebind_forces = []
+    orig_init = vec._GroupProgram.__init__
+    orig_rebind = vec._GroupProgram.rebind_data
+
+    def counting_init(self, *a, **kw):
+        builds.append(1)
+        return orig_init(self, *a, **kw)
+
+    def spy_rebind(self, tr, vl, force=False):
+        rebind_forces.append(force)
+        return orig_rebind(self, tr, vl, force=force)
+
+    vec._GroupProgram.__init__ = counting_init
+    vec._GroupProgram.rebind_data = spy_rebind
+    try:
+        def sweep(name, **kw):
+            return run_vectorized(
+                MLP_SPACE, train_data=train, val_data=val,
+                metric="validation_mse", mode="min", num_samples=3,
+                storage_path=str(tmp_path), name=name, seed=5, verbose=0,
+                **kw,
+            )
+
+        sweep("devkey_a")
+        n_first = len(builds)
+        # Same device, same data: hit; force_restage plumbs through.
+        sweep("devkey_b", force_restage=True)
+        assert len(builds) == n_first
+        assert rebind_forces and rebind_forces[-1] is True
+        # Different explicit device: the entry staged on device 0 must not
+        # serve it — a fresh program is built for device 1.
+        assert len(jax.devices()) > 1
+        sweep("devkey_c", device=jax.devices()[1])
+        assert len(builds) > n_first
+    finally:
+        vec._GroupProgram.__init__ = orig_init
+        vec._GroupProgram.rebind_data = orig_rebind
+        vec._PROGRAM_CACHE.clear()
+
+
+def test_data_checksums_exact_below_threshold_sampled_above(monkeypatch):
+    """Arrays at or below _FULL_HASH_BYTES are fingerprinted bit-exactly
+    (any single-element edit changes the checksum); above, the strided
+    sample applies — documented to miss edits at non-sampled indices."""
+    import distributed_machine_learning_tpu.tune.vectorized as vec
+
+    x = np.zeros((300, 7), np.float32)
+    y = np.zeros((300, 1), np.float32)
+    train, val = Dataset(x, y), Dataset(x.copy(), y.copy())
+    base = vec._data_checksums(train, val)
+    assert all(s[1] == "full" for s in base)
+    train.x[173, 3] = 1e-7  # tiny edit, any index
+    assert vec._data_checksums(train, val) != base
+
+    # Force the sampled path: stride for 2100 elements is 1 below 65536,
+    # so shrink both thresholds via monkeypatched module constants.
+    monkeypatch.setattr(vec, "_FULL_HASH_BYTES", 0)
+    big = np.zeros(65536 * 3, np.float32)
+    train2 = Dataset(big, np.zeros(65536 * 3, np.float32))
+    val2 = Dataset(big.copy(), big.copy())
+    s1 = vec._data_checksums(train2, val2)
+    assert all(s[1] == "sampled" for s in s1)
+    train2.x[1] = 5.0  # stride is 3: index 1 is never sampled
+    assert vec._data_checksums(train2, val2) == s1  # the documented miss
+    train2.x[3] = 5.0  # sampled index -> caught
+    assert vec._data_checksums(train2, val2) != s1
