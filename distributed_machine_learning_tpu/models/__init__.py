@@ -104,6 +104,7 @@ def _build_transformer(config: Dict[str, Any]):
         dtype=compute_dtype_of(config),
         position_encoding=config.get("position_encoding", "sincos"),
         num_kv_heads=config.get("num_kv_heads"),
+        block_size=config.get("block_size"),
         remat=config.get("remat", False),
     )
 

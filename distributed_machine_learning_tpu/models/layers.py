@@ -460,6 +460,9 @@ class EncoderLayer(nn.Module):
     dtype: Optional[jnp.dtype] = None
     rope: bool = False
     num_kv_heads: Optional[int] = None
+    # Attention tile override (flash block_q/block_k, blockwise block) —
+    # None = the kernel's measured-fastest defaults.
+    block_size: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
@@ -477,6 +480,7 @@ class EncoderLayer(nn.Module):
             dtype=self.dtype,
             rope=self.rope,
             num_kv_heads=self.num_kv_heads,
+            block_size=self.block_size,
             name="attention",
         )(x, deterministic=deterministic)
         attn = StochasticDepth(self.stochastic_depth_rate)(attn, deterministic)

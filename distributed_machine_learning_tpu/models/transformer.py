@@ -102,6 +102,10 @@ class TransformerRegressor(nn.Module):
     # Grouped-query attention: kv heads per block (None = num_heads; 1 =
     # multi-query). See models/layers.py MultiHeadAttention.
     num_kv_heads: Optional[int] = None
+    # Attention tile override (flash block_q/block_k) — None = the
+    # kernel's measured-fastest defaults; bench.py's flagship tile probe
+    # sets it from config["block_size"].
+    block_size: Optional[int] = None
     # Rematerialization (jax.checkpoint): drop each encoder block's
     # activations in the forward and recompute them in the backward —
     # activation memory goes from O(num_layers) to O(1) blocks at ~1/3
@@ -126,6 +130,7 @@ class TransformerRegressor(nn.Module):
             dtype=self.dtype,
             rope=self.position_encoding == "rope",
             num_kv_heads=self.num_kv_heads,
+            block_size=self.block_size,
             d_model=self.d_model,
             num_heads=self.num_heads,
             dim_feedforward=self.dim_feedforward,

@@ -107,3 +107,80 @@ def make_optimizer(
     if accumulate_grad_batches and int(accumulate_grad_batches) > 1:
         tx = optax.MultiSteps(tx, every_k_schedule=int(accumulate_grad_batches))
     return tx
+
+
+# ---------------------------------------------------------------------------
+# Injected-hyperparameter optimizers: lr/wd live in the optimizer STATE
+# instead of being baked into the traced program as constants.  Two users:
+# the vectorized runner (a population vmaps over the injected slots), and
+# the per-trial trainable (every same-architecture trial then traces to
+# IDENTICAL HLO, so the persistent XLA cache serves one compile to the
+# whole cohort — over the one-claimant TPU tunnel, per-trial backend
+# compiles of 20-40s each were the dominant cost of multi-trial runs and
+# the suspected round-4 bohb stall).
+
+INJECTABLE_OPTIMIZERS = frozenset({"adam", "adamw", "sgd", "rmsprop"})
+
+
+def make_injected_optimizer(
+    name: str,
+    shape_schedule,
+    momentum: float = 0.0,
+    gradient_clipping: float = 0.0,
+) -> optax.GradientTransformation:
+    """Optimizer whose lr/wd are *state* (``optax.inject_hyperparams``).
+
+    The LR schedule contributes a shared *shape* (peak 1.0) via
+    ``scale_by_schedule``; the injected per-run ``learning_rate`` scales it.
+    Decay placement mirrors :func:`make_optimizer`'s registry semantics:
+    L2-style (added to the gradient pre-update) for adam/sgd/rmsprop,
+    decoupled (post-update) for adamw — the reference's optimizer-registry
+    semantics (SURVEY.md §2 C14).  ``momentum`` and ``gradient_clipping``
+    stay baked (they change the chain's structure).
+    """
+    name = name.lower()
+    if name not in INJECTABLE_OPTIMIZERS:
+        raise ValueError(
+            f"injected mode supports {sorted(INJECTABLE_OPTIMIZERS)}, "
+            f"got {name!r}"
+        )
+
+    def factory(learning_rate, weight_decay):
+        parts, post = [], []
+        if gradient_clipping and gradient_clipping > 0:
+            parts.append(optax.clip_by_global_norm(float(gradient_clipping)))
+        if name == "adam":
+            parts.append(optax.add_decayed_weights(weight_decay))
+            parts.append(optax.scale_by_adam())
+        elif name == "adamw":
+            parts.append(optax.scale_by_adam())
+            parts.append(optax.add_decayed_weights(weight_decay))
+        elif name == "sgd":
+            parts.append(optax.add_decayed_weights(weight_decay))
+            if momentum:
+                # optax.sgd applies momentum BEFORE lr scaling.
+                parts.append(optax.trace(decay=float(momentum)))
+        elif name == "rmsprop":
+            parts.append(optax.add_decayed_weights(weight_decay))
+            parts.append(optax.scale_by_rms())
+            if momentum:
+                # optax.rmsprop applies momentum AFTER lr scaling — with a
+                # non-constant schedule the orders genuinely differ (the
+                # trace accumulates lr(t)-scaled steps), so placement must
+                # match the registry's semantics exactly.
+                post.append(optax.trace(decay=float(momentum)))
+        parts.append(optax.scale_by_schedule(shape_schedule))
+        parts.append(optax.scale(-1.0 * learning_rate))
+        return optax.chain(*parts, *post)
+
+    return optax.inject_hyperparams(factory)(learning_rate=0.0, weight_decay=0.0)
+
+
+def set_injected_hyperparams(opt_state, lr, wd):
+    """Return ``opt_state`` with lr/wd written into the inject slots."""
+    import jax.numpy as jnp
+
+    hp = dict(opt_state.hyperparams)
+    hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
+    hp["weight_decay"] = jnp.asarray(wd, jnp.float32)
+    return opt_state._replace(hyperparams=hp)

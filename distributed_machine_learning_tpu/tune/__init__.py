@@ -71,6 +71,7 @@ from distributed_machine_learning_tpu.tune.session import (
     get_devices,
     get_trial_id,
     report,
+    standalone,
     with_parameters,
 )
 from distributed_machine_learning_tpu.tune.trainable import train_regressor
@@ -91,6 +92,7 @@ __all__ = [
     "get_checkpoint",
     "get_devices",
     "get_trial_id",
+    "standalone",
     "with_parameters",
     "train_regressor",
     "train_sharded_regressor",

@@ -144,13 +144,23 @@ class PopulationBasedTraining(TrialScheduler):
             donor = self._find_trial(tid)
             if donor is None or not donor.latest_checkpoint:
                 continue
-            # Budget preservation: never exploit a checkpoint AHEAD of the
-            # laggard's own progress — restoring a donor's final-epoch state
-            # would leave the laggard zero epochs of remaining budget (it
-            # would terminate immediately, silently losing its training run).
-            # A terminated donor is fine as long as its checkpoint iteration
-            # is within the laggard's reach.
-            if donor.latest_checkpoint_iteration > it:
+            # PBT semantics (the reference delegates these to Ray, whose
+            # exploit copies the donor's state INCLUDING its progress):
+            # the laggard adopts the donor's weights and iteration — the
+            # trainable resumes at restored epoch + 1 — so a donor AHEAD
+            # of the laggard is fine and is in fact the common case when
+            # trial starts stagger on shared devices (an earlier
+            # ahead-donors-are-ineligible rule made respawn-PBT
+            # structurally inert e2e: every top trial was ahead of every
+            # bottom one).  The only ineligible donor is one whose
+            # checkpoint leaves NO remaining budget — restoring a
+            # final-epoch state would terminate the laggard immediately,
+            # silently deleting its training run.
+            # 20 is the trainables' own num_epochs default — a config that
+            # omits the key still trains 20 epochs, so the guard must not
+            # silently disable for it (review r5).
+            budget = int(donor.config.get("num_epochs", 20) or 0)
+            if budget and donor.latest_checkpoint_iteration >= budget:
                 continue
             donors.append(donor)
         if not donors:

@@ -11,6 +11,7 @@ fixed (SURVEY.md §3.1).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import threading
 from typing import Any, Callable, Dict, Optional
@@ -89,6 +90,38 @@ def get_trial_id() -> str:
 def get_devices():
     """The jax devices assigned to this trial by the executor."""
     return list(_get_session().devices)
+
+
+class _StandaloneTrial:
+    trial_id = "standalone"
+    training_iteration = 0
+
+
+@contextlib.contextmanager
+def standalone(devices=None):
+    """Run a trainable OUTSIDE ``tune.run``: a no-op session is installed
+    for the calling thread — reports are accepted and discarded (decision
+    always "continue"), no checkpoint to resume from.
+
+    Uses: smoke-running a trainable directly while debugging, and compile
+    warmups — one sequential standalone trial populates the in-process jit
+    and persistent XLA caches so a concurrent trial cohort starts on cache
+    hits instead of firing simultaneous backend compiles (on the one-
+    claimant TPU tunnel those concurrent first compiles are the suspected
+    round-4 bohb stall; bench.py --variant bohb_transformer warms this
+    way).
+    """
+    prev = getattr(_session_store, "session", None)
+    _session_store.session = Session(
+        trial=_StandaloneTrial(),
+        report_fn=lambda metrics, checkpoint: "continue",
+        checkpoint_loader=lambda: None,
+        devices=devices,
+    )
+    try:
+        yield
+    finally:
+        _session_store.session = prev
 
 
 def with_parameters(fn: Callable, **bound) -> Callable:

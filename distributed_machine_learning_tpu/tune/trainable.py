@@ -45,7 +45,12 @@ import jax.numpy as jnp
 from distributed_machine_learning_tpu.data.loader import Dataset
 from distributed_machine_learning_tpu.models import build_model
 from distributed_machine_learning_tpu.ops.losses import get_loss
-from distributed_machine_learning_tpu.ops.optimizers import make_optimizer
+from distributed_machine_learning_tpu.ops.optimizers import (
+    INJECTABLE_OPTIMIZERS,
+    make_injected_optimizer,
+    make_optimizer,
+    set_injected_hyperparams,
+)
 from distributed_machine_learning_tpu.ops.rng import resolve_rng_impl
 from distributed_machine_learning_tpu.ops.schedules import get_schedule
 from distributed_machine_learning_tpu.tune import session
@@ -101,20 +106,56 @@ def train_regressor(
             "total_steps", num_epochs * max(steps_per_epoch // accum, 1)
         )
     )
-    schedule = get_schedule(
+    lr = float(config["learning_rate"])
+    wd = float(config.get("weight_decay", 0.0))
+    opt_name = str(config.get("optimizer", "adam")).lower()
+    # lr/wd as optimizer STATE, not baked HLO constants, whenever the
+    # optimizer supports it: every same-architecture trial then traces to
+    # IDENTICAL HLO and the persistent XLA cache serves ONE backend
+    # compile to the whole cohort.  Over the one-claimant TPU tunnel,
+    # per-trial 20-40s compiles dominated multi-trial thread-executor
+    # runs (the suspected round-4 bohb stall).  The legacy baked path
+    # remains for the optimizers whose chains can't inject (lamb,
+    # adafactor, ...) and for gradient accumulation (MultiSteps wraps the
+    # hyperparam slots); config["inject_hyperparams"]=False forces it.
+    injected = (
+        opt_name in INJECTABLE_OPTIMIZERS
+        and accum == 1
+        and bool(config.get("inject_hyperparams", True))
+    )
+    shape_schedule = get_schedule(
         str(config.get("lr_schedule", "warmup_linear_decay")),
-        learning_rate=float(config["learning_rate"]),
+        learning_rate=1.0,
         warmup_steps=int(config.get("warmup_steps", 0)),
         total_steps=max(total_steps, 1),
     )
-    tx = make_optimizer(
-        str(config.get("optimizer", "adam")),
-        learning_rate=schedule,
-        weight_decay=float(config.get("weight_decay", 0.0)),
-        momentum=float(config.get("momentum", 0.0)),
-        gradient_clipping=float(config.get("gradient_clipping", 0.0)),
-        accumulate_grad_batches=accum,
+    schedule = get_schedule(
+        str(config.get("lr_schedule", "warmup_linear_decay")),
+        learning_rate=lr,
+        warmup_steps=int(config.get("warmup_steps", 0)),
+        total_steps=max(total_steps, 1),
     )
+
+    def _build_tx(use_injected):
+        if use_injected:
+            return make_injected_optimizer(
+                opt_name,
+                shape_schedule,
+                momentum=float(config.get("momentum", 0.0)),
+                gradient_clipping=float(
+                    config.get("gradient_clipping", 0.0)
+                ),
+            )
+        return make_optimizer(
+            opt_name,
+            learning_rate=schedule,
+            weight_decay=wd,
+            momentum=float(config.get("momentum", 0.0)),
+            gradient_clipping=float(config.get("gradient_clipping", 0.0)),
+            accumulate_grad_batches=accum,
+        )
+
+    tx = _build_tx(injected)
 
     model = build_model(config)
     sample_x = data.x_train[:1]
@@ -123,15 +164,21 @@ def train_regressor(
     batch_stats = variables.get("batch_stats", {})
     has_bn = "batch_stats" in variables
     opt_state = tx.init(params)
+    if injected:
+        opt_state = set_injected_hyperparams(opt_state, lr, wd)
 
     forward = make_forward(model, flag_name, has_bn)
-    train_epoch = jax.jit(
-        make_epoch_fn(
-            forward, tx, get_loss(loss_name),
-            data.n_train, data.num_batches, data.batch_size,
-        ),
-        donate_argnums=(0, 1, 2),
-    )
+
+    def _jit_train_epoch(tx):
+        return jax.jit(
+            make_epoch_fn(
+                forward, tx, get_loss(loss_name),
+                data.n_train, data.num_batches, data.batch_size,
+            ),
+            donate_argnums=(0, 1, 2),
+        )
+
+    train_epoch = _jit_train_epoch(tx)
     evaluate = jax.jit(
         make_eval_fn(forward, loss_name, data.n_val_blocks, data.eval_bs)
     )
@@ -163,11 +210,33 @@ def train_regressor(
             "batch_stats": batch_stats,
             "epoch": 0,
         }
-        restored = restore_into(template, ckpt)
+        try:
+            restored = restore_into(template, ckpt)
+        except (ValueError, KeyError, TypeError, AttributeError):
+            if not injected:
+                raise
+            # Legacy checkpoint: written by the pre-injection (baked)
+            # optimizer layout — its opt_state pytree does not match the
+            # InjectHyperparamsState template.  Fall back to the baked
+            # chain for THIS incarnation so old experiments stay
+            # resumable (the next fresh trial uses injection again).
+            injected = False
+            tx = _build_tx(False)
+            opt_state = tx.init(params)
+            train_epoch = _jit_train_epoch(tx)
+            template["opt_state"] = opt_state
+            restored = restore_into(template, ckpt)
         params = restored["params"]
         opt_state = restored["opt_state"]
         batch_stats = restored["batch_stats"]
         start_epoch = int(restored["epoch"]) + 1
+        if injected:
+            # PBT exploit copies a PEER's optimizer state and explore
+            # rewrites config lr/wd — this trial's config values must win
+            # over whatever rode in the restored hyperparam slots (the
+            # baked path achieved the same by rebuilding the schedule
+            # from config).
+            opt_state = set_injected_hyperparams(opt_state, lr, wd)
 
     checkpoint_freq = int(config.get("checkpoint_freq", 1))
 
@@ -212,7 +281,11 @@ def train_regressor(
         record = {
             "epoch": epoch,
             "train_loss": float(train_loss),
-            "lr": float(schedule(min(opt_steps, total_steps))),
+            # Injected path: the shape schedule peaks at 1.0 and the
+            # trial's lr scales it from the optimizer state.
+            "lr": (lr * float(shape_schedule(min(opt_steps, total_steps)))
+                   if injected
+                   else float(schedule(min(opt_steps, total_steps)))),
             "steps": step_count,
             **{k: float(v) for k, v in metrics.items()},
         }

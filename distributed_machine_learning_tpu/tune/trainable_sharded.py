@@ -35,7 +35,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from distributed_machine_learning_tpu.data.loader import Dataset
 from distributed_machine_learning_tpu.models import build_model
 from distributed_machine_learning_tpu.ops.losses import get_loss
-from distributed_machine_learning_tpu.ops.optimizers import make_optimizer
+from distributed_machine_learning_tpu.ops.optimizers import (
+    INJECTABLE_OPTIMIZERS,
+    make_injected_optimizer,
+    make_optimizer,
+    set_injected_hyperparams,
+)
 from distributed_machine_learning_tpu.ops.schedules import get_schedule
 from distributed_machine_learning_tpu.parallel.mesh import make_mesh
 from distributed_machine_learning_tpu.parallel.sharding import (
@@ -99,20 +104,45 @@ def train_sharded_regressor(
             "total_steps", num_epochs * max(steps_per_epoch // accum, 1)
         )
     )
-    schedule = get_schedule(
-        str(config.get("lr_schedule", "warmup_linear_decay")),
-        learning_rate=float(config["learning_rate"]),
-        warmup_steps=int(config.get("warmup_steps", 0)),
-        total_steps=max(total_steps, 1),
+    lr = float(config["learning_rate"])
+    wd = float(config.get("weight_decay", 0.0))
+    opt_name = str(config.get("optimizer", "adam")).lower()
+    # Same-architecture trials share ONE traced program when lr/wd ride in
+    # the optimizer state instead of being baked as HLO constants — see
+    # tune/trainable.py (the identical logic) and ops/optimizers.py.
+    injected = (
+        opt_name in INJECTABLE_OPTIMIZERS
+        and accum == 1
+        and bool(config.get("inject_hyperparams", True))
     )
-    tx = make_optimizer(
-        str(config.get("optimizer", "adam")),
-        learning_rate=schedule,
-        weight_decay=float(config.get("weight_decay", 0.0)),
-        momentum=float(config.get("momentum", 0.0)),
-        gradient_clipping=float(config.get("gradient_clipping", 0.0)),
-        accumulate_grad_batches=accum,
-    )
+    if injected:
+        shape_schedule = get_schedule(
+            str(config.get("lr_schedule", "warmup_linear_decay")),
+            learning_rate=1.0,
+            warmup_steps=int(config.get("warmup_steps", 0)),
+            total_steps=max(total_steps, 1),
+        )
+        tx = make_injected_optimizer(
+            opt_name,
+            shape_schedule,
+            momentum=float(config.get("momentum", 0.0)),
+            gradient_clipping=float(config.get("gradient_clipping", 0.0)),
+        )
+    else:
+        schedule = get_schedule(
+            str(config.get("lr_schedule", "warmup_linear_decay")),
+            learning_rate=lr,
+            warmup_steps=int(config.get("warmup_steps", 0)),
+            total_steps=max(total_steps, 1),
+        )
+        tx = make_optimizer(
+            opt_name,
+            learning_rate=schedule,
+            weight_decay=wd,
+            momentum=float(config.get("momentum", 0.0)),
+            gradient_clipping=float(config.get("gradient_clipping", 0.0)),
+            accumulate_grad_batches=accum,
+        )
     loss_fn = get_loss(loss_name)
 
     model = build_model(config)
@@ -131,6 +161,8 @@ def train_sharded_regressor(
     opt_state = jax.jit(
         tx.init, in_shardings=(p_shardings,), out_shardings=o_shardings
     )(params)
+    if injected:
+        opt_state = set_injected_hyperparams(opt_state, lr, wd)
     batch_stats = jax.device_put(
         variables.get("batch_stats", {}),
         jax.tree.map(lambda _: NamedSharding(mesh, P()),
@@ -211,10 +243,55 @@ def train_sharded_regressor(
             "batch_stats": _host(batch_stats),
             "epoch": 0,
         }
-        restored = restore_into(template, ckpt)
+        try:
+            restored = restore_into(template, ckpt)
+        except (ValueError, KeyError, TypeError, AttributeError):
+            if not injected:
+                raise
+            # Legacy checkpoint from the pre-injection (baked) optimizer
+            # layout — rebuild the baked chain for this incarnation (same
+            # fallback as tune/trainable.py).  epoch_fn closes over `tx`
+            # late-bound, so re-jitting after the rebind traces the baked
+            # update.
+            injected = False
+            schedule = get_schedule(
+                str(config.get("lr_schedule", "warmup_linear_decay")),
+                learning_rate=lr,
+                warmup_steps=int(config.get("warmup_steps", 0)),
+                total_steps=max(total_steps, 1),
+            )
+            tx = make_optimizer(
+                opt_name,
+                learning_rate=schedule,
+                weight_decay=wd,
+                momentum=float(config.get("momentum", 0.0)),
+                gradient_clipping=float(
+                    config.get("gradient_clipping", 0.0)
+                ),
+                accumulate_grad_batches=accum,
+            )
+            o_shardings = opt_state_shardings(
+                jax.eval_shape(tx.init, params), p_shardings, mesh
+            )
+            opt_state = jax.jit(
+                tx.init, in_shardings=(p_shardings,),
+                out_shardings=o_shardings,
+            )(params)
+            train_epoch = jax.jit(
+                epoch_fn,
+                donate_argnums=(0, 1, 2),
+                in_shardings=(None, None, None, xb_sharding, yb_sharding,
+                              None),
+            )
+            template["opt_state"] = _host(opt_state)
+            restored = restore_into(template, ckpt)
         # Re-shard restored host arrays into the live mesh layout.
         params = jax.device_put(restored["params"], p_shardings)
         opt_state = jax.device_put(restored["opt_state"], o_shardings)
+        if injected:
+            # This trial's config lr/wd win over restored slots (PBT
+            # explore semantics — same as tune/trainable.py).
+            opt_state = set_injected_hyperparams(opt_state, lr, wd)
         batch_stats = jax.device_put(
             restored["batch_stats"],
             jax.tree.map(lambda _: NamedSharding(mesh, P()),
@@ -247,7 +324,9 @@ def train_sharded_regressor(
         record = {
             "epoch": epoch,
             "train_loss": float(train_loss),
-            "lr": float(schedule(min(opt_steps, total_steps))),
+            "lr": (lr * float(shape_schedule(min(opt_steps, total_steps)))
+                   if injected
+                   else float(schedule(min(opt_steps, total_steps)))),
             "steps": step_count,
             "num_devices": len(devices),
             **{k: float(v) for k, v in metrics.items()},

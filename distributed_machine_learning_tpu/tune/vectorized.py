@@ -65,11 +65,14 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 
 from distributed_machine_learning_tpu.data.loader import Dataset
 from distributed_machine_learning_tpu.models import build_model
 from distributed_machine_learning_tpu.ops.losses import get_loss
+from distributed_machine_learning_tpu.ops.optimizers import (
+    make_injected_optimizer,
+    set_injected_hyperparams,
+)
 from distributed_machine_learning_tpu.ops.rng import resolve_rng_impl
 from distributed_machine_learning_tpu.ops.schedules import get_schedule
 from distributed_machine_learning_tpu.utils.heartbeat import touch_heartbeat
@@ -116,60 +119,11 @@ def _static_signature(config: Dict[str, Any]) -> Tuple:
     return tuple(items)
 
 
-def _make_population_optimizer(
-    name: str,
-    shape_schedule,
-    momentum: float,
-    gradient_clipping: float,
-) -> optax.GradientTransformation:
-    """Optimizer whose lr/wd are *state*, so a population can vmap over them.
-
-    ``optax.inject_hyperparams`` lifts ``learning_rate``/``weight_decay`` into
-    the optimizer state pytree; each trial's slice of the vmapped state carries
-    its own values.  The LR schedule contributes a shared *shape* (peak 1.0)
-    via ``scale_by_schedule``; the injected per-trial ``learning_rate`` scales
-    it.  Decay placement mirrors ops.optimizers: L2-style (added to the
-    gradient pre-update) for adam/sgd/rmsprop, decoupled (post-update) for
-    adamw — the reference's optimizer-registry semantics (SURVEY.md §2 C14).
-    """
-    name = name.lower()
-
-    def factory(learning_rate, weight_decay):
-        parts = []
-        if gradient_clipping and gradient_clipping > 0:
-            parts.append(optax.clip_by_global_norm(float(gradient_clipping)))
-        if name == "adam":
-            parts.append(optax.add_decayed_weights(weight_decay))
-            parts.append(optax.scale_by_adam())
-        elif name == "adamw":
-            parts.append(optax.scale_by_adam())
-            parts.append(optax.add_decayed_weights(weight_decay))
-        elif name == "sgd":
-            parts.append(optax.add_decayed_weights(weight_decay))
-            if momentum:
-                parts.append(optax.trace(decay=float(momentum)))
-        elif name == "rmsprop":
-            parts.append(optax.add_decayed_weights(weight_decay))
-            parts.append(optax.scale_by_rms())
-            if momentum:
-                parts.append(optax.trace(decay=float(momentum)))
-        else:
-            raise ValueError(
-                f"vectorized mode supports adam/adamw/sgd/rmsprop, got {name!r}"
-            )
-        parts.append(optax.scale_by_schedule(shape_schedule))
-        parts.append(optax.scale(-1.0 * learning_rate))
-        return optax.chain(*parts)
-
-    return optax.inject_hyperparams(factory)(learning_rate=0.0, weight_decay=0.0)
-
-
-def _set_hyperparams(opt_state, lr, wd):
-    """Return opt_state with this trial's lr/wd written into the inject slot."""
-    hp = dict(opt_state.hyperparams)
-    hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
-    hp["weight_decay"] = jnp.asarray(wd, jnp.float32)
-    return opt_state._replace(hyperparams=hp)
+# Shared with the per-trial trainable (ops/optimizers.py): lr/wd live in
+# the optimizer state so a population can vmap over them — and so every
+# same-architecture trial traces to identical HLO.
+_make_population_optimizer = make_injected_optimizer
+_set_hyperparams = set_injected_hyperparams
 
 
 class _GroupProgram:

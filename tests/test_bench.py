@@ -485,8 +485,9 @@ def test_child_flagship_tiny_shapes(monkeypatch, capsys):
     ))
     bench.child_flagship()
     lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
-    # MHA, +gqa, +seq_x2, final(complete) — crash-safe increments.
-    assert len(lines) == 4
+    # MHA, +gqa, +seq_x2, +tile_256, final(complete) — crash-safe
+    # increments.
+    assert len(lines) == 5
     final = json.loads(lines[-1])
     assert final["config"]["batch"] == 2  # no promotion without peak flops
     assert final["gqa_kv2"].get("step_s") or final["gqa_kv2"].get("error")

@@ -356,3 +356,29 @@ def test_linear_attention_gqa_matches_repeat(causal):
     assert g[1].shape == k.shape
     for a, b in zip(g, r):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_block_size_config_plumbs_to_attention_tiles():
+    """config["block_size"] must reach the attention kernels (review r5:
+    it was silently dropped, making bench's tile probe measure the same
+    program twice). Numerics are tile-invariant, so same-seed outputs
+    must match the default-tile model."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_machine_learning_tpu.models import build_model
+
+    base = {
+        "model": "transformer", "d_model": 16, "num_heads": 2,
+        "num_layers": 1, "dim_feedforward": 32, "dropout": 0.0,
+        "attention_type": "flash", "max_seq_length": 64,
+    }
+    m_tiled = build_model(dict(base, block_size=32))
+    assert m_tiled.block_size == 32  # factory -> module
+    m_default = build_model(base)
+    x = jnp.ones((2, 64, 4), jnp.float32)
+    v1 = m_tiled.init({"params": jax.random.key(0)}, x)
+    v2 = m_default.init({"params": jax.random.key(0)}, x)
+    o1 = m_tiled.apply(v1, x, deterministic=True)
+    o2 = m_default.apply(v2, x, deterministic=True)
+    assert jnp.allclose(o1, o2, atol=1e-5), (o1 - o2)

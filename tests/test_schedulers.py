@@ -99,6 +99,45 @@ class TestPBT:
         assert worst.restore_path in {f"/fake/ckpt_{i}" for i in range(2)}
         assert worst.config["learning_rate"] != 8e-3  # mutated
 
+    def test_ahead_donor_is_eligible_but_exhausted_donor_is_not(self):
+        """Ray-parity exploit semantics (r5): a donor AHEAD of the laggard
+        donates (the laggard adopts its weights AND iteration — the common
+        case when trial starts stagger on shared devices; the old
+        ahead-donors-ineligible rule made respawn-PBT structurally inert
+        e2e).  A donor at its FINAL epoch stays ineligible: restoring it
+        would leave the laggard zero remaining budget."""
+        s = tune.PopulationBasedTraining(
+            metric="loss", mode="min", perturbation_interval=2,
+            hyperparam_mutations={"learning_rate": tune.loguniform(1e-5, 1e-1)},
+        )
+        trials = self._population(s)
+        for t in trials:
+            t.config["num_epochs"] = 10
+        # Every trial has an early score (iteration-bucketed ranking needs
+        # peers at-or-before the laggard's it), but the top trials' LATEST
+        # CHECKPOINTS are far ahead (iteration 6 vs the laggard's 2).
+        for i, t in enumerate(trials):
+            t.latest_checkpoint_iteration = 6
+            s.on_trial_result(t, _result(t, 1, float(i)))
+        worst = trials[7]
+        assert s.on_trial_result(worst, _result(worst, 2, 7.0)) == REQUEUE
+        assert worst.restore_path in {f"/fake/ckpt_{i}" for i in range(2)}
+        assert worst.restore_base == 6  # adopted the donor's progress
+
+        # Same setup, but every potential donor checkpoint is at the final
+        # epoch -> no eligible donor -> no perturbation.
+        s2 = tune.PopulationBasedTraining(
+            metric="loss", mode="min", perturbation_interval=2,
+            hyperparam_mutations={"learning_rate": tune.loguniform(1e-5, 1e-1)},
+        )
+        trials2 = self._population(s2)
+        for i, t in enumerate(trials2):
+            t.config["num_epochs"] = 10
+            t.latest_checkpoint_iteration = 10
+            s2.on_trial_result(t, _result(t, 10 if i < 4 else 1, float(i)))
+        worst2 = trials2[7]
+        assert s2.on_trial_result(worst2, _result(worst2, 2, 7.0)) == CONTINUE
+
     def test_no_perturbation_off_interval(self):
         s = tune.PopulationBasedTraining(
             metric="loss", mode="min", perturbation_interval=5,

@@ -290,3 +290,33 @@ def test_rng_impl_rbg_trains_and_resumes(tmp_path):
     assert v2.num_terminated() == 2
     # Every trial reached full depth through the post-resume epochs.
     assert all(t.training_iteration == 3 for t in v2.trials)
+
+
+def test_standalone_session_runs_trainable_directly():
+    """tune.standalone(): a trainable runs OUTSIDE tune.run — reports are
+    swallowed (always 'continue'), no checkpoint — the compile-warmup path
+    bench.py's bohb variant uses before its concurrent cohort."""
+    import numpy as np
+
+    from distributed_machine_learning_tpu import tune
+    from distributed_machine_learning_tpu.data import dummy_regression_data
+
+    train, val = dummy_regression_data(
+        num_samples=64, seq_len=8, num_features=4
+    )
+    cfg = {
+        "model": "simple_transformer", "d_model": 8, "num_heads": 2,
+        "num_layers": 1, "dim_feedforward": 16, "learning_rate": 1e-3,
+        "num_epochs": 2, "batch_size": 16, "loss_function": "mse",
+    }
+    with tune.standalone():
+        # Completing both epochs without raising IS the contract (every
+        # per-epoch report is swallowed with decision "continue").
+        tune.train_regressor(cfg, train_data=train, val_data=val)
+    # Outside the context the session is gone again.
+    import pytest
+
+    from distributed_machine_learning_tpu.tune import session
+
+    with pytest.raises(RuntimeError):
+        session.report({"x": 1.0})
