@@ -204,14 +204,19 @@ def stage_data(
     )
 
 
-def detect_call_convention(model, sample_x):
+def detect_call_convention(model, sample_x, init_rngs=None):
     """Init the model and learn (variables, train-flag kwarg name).
 
     The init is jitted: eager ``model.init`` dispatches hundreds of tiny ops
     one by one, which is pathological on a remote/tunneled TPU backend; one
-    compiled executable makes trial startup near-constant.
+    compiled executable makes trial startup near-constant.  The rng dict is
+    a traced ARGUMENT, so trials with different ``init_rngs`` (per-trial
+    init diversity — the reference's torch trials each start from their own
+    random init) share one compiled init program.
     """
-    rng = {"params": jax.random.key(0), "dropout": jax.random.key(1)}
+    rng = init_rngs or {
+        "params": jax.random.key(0), "dropout": jax.random.key(1)
+    }
     try:
         variables = jax.jit(
             lambda r, x: model.init(r, x, deterministic=True)

@@ -69,7 +69,10 @@ from distributed_machine_learning_tpu.ops.flops import (
 )
 from distributed_machine_learning_tpu.tune.checkpoint import restore_into
 from distributed_machine_learning_tpu.utils.compile_cache import get_tracker
-from distributed_machine_learning_tpu.utils.seeding import fold_seed
+from distributed_machine_learning_tpu.utils.seeding import (
+    fold_seed,
+    init_rngs_for,
+)
 
 # Back-compat aliases (vectorized.py and external users imported these names).
 _detect_call_convention = detect_call_convention
@@ -159,7 +162,15 @@ def train_regressor(
 
     model = build_model(config)
     sample_x = data.x_train[:1]
-    variables, flag_name = detect_call_convention(model, sample_x)
+    # Per-trial init diversity (the reference's torch trials each start
+    # from their own random init; the vectorized runner seeds init_one
+    # per row): the trial's seed derives the init streams.  The rng is a
+    # traced argument, so every same-architecture trial still shares one
+    # compiled init program.
+    variables, flag_name = detect_call_convention(
+        model, sample_x,
+        init_rngs=init_rngs_for(seed),
+    )
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
     has_bn = "batch_stats" in variables

@@ -56,7 +56,10 @@ from distributed_machine_learning_tpu.tune._regression_program import (
     per_example_losses,
 )
 from distributed_machine_learning_tpu.tune.checkpoint import restore_into
-from distributed_machine_learning_tpu.utils.seeding import fold_seed
+from distributed_machine_learning_tpu.utils.seeding import (
+    fold_seed,
+    init_rngs_for,
+)
 
 
 def _host(tree):
@@ -147,7 +150,12 @@ def train_sharded_regressor(
 
     model = build_model(config)
     sample_x = x_np[:1]
-    variables, flag_name = detect_call_convention(model, sample_x)
+    # Per-trial init diversity, same as train_regressor (the rng is a
+    # traced argument — one compiled init program per architecture).
+    variables, flag_name = detect_call_convention(
+        model, sample_x,
+        init_rngs=init_rngs_for(seed),
+    )
     has_bn = "batch_stats" in variables
     forward = make_forward(model, flag_name, has_bn)
 

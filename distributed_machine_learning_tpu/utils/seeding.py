@@ -21,3 +21,16 @@ def fold_seed(*parts) -> int:
     """A stable 31-bit integer seed derived from the parts (for jax.random.key)."""
     h = hashlib.sha256("/".join(str(p) for p in parts).encode()).digest()
     return int.from_bytes(h[:4], "little") & 0x7FFFFFFF
+
+
+def init_rngs_for(seed):
+    """The per-trial model-init rng streams ({"params", "dropout"}) derived
+    from a trial seed — ONE derivation shared by the thread-executor and
+    sharded trainables, so same-seed trials init identically on both paths.
+    """
+    import jax
+
+    return {
+        "params": jax.random.key(fold_seed(seed, "init")),
+        "dropout": jax.random.key(fold_seed(seed, "init_dropout")),
+    }

@@ -2,7 +2,6 @@
 
 from __future__ import annotations
 
-import jax
 import numpy as np
 import pytest
 
@@ -100,13 +99,17 @@ def test_best_model_reload(tmp_path):
     preds = model.apply(variables, val.x[:8], deterministic=True)
     assert preds.shape == (8, 1)
     assert np.all(np.isfinite(np.asarray(preds)))
-    # The reloaded params are the TRAINED ones: they beat a fresh init.
-    fresh = model.init(
-        {"params": jax.random.key(0)}, val.x[:1], deterministic=True
-    )
+    # The reloaded params are the best trial's TRAINED weights: applying
+    # them reproduces its reported validation loss.  (The old check —
+    # "beats a fresh key(0) init" — assumed every trial STARTED from
+    # key(0); per-trial init diversity (r5) broke that premise.)
     mse = lambda v: float(np.mean((np.asarray(
         model.apply(v, val.x, deterministic=True)) - val.y) ** 2))
-    assert mse(variables) < mse(fresh)
+    # best_model() loads the NEWEST checkpoint, so compare against the
+    # best trial's LAST report (best_result is the min over epochs and
+    # diverges whenever the final epoch regresses).
+    reported = float(analysis.best_trial.last_result["validation_loss"])
+    assert mse(variables) == pytest.approx(reported, rel=1e-4)
 
 
 def test_invalid_stop_rejected_at_submission(tmp_path):
