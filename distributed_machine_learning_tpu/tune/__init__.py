@@ -74,19 +74,31 @@ from distributed_machine_learning_tpu.tune.session import (
     standalone,
     with_parameters,
 )
-from distributed_machine_learning_tpu.tune.trainable import train_regressor
+from distributed_machine_learning_tpu.tune.trainable import (
+    clear_cohort_program_cache,
+    train_regressor,
+)
 from distributed_machine_learning_tpu.tune.trainable_sharded import (
     train_sharded_regressor,
 )
 from distributed_machine_learning_tpu.tune.vectorized import (
-    clear_program_cache,
+    clear_program_cache as _clear_vectorized_program_cache,
     run_vectorized,
 )
 from distributed_machine_learning_tpu.tune.trial import Resources, Trial, TrialStatus
 
+
+def clear_program_cache() -> None:
+    """Free every cached traced program and its staged device data: the
+    vectorized runner's cross-call cache AND tune.run's cohort cache
+    (one call frees everything that pins device memory)."""
+    _clear_vectorized_program_cache()
+    clear_cohort_program_cache()
+
 __all__ = [
     "run",
     "clear_program_cache",
+    "clear_cohort_program_cache",
     "run_vectorized",
     "report",
     "get_checkpoint",

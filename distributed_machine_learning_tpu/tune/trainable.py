@@ -37,6 +37,8 @@ seed, but different impls produce different trajectories).
 
 from __future__ import annotations
 
+import threading
+from collections import namedtuple
 from typing import Any, Dict, Optional
 
 import jax
@@ -79,6 +81,98 @@ _detect_call_convention = detect_call_convention
 _per_example_losses = per_example_losses
 
 
+# ---------------------------------------------------------------------------
+# Cohort program cache: ONE build (stage + trace + compile) per
+# (architecture, data, device) shared by every trial of a tune.run cohort.
+#
+# With injected hyperparameters the staged data and every jitted program
+# are trial-independent (lr/wd are state, seed enters as traced rng /
+# per-epoch key arguments), yet each train_regressor call used to rebuild
+# and retrace them — seconds of host work per trial on a 1-core TPU host,
+# and N racing first-compiles when a cohort's threads start together.
+# Construction runs under a per-key lock: the first trial builds, the
+# rest of the cohort WAITS and reuses — in-process, this alone serializes
+# the cohort's backend compile into exactly one.
+
+_CohortBundle = namedtuple("_CohortBundle", [
+    "data", "model", "flag_name", "has_bn", "forward", "tx", "init_model",
+    "init_opt", "train_epoch", "evaluate", "shape_schedule",
+    "steps_per_epoch", "total_steps",
+])
+
+_COHORT_CACHE: Dict[Any, Any] = {}
+_COHORT_LOCKS: Dict[Any, Any] = {}
+_COHORT_CACHE_MAX = 8
+# Entries pin their staged splits in device memory: cap total staged
+# bytes too (same rationale and limit as vectorized._PROGRAM_CACHE).
+_COHORT_CACHE_MAX_BYTES = 256 * 1024 * 1024
+_COHORT_GUARD = threading.Lock()
+
+
+def _bundle_nbytes(bundle) -> int:
+    return sum(
+        int(getattr(a, "nbytes", 0))
+        for a in (bundle.data.x_train, bundle.data.y_train,
+                  bundle.data.x_val, bundle.data.y_val)
+    )
+
+
+def clear_cohort_program_cache() -> None:
+    """Drop every cached cohort bundle (frees their staged device data)."""
+    with _COHORT_GUARD:
+        _COHORT_CACHE.clear()
+        _COHORT_LOCKS.clear()
+
+
+def _cohort_key(config, train_data, val_data, device):
+    # Shared definitions: the vectorized runner's static signature (what
+    # shapes a traced program) and content checksums (bit-exact below
+    # 64 MB).  Function-level import — vectorized.py does not import this
+    # module, but it imports half the package.
+    from distributed_machine_learning_tpu.tune.vectorized import (
+        _data_checksums,
+        _static_signature,
+    )
+
+    sig = _static_signature(dict(config))
+    try:
+        hash(sig)
+    except TypeError:
+        sig = repr(sig)
+    return (
+        sig,
+        _data_checksums(train_data, val_data),
+        (getattr(device, "platform", "cpu"), getattr(device, "id", 0)),
+    )
+
+
+def _cohort_bundle_for(config, train_data, val_data, device, build):
+    key = _cohort_key(config, train_data, val_data, device)
+    with _COHORT_GUARD:
+        bundle = _COHORT_CACHE.pop(key, None)
+        if bundle is not None:
+            _COHORT_CACHE[key] = bundle  # re-insert = LRU touch
+            return bundle
+        lock = _COHORT_LOCKS.setdefault(key, threading.Lock())
+    with lock:  # exactly-once build; the cohort's other trials wait here
+        with _COHORT_GUARD:
+            bundle = _COHORT_CACHE.get(key)
+            if bundle is not None:
+                return bundle
+        bundle = build()
+        with _COHORT_GUARD:
+            _COHORT_CACHE[key] = bundle
+            while len(_COHORT_CACHE) > 1 and (
+                len(_COHORT_CACHE) > _COHORT_CACHE_MAX
+                or sum(_bundle_nbytes(b) for b in _COHORT_CACHE.values())
+                > _COHORT_CACHE_MAX_BYTES
+            ):
+                evicted = next(iter(_COHORT_CACHE))
+                _COHORT_CACHE.pop(evicted)
+                _COHORT_LOCKS.pop(evicted, None)
+        return bundle
+
+
 def train_regressor(
     config: Dict[str, Any],
     train_data: Optional[Dataset] = None,
@@ -97,18 +191,7 @@ def train_regressor(
 
     compute_dtype = compute_dtype_of(config) or jnp.float32
 
-    data = stage_data(
-        train_data, val_data, int(config.get("batch_size", 32)), compute_dtype
-    )
-    steps_per_epoch = data.num_batches
     accum = max(int(config.get("accumulate_grad_batches", 1)), 1)
-    # The schedule advances once per OPTIMIZER step; with accumulation that
-    # is steps_per_epoch // accum per epoch, not per micro-batch.
-    total_steps = int(
-        config.get(
-            "total_steps", num_epochs * max(steps_per_epoch // accum, 1)
-        )
-    )
     lr = float(config["learning_rate"])
     wd = float(config.get("weight_decay", 0.0))
     opt_name = str(config.get("optimizer", "adam")).lower()
@@ -126,22 +209,26 @@ def train_regressor(
         and accum == 1
         and bool(config.get("inject_hyperparams", True))
     )
-    shape_schedule = get_schedule(
-        str(config.get("lr_schedule", "warmup_linear_decay")),
-        learning_rate=1.0,
-        warmup_steps=int(config.get("warmup_steps", 0)),
-        total_steps=max(total_steps, 1),
-    )
-    schedule = get_schedule(
-        str(config.get("lr_schedule", "warmup_linear_decay")),
-        learning_rate=lr,
-        warmup_steps=int(config.get("warmup_steps", 0)),
-        total_steps=max(total_steps, 1),
-    )
 
-    def _build_tx(use_injected):
+    def _build_bundle(use_injected) -> _CohortBundle:
+        data = stage_data(
+            train_data, val_data, int(config.get("batch_size", 32)),
+            compute_dtype,
+        )
+        steps_per_epoch = data.num_batches
+        # The schedule advances once per OPTIMIZER step; with accumulation
+        # that is steps_per_epoch // accum per epoch, not per micro-batch.
+        total_steps = max(int(config.get(
+            "total_steps", num_epochs * max(steps_per_epoch // accum, 1)
+        )), 1)
+        shape_schedule = get_schedule(
+            str(config.get("lr_schedule", "warmup_linear_decay")),
+            learning_rate=1.0,
+            warmup_steps=int(config.get("warmup_steps", 0)),
+            total_steps=total_steps,
+        )
         if use_injected:
-            return make_injected_optimizer(
+            tx = make_injected_optimizer(
                 opt_name,
                 shape_schedule,
                 momentum=float(config.get("momentum", 0.0)),
@@ -149,50 +236,81 @@ def train_regressor(
                     config.get("gradient_clipping", 0.0)
                 ),
             )
-        return make_optimizer(
-            opt_name,
-            learning_rate=schedule,
-            weight_decay=wd,
-            momentum=float(config.get("momentum", 0.0)),
-            gradient_clipping=float(config.get("gradient_clipping", 0.0)),
-            accumulate_grad_batches=accum,
+        else:
+            tx = make_optimizer(
+                opt_name,
+                learning_rate=get_schedule(
+                    str(config.get("lr_schedule", "warmup_linear_decay")),
+                    learning_rate=lr,
+                    warmup_steps=int(config.get("warmup_steps", 0)),
+                    total_steps=total_steps,
+                ),
+                weight_decay=wd,
+                momentum=float(config.get("momentum", 0.0)),
+                gradient_clipping=float(
+                    config.get("gradient_clipping", 0.0)
+                ),
+                accumulate_grad_batches=accum,
+            )
+        model = build_model(config)
+        # Convention probe (fixed rng, discarded): learns the train-flag
+        # kwarg and whether the family carries batch stats.
+        probe, flag_name = detect_call_convention(model, data.x_train[:1])
+        has_bn = "batch_stats" in probe
+        init_kwargs = {
+            flag_name: True if flag_name == "deterministic" else False
+        }
+        # Per-trial init diversity rides through the rng ARGUMENT (the
+        # reference's torch trials each start from their own random
+        # init): one compiled init program serves every seed.
+        init_model = jax.jit(
+            lambda rngs, x: model.init(rngs, x, **init_kwargs)
         )
-
-    tx = _build_tx(injected)
-
-    model = build_model(config)
-    sample_x = data.x_train[:1]
-    # Per-trial init diversity (the reference's torch trials each start
-    # from their own random init; the vectorized runner seeds init_one
-    # per row): the trial's seed derives the init streams.  The rng is a
-    # traced argument, so every same-architecture trial still shares one
-    # compiled init program.
-    variables, flag_name = detect_call_convention(
-        model, sample_x,
-        init_rngs=init_rngs_for(seed),
-    )
-    params = variables["params"]
-    batch_stats = variables.get("batch_stats", {})
-    has_bn = "batch_stats" in variables
-    opt_state = tx.init(params)
-    if injected:
-        opt_state = set_injected_hyperparams(opt_state, lr, wd)
-
-    forward = make_forward(model, flag_name, has_bn)
-
-    def _jit_train_epoch(tx):
-        return jax.jit(
+        forward = make_forward(model, flag_name, has_bn)
+        train_epoch = jax.jit(
             make_epoch_fn(
                 forward, tx, get_loss(loss_name),
                 data.n_train, data.num_batches, data.batch_size,
             ),
             donate_argnums=(0, 1, 2),
         )
+        evaluate = jax.jit(
+            make_eval_fn(forward, loss_name, data.n_val_blocks, data.eval_bs)
+        )
+        return _CohortBundle(
+            data=data, model=model, flag_name=flag_name, has_bn=has_bn,
+            forward=forward, tx=tx, init_model=init_model,
+            init_opt=jax.jit(tx.init), train_epoch=train_epoch,
+            evaluate=evaluate, shape_schedule=shape_schedule,
+            steps_per_epoch=steps_per_epoch, total_steps=total_steps,
+        )
 
-    train_epoch = _jit_train_epoch(tx)
-    evaluate = jax.jit(
-        make_eval_fn(forward, loss_name, data.n_val_blocks, data.eval_bs)
-    )
+    lease = session.get_devices()
+    device = lease[0] if lease else jax.devices()[0]
+    if injected and bool(config.get("share_programs", True)):
+        # Everything in the bundle is trial-independent under injection:
+        # one build serves the whole cohort (and the per-key lock makes
+        # the cohort's first backend compile exactly-once in-process).
+        bundle = _cohort_bundle_for(
+            config, train_data, val_data, device,
+            lambda: _build_bundle(True),
+        )
+    else:
+        bundle = _build_bundle(injected)
+    data = bundle.data
+    steps_per_epoch = bundle.steps_per_epoch
+    total_steps = bundle.total_steps
+    shape_schedule = bundle.shape_schedule
+    tx = bundle.tx
+    train_epoch = bundle.train_epoch
+    evaluate = bundle.evaluate
+
+    variables = bundle.init_model(init_rngs_for(seed), data.x_train[:1])
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    opt_state = bundle.init_opt(params)
+    if injected:
+        opt_state = set_injected_hyperparams(opt_state, lr, wd)
 
     # ---- restore (PBT exploit / fault retry) -------------------------------
     # Dropout PRNG implementation (ops/rng.py): defaults to the hardware
@@ -232,9 +350,33 @@ def train_regressor(
             # chain for THIS incarnation so old experiments stay
             # resumable (the next fresh trial uses injection again).
             injected = False
-            tx = _build_tx(False)
-            opt_state = tx.init(params)
-            train_epoch = _jit_train_epoch(tx)
+            # Only the optimizer chain (and the epoch program that closes
+            # over it) differ from the cached bundle — reuse its staged
+            # data, forward, init, and eval programs instead of paying a
+            # second stage + compile set (review r5).
+            tx = make_optimizer(
+                opt_name,
+                learning_rate=get_schedule(
+                    str(config.get("lr_schedule", "warmup_linear_decay")),
+                    learning_rate=lr,
+                    warmup_steps=int(config.get("warmup_steps", 0)),
+                    total_steps=total_steps,
+                ),
+                weight_decay=wd,
+                momentum=float(config.get("momentum", 0.0)),
+                gradient_clipping=float(
+                    config.get("gradient_clipping", 0.0)
+                ),
+                accumulate_grad_batches=accum,
+            )
+            train_epoch = jax.jit(
+                make_epoch_fn(
+                    bundle.forward, tx, get_loss(loss_name),
+                    data.n_train, data.num_batches, data.batch_size,
+                ),
+                donate_argnums=(0, 1, 2),
+            )
+            opt_state = jax.jit(tx.init)(params)
             template["opt_state"] = opt_state
             restored = restore_into(template, ckpt)
         params = restored["params"]
@@ -262,8 +404,6 @@ def train_regressor(
         if step_flops is not None
         else None
     )
-    devices = session.get_devices()
-    device = devices[0] if devices else jax.devices()[0]
     peak = device_peak_flops(
         device, str(config.get("compute_dtype", "float32"))
     )
@@ -292,11 +432,10 @@ def train_regressor(
         record = {
             "epoch": epoch,
             "train_loss": float(train_loss),
-            # Injected path: the shape schedule peaks at 1.0 and the
-            # trial's lr scales it from the optimizer state.
-            "lr": (lr * float(shape_schedule(min(opt_steps, total_steps)))
-                   if injected
-                   else float(schedule(min(opt_steps, total_steps)))),
+            # Every registered schedule is linear in learning_rate, so
+            # lr x the peak-1.0 shape IS the effective rate on both the
+            # injected and baked paths.
+            "lr": lr * float(shape_schedule(min(opt_steps, total_steps))),
             "steps": step_count,
             **{k: float(v) for k, v in metrics.items()},
         }

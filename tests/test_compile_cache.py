@@ -31,7 +31,11 @@ def test_identical_arch_trials_hit_cache(tiny_data, tmp_path):
 
     max_concurrent=1 serializes the trials so trial 2's compile request can
     see trial 1's cache entries (concurrent compiles of the same program
-    race and both miss).
+    race and both miss).  share_programs=False pins the test to the
+    PERSISTENT-cache layer: under the default cohort cache trial 2
+    compiles (and traces) nothing at all, so there would be no cache
+    lookup to observe — that stronger behavior has its own test
+    (test_cohort_program_cache_builds_once_per_architecture).
     """
     train, val = tiny_data
     cache = str(tmp_path / "xla")
@@ -44,6 +48,7 @@ def test_identical_arch_trials_hit_cache(tiny_data, tmp_path):
             "num_epochs": 2,
             "batch_size": 32,
             "lr_schedule": "constant",
+            "share_programs": False,
         },
         metric="validation_loss",
         num_samples=2,

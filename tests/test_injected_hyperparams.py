@@ -227,3 +227,74 @@ def test_trial_seed_varies_init_weights():
     a, b, a2 = first_val_loss(1), first_val_loss(2), first_val_loss(1)
     assert a == a2  # deterministic in the seed
     assert a != b   # distinct inits across seeds
+
+
+def test_cohort_program_cache_builds_once_per_architecture():
+    """tune.run cohort sharing: trials of one architecture stage data and
+    build programs ONCE (per-trial seeds still produce distinct inits);
+    a different architecture or changed data rebuilds; clear() frees."""
+    import distributed_machine_learning_tpu.tune.trainable as tr
+    from distributed_machine_learning_tpu import tune
+    from distributed_machine_learning_tpu.tune import session as sess_mod
+
+    train, val = _tiny_data()
+    tr.clear_cohort_program_cache()
+    builds = []
+    orig = tr.build_model
+
+    def counting_build(cfg):
+        builds.append(1)
+        return orig(cfg)
+
+    tr.build_model = counting_build
+    try:
+        losses = []
+        for seed in (1, 2, 3):
+            seen = []
+            sess_mod.set_session(sess_mod.Session(
+                trial=None,
+                report_fn=lambda m, c=None: (seen.append(dict(m)),
+                                             "continue")[1],
+                checkpoint_loader=lambda: None))
+            try:
+                tune.train_regressor(
+                    {"model": "mlp", "hidden_sizes": (8,),
+                     "learning_rate": 1e-9, "num_epochs": 1,
+                     "batch_size": 16, "seed": seed,
+                     "lr_schedule": "constant"},
+                    train_data=train, val_data=val)
+            finally:
+                sess_mod.set_session(None)
+            losses.append(seen[0]["validation_loss"])
+        assert len(builds) == 1  # one build served all three trials
+        assert len(set(losses)) == 3  # ...with distinct per-seed inits
+        # A different architecture is a different cohort.
+        sess_mod.set_session(sess_mod.Session(
+            trial=None, report_fn=lambda m, c=None: "continue",
+            checkpoint_loader=lambda: None))
+        try:
+            tune.train_regressor(
+                {"model": "mlp", "hidden_sizes": (16,),
+                 "learning_rate": 1e-3, "num_epochs": 1, "batch_size": 16,
+                 "lr_schedule": "constant"},
+                train_data=train, val_data=val)
+        finally:
+            sess_mod.set_session(None)
+        assert len(builds) == 2
+        # In-place data mutation changes the key (checksums): rebuild.
+        train.y[:] = train.y + 1.0
+        sess_mod.set_session(sess_mod.Session(
+            trial=None, report_fn=lambda m, c=None: "continue",
+            checkpoint_loader=lambda: None))
+        try:
+            tune.train_regressor(
+                {"model": "mlp", "hidden_sizes": (8,),
+                 "learning_rate": 1e-3, "num_epochs": 1, "batch_size": 16,
+                 "seed": 9, "lr_schedule": "constant"},
+                train_data=train, val_data=val)
+        finally:
+            sess_mod.set_session(None)
+        assert len(builds) == 3
+    finally:
+        tr.build_model = orig
+        tr.clear_cohort_program_cache()
