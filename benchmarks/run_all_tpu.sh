@@ -52,17 +52,17 @@ gate() {
 
 # Headline bench first (the driver artifact path): probes, single-claim
 # suite (flagship MFU + both-dtype sweeps with warm repeats), torch
-# baseline. 4200 > bench.py's own worst case (~3500s: probe window +
+# baseline. 4800 > bench.py's own worst case (probe window +
 # SUITE_TIMEOUT_S + RESUME_TIMEOUT_S + torch + settle/gaps) so a slow
 # run emits its JSON instead of dying to this outer SIGTERM.
-TIMEOUT=4200 run bench python bench.py
+TIMEOUT=4800 run bench python bench.py
 
 # Same sweep with threefry dropout streams forced: measures the tax the
 # default hardware-RNG ("auto" -> rbg on TPU, ops/rng.py) avoids. Gated:
 # the comparison is only interesting on-chip, and bench.py's own probe
 # schedule would burn ~8 min against a tunnel that died during the
 # previous step.
-gate bench_threefry && TIMEOUT=4200 run bench_threefry env DML_BENCH_RNG_IMPL=threefry python bench.py
+gate bench_threefry && TIMEOUT=4800 run bench_threefry env DML_BENCH_RNG_IMPL=threefry python bench.py
 
 # GQA kv-bandwidth: native grouped kv vs repeat, fwd and fwd+bwd.
 gate gqa && TIMEOUT=1800 run gqa python benchmarks/gqa_bench.py
