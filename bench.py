@@ -334,6 +334,27 @@ def _run_child_monitored(args, env, timeout_s: float, heartbeat_path,
     # coast is clear while the grant is still held.
     if is_tunnel and result[3]:
         _stamp_tunnel_release()
+    # Forensics: the parent normally surfaces only the stderr tail, which
+    # was not enough to diagnose the 2026-08-01 bohb stall (warmup
+    # timestamps lost with the temp files). Opt-in full retention.
+    log_dir = os.environ.get("DML_BENCH_CHILD_LOG_DIR")
+    if log_dir:
+        try:
+            os.makedirs(log_dir, exist_ok=True)
+            tag = "_".join(a.lstrip("-") for a in args)[:80]
+            # pid disambiguates same-second same-args children (a fast
+            # rc=1 pair would otherwise truncate each other's evidence).
+            stamp = f"{int(time.time())}_{tag}_pid{proc.pid}_rc{result[0]}"
+            with open(os.path.join(log_dir, stamp + ".out"), "w") as f:
+                f.write(result[1])
+            with open(os.path.join(log_dir, stamp + ".err"), "w") as f:
+                f.write(result[2])
+        except OSError as exc:
+            # Best-effort, but never silently: an unwritable dir on an
+            # instrumented forensic session must not eat the evidence
+            # run without a trace.
+            print(f"[bench] child log retention failed: {exc!r}",
+                  file=sys.stderr, flush=True)
     return result
 
 

@@ -1,0 +1,35 @@
+# Shared helpers for the one-shot TPU capture scripts (run_all_tpu.sh,
+# run_round5_remainder.sh). Sourced, not executed. Requires $out to be
+# set by the caller. These encode the tunnel discipline from the
+# 2026-07-31 wedge postmortem (benchmarks/RESULTS.md): SIGTERM-only
+# (never SIGKILL a tunnel holder), 15s cool-down between claimants so a
+# claim never races a lagging far-side release, and a bounded probe gate
+# so a dead tunnel skips a step in ~3 min instead of burning its whole
+# timeout hung at backend init.
+
+run() {
+  name=$1; shift
+  echo "=== $name: $* (log: $out/$name.log)" | tee -a "$out/summary.txt"
+  timeout --signal=TERM --kill-after=0 "$TIMEOUT" "$@" \
+    > "$out/$name.log" 2>&1
+  rc=$?
+  tail -3 "$out/$name.log" | tee -a "$out/summary.txt"
+  echo "--- $name rc=$rc" | tee -a "$out/summary.txt"
+  sleep 15
+}
+
+# Probe gate for tunnel-claiming steps: rc=0 only when an accelerator
+# executed a computation (rc=1 healthy-but-CPU-only, rc=124 hung).
+gate() {
+  name=$1
+  timeout --signal=TERM 180 python -m distributed_machine_learning_tpu \
+    probe --timeout 80 >/dev/null 2>&1
+  rc=$?
+  if [ "$rc" -eq 0 ]; then
+    sleep 15  # let the probe's claim release before the step claims
+    return 0
+  fi
+  echo "--- $name SKIPPED: probe rc=$rc (0=chip, 1=cpu-only, 124=hung)" \
+    | tee -a "$out/summary.txt"
+  return 1
+}

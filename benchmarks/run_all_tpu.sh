@@ -15,40 +15,10 @@ ts=$(date +%H%M%S)
 out="/tmp/tpu_capture_${ts}"
 mkdir -p "$out"
 cd "$(dirname "$0")/.."
-
-run() {
-  name=$1; shift
-  echo "=== $name: $* (log: $out/$name.log)" | tee -a "$out/summary.txt"
-  timeout --signal=TERM --kill-after=0 "$TIMEOUT" "$@" \
-    > "$out/$name.log" 2>&1
-  rc=$?
-  tail -3 "$out/$name.log" | tee -a "$out/summary.txt"
-  echo "--- $name rc=$rc" | tee -a "$out/summary.txt"
-  # Give the far side time to release the previous claimant's grant
-  # before the next step claims (claims raced against a lagging release
-  # can wedge — 2026-07-31 postmortem in ../benchmarks/RESULTS.md).
-  sleep 15
-}
-
-# Probe gate for tunnel-claiming steps: this is an ON-CHIP capture
-# session, so any probe outcome except "accelerator executed" (rc=0 —
-# rc=1 is healthy-but-CPU-only, rc=124 hung) skips the step in ~3 min
-# instead of burning its whole timeout hung at backend init. (The
-# variant steps' own CPU fallbacks are not worth capturing here — the
-# CPU shakedown numbers are already in RESULTS.md.)
-gate() {
-  name=$1
-  timeout --signal=TERM 180 python -m distributed_machine_learning_tpu \
-    probe --timeout 80 >/dev/null 2>&1
-  rc=$?
-  if [ "$rc" -eq 0 ]; then
-    sleep 15  # let the probe's claim release before the step claims
-    return 0
-  fi
-  echo "--- $name SKIPPED: probe rc=$rc (0=chip, 1=cpu-only, 124=hung)" \
-    | tee -a "$out/summary.txt"
-  return 1
-}
+# run()/gate() + the wedge-postmortem tunnel discipline live in the
+# shared lib (one place to adjust cool-downs/probe bounds for every
+# capture script).
+. benchmarks/_capture_lib.sh
 
 # Headline bench first (the driver artifact path): probes, single-claim
 # suite (flagship MFU + both-dtype sweeps with warm repeats), torch

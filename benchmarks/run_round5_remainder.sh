@@ -1,0 +1,22 @@
+#!/bin/bash
+# Remainder of the 2026-08-01 capture session: the two steps the mid-run
+# tunnel wedge ate (sharded_resnet, refdata) plus an instrumented re-run
+# of the bohb variant with FULL child logs kept
+# (DML_BENCH_CHILD_LOG_DIR) so a repeat of the 09:10 UTC stall is
+# diagnosable: the kept stderr shows the warmup timestamps and the
+# per-30s trial table right up to the wedge. Same discipline as
+# run_all_tpu.sh (shared helpers: sequential, SIGTERM-only, cool-down
+# between claimants).
+set -u
+ts=$(date +%H%M%S)
+out="/tmp/tpu_r5rem_${ts}"
+mkdir -p "$out"
+cd "$(dirname "$0")/.."
+. benchmarks/_capture_lib.sh
+export DML_BENCH_CHILD_LOG_DIR="$out/children"
+
+gate bohb && TIMEOUT=2400 run bohb python bench.py --variant bohb_transformer
+gate resnet && TIMEOUT=2400 run resnet python bench.py --variant sharded_resnet
+gate refdata && TIMEOUT=1800 run refdata python examples/hpo_reference_data.py
+
+echo "remainder complete: $out" | tee -a "$out/summary.txt"

@@ -27,7 +27,7 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     # first child claims (a claim raced against a lagging release can
     # wedge — the very failure this script exists to recover from).
     sleep 15
-    exec bash benchmarks/run_all_tpu.sh
+    exec bash "${CAPTURE_SCRIPT:-benchmarks/run_all_tpu.sh}"
   fi
   sleep 150
 done
