@@ -485,9 +485,10 @@ def test_child_flagship_tiny_shapes(monkeypatch, capsys):
     ))
     bench.child_flagship()
     lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
-    # MHA, +gqa, +seq_x2, +tile_256, final(complete) — crash-safe
-    # increments.
-    assert len(lines) == 5
+    # MHA, +gqa, +seq_x2, +tile_256, +pre-XL checkpoint, final(complete)
+    # — crash-safe increments.
+    assert len(lines) == 6
+    assert json.loads(lines[-1])["xl_d1024"] == {"skipped": "cpu"}
     final = json.loads(lines[-1])
     assert final["config"]["batch"] == 2  # no promotion without peak flops
     assert final["gqa_kv2"].get("step_s") or final["gqa_kv2"].get("error")
@@ -901,3 +902,26 @@ def test_main_quality_from_tpu_suite(monkeypatch, capsys):
     assert q["ours_best_mape"] == 79.9
     assert q["torch_best_mape"] == 92.0
     assert ["--child", "quality"] not in children  # suite already ran ours
+
+
+def test_monitored_runner_retains_full_child_logs(tmp_path, monkeypatch):
+    """DML_BENCH_CHILD_LOG_DIR keeps the child's FULL stdout/stderr
+    (pid-stamped): the 2026-08-01 bohb stall was undiagnosable because
+    only the stderr tail survived the run."""
+    hb = str(tmp_path / "hb")
+    env = dict(os.environ, DML_BENCH_HEARTBEAT_PATH=hb)
+    env.pop("PYTHONPATH", None)  # never a tunnel env in tests
+    log_dir = tmp_path / "children"
+    monkeypatch.setenv("DML_BENCH_CHILD_LOG_DIR", str(log_dir))
+    rc, out, err, exited = bench._run_child_monitored(
+        ["--child", "_test_stall"], env, 120, hb, 3.0
+    )
+    assert rc == 124 and exited
+    outs = sorted(log_dir.glob("*.out"))
+    errs = sorted(log_dir.glob("*.err"))
+    assert len(outs) == 1 and len(errs) == 1, list(log_dir.iterdir())
+    # pid-stamped (same-second same-args children must not clobber) and
+    # rc recorded in the name; contents are the child's full streams.
+    assert "_pid" in outs[0].name and outs[0].name.endswith("_rc124.out")
+    assert outs[0].read_text() == out
+    assert errs[0].read_text() == err
