@@ -1532,19 +1532,19 @@ def _flagship_result(progress_cb) -> dict:
     # feeds the systolic array properly.  Kept out of the headline —
     # it is a different model than the flagship — but carried in the
     # artifact as the framework's measured ceiling.
-    if jax.devices()[0].platform == "tpu":
+    if out["platform"] == "tpu":
         try:
             xl_cfg = dict(base_cfg, d_model=1024, num_heads=16,
                           num_layers=8, dim_feedforward=4096)
             xl = measure(xl_cfg, batch=B, seq_len=S)
-            xl["config"] = dict(xl_cfg, batch=B, seq=S)
+            xl["config"] = dict(xl_cfg, batch=B, seq=S, features=F)
             out["xl_d1024"] = xl
         except Exception as exc:  # noqa: BLE001 - flagship result stands
             out["xl_d1024"] = {"error": repr(exc)[-300:]}
     else:
-        # A d1024/8-layer compile is minutes on the CPU fallback host for
-        # a number that only means something on the MXU.
-        out["xl_d1024"] = {"skipped": "cpu"}
+        # A d1024/8-layer compile is minutes on the fallback host for a
+        # number that only means something on the MXU.
+        out["xl_d1024"] = {"skipped": out["platform"]}
     # Every sub-phase ran (possibly recording its error): intermediate
     # snapshots recovered from a killed child lack this marker, and the
     # parent turns its absence into the `partial` honesty flag.

@@ -18,5 +18,8 @@ export DML_BENCH_CHILD_LOG_DIR="$out/children"
 gate bohb && TIMEOUT=2400 run bohb python bench.py --variant bohb_transformer
 gate resnet && TIMEOUT=2400 run resnet python bench.py --variant sharded_resnet
 gate refdata && TIMEOUT=1800 run refdata python examples/hpo_reference_data.py
+# Fresh full bench last: banks a capture that includes the XL ceiling
+# probe (mfu_xl), added after the 08:30 session's suite ran.
+gate bench && TIMEOUT=4800 run bench python bench.py
 
 echo "remainder complete: $out" | tee -a "$out/summary.txt"

@@ -488,8 +488,8 @@ def test_child_flagship_tiny_shapes(monkeypatch, capsys):
     # MHA, +gqa, +seq_x2, +tile_256, +pre-XL checkpoint, final(complete)
     # — crash-safe increments.
     assert len(lines) == 6
-    assert json.loads(lines[-1])["xl_d1024"] == {"skipped": "cpu"}
     final = json.loads(lines[-1])
+    assert final["xl_d1024"] == {"skipped": "cpu"}
     assert final["config"]["batch"] == 2  # no promotion without peak flops
     assert final["gqa_kv2"].get("step_s") or final["gqa_kv2"].get("error")
     bx2 = final["batch_x2"]
