@@ -71,6 +71,10 @@ from distributed_machine_learning_tpu.ops.flops import (
 )
 from distributed_machine_learning_tpu.tune.checkpoint import restore_into
 from distributed_machine_learning_tpu.utils.compile_cache import get_tracker
+from distributed_machine_learning_tpu.utils.dispatch import (
+    dispatch_lock,
+    serialization_on,
+)
 from distributed_machine_learning_tpu.utils.seeding import (
     fold_seed,
     init_rngs_for,
@@ -159,7 +163,13 @@ def _cohort_bundle_for(config, train_data, val_data, device, build):
             bundle = _COHORT_CACHE.get(key)
             if bundle is not None:
                 return bundle
-        bundle = build()
+        # The build stages data and compiles through the backend; in a
+        # MIXED-architecture cohort it can otherwise overlap another
+        # architecture's epoch dispatches (utils/dispatch.py; ordering
+        # is always cohort lock -> dispatch lock, never the reverse, so
+        # no cycle with the epoch path which takes only dispatch_lock).
+        with dispatch_lock():
+            bundle = build()
         with _COHORT_GUARD:
             _COHORT_CACHE[key] = bundle
             while len(_COHORT_CACHE) > 1 and (
@@ -296,7 +306,8 @@ def train_regressor(
             lambda: _build_bundle(True),
         )
     else:
-        bundle = _build_bundle(injected)
+        with dispatch_lock():
+            bundle = _build_bundle(injected)
     data = bundle.data
     steps_per_epoch = bundle.steps_per_epoch
     total_steps = bundle.total_steps
@@ -305,12 +316,15 @@ def train_regressor(
     train_epoch = bundle.train_epoch
     evaluate = bundle.evaluate
 
-    variables = bundle.init_model(init_rngs_for(seed), data.x_train[:1])
-    params = variables["params"]
-    batch_stats = variables.get("batch_stats", {})
-    opt_state = bundle.init_opt(params)
-    if injected:
-        opt_state = set_injected_hyperparams(opt_state, lr, wd)
+    # Device-call section: serialized across concurrent trial threads on
+    # fragile backends (utils/dispatch.py — the tunnel-wedge mitigation).
+    with dispatch_lock():
+        variables = bundle.init_model(init_rngs_for(seed), data.x_train[:1])
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        opt_state = bundle.init_opt(params)
+        if injected:
+            opt_state = set_injected_hyperparams(opt_state, lr, wd)
 
     # ---- restore (PBT exploit / fault retry) -------------------------------
     # Dropout PRNG implementation (ops/rng.py): defaults to the hardware
@@ -339,9 +353,13 @@ def train_regressor(
             "batch_stats": batch_stats,
             "epoch": 0,
         }
-        try:
+        # One hold for the whole restore (including the legacy-layout
+        # fallback's jit(tx.init) dispatch and retry): same coverage as
+        # the sharded twin.
+        with dispatch_lock():
+          try:
             restored = restore_into(template, ckpt)
-        except (ValueError, KeyError, TypeError, AttributeError):
+          except (ValueError, KeyError, TypeError, AttributeError):
             if not injected:
                 raise
             # Legacy checkpoint: written by the pre-injection (baked)
@@ -389,7 +407,8 @@ def train_regressor(
             # over whatever rode in the restored hyperparam slots (the
             # baked path achieved the same by rebuilding the schedule
             # from config).
-            opt_state = set_injected_hyperparams(opt_state, lr, wd)
+            with dispatch_lock():
+                opt_state = set_injected_hyperparams(opt_state, lr, wd)
 
     checkpoint_freq = int(config.get("checkpoint_freq", 1))
 
@@ -413,17 +432,33 @@ def train_regressor(
 
     # ---- epoch loop: host-driven so the scheduler can interrupt ------------
     for epoch in range(start_epoch, num_epochs):
-        epoch_key = jax.random.key(
-            fold_seed(seed, "epoch", epoch), impl=rng_impl
-        )
-        c0 = tracker.thread_seconds()
-        t0 = _time.time()
-        params, opt_state, batch_stats, train_loss = train_epoch(
-            params, opt_state, batch_stats, data.x_train, data.y_train, epoch_key
-        )
-        metrics = evaluate(
-            params, batch_stats, data.x_val, data.y_val, data.val_mask
-        )
+        # One lock hold per epoch (train + eval): the chip runs one
+        # program at a time regardless; on the tunnel this keeps the
+        # relay single-streamed (utils/dispatch.py).  The key creation
+        # (a small device dispatch) and the t0/c0 stamps live INSIDE
+        # the hold: stamping outside would count lock-wait — other
+        # trials' whole epochs — as this trial's execute time and
+        # deflate mfu by ~Nx under serialization.
+        with dispatch_lock():
+            epoch_key = jax.random.key(
+                fold_seed(seed, "epoch", epoch), impl=rng_impl
+            )
+            c0 = tracker.thread_seconds()
+            t0 = _time.time()
+            params, opt_state, batch_stats, train_loss = train_epoch(
+                params, opt_state, batch_stats, data.x_train, data.y_train,
+                epoch_key
+            )
+            metrics = evaluate(
+                params, batch_stats, data.x_val, data.y_val, data.val_mask
+            )
+            # Sync INSIDE the locked section via scalar readbacks
+            # (block_until_ready is a no-op through the tunnel): jit
+            # returns futures, so without this the lock would release
+            # while the epoch still streams through the relay — the
+            # overlap the lock exists to prevent.
+            train_loss = float(train_loss)
+            metrics = {k: float(v) for k, v in metrics.items()}
         step_count = (epoch + 1) * steps_per_epoch
         # The schedule is indexed by OPTIMIZER steps; with accumulation
         # that is micro-steps // accum, or the logged lr would decay
@@ -431,15 +466,15 @@ def train_regressor(
         opt_steps = (epoch + 1) * max(steps_per_epoch // accum, 1)
         record = {
             "epoch": epoch,
-            "train_loss": float(train_loss),
+            "train_loss": train_loss,
             # Every registered schedule is linear in learning_rate, so
             # lr x the peak-1.0 shape IS the effective rate on both the
             # injected and baked paths.
             "lr": lr * float(shape_schedule(min(opt_steps, total_steps))),
             "steps": step_count,
-            **{k: float(v) for k, v in metrics.items()},
+            **metrics,
         }
-        # The float() conversions above synced both programs; wall minus
+        # The in-lock readbacks above synced both programs; wall minus
         # this thread's compile seconds is device-execute time.
         exec_s = max(
             _time.time() - t0 - (tracker.thread_seconds() - c0), 1e-9
@@ -470,6 +505,16 @@ def train_regressor(
                 # above).  Extra key: older restore templates ignore it.
                 "rng_impl": rng_impl or "",
             }
+            if serialization_on():
+                # The async writer would otherwise read these device
+                # buffers back OUTSIDE any lock, concurrent with other
+                # threads' dispatches — the exact traffic pattern the
+                # serialization exists to prevent.  Off the fragile
+                # backend, the device-held pytree keeps the writer's
+                # readback overlapped with training (the designed
+                # async-checkpoint behavior).
+                with dispatch_lock():
+                    checkpoint = jax.device_get(checkpoint)
         session.report(record, checkpoint=checkpoint)
 
     return None

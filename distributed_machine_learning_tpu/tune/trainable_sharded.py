@@ -56,6 +56,7 @@ from distributed_machine_learning_tpu.tune._regression_program import (
     per_example_losses,
 )
 from distributed_machine_learning_tpu.tune.checkpoint import restore_into
+from distributed_machine_learning_tpu.utils.dispatch import dispatch_lock
 from distributed_machine_learning_tpu.utils.seeding import (
     fold_seed,
     init_rngs_for,
@@ -150,32 +151,37 @@ def train_sharded_regressor(
 
     model = build_model(config)
     sample_x = x_np[:1]
-    # Per-trial init diversity, same as train_regressor (the rng is a
-    # traced argument — one compiled init program per architecture).
-    variables, flag_name = detect_call_convention(
-        model, sample_x,
-        init_rngs=init_rngs_for(seed),
-    )
-    has_bn = "batch_stats" in variables
-    forward = make_forward(model, flag_name, has_bn)
+    # Device-call section (init dispatch, shard placement, jit init):
+    # serialized across concurrent trial threads on fragile backends
+    # (utils/dispatch.py — the tunnel-wedge mitigation, same coverage
+    # as tune/trainable.py's init block).
+    with dispatch_lock():
+        # Per-trial init diversity, same as train_regressor (the rng is a
+        # traced argument — one compiled init program per architecture).
+        variables, flag_name = detect_call_convention(
+            model, sample_x,
+            init_rngs=init_rngs_for(seed),
+        )
+        has_bn = "batch_stats" in variables
+        forward = make_forward(model, flag_name, has_bn)
 
-    # Shard params per the TP rules (pure-dp meshes leave everything
-    # replicated); optimizer state inherits the layout via jit init.
-    params = shard_params(variables["params"], mesh, TRANSFORMER_TP_RULES)
-    p_shardings = param_shardings(params, mesh, TRANSFORMER_TP_RULES)
-    o_shardings = opt_state_shardings(
-        jax.eval_shape(tx.init, params), p_shardings, mesh
-    )
-    opt_state = jax.jit(
-        tx.init, in_shardings=(p_shardings,), out_shardings=o_shardings
-    )(params)
-    if injected:
-        opt_state = set_injected_hyperparams(opt_state, lr, wd)
-    batch_stats = jax.device_put(
-        variables.get("batch_stats", {}),
-        jax.tree.map(lambda _: NamedSharding(mesh, P()),
-                     variables.get("batch_stats", {})),
-    )
+        # Shard params per the TP rules (pure-dp meshes leave everything
+        # replicated); optimizer state inherits the layout via jit init.
+        params = shard_params(variables["params"], mesh, TRANSFORMER_TP_RULES)
+        p_shardings = param_shardings(params, mesh, TRANSFORMER_TP_RULES)
+        o_shardings = opt_state_shardings(
+            jax.eval_shape(tx.init, params), p_shardings, mesh
+        )
+        opt_state = jax.jit(
+            tx.init, in_shardings=(p_shardings,), out_shardings=o_shardings
+        )(params)
+        if injected:
+            opt_state = set_injected_hyperparams(opt_state, lr, wd)
+        batch_stats = jax.device_put(
+            variables.get("batch_stats", {}),
+            jax.tree.map(lambda _: NamedSharding(mesh, P()),
+                         variables.get("batch_stats", {})),
+        )
 
     # Batched-epoch shardings: [num_batches, global_batch, ...] with the
     # in-batch dim over dp.
@@ -237,14 +243,20 @@ def train_sharded_regressor(
     evaluate = jax.jit(
         eval_fn, in_shardings=(None, None, xv_sharding, xv_sharding, xv_sharding)
     )
-    xv = jax.device_put(xv_np, xv_sharding)
-    yv = jax.device_put(yv_np, xv_sharding)
-    mask = jax.device_put(mask_np, xv_sharding)
+    # Validation staging is device traffic too — same hold discipline
+    # (utils/dispatch.py).
+    with dispatch_lock():
+        xv = jax.device_put(xv_np, xv_sharding)
+        yv = jax.device_put(yv_np, xv_sharding)
+        mask = jax.device_put(mask_np, xv_sharding)
 
     # ---- restore (PBT exploit / fault retry) -------------------------------
     start_epoch = 0
     ckpt = session.get_checkpoint()
     if ckpt is not None:
+      # Restore readbacks (_host) + re-sharding device_puts serialized
+      # like every other device-call section (utils/dispatch.py).
+      with dispatch_lock():
         template = {
             "params": _host(params),
             "opt_state": _host(opt_state),
@@ -313,40 +325,57 @@ def train_sharded_regressor(
     # ---- epoch loop: host-driven so the scheduler can interrupt ------------
     for epoch in range(start_epoch, num_epochs):
         perm = rng.permutation(n_train)[: num_batches * global_batch]
-        xb = jax.device_put(
-            x_np[perm].reshape(num_batches, global_batch, *x_np.shape[1:]),
-            xb_sharding,
-        )
-        yb = jax.device_put(
-            y_np[perm].reshape(num_batches, global_batch, *y_np.shape[1:]),
-            yb_sharding,
-        )
-        epoch_key = jax.random.key(fold_seed(seed, "epoch", epoch))
-        params, opt_state, batch_stats, train_loss = train_epoch(
-            params, opt_state, batch_stats, xb, yb, epoch_key
-        )
-        metrics = evaluate(params, batch_stats, xv, yv, mask)
+        # Serialized across concurrent trial threads on fragile backends
+        # (utils/dispatch.py — the tunnel-wedge mitigation). The epoch
+        # batches' host->device transfer — the loop's largest single
+        # transfer — rides inside the same hold, and the scalar
+        # readbacks sync BEFORE release (jit returns futures; an
+        # unsynced exit would let the next thread's traffic overlap
+        # this epoch still streaming through the relay).
+        with dispatch_lock():
+            epoch_key = jax.random.key(fold_seed(seed, "epoch", epoch))
+            xb = jax.device_put(
+                x_np[perm].reshape(
+                    num_batches, global_batch, *x_np.shape[1:]
+                ),
+                xb_sharding,
+            )
+            yb = jax.device_put(
+                y_np[perm].reshape(
+                    num_batches, global_batch, *y_np.shape[1:]
+                ),
+                yb_sharding,
+            )
+            params, opt_state, batch_stats, train_loss = train_epoch(
+                params, opt_state, batch_stats, xb, yb, epoch_key
+            )
+            metrics = evaluate(params, batch_stats, xv, yv, mask)
+            train_loss = float(train_loss)
+            metrics = {k: float(v) for k, v in metrics.items()}
         step_count = (epoch + 1) * steps_per_epoch
         # Schedule is indexed by optimizer steps (micro-steps // accum).
         opt_steps = (epoch + 1) * max(steps_per_epoch // accum, 1)
         record = {
             "epoch": epoch,
-            "train_loss": float(train_loss),
+            "train_loss": train_loss,
             "lr": (lr * float(shape_schedule(min(opt_steps, total_steps)))
                    if injected
                    else float(schedule(min(opt_steps, total_steps)))),
             "steps": step_count,
             "num_devices": len(devices),
-            **{k: float(v) for k, v in metrics.items()},
+            **metrics,
         }
         checkpoint = None
         if checkpoint_freq and (epoch + 1) % checkpoint_freq == 0:
-            checkpoint = {
-                "params": _host(params),
-                "opt_state": _host(opt_state),
-                "batch_stats": _host(batch_stats),
-                "epoch": epoch,
-            }
+            # Checkpoint readback is device traffic too — same hold
+            # discipline as the epoch dispatch (utils/dispatch.py).
+            with dispatch_lock():
+                checkpoint = {
+                    "params": _host(params),
+                    "opt_state": _host(opt_state),
+                    "batch_stats": _host(batch_stats),
+                    "epoch": epoch,
+                }
         session.report(record, checkpoint=checkpoint)
 
     return None

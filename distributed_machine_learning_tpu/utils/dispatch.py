@@ -1,0 +1,81 @@
+"""Cross-thread device-dispatch serialization for fragile backends.
+
+Concurrent-trial executors (``ThreadTrialExecutor``) run many trials as
+Python threads inside one process; each trial fires its own device
+calls (init, per-epoch train program, eval, checkpoint readback).  On a
+normal local backend that is fine — XLA serializes execution on the
+device and the runtime is thread-safe.  A *remote* single-chip tunnel
+(the axon relay this project benches through) is not: both recorded
+tunnel wedges (2026-07-31 session 6, 2026-08-01 09:10 UTC — see
+benchmarks/RESULTS.md) happened at the one workload whose dispatches
+come from multiple threads at once (the bohb thread-executor cohort),
+while single-threaded dispatchers (vectorized sweeps, pbt, the suite)
+ran clean in the same sessions.
+
+``dispatch_lock()`` returns a context manager that serializes the
+device-call sections of concurrent trials when serialization is on, and
+is a no-op otherwise:
+
+- ``DML_SERIALIZE_DISPATCH=1`` forces it on, ``=0`` forces it off;
+- unset, it defaults to ON exactly when the axon tunnel sitecustomize
+  is on ``PYTHONPATH`` (the one backend with the observed failure mode).
+
+Serialization costs thread-level device overlap — which a one-chip
+tunnel cannot deliver anyway (the chip runs one program at a time;
+interleaved host->tunnel traffic buys nothing but relay pressure) — and
+keeps host-side work (scheduler bookkeeping, checkpoint serialization,
+data prep) fully concurrent.
+
+The reference stack has no analogue: Ray actors are processes, so its
+trials never share a CUDA context from threads
+(ray-tune-hpo-regression.py:469-480 relies on actor isolation).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+_LOCK = threading.RLock()
+_resolved: bool | None = None
+
+
+def _serialize_on() -> bool:
+    global _resolved
+    if _resolved is None:
+        flag = os.environ.get("DML_SERIALIZE_DISPATCH", "").strip()
+        if flag in ("1", "true", "on"):
+            _resolved = True
+        elif flag in ("0", "false", "off"):
+            _resolved = False
+        else:
+            _resolved = ".axon_site" in os.environ.get("PYTHONPATH", "")
+    return _resolved
+
+
+def _reset_for_tests() -> None:
+    global _resolved
+    _resolved = None
+
+
+def serialization_on() -> bool:
+    """Whether dispatch serialization is active for this process.
+
+    The resolution is captured at FIRST use (then cached for the process
+    lifetime): set ``DML_SERIALIZE_DISPATCH`` before the first trial
+    runs, not mid-run.
+    """
+    return _serialize_on()
+
+
+def dispatch_lock():
+    """Context manager guarding a device-call section of a trial.
+
+    Reentrant (RLock): a guarded section may call helpers that guard
+    themselves. No-op unless serialization resolved on (see module doc;
+    resolution is captured at first use — ``serialization_on``).
+    """
+    if _serialize_on():
+        return _LOCK
+    return contextlib.nullcontext()
