@@ -143,6 +143,12 @@ class _GroupProgram:
             train_data, val_data, int(cfg.get("batch_size", 32)), compute_dtype
         )
         self._data_sums = _data_checksums(train_data, val_data)
+        # Measured dispatch history for epochs_per_dispatch="auto": dicts of
+        # {chunk, rows, exec_s, compile_s} appended per dispatch.  Rides the
+        # cross-call program cache, so a later sweep on this program (e.g.
+        # an ASHA pass after a FIFO pass) decides from the earlier sweep's
+        # measurements.
+        self.dispatch_obs: list = []
         self.steps_per_epoch = data.num_batches
         total_steps = int(
             cfg.get("total_steps", self.num_epochs * data.num_batches)
@@ -355,6 +361,122 @@ def _group_program_for(sig: Tuple, static_cfg: Dict[str, Any],
     return prog
 
 
+def _stopper_epoch_fraction(sched, num_epochs: int) -> float:
+    """Idealized fraction of trial-epochs a rung-based stopper computes.
+
+    Successive-halving geometry from the scheduler's own knobs (ASHA /
+    HyperBand expose ``grace_period`` and ``eta``): survivors thin by
+    1/eta at each rung, so expected epochs per trial are
+    sum_i survivors_i * rung_increment_i.  Schedulers without those
+    knobs (median etc.) get a 0.5 prior.
+    """
+    g = getattr(sched, "grace_period", None)
+    rf = getattr(sched, "eta", None) or getattr(sched, "reduction_factor", None)
+    if not g or not rf or rf <= 1 or num_epochs <= 0:
+        return 0.5
+    frac_num, prev, surv, e = 0.0, 0, 1.0, float(g)
+    while prev < num_epochs:
+        nxt = min(e, float(num_epochs))
+        frac_num += surv * (nxt - prev)
+        prev, e, surv = nxt, e * rf, surv / rf
+    return min(max(frac_num / num_epochs, g / num_epochs), 1.0)
+
+
+def _fit_dispatch_model(obs):
+    """Least-squares (latency, per-row-epoch exec) from dispatch history.
+
+    Model: exec_s = latency + chunk * rows * ppe.  Needs two observations
+    with distinct chunk*rows; returns None otherwise (or on a degenerate
+    fit with negative components)."""
+    if len(obs) < 2:
+        return None
+    x = np.array([o["chunk"] * o["rows"] for o in obs], dtype=float)
+    y = np.array([o["exec_s"] for o in obs], dtype=float)
+    if len(set(x.tolist())) < 2:
+        return None
+    a = np.stack([np.ones_like(x), x], axis=1)
+    (lat, ppe), *_ = np.linalg.lstsq(a, y, rcond=None)
+    if lat < 0 or ppe <= 0:
+        return None
+    return float(lat), float(ppe)
+
+
+def _resolve_auto_dispatch(program, sched, pbt, rows_now: int, log) -> int:
+    """Pick epochs_per_dispatch for this sweep from measured history.
+
+    The trade (RESULTS.md round-5 session 2): rung-sized chunks let a
+    stopper SAVE the pruned trials' compute, but pay per-dispatch latency
+    and per-new-size compiles — at latency-bound shapes a warm
+    whole-budget program beats pruning (measured exec_speedup_vs_fifo
+    0.88 when chunked).  Whole-budget "speculative" dispatch runs every
+    trial to max_t in the one cached program and applies rung stops
+    post-hoc to the per-epoch record stream — identical reported
+    results (stops land at the same rungs), more row-epochs, less wall
+    when dispatch latency dominates.  PBT can never speculate (exploit
+    mutates mid-flight state); FIFO always runs whole-budget.
+    """
+    from distributed_machine_learning_tpu.tune.schedulers.base import (
+        FIFOScheduler,
+    )
+
+    if pbt is not None:
+        return max(int(pbt.interval), 1)
+    if isinstance(sched, FIFOScheduler):
+        return program.num_epochs
+    # Speculation horizon: the stopper ends every trial at max_t, and the
+    # chunked loop early-exits once all rows are inactive — so both arms
+    # of the comparison (and the speculative pick itself) are bounded by
+    # max_t, not the config's num_epochs.
+    e_total = min(
+        program.num_epochs,
+        int(getattr(sched, "max_t", program.num_epochs)
+            or program.num_epochs),
+    )
+    cadence = max(int(getattr(sched, "grace_period", 1) or 1), 1)
+    cadence = min(cadence, e_total)
+    frac = _stopper_epoch_fraction(sched, e_total)
+    obs = program.dispatch_obs
+    fit = _fit_dispatch_model(obs)
+    if fit is not None:
+        lat, ppe = fit
+        seen_sizes = {o["chunk"] for o in obs}
+        worst_compile = max((o["compile_s"] for o in obs), default=0.0)
+        # A new scan trip count is a new XLA program: charge whichever
+        # arm would compile a size this program has not yet dispatched.
+        spec = (lat + e_total * rows_now * ppe
+                + (0.0 if e_total in seen_sizes else worst_compile))
+        n_disp = -(-e_total // cadence)
+        chunked = (n_disp * lat + frac * e_total * rows_now * ppe
+                   + (0.0 if cadence in seen_sizes else worst_compile))
+        pick = e_total if spec <= chunked else cadence
+        log(
+            f"epochs_per_dispatch auto: fit latency={lat:.2f}s "
+            f"per-row-epoch={ppe * rows_now:.4f}s(x{rows_now}) -> "
+            f"speculative {spec:.1f}s vs chunked {chunked:.1f}s "
+            f"(frac {frac:.2f}) -> {pick}"
+        )
+        return pick
+    whole = [o for o in obs if o["chunk"] >= e_total and o["rows"]]
+    if whole:
+        # Cold-chunk history: only whole-budget runs observed (e.g. the
+        # FIFO pass that populated the program cache).  Known: a warm
+        # whole-budget pass costs ~w.  Chunking would save at most
+        # (1-frac)*w but pays >=1 fresh-size compile; decide on that
+        # bound.
+        w = min(o["exec_s"] * rows_now / o["rows"] * e_total / o["chunk"]
+                for o in whole)
+        est_compile = max((o["compile_s"] for o in obs), default=0.0)
+        savings = (1.0 - frac) * w
+        pick = e_total if savings <= est_compile else cadence
+        log(
+            f"epochs_per_dispatch auto: whole-budget history only "
+            f"(~{w:.1f}s exec, best-case chunk savings {savings:.1f}s vs "
+            f"compile ~{est_compile:.1f}s) -> {pick}"
+        )
+        return pick
+    return cadence
+
+
 def run_vectorized(
     param_space: Union[Dict[str, Any], SearchSpace],
     *,
@@ -374,7 +496,7 @@ def run_vectorized(
     verbose: int = 1,
     compile_cache_dir: Optional[str] = "auto",
     compaction: str = "auto",
-    epochs_per_dispatch: int = 1,
+    epochs_per_dispatch="auto",
     checkpoint_every_epochs: int = 0,
     resume: bool = False,
     callbacks: Optional[List] = None,
@@ -408,7 +530,17 @@ def run_vectorized(
     losses/metrics stacked), but scheduler stops, PBT perturbations, and
     compaction act at dispatch boundaries, so mid-chunk stops save
     reporting, not FLOPs — pick E to match the scheduler's cadence (e.g.
-    ASHA's grace_period, PBT's perturbation_interval).
+    ASHA's grace_period, PBT's perturbation_interval).  The default
+    ``"auto"`` picks from measured dispatch history riding the cross-call
+    program cache (``_resolve_auto_dispatch``): whole-budget for FIFO,
+    the perturbation interval for PBT, and for rung stoppers either
+    rung-sized chunks (pruning saves compute) or ONE speculative
+    whole-budget dispatch reusing the cached program (stops land
+    post-hoc at the same rungs; identical reported results) — whichever
+    the latency/per-epoch-cost fit predicts is faster.  A user ``stop``
+    rule or ``checkpoint_every_epochs`` caps the auto pick so those
+    keep their dispatch-boundary semantics; pass an int to force a
+    chunk size.
 
     ``checkpoint_every_epochs``: preemption tolerance for long sweeps — at
     matching dispatch boundaries the WHOLE in-flight population (params,
@@ -1155,7 +1287,25 @@ def _run_population(
     exec_total_s = 0.0  # device-execute seconds (utilization numerator)
     exec_ema = None  # measured per-epoch execute seconds at the current size
     compile_cost_s = None  # most recent substantial compile observed
-    dispatch = max(int(epochs_per_dispatch), 1)
+    if epochs_per_dispatch == "auto":
+        dispatch = _resolve_auto_dispatch(program, sched, pbt, len(rows), log)
+        if stop_rules is not None:
+            # User stop rules act at dispatch boundaries; a whole-budget
+            # dispatch would turn a mid-sweep stop (plateau, timeout)
+            # into a no-op.  Fall back to the stopper cadence.
+            dispatch = min(
+                dispatch,
+                max(int(getattr(sched, "grace_period", 1) or 1), 1),
+            )
+        if ckpt_every and ckpt_path:
+            # Population checkpoints land at dispatch boundaries; keep
+            # the requested preemption granularity (ckpt_path None means
+            # checkpointing is disabled for this chunk — no granularity
+            # to preserve).
+            dispatch = min(dispatch, max(int(ckpt_every), 1))
+        dispatch = max(int(dispatch), 1)
+    else:
+        dispatch = max(int(epochs_per_dispatch), 1)
     if pbt is not None and dispatch > pbt.interval:
         # One state gather can happen per dispatch boundary, so a chunk
         # larger than the perturbation interval would silently DROP
@@ -1226,6 +1376,11 @@ def _run_population(
         )
         if compile_delta > 0.05:
             compile_cost_s = compile_delta
+        program.dispatch_obs.append({
+            "chunk": chunk, "rows": len(rows),
+            "exec_s": exec_s, "compile_s": compile_delta,
+        })
+        del program.dispatch_obs[:-32]  # bounded history
         per_epoch_exec = exec_s / chunk
         exec_ema = (
             per_epoch_exec if exec_ema is None

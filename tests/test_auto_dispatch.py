@@ -1,0 +1,153 @@
+"""epochs_per_dispatch="auto" (vectorized.py): the measured cost model
+that picks rung-sized chunked pruning vs one speculative whole-budget
+dispatch. Motivated by the 2026-08-01 on-chip capture: chunked ASHA
+measured 0.88x FIFO exec at latency-bound bench shapes — pruning saved
+46% of the epochs but paid per-dispatch latency + per-size compiles
+that cost more than the epochs were worth."""
+
+import numpy as np
+
+from distributed_machine_learning_tpu import tune
+from distributed_machine_learning_tpu.data import dummy_regression_data
+from distributed_machine_learning_tpu.tune import vectorized as vz
+from distributed_machine_learning_tpu.tune.schedulers.base import (
+    FIFOScheduler,
+)
+
+
+def test_stopper_epoch_fraction_asha_geometry():
+    sched = tune.ASHAScheduler(max_t=20, grace_period=5, reduction_factor=2)
+    # rungs 5/10/20, survivors 1, 1/2, 1/4 -> (5 + 2.5 + 2.5)/20 = 0.5
+    assert abs(vz._stopper_epoch_fraction(sched, 20) - 0.5) < 1e-9
+    # no knobs -> 0.5 prior
+    assert vz._stopper_epoch_fraction(object(), 20) == 0.5
+
+
+def test_fit_dispatch_model_recovers_latency_and_per_epoch():
+    lat, ppe = 0.4, 0.002
+    obs = [
+        {"chunk": c, "rows": r, "exec_s": lat + c * r * ppe, "compile_s": 0}
+        for c, r in ((20, 50), (5, 50), (5, 25))
+    ]
+    fit = vz._fit_dispatch_model(obs)
+    assert fit is not None
+    assert abs(fit[0] - lat) < 1e-6 and abs(fit[1] - ppe) < 1e-9
+    # one distinct chunk*rows -> no fit
+    assert vz._fit_dispatch_model(obs[:1]) is None
+    assert vz._fit_dispatch_model([obs[1], dict(obs[1])]) is None
+
+
+class _StubProgram:
+    def __init__(self, num_epochs, obs):
+        self.num_epochs = num_epochs
+        self.dispatch_obs = obs
+
+
+def _asha():
+    return tune.ASHAScheduler(max_t=20, grace_period=5, reduction_factor=2)
+
+
+def test_auto_fifo_and_pbt_resolution():
+    prog = _StubProgram(20, [])
+    assert vz._resolve_auto_dispatch(
+        prog, FIFOScheduler(), None, 50, lambda m: None) == 20
+
+    class _Pbt:
+        interval = 3
+
+    assert vz._resolve_auto_dispatch(
+        prog, _asha(), _Pbt(), 50, lambda m: None) == 3
+
+
+def test_auto_cold_defaults_to_cadence():
+    prog = _StubProgram(20, [])
+    assert vz._resolve_auto_dispatch(
+        prog, _asha(), None, 50, lambda m: None) == 5
+
+
+def test_auto_whole_budget_history_speculates_when_compile_dominates():
+    # Whole-budget warm exec ~10s; best-case chunk savings 0.5*10=5s < the
+    # 30s compile a fresh chunk size would pay -> speculate (pick 20).
+    obs = [{"chunk": 20, "rows": 50, "exec_s": 10.0, "compile_s": 30.0}]
+    prog = _StubProgram(20, obs)
+    assert vz._resolve_auto_dispatch(
+        prog, _asha(), None, 50, lambda m: None) == 20
+    # Savings 0.5*200=100s > 30s compile -> chunk at the rung cadence.
+    obs2 = [{"chunk": 20, "rows": 50, "exec_s": 200.0, "compile_s": 30.0}]
+    prog2 = _StubProgram(20, obs2)
+    assert vz._resolve_auto_dispatch(
+        prog2, _asha(), None, 50, lambda m: None) == 5
+
+
+def test_auto_fit_based_choice_both_directions():
+    # Latency-dominated: lat 1.0s, per-row-epoch 1e-4 -> speculative.
+    lat, ppe = 1.0, 1e-4
+    obs = [
+        {"chunk": c, "rows": r, "exec_s": lat + c * r * ppe,
+         "compile_s": 0.0}
+        for c, r in ((20, 50), (5, 50))
+    ]
+    prog = _StubProgram(20, obs)
+    assert vz._resolve_auto_dispatch(
+        prog, _asha(), None, 50, lambda m: None) == 20
+    # Compute-dominated: lat 0.01s, per-row-epoch 0.05 -> chunked pruning.
+    lat, ppe = 0.01, 0.05
+    obs2 = [
+        {"chunk": c, "rows": r, "exec_s": lat + c * r * ppe,
+         "compile_s": 0.0}
+        for c, r in ((20, 50), (5, 50))
+    ]
+    prog2 = _StubProgram(20, obs2)
+    assert vz._resolve_auto_dispatch(
+        prog2, _asha(), None, 50, lambda m: None) == 5
+
+
+def test_e2e_fifo_then_asha_auto_reuses_whole_budget_program():
+    """The bench sequence: FIFO whole-budget populates the cached
+    program's history; a following ASHA sweep with "auto" must pick
+    whole-budget speculation when a fresh chunk compile dwarfs the
+    best-case pruning savings, and report stops at the same rungs."""
+    train, val = dummy_regression_data(
+        num_samples=96, seq_len=6, num_features=3
+    )
+    space = {
+        "model": "mlp", "hidden_dims": [8], "num_epochs": 8,
+        "batch_size": 32, "learning_rate": tune.loguniform(1e-3, 1e-2),
+        "seed": tune.randint(0, 10_000),
+    }
+    common = dict(
+        train_data=train, val_data=val, metric="validation_loss",
+        mode="min", num_samples=6, max_batch_trials=8, seed=3,
+        storage_path="/tmp/auto_dispatch_e2e", verbose=0,
+    )
+    a1 = tune.run_vectorized(space, name="fifo_pass",
+                             epochs_per_dispatch=8, **common)
+    assert len(a1.trials) == 6
+    # The cached program now has whole-budget observations; force the
+    # compile estimate high so the cold rule must speculate.
+    progs = list(vz._PROGRAM_CACHE.values())
+    assert progs, "FIFO pass should have cached its program"
+    for p in progs:
+        assert any(o["chunk"] == 8 for o in p.dispatch_obs)
+        for o in p.dispatch_obs:
+            o["compile_s"] = max(o["compile_s"], 60.0)
+    picks = []
+    a2 = tune.run_vectorized(
+        space, name="asha_auto",
+        scheduler=tune.ASHAScheduler(
+            max_t=8, grace_period=2, reduction_factor=2
+        ),
+        epochs_per_dispatch="auto",
+        callbacks=[], **common)
+    assert len(a2.trials) == 6
+    # Speculation ran every row to max_t in one dispatch: a new
+    # whole-budget observation must exist on the SAME cached program
+    # (row count == population size incl. padding multiple handling).
+    obs_after = [o for p in vz._PROGRAM_CACHE.values()
+                 for o in p.dispatch_obs if o["chunk"] == 8]
+    assert len(obs_after) >= 2, obs_after
+    # ASHA semantics preserved: some trials report fewer than max_t
+    # epochs (stopped at a rung), at least one runs to the end.
+    iters = sorted(len(t.results) for t in a2.trials)
+    assert iters[-1] == 8
+    assert iters[0] <= 4
