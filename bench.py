@@ -787,6 +787,10 @@ def child_torch(scale: dict) -> None:
         "step_s": step_s,
         "steps_measured": TORCH_STEPS_MEASURED,
         "extrapolated": True,
+        # 1-min loadavg on this 1-core host: >~1.5 means another process
+        # contended the measurement and the baseline reads slow (the
+        # 2026-08-01 707x-vs-315x contamination — RESULTS.md).
+        "loadavg_1m": round(os.getloadavg()[0], 2),
     }))
 
 
@@ -967,6 +971,7 @@ def child_torch_quality(scale: dict) -> None:
         "best_validation_mape": best,
         "trials": total_trials,
         "brackets": brackets,
+        "loadavg_1m": round(os.getloadavg()[0], 2),
         "sha": {"bracket": 8, "grace": grace, "max_t": max_t,
                 "reduction": 2},
     }))
@@ -2174,6 +2179,10 @@ def main() -> None:
                          batch=BATCH, d_model=D_MODEL, layers=LAYERS,
                          seq=SEQ),
         "baseline": ("torch-cpu-1core-extrapolated" if torch_res else None),
+        # Contention honesty: the baseline child records its 1-min
+        # loadavg; >1.5 on this 1-core host means vs_baseline is
+        # INFLATED by load that slowed torch, not by our speed.
+        "baseline_loadavg_1m": (torch_res or {}).get("loadavg_1m"),
         "best_validation_mape": ours.get("best_mape"),
         # Headline wall is the MEDIAN WARM repeat (spread recorded); the
         # cold wall (one-time compile included) is broken out so a compile-
