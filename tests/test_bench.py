@@ -683,11 +683,14 @@ def test_emit_line_fits_driver_tail_with_worst_case_payload(capsys):
                    "compute_dtype": "bfloat16"},
         "gqa_kv2": {"step_s": 0.07, "speedup_vs_mha": 1.048},
         "batch_x2": {"step_s": 0.14, "mfu": 0.27},
+        "xl_d1024": {"step_s": 0.21, "mfu": 0.41,
+                     "config": {"d_model": 1024, "num_layers": 8}},
     }
     extra = {
         "mfu": 0.002, "compute_dtype": "bfloat16",
         "best_validation_mape": 83.4, "wall_s": 11.7,
         "device_utilization": 0.54, "vs_baseline_cold": 11.2,
+        "baseline_loadavg_1m": 1.07,
         "probe": {"attempts": [
             {"rc": 124, "seconds": 120.0, "timeout_s": 120,
              "cause": "x" * 240}] * 4},
@@ -717,6 +720,7 @@ def test_emit_line_fits_driver_tail_with_worst_case_payload(capsys):
     assert line["flagship"]["batch"] == 16
     assert line["flagship"]["partial"] is True
     assert line["asha"]["exec_speedup_vs_fifo"] == 1.94
+    assert line["flagship"]["mfu_xl"] == 0.41
     assert line["last_tpu_capture"]["trials_per_hour"] == 15324.0
     assert line["probe_attempts"] == 4
     detail = _detail()
