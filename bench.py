@@ -53,7 +53,7 @@ FULL = dict(num_trials=50, num_epochs=20, data_steps=100_000, warm_repeats=5)
 # "lost" to torch 0.39x mostly on jit compile baked into a single cold
 # wall) AND is a median with spread — the cross-call program cache makes
 # each repeat cost only the execute wall (~18s here).
-SMALL = dict(num_trials=8, num_epochs=3, data_steps=30_000, warm_repeats=3)
+SMALL = dict(num_trials=8, num_epochs=3, data_steps=30_000, warm_repeats=5)
 
 # MXU-bound flagship measurement (VERDICT r3 next #2): the RESULTS.md
 # end-to-end shape — d_model 512, seq 2048, bf16, explicit flash attention
