@@ -222,7 +222,15 @@ def detect_call_convention(model, sample_x, init_rngs=None):
             lambda r, x: model.init(r, x, deterministic=True)
         )(rng, sample_x)
         return variables, "deterministic"
-    except TypeError:
+    except TypeError as exc:
+        # Only a rejected 'deterministic' kwarg means "wrong convention".
+        # Any other TypeError (e.g. a positional-encoding broadcast
+        # mismatch when max_seq_length < the data's window length) is the
+        # model's REAL failure: retrying with train= would just fail on
+        # the unknown kwarg and mask the actual error behind a confusing
+        # "unexpected keyword argument 'train'".
+        if "unexpected keyword argument 'deterministic'" not in str(exc):
+            raise
         variables = jax.jit(
             lambda r, x: model.init(r, x, train=False)
         )(rng, sample_x)

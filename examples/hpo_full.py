@@ -37,6 +37,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from distributed_machine_learning_tpu import tune  # noqa: E402
 from distributed_machine_learning_tpu.data import glucose_like_data  # noqa: E402
 
+# The reference pins its loaders at window length 96 (`:446`); every data
+# path in this example produces seq-96 windows.
+WINDOW_SEQ_LEN = 96
+
 
 def build_search_space(args) -> tune.SearchSpace:
     """The reference's 20 hyperparameters (`:379-400`), resolvable + valid."""
@@ -87,7 +91,7 @@ def build_search_space(args) -> tune.SearchSpace:
             "num_heads": tune.choice([2, 4]),
             "num_layers": tune.choice([1, 2]),
             "d_model": tune.choice([32, 64]),
-            "max_seq_length": 96,
+            "max_seq_length": WINDOW_SEQ_LEN,
             "batch_size": 32,
             "warmup_steps": 10,
         })
@@ -105,6 +109,18 @@ def build_search_space(args) -> tune.SearchSpace:
                 lambda cfg: cfg["attn_kernel_size"] < cfg["max_seq_length"],
                 description="attention kernel fits the sequence",
             ),
+            tune.Constraint(
+                # The PE table must cover the data's window length (96 for
+                # the reference-format window grid): the reference crashes
+                # on this combo too (its torch PE slices pe[:, :seq] from a
+                # max_seq_length-long table, a broadcast error when seq >
+                # max_seq_length) — here the sampler simply never proposes
+                # it, so a bounded run spends its whole budget on valid
+                # trials.
+                lambda cfg: (cfg["position_encoding"] != "sincos"
+                             or cfg["max_seq_length"] >= WINDOW_SEQ_LEN),
+                description="sincos PE table covers the data window length",
+            ),
         ],
     )
 
@@ -119,8 +135,8 @@ def load_data(args):
         return make_regression_dataset(
             load_dataframe_from_npy(args.features),
             load_dataframe_from_npy(args.labels),
-            interval=96,
-            stride=96,
+            interval=WINDOW_SEQ_LEN,
+            stride=WINDOW_SEQ_LEN,
         )
     return glucose_like_data(
         num_steps=args.data_steps, num_features=args.num_features

@@ -320,3 +320,26 @@ def test_standalone_session_runs_trainable_directly():
 
     with pytest.raises(RuntimeError):
         session.report({"x": 1.0})
+
+
+def test_convention_probe_reraises_non_flag_errors():
+    """A model whose init fails for a REAL reason (PE table shorter than
+    the sequence) must surface that error, not a misleading
+    "unexpected keyword argument 'train'" from the convention fallback
+    (2026-08-01 refdata run forensics)."""
+    import jax.numpy as jnp
+    import pytest
+
+    from distributed_machine_learning_tpu.models import build_model
+    from distributed_machine_learning_tpu.tune._regression_program import (
+        detect_call_convention,
+    )
+
+    model = build_model({
+        "model": "transformer", "d_model": 16, "num_heads": 2,
+        "num_layers": 1, "dim_feedforward": 32, "max_seq_length": 8,
+    })
+    x = jnp.zeros((1, 24, 4))  # seq 24 > PE table 8
+    with pytest.raises(TypeError) as ei:
+        detect_call_convention(model, x)
+    assert "train" not in str(ei.value)
