@@ -1454,7 +1454,10 @@ def _flagship_result(progress_cb) -> dict:
     # The climb stops at the first non-improving doubling (a losing 2B
     # means 4B would pay another compile to lose harder) or on error
     # (e.g. activation HBM exhaustion at the biggest batch).
-    for mult in (2, 4):
+    # (2, 4, 8): the 2026-08-01 capture promoted x4 (B32, mfu 0.3111) as
+    # the last rung tried while still improving — x8 is attempted only
+    # when x4 won, so a stalling climb costs nothing extra.
+    for mult in (2, 4, 8):
         key = f"batch_x{mult}"
         try:
             bx = FLAGSHIP["batch"] * mult
