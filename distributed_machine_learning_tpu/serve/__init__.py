@@ -1,0 +1,57 @@
+"""TPU-native inference serving: checkpoint -> compiled replicas -> HTTP.
+
+The deployment end of the pipeline (ROADMAP north star: serve the tuned
+winner, not just find it)::
+
+    from distributed_machine_learning_tpu import serve
+
+    serve.export_bundle(analysis, "/models/winner")     # or an exp dir
+    bundle = serve.load_bundle("/models/winner")
+    srv = serve.PredictionServer(bundle, num_replicas=2)
+    srv.warmup(sample_batch)
+    host, port = srv.start()                            # POST /predict
+
+Layering: ``export`` freezes the best trial into a self-describing bundle;
+``engine`` runs jit-compiled, shape-bucketed forward passes; ``batcher``
+micro-batches concurrent requests; ``replica`` scales engines across
+leased devices with failover; ``server`` is the stdlib HTTP front end;
+``metrics`` the latency/throughput accounting behind ``/metrics``.
+"""
+
+from distributed_machine_learning_tpu.serve.batcher import (
+    BatcherStats,
+    MicroBatcher,
+)
+from distributed_machine_learning_tpu.serve.engine import (
+    InferenceEngine,
+    bucket_sizes,
+)
+from distributed_machine_learning_tpu.serve.export import (
+    BUNDLE_VERSION,
+    ServableBundle,
+    export_bundle,
+    load_bundle,
+)
+from distributed_machine_learning_tpu.serve.metrics import ServeMetrics
+from distributed_machine_learning_tpu.serve.replica import (
+    Replica,
+    ReplicaSet,
+    replica_process_env,
+)
+from distributed_machine_learning_tpu.serve.server import PredictionServer
+
+__all__ = [
+    "BUNDLE_VERSION",
+    "BatcherStats",
+    "InferenceEngine",
+    "MicroBatcher",
+    "PredictionServer",
+    "Replica",
+    "ReplicaSet",
+    "ServableBundle",
+    "ServeMetrics",
+    "bucket_sizes",
+    "export_bundle",
+    "load_bundle",
+    "replica_process_env",
+]

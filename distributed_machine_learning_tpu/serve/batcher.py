@@ -1,0 +1,202 @@
+"""Micro-batching request queue in front of an inference engine.
+
+Individual ``/predict`` requests are tiny; dispatching each alone wastes
+the accelerator (a batch-1 program moves the same weights through the chip
+as a batch-64 one).  The batcher coalesces concurrent requests into one
+engine call under a two-trigger flush policy:
+
+* **size**: accumulated rows reach ``max_batch_size`` -> flush now;
+* **latency**: the oldest queued request has waited ``max_latency_ms``
+  -> flush whatever is there (partial batch) so light traffic still gets
+  bounded latency.
+
+Requests are numpy arrays of shape ``(rows, ...features)``; the caller gets
+a ``concurrent.futures.Future`` resolving to its own rows of the batched
+result — arrival order is preserved within a flush, so splitting the
+output back is pure bookkeeping.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class _Pending:
+    x: np.ndarray
+    future: Future
+    enqueued_at: float = field(default_factory=time.time)
+
+
+class BatcherStats:
+    """Thread-safe flush accounting (fill ratio, trigger mix, depth)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.batches = 0
+        self.rows = 0
+        self.size_flushes = 0
+        self.latency_flushes = 0
+
+    def record(self, rows: int, trigger: str):
+        with self._lock:
+            self.batches += 1
+            self.rows += rows
+            if trigger == "size":
+                self.size_flushes += 1
+            else:
+                self.latency_flushes += 1
+
+    def to_dict(self, max_batch_size: int) -> Dict[str, Any]:
+        with self._lock:
+            fill = (
+                self.rows / (self.batches * max_batch_size)
+                if self.batches
+                else 0.0
+            )
+            return {
+                "batches": self.batches,
+                "rows": self.rows,
+                "batch_fill_ratio": round(fill, 4),
+                "size_flushes": self.size_flushes,
+                "latency_flushes": self.latency_flushes,
+            }
+
+
+class MicroBatcher:
+    """Background flush loop feeding ``infer_fn`` coalesced batches.
+
+    ``infer_fn(batch) -> predictions`` is called on the batcher's worker
+    thread, one flush at a time; an exception fails every request in that
+    flush (each future gets it) and the loop keeps serving — one poisoned
+    batch must not take the replica down.
+    """
+
+    def __init__(
+        self,
+        infer_fn: Callable[[np.ndarray], np.ndarray],
+        max_batch_size: int = 64,
+        max_latency_ms: float = 5.0,
+        name: str = "batcher",
+    ):
+        if max_batch_size < 1:
+            raise ValueError(f"max_batch_size must be >= 1: {max_batch_size}")
+        self.infer_fn = infer_fn
+        self.max_batch_size = int(max_batch_size)
+        self.max_latency_s = float(max_latency_ms) / 1000.0
+        self.stats = BatcherStats()
+        self._queue: List[_Pending] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, name=name, daemon=True
+        )
+        self._thread.start()
+
+    # -- producer side -------------------------------------------------------
+
+    def submit(self, x) -> Future:
+        """Enqueue one request; resolves to its rows of the batched output."""
+        x = np.asarray(x)
+        fut: Future = Future()
+        with self._wake:
+            if self._stop:
+                fut.set_exception(RuntimeError("batcher is stopped"))
+                return fut
+            self._queue.append(_Pending(x, fut))
+            self._wake.notify()
+        return fut
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def is_alive(self) -> bool:
+        return self._thread.is_alive() and not self._stop
+
+    # -- worker side ---------------------------------------------------------
+
+    def _take_batch(self) -> Optional[List[_Pending]]:
+        """Block until a flush trigger fires (or stop); returns the drained
+        requests for one batch."""
+        with self._wake:
+            while True:
+                if self._stop and not self._queue:
+                    return None
+                if self._queue:
+                    rows = sum(p.x.shape[0] for p in self._queue)
+                    oldest = self._queue[0].enqueued_at
+                    now = time.time()
+                    if self._stop or rows >= self.max_batch_size:
+                        return self._drain("size")
+                    remaining = self.max_latency_s - (now - oldest)
+                    if remaining <= 0:
+                        return self._drain("latency")
+                    self._wake.wait(timeout=remaining)
+                else:
+                    self._wake.wait(timeout=0.1)
+
+    def _drain(self, trigger: str) -> List[_Pending]:
+        # Called under the lock. Take whole requests up to the size cap —
+        # never split one request across flushes (its future maps 1:1 to a
+        # contiguous slice of ONE engine call); a single over-cap request
+        # flushes alone and the engine chunks it internally.
+        batch: List[_Pending] = []
+        rows = 0
+        while self._queue:
+            nxt = self._queue[0]
+            n = nxt.x.shape[0]
+            if batch and rows + n > self.max_batch_size:
+                break
+            batch.append(self._queue.pop(0))
+            rows += n
+        self.stats.record(rows, trigger)
+        return batch
+
+    def _loop(self):
+        from distributed_machine_learning_tpu.utils.heartbeat import (
+            touch_heartbeat,
+        )
+
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            try:
+                xs = np.concatenate([p.x for p in batch], axis=0)
+                preds = np.asarray(self.infer_fn(xs))
+                off = 0
+                for p in batch:
+                    n = p.x.shape[0]
+                    p.future.set_result(preds[off: off + n])
+                    off += n
+            except BaseException as exc:  # noqa: BLE001 - fail the batch only
+                for p in batch:
+                    if not p.future.done():
+                        p.future.set_exception(exc)
+            # A completed flush is real progress — same contract as the
+            # trainables' phase boundaries (utils/heartbeat.py).
+            touch_heartbeat()
+
+    def stop(self, drain: bool = True, timeout: float = 5.0):
+        """Stop the worker; with ``drain`` the queue is flushed first,
+        otherwise queued futures fail fast."""
+        with self._wake:
+            self._stop = True
+            if not drain:
+                for p in self._queue:
+                    if not p.future.done():
+                        p.future.set_exception(
+                            RuntimeError("batcher stopped before flush")
+                        )
+                self._queue.clear()
+            self._wake.notify_all()
+        self._thread.join(timeout=timeout)

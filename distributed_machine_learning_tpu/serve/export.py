@@ -1,0 +1,203 @@
+"""Best-trial checkpoint -> self-describing servable bundle.
+
+The checkpoint is the stable contract between training and serving (the
+Orbax position in PAPERS.md): ``tune`` persists a winner's pytree, and this
+module freezes everything a serving process needs to rebuild it — params,
+the trial config the ``models/`` registry rebuilds the architecture from,
+and the feature schema the inputs were assembled with — into one directory
+that needs no experiment store, no searcher state, and no live driver.
+
+Bundle layout (any ``tune.storage`` scheme — local, ``mem://``, ``gs://``)::
+
+    <bundle>/bundle.json      manifest: version, config, metric, features,
+                              provenance (experiment / trial / checkpoint)
+    <bundle>/params.msgpack   flax msgpack pytree {"params": ..,
+                              ["batch_stats": ..]} — the same format
+                              ``tune.checkpoint`` writes, so round-trips
+                              are bit-identical.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from distributed_machine_learning_tpu.tune import checkpoint as ckpt_lib
+from distributed_machine_learning_tpu.tune.experiment import (
+    ExperimentAnalysis,
+    _jsonable,
+)
+from distributed_machine_learning_tpu.tune.storage import get_storage
+
+BUNDLE_VERSION = 1
+MANIFEST_NAME = "bundle.json"
+PARAMS_NAME = "params.msgpack"
+
+
+@dataclass
+class ServableBundle:
+    """A loaded bundle: everything ``serve.engine`` needs to answer."""
+
+    config: Dict[str, Any]
+    variables: Dict[str, Any]  # {"params": ..., ["batch_stats": ...]}
+    manifest: Dict[str, Any] = field(default_factory=dict)
+    path: Optional[str] = None
+
+    @property
+    def model_family(self) -> str:
+        return self.config.get("model", "transformer")
+
+    @property
+    def feature_names(self) -> List[str]:
+        return list((self.manifest.get("features") or {}).get("names", []))
+
+    def build_model(self):
+        from distributed_machine_learning_tpu.models import build_model
+
+        return build_model(self.config)
+
+
+def _feature_block(schema: str) -> Dict[str, Any]:
+    """The input-column contract, from ``data/features.py`` — a serving
+    client can validate/order its feature vector without this package."""
+    from distributed_machine_learning_tpu.data import features as F
+
+    names = F.features if schema == "canonical" else F.reference_features
+    return {"schema": schema, "names": list(names), "label": F.LABEL_COLUMN}
+
+
+def export_bundle(
+    source,
+    out_dir: str,
+    metric: Optional[str] = None,
+    mode: Optional[str] = None,
+    trial_id: Optional[str] = None,
+    feature_schema: str = "canonical",
+) -> str:
+    """Resolve the best trial of ``source`` and write a servable bundle.
+
+    ``source`` is either a live :class:`ExperimentAnalysis` (the object
+    ``tune.run`` returns) or an experiment directory path
+    (``<storage_path>/<name>``), in which case ``metric``/``mode`` default
+    to the objective recorded in ``experiment_state.json``.  ``trial_id``
+    overrides best-trial selection (serve a specific trial).  Returns
+    ``out_dir``.
+    """
+    if isinstance(source, ExperimentAnalysis):
+        analysis = source
+    else:
+        root = str(source)
+        state = _read_state(root)
+        metric = metric or state.get("metric")
+        mode = mode or state.get("mode") or "min"
+        if not metric:
+            raise ValueError(
+                f"experiment at {root!r} predates metric recording — "
+                f"pass metric= explicitly"
+            )
+        analysis = ExperimentAnalysis.from_directory(root, metric, mode)
+
+    if trial_id is not None:
+        matches = [t for t in analysis.trials if t.trial_id == trial_id]
+        if not matches:
+            raise ValueError(
+                f"no trial {trial_id!r} in experiment "
+                f"{analysis.root!r}"
+            )
+        trial = matches[0]
+    else:
+        trial = analysis.best_trial
+
+    ckpt_path = trial.latest_checkpoint
+    if ckpt_path is None and analysis.root:
+        # Rehydrated analyses don't carry live checkpoint pointers — the
+        # on-disk layout does (<root>/<trial_id>/checkpoints/ckpt_*.msgpack).
+        backend, root = get_storage(analysis.root)
+        ckpt_path, _ = ckpt_lib.find_latest_checkpoint(
+            backend.join(root, trial.trial_id, "checkpoints")
+        )
+    ckpt = ckpt_lib.load_checkpoint(ckpt_path) if ckpt_path else None
+    if ckpt is None or "params" not in ckpt:
+        raise ValueError(
+            f"trial {trial.trial_id} has no restorable checkpoint "
+            f"(path={ckpt_path!r}); run with checkpointing enabled"
+        )
+
+    variables: Dict[str, Any] = {"params": ckpt["params"]}
+    if ckpt.get("batch_stats"):
+        variables["batch_stats"] = ckpt["batch_stats"]
+
+    score = analysis._score(trial)
+    manifest = {
+        "bundle_version": BUNDLE_VERSION,
+        "created_at": time.time(),
+        "model_family": trial.config.get("model", "transformer"),
+        "config": _jsonable(_servable_config(trial.config)),
+        "metric": analysis.metric,
+        "mode": analysis.mode,
+        "best_score": score,
+        "features": _feature_block(feature_schema),
+        "source": {
+            "experiment": analysis.root,
+            "trial_id": trial.trial_id,
+            "checkpoint": ckpt_path,
+        },
+    }
+
+    backend, out = get_storage(out_dir)
+    backend.write_bytes(
+        backend.join(out, MANIFEST_NAME),
+        json.dumps(manifest, indent=2).encode(),
+    )
+    # Same writer as training checkpoints: identical msgpack bytes in and
+    # out, so a served prediction is bit-identical to one made from the
+    # original checkpoint.
+    ckpt_lib.save_checkpoint(backend.join(out, PARAMS_NAME), variables)
+    return out_dir
+
+
+def _servable_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    """Strip non-serializable / training-only entries (a live mesh handle
+    cannot ride in a manifest; serving rebuilds placement itself)."""
+    return {k: v for k, v in config.items() if k != "mesh"}
+
+
+def _read_state(root: str) -> Dict[str, Any]:
+    import os
+
+    path = os.path.join(root, "experiment_state.json")
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def load_bundle(bundle_dir: str) -> ServableBundle:
+    """Read a bundle directory back into a :class:`ServableBundle`."""
+    backend, d = get_storage(bundle_dir)
+    raw = backend.read_bytes(backend.join(d, MANIFEST_NAME))
+    if raw is None:
+        raise FileNotFoundError(
+            f"no {MANIFEST_NAME} under {bundle_dir!r} — not a bundle "
+            f"directory (expected the output of export_bundle)"
+        )
+    manifest = json.loads(raw)
+    version = manifest.get("bundle_version")
+    if version != BUNDLE_VERSION:
+        raise ValueError(
+            f"bundle at {bundle_dir!r} has version {version!r}; this "
+            f"build reads version {BUNDLE_VERSION}"
+        )
+    variables = ckpt_lib.load_checkpoint(backend.join(d, PARAMS_NAME))
+    if variables is None or "params" not in variables:
+        raise FileNotFoundError(
+            f"bundle at {bundle_dir!r} is missing {PARAMS_NAME}"
+        )
+    return ServableBundle(
+        config=dict(manifest.get("config", {})),
+        variables=variables,
+        manifest=manifest,
+        path=bundle_dir,
+    )

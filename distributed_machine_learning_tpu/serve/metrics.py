@@ -1,0 +1,109 @@
+"""Serving metrics: latency quantiles, throughput, and TensorBoard export.
+
+Plain-JSON first (the ``/metrics`` endpoint), with the same scalars
+optionally streamed through ``utils/tensorboard.py`` so a serving process
+shows up next to training runs in one TensorBoard — no tensorflow
+dependency either way.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list (0 if empty)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q / 100.0 * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+class ServeMetrics:
+    """Thread-safe request accounting for one serving process.
+
+    Latencies keep a bounded window (the newest ``window`` samples) — p50
+    and p99 over recent traffic, not a lifetime average that hides a
+    regression behind a month of history.
+    """
+
+    def __init__(self, window: int = 4096):
+        self._lock = threading.Lock()
+        self._latencies_ms: deque = deque(maxlen=window)
+        self._started_at = time.time()
+        self.requests = 0
+        self.rows = 0
+        self.errors = 0
+
+    def observe(self, latency_s: float, rows: int):
+        with self._lock:
+            self.requests += 1
+            self.rows += rows
+            self._latencies_ms.append(latency_s * 1000.0)
+
+    def observe_error(self):
+        with self._lock:
+            self.errors += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            lat = sorted(self._latencies_ms)
+            uptime = max(time.time() - self._started_at, 1e-9)
+            return {
+                "uptime_s": round(uptime, 1),
+                "requests_total": self.requests,
+                "rows_total": self.rows,
+                "errors_total": self.errors,
+                "requests_per_s": round(self.requests / uptime, 2),
+                "rows_per_s": round(self.rows / uptime, 2),
+                "latency_ms_p50": round(percentile(lat, 50.0), 3),
+                "latency_ms_p99": round(percentile(lat, 99.0), 3),
+                "latency_window": len(lat),
+            }
+
+    def scalar_pairs(self) -> List[Tuple[str, float]]:
+        """The snapshot as (tag, value) pairs for ``SummaryWriter``."""
+        snap = self.snapshot()
+        return [
+            (f"serve/{k}", float(v))
+            for k, v in snap.items()
+            if isinstance(v, (int, float))
+        ]
+
+
+class TensorBoardEmitter:
+    """Writes serve scalars to an event file on demand (step = request
+    count), created lazily so metrics-only deployments pay nothing."""
+
+    def __init__(self, logdir: Optional[str]):
+        self._logdir = logdir
+        self._writer = None
+        self._lock = threading.Lock()
+
+    def emit(self, metrics: ServeMetrics, extra: Optional[Dict] = None):
+        if not self._logdir:
+            return
+        with self._lock:
+            if self._writer is None:
+                from distributed_machine_learning_tpu.utils.tensorboard import (
+                    SummaryWriter,
+                )
+
+                self._writer = SummaryWriter(self._logdir)
+            pairs = metrics.scalar_pairs()
+            if extra:
+                pairs += [
+                    (f"serve/{k}", float(v))
+                    for k, v in extra.items()
+                    if isinstance(v, (int, float))
+                ]
+            self._writer.add_scalars(pairs, step=metrics.requests)
+            self._writer.flush()
+
+    def close(self):
+        with self._lock:
+            if self._writer is not None:
+                self._writer.close()

@@ -1,0 +1,268 @@
+"""Device-pinned inference replicas with round-robin dispatch + failover.
+
+Throughput scaling for serving mirrors the HPO executor's trial placement
+(``tune/executor.py``): a ``DeviceManager`` leases each replica its own
+device, the replica's engine pins its programs there via
+``jax.default_device`` (thread-local, same as ``ThreadTrialExecutor``), and
+a monitor thread restarts any replica whose worker dies — traffic keeps
+flowing on the survivors in the meantime.
+
+For one-replica-per-process deployments (the hard isolation the process
+executor gives trials), :func:`replica_process_env` builds the same
+``TPU_VISIBLE_CHIPS`` environment the executor uses, so a replica child
+claims exactly its leased chips.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from distributed_machine_learning_tpu.serve.batcher import MicroBatcher
+from distributed_machine_learning_tpu.serve.engine import InferenceEngine
+from distributed_machine_learning_tpu.serve.export import ServableBundle
+from distributed_machine_learning_tpu.tune.executor import (
+    DeviceManager,
+    _host_chip_ordinals,
+)
+
+
+def replica_process_env(devices: Sequence) -> Dict[str, str]:
+    """Environment for a one-replica child process claiming exactly
+    ``devices`` — the executor's ``TPU_VISIBLE_CHIPS`` isolation applied
+    to serving (no-op mapping on CPU, where the thread path is used)."""
+    env = dict(os.environ)
+    if devices and getattr(devices[0], "platform", "cpu") != "cpu":
+        visible = ",".join(str(c) for c in _host_chip_ordinals(list(devices)))
+        env["TPU_VISIBLE_CHIPS"] = visible
+        env["TPU_VISIBLE_DEVICES"] = visible
+    return env
+
+
+class Replica:
+    """One engine + one micro-batcher pinned to one leased device."""
+
+    def __init__(
+        self,
+        idx: int,
+        bundle: ServableBundle,
+        device,
+        max_batch_size: int = 64,
+        max_latency_ms: float = 5.0,
+        max_bucket: int = 256,
+    ):
+        self.idx = idx
+        self.device = device
+        self.engine = InferenceEngine(
+            bundle, max_bucket=max_bucket, device=device
+        )
+        self.processed_batches = 0
+        self.last_beat = time.time()
+        self.batcher = MicroBatcher(
+            self._infer,
+            max_batch_size=max_batch_size,
+            max_latency_ms=max_latency_ms,
+            name=f"replica-{idx}",
+        )
+
+    def _infer(self, x: np.ndarray) -> np.ndarray:
+        out = self.engine.predict(x)
+        self.processed_batches += 1
+        self.last_beat = time.time()
+        return out
+
+    def submit(self, x):
+        return self.batcher.submit(x)
+
+    def alive(self) -> bool:
+        return self.batcher.is_alive()
+
+    def kill(self):
+        """Hard-stop this replica's worker (failover tests / ops drain):
+        queued requests fail fast and the batcher thread exits."""
+        self.batcher.stop(drain=False, timeout=2.0)
+
+    def health(self) -> Dict[str, Any]:
+        return {
+            "replica": self.idx,
+            "device": str(self.device),
+            "alive": self.alive(),
+            "queue_depth": self.batcher.queue_depth,
+            "processed_batches": self.processed_batches,
+            "last_beat_age_s": round(time.time() - self.last_beat, 3),
+        }
+
+
+class ReplicaSet:
+    """N replicas behind one ``submit()`` — round-robin over the healthy.
+
+    ``restart=True`` runs a monitor thread that respawns dead replicas on
+    their original leased device (a fresh engine re-jits from the shared
+    persistent compile cache, so recovery does not re-pay backend
+    compiles).  ``kill()`` hard-stops one replica's worker — dispatch
+    fails over to the survivors immediately, and the monitor treats the
+    gap like any other death; pass ``restart=False`` for an operator
+    drain that should stay down.
+    """
+
+    def __init__(
+        self,
+        bundle: ServableBundle,
+        num_replicas: int = 2,
+        devices: Optional[List] = None,
+        max_batch_size: int = 64,
+        max_latency_ms: float = 5.0,
+        max_bucket: int = 256,
+        restart: bool = True,
+        monitor_interval_s: float = 0.25,
+    ):
+        if num_replicas < 1:
+            raise ValueError(f"num_replicas must be >= 1: {num_replicas}")
+        self.bundle = bundle
+        self._kwargs = dict(
+            max_batch_size=max_batch_size,
+            max_latency_ms=max_latency_ms,
+            max_bucket=max_bucket,
+        )
+        self._dm = DeviceManager(devices)
+        self._leases = []
+        self._devices = []
+        for r in range(num_replicas):
+            lease = self._dm.acquire(1) if self._dm.num_free else None
+            if lease:
+                self._leases.append(lease)
+                self._devices.append(lease[0][1])
+            else:
+                # More replicas than devices: share round-robin (CPU dev
+                # boxes; on TPU, size the replica count to the slice).
+                self._devices.append(self._dm.devices[r % self._dm.num_devices])
+        self._lock = threading.Lock()
+        self._rr = 0
+        self.restarts = 0
+        self._closing = False
+        self._warmup_programs: Optional[int] = None
+        self.replicas: List[Replica] = [
+            Replica(r, bundle, self._devices[r], **self._kwargs)
+            for r in range(num_replicas)
+        ]
+        self._monitor: Optional[threading.Thread] = None
+        if restart:
+            self._monitor = threading.Thread(
+                target=self._monitor_loop,
+                args=(monitor_interval_s,),
+                name="replica-monitor",
+                daemon=True,
+            )
+            self._monitor.start()
+
+    # -- dispatch ------------------------------------------------------------
+
+    def submit(self, x):
+        """Round-robin to the next healthy replica; a dead replica is
+        skipped (failover) until the monitor restarts it."""
+        with self._lock:
+            replicas = list(self.replicas)
+            start = self._rr
+            self._rr = (self._rr + 1) % len(replicas)
+        for off in range(len(replicas)):
+            r = replicas[(start + off) % len(replicas)]
+            if r.alive():
+                return r.submit(x)
+        raise RuntimeError("no healthy replicas")
+
+    def predict(self, x, timeout: Optional[float] = 30.0) -> np.ndarray:
+        return self.submit(x).result(timeout=timeout)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _monitor_loop(self, interval_s: float):
+        while not self._closing:
+            time.sleep(interval_s)
+            if self._closing:
+                return
+            with self._lock:
+                dead = [
+                    (i, r)
+                    for i, r in enumerate(self.replicas)
+                    if not r.alive()
+                ]
+            for i, old in dead:
+                if self._closing:
+                    return
+                fresh = Replica(
+                    old.idx, self.bundle, old.device, **self._kwargs
+                )
+                with self._lock:
+                    if self.replicas[i] is old:
+                        self.replicas[i] = fresh
+                        self.restarts += 1
+                    else:  # raced another restart; discard ours
+                        fresh.kill()
+
+    def kill(self, idx: int):
+        with self._lock:
+            replica = self.replicas[idx]
+        replica.kill()
+
+    def warmup(self, sample) -> Dict[str, Any]:
+        """Compile every replica's bucket grid; records the program count
+        the zero-recompile acceptance check diffs against."""
+        for r in list(self.replicas):
+            r.engine.warmup(sample)
+        stats = self.program_stats()
+        self._warmup_programs = stats["programs"]
+        return stats
+
+    def program_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            replicas = list(self.replicas)
+        programs = sum(r.engine.num_programs for r in replicas)
+        out = {
+            "programs": programs,
+            "per_replica": [r.engine.program_stats() for r in replicas],
+        }
+        if self._warmup_programs is not None:
+            out["programs_after_warmup"] = self._warmup_programs
+            out["new_programs_since_warmup"] = max(
+                programs - self._warmup_programs, 0
+            )
+        return out
+
+    def health(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            replicas = list(self.replicas)
+        return [r.health() for r in replicas]
+
+    def num_healthy(self) -> int:
+        return sum(1 for h in self.health() if h["alive"])
+
+    def batcher_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            replicas = list(self.replicas)
+        agg = {"batches": 0, "rows": 0, "size_flushes": 0,
+               "latency_flushes": 0}
+        for r in replicas:
+            d = r.batcher.stats.to_dict(r.batcher.max_batch_size)
+            for k in agg:
+                agg[k] += d[k]
+        agg["batch_fill_ratio"] = round(
+            agg["rows"] / (agg["batches"] * self._kwargs["max_batch_size"]),
+            4,
+        ) if agg["batches"] else 0.0
+        agg["queue_depth"] = sum(r.batcher.queue_depth for r in replicas)
+        return agg
+
+    def close(self):
+        self._closing = True
+        if self._monitor is not None:
+            self._monitor.join(timeout=2.0)
+        with self._lock:
+            replicas = list(self.replicas)
+        for r in replicas:
+            r.batcher.stop(drain=False, timeout=2.0)
+        for lease in self._leases:
+            self._dm.release(lease)

@@ -1,0 +1,184 @@
+"""Stdlib HTTP front end: /predict, /healthz, /metrics.
+
+No web framework in the image, none needed: ``http.server`` with a
+threading server is enough for a JSON prediction API, and keeps the
+serving path dependency-free end to end (the same stance as the hand-rolled
+TensorBoard writer in ``utils/tensorboard.py``).
+
+Endpoints::
+
+    POST /predict   {"instances": [[...], ...]}
+                    -> {"predictions": [...], "latency_ms": ...}
+    GET  /healthz   {"status": "ok"|"degraded", "replicas": [...]}
+    GET  /metrics   latency p50/p99, throughput, queue depth, batch fill
+                    ratio, compile counters (plain JSON; also streamed to
+                    TensorBoard when --tb-logdir is set)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from distributed_machine_learning_tpu.serve.export import ServableBundle
+from distributed_machine_learning_tpu.serve.metrics import (
+    ServeMetrics,
+    TensorBoardEmitter,
+)
+from distributed_machine_learning_tpu.serve.replica import ReplicaSet
+
+
+class PredictionServer:
+    """Owns a :class:`ReplicaSet` and serves it over HTTP.
+
+    ``port=0`` binds an ephemeral port (tests); ``start()`` returns the
+    bound ``(host, port)``.  The handler threads only do JSON work — the
+    device path stays inside the replicas' batcher workers.
+    """
+
+    def __init__(
+        self,
+        bundle: ServableBundle,
+        host: str = "127.0.0.1",
+        port: int = 8000,
+        num_replicas: int = 2,
+        max_batch_size: int = 64,
+        max_latency_ms: float = 5.0,
+        max_bucket: int = 256,
+        tb_logdir: Optional[str] = None,
+        request_timeout_s: float = 30.0,
+    ):
+        self.bundle = bundle
+        self.replicas = ReplicaSet(
+            bundle,
+            num_replicas=num_replicas,
+            max_batch_size=max_batch_size,
+            max_latency_ms=max_latency_ms,
+            max_bucket=max_bucket,
+        )
+        self.metrics = ServeMetrics()
+        self._tb = TensorBoardEmitter(tb_logdir)
+        self._timeout_s = request_timeout_s
+        self._host, self._port = host, port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- request handling (called from handler threads) ----------------------
+
+    def handle_predict(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        instances = body.get("instances")
+        if instances is None:
+            raise ValueError('request body needs an "instances" array')
+        x = np.asarray(instances, dtype=np.float32)
+        if x.ndim < 1 or x.shape[0] == 0:
+            raise ValueError("instances must be a non-empty array")
+        t0 = time.time()
+        preds = self.replicas.predict(x, timeout=self._timeout_s)
+        latency = time.time() - t0
+        self.metrics.observe(latency, rows=x.shape[0])
+        return {
+            "predictions": np.asarray(preds).tolist(),
+            "latency_ms": round(latency * 1000.0, 3),
+        }
+
+    def handle_healthz(self) -> Dict[str, Any]:
+        health = self.replicas.health()
+        alive = sum(1 for h in health if h["alive"])
+        return {
+            "status": "ok" if alive == len(health) else
+            ("degraded" if alive else "down"),
+            "replicas": health,
+            "restarts": self.replicas.restarts,
+            "model_family": self.bundle.model_family,
+        }
+
+    def handle_metrics(self) -> Dict[str, Any]:
+        programs = self.replicas.program_stats()
+        batcher = self.replicas.batcher_stats()
+        out = {
+            **self.metrics.snapshot(),
+            **{f"batcher_{k}": v for k, v in batcher.items()},
+            "compile": programs,
+            "num_replicas": len(self.replicas.replicas),
+            "num_healthy": self.replicas.num_healthy(),
+        }
+        self._tb.emit(self.metrics, extra={
+            "queue_depth": batcher.get("queue_depth", 0),
+            "batch_fill_ratio": batcher.get("batch_fill_ratio", 0.0),
+            "programs": programs.get("programs", 0),
+        })
+        return out
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def warmup(self, sample) -> Dict[str, Any]:
+        return self.replicas.warmup(sample)
+
+    def start(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # Silence per-request stderr lines; metrics carry the signal.
+            def log_message(self, *args):  # noqa: D102
+                pass
+
+            def _reply(self, code: int, payload: Dict[str, Any]):
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                try:
+                    if self.path == "/healthz":
+                        self._reply(200, server.handle_healthz())
+                    elif self.path == "/metrics":
+                        self._reply(200, server.handle_metrics())
+                    else:
+                        self._reply(404, {"error": f"no route {self.path}"})
+                except Exception as exc:  # noqa: BLE001 - surface as 500
+                    self._reply(500, {"error": repr(exc)})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._reply(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    self._reply(200, server.handle_predict(body))
+                except ValueError as exc:
+                    server.metrics.observe_error()
+                    self._reply(400, {"error": str(exc)})
+                except Exception as exc:  # noqa: BLE001 - surface as 503
+                    server.metrics.observe_error()
+                    self._reply(503, {"error": repr(exc)})
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self._host, self._port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serve-http", daemon=True
+        )
+        self._thread.start()
+        return self._host, self._port
+
+    @property
+    def address(self):
+        return self._host, self._port
+
+    def close(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self.replicas.close()
+        self._tb.close()

@@ -204,6 +204,24 @@ def stage_data(
     )
 
 
+def _call_lacks_deterministic(model) -> bool:
+    """Whether ``model.__call__`` provably has no ``deterministic``
+    parameter (explicit signature, no ``**kwargs``).  Inconclusive
+    signatures return False — the caller then re-raises rather than
+    guessing."""
+    import inspect
+
+    try:
+        params = inspect.signature(type(model).__call__).parameters
+    except (TypeError, ValueError):
+        return False
+    if any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+    ):
+        return False
+    return "deterministic" not in params
+
+
 def detect_call_convention(model, sample_x, init_rngs=None):
     """Init the model and learn (variables, train-flag kwarg name).
 
@@ -228,8 +246,17 @@ def detect_call_convention(model, sample_x, init_rngs=None):
         # mismatch when max_seq_length < the data's window length) is the
         # model's REAL failure: retrying with train= would just fail on
         # the unknown kwarg and mask the actual error behind a confusing
-        # "unexpected keyword argument 'train'".
-        if "unexpected keyword argument 'deterministic'" not in str(exc):
+        # "unexpected keyword argument 'train'".  The match is deliberately
+        # loose — any wording that names the flag as an argument problem
+        # (CPython's current phrasing, a future rewording, a wrapper's
+        # re-raise) counts — and a signature probe covers a TypeError that
+        # names neither (a __call__ provably without the flag cannot have
+        # run its body, so the error can only be the kwarg rejection).
+        msg = str(exc)
+        mentions_flag = "deterministic" in msg and (
+            "argument" in msg or "keyword" in msg
+        )
+        if not mentions_flag and not _call_lacks_deterministic(model):
             raise
         variables = jax.jit(
             lambda r, x: model.init(r, x, train=False)

@@ -237,6 +237,17 @@ class ExperimentAnalysis:
             variables["batch_stats"] = ckpt["batch_stats"]
         return build_model(trial.config), variables
 
+    def export_bundle(self, out_dir: str, **kwargs) -> str:
+        """Freeze the winner into a servable bundle (``serve/export.py``):
+        params + config + feature schema in one self-describing directory,
+        ready for ``dml-tpu serve --bundle <out_dir>``.  Keyword arguments
+        (``trial_id``, ``feature_schema``) pass through."""
+        from distributed_machine_learning_tpu.serve.export import (
+            export_bundle,
+        )
+
+        return export_bundle(self, out_dir, **kwargs)
+
     def dataframe(self):
         """Last-result-per-trial table (pandas if available, else list of dicts)."""
         rows = []

@@ -432,6 +432,11 @@ def train_regressor(
 
     # ---- epoch loop: host-driven so the scheduler can interrupt ------------
     for epoch in range(start_epoch, num_epochs):
+        step_count = (epoch + 1) * steps_per_epoch
+        # The schedule is indexed by OPTIMIZER steps; with accumulation
+        # that is micro-steps // accum, or the logged lr would decay
+        # ``accum`` times faster than the one the optimizer actually used.
+        opt_steps = (epoch + 1) * max(steps_per_epoch // accum, 1)
         # One lock hold per epoch (train + eval): the chip runs one
         # program at a time regardless; on the tunnel this keeps the
         # relay single-streamed (utils/dispatch.py).  The key creation
@@ -443,6 +448,13 @@ def train_regressor(
             epoch_key = jax.random.key(
                 fold_seed(seed, "epoch", epoch), impl=rng_impl
             )
+            # Optax schedules are jnp-based: evaluating one IS a (small)
+            # device dispatch, so it rides inside the hold too — placed
+            # before the t0/c0 stamps so it never counts as epoch execute
+            # time.  Every registered schedule is linear in learning_rate,
+            # so lr x the peak-1.0 shape IS the effective rate on both the
+            # injected and baked paths.
+            lr_now = lr * float(shape_schedule(min(opt_steps, total_steps)))
             c0 = tracker.thread_seconds()
             t0 = _time.time()
             params, opt_state, batch_stats, train_loss = train_epoch(
@@ -459,18 +471,10 @@ def train_regressor(
             # overlap the lock exists to prevent.
             train_loss = float(train_loss)
             metrics = {k: float(v) for k, v in metrics.items()}
-        step_count = (epoch + 1) * steps_per_epoch
-        # The schedule is indexed by OPTIMIZER steps; with accumulation
-        # that is micro-steps // accum, or the logged lr would decay
-        # ``accum`` times faster than the one the optimizer actually used.
-        opt_steps = (epoch + 1) * max(steps_per_epoch // accum, 1)
         record = {
             "epoch": epoch,
             "train_loss": train_loss,
-            # Every registered schedule is linear in learning_rate, so
-            # lr x the peak-1.0 shape IS the effective rate on both the
-            # injected and baked paths.
-            "lr": lr * float(shape_schedule(min(opt_steps, total_steps))),
+            "lr": lr_now,
             "steps": step_count,
             **metrics,
         }

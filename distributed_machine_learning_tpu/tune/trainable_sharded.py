@@ -332,8 +332,20 @@ def train_sharded_regressor(
         # readbacks sync BEFORE release (jit returns futures; an
         # unsynced exit would let the next thread's traffic overlap
         # this epoch still streaming through the relay).
+        step_count = (epoch + 1) * steps_per_epoch
+        # Schedule is indexed by optimizer steps (micro-steps // accum).
+        opt_steps = (epoch + 1) * max(steps_per_epoch // accum, 1)
         with dispatch_lock():
             epoch_key = jax.random.key(fold_seed(seed, "epoch", epoch))
+            # Optax schedules are jnp-based — evaluating one is a small
+            # device dispatch, so it stays inside the hold (advisor r5:
+            # an unlocked eval per epoch is exactly the concurrent
+            # multi-thread traffic the serialization exists to prevent).
+            lr_now = (
+                lr * float(shape_schedule(min(opt_steps, total_steps)))
+                if injected
+                else float(schedule(min(opt_steps, total_steps)))
+            )
             xb = jax.device_put(
                 x_np[perm].reshape(
                     num_batches, global_batch, *x_np.shape[1:]
@@ -352,15 +364,10 @@ def train_sharded_regressor(
             metrics = evaluate(params, batch_stats, xv, yv, mask)
             train_loss = float(train_loss)
             metrics = {k: float(v) for k, v in metrics.items()}
-        step_count = (epoch + 1) * steps_per_epoch
-        # Schedule is indexed by optimizer steps (micro-steps // accum).
-        opt_steps = (epoch + 1) * max(steps_per_epoch // accum, 1)
         record = {
             "epoch": epoch,
             "train_loss": train_loss,
-            "lr": (lr * float(shape_schedule(min(opt_steps, total_steps)))
-                   if injected
-                   else float(schedule(min(opt_steps, total_steps)))),
+            "lr": lr_now,
             "steps": step_count,
             "num_devices": len(devices),
             **metrics,

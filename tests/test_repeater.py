@@ -155,10 +155,20 @@ def test_repeater_composes_with_points_to_evaluate(tmp_results):
     xs = [t.config["x"] for t in analysis.trials]
     assert xs[0] == 0.5 and xs[1] == 0.5  # the point ran `repeat` times
     # Inner saw one mean per group, each matching that group's config.
+    # Key by the deterministic group ids, NOT arrival order: trials run as
+    # concurrent threads, so on a loaded machine a later group's repeats
+    # can both finish (and dispatch their mean) before group 0's — the
+    # completion LIST order is thread-finish order by design.  Asserting
+    # ``completed[0]`` was the point group made this test fail under full-
+    # suite load while passing alone.
     assert len(inner.completed) == 3
-    for (tid, cfg, result), g in zip(inner.completed, range(3)):
+    by_tid = {tid: (cfg, result) for tid, cfg, result in inner.completed}
+    assert set(by_tid) == {f"repeat_group_{g:05d}" for g in range(3)}
+    for cfg, result in by_tid.values():
         assert result["loss"] == pytest.approx((cfg["x"] - 0.25) ** 2)
-    assert inner.completed[0][1]["x"] == 0.5
+    # Group 0 IS the warm-start point (id alignment holds through the
+    # Repeater-outside/WarmStart-inside composition).
+    assert by_tid["repeat_group_00000"][0]["x"] == 0.5
 
 
 def test_repeater_metric_override_through_warmstart():

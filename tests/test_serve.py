@@ -1,0 +1,405 @@
+"""serve/: export round-trip, shape bucketing, micro-batching, replicas,
+and the HTTP front end — the checkpoint -> compiled replicas -> request
+loop pipeline, end to end on CPU virtual devices."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu import serve, tune
+from distributed_machine_learning_tpu.data import dummy_regression_data
+
+
+@pytest.fixture(scope="module")
+def experiment(tmp_path_factory):
+    """One tiny finished experiment (2 trials, checkpointed) shared by the
+    export/serving tests; returns (analysis, val_data)."""
+    tmp = str(tmp_path_factory.mktemp("serve_exp"))
+    train, val = dummy_regression_data(
+        num_samples=96, seq_len=6, num_features=4, seed=7
+    )
+    analysis = tune.run(
+        tune.with_parameters(
+            tune.train_regressor, train_data=train, val_data=val
+        ),
+        {"model": "mlp", "hidden_sizes": [16],
+         "learning_rate": tune.loguniform(1e-3, 1e-2),
+         "num_epochs": 2, "batch_size": 32, "seed": 5},
+        metric="validation_loss", mode="min", num_samples=2,
+        storage_path=tmp, name="serve_src", verbose=0,
+    )
+    return analysis, val
+
+
+@pytest.fixture(scope="module")
+def bundle_dir(experiment, tmp_path_factory):
+    analysis, _ = experiment
+    out = str(tmp_path_factory.mktemp("bundles") / "winner")
+    serve.export_bundle(analysis, out)
+    return out
+
+
+def _direct_apply(model, variables, x, bucket):
+    """The engine's own program shape (padded to ``bucket``, jitted) over
+    pristine variables — the reference output a bundle round-trip must
+    reproduce bit-for-bit."""
+    pad = bucket - x.shape[0]
+    xp = np.concatenate(
+        [x, np.zeros((pad, *x.shape[1:]), x.dtype)]
+    ) if pad else x
+    out = jax.jit(
+        lambda v, b: model.apply(v, b, deterministic=True)
+    )(variables, xp)
+    return np.asarray(out)[: x.shape[0]]
+
+
+# --------------------------------------------------------------------------
+# export
+# --------------------------------------------------------------------------
+
+
+def test_export_round_trip_bit_identical(experiment, bundle_dir):
+    """export -> load -> predict reproduces the checkpointed model exactly:
+    the serialized params drive the same compiled program to bit-identical
+    outputs (and stay allclose to the eager forward pass, which XLA fusion
+    keeps only ulp-close)."""
+    analysis, val = experiment
+    bundle = serve.load_bundle(bundle_dir)
+    engine = serve.InferenceEngine(bundle, max_bucket=32)
+    x = np.asarray(val.x[:5], np.float32)
+    preds = engine.predict(x)
+
+    model, variables = analysis.best_model()
+    direct = _direct_apply(model, variables, x, engine.bucket_for(5))
+    assert np.array_equal(preds, direct)  # not one bit of drift
+    eager = np.asarray(model.apply(variables, x, deterministic=True))
+    np.testing.assert_allclose(preds, eager, rtol=1e-5, atol=1e-6)
+
+
+def test_export_manifest_is_self_describing(experiment, bundle_dir):
+    analysis, _ = experiment
+    bundle = serve.load_bundle(bundle_dir)
+    m = bundle.manifest
+    assert m["bundle_version"] == serve.BUNDLE_VERSION
+    assert m["metric"] == "validation_loss" and m["mode"] == "min"
+    assert m["config"] == {
+        k: v for k, v in analysis.best_config.items() if k != "mesh"
+    }
+    assert m["source"]["trial_id"] == analysis.best_trial.trial_id
+    # Feature contract from data/features.py rides along for clients.
+    from distributed_machine_learning_tpu.data import features as F
+
+    assert bundle.feature_names == list(F.features)
+    assert m["features"]["label"] == F.LABEL_COLUMN
+
+
+def test_export_from_directory_matches_live_export(
+    experiment, bundle_dir, tmp_path
+):
+    """The offline path (experiment dir only, objective read from
+    experiment_state.json) serves the same winner as the live analysis."""
+    analysis, val = experiment
+    out = str(tmp_path / "from_dir")
+    serve.export_bundle(analysis.root, out)
+    x = np.asarray(val.x[:4], np.float32)
+    a = serve.InferenceEngine(serve.load_bundle(out), max_bucket=8).predict(x)
+    b = serve.InferenceEngine(
+        serve.load_bundle(bundle_dir), max_bucket=8
+    ).predict(x)
+    assert np.array_equal(a, b)
+
+
+def test_analysis_export_bundle_method(experiment, tmp_path):
+    """The tune-side hook: analysis.export_bundle is the one-call path
+    from a finished sweep to a servable directory."""
+    analysis, _ = experiment
+    out = str(tmp_path / "via_method")
+    assert analysis.export_bundle(out) == out
+    bundle = serve.load_bundle(out)
+    assert (
+        bundle.manifest["source"]["trial_id"]
+        == analysis.best_trial.trial_id
+    )
+
+
+def test_export_errors(experiment, tmp_path):
+    analysis, _ = experiment
+    with pytest.raises(ValueError, match="no trial 'nope'"):
+        serve.export_bundle(analysis, str(tmp_path / "x"), trial_id="nope")
+    with pytest.raises(FileNotFoundError, match="not a bundle"):
+        serve.load_bundle(str(tmp_path / "empty"))
+
+
+# --------------------------------------------------------------------------
+# engine: shape bucketing
+# --------------------------------------------------------------------------
+
+
+def test_engine_bucket_reuse_zero_new_programs(bundle_dir, experiment):
+    """A second request at a NEW batch size inside the same bucket runs the
+    already-compiled program — 0 new programs, counted as a hit."""
+    _, val = experiment
+    engine = serve.InferenceEngine(serve.load_bundle(bundle_dir), max_bucket=32)
+    x = np.asarray(val.x, np.float32)
+    engine.predict(x[:5])  # bucket 8
+    assert engine.num_programs == 1
+    before_hits = engine.program_stats()["program_hits"]
+    out7 = engine.predict(x[:7])  # new size, same bucket
+    assert engine.num_programs == 1
+    assert engine.program_stats()["program_hits"] == before_hits + 1
+    assert out7.shape[0] == 7
+    engine.predict(x[:9])  # crosses into bucket 16
+    assert engine.num_programs == 2
+
+
+def test_engine_oversize_request_chunks(bundle_dir, experiment):
+    """Requests beyond the top bucket are answered in top-bucket chunks and
+    stitched back in order."""
+    _, val = experiment
+    engine = serve.InferenceEngine(serve.load_bundle(bundle_dir), max_bucket=8)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((20, *val.x.shape[1:])).astype(np.float32)
+    out = engine.predict(x)
+    assert out.shape[0] == 20
+    assert engine.num_programs <= 2  # the 8-bucket + one remainder bucket
+    ref = np.concatenate([engine.predict(x[i: i + 8]) for i in (0, 8, 16)])
+    assert np.array_equal(out, ref)
+
+
+def test_engine_warmup_precompiles_grid(bundle_dir, experiment):
+    _, val = experiment
+    engine = serve.InferenceEngine(serve.load_bundle(bundle_dir), max_bucket=16)
+    stats = engine.warmup(np.asarray(val.x[:1], np.float32))
+    assert stats["programs"] == len(engine.buckets)
+    n = engine.num_programs
+    for size in (1, 3, 9, 16, 11):
+        engine.predict(np.asarray(val.x[:size], np.float32))
+    assert engine.num_programs == n  # warm grid absorbed every size
+
+
+# --------------------------------------------------------------------------
+# batcher: flush policies
+# --------------------------------------------------------------------------
+
+
+def test_batcher_size_trigger():
+    seen = []
+
+    def infer(x):
+        seen.append(x.shape[0])
+        return x.sum(axis=1)
+
+    b = serve.MicroBatcher(infer, max_batch_size=8, max_latency_ms=10_000)
+    futs = [b.submit(np.ones((2, 3), np.float32)) for _ in range(4)]
+    for f in futs:
+        assert f.result(timeout=5.0).shape == (2,)
+    b.stop()
+    # 8 rows hit the cap -> ONE size-triggered flush, no latency wait.
+    assert seen == [8]
+    stats = b.stats.to_dict(8)
+    assert stats["size_flushes"] == 1 and stats["latency_flushes"] == 0
+    assert stats["batch_fill_ratio"] == 1.0
+
+
+def test_batcher_latency_trigger():
+    seen = []
+
+    def infer(x):
+        seen.append(x.shape[0])
+        return x * 2
+
+    b = serve.MicroBatcher(infer, max_batch_size=1024, max_latency_ms=30)
+    t0 = time.time()
+    fut = b.submit(np.ones((3, 2), np.float32))
+    out = fut.result(timeout=5.0)
+    waited = time.time() - t0
+    b.stop()
+    assert np.array_equal(out, np.full((3, 2), 2.0, np.float32))
+    assert seen == [3]            # partial batch flushed by the deadline
+    assert waited >= 0.025        # ... not before it
+    assert b.stats.to_dict(1024)["latency_flushes"] == 1
+
+
+def test_batcher_error_fails_batch_not_worker():
+    calls = []
+
+    def infer(x):
+        calls.append(x.shape[0])
+        if len(calls) == 1:
+            raise RuntimeError("poisoned batch")
+        return x
+
+    b = serve.MicroBatcher(infer, max_batch_size=4, max_latency_ms=5)
+    bad = b.submit(np.ones((4, 1), np.float32))
+    with pytest.raises(RuntimeError, match="poisoned"):
+        bad.result(timeout=5.0)
+    good = b.submit(np.ones((4, 1), np.float32))
+    assert good.result(timeout=5.0).shape == (4, 1)  # worker survived
+    b.stop()
+
+
+def test_batcher_never_splits_a_request():
+    seen = []
+
+    def infer(x):
+        seen.append(x.shape[0])
+        return x
+
+    b = serve.MicroBatcher(infer, max_batch_size=4, max_latency_ms=20)
+    f1 = b.submit(np.ones((3, 1), np.float32))
+    f2 = b.submit(np.ones((3, 1), np.float32))
+    f1.result(timeout=5.0), f2.result(timeout=5.0)
+    b.stop()
+    # 3+3 > cap: the second request waits for the next flush rather than
+    # having 1 of its rows ride along.
+    assert seen == [3, 3]
+
+
+# --------------------------------------------------------------------------
+# replicas: round-robin + failover + restart
+# --------------------------------------------------------------------------
+
+
+def test_replica_failover_and_restart(bundle_dir, experiment):
+    _, val = experiment
+    bundle = serve.load_bundle(bundle_dir)
+    rs = serve.ReplicaSet(
+        bundle, num_replicas=2, max_batch_size=8, max_latency_ms=2,
+        max_bucket=8, monitor_interval_s=0.1,
+    )
+    try:
+        x = np.asarray(val.x[:3], np.float32)
+        baseline = rs.predict(x)
+        rs.kill(0)
+        assert rs.num_healthy() == 1
+        # Dispatch skips the dead replica: every request still answers,
+        # identically.
+        for _ in range(4):
+            assert np.array_equal(rs.predict(x), baseline)
+        deadline = time.time() + 5.0
+        while rs.num_healthy() < 2 and time.time() < deadline:
+            time.sleep(0.05)
+        assert rs.num_healthy() == 2  # monitor restarted the dead replica
+        assert rs.restarts >= 1
+        assert np.array_equal(rs.predict(x), baseline)
+    finally:
+        rs.close()
+
+
+def test_replica_set_rejects_when_all_dead(bundle_dir):
+    bundle = serve.load_bundle(bundle_dir)
+    rs = serve.ReplicaSet(bundle, num_replicas=1, restart=False,
+                          max_bucket=8)
+    try:
+        rs.kill(0)
+        with pytest.raises(RuntimeError, match="no healthy replicas"):
+            rs.submit(np.zeros((1, 6, 4), np.float32))
+    finally:
+        rs.close()
+
+
+# --------------------------------------------------------------------------
+# HTTP server
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def server(bundle_dir, experiment, tmp_path):
+    _, val = experiment
+    srv = serve.PredictionServer(
+        serve.load_bundle(bundle_dir), port=0, num_replicas=2,
+        max_batch_size=8, max_latency_ms=2, max_bucket=16,
+        tb_logdir=str(tmp_path / "tb"),
+    )
+    srv.warmup(np.asarray(val.x[:1], np.float32))
+    host, port = srv.start()
+    yield srv, f"http://{host}:{port}", val
+    srv.close()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as resp:
+        return json.loads(resp.read())
+
+
+def test_server_predict_healthz_metrics(server):
+    srv, base, val = server
+    x = np.asarray(val.x[:5], np.float32)
+    out = _post(f"{base}/predict", {"instances": x.tolist()})
+    direct = srv.replicas.replicas[0].engine.predict(x)
+    assert np.array_equal(
+        np.asarray(out["predictions"], np.float32), direct
+    )
+    assert out["latency_ms"] >= 0
+
+    health = _get(f"{base}/healthz")
+    assert health["status"] == "ok" and len(health["replicas"]) == 2
+
+    for _ in range(10):
+        _post(f"{base}/predict", {"instances": x.tolist()})
+    m = _get(f"{base}/metrics")
+    assert m["requests_total"] == 11
+    assert m["rows_total"] == 55
+    assert m["latency_ms_p99"] >= m["latency_ms_p50"] > 0
+    assert 0 < m["batcher_batch_fill_ratio"] <= 1.0
+    # The acceptance counter: warmup compiled the grid, traffic added none.
+    assert m["compile"]["new_programs_since_warmup"] == 0
+    # The same scalars stream to TensorBoard (utils/tensorboard round-trip).
+    from distributed_machine_learning_tpu.utils.tensorboard import read_events
+
+    srv._tb._writer.flush()
+    events = read_events(srv._tb._writer.path)
+    tags = {t for ev in events for t in ev["scalars"]}
+    assert {"serve/latency_ms_p50", "serve/requests_total"} <= tags
+
+
+def test_server_bad_requests(server):
+    _, base, _ = server
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(f"{base}/predict", {"rows": [1, 2]})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(f"{base}/nope")
+    assert e.value.code == 404
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+
+def test_cli_export_bundle(experiment, tmp_path, capsys):
+    from distributed_machine_learning_tpu.__main__ import main
+
+    analysis, _ = experiment
+    out = str(tmp_path / "cli_bundle")
+    main(["export-bundle", analysis.root, out])
+    assert "exported best trial" in capsys.readouterr().out
+    bundle = serve.load_bundle(out)
+    assert (
+        bundle.manifest["source"]["trial_id"]
+        == analysis.best_trial.trial_id
+    )
+
+
+def test_cli_serve_rejects_missing_bundle(tmp_path, capsys):
+    from distributed_machine_learning_tpu.__main__ import main
+
+    with pytest.raises(SystemExit) as e:
+        main(["serve", "--bundle", str(tmp_path / "nope")])
+    assert e.value.code == 1
+    assert "not a bundle" in capsys.readouterr().err
