@@ -1,0 +1,274 @@
+"""Deterministic fault injection: the harness that proves recovery works.
+
+The seed paper's value proposition is trial-level fault isolation — work
+Ray owned there and this framework owns natively (per-trial retry in
+``tune/runner.py``, atomic writes in ``tune/storage.py``, replica restart
+in ``serve/replica.py``).  None of that machinery is trustworthy until it
+has been exercised against real failure shapes: preempted writes,
+corrupted checkpoint bytes, flaky shared storage, replicas dying under
+traffic.  This module injects exactly those faults, **deterministically**
+(seeded, independent of thread timing), at three narrow choke points:
+
+* **storage** — ``FaultyStorage`` wraps any ``StorageBackend``
+  (installed process-wide via :func:`activate`, which hooks
+  ``tune.storage.get_storage`` INSIDE its retry layer, so injected
+  transient errors are absorbed by the same retries real ones are);
+* **trial executors** — both executors consult the active plan at each
+  report boundary and raise :class:`InjectedTrialCrash`, which follows the
+  ordinary error path (retry budget, checkpoint restore, device release);
+* **serve** — ``ReplicaSet`` polls the plan per dispatched request and
+  hard-kills the scheduled replica, exercising failover, monitor restart,
+  and the circuit breaker.
+
+Determinism: probabilistic decisions are a pure hash of
+``(seed, op, key, n)`` where ``n`` is a per-``(op, key)`` call counter —
+each path's fault sequence is fixed by the seed regardless of how threads
+interleave across paths.  Scheduled faults (trial crashes, replica kills)
+fire exactly once.  Every injection increments a named counter
+(:meth:`FaultPlan.snapshot`), so tests and ``/metrics`` can assert the
+faults actually happened.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from distributed_machine_learning_tpu.tune import storage as storage_lib
+
+
+class InjectedFault(Exception):
+    """Base class for every chaos-injected failure (marker for tests)."""
+
+
+class InjectedIOError(InjectedFault, IOError):
+    """Transient storage fault.  Subclasses IOError/OSError so the retry
+    policy and existing error handling treat it exactly like the real
+    thing."""
+
+
+class InjectedTrialCrash(InjectedFault, RuntimeError):
+    """A trial killed at a scheduled epoch (preemption stand-in)."""
+
+
+def _hash_fraction(*parts) -> float:
+    """Uniform [0, 1) value from a stable hash of the parts."""
+    h = hashlib.sha256("/".join(str(p) for p in parts).encode()).digest()
+    return int.from_bytes(h[:8], "little") / 2**64
+
+
+class FaultPlan:
+    """A seeded schedule of faults.
+
+    Probabilistic faults (rates in [0, 1], decided per call as described in
+    the module docstring):
+
+    * ``write_error_rate`` / ``read_error_rate`` — raise
+      :class:`InjectedIOError` before the backend runs (transient: the
+      retry's next attempt re-rolls).
+    * ``slow_rate`` / ``slow_s`` — sleep ``slow_s`` before the operation
+      (a degraded-storage stall; keep it <= 0.2s in CI-tier tests).
+
+    Scheduled faults (each fires exactly once):
+
+    * ``corrupt_path_substrings`` — the first write whose path contains
+      each substring has its payload bit-flipped ON DISK (the manifest
+      checksum is computed upstream, so restore detects the damage).
+    * ``trial_crashes`` — ``(trial_id, training_iteration)`` pairs; the
+      executor raises :class:`InjectedTrialCrash` at that report boundary.
+    * ``replica_kills`` — ``(request_index, replica_idx)`` pairs; the
+      ReplicaSet kills that replica when its dispatch counter reaches the
+      index (1-based: ``(10, 0)`` kills replica 0 at the 10th request).
+      ``replica_idx=-1`` kills whichever replica is serving that request —
+      the deterministic way to fail an in-flight request.
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        *,
+        write_error_rate: float = 0.0,
+        read_error_rate: float = 0.0,
+        slow_rate: float = 0.0,
+        slow_s: float = 0.02,
+        corrupt_path_substrings: Sequence[str] = (),
+        trial_crashes: Iterable[Tuple[str, int]] = (),
+        replica_kills: Iterable[Tuple[int, int]] = (),
+    ):
+        self.seed = seed
+        self.write_error_rate = float(write_error_rate)
+        self.read_error_rate = float(read_error_rate)
+        self.slow_rate = float(slow_rate)
+        self.slow_s = float(slow_s)
+        self._corrupt_pending: List[str] = list(corrupt_path_substrings)
+        self._trial_crashes = {(str(t), int(i)) for t, i in trial_crashes}
+        self._kills = sorted(
+            ((int(n), int(r)) for n, r in replica_kills), reverse=True
+        )
+        self._lock = threading.Lock()
+        self._op_counts: Dict[Tuple[str, str], int] = {}
+        self._counters: Dict[str, int] = {}
+        self._submit_count = 0
+        self.corrupted_paths: List[str] = []
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _next_index(self, op: str, key: str) -> int:
+        with self._lock:
+            n = self._op_counts.get((op, key), 0)
+            self._op_counts[(op, key)] = n + 1
+            return n
+
+    def _count(self, name: str) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + 1
+
+    def snapshot(self) -> Dict[str, int]:
+        """Copy of the injected-fault counters (what actually fired)."""
+        with self._lock:
+            return dict(self._counters)
+
+    # -- storage faults ------------------------------------------------------
+
+    def _roll(self, op: str, key: str, rate: float) -> bool:
+        if rate <= 0.0:
+            return False
+        n = self._next_index(op, key)
+        return _hash_fraction(self.seed, op, key, n) < rate
+
+    def on_storage_op(self, op: str, path: str) -> None:
+        """Called by FaultyStorage before the real backend op; may sleep
+        and/or raise InjectedIOError."""
+        if self._roll("slow", f"{op}:{path}", self.slow_rate):
+            self._count("storage_slow")
+            time.sleep(self.slow_s)
+        rate = (self.write_error_rate if op == "write"
+                else self.read_error_rate if op == "read" else 0.0)
+        if self._roll(op, path, rate):
+            self._count(f"storage_{op}_errors")
+            raise InjectedIOError(
+                f"injected transient {op} fault on {path}"
+            )
+
+    def corrupt_write(self, path: str, data: bytes) -> bytes:
+        """Return ``data``, bit-flipped once per scheduled path substring."""
+        with self._lock:
+            hit = next(
+                (s for s in self._corrupt_pending if s in path), None
+            )
+            if hit is None:
+                return data
+            self._corrupt_pending.remove(hit)
+            self.corrupted_paths.append(path)
+            self._counters["storage_corruptions"] = (
+                self._counters.get("storage_corruptions", 0) + 1
+            )
+        return corrupt_bytes(data)
+
+    # -- trial faults --------------------------------------------------------
+
+    def maybe_crash_trial(self, trial_id: str, iteration: int) -> None:
+        """Raise InjectedTrialCrash if (trial_id, iteration) is scheduled.
+        Fires once — the retried incarnation passes the same boundary."""
+        key = (str(trial_id), int(iteration))
+        with self._lock:
+            if key not in self._trial_crashes:
+                return
+            self._trial_crashes.discard(key)
+            self._counters["trial_crashes"] = (
+                self._counters.get("trial_crashes", 0) + 1
+            )
+        raise InjectedTrialCrash(
+            f"injected crash: {trial_id} at iteration {iteration}"
+        )
+
+    # -- serve faults --------------------------------------------------------
+
+    def poll_replica_kill(self) -> Optional[int]:
+        """Advance the dispatch counter; return a replica index to kill when
+        a scheduled kill comes due (else None)."""
+        with self._lock:
+            self._submit_count += 1
+            if self._kills and self._submit_count >= self._kills[-1][0]:
+                _, idx = self._kills.pop()
+                self._counters["replica_kills"] = (
+                    self._counters.get("replica_kills", 0) + 1
+                )
+                return idx
+        return None
+
+
+def corrupt_bytes(data: bytes, flip_every: int = 97) -> bytes:
+    """Deterministically damage a payload (bit-flip a stride of bytes) —
+    shared by the plan and by tests that corrupt stored files directly."""
+    buf = bytearray(data)
+    for i in range(0, len(buf), flip_every):
+        buf[i] ^= 0xFF
+    return bytes(buf)
+
+
+class FaultyStorage(storage_lib.StorageBackend):
+    """Wraps a real backend; consults the plan before every operation."""
+
+    def __init__(self, inner: storage_lib.StorageBackend, plan: FaultPlan):
+        self.inner = inner
+        self.plan = plan
+
+    def write_bytes(self, path: str, data: bytes) -> str:
+        self.plan.on_storage_op("write", path)
+        return self.inner.write_bytes(path, self.plan.corrupt_write(path, data))
+
+    def read_bytes(self, path: str) -> Optional[bytes]:
+        self.plan.on_storage_op("read", path)
+        return self.inner.read_bytes(path)
+
+    def exists(self, path: str) -> bool:
+        self.plan.on_storage_op("exists", path)
+        return self.inner.exists(path)
+
+    def listdir(self, path: str) -> List[str]:
+        self.plan.on_storage_op("listdir", path)
+        return self.inner.listdir(path)
+
+    def delete(self, path: str) -> None:
+        self.plan.on_storage_op("delete", path)
+        return self.inner.delete(path)
+
+    def join(self, *parts: str) -> str:
+        return self.inner.join(*parts)
+
+
+# -- process-wide activation --------------------------------------------------
+
+_active_plan: Optional[FaultPlan] = None
+
+
+def activate(plan: FaultPlan) -> None:
+    """Install ``plan`` process-wide: storage faults via the get_storage
+    fault wrapper, trial/serve faults via :func:`active_plan` polling."""
+    global _active_plan
+    _active_plan = plan
+    storage_lib.set_fault_wrapper(lambda backend: FaultyStorage(backend, plan))
+
+
+def deactivate() -> None:
+    global _active_plan
+    _active_plan = None
+    storage_lib.set_fault_wrapper(None)
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _active_plan
+
+
+@contextlib.contextmanager
+def active(plan: FaultPlan):
+    """``with chaos.active(FaultPlan(...)):`` — scoped activation."""
+    activate(plan)
+    try:
+        yield plan
+    finally:
+        deactivate()

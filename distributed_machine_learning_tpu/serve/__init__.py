@@ -34,6 +34,8 @@ from distributed_machine_learning_tpu.serve.export import (
 )
 from distributed_machine_learning_tpu.serve.metrics import ServeMetrics
 from distributed_machine_learning_tpu.serve.replica import (
+    AllReplicasOpen,
+    CircuitBreaker,
     Replica,
     ReplicaSet,
     replica_process_env,
@@ -41,8 +43,10 @@ from distributed_machine_learning_tpu.serve.replica import (
 from distributed_machine_learning_tpu.serve.server import PredictionServer
 
 __all__ = [
+    "AllReplicasOpen",
     "BUNDLE_VERSION",
     "BatcherStats",
+    "CircuitBreaker",
     "InferenceEngine",
     "MicroBatcher",
     "PredictionServer",

@@ -37,6 +37,7 @@ class ServeMetrics:
         self.requests = 0
         self.rows = 0
         self.errors = 0
+        self.rejected = 0
 
     def observe(self, latency_s: float, rows: int):
         with self._lock:
@@ -48,6 +49,12 @@ class ServeMetrics:
         with self._lock:
             self.errors += 1
 
+    def observe_rejected(self):
+        """A load-shed 503 (all breakers open) — counted apart from errors
+        so shedding under chaos is distinguishable from failing."""
+        with self._lock:
+            self.rejected += 1
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             lat = sorted(self._latencies_ms)
@@ -57,6 +64,7 @@ class ServeMetrics:
                 "requests_total": self.requests,
                 "rows_total": self.rows,
                 "errors_total": self.errors,
+                "rejected_total": self.rejected,
                 "requests_per_s": round(self.requests / uptime, 2),
                 "rows_per_s": round(self.rows / uptime, 2),
                 "latency_ms_p50": round(percentile(lat, 50.0), 3),

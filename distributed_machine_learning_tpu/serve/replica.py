@@ -7,6 +7,16 @@ device, the replica's engine pins its programs there via
 a monitor thread restarts any replica whose worker dies — traffic keeps
 flowing on the survivors in the meantime.
 
+Failure hardening: each replica slot carries a :class:`CircuitBreaker`
+(closed → open after N consecutive failures → half-open probe after a
+cool-down → closed on probe success).  A replica that is alive but
+*failing* — poisoned engine state, a wedged device — would otherwise keep
+receiving its round-robin share and fail every request it takes; the
+breaker quarantines it and sends single probes instead.  When every
+dispatchable replica is open, ``submit`` raises :class:`AllReplicasOpen`
+carrying ``retry_after_s`` so the HTTP front end can answer 503 +
+``Retry-After`` instead of timing out request by request.
+
 For one-replica-per-process deployments (the hard isolation the process
 executor gives trials), :func:`replica_process_env` builds the same
 ``TPU_VISIBLE_CHIPS`` environment the executor uses, so a replica child
@@ -41,6 +51,127 @@ def replica_process_env(devices: Sequence) -> Dict[str, str]:
         env["TPU_VISIBLE_CHIPS"] = visible
         env["TPU_VISIBLE_DEVICES"] = visible
     return env
+
+
+class AllReplicasOpen(RuntimeError):
+    """Every dispatchable replica's breaker is open — back off and retry."""
+
+    def __init__(self, retry_after_s: float):
+        super().__init__(
+            f"all replicas quarantined by circuit breaker; retry in "
+            f"{retry_after_s:.2f}s"
+        )
+        self.retry_after_s = retry_after_s
+
+
+class CircuitBreaker:
+    """Per-replica closed/open/half-open breaker (thread-safe).
+
+    * **closed**: requests flow; ``failure_threshold`` CONSECUTIVE
+      failures trip it open (one success resets the streak).
+    * **open**: requests are refused for ``recovery_s``; the replica
+      cools down (or the monitor restarts it) without taking traffic.
+    * **half-open**: after the cool-down, up to ``half_open_probes``
+      requests are let through at a time; a probe success closes the
+      breaker, a probe failure re-opens it for another ``recovery_s``.
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(self, failure_threshold: int = 3, recovery_s: float = 1.0,
+                 half_open_probes: int = 1):
+        if failure_threshold < 1:
+            raise ValueError(
+                f"failure_threshold must be >= 1: {failure_threshold}"
+            )
+        self.failure_threshold = int(failure_threshold)
+        self.recovery_s = float(recovery_s)
+        self.half_open_probes = int(half_open_probes)
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probes_in_flight = 0
+        self.failures_total = 0
+        self.successes_total = 0
+        self.opens_total = 0
+        self.probes_total = 0
+
+    def _trip(self, now: float):
+        self._state = self.OPEN
+        self._opened_at = now
+        self._probes_in_flight = 0
+        self.opens_total += 1
+
+    def allow(self) -> bool:
+        """May a request be dispatched now?  In half-open, a True answer
+        consumes a probe slot (released by the request's outcome)."""
+        now = time.time()
+        with self._lock:
+            if self._state == self.OPEN:
+                if now - self._opened_at < self.recovery_s:
+                    return False
+                self._state = self.HALF_OPEN
+                self._probes_in_flight = 0
+            if self._state == self.HALF_OPEN:
+                if self._probes_in_flight >= self.half_open_probes:
+                    return False
+                self._probes_in_flight += 1
+                self.probes_total += 1
+                return True
+            return True
+
+    def record_success(self):
+        with self._lock:
+            self.successes_total += 1
+            self._consecutive_failures = 0
+            if self._state == self.HALF_OPEN:
+                self._probes_in_flight = max(self._probes_in_flight - 1, 0)
+                self._state = self.CLOSED
+
+    def record_failure(self):
+        now = time.time()
+        with self._lock:
+            self.failures_total += 1
+            self._consecutive_failures += 1
+            if self._state == self.HALF_OPEN:
+                self._trip(now)
+            elif (
+                self._state == self.CLOSED
+                and self._consecutive_failures >= self.failure_threshold
+            ):
+                self._trip(now)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            # Report the pending transition too: an expired cool-down IS
+            # half-open to the next caller.
+            if (
+                self._state == self.OPEN
+                and time.time() - self._opened_at >= self.recovery_s
+            ):
+                return self.HALF_OPEN
+            return self._state
+
+    def retry_after_s(self) -> float:
+        """Seconds until this breaker would admit a probe (0 if it already
+        would)."""
+        with self._lock:
+            if self._state != self.OPEN:
+                return 0.0
+            return max(self.recovery_s - (time.time() - self._opened_at), 0.0)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "state": self.state,
+            "failures_total": self.failures_total,
+            "successes_total": self.successes_total,
+            "opens_total": self.opens_total,
+            "probes_total": self.probes_total,
+        }
 
 
 class Replica:
@@ -119,6 +250,9 @@ class ReplicaSet:
         max_bucket: int = 256,
         restart: bool = True,
         monitor_interval_s: float = 0.25,
+        breaker_failure_threshold: int = 3,
+        breaker_recovery_s: float = 1.0,
+        fault_plan=None,
     ):
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1: {num_replicas}")
@@ -128,6 +262,19 @@ class ReplicaSet:
             max_latency_ms=max_latency_ms,
             max_bucket=max_bucket,
         )
+        # One breaker per SLOT, deliberately surviving monitor restarts: a
+        # crash-looping replica must re-earn traffic through a half-open
+        # probe, not get a clean slate on every respawn.
+        self._breakers = [
+            CircuitBreaker(
+                failure_threshold=breaker_failure_threshold,
+                recovery_s=breaker_recovery_s,
+            )
+            for _ in range(num_replicas)
+        ]
+        # chaos.FaultPlan (or None): polled once per dispatched request so
+        # scheduled replica kills land deterministically mid-traffic.
+        self._fault_plan = fault_plan
         self._dm = DeviceManager(devices)
         self._leases = []
         self._devices = []
@@ -162,17 +309,60 @@ class ReplicaSet:
     # -- dispatch ------------------------------------------------------------
 
     def submit(self, x):
-        """Round-robin to the next healthy replica; a dead replica is
-        skipped (failover) until the monitor restarts it."""
+        """Round-robin to the next healthy replica whose breaker admits the
+        request; dead replicas are skipped (failover) until the monitor
+        restarts them, quarantined ones until their half-open probe
+        succeeds.  Raises :class:`AllReplicasOpen` when only breakers stand
+        in the way (503 + Retry-After upstream), plain RuntimeError when
+        every replica is dead."""
         with self._lock:
             replicas = list(self.replicas)
             start = self._rr
             self._rr = (self._rr + 1) % len(replicas)
+        any_alive = False
         for off in range(len(replicas)):
-            r = replicas[(start + off) % len(replicas)]
-            if r.alive():
-                return r.submit(x)
+            i = (start + off) % len(replicas)
+            r = replicas[i]
+            if not r.alive():
+                continue
+            any_alive = True
+            breaker = self._breakers[i]
+            if not breaker.allow():
+                continue
+            fut = r.submit(x)
+
+            def _outcome(f, breaker=breaker):
+                # Runs on the batcher worker (or inline if already done):
+                # the request's fate is the breaker's signal.
+                try:
+                    failed = f.exception() is not None
+                except BaseException:  # noqa: BLE001 - cancelled counts too
+                    failed = True
+                if failed:
+                    breaker.record_failure()
+                else:
+                    breaker.record_success()
+
+            fut.add_done_callback(_outcome)
+            if self._fault_plan is not None:
+                # Chaos kill switch, polled per dispatched request so
+                # scheduled replica deaths land deterministically
+                # mid-traffic.  Index -1 kills the replica that just took
+                # THIS request (its queued future fails -> the breaker and
+                # failover paths are exercised, the client retries).
+                kill_idx = self._fault_plan.poll_replica_kill()
+                if kill_idx is not None:
+                    self.kill(i if kill_idx < 0 else
+                              kill_idx % len(replicas))
+            return fut
+        if any_alive:
+            raise AllReplicasOpen(self.min_retry_after_s())
         raise RuntimeError("no healthy replicas")
+
+    def min_retry_after_s(self) -> float:
+        """Soonest moment any breaker would admit a probe (Retry-After)."""
+        waits = [b.retry_after_s() for b in self._breakers]
+        return min(waits) if waits else 0.0
 
     def predict(self, x, timeout: Optional[float] = 30.0) -> np.ndarray:
         return self.submit(x).result(timeout=timeout)
@@ -235,7 +425,22 @@ class ReplicaSet:
     def health(self) -> List[Dict[str, Any]]:
         with self._lock:
             replicas = list(self.replicas)
-        return [r.health() for r in replicas]
+        return [
+            {**r.health(), "breaker": self._breakers[i].state}
+            for i, r in enumerate(replicas)
+        ]
+
+    def breaker_stats(self) -> Dict[str, Any]:
+        """Breaker state + fault counters for ``/metrics``."""
+        per = [b.stats() for b in self._breakers]
+        return {
+            "per_replica": per,
+            "open_replicas": sum(
+                1 for s in per if s["state"] == CircuitBreaker.OPEN
+            ),
+            "opens_total": sum(s["opens_total"] for s in per),
+            "request_failures_total": sum(s["failures_total"] for s in per),
+        }
 
     def num_healthy(self) -> int:
         return sum(1 for h in self.health() if h["alive"])

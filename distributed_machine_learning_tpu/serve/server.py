@@ -18,6 +18,7 @@ Endpoints::
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -30,7 +31,10 @@ from distributed_machine_learning_tpu.serve.metrics import (
     ServeMetrics,
     TensorBoardEmitter,
 )
-from distributed_machine_learning_tpu.serve.replica import ReplicaSet
+from distributed_machine_learning_tpu.serve.replica import (
+    AllReplicasOpen,
+    ReplicaSet,
+)
 
 
 class PredictionServer:
@@ -52,6 +56,9 @@ class PredictionServer:
         max_bucket: int = 256,
         tb_logdir: Optional[str] = None,
         request_timeout_s: float = 30.0,
+        breaker_failure_threshold: int = 3,
+        breaker_recovery_s: float = 1.0,
+        fault_plan=None,
     ):
         self.bundle = bundle
         self.replicas = ReplicaSet(
@@ -60,7 +67,11 @@ class PredictionServer:
             max_batch_size=max_batch_size,
             max_latency_ms=max_latency_ms,
             max_bucket=max_bucket,
+            breaker_failure_threshold=breaker_failure_threshold,
+            breaker_recovery_s=breaker_recovery_s,
+            fault_plan=fault_plan,
         )
+        self._fault_plan = fault_plan
         self.metrics = ServeMetrics()
         self._tb = TensorBoardEmitter(tb_logdir)
         self._timeout_s = request_timeout_s
@@ -106,7 +117,13 @@ class PredictionServer:
             "compile": programs,
             "num_replicas": len(self.replicas.replicas),
             "num_healthy": self.replicas.num_healthy(),
+            "breakers": self.replicas.breaker_stats(),
+            "restarts": self.replicas.restarts,
         }
+        if self._fault_plan is not None:
+            # A chaos soak's injections are observable where the breaker
+            # state is — one endpoint tells the whole failure story.
+            out["injected_faults"] = self._fault_plan.snapshot()
         self._tb.emit(self.metrics, extra={
             "queue_depth": batcher.get("queue_depth", 0),
             "batch_fill_ratio": batcher.get("batch_fill_ratio", 0.0),
@@ -127,11 +144,14 @@ class PredictionServer:
             def log_message(self, *args):  # noqa: D102
                 pass
 
-            def _reply(self, code: int, payload: Dict[str, Any]):
+            def _reply(self, code: int, payload: Dict[str, Any],
+                       headers: Optional[Dict[str, str]] = None):
                 data = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -157,6 +177,18 @@ class PredictionServer:
                 except ValueError as exc:
                     server.metrics.observe_error()
                     self._reply(400, {"error": str(exc)})
+                except AllReplicasOpen as exc:
+                    # Load-shed honestly: every replica is quarantined, so
+                    # tell the client WHEN the first half-open probe opens
+                    # instead of letting it burn its timeout on retries.
+                    server.metrics.observe_rejected()
+                    retry_after = max(int(math.ceil(exc.retry_after_s)), 1)
+                    self._reply(
+                        503,
+                        {"error": str(exc),
+                         "retry_after_s": round(exc.retry_after_s, 3)},
+                        headers={"Retry-After": str(retry_after)},
+                    )
                 except Exception as exc:  # noqa: BLE001 - surface as 503
                     server.metrics.observe_error()
                     self._reply(503, {"error": repr(exc)})

@@ -152,6 +152,43 @@ class DeviceManager:
         return min(busy / (wall_clock_s * len(self.devices)), 1.0)
 
 
+def _rewind_after_fallback(trial: Trial, tree, used_path, used_iteration):
+    """Align a trial's progress bookkeeping with what actually restored.
+
+    When corruption forced ``load_checkpoint_with_fallback`` off the
+    requested restore target (older generation, or nothing at all), the
+    trial's ``restore_base``/checkpoint pointers must rewind with it —
+    otherwise ``training_iteration`` (scheduler rungs, checkpoint
+    numbering) would claim progress the restored state doesn't have.
+    Shared by both executors; runs before the incarnation's first report,
+    so the runner never sees the intermediate state.
+    """
+    if not trial.restore_path:
+        return
+    if tree is None:
+        print(
+            f"[executor] WARNING: no checksum-valid checkpoint for "
+            f"{trial.trial_id} (wanted {trial.restore_path}); restarting "
+            f"from scratch",
+            flush=True,
+        )
+        trial.restore_path = None
+        trial.restore_base = 0
+        trial.latest_checkpoint = None
+        trial.latest_checkpoint_iteration = 0
+    elif used_path != trial.restore_path:
+        print(
+            f"[executor] WARNING: {trial.trial_id} restore fell back "
+            f"{trial.restore_path} -> {used_path} (iteration "
+            f"{used_iteration})",
+            flush=True,
+        )
+        trial.restore_path = used_path
+        trial.restore_base = used_iteration
+        trial.latest_checkpoint = used_path
+        trial.latest_checkpoint_iteration = used_iteration
+
+
 class ResultEvent:
     __slots__ = ("trial", "metrics", "decision", "done", "incarnation")
 
@@ -218,6 +255,17 @@ class ThreadTrialExecutor:
         pending_writes = deque()  # this incarnation's in-flight ckpt paths
 
         def report_fn(metrics: Dict, checkpoint) -> str:
+            # Chaos hook (no-op without an active plan): an injected crash
+            # raises out of session.report inside the trainable and follows
+            # the ordinary error path — retry budget, checkpoint restore,
+            # device release — which is exactly what the harness verifies.
+            from distributed_machine_learning_tpu import chaos
+
+            plan = chaos.active_plan()
+            if plan is not None:
+                plan.maybe_crash_trial(
+                    trial.trial_id, trial.training_iteration + 1
+                )
             metrics.setdefault(
                 "compile_time_s",
                 round(tracker.thread_seconds() - compile_base, 4),
@@ -284,7 +332,11 @@ class ThreadTrialExecutor:
                     flush=True,
                 )
                 return None
-            return ckpt_lib.load_checkpoint(trial.restore_path)
+            tree, used, used_it = ckpt_lib.load_checkpoint_with_fallback(
+                trial.restore_path, self.store.checkpoint_dir(trial),
+            )
+            _rewind_after_fallback(trial, tree, used, used_it)
+            return tree
 
         set_session(Session(trial, report_fn, checkpoint_loader, devices))
         try:
@@ -468,12 +520,23 @@ class ProcessTrialExecutor:
               incarnation: int = 0):
         from distributed_machine_learning_tpu.tune import _process_child as pc
 
+        from distributed_machine_learning_tpu import chaos
+
         try:
             import cloudpickle
 
             restore = None
             if trial.restore_path:
-                restore = ckpt_lib.load_checkpoint(trial.restore_path)
+                # Same corruption fallback as the thread executor — the
+                # parent owns storage, so the child never sees a damaged
+                # checkpoint, only the newest checksum-valid state.
+                restore, used, used_it = (
+                    ckpt_lib.load_checkpoint_with_fallback(
+                        trial.restore_path,
+                        self.store.checkpoint_dir(trial),
+                    )
+                )
+                _rewind_after_fallback(trial, restore, used, used_it)
             pc.write_frame(
                 proc.stdin,
                 {
@@ -491,6 +554,14 @@ class ProcessTrialExecutor:
                 msg = pc.read_frame(proc.stdout)
                 kind = msg[0]
                 if kind == "result":
+                    plan = chaos.active_plan()
+                    if plan is not None:
+                        # Raises InjectedTrialCrash -> the generic error
+                        # path below kills/reaps the child and the runner
+                        # retries within max_failures (chaos harness).
+                        plan.maybe_crash_trial(
+                            trial.trial_id, trial.training_iteration + 1
+                        )
                     metrics, ckpt_bytes = msg[1], msg[2]
                     if ckpt_bytes is not None:
                         count = trial.training_iteration + 1
@@ -510,10 +581,10 @@ class ProcessTrialExecutor:
                 elif kind == "error":
                     self.events.put(("error", trial, msg[1], incarnation))
                     return
-        except (EOFError, OSError):
+        except (EOFError, OSError) as exc:
             reason = getattr(trial, "_kill_reason", None) or (
                 f"trial process died unexpectedly "
-                f"(rc={proc.poll()})"
+                f"(rc={proc.poll()}, {exc!r})"
             )
             self.events.put(("error", trial, reason, incarnation))
         except Exception:  # noqa: BLE001 - e.g. unpicklable trainable

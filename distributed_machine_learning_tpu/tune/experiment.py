@@ -74,8 +74,14 @@ class ExperimentStore:
         return d
 
     def write_params(self, trial: Trial):
-        with open(os.path.join(self.trial_dir(trial), "params.json"), "w") as f:
-            json.dump(_jsonable(trial.config), f, indent=2)
+        from distributed_machine_learning_tpu.tune.storage import retry_call
+
+        def _write():
+            path = os.path.join(self.trial_dir(trial), "params.json")
+            with open(path, "w") as f:
+                json.dump(_jsonable(trial.config), f, indent=2)
+
+        retry_call(_write, key=f"params:{trial.trial_id}")
 
     def append_result(self, trial: Trial, result: Dict[str, Any]):
         f = self._result_files.get(trial.trial_id)
@@ -110,10 +116,19 @@ class ExperimentStore:
         }
         if extra:
             state.update(_jsonable(extra))
-        tmp = os.path.join(self.root, ".state.tmp")
-        with open(tmp, "w") as f:
-            json.dump(state, f, indent=2)
-        os.replace(tmp, os.path.join(self.root, "experiment_state.json"))
+
+        # Retried as one unit (tune.storage policy): the tmp+rename pair is
+        # atomic, so a transient fault anywhere in it re-runs cleanly and a
+        # reader never observes a torn state snapshot.
+        from distributed_machine_learning_tpu.tune.storage import retry_call
+
+        def _write():
+            tmp = os.path.join(self.root, ".state.tmp")
+            with open(tmp, "w") as f:
+                json.dump(state, f, indent=2)
+            os.replace(tmp, os.path.join(self.root, "experiment_state.json"))
+
+        retry_call(_write, key=f"state:{self.root}")
 
     def close(self):
         for f in self._result_files.values():

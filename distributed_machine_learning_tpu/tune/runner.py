@@ -381,19 +381,24 @@ def run(
         # converge to exactly keep_checkpoints_num on disk.
         lifecycle.final_prune()
         utilization = device_mgr.utilization(wall)
+        from distributed_machine_learning_tpu import chaos
         from distributed_machine_learning_tpu.utils import compile_cache as cc
 
+        extra = {
+            "wall_clock_s": wall,
+            "device_utilization": utilization,
+            "compile_time_total_s": round(cc.get_tracker().total_seconds(), 3),
+            "compile_cache_hits": cc.get_tracker().total_cache_hits(),
+            "compile_cache_entries": cc.cache_entry_count(),
+        }
+        plan = chaos.active_plan()
+        if plan is not None:
+            # A chaos run's state snapshot records what was injected, so
+            # "it survived N faults" is a property of the artifact, not of
+            # test logs.
+            extra["injected_faults"] = plan.snapshot()
         try:
-            store.write_state(
-                trials,
-                extra={
-                    "wall_clock_s": wall,
-                    "device_utilization": utilization,
-                    "compile_time_total_s": round(cc.get_tracker().total_seconds(), 3),
-                    "compile_cache_hits": cc.get_tracker().total_cache_hits(),
-                    "compile_cache_entries": cc.cache_entry_count(),
-                },
-            )
+            store.write_state(trials, extra=extra)
             store.close()
         except Exception as exc:  # noqa: BLE001 - callbacks still tear down
             log(f"experiment store teardown failed: {exc!r}")

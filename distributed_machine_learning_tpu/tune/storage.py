@@ -15,15 +15,32 @@ star names GCS explicitly.  This module dispatches on the path scheme:
 Every consumer (checkpoint save/load, retention pruning) goes through
 ``get_storage`` so a ``storage_path='gs://bucket/exp'`` flows end to end
 without any caller branching on scheme.
+
+Failure hardening (chaos.py is the harness that proves it):
+
+* ``get_storage`` composes two wrappers around the scheme backend:
+  an optional **fault wrapper** (installed by ``chaos.activate`` — injects
+  deterministic, seeded IOErrors/corruption/latency for tests) and a
+  **retry wrapper** (``RetryingStorage``: exponential backoff + jitter +
+  a bounded attempt budget for transient I/O faults — shared storage on a
+  pod is exactly the place writes flake).  Order matters: retries sit
+  OUTSIDE the fault layer so an injected transient error is absorbed the
+  same way a real one would be.
+* ``retry_call`` is the same policy as a bare function, used by the
+  experiment store's local JSON writes (state snapshots, params) which
+  bypass the byte-backend interface.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 import posixpath
 import tempfile
 import threading
-from typing import Dict, List, Optional, Tuple
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
 
 
 class StorageBackend:
@@ -176,13 +193,125 @@ class FsspecStorage(StorageBackend):
             self._fs.rm(p)
 
 
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded-budget exponential backoff for transient storage faults.
+
+    ``attempts`` is the TOTAL number of tries (1 = no retry).  Delay before
+    retry k (1-based) is ``base_delay_s * 2**(k-1)`` capped at
+    ``max_delay_s``, plus a deterministic jitter in ``[0, jitter * delay]``
+    derived from the operation key — reproducible under a seeded chaos
+    plan, decorrelated across concurrent writers against real storage.
+    """
+
+    attempts: int = 4
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    jitter: float = 0.5
+    retry_on: Tuple[type, ...] = field(default=(OSError, TimeoutError))
+
+    def delay_for(self, attempt: int, key: str = "") -> float:
+        delay = min(self.base_delay_s * (2 ** attempt), self.max_delay_s)
+        if self.jitter > 0:
+            h = hashlib.sha256(f"{key}/{attempt}".encode()).digest()
+            frac = int.from_bytes(h[:8], "little") / 2**64
+            delay += self.jitter * delay * frac
+        return delay
+
+
+DEFAULT_RETRY_POLICY = RetryPolicy()
+
+# Module-level knobs, both consulted by get_storage on every call:
+# the fault wrapper is chaos.py's injection point; the retry policy is the
+# process-wide default (None disables retries entirely).
+_fault_wrapper: Optional[Callable[[StorageBackend], StorageBackend]] = None
+_default_retry_policy: Optional[RetryPolicy] = DEFAULT_RETRY_POLICY
+
+
+def set_fault_wrapper(
+    wrapper: Optional[Callable[[StorageBackend], StorageBackend]],
+) -> None:
+    """Install (or clear, with None) a backend wrapper applied by
+    ``get_storage`` INSIDE the retry layer — chaos.py's choke point."""
+    global _fault_wrapper
+    _fault_wrapper = wrapper
+
+
+def set_default_retry_policy(policy: Optional[RetryPolicy]) -> None:
+    """Process-wide retry policy for all storage access (None disables)."""
+    global _default_retry_policy
+    _default_retry_policy = policy
+
+
+def retry_call(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
+               key: str = "", log: Optional[Callable[[str], None]] = None,
+               **kwargs):
+    """Run ``fn(*args, **kwargs)`` under ``policy`` (default: the process
+    policy).  Retries only the policy's exception types; the final attempt's
+    error propagates unchanged so callers keep their existing error paths."""
+    policy = policy if policy is not None else _default_retry_policy
+    if policy is None or policy.attempts <= 1:
+        return fn(*args, **kwargs)
+    last_exc: Optional[BaseException] = None
+    for attempt in range(policy.attempts):
+        try:
+            return fn(*args, **kwargs)
+        except policy.retry_on as exc:
+            last_exc = exc
+            if attempt == policy.attempts - 1:
+                raise
+            delay = policy.delay_for(attempt, key)
+            if log is not None:
+                log(
+                    f"transient storage fault (attempt "
+                    f"{attempt + 1}/{policy.attempts}): {exc!r}; retrying "
+                    f"in {delay:.3f}s"
+                )
+            time.sleep(delay)
+    raise last_exc  # pragma: no cover - loop always returns or raises
+
+
+class RetryingStorage(StorageBackend):
+    """Decorator adding the retry policy to every byte operation.
+
+    Wraps any backend (including a chaos ``FaultyStorage``); ``join`` and
+    identity-ish helpers delegate straight through.
+    """
+
+    def __init__(self, inner: StorageBackend,
+                 policy: Optional[RetryPolicy] = None):
+        self.inner = inner
+        self.policy = policy or DEFAULT_RETRY_POLICY
+
+    def _retry(self, op: str, fn: Callable, path: str, *args):
+        return retry_call(fn, path, *args, policy=self.policy,
+                          key=f"{op}:{path}")
+
+    def write_bytes(self, path: str, data: bytes) -> str:
+        return self._retry("write", self.inner.write_bytes, path, data)
+
+    def read_bytes(self, path: str) -> Optional[bytes]:
+        return self._retry("read", self.inner.read_bytes, path)
+
+    def exists(self, path: str) -> bool:
+        return self._retry("exists", self.inner.exists, path)
+
+    def listdir(self, path: str) -> List[str]:
+        return self._retry("listdir", self.inner.listdir, path)
+
+    def delete(self, path: str) -> None:
+        return self._retry("delete", self.inner.delete, path)
+
+    def join(self, *parts: str) -> str:
+        return self.inner.join(*parts)
+
+
 _local = LocalStorage()
 _memory = MemoryStorage()
 _fsspec_cache: Dict[str, FsspecStorage] = {}
 
 
-def get_storage(path: str) -> Tuple[StorageBackend, str]:
-    """Backend + normalized path for ``path``, dispatched on its scheme."""
+def _raw_storage(path: str) -> Tuple[StorageBackend, str]:
     if "://" not in path:
         return _local, path
     scheme, rest = path.split("://", 1)
@@ -194,3 +323,18 @@ def get_storage(path: str) -> Tuple[StorageBackend, str]:
     if backend is None:
         backend = _fsspec_cache[scheme] = FsspecStorage(scheme)
     return backend, path
+
+
+def get_storage(path: str) -> Tuple[StorageBackend, str]:
+    """Backend + normalized path for ``path``, dispatched on its scheme.
+
+    The returned backend is wrapped with the active fault layer (chaos
+    injection, when installed) and the process retry policy, in that order
+    — retries absorb injected transient faults exactly as real ones.
+    """
+    backend, p = _raw_storage(path)
+    if _fault_wrapper is not None:
+        backend = _fault_wrapper(backend)
+    if _default_retry_policy is not None:
+        backend = RetryingStorage(backend, _default_retry_policy)
+    return backend, p

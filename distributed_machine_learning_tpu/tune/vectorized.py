@@ -439,15 +439,20 @@ def _resolve_auto_dispatch(program, sched, pbt, rows_now: int, log) -> int:
     fit = _fit_dispatch_model(obs)
     if fit is not None:
         lat, ppe = fit
-        seen_sizes = {o["chunk"] for o in obs}
+        # An XLA program is keyed by BOTH the scan trip count and the
+        # population row count: charge whichever arm would compile a
+        # (chunk, rows) combination this program has not yet dispatched —
+        # keying on chunk alone under-charged both arms whenever rows_now
+        # differed from every observation (ADVICE r5).
+        seen_programs = {(o["chunk"], o["rows"]) for o in obs}
         worst_compile = max((o["compile_s"] for o in obs), default=0.0)
-        # A new scan trip count is a new XLA program: charge whichever
-        # arm would compile a size this program has not yet dispatched.
         spec = (lat + e_total * rows_now * ppe
-                + (0.0 if e_total in seen_sizes else worst_compile))
+                + (0.0 if (e_total, rows_now) in seen_programs
+                   else worst_compile))
         n_disp = -(-e_total // cadence)
         chunked = (n_disp * lat + frac * e_total * rows_now * ppe
-                   + (0.0 if cadence in seen_sizes else worst_compile))
+                   + (0.0 if (cadence, rows_now) in seen_programs
+                      else worst_compile))
         pick = e_total if spec <= chunked else cadence
         log(
             f"epochs_per_dispatch auto: fit latency={lat:.2f}s "
@@ -1287,6 +1292,14 @@ def _run_population(
     exec_total_s = 0.0  # device-execute seconds (utilization numerator)
     exec_ema = None  # measured per-epoch execute seconds at the current size
     compile_cost_s = None  # most recent substantial compile observed
+    # Speculation horizon (matches _resolve_auto_dispatch): the largest
+    # chunk the auto cost model ever proposes for a rung stopper.
+    e_spec = min(
+        program.num_epochs,
+        int(getattr(sched, "max_t", program.num_epochs)
+            or program.num_epochs),
+    )
+    speculative = False
     if epochs_per_dispatch == "auto":
         dispatch = _resolve_auto_dispatch(program, sched, pbt, len(rows), log)
         if stop_rules is not None:
@@ -1304,6 +1317,10 @@ def _run_population(
             # to preserve).
             dispatch = min(dispatch, max(int(ckpt_every), 1))
         dispatch = max(int(dispatch), 1)
+        # Speculative only if the pick SURVIVED the clamps above: a
+        # stop-rule or checkpoint cadence that shrank it turns the run
+        # back into ordinary chunking.
+        speculative = pbt is None and dispatch == e_spec
     else:
         dispatch = max(int(epochs_per_dispatch), 1)
     if pbt is not None and dispatch > pbt.interval:
@@ -1315,25 +1332,42 @@ def _run_population(
             f"match the PBT perturbation interval"
         )
         dispatch = pbt.interval
+    epoch_budget = program.num_epochs
     if dispatch > 1 and program.num_epochs % dispatch:
-        # A ragged final chunk is a second full XLA program (different scan
-        # trip count) — in the dispatch-latency regime this feature targets,
-        # that compile can cost more than the round trips saved.  Round down
-        # to the largest divisor of num_epochs so every chunk shares one
-        # compiled program.
-        d = dispatch
-        while program.num_epochs % d:
-            d -= 1
-        log(
-            f"epochs_per_dispatch rounded {dispatch} -> {d} "
-            f"(largest divisor of num_epochs={program.num_epochs}; avoids a "
-            f"second compile for a ragged final chunk)"
-        )
-        dispatch = d
+        if speculative:
+            # The auto resolver picked ONE whole-horizon speculative
+            # dispatch (dispatch == max_t < num_epochs, not dividing it).
+            # Divisor-rounding here would silently shrink the chunk to a
+            # size that was never an arm of the cost comparison — and pay
+            # the fresh-size compile the model predicted avoiding (ADVICE
+            # r5).  Cap the epoch loop at the horizon instead: the stopper
+            # ends every trial there anyway, so no ragged second chunk
+            # ever dispatches.
+            epoch_budget = dispatch
+            log(
+                f"epochs_per_dispatch speculative: epoch loop capped at "
+                f"{dispatch} (scheduler horizon; num_epochs="
+                f"{program.num_epochs} never dispatches past it)"
+            )
+        else:
+            # A ragged final chunk is a second full XLA program (different
+            # scan trip count) — in the dispatch-latency regime this
+            # feature targets, that compile can cost more than the round
+            # trips saved.  Round down to the largest divisor of
+            # num_epochs so every chunk shares one compiled program.
+            d = dispatch
+            while program.num_epochs % d:
+                d -= 1
+            log(
+                f"epochs_per_dispatch rounded {dispatch} -> {d} "
+                f"(largest divisor of num_epochs={program.num_epochs}; "
+                f"avoids a second compile for a ragged final chunk)"
+            )
+            dispatch = d
 
     epoch0 = epoch_start
-    while epoch0 < program.num_epochs:
-        chunk = min(dispatch, program.num_epochs - epoch0)
+    while epoch0 < epoch_budget:
+        chunk = min(dispatch, epoch_budget - epoch0)
         _progress_note(
             f"dispatch epochs {epoch0}..{epoch0 + chunk} over "
             f"{len(rows)} rows (first dispatch of a shape traces+compiles)"
@@ -1516,7 +1550,7 @@ def _run_population(
         # size means an XLA recompile, so "auto" only compacts when the
         # measured epoch savings outweigh the measured compile cost.
         pos = [i for i, r in enumerate(rows) if r >= 0 and active[r]]
-        remaining = program.num_epochs - epoch - 1
+        remaining = epoch_budget - epoch - 1
         target = len(rows) // 2
         if size_multiple > 1:
             target = (target // size_multiple) * size_multiple

@@ -1,0 +1,118 @@
+"""Chaos smoke: an HPO sweep that survives injected faults, provably.
+
+Runs the same tiny sweep twice — once clean, once under a seeded
+``chaos.FaultPlan`` injecting transient storage write failures, one
+corrupted checkpoint, and two trial crashes — and checks both runs pick
+the SAME best config.  Then serves the winner on two replicas and kills
+one mid-traffic to show failover + the circuit breaker recovering.
+
+Runs on virtual CPU devices (see README):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/chaos_sweep.py
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_machine_learning_tpu import chaos, serve, tune  # noqa: E402
+from distributed_machine_learning_tpu.data import dummy_regression_data
+
+
+def run_sweep(storage, name):
+    train, val = dummy_regression_data(
+        num_samples=200, seq_len=8, num_features=4
+    )
+    return tune.run(
+        tune.with_parameters(
+            tune.train_regressor, train_data=train, val_data=val
+        ),
+        {
+            "model": "mlp",
+            "hidden_sizes": (32,),
+            "learning_rate": tune.loguniform(1e-3, 1e-1),
+            "num_epochs": 5,
+            "batch_size": 32,
+            "lr_schedule": "constant",
+        },
+        metric="validation_loss",
+        mode="min",
+        num_samples=6,
+        max_failures=2,
+        seed=0,
+        storage_path=storage,
+        name=name,
+        verbose=0,
+    ), val
+
+
+def main():
+    storage = tempfile.mkdtemp(prefix="chaos_sweep_")
+
+    print("== fault-free sweep ==")
+    baseline, val = run_sweep(storage, "fault_free")
+    print(f"best: {baseline.best_trial.trial_id} "
+          f"loss={baseline.best_result['validation_loss']:.5f}")
+
+    print("\n== same sweep under injected faults ==")
+    plan = chaos.FaultPlan(
+        seed=7,
+        write_error_rate=0.15,                       # flaky shared storage
+        trial_crashes=[("trial_00001", 4),           # preemptions
+                       ("trial_00003", 3)],
+        corrupt_path_substrings=[                    # bitrot on a restore
+            "trial_00001/checkpoints/ckpt_000003.msgpack"
+        ],
+    )
+    with chaos.active(plan):
+        chaotic, _ = run_sweep(storage, "faulted")
+    print(f"best: {chaotic.best_trial.trial_id} "
+          f"loss={chaotic.best_result['validation_loss']:.5f}")
+    print(f"injected: {plan.snapshot()}")
+    same = chaotic.best_config == baseline.best_config
+    print(f"same best config as fault-free run: {same}")
+    assert same, "chaos run diverged from the fault-free run"
+
+    print("\n== serve the winner, kill a replica mid-traffic ==")
+    bundle_dir = f"{storage}/bundle"
+    baseline.export_bundle(bundle_dir)
+    serve_plan = chaos.FaultPlan(seed=4, replica_kills=[(25, -1)])
+    srv = serve.PredictionServer(
+        serve.load_bundle(bundle_dir), port=0, num_replicas=2,
+        max_latency_ms=10, max_bucket=16,
+        breaker_failure_threshold=1, breaker_recovery_s=0.2,
+        fault_plan=serve_plan,
+    )
+    x = np.asarray(val.x[:4], np.float32)
+    srv.warmup(x[:1])
+    srv.start()
+    ok = 0
+    for _ in range(60):
+        deadline = time.time() + 10.0
+        while True:
+            try:
+                srv.replicas.predict(x, timeout=5.0)
+                ok += 1
+                break
+            except Exception:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.05)
+    stats = srv.replicas.breaker_stats()
+    print(f"answered {ok}/60 requests; kills="
+          f"{serve_plan.snapshot().get('replica_kills', 0)}, "
+          f"breaker opens={stats['opens_total']}, "
+          f"restarts={srv.replicas.restarts}")
+    srv.close()
+    assert ok == 60, "some requests were never answered"
+    print("\nchaos smoke passed")
+
+
+if __name__ == "__main__":
+    main()

@@ -102,6 +102,80 @@ def test_auto_fit_based_choice_both_directions():
         prog2, _asha(), None, 50, lambda m: None) == 5
 
 
+def test_compile_charge_keys_on_chunk_and_rows():
+    """An XLA program depends on (scan trip count, population rows) — a
+    whole-budget observation at DIFFERENT rows must not exempt the
+    speculative arm from its compile charge (ADVICE r5)."""
+    lat, ppe = 1.0, 1e-4
+    obs = [
+        # Whole-budget chunk seen, but at rows=50 — not this sweep's 100.
+        {"chunk": 20, "rows": 50, "exec_s": lat + 20 * 50 * ppe,
+         "compile_s": 50.0},
+        # The rung cadence HAS been dispatched at rows=100: no charge.
+        {"chunk": 5, "rows": 100, "exec_s": lat + 5 * 100 * ppe,
+         "compile_s": 0.0},
+    ]
+    prog = _StubProgram(20, obs)
+    # Latency-dominated, so without the compile charge speculation would
+    # win (spec ~1.2s vs chunked ~4.1s); the 50s fresh-(20,100) compile
+    # must flip the pick to the already-compiled cadence.
+    assert vz._resolve_auto_dispatch(
+        prog, _asha(), None, 100, lambda m: None) == 5
+    # Same history at rows=50 (both programs seen): speculation wins.
+    obs50 = [
+        {"chunk": 20, "rows": 50, "exec_s": lat + 20 * 50 * ppe,
+         "compile_s": 50.0},
+        {"chunk": 5, "rows": 50, "exec_s": lat + 5 * 50 * ppe,
+         "compile_s": 0.0},
+    ]
+    assert vz._resolve_auto_dispatch(
+        _StubProgram(20, obs50), _asha(), None, 50, lambda m: None) == 20
+
+
+def test_speculative_pick_not_divisor_rounded():
+    """max_t=6 does not divide num_epochs=8: the speculative whole-horizon
+    pick must dispatch ONE chunk of 6 (epoch loop capped at the horizon),
+    not get silently rounded to a 4-epoch divisor chunk that was never an
+    arm of the cost comparison (ADVICE r5)."""
+    train, val = dummy_regression_data(
+        num_samples=96, seq_len=6, num_features=3
+    )
+    space = {
+        "model": "mlp", "hidden_dims": [8], "num_epochs": 8,
+        "batch_size": 32, "learning_rate": tune.loguniform(1e-3, 1e-2),
+        "seed": tune.randint(0, 10_000),
+    }
+    common = dict(
+        train_data=train, val_data=val, metric="validation_loss",
+        mode="min", num_samples=6, max_batch_trials=8, seed=5,
+        storage_path="/tmp/auto_dispatch_spec", verbose=0,
+    )
+    vz.clear_program_cache()
+    a1 = tune.run_vectorized(space, name="fifo_seed_pass",
+                             epochs_per_dispatch=8, **common)
+    assert len(a1.trials) == 6
+    progs = list(vz._PROGRAM_CACHE.values())
+    assert progs
+    for p in progs:
+        for o in p.dispatch_obs:
+            o["compile_s"] = max(o["compile_s"], 60.0)  # force speculation
+    a2 = tune.run_vectorized(
+        space, name="asha_ragged_horizon",
+        scheduler=tune.ASHAScheduler(
+            max_t=6, grace_period=2, reduction_factor=2
+        ),
+        epochs_per_dispatch="auto", **common)
+    assert len(a2.trials) == 6
+    chunks = [o["chunk"] for p in vz._PROGRAM_CACHE.values()
+              for o in p.dispatch_obs]
+    assert 6 in chunks, chunks     # the horizon dispatched as picked
+    assert 4 not in chunks, chunks  # no silent divisor shrink
+    # ASHA semantics: nobody trains past max_t, rung stops still land.
+    iters = sorted(len(t.results) for t in a2.trials)
+    assert iters[-1] == 6
+    assert iters[0] <= 4
+
+
 def test_e2e_fifo_then_asha_auto_reuses_whole_budget_program():
     """The bench sequence: FIFO whole-budget populates the cached
     program's history; a following ASHA sweep with "auto" must pick

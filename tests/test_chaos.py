@@ -1,0 +1,463 @@
+"""Chaos harness: deterministic fault injection end to end.
+
+The recovery machinery (per-trial retry, checkpoint restore, storage
+retries, replica restart, circuit breaker) is only trustworthy once it has
+survived real failure shapes.  Every test here runs a SEEDED
+``chaos.FaultPlan`` — reproducible byte-for-byte, no timing dependence in
+what gets injected — and asserts both that the faults actually fired
+(plan counters) and that the system converged to the same answer it gives
+fault-free.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu import chaos, serve, tune
+from distributed_machine_learning_tpu.data import dummy_regression_data
+from distributed_machine_learning_tpu.tune import checkpoint as ckpt_lib
+from distributed_machine_learning_tpu.tune import storage as storage_lib
+from distributed_machine_learning_tpu.tune.storage import (
+    MemoryStorage,
+    RetryPolicy,
+    RetryingStorage,
+    get_storage,
+    retry_call,
+)
+from distributed_machine_learning_tpu.tune.trial import TrialStatus
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _fast_retries_and_clean_state():
+    """Tight retry delays (CI rule: no wall-clock sleeps > 0.2s), a clean
+    mem:// namespace, and guaranteed chaos deactivation."""
+    storage_lib.set_default_retry_policy(
+        RetryPolicy(attempts=4, base_delay_s=0.005, max_delay_s=0.02)
+    )
+    MemoryStorage.clear()
+    yield
+    chaos.deactivate()
+    MemoryStorage.clear()
+    storage_lib.set_default_retry_policy(storage_lib.DEFAULT_RETRY_POLICY)
+
+
+# --------------------------------------------------------------------------
+# FaultPlan determinism + storage retry
+# --------------------------------------------------------------------------
+
+
+def _decision_trace(plan, n=40):
+    out = []
+    for i in range(n):
+        try:
+            plan.on_storage_op("write", f"/exp/t{i % 5}/ckpt.msgpack")
+            out.append(0)
+        except chaos.InjectedIOError:
+            out.append(1)
+    return out
+
+
+def test_fault_plan_is_seed_deterministic():
+    a = chaos.FaultPlan(seed=11, write_error_rate=0.3)
+    b = chaos.FaultPlan(seed=11, write_error_rate=0.3)
+    c = chaos.FaultPlan(seed=12, write_error_rate=0.3)
+    ta, tb, tc = _decision_trace(a), _decision_trace(b), _decision_trace(c)
+    assert ta == tb  # same seed -> identical schedule
+    assert ta != tc  # different seed -> different schedule
+    assert sum(ta) > 0  # ~30% of 40 ops actually failed
+    assert a.snapshot()["storage_write_errors"] == sum(ta)
+
+
+def test_retrying_storage_absorbs_transient_faults(tmp_path):
+    plan = chaos.FaultPlan(seed=5, write_error_rate=0.3)
+    backend = RetryingStorage(
+        chaos.FaultyStorage(storage_lib.LocalStorage(), plan),
+        RetryPolicy(attempts=6, base_delay_s=0.001, max_delay_s=0.004),
+    )
+    for i in range(20):
+        p = str(tmp_path / f"f{i}.bin")
+        backend.write_bytes(p, b"payload-%d" % i)
+        assert backend.read_bytes(p) == b"payload-%d" % i
+    # The faults really happened — the retries hid them.
+    assert plan.snapshot()["storage_write_errors"] >= 3
+
+
+def test_retry_budget_exhaustion_propagates():
+    plan = chaos.FaultPlan(seed=1, write_error_rate=1.0)
+    backend = RetryingStorage(
+        chaos.FaultyStorage(storage_lib.MemoryStorage(), plan),
+        RetryPolicy(attempts=3, base_delay_s=0.001, max_delay_s=0.002),
+    )
+    with pytest.raises(IOError, match="injected transient write"):
+        backend.write_bytes("mem://x/y", b"z")
+    assert plan.snapshot()["storage_write_errors"] == 3  # one per attempt
+
+
+def test_retry_call_retries_plain_functions():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("disk hiccup")
+        return "ok"
+
+    policy = RetryPolicy(attempts=4, base_delay_s=0.001, max_delay_s=0.002)
+    assert retry_call(flaky, policy=policy, key="t") == "ok"
+    assert calls["n"] == 3
+    # Non-retryable exception types pass straight through.
+    def bad():
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError):
+        retry_call(bad, policy=policy, key="t2")
+
+
+def test_get_storage_composes_fault_and_retry_layers(tmp_path):
+    plan = chaos.FaultPlan(seed=9, write_error_rate=0.4)
+    with chaos.active(plan):
+        backend, p = get_storage(str(tmp_path / "a.bin"))
+        assert isinstance(backend, RetryingStorage)
+        assert isinstance(backend.inner, chaos.FaultyStorage)
+        for i in range(10):
+            backend.write_bytes(str(tmp_path / f"a{i}.bin"), b"x" * 32)
+    assert plan.snapshot()["storage_write_errors"] >= 1
+    # Deactivated: plain dispatch again.
+    backend, _ = get_storage(str(tmp_path / "b.bin"))
+    assert not isinstance(backend.inner, chaos.FaultyStorage)
+
+
+# --------------------------------------------------------------------------
+# checkpoint integrity: manifests, corruption detection, fallback
+# --------------------------------------------------------------------------
+
+
+def test_manifest_written_and_corruption_detected(tmp_path):
+    path = ckpt_lib.checkpoint_path(str(tmp_path), 1)
+    ckpt_lib.save_checkpoint(path, {"w": np.arange(8.0), "epoch": 0})
+    backend, p = get_storage(path)
+    manifest = json.loads(backend.read_bytes(ckpt_lib.manifest_path_for(p)))
+    assert manifest["sha256"] and manifest["bytes"] > 0
+    assert ckpt_lib.verify_checkpoint(path)
+    # Bit-flip the stored payload (manifest untouched) -> detected.
+    raw = backend.read_bytes(p)
+    backend.write_bytes(p, chaos.corrupt_bytes(raw))
+    # The sidecar survived the overwrite, so the checksum must fail.
+    with pytest.raises(ckpt_lib.CheckpointCorruptionError, match="checksum"):
+        ckpt_lib.load_checkpoint(path)
+    assert not ckpt_lib.verify_checkpoint(path)
+
+
+def test_fallback_walks_to_newest_valid_generation(tmp_path):
+    """Satellite: truncate one generation, bit-flip another — restore must
+    land on the newest generation that still passes its checksum."""
+    d = str(tmp_path)
+    for i in (1, 2, 3, 4):
+        ckpt_lib.save_checkpoint(
+            ckpt_lib.checkpoint_path(d, i), {"gen": np.float32(i)}
+        )
+    backend, _ = get_storage(d)
+    p4 = ckpt_lib.checkpoint_path(d, 4)
+    p3 = ckpt_lib.checkpoint_path(d, 3)
+    backend.write_bytes(p4, backend.read_bytes(p4)[:10])  # truncated
+    backend.write_bytes(p3, chaos.corrupt_bytes(backend.read_bytes(p3)))
+    tree, used, it = ckpt_lib.load_checkpoint_with_fallback(
+        p4, d, log=lambda m: None
+    )
+    assert it == 2 and used == ckpt_lib.checkpoint_path(d, 2)
+    assert float(tree["gen"]) == 2.0
+    # Nothing valid at all -> (None, None, 0), the from-scratch signal.
+    p2 = ckpt_lib.checkpoint_path(d, 2)
+    p1 = ckpt_lib.checkpoint_path(d, 1)
+    backend.write_bytes(p2, chaos.corrupt_bytes(backend.read_bytes(p2)))
+    backend.write_bytes(p1, chaos.corrupt_bytes(backend.read_bytes(p1)))
+    tree, used, it = ckpt_lib.load_checkpoint_with_fallback(
+        p4, d, log=lambda m: None
+    )
+    assert tree is None and used is None and it == 0
+
+
+def test_legacy_checkpoint_without_manifest_still_loads(tmp_path):
+    """Pre-integrity checkpoints (no sidecar) must keep restoring."""
+    path = str(tmp_path / "ckpt_000002.msgpack")
+    from flax import serialization
+
+    backend, p = get_storage(path)
+    backend.write_bytes(
+        p, serialization.to_bytes({"x": np.ones(3, np.float32)})
+    )
+    tree = ckpt_lib.load_checkpoint(path)
+    assert np.array_equal(tree["x"], np.ones(3, np.float32))
+
+
+def test_trial_retry_resumes_from_fallback_generation(tmp_path):
+    """Satellite e2e: a trial crashes AND its newest checkpoint is corrupt
+    (injected at write time) — the retry must restore the previous
+    checksum-valid generation and re-run from there instead of erroring."""
+    train, val = dummy_regression_data(
+        num_samples=96, seq_len=8, num_features=4
+    )
+    plan = chaos.FaultPlan(
+        seed=2,
+        trial_crashes=[("trial_00000", 4)],
+        corrupt_path_substrings=[
+            "trial_00000/checkpoints/ckpt_000003.msgpack"
+        ],
+    )
+    with chaos.active(plan):
+        analysis = tune.run(
+            tune.with_parameters(
+                tune.train_regressor, train_data=train, val_data=val
+            ),
+            {"model": "mlp", "hidden_sizes": (16,), "learning_rate": 0.01,
+             "num_epochs": 6, "batch_size": 32, "lr_schedule": "constant"},
+            metric="validation_loss", num_samples=1, max_failures=1,
+            storage_path=str(tmp_path), name="fallback_e2e", verbose=0,
+        )
+    snap = plan.snapshot()
+    assert snap["trial_crashes"] == 1
+    assert snap["storage_corruptions"] == 1
+    t = analysis.trials[0]
+    assert t.status == TrialStatus.TERMINATED
+    assert t.num_failures == 1
+    epochs = [r["epoch"] for r in t.results]
+    # First incarnation reported epochs 0-2 then crashed at report 4.  Its
+    # newest checkpoint (epoch 2 -> ckpt_000003) was corrupted on write, so
+    # the retry fell back to ckpt_000002 (epoch 1) and re-ran FROM EPOCH 2:
+    # epoch 2 appears twice, and the trial still finishes all 6 epochs.
+    assert epochs == [0, 1, 2, 2, 3, 4, 5], epochs
+
+
+# --------------------------------------------------------------------------
+# the HPO acceptance run: faulted sweep == fault-free sweep
+# --------------------------------------------------------------------------
+
+
+def _sweep(tmp_path, name, checkpoint_storage=None):
+    train, val = dummy_regression_data(
+        num_samples=96, seq_len=8, num_features=4
+    )
+    return tune.run(
+        tune.with_parameters(
+            tune.train_regressor, train_data=train, val_data=val
+        ),
+        {"model": "mlp", "hidden_sizes": (16,),
+         "learning_rate": tune.loguniform(1e-3, 1e-1),
+         "num_epochs": 5, "batch_size": 32, "lr_schedule": "constant"},
+        metric="validation_loss", mode="min", num_samples=5,
+        max_failures=2, seed=0, storage_path=str(tmp_path), name=name,
+        checkpoint_storage=checkpoint_storage, verbose=0,
+    )
+
+
+def test_hpo_sweep_under_chaos_finds_same_best_trial(tmp_path):
+    """The tentpole acceptance: >=10% of checkpoint writes failing
+    transiently, one corrupted checkpoint, two injected trial crashes —
+    the sweep completes every trial and picks the SAME winner as the
+    fault-free run."""
+    baseline = _sweep(tmp_path, "fault_free")
+    assert baseline.num_terminated() == 5
+
+    plan = chaos.FaultPlan(
+        seed=7,
+        write_error_rate=0.12,
+        trial_crashes=[("trial_00001", 4), ("trial_00003", 3)],
+        corrupt_path_substrings=[
+            "trial_00001/checkpoints/ckpt_000003.msgpack"
+        ],
+    )
+    with chaos.active(plan):
+        chaotic = _sweep(tmp_path, "faulted",
+                         checkpoint_storage="mem://chaos-bucket")
+
+    snap = plan.snapshot()
+    assert snap["trial_crashes"] == 2
+    assert snap["storage_corruptions"] == 1
+    assert snap.get("storage_write_errors", 0) >= 3  # ~12% of ckpt writes
+
+    assert chaotic.num_terminated() == 5  # every trial recovered
+    crashed = {t.trial_id: t for t in chaotic.trials}
+    assert crashed["trial_00001"].num_failures >= 1
+    assert crashed["trial_00003"].num_failures >= 1
+
+    # Same winner, same config: per-epoch RNG keys derive from
+    # (seed, epoch), so restored re-runs are bit-deterministic.
+    assert chaotic.best_trial.trial_id == baseline.best_trial.trial_id
+    assert chaotic.best_config == baseline.best_config
+    assert chaotic.best_result["validation_loss"] == pytest.approx(
+        baseline.best_result["validation_loss"], rel=1e-6
+    )
+
+    # The experiment artifact records what was injected.
+    state = json.load(
+        open(f"{chaotic.root}/experiment_state.json")
+    )
+    assert state["injected_faults"]["trial_crashes"] == 2
+
+
+# --------------------------------------------------------------------------
+# serve: circuit breaker + soak under replica kills
+# --------------------------------------------------------------------------
+
+
+def test_circuit_breaker_state_machine():
+    br = serve.CircuitBreaker(failure_threshold=2, recovery_s=0.05)
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    assert br.state == "closed"  # one failure is not a pattern
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    assert 0.0 < br.retry_after_s() <= 0.05
+    time.sleep(0.06)
+    assert br.allow()  # half-open probe admitted
+    assert br.state == "half_open"
+    assert not br.allow()  # only one probe in flight
+    br.record_failure()  # probe failed -> re-open
+    assert br.state == "open"
+    time.sleep(0.06)
+    assert br.allow()
+    br.record_success()  # probe succeeded -> closed
+    assert br.state == "closed" and br.allow()
+    assert br.opens_total == 2 and br.probes_total == 2
+
+
+@pytest.fixture(scope="module")
+def chaos_bundle(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("chaos_serve")
+    train, val = dummy_regression_data(
+        num_samples=96, seq_len=6, num_features=4, seed=3
+    )
+    analysis = tune.run(
+        tune.with_parameters(
+            tune.train_regressor, train_data=train, val_data=val
+        ),
+        {"model": "mlp", "hidden_sizes": (16,), "learning_rate": 0.01,
+         "num_epochs": 2, "batch_size": 32, "lr_schedule": "constant"},
+        metric="validation_loss", num_samples=1,
+        storage_path=str(tmp), name="src", verbose=0,
+    )
+    out = str(tmp / "bundle")
+    serve.export_bundle(analysis, out)
+    return serve.load_bundle(out), val
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def test_all_replicas_open_returns_503_with_retry_after(chaos_bundle):
+    bundle, val = chaos_bundle
+    srv = serve.PredictionServer(
+        bundle, port=0, num_replicas=1, max_bucket=8,
+        breaker_failure_threshold=1, breaker_recovery_s=30.0,
+    )
+    try:
+        host, port = srv.start()
+        base = f"http://{host}:{port}"
+        x = np.asarray(val.x[:2], np.float32)
+        _post(f"{base}/predict", {"instances": x.tolist()})  # healthy
+        # Trip the (only) breaker: the replica is alive but quarantined.
+        srv.replicas._breakers[0].record_failure()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"{base}/predict", {"instances": x.tolist()})
+        assert ei.value.code == 503
+        retry_after = ei.value.headers.get("Retry-After")
+        assert retry_after is not None and int(retry_after) >= 1
+        body = json.loads(ei.value.read())
+        assert body["retry_after_s"] > 0
+        with urllib.request.urlopen(f"{base}/metrics") as resp:
+            m = json.loads(resp.read())
+        assert m["rejected_total"] == 1
+        assert m["breakers"]["open_replicas"] == 1
+        assert m["breakers"]["per_replica"][0]["state"] == "open"
+    finally:
+        srv.close()
+
+
+def test_serve_soak_with_replica_kills_answers_every_request(chaos_bundle):
+    """The serve acceptance: two replicas killed mid-traffic (the chaos
+    plan kills the replica serving requests #15 and #40), every request is
+    eventually answered, and the breaker transitions show in /metrics."""
+    bundle, val = chaos_bundle
+    plan = chaos.FaultPlan(
+        seed=4, replica_kills=[(15, -1), (40, -1)]
+    )
+    srv = serve.PredictionServer(
+        bundle, port=0, num_replicas=2, max_batch_size=64,
+        max_latency_ms=25, max_bucket=8,
+        breaker_failure_threshold=1, breaker_recovery_s=0.2,
+        fault_plan=plan,
+    )
+    try:
+        srv.warmup(np.asarray(val.x[:1], np.float32))
+        host, port = srv.start()
+        base = f"http://{host}:{port}"
+        x = np.asarray(val.x[:2], np.float32).tolist()
+
+        failures = []
+        answered = [0]
+        lock = threading.Lock()
+
+        def client(n):
+            for _ in range(n):
+                deadline = time.time() + 15.0
+                while True:
+                    try:
+                        out = _post(f"{base}/predict", {"instances": x})
+                        assert len(out["predictions"]) == 2
+                        with lock:
+                            answered[0] += 1
+                        break
+                    except (urllib.error.HTTPError, urllib.error.URLError,
+                            ConnectionError, OSError):
+                        if time.time() >= deadline:
+                            with lock:
+                                failures.append("permanent")
+                            break
+                        time.sleep(0.05)
+
+        threads = [threading.Thread(target=client, args=(20,))
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+
+        assert failures == []          # zero permanently failed requests
+        assert answered[0] == 80
+        assert plan.snapshot()["replica_kills"] == 2
+
+        # Monitor restarted the killed replicas.
+        deadline = time.time() + 5.0
+        while srv.replicas.num_healthy() < 2 and time.time() < deadline:
+            time.sleep(0.05)
+        assert srv.replicas.num_healthy() == 2
+        assert srv.replicas.restarts >= 2
+
+        with urllib.request.urlopen(f"{base}/metrics") as resp:
+            m = json.loads(resp.read())
+        # Breaker transitions are visible: each kill failed the in-flight
+        # request on the victim (threshold 1 -> open), and the half-open
+        # probe after restart closed it again.
+        assert m["breakers"]["opens_total"] >= 1
+        assert m["breakers"]["request_failures_total"] >= 1
+        assert m["injected_faults"]["replica_kills"] == 2
+        states = [s["state"] for s in m["breakers"]["per_replica"]]
+        assert all(s in ("closed", "half_open") for s in states)
+        probes = sum(s["probes_total"]
+                     for s in m["breakers"]["per_replica"])
+        assert probes >= 1
+    finally:
+        srv.close()

@@ -242,14 +242,20 @@ def test_prune_keeps_durable_files_while_write_pending(tmp_path):
     assert deleted == 2  # newest 2 DURABLE files (3, 4) survive
     import os as _os
 
-    left = sorted(p for p in _os.listdir(d))
+    def _data_files():
+        return sorted(p for p in _os.listdir(d) if p.endswith(".msgpack"))
+
+    left = _data_files()
     assert left == ["ckpt_000003.msgpack", "ckpt_000004.msgpack"]
+    # Integrity sidecars prune with their checkpoints: none orphaned.
+    manifests = sorted(p for p in _os.listdir(d) if p.endswith(".json"))
+    assert manifests == [f + ".manifest.json" for f in left]
     save_checkpoint(pending, {"i": 5})  # the write lands -> keep+1
-    assert len(_os.listdir(d)) == 3
+    assert len(_data_files()) == 3
     # Next result's prune converges back to exactly keep.
     deleted = prune_checkpoints(d, keep=2, pending_latest=pending)
     assert deleted == 1
-    assert sorted(_os.listdir(d)) == [
+    assert _data_files() == [
         "ckpt_000004.msgpack", "ckpt_000005.msgpack"
     ]
 
@@ -273,11 +279,14 @@ def test_prune_keep_one_with_pending_preserves_durable(tmp_path):
     assert deleted == 2  # ckpt 3 survives as the durable restore point
     import os as _os
 
-    assert _os.listdir(d) == ["ckpt_000003.msgpack"]
+    def _data_files():
+        return sorted(p for p in _os.listdir(d) if p.endswith(".msgpack"))
+
+    assert _data_files() == ["ckpt_000003.msgpack"]
     save_checkpoint(pending, {"i": 4})
     deleted = prune_checkpoints(d, keep=1, pending_latest=pending)
     assert deleted == 1
-    assert _os.listdir(d) == ["ckpt_000004.msgpack"]
+    assert _data_files() == ["ckpt_000004.msgpack"]
 
 
 def test_orbax_export_import_round_trip(tmp_path):
@@ -395,5 +404,5 @@ def test_final_retention_converges_with_inflight_writes(tmp_path, monkeypatch):
     )
     for t in analysis.trials:
         d = os.path.dirname(t.latest_checkpoint)
-        files = sorted(os.listdir(d))
+        files = sorted(f for f in os.listdir(d) if f.endswith(".msgpack"))
         assert files == ["ckpt_000004.msgpack"], files
