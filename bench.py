@@ -1852,6 +1852,12 @@ def emit(value: float, vs_baseline, backend: str, extra: dict) -> None:
 # round's TPU number; plus one LATE re-probe after the CPU fallback runs.
 PROBE_SCHEDULE = ((120, 0), (120, 30), (180, 60))
 LATE_PROBE_TIMEOUT = 180
+# Hard ceiling on TOTAL probe wall time per _probe_tpu call (VERDICT r5:
+# the probe wedged for 4 straight attempts and the schedule alone let it
+# burn ~8.5 min).  An attempt whose worst case (backoff + timeout) cannot
+# fit in the remaining budget is skipped, and the skip is recorded in the
+# artifact — the emit documents WHY the TPU path was abandoned.
+PROBE_TOTAL_BUDGET_S = 420.0
 # Gap between consecutive tunnel-claiming children: the far side releases
 # a dead child's claim with some lag, and a claim that starts against a
 # still-held grant can wedge permanently (2026-07-31: probe+flagship ran
@@ -1861,12 +1867,30 @@ LATE_PROBE_TIMEOUT = 180
 INTER_CHILD_GAP_S = 15.0
 
 
-def _probe_tpu(log, probe_info, schedule) -> tuple:
+def _probe_tpu(log, probe_info, schedule,
+               budget_s: float = PROBE_TOTAL_BUDGET_S) -> tuple:
     """Run probe attempts per ``schedule``; returns (probe_ok, tunnel_ok).
-    Every attempt's rc/duration/cause is recorded in ``probe_info`` so a
-    failed round documents WHY in the output JSON."""
+
+    Bounded: total wall time (backoffs + attempts) stays under
+    ``budget_s`` — an attempt that could overrun it is skipped rather than
+    started (a wedged attempt burns its FULL timeout, so admission is the
+    only place the bound can hold).  Every attempt's rc / duration /
+    exited / cause lands in ``probe_info`` (and from there the BENCH
+    artifact), so a wedged round carries its own forensics instead of only
+    a log tail: ``total_s``, ``budget_exhausted``, ``wedged_attempts``,
+    and the per-attempt records say what happened and what it cost."""
     probe_ok, tunnel_ok = False, True
+    t_start = time.time()
     for timeout_s, backoff_s in schedule:
+        elapsed = time.time() - t_start
+        if elapsed + backoff_s + timeout_s > budget_s:
+            log(
+                f"probe budget exhausted ({elapsed:.0f}s elapsed; next "
+                f"attempt needs {backoff_s + timeout_s}s > "
+                f"{budget_s:.0f}s total); abandoning the TPU path"
+            )
+            probe_info["budget_exhausted"] = True
+            break
         if backoff_s:
             log(f"probe backoff {backoff_s}s")
             time.sleep(backoff_s)
@@ -1882,6 +1906,7 @@ def _probe_tpu(log, probe_info, schedule) -> tuple:
             "rc": rc,
             "seconds": round(time.time() - t0, 1),
             "timeout_s": timeout_s,
+            "exited": exited,
             "cause": None if rc == 0 else (cause or "timeout (no output)"),
         })
         if rc == 0:
@@ -1894,6 +1919,12 @@ def _probe_tpu(log, probe_info, schedule) -> tuple:
             probe_info["zombie_claimant"] = True
             tunnel_ok = False
             break
+    probe_info["total_s"] = round(
+        probe_info.get("total_s", 0.0) + (time.time() - t_start), 1
+    )
+    probe_info["wedged_attempts"] = sum(
+        1 for a in probe_info["attempts"] if not a.get("exited", True)
+    )
     return probe_ok, tunnel_ok
 
 

@@ -8,8 +8,17 @@ metric streaming, pytree checkpoint/restore, and an experiment store with
 best-config analysis — no Ray, no torch in the loop.
 """
 
-from distributed_machine_learning_tpu import data, models, ops, tune, utils
+from distributed_machine_learning_tpu import (
+    data,
+    liveness,
+    models,
+    ops,
+    tune,
+    utils,
+)
 
 __version__ = "0.1.0"
 
-__all__ = ["data", "models", "ops", "tune", "utils", "__version__"]
+__all__ = [
+    "data", "liveness", "models", "ops", "tune", "utils", "__version__",
+]

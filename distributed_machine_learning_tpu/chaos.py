@@ -84,6 +84,23 @@ class FaultPlan:
       index (1-based: ``(10, 0)`` kills replica 0 at the 10th request).
       ``replica_idx=-1`` kills whichever replica is serving that request —
       the deterministic way to fail an in-flight request.
+
+    Fail-SLOW faults (each fires exactly once; nothing raises — recovery
+    depends on the liveness layer noticing the silence):
+
+    * ``hang_dispatch_at`` — ``(trial_id, training_iteration)`` pairs; the
+      executor's report path sleeps ``hang_s`` seconds at that boundary
+      (a wedged device dispatch stand-in).  Keep ``hang_s`` small in CI
+      (the watchdog deadline under test must be smaller still).
+    * ``stall_storage_paths`` / ``stall_storage_ms`` — the first storage
+      op whose path contains each substring sleeps ``stall_storage_ms``
+      (degraded shared storage that stalls instead of erroring).
+    * ``partition_worker`` — ``(result_index, worker_idx, duration_s)``
+      triples; when the cluster driver has processed ``result_index``
+      result frames, worker ``worker_idx`` is partitioned for
+      ``duration_s``: its frames (both directions) are delayed until the
+      partition heals — TCP semantics, delivery delayed not dropped — so
+      the head's lease expiry, requeue, and self-fencing all exercise.
     """
 
     def __init__(
@@ -97,6 +114,11 @@ class FaultPlan:
         corrupt_path_substrings: Sequence[str] = (),
         trial_crashes: Iterable[Tuple[str, int]] = (),
         replica_kills: Iterable[Tuple[int, int]] = (),
+        hang_dispatch_at: Iterable[Tuple[str, int]] = (),
+        hang_s: float = 1.5,
+        stall_storage_paths: Sequence[str] = (),
+        stall_storage_ms: float = 0.0,
+        partition_worker: Iterable[Tuple[int, int, float]] = (),
     ):
         self.seed = seed
         self.write_error_rate = float(write_error_rate)
@@ -108,10 +130,22 @@ class FaultPlan:
         self._kills = sorted(
             ((int(n), int(r)) for n, r in replica_kills), reverse=True
         )
+        # Fail-slow faults (PR 3): dispatch hangs, storage stalls, worker
+        # partitions — silence, not errors, so only liveness machinery
+        # (liveness.py watchdogs, cluster lease expiry) can recover them.
+        self._hangs = {(str(t), int(i)) for t, i in hang_dispatch_at}
+        self.hang_s = float(hang_s)
+        self._stall_pending: List[str] = list(stall_storage_paths)
+        self.stall_storage_ms = float(stall_storage_ms)
+        self._partitions = sorted(
+            ((int(n), int(w), float(d)) for n, w, d in partition_worker),
+            reverse=True,
+        )
         self._lock = threading.Lock()
         self._op_counts: Dict[Tuple[str, str], int] = {}
         self._counters: Dict[str, int] = {}
         self._submit_count = 0
+        self._result_count = 0
         self.corrupted_paths: List[str] = []
 
     # -- bookkeeping ---------------------------------------------------------
@@ -142,6 +176,18 @@ class FaultPlan:
     def on_storage_op(self, op: str, path: str) -> None:
         """Called by FaultyStorage before the real backend op; may sleep
         and/or raise InjectedIOError."""
+        if self.stall_storage_ms > 0:
+            with self._lock:
+                hit = next(
+                    (s for s in self._stall_pending if s in path), None
+                )
+                if hit is not None:
+                    self._stall_pending.remove(hit)
+                    self._counters["storage_stalls"] = (
+                        self._counters.get("storage_stalls", 0) + 1
+                    )
+            if hit is not None:
+                time.sleep(self.stall_storage_ms / 1000.0)
         if self._roll("slow", f"{op}:{path}", self.slow_rate):
             self._count("storage_slow")
             time.sleep(self.slow_s)
@@ -184,6 +230,40 @@ class FaultPlan:
         raise InjectedTrialCrash(
             f"injected crash: {trial_id} at iteration {iteration}"
         )
+
+    def maybe_hang_dispatch(self, trial_id: str, iteration: int) -> None:
+        """Sleep ``hang_s`` if (trial_id, iteration) is scheduled — a
+        dispatch that goes silent instead of erroring.  Fires once; the
+        recovered/retried incarnation passes the same boundary."""
+        key = (str(trial_id), int(iteration))
+        with self._lock:
+            if key not in self._hangs:
+                return
+            self._hangs.discard(key)
+            self._counters["dispatch_hangs"] = (
+                self._counters.get("dispatch_hangs", 0) + 1
+            )
+        time.sleep(self.hang_s)
+
+    # -- cluster faults ------------------------------------------------------
+
+    def poll_worker_partition(self) -> Optional[Tuple[int, float]]:
+        """Advance the driver's result counter; return
+        ``(worker_idx, duration_s)`` when a scheduled partition comes due
+        (else None).  Called by the cluster driver once per processed
+        result frame — deterministic in the frame stream, not wall time."""
+        with self._lock:
+            self._result_count += 1
+            if (
+                self._partitions
+                and self._result_count >= self._partitions[-1][0]
+            ):
+                _, idx, duration = self._partitions.pop()
+                self._counters["worker_partitions"] = (
+                    self._counters.get("worker_partitions", 0) + 1
+                )
+                return idx, duration
+        return None
 
     # -- serve faults --------------------------------------------------------
 
@@ -272,3 +352,39 @@ def active(plan: FaultPlan):
         yield plan
     finally:
         deactivate()
+
+
+PLAN_ENV_VAR = "DML_CHAOS_PLAN"
+
+
+def plan_from_env() -> Optional[FaultPlan]:
+    """Build a FaultPlan from the ``DML_CHAOS_PLAN`` env var (JSON kwargs
+    for :class:`FaultPlan`), or None when unset/unparsable.
+
+    This is how faults reach SUBPROCESSES: ``chaos.activate`` is
+    process-local, but cluster worker supervisors and process-executor
+    children are separate processes — the chaos harness sets the env var in
+    their spawn environment and the worker entrypoint activates the plan at
+    startup, so a seeded hang/crash schedule lands on the host that
+    actually runs the trial."""
+    import json
+    import os
+
+    raw = os.environ.get(PLAN_ENV_VAR)
+    if not raw:
+        return None
+    try:
+        kwargs = json.loads(raw)
+        return FaultPlan(**kwargs)
+    except (ValueError, TypeError) as exc:
+        print(f"[chaos] ignoring unparsable {PLAN_ENV_VAR}: {exc!r}",
+              flush=True)
+        return None
+
+
+def activate_from_env() -> Optional[FaultPlan]:
+    """``plan_from_env()`` + ``activate`` in one call (worker entrypoints)."""
+    plan = plan_from_env()
+    if plan is not None:
+        activate(plan)
+    return plan

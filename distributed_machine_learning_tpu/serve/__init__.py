@@ -38,6 +38,7 @@ from distributed_machine_learning_tpu.serve.replica import (
     CircuitBreaker,
     Replica,
     ReplicaSet,
+    ReplicaTimeout,
     replica_process_env,
 )
 from distributed_machine_learning_tpu.serve.server import PredictionServer
@@ -52,6 +53,7 @@ __all__ = [
     "PredictionServer",
     "Replica",
     "ReplicaSet",
+    "ReplicaTimeout",
     "ServableBundle",
     "ServeMetrics",
     "bucket_sizes",

@@ -38,6 +38,7 @@ class ServeMetrics:
         self.rows = 0
         self.errors = 0
         self.rejected = 0
+        self.timeouts = 0
 
     def observe(self, latency_s: float, rows: int):
         with self._lock:
@@ -55,6 +56,12 @@ class ServeMetrics:
         with self._lock:
             self.rejected += 1
 
+    def observe_timeout(self):
+        """A request that missed its /predict deadline (hung replica, 504)
+        — the fail-slow counter, apart from errors that actually returned."""
+        with self._lock:
+            self.timeouts += 1
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             lat = sorted(self._latencies_ms)
@@ -65,6 +72,7 @@ class ServeMetrics:
                 "rows_total": self.rows,
                 "errors_total": self.errors,
                 "rejected_total": self.rejected,
+                "timeouts_total": self.timeouts,
                 "requests_per_s": round(self.requests / uptime, 2),
                 "rows_per_s": round(self.rows / uptime, 2),
                 "latency_ms_p50": round(percentile(lat, 50.0), 3),

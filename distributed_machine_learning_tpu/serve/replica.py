@@ -28,6 +28,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -62,6 +63,58 @@ class AllReplicasOpen(RuntimeError):
             f"{retry_after_s:.2f}s"
         )
         self.retry_after_s = retry_after_s
+
+
+class ReplicaTimeout(RuntimeError):
+    """A dispatched request missed its deadline — the replica may be hung.
+
+    The breaker only learns from outcomes that RETURN; a wedged engine
+    never resolves its future, so the deadline is the signal: predict()
+    records the timeout as a breaker failure on the serving slot (enough
+    of them quarantine it) and raises this for the HTTP layer's 504."""
+
+    def __init__(self, timeout_s: float, replica_idx: int):
+        super().__init__(
+            f"replica {replica_idx} did not answer within {timeout_s:.1f}s"
+        )
+        self.timeout_s = timeout_s
+        self.replica_idx = replica_idx
+
+
+class _RequestOutcome:
+    """One-shot breaker recorder shared by the done-callback and the
+    deadline path: whichever fires first (completion or timeout) is the
+    request's fate — a later signal for the same request must not count
+    twice (a timed-out request that eventually succeeds device-side was
+    still a client-visible failure)."""
+
+    __slots__ = ("_breaker", "_lock", "_recorded")
+
+    def __init__(self, breaker: "CircuitBreaker"):
+        self._breaker = breaker
+        self._lock = threading.Lock()
+        self._recorded = False
+
+    def _claim(self) -> bool:
+        with self._lock:
+            if self._recorded:
+                return False
+            self._recorded = True
+            return True
+
+    def record(self, failed: bool) -> None:
+        if self._claim():
+            if failed:
+                self._breaker.record_failure()
+            else:
+                self._breaker.record_success()
+
+    def from_future(self, fut) -> None:
+        try:
+            failed = fut.exception() is not None
+        except BaseException:  # noqa: BLE001 - cancelled counts too
+            failed = True
+        self.record(failed)
 
 
 class CircuitBreaker:
@@ -290,6 +343,7 @@ class ReplicaSet:
         self._lock = threading.Lock()
         self._rr = 0
         self.restarts = 0
+        self.timeouts = 0  # requests that missed their deadline (predict)
         self._closing = False
         self._warmup_programs: Optional[int] = None
         self.replicas: List[Replica] = [
@@ -314,7 +368,11 @@ class ReplicaSet:
         restarts them, quarantined ones until their half-open probe
         succeeds.  Raises :class:`AllReplicasOpen` when only breakers stand
         in the way (503 + Retry-After upstream), plain RuntimeError when
-        every replica is dead."""
+        every replica is dead.
+
+        The returned future carries ``_dml_outcome`` (one-shot breaker
+        recorder) and ``_dml_replica_idx`` so deadline enforcement in
+        :meth:`predict` can charge a timeout to the serving slot."""
         with self._lock:
             replicas = list(self.replicas)
             start = self._rr
@@ -331,19 +389,13 @@ class ReplicaSet:
                 continue
             fut = r.submit(x)
 
-            def _outcome(f, breaker=breaker):
-                # Runs on the batcher worker (or inline if already done):
-                # the request's fate is the breaker's signal.
-                try:
-                    failed = f.exception() is not None
-                except BaseException:  # noqa: BLE001 - cancelled counts too
-                    failed = True
-                if failed:
-                    breaker.record_failure()
-                else:
-                    breaker.record_success()
-
-            fut.add_done_callback(_outcome)
+            # Runs on the batcher worker (or inline if already done): the
+            # request's fate is the breaker's signal — once, whether it
+            # arrives by completion or by deadline.
+            outcome = _RequestOutcome(breaker)
+            fut._dml_outcome = outcome
+            fut._dml_replica_idx = i
+            fut.add_done_callback(outcome.from_future)
             if self._fault_plan is not None:
                 # Chaos kill switch, polled per dispatched request so
                 # scheduled replica deaths land deterministically
@@ -365,7 +417,26 @@ class ReplicaSet:
         return min(waits) if waits else 0.0
 
     def predict(self, x, timeout: Optional[float] = 30.0) -> np.ndarray:
-        return self.submit(x).result(timeout=timeout)
+        """Submit + wait, with the timeout treated as a replica FAILURE.
+
+        A hung replica's future never resolves, so without this the
+        breaker never learns (it only counts outcomes that return) and
+        every HTTP worker that round-robins onto the wedged slot blocks
+        for the full timeout.  Charging the deadline miss to the slot's
+        breaker quarantines it after ``failure_threshold`` misses — the
+        monitor/half-open probe path then owns recovery."""
+        fut = self.submit(x)
+        try:
+            return fut.result(timeout=timeout)
+        except FuturesTimeoutError:
+            self.timeouts += 1
+            outcome = getattr(fut, "_dml_outcome", None)
+            if outcome is not None:
+                outcome.record(failed=True)
+            raise ReplicaTimeout(
+                timeout if timeout is not None else float("inf"),
+                getattr(fut, "_dml_replica_idx", -1),
+            ) from None
 
     # -- lifecycle -----------------------------------------------------------
 

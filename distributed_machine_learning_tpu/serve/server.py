@@ -34,6 +34,7 @@ from distributed_machine_learning_tpu.serve.metrics import (
 from distributed_machine_learning_tpu.serve.replica import (
     AllReplicasOpen,
     ReplicaSet,
+    ReplicaTimeout,
 )
 
 
@@ -177,6 +178,20 @@ class PredictionServer:
                 except ValueError as exc:
                     server.metrics.observe_error()
                     self._reply(400, {"error": str(exc)})
+                except ReplicaTimeout as exc:
+                    # Per-request deadline (request_timeout_s): a hung
+                    # replica cannot pin this worker past it.  The miss
+                    # already counted as a breaker failure on the serving
+                    # slot (enough of them quarantine it), so clients see a
+                    # fast 504 + the slot stops taking traffic — instead of
+                    # every round-robin pass burning a full timeout.
+                    server.metrics.observe_timeout()
+                    self._reply(
+                        504,
+                        {"error": str(exc),
+                         "timeout_s": exc.timeout_s,
+                         "replica": exc.replica_idx},
+                    )
                 except AllReplicasOpen as exc:
                     # Load-shed honestly: every replica is quarantined, so
                     # tell the client WHEN the first half-open probe opens

@@ -70,6 +70,7 @@ from distributed_machine_learning_tpu.tune.session import (
     get_checkpoint,
     get_devices,
     get_trial_id,
+    heartbeat,
     report,
     standalone,
     with_parameters,
@@ -101,6 +102,7 @@ __all__ = [
     "clear_cohort_program_cache",
     "run_vectorized",
     "report",
+    "heartbeat",
     "get_checkpoint",
     "get_devices",
     "get_trial_id",

@@ -11,6 +11,7 @@ stdio:
                        "restore": pytree|None, "sys_path": [...]}   (init)
     child  -> parent  ("result", metrics, ckpt_bytes|None)
     parent -> child   ("decision", "continue"|"stop"|"pause")
+    child  -> parent  ("beat",)            (tune.heartbeat(); no reply)
     child  -> parent  ("complete",) | ("error", traceback_str)
 
 The child's real stdout is reserved for frames; ``print`` inside trainables
@@ -97,6 +98,21 @@ def main() -> None:
         assert msg[0] == "decision", msg
         return msg[1]
 
+    # Mid-epoch liveness: tune.heartbeat() in the trainable emits a "beat"
+    # frame so the parent's watchdog sees progress between reports.  Rate-
+    # limited host-side — a heartbeat in a hot step loop must not flood the
+    # pipe.  Same thread as report_fn (the trainable's), so frame writes
+    # never interleave.
+    import time as _time
+
+    last_beat = [0.0]
+
+    def heartbeat_fn() -> None:
+        now = _time.monotonic()
+        if now - last_beat[0] >= 0.05:
+            last_beat[0] = now
+            write_frame(stdout, ("beat",))
+
     restore = init.get("restore")
     try:
         set_session(
@@ -105,6 +121,7 @@ def main() -> None:
                 report_fn,
                 lambda: restore,
                 devices,
+                heartbeat_fn=heartbeat_fn,
             )
         )
         trainable(dict(init["config"]))

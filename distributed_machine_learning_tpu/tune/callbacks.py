@@ -76,6 +76,13 @@ class Callback:
     def on_trial_error(self, trial: Trial, error: str):
         pass
 
+    def on_experiment_counters(self, counters: Dict[str, int]):
+        """Experiment-level counters at teardown, prefixed by family
+        (``liveness/stalls_detected``, ``faults/trial_crashes``, ...).
+        Fires just before ``on_experiment_end``, and only when any
+        counter family is active (a liveness watchdog or a chaos plan)."""
+        pass
+
     def on_experiment_end(self, trials: List[Trial], wall_clock_s: float):
         pass
 
@@ -224,6 +231,23 @@ class TensorBoardCallback(Callback):
 
     def on_trial_error(self, trial: Trial, error: str):
         self._close(trial.trial_id)
+
+    def on_experiment_counters(self, counters: Dict[str, int]):
+        # Experiment-scope run ("_experiment" sorts above trial runs in
+        # TB's selector): stall/requeue/fence and injected-fault counters
+        # graph next to the per-trial curves they explain.
+        from distributed_machine_learning_tpu.utils.tensorboard import (
+            SummaryWriter,
+        )
+
+        w = SummaryWriter(os.path.join(self._root, "_experiment"))
+        try:
+            w.add_scalars(
+                [(key, float(val)) for key, val in sorted(counters.items())],
+                step=0,
+            )
+        finally:
+            w.close()
 
     def on_experiment_end(self, trials: List[Trial], wall_clock_s: float):
         for w in self._writers.values():

@@ -199,6 +199,27 @@ def find_latest_checkpoint(directory: str):
     return best_path, best_it
 
 
+def newest_valid_checkpoint(directory: str):
+    """(path, iteration) of the newest generation that PASSES its integrity
+    check, or (None, 0).  The restore target for trials requeued off a
+    silent worker (cluster lease expiry / stall fencing): the lost
+    incarnation may have died mid-write, so the newest file on disk is not
+    necessarily a loadable one — walk generations newest-first and trust
+    only a verified checksum (legacy manifest-less files verify by
+    decodability, matching ``load_checkpoint``)."""
+    backend, d = get_storage(directory)
+    generations = []
+    for name in backend.listdir(d):
+        m = _CKPT_RE.match(name)
+        if m:
+            generations.append((int(m.group(1)), name))
+    for it, name in sorted(generations, reverse=True):
+        full = backend.join(d, name)
+        if verify_checkpoint(full):
+            return full, it
+    return None, 0
+
+
 def _abspath_unless_remote(path: str) -> str:
     """abspath local paths only — os.path.abspath would mangle gs://-style
     URLs into '<cwd>/gs:/...' (orbax handles remote schemes itself)."""

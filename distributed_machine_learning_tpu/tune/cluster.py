@@ -186,15 +186,24 @@ class _WorkerState:
         self.sock = sock
         self.secret = secret
         self.send_lock = threading.Lock()
-        self.decisions: Dict[str, "queue.Queue[str]"] = {}
+        # (trial_id, incarnation) -> decision queue; incarnation-keyed so a
+        # fenced incarnation and its redispatched replacement on this same
+        # worker never swallow each other's decisions.
+        self.decisions: Dict[Tuple[str, int], "queue.Queue[str]"] = {}
         self.dec_lock = threading.Lock()
 
 
 def _worker_run_trial(state: _WorkerState, msg: Dict[str, Any], devices: List):
     trial_id = msg["trial_id"]
+    # Decision routing is keyed by (trial_id, incarnation): after a fence +
+    # requeue the driver may redispatch the SAME trial to this same worker
+    # while the fenced incarnation still drains — their decisions must
+    # never cross.
+    incarnation = int(msg.get("incarnation", 0))
+    dec_key = (trial_id, incarnation)
     dq: "queue.Queue[str]" = queue.Queue()
     with state.dec_lock:
-        state.decisions[trial_id] = dq
+        state.decisions[dec_key] = dq
 
     trial = Trial(trial_id=trial_id, config=dict(msg["config"]))
     trial.restore_path = msg.get("restore_path")
@@ -202,6 +211,16 @@ def _worker_run_trial(state: _WorkerState, msg: Dict[str, Any], devices: List):
     iteration = [int(msg.get("start_iteration", 0))]
 
     def report_fn(metrics: Dict[str, Any], checkpoint) -> str:
+        # Chaos hooks (plan activated from DML_CHAOS_PLAN on this worker —
+        # supervisors are separate processes): a hang sleeps HERE so the
+        # driver-side progress watchdog sees real silence from a real
+        # worker; a crash follows the ordinary error-frame path.
+        from distributed_machine_learning_tpu import chaos
+
+        plan = chaos.active_plan()
+        if plan is not None:
+            plan.maybe_hang_dispatch(trial_id, iteration[0] + 1)
+            plan.maybe_crash_trial(trial_id, iteration[0] + 1)
         iteration[0] += 1
         ckpt_path = None
         if checkpoint is not None and ckpt_dir:
@@ -216,6 +235,7 @@ def _worker_run_trial(state: _WorkerState, msg: Dict[str, Any], devices: List):
             {
                 "type": "result",
                 "trial_id": trial_id,
+                "incarnation": incarnation,
                 "metrics": metrics,
                 "checkpoint_path": ckpt_path,
             },
@@ -223,9 +243,36 @@ def _worker_run_trial(state: _WorkerState, msg: Dict[str, Any], devices: List):
         )
         return dq.get()
 
+    def heartbeat_fn():
+        # tune.heartbeat() inside a long epoch: piggyback a per-trial
+        # progress frame on the control plane so the driver's watchdog
+        # never misreads slow-but-alive as wedged.
+        try:
+            _send(
+                state.sock,
+                state.send_lock,
+                {"type": "trial_beat", "trial_id": trial_id,
+                 "incarnation": incarnation},
+                state.secret,
+            )
+        except OSError:
+            pass  # driver gone; the terminal path handles it
+
     def checkpoint_loader():
         if trial.restore_path:
-            return ckpt_lib.load_checkpoint(trial.restore_path)
+            # Same corruption fallback as the local executors: a requeued
+            # trial whose restore target was damaged restores the newest
+            # checksum-valid generation instead of dying again.
+            tree, used, used_it = ckpt_lib.load_checkpoint_with_fallback(
+                trial.restore_path, ckpt_dir,
+            )
+            if used != trial.restore_path:
+                print(
+                    f"[worker] {trial_id}: restore fell back "
+                    f"{trial.restore_path} -> {used} (it={used_it})",
+                    flush=True,
+                )
+            return tree
         return None
 
     # The terminal frame is sent only AFTER session/decision-map cleanup: the
@@ -235,18 +282,22 @@ def _worker_run_trial(state: _WorkerState, msg: Dict[str, Any], devices: List):
     terminal: Dict[str, Any]
     try:
         trainable = resolve_trainable(msg["trainable"])
-        set_session(Session(trial, report_fn, checkpoint_loader, devices))
+        set_session(Session(trial, report_fn, checkpoint_loader, devices,
+                            heartbeat_fn=heartbeat_fn))
         import jax
 
         with jax.default_device(devices[0]):
             trainable(dict(trial.config))
-        terminal = {"type": "complete", "trial_id": trial_id}
+        terminal = {"type": "complete", "trial_id": trial_id,
+                    "incarnation": incarnation}
     except (StopTrial, PauseTrial):
-        terminal = {"type": "complete", "trial_id": trial_id}
+        terminal = {"type": "complete", "trial_id": trial_id,
+                    "incarnation": incarnation}
     except BaseException:  # noqa: BLE001 - ship the traceback to the driver
         terminal = {
             "type": "error",
             "trial_id": trial_id,
+            "incarnation": incarnation,
             "traceback": traceback.format_exc(),
         }
     finally:
@@ -255,8 +306,8 @@ def _worker_run_trial(state: _WorkerState, msg: Dict[str, Any], devices: List):
             # The same-incarnation guard stays even though the terminal frame
             # now follows cleanup: a worker-death requeue on the driver can
             # still race a slow teardown here.
-            if state.decisions.get(trial_id) is dq:
-                del state.decisions[trial_id]
+            if state.decisions.get(dec_key) is dq:
+                del state.decisions[dec_key]
         try:
             _send(state.sock, state.send_lock, terminal, state.secret)
         except OSError:
@@ -300,6 +351,13 @@ def serve_worker(
 
     import jax
 
+    from distributed_machine_learning_tpu import chaos
+
+    # Supervisors are separate processes — a chaos harness reaches them
+    # through the spawn environment, not chaos.activate() in the driver.
+    if chaos.activate_from_env() is not None:
+        print("[worker] chaos plan activated from environment", flush=True)
+
     devices = list(jax.devices())
     slots = slots or len(devices)
 
@@ -342,6 +400,32 @@ def _serve_driver_connection(
         },
         secret,
     )
+    # Liveness heartbeats, piggybacked on the control plane: the driver's
+    # lease expiry measures the gap between ANY frames from this worker, so
+    # an idle-but-healthy supervisor must keep speaking.  A worker whose
+    # supervisor process wedges entirely stops beating (the point); a
+    # worker with one hung trial thread keeps beating (per-trial progress
+    # watchdogs on the driver catch that case).
+    hb_interval = float(os.environ.get("DML_CLUSTER_HEARTBEAT_S", "2.0"))
+    stop_hb = threading.Event()
+
+    def _heartbeat_loop():
+        while not stop_hb.wait(hb_interval):
+            try:
+                with state.dec_lock:
+                    running = sorted({k[0] for k in state.decisions})
+                _send(
+                    sock,
+                    state.send_lock,
+                    {"type": "heartbeat", "running": running},
+                    secret,
+                )
+            except OSError:
+                return  # connection gone; the main recv loop notices too
+
+    threading.Thread(
+        target=_heartbeat_loop, name="worker-heartbeat", daemon=True
+    ).start()
     shutdown = False
     while True:
         msg = _recv(sock, secret)
@@ -363,13 +447,32 @@ def _serve_driver_connection(
             ).start()
         elif mtype == "decision":
             with state.dec_lock:
-                dq = state.decisions.get(msg["trial_id"])
+                dq = state.decisions.get(
+                    (msg["trial_id"], int(msg.get("incarnation", 0)))
+                )
             if dq is not None:
                 dq.put(msg["decision"])
+        elif mtype == "fence":
+            # Self-fencing: the driver requeued this trial elsewhere (we
+            # looked hung or partitioned).  Pre-load a stop decision so the
+            # named incarnation(s) end at their next report boundary instead
+            # of racing the replacement for the rest of the sweep.  Without
+            # an incarnation, fence every incarnation of the trial.
+            inc = msg.get("incarnation")
+            with state.dec_lock:
+                targets = [
+                    dq for key, dq in state.decisions.items()
+                    if key[0] == msg["trial_id"]
+                    and (inc is None or key[1] == int(inc))
+                ]
+            for dq in targets:
+                dbg(f"fenced {msg['trial_id']}")
+                dq.put("stop")
         elif mtype == "shutdown":
             shutdown = True
             break
     # Unblock any trials still waiting on decisions so threads exit.
+    stop_hb.set()
     with state.dec_lock:
         for dq in state.decisions.values():
             dq.put("stop")
@@ -471,13 +574,63 @@ class RemoteWorker:
         self.hostname: str = hello.get("host", self.address)
         self.running: Dict[str, int] = {}  # trial_id -> slot
         self.alive = True
+        # Liveness bookkeeping (driver clock): last frame seen, and the
+        # suspect state a silent worker enters when its lease expires —
+        # no dispatches, trials requeued, connection kept for the
+        # reconnect-grace window (a partition heals; a dead host doesn't).
+        self.last_seen = time.time()
+        self.suspect = False
+        self.expired_at = 0.0
+        # Chaos partition (injected by the driver's fault plan): while
+        # active, frames in BOTH directions are buffered, not dropped —
+        # TCP delays delivery across a real partition, so on heal the
+        # backlog lands all at once and stale frames get fenced.
+        self._pt_lock = threading.Lock()
+        self._partition_until = 0.0
+        self._in_buffer: List[Dict[str, Any]] = []
+        self._out_buffer: List[Dict[str, Any]] = []
 
     @property
     def free_slots(self) -> int:
-        return self.slots - len(self.running) if self.alive else 0
+        if not self.alive or self.suspect:
+            return 0
+        return self.slots - len(self.running)
 
     def send(self, msg: Dict[str, Any]):
+        with self._pt_lock:
+            if time.time() < self._partition_until:
+                self._out_buffer.append(msg)
+                return
         _send(self.sock, self.send_lock, msg, self.secret)
+
+    # -- injected partition (chaos) -----------------------------------------
+
+    def partition(self, duration_s: float):
+        with self._pt_lock:
+            self._partition_until = time.time() + float(duration_s)
+
+    def receive_frames(self, msg: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Reader-thread choke point: buffer ``msg`` while partitioned;
+        on the first frame after the partition elapses, flush the held
+        outgoing frames to the worker and release the held incoming ones
+        (in arrival order, before ``msg``)."""
+        with self._pt_lock:
+            if time.time() < self._partition_until:
+                self._in_buffer.append(msg)
+                return []
+            if not self._in_buffer and not self._out_buffer:
+                return [msg]
+            backlog_in = self._in_buffer
+            backlog_out = self._out_buffer
+            self._in_buffer = []
+            self._out_buffer = []
+        for held in backlog_out:
+            try:
+                _send(self.sock, self.send_lock, held, self.secret)
+            except OSError:
+                self.alive = False
+                break
+        return backlog_in + [msg]
 
     def close(self, shutdown: bool = False):
         try:
@@ -525,6 +678,10 @@ def run_distributed(
     resume: bool = False,
     points_to_evaluate: Optional[Sequence[Dict[str, Any]]] = None,
     stop=None,
+    progress_deadline_s: Optional[float] = None,
+    progress_grace_s: Optional[float] = None,
+    worker_heartbeat_timeout_s: Optional[float] = 60.0,
+    worker_reconnect_grace_s: float = 30.0,
 ) -> ExperimentAnalysis:
     """``tune.run`` across multiple host supervisors (see module docstring).
 
@@ -550,6 +707,33 @@ def run_distributed(
     (LoggerCallback, JsonlCallback, TensorBoardCallback, ProgressReporter —
     verbose>=2 auto-attaches the live trial table); hooks run on the
     driver's single event-loop thread.
+
+    Fail-slow liveness (the fault class socket EOF cannot catch — a hung
+    worker keeps its TCP connection open):
+
+    * ``worker_heartbeat_timeout_s`` — supervisors heartbeat on the control
+      plane (every ``DML_CLUSTER_HEARTBEAT_S``, default 2s); a worker
+      silent for this long has its lease expired: no new dispatches, its
+      in-flight trials are requeued to live workers from their newest
+      checksum-valid checkpoints within ``max_failures``.  ``None``
+      disables.  A partitioned worker that speaks again within
+      ``worker_reconnect_grace_s`` of expiry rejoins the pool (its old
+      trials stay requeued; any late frames for them are fenced and the
+      zombie incarnations told to stop); one that stays silent past the
+      grace is closed and treated as dead.
+    * ``progress_deadline_s`` — per-TRIAL progress watchdog (liveness.py):
+      a dispatched trial with no result/heartbeat frame for this long is
+      counted stalled, fenced on its worker, and requeued — this catches a
+      single wedged trial thread on an otherwise-healthy (still
+      heartbeating) host.  ``progress_grace_s`` adds first-signal
+      allowance for startup/compile (default ``max(3 * deadline, 30)``).
+
+    Counters (lease expiries, stalls, requeues, fenced frames, reconnects)
+    land in ``experiment_state.json["liveness"]`` and TensorBoard.  Note
+    the fencing model is at-least-once: until a fenced incarnation reaches
+    its next report boundary it may still write checkpoint generations —
+    atomic per file, so restores stay safe, but non-deterministic
+    trainables can interleave generations from two incarnations.
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
@@ -622,7 +806,12 @@ def run_distributed(
             if msg is None:
                 events.put(("worker_dead", worker))
                 return
-            events.put(("msg", worker, msg))
+            # receive_frames is the injected-partition choke point: during
+            # a partition frames are held (last_seen frozen — the lease
+            # expiry this exercises), and the heal flushes the backlog.
+            for held in worker.receive_frames(msg):
+                worker.last_seen = time.time()
+                events.put(("msg", worker, held))
 
     def add_worker(w: RemoteWorker):
         pool.append(w)
@@ -698,6 +887,25 @@ def run_distributed(
     trainable_spec: Any = trainable
     assignment: Dict[str, RemoteWorker] = {}
 
+    from distributed_machine_learning_tpu import chaos as chaos_lib
+
+    watchdog = None
+    if progress_deadline_s is not None:
+        from distributed_machine_learning_tpu.liveness import DispatchWatchdog
+
+        # Polled from the event loop below (ticks every <=0.5s).
+        watchdog = DispatchWatchdog(
+            progress_deadline_s, first_beat_grace_s=progress_grace_s
+        )
+    liveness = {
+        "stalls_detected": 0,
+        "stall_requeues": 0,
+        "lease_expiries": 0,
+        "silent_worker_requeues": 0,
+        "fenced_frames": 0,
+        "worker_reconnects": 0,
+    }
+
     lifecycle = TrialLifecycle(
         searcher=searcher,
         scheduler=sched,
@@ -734,12 +942,15 @@ def run_distributed(
         worker.running[trial.trial_id] = slot
         assignment[trial.trial_id] = worker
         lifecycle.mark_running(trial)
+        if watchdog is not None:
+            watchdog.track(trial.trial_id)
         safe_cb("on_trial_start", trial)
         try:
             worker.send(
                 {
                     "type": "run_trial",
                     "trial_id": trial.trial_id,
+                    "incarnation": trial.incarnation,
                     "config": dict(trial.config),
                     "trainable": trainable_spec,
                     "slot": slot,
@@ -766,6 +977,123 @@ def run_distributed(
         worker = assignment.pop(trial.trial_id, None)
         if worker is not None:
             worker.running.pop(trial.trial_id, None)
+        if watchdog is not None:
+            watchdog.untrack(trial.trial_id)
+
+    def requeue_lost(trial: Trial, why: str,
+                     counter: str = "silent_worker_requeues"):
+        """Requeue a trial whose worker went silent or whose dispatch
+        stalled: rewind the restore target to the newest CHECKSUM-VALID
+        generation (the silent incarnation may have left a torn or
+        damaged newest file) and route through fail_trial so the
+        per-trial retry budget bounds requeue storms."""
+        release(trial)
+        path, it = ckpt_lib.newest_valid_checkpoint(
+            store.checkpoint_dir(trial)
+        )
+        trial.restore_path = None
+        trial.latest_checkpoint = path
+        trial.latest_checkpoint_iteration = it
+        # The valid generation may be older than what this incarnation had
+        # restored from; progress accounting must rewind with it.
+        trial.restore_base = min(trial.restore_base, it)
+        safe_cb("on_trial_error", trial, why)
+        retried = lifecycle.fail_trial(trial, why)
+        if retried:
+            liveness[counter] += 1
+        else:
+            store.write_state(trials)
+        return retried
+
+    last_enforce = [0.0]
+
+    def revive_if_suspect(worker: RemoteWorker):
+        """Any frame from a suspect worker means the silence was a
+        partition, not a death.  Within the reconnect grace it rejoins the
+        pool (its requeued trials stay requeued — late frames for them are
+        fenced); past the grace it is closed as presumed dead."""
+        if not worker.suspect or not worker.alive:
+            return
+        if time.time() - worker.expired_at <= worker_reconnect_grace_s:
+            worker.suspect = False
+            liveness["worker_reconnects"] += 1
+            log(
+                f"worker {worker.address} reconnected within grace "
+                f"({time.time() - worker.expired_at:.1f}s after lease "
+                f"expiry); rejoining pool"
+            )
+            launch_ready()
+        else:
+            log(
+                f"worker {worker.address} reappeared after the reconnect "
+                f"grace ({worker_reconnect_grace_s:.0f}s); closing"
+            )
+            worker.close()
+
+    def enforce_liveness():
+        """Lease expiry for silent WORKERS + progress deadlines for
+        dispatched TRIALS.  Rate-limited; runs every loop iteration so a
+        busy event stream cannot starve detection."""
+        now = time.time()
+        if now - last_enforce[0] < 0.25:
+            return
+        last_enforce[0] = now
+        if worker_heartbeat_timeout_s is not None:
+            for worker in pool:
+                if not worker.alive:
+                    continue
+                silent = now - worker.last_seen
+                if not worker.suspect and silent > worker_heartbeat_timeout_s:
+                    worker.suspect = True
+                    worker.expired_at = now
+                    liveness["lease_expiries"] += 1
+                    lost = [by_id[tid] for tid in list(worker.running)]
+                    log(
+                        f"worker {worker.address} silent for {silent:.1f}s "
+                        f"(> {worker_heartbeat_timeout_s:.1f}s); lease "
+                        f"expired, requeueing {len(lost)} in-flight trials"
+                    )
+                    for trial in lost:
+                        requeue_lost(
+                            trial,
+                            f"worker {worker.address} lease expired "
+                            f"(silent {silent:.1f}s — hung or partitioned)",
+                        )
+                    launch_ready()
+                elif worker.suspect and (
+                    now - worker.expired_at > worker_reconnect_grace_s
+                ):
+                    log(
+                        f"worker {worker.address} silent past the "
+                        f"reconnect grace; presumed dead, closing"
+                    )
+                    worker.close()
+        if watchdog is not None:
+            for event in watchdog.expired():
+                trial = by_id.get(event.key)
+                worker = assignment.get(event.key)
+                if trial is None or worker is None:
+                    watchdog.untrack(event.key)
+                    continue
+                trial.stall_count += 1
+                liveness["stalls_detected"] += 1
+                why = (
+                    f"stalled: no progress signal in {event.age_s:.1f}s "
+                    f"on {worker.address} (deadline "
+                    f"{event.deadline_s:.1f}s)"
+                )
+                log(f"{trial.trial_id} {why}; fencing and requeueing")
+                try:
+                    # Pre-load the stop decision so the wedged incarnation
+                    # self-fences at its next report boundary.
+                    worker.send(
+                        {"type": "fence", "trial_id": trial.trial_id,
+                         "incarnation": trial.incarnation}
+                    )
+                except OSError:
+                    worker.alive = False
+                requeue_lost(trial, why, counter="stall_requeues")
+                launch_ready()
 
     # ---- main loop ----
     try:
@@ -805,6 +1133,7 @@ def run_distributed(
                     lifecycle.finish(trial, TrialStatus.ERROR)
                 break
 
+            enforce_liveness()
             try:
                 event = events.get(timeout=0.5)
             except queue.Empty:
@@ -838,11 +1167,58 @@ def run_distributed(
 
             _, worker, msg = event
             mtype = msg.get("type")
+            # Any frame from a suspect worker is proof of life — the
+            # partition healed (or the hang cleared); decide rejoin/close.
+            revive_if_suspect(worker)
+
+            if mtype == "heartbeat":
+                continue  # liveness only; last_seen already stamped
+
             trial = by_id.get(msg.get("trial_id", ""))
             if trial is None:
                 continue
 
+            if mtype == "trial_beat":
+                # Piggybacked tune.heartbeat(): per-trial progress without
+                # a result.  Only the CURRENT incarnation's beats count — a
+                # fenced zombie must not keep its replacement looking live.
+                if watchdog is not None and (
+                    assignment.get(trial.trial_id) is worker
+                    and int(msg.get("incarnation", trial.incarnation))
+                    == trial.incarnation
+                ):
+                    watchdog.beat(trial.trial_id)
+                continue
+
+            frame_inc = int(msg.get("incarnation", trial.incarnation))
+            if (
+                assignment.get(trial.trial_id) is not worker
+                or frame_inc != trial.incarnation
+            ):
+                # Stale frame: this incarnation was requeued away (lease
+                # expiry, stall fence) while the frame was in flight or
+                # buffered behind a partition — possibly superseded on this
+                # very worker.  Never apply it — and for results, answer
+                # "stop" TO THAT INCARNATION so the zombie self-fences
+                # instead of grinding on.
+                liveness["fenced_frames"] += 1
+                if mtype == "result":
+                    try:
+                        worker.send(
+                            {
+                                "type": "decision",
+                                "trial_id": trial.trial_id,
+                                "incarnation": frame_inc,
+                                "decision": "stop",
+                            }
+                        )
+                    except OSError:
+                        worker.alive = False
+                continue
+
             if mtype == "result":
+                if watchdog is not None:
+                    watchdog.beat(trial.trial_id)
                 if msg.get("checkpoint_path"):
                     trial.latest_checkpoint = msg["checkpoint_path"]
                     trial.latest_checkpoint_iteration = int(
@@ -853,6 +1229,19 @@ def run_distributed(
                 decision = lifecycle.process_result(
                     trial, msg["metrics"], extra={"hostname": worker.hostname}
                 )
+                plan = chaos_lib.active_plan()
+                if plan is not None:
+                    # Deterministic partition injection: keyed to the Nth
+                    # processed result frame, not wall time.
+                    due = plan.poll_worker_partition()
+                    if due is not None:
+                        idx, duration = due
+                        if 0 <= idx < len(pool):
+                            log(
+                                f"chaos: partitioning worker "
+                                f"{pool[idx].address} for {duration:.1f}s"
+                            )
+                            pool[idx].partition(duration)
                 # Decision frame FIRST: the worker's report() blocks on it,
                 # so a slow observer must never sit between a result and
                 # its decision (same rule as runner.py's trial threads).
@@ -861,6 +1250,7 @@ def run_distributed(
                         {
                             "type": "decision",
                             "trial_id": trial.trial_id,
+                            "incarnation": frame_inc,
                             "decision": decision,
                         }
                     )
@@ -904,11 +1294,34 @@ def run_distributed(
             # their join_driver returns on EOF, and an operator loop around
             # it can then re-join the next driver.
             w.close(shutdown=shutdown_workers)
+        extra: Dict[str, Any] = {"wall_clock_s": wall}
+        if watchdog is not None or any(liveness.values()):
+            counters = dict(liveness)
+            if watchdog is not None:
+                counters.update(
+                    {
+                        k: v
+                        for k, v in watchdog.snapshot().items()
+                        if k not in ("stalls_detected",)  # driver-counted
+                    }
+                )
+            extra["liveness"] = counters
+        plan = chaos_lib.active_plan()
+        if plan is not None:
+            extra["injected_faults"] = plan.snapshot()
         try:
-            store.write_state(trials, extra={"wall_clock_s": wall})
+            store.write_state(trials, extra=extra)
             store.close()
         except Exception as exc:  # noqa: BLE001
             log(f"store teardown failed: {exc!r}")
+        counter_scalars = {
+            **{f"liveness/{k}": v
+               for k, v in (extra.get("liveness") or {}).items()},
+            **{f"faults/{k}": v
+               for k, v in (extra.get("injected_faults") or {}).items()},
+        }
+        if counter_scalars:
+            safe_cb("on_experiment_counters", counter_scalars)
         safe_cb("on_experiment_end", trials, wall)
 
     analysis = ExperimentAnalysis(

@@ -203,9 +203,14 @@ class ResultEvent:
 class ThreadTrialExecutor:
     """Runs each trial in a daemon thread pinned to its leased devices."""
 
-    def __init__(self, store, event_queue: "queue.Queue"):
+    def __init__(self, store, event_queue: "queue.Queue", watchdog=None):
         self.store = store
         self.events = event_queue
+        # Optional liveness.DispatchWatchdog (runner-owned): report
+        # boundaries and tune.heartbeat() calls beat it; the runner polls
+        # expiry.  Threads cannot be preempted, so a stall here is marked,
+        # never killed (the process executor owns the kill response).
+        self.watchdog = watchdog
         self._threads: Dict[str, threading.Thread] = {}
         # Async checkpoint writes: trials resume training while the D2H
         # transfer + serialization + IO run on the writer thread. Safe
@@ -255,14 +260,19 @@ class ThreadTrialExecutor:
         pending_writes = deque()  # this incarnation's in-flight ckpt paths
 
         def report_fn(metrics: Dict, checkpoint) -> str:
-            # Chaos hook (no-op without an active plan): an injected crash
-            # raises out of session.report inside the trainable and follows
-            # the ordinary error path — retry budget, checkpoint restore,
-            # device release — which is exactly what the harness verifies.
+            # Chaos hooks (no-op without an active plan): an injected hang
+            # sleeps HERE — before the result reaches the runner — so the
+            # report gap the liveness watchdog measures actually opens; an
+            # injected crash raises out of session.report inside the
+            # trainable and follows the ordinary error path — retry budget,
+            # checkpoint restore, device release.
             from distributed_machine_learning_tpu import chaos
 
             plan = chaos.active_plan()
             if plan is not None:
+                plan.maybe_hang_dispatch(
+                    trial.trial_id, trial.training_iteration + 1
+                )
                 plan.maybe_crash_trial(
                     trial.trial_id, trial.training_iteration + 1
                 )
@@ -338,7 +348,11 @@ class ThreadTrialExecutor:
             _rewind_after_fallback(trial, tree, used, used_it)
             return tree
 
-        set_session(Session(trial, report_fn, checkpoint_loader, devices))
+        heartbeat_fn = None
+        if self.watchdog is not None:
+            heartbeat_fn = lambda: self.watchdog.beat(trial.trial_id)  # noqa: E731
+        set_session(Session(trial, report_fn, checkpoint_loader, devices,
+                            heartbeat_fn=heartbeat_fn))
         try:
             # TraceAnnotation tags this trial's host activity in profiler
             # captures (ProfilerCallback), so per-trial spans are visible.
@@ -405,9 +419,13 @@ class ProcessTrialExecutor:
 
     supports_kill = True
 
-    def __init__(self, store, event_queue: "queue.Queue"):
+    def __init__(self, store, event_queue: "queue.Queue", watchdog=None):
         self.store = store
         self.events = event_queue
+        # Optional liveness.DispatchWatchdog: result and "beat" frames from
+        # the child beat it; the runner's expiry poll calls kill() — the
+        # stall response this executor exists to provide.
+        self.watchdog = watchdog
         self._procs: Dict[str, subprocess.Popen] = {}
         self._pumps: Dict[str, threading.Thread] = {}
 
@@ -553,12 +571,24 @@ class ProcessTrialExecutor:
             while True:
                 msg = pc.read_frame(proc.stdout)
                 kind = msg[0]
+                if kind == "beat":
+                    # Mid-epoch tune.heartbeat() from the child: liveness
+                    # only — no runner event, no decision.
+                    if self.watchdog is not None:
+                        self.watchdog.beat(trial.trial_id)
+                    continue
                 if kind == "result":
                     plan = chaos.active_plan()
                     if plan is not None:
-                        # Raises InjectedTrialCrash -> the generic error
-                        # path below kills/reaps the child and the runner
+                        # A hang sleeps the pump BEFORE the result event
+                        # lands — the runner-visible silence the watchdog
+                        # kills through this executor.  A crash raises
+                        # InjectedTrialCrash -> the generic error path
+                        # below kills/reaps the child and the runner
                         # retries within max_failures (chaos harness).
+                        plan.maybe_hang_dispatch(
+                            trial.trial_id, trial.training_iteration + 1
+                        )
                         plan.maybe_crash_trial(
                             trial.trial_id, trial.training_iteration + 1
                         )

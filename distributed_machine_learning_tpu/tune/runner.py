@@ -91,6 +91,8 @@ def run(
     trial_executor: str = "thread",
     resume: bool = False,
     points_to_evaluate: Optional[List[Dict[str, Any]]] = None,
+    progress_deadline_s: Optional[float] = None,
+    progress_grace_s: Optional[float] = None,
 ) -> ExperimentAnalysis:
     """Run an HPO experiment; see module docstring.
 
@@ -126,6 +128,20 @@ def run(
     ``trial_executor``: "thread" (default; lowest overhead, no preemption) or
     "process" (one OS process per trial with per-process device visibility;
     requires picklable trainables).
+    ``progress_deadline_s``: fail-SLOW detection (liveness.py).  Where
+    ``time_limit_per_trial_s`` bounds total runtime, this bounds SILENCE:
+    a trial that produces no progress signal (``tune.report`` or
+    ``tune.heartbeat``) for this long is marked STALLED — and, under the
+    process executor, killed and restarted from its newest checkpoint
+    within ``max_failures`` (the thread executor cannot preempt; the stall
+    is marked, counted, and cleared if the trial recovers).  Counters land
+    in ``experiment_state.json["liveness"]`` and TensorBoard.  Size it
+    comfortably above the slowest legitimate report gap (or call
+    ``tune.heartbeat()`` inside long epochs).
+    ``progress_grace_s``: extra allowance before each incarnation's FIRST
+    progress signal (process spawn, jax import, cold compile; default
+    ``max(3 * deadline, 30)``) so startup latency is never misread as a
+    stall.
     ``resume``: continue an interrupted experiment (requires an explicit
     ``name`` pointing at its directory): finished trials are kept and their
     metric streams replayed into the scheduler/searcher, interrupted trials
@@ -164,10 +180,19 @@ def run(
     store.set_context(metric, mode)
     device_mgr = DeviceManager(devices)
     events: "queue.Queue" = queue.Queue()
+    watchdog = None
+    if progress_deadline_s is not None:
+        from distributed_machine_learning_tpu.liveness import DispatchWatchdog
+
+        # Polled from the event loop below (which ticks every <=0.5s); no
+        # monitor thread needed.
+        watchdog = DispatchWatchdog(
+            progress_deadline_s, first_beat_grace_s=progress_grace_s
+        )
     if trial_executor == "thread":
-        executor = ThreadTrialExecutor(store, events)
+        executor = ThreadTrialExecutor(store, events, watchdog=watchdog)
     elif trial_executor == "process":
-        executor = ProcessTrialExecutor(store, events)
+        executor = ProcessTrialExecutor(store, events, watchdog=watchdog)
     else:
         raise ValueError(
             f"trial_executor must be 'thread' or 'process', got {trial_executor!r}"
@@ -226,6 +251,8 @@ def run(
             trial = pending.pop(0)
             lifecycle.mark_running(trial)
             running[trial.trial_id] = leased
+            if watchdog is not None:
+                watchdog.track(trial.trial_id)
             safe_cb("on_trial_start", trial)
             executor.start_trial(trial, trainable, leased)
 
@@ -233,9 +260,57 @@ def run(
         leased = running.pop(trial.trial_id, None)
         if leased:
             device_mgr.release(leased)
+        if watchdog is not None:
+            watchdog.untrack(trial.trial_id)
 
     # -------- main event loop ------------------------------------------------
     last_enforce = [0.0]
+    liveness_counters = {"stall_kills": 0, "stall_requeues": 0}
+    _STALL_PREFIX = "stalled: no progress signal"
+
+    def enforce_liveness():
+        """Turn watchdog expiries into actions: kill+restart under the
+        process executor (preemption-safe — the error path restores the
+        newest checksum-valid checkpoint within max_failures), mark
+        STALLED under the thread executor (threads can't be preempted;
+        a later beat flips the trial back to RUNNING)."""
+        if watchdog is None:
+            return
+        # Reconcile recoveries first: a beat may have arrived straight from
+        # the trial thread (tune.heartbeat()) since the stall was flagged.
+        for tid in list(running):
+            trial = lifecycle.by_id[tid]
+            if (
+                trial.status == TrialStatus.STALLED
+                and not watchdog.is_stalled(tid)
+            ):
+                trial.status = TrialStatus.RUNNING
+                trial.stall_recoveries += 1
+                log(f"{tid} recovered after stall (progress resumed)")
+        for event in watchdog.expired():
+            trial = lifecycle.by_id.get(event.key)
+            if trial is None or trial.trial_id not in running:
+                watchdog.untrack(event.key)
+                continue
+            trial.stall_count += 1
+            if getattr(executor, "supports_kill", False):
+                why = (
+                    f"{_STALL_PREFIX} in {event.age_s:.1f}s "
+                    f"(deadline {event.deadline_s:.1f}s)"
+                )
+                log(f"{trial.trial_id} {why}; killing incarnation "
+                    f"{trial.incarnation}")
+                liveness_counters["stall_kills"] += 1
+                executor.kill(trial, why)
+            else:
+                trial.status = TrialStatus.STALLED
+                log(
+                    f"{trial.trial_id} STALLED: no progress signal in "
+                    f"{event.age_s:.1f}s (deadline {event.deadline_s:.1f}s; "
+                    f"thread executor cannot preempt — the mark clears if "
+                    f"it beats again; use trial_executor='process' for "
+                    f"kill/restart)"
+                )
 
     def enforce_time_limits():
         """Hard preemption: a trial past its time limit that has gone quiet
@@ -286,6 +361,7 @@ def run(
                 continue
 
             enforce_time_limits()
+            enforce_liveness()
             try:
                 event = events.get(timeout=0.5)
             except queue.Empty:
@@ -333,6 +409,15 @@ def run(
             if kind == "result":
                 result_event = event[1]
                 trial = result_event.trial
+                if watchdog is not None:
+                    # A report IS progress: beat before deciding, and a
+                    # STALLED-but-reporting trial is a recovery, not a kill.
+                    watchdog.beat(trial.trial_id)
+                    if trial.status == TrialStatus.STALLED:
+                        trial.status = TrialStatus.RUNNING
+                        trial.stall_recoveries += 1
+                        log(f"{trial.trial_id} recovered after stall "
+                            f"(report resumed)")
                 result_event.decision = lifecycle.process_result(
                     trial, result_event.metrics
                 )
@@ -355,7 +440,10 @@ def run(
                 # retried (preemptions are exactly what observers watch for).
                 safe_cb("on_trial_error", trial, tb)
                 release_devices(trial)
-                if not lifecycle.fail_trial(trial, tb) and verbose:
+                retried = lifecycle.fail_trial(trial, tb)
+                if retried and tb and tb.startswith(_STALL_PREFIX):
+                    liveness_counters["stall_requeues"] += 1
+                if not retried and verbose:
                     log(f"{trial.trial_id} errored:\n{tb}")
                 store.write_state(trials)
 
@@ -391,6 +479,10 @@ def run(
             "compile_cache_hits": cc.get_tracker().total_cache_hits(),
             "compile_cache_entries": cc.cache_entry_count(),
         }
+        if watchdog is not None:
+            # Fail-slow observability next to the fail-fast counters: how
+            # many silences were detected, killed, requeued, or recovered.
+            extra["liveness"] = {**watchdog.snapshot(), **liveness_counters}
         plan = chaos.active_plan()
         if plan is not None:
             # A chaos run's state snapshot records what was injected, so
@@ -402,6 +494,14 @@ def run(
             store.close()
         except Exception as exc:  # noqa: BLE001 - callbacks still tear down
             log(f"experiment store teardown failed: {exc!r}")
+        counter_scalars = {
+            **{f"liveness/{k}": v
+               for k, v in (extra.get("liveness") or {}).items()},
+            **{f"faults/{k}": v
+               for k, v in (extra.get("injected_faults") or {}).items()},
+        }
+        if counter_scalars:
+            safe_cb("on_experiment_counters", counter_scalars)
         safe_cb("on_experiment_end", trials, wall)
     analysis = ExperimentAnalysis(
         trials, metric=metric, mode=mode, root=store.root, wall_clock_s=wall,

@@ -7,6 +7,17 @@ incompatibility, since upstream ``bayes_opt`` only models continuous params
 process with expected-improvement acquisition models the *continuous* keys
 (uniform/loguniform, normalized to the unit cube); categorical/integer keys are
 sampled randomly per suggestion.  Pure numpy — no GP library dependency.
+
+Async-safe by construction: the runner keeps up to ``max_concurrent + 2``
+trials in flight, so at suggest time the most recent proposals have no
+observations yet.  Naively ignoring them makes the acquisition re-propose
+the same optimum for every in-flight slot AND makes suggestions depend on
+completion timing (which trials happen to be observed varies with machine
+load — the full-suite flake this guards against).  Suggested-but-unfinished
+points are therefore kept as PENDING and fed to the GP with a "constant
+liar" target (the running mean): the posterior variance collapses around
+in-flight points, EI moves elsewhere, and the proposal stream is far less
+sensitive to when observations land.
 """
 
 from __future__ import annotations
@@ -66,7 +77,9 @@ class BayesOptSearch(Searcher):
         self.xi = xi
         self._X: List[np.ndarray] = []  # observed unit-cube points
         self._y: List[float] = []       # observed scores (lower = better)
-        self._pending: Dict[str, np.ndarray] = {}
+        # trial_index -> suggested-but-unobserved unit-cube point
+        # (constant-liar pending set; see module docstring).
+        self._pending: Dict[int, np.ndarray] = {}
 
     def set_search_space(self, space: SearchSpace, seed: int):
         super().set_search_space(space, seed)
@@ -88,22 +101,45 @@ class BayesOptSearch(Searcher):
     # -- searcher API --------------------------------------------------------
     def suggest(self, trial_index: int) -> Optional[Dict[str, Any]]:
         base = self.space.sample(("bayesopt", self.seed, trial_index))
-        if not self._cont_keys or len(self._y) < self.random_steps:
-            return base  # bootstrap phase: pure random (random_search_steps)
+        if not self._cont_keys:
+            return base
+        if len(self._y) < self.random_steps:
+            # Bootstrap phase: pure random (random_search_steps).  Pending
+            # registration still matters — the first GP suggestion must
+            # know which random points are already in flight.
+            self._pending[trial_index] = self._encode(base)
+            return base
 
         rng = rng_from("bayesopt-acq", self.seed, trial_index)
-        X = np.stack(self._X)
-        y = np.array(self._y)
+        # Constant liar: in-flight points enter the fit at the observed
+        # MEAN score, pinning the posterior there so EI explores elsewhere
+        # instead of stacking every concurrent slot on one argmax (and so
+        # the proposal depends far less on completion timing).
+        X_obs = np.stack(self._X)
+        y_obs = np.array(self._y)
+        if self._pending:
+            lie = float(y_obs.mean())
+            X = np.concatenate(
+                [X_obs, np.stack(list(self._pending.values()))]
+            )
+            y = np.concatenate(
+                [y_obs, np.full(len(self._pending), lie)]
+            )
+        else:
+            X, y = X_obs, y_obs
         cand = rng.random((self.num_candidates, len(self._cont_keys)))
         try:
             mu, sigma, yn = gp_posterior(
                 X, y, cand, self.lengthscale, self.noise
             )
         except np.linalg.LinAlgError:
+            self._pending[trial_index] = self._encode(base)
             return base  # degenerate kernel: stay with the random sample
 
-        # Expected improvement (minimization of normalized score).
-        best = yn.min()
+        # Expected improvement (minimization of normalized score), judged
+        # against the best OBSERVED point — liars must not shift the
+        # improvement baseline, only the posterior shape.
+        best = yn[: len(y_obs)].min()
         from math import erf, sqrt
 
         z = (best - self.xi - mu) / sigma
@@ -115,12 +151,43 @@ class BayesOptSearch(Searcher):
 
         # Re-check joint constraints after the GP overrides continuous keys.
         if not all(c(config) for c in self.space.constraints):
+            self._pending[trial_index] = self._encode(base)
             return base
+        self._pending[trial_index] = u_best
         return config
 
+    @staticmethod
+    def _trial_index_of(trial_id) -> Optional[int]:
+        # Both drivers name trials "trial_<index>"; pending bookkeeping
+        # falls back to nearest-point matching when the id doesn't parse.
+        try:
+            return int(str(trial_id).rsplit("_", 1)[-1])
+        except ValueError:
+            return None
+
+    def _clear_pending(self, trial_id, config) -> None:
+        idx = self._trial_index_of(trial_id)
+        if idx is not None:
+            self._pending.pop(idx, None)
+            return
+        if not self._pending:
+            return
+        u = self._encode(config)
+        nearest = min(
+            self._pending,
+            key=lambda k: float(((self._pending[k] - u) ** 2).sum()),
+        )
+        self._pending.pop(nearest, None)
+
     def on_trial_complete(self, trial_id, config, result, metric, mode):
+        if not self._cont_keys:
+            return
+        # Errored trials observe nothing but must still leave the pending
+        # set — a permanently-pending liar would dent the posterior there
+        # for the rest of the sweep.
+        self._clear_pending(trial_id, config)
         score = self._effective_score(result, metric, mode)
-        if score is None or not self._cont_keys:
+        if score is None:
             return
         self._X.append(self._encode(config))
         self._y.append(score)

@@ -36,11 +36,13 @@ class Session:
         report_fn: Callable[[Dict[str, Any], Optional[Any]], str],
         checkpoint_loader: Callable[[], Optional[Dict[str, Any]]],
         devices=None,
+        heartbeat_fn: Optional[Callable[[], None]] = None,
     ):
         self.trial = trial
         self._report_fn = report_fn
         self._checkpoint_loader = checkpoint_loader
         self.devices = devices or []
+        self._heartbeat_fn = heartbeat_fn
 
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Any] = None):
         decision = self._report_fn(metrics, checkpoint)
@@ -48,6 +50,12 @@ class Session:
             raise StopTrial()
         if decision == "pause":
             raise PauseTrial()
+
+    def heartbeat(self):
+        """Signal liveness WITHOUT reporting (see module-level
+        :func:`heartbeat`); no-op when the executor wired no sink."""
+        if self._heartbeat_fn is not None:
+            self._heartbeat_fn()
 
     def get_checkpoint(self) -> Optional[Dict[str, Any]]:
         return self._checkpoint_loader()
@@ -81,6 +89,19 @@ def report(_metrics: Optional[Dict[str, Any]] = None, *, checkpoint=None, **kwar
 def get_checkpoint() -> Optional[Dict[str, Any]]:
     """Return the checkpoint pytree this trial should resume from, if any."""
     return _get_session().get_checkpoint()
+
+
+def heartbeat() -> None:
+    """Mark this trial as making progress WITHOUT reporting metrics.
+
+    The liveness watchdog (``tune.run(progress_deadline_s=...)``,
+    ``run_distributed(progress_deadline_s=...)``) measures the gap between
+    progress signals; ``report`` is one implicitly.  A trainable whose
+    single epoch legitimately exceeds the deadline (huge model, cold
+    compile) calls this inside its step loop so slow-but-alive is never
+    misread as wedged.  No-op outside a watchdog-enabled run — safe to
+    call unconditionally."""
+    _get_session().heartbeat()
 
 
 def get_trial_id() -> str:

@@ -19,6 +19,12 @@ class TrialStatus(str, enum.Enum):
     PENDING = "PENDING"
     RUNNING = "RUNNING"
     PAUSED = "PAUSED"
+    # Dispatched but past its progress deadline (liveness.py watchdog): the
+    # trial is *probably* wedged but may still be alive.  A beat flips it
+    # back to RUNNING (recovery); a kill/requeue follows the ordinary error
+    # path.  On resume, STALLED counts as interrupted — requeued from its
+    # newest checkpoint like RUNNING.
+    STALLED = "STALLED"
     TERMINATED = "TERMINATED"  # finished or early-stopped, successfully
     ERROR = "ERROR"
 
@@ -74,6 +80,12 @@ class Trial:
     # incarnation that produced them so the runner can drop a dead
     # incarnation's late events instead of applying them to a retry.
     incarnation: int = 0
+
+    # Liveness bookkeeping (liveness.py): how many times this trial's
+    # dispatch went silent past the progress deadline, and how many of
+    # those episodes later produced a beat again ("slow, not dead").
+    stall_count: int = 0
+    stall_recoveries: int = 0
 
     # Runtime bookkeeping.  ``started_at`` is the FIRST start (total-runtime
     # accounting); ``restarted_at`` is the current incarnation's start —

@@ -508,6 +508,8 @@ def run_vectorized(
     points_to_evaluate: Optional[List[Dict[str, Any]]] = None,
     stop=None,
     force_restage: bool = False,
+    progress_deadline_s: Optional[float] = None,
+    progress_grace_s: Optional[float] = None,
 ) -> ExperimentAnalysis:
     """Run an HPO sweep with trials batched into vmapped populations.
 
@@ -564,6 +566,18 @@ def run_vectorized(
     content fingerprint matches a cached program's.  Only needed for
     arrays above the full-hash threshold (64 MB) edited in place at
     indices the strided sample might miss — see ``_data_checksums``.
+
+    ``progress_deadline_s``: fail-slow detection for the dispatch loop
+    (liveness.py).  A vectorized dispatch blocks this thread until the
+    device syncs, so a wedged backend (the round-4/5 tunnel incidents)
+    is pure silence; with a deadline set, a watchdog thread flags any
+    dispatch that has not synced within it — stall diagnostics (epoch
+    window, rows, age) go to stderr immediately for forensics, and
+    counters land in ``experiment_state.json["liveness"]``.  The
+    watchdog cannot unblock the device call; it makes the hang visible
+    (and the bench parent's heartbeat-staleness kill actionable) instead
+    of silent.  ``progress_grace_s`` adds first-dispatch allowance
+    (tracing + XLA compile; default ``max(3 * deadline, 30)``).
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
@@ -648,6 +662,31 @@ def run_vectorized(
 
         dispatch_safely(callbacks, hook, *cb_args, log=log)
 
+    watchdog = None
+    if progress_deadline_s is not None:
+        from distributed_machine_learning_tpu.liveness import DispatchWatchdog
+
+        def _on_dispatch_stall(event):
+            # Straight to stderr, not log(): a stalled dispatch is exactly
+            # the moment forensics channels matter (the bench parent reads
+            # the child's stderr tail after a heartbeat-staleness kill).
+            info = event.info or {}
+            print(
+                f"[tune.vectorized] WARNING: dispatch stalled — no device "
+                f"sync in {event.age_s:.1f}s (deadline "
+                f"{event.deadline_s:.1f}s): epochs "
+                f"{info.get('epoch0', '?')}..{info.get('epoch_end', '?')} "
+                f"over {info.get('rows', '?')} rows",
+                file=sys.stderr, flush=True,
+            )
+
+        # The dispatch blocks THIS thread, so detection needs the monitor
+        # thread (unlike tune.run's polled watchdog).
+        watchdog = DispatchWatchdog(
+            progress_deadline_s, on_stall=_on_dispatch_stall,
+            first_beat_grace_s=progress_grace_s,
+        ).start()
+
     mesh = pop_sharding = repl_sharding = None
     if devices and len(devices) > 1:
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -719,29 +758,43 @@ def run_vectorized(
         utilization = (
             round(min(exec_total_s / wall, 1.0), 4) if wall > 0 else 0.0
         )
+        extra = {
+            "wall_clock_s": wall,
+            "device_utilization": utilization,
+            "device_exec_s": round(exec_total_s, 3),
+            "vectorized": True,
+            "row_epochs_computed": row_epochs,
+            "population_sharded_over": (
+                len(devices) if mesh is not None else 1
+            ),
+            # This RUN's compile seconds (tracker is process-wide).
+            "compile_time_total_s": round(
+                tracker.total_seconds() - compile_s_at_start, 3
+            ),
+            "compile_cache_hits": tracker.total_cache_hits(),
+            "compile_cache_entries": cc.cache_entry_count(),
+        }
+        if watchdog is not None:
+            watchdog.close()
+            extra["liveness"] = watchdog.snapshot()
+        from distributed_machine_learning_tpu import chaos as _chaos
+
+        _plan = _chaos.active_plan()
+        if _plan is not None:
+            extra["injected_faults"] = _plan.snapshot()
         try:
-            store.write_state(
-                trials,
-                extra={
-                    "wall_clock_s": wall,
-                    "device_utilization": utilization,
-                    "device_exec_s": round(exec_total_s, 3),
-                    "vectorized": True,
-                    "row_epochs_computed": row_epochs,
-                    "population_sharded_over": (
-                        len(devices) if mesh is not None else 1
-                    ),
-                    # This RUN's compile seconds (tracker is process-wide).
-                    "compile_time_total_s": round(
-                        tracker.total_seconds() - compile_s_at_start, 3
-                    ),
-                    "compile_cache_hits": tracker.total_cache_hits(),
-                    "compile_cache_entries": cc.cache_entry_count(),
-                },
-            )
+            store.write_state(trials, extra=extra)
             store.close()
         except Exception as exc:  # noqa: BLE001 - callbacks still tear down
             log(f"experiment store teardown failed: {exc!r}")
+        counter_scalars = {
+            **{f"liveness/{k}": v
+               for k, v in (extra.get("liveness") or {}).items()},
+            **{f"faults/{k}": v
+               for k, v in (extra.get("injected_faults") or {}).items()},
+        }
+        if counter_scalars:
+            safe_cb("on_experiment_counters", counter_scalars)
         safe_cb("on_experiment_end", trials, wall)
         return wall, utilization
 
@@ -810,7 +863,7 @@ def run_vectorized(
                         log, tracker, compaction, size_multiple,
                         pop_sharding, repl_sharding, pbt, epochs_per_dispatch,
                         checkpoint_every_epochs, group_ckpt_path, resume_state,
-                        safe_cb, stop_rules=stop,
+                        safe_cb, stop_rules=stop, watchdog=watchdog,
                     )
                     resume_state = None  # consumed by the first (only) group
                     row_epochs += pop_rows
@@ -1125,6 +1178,7 @@ def _run_population(
     resume_state: Optional[Dict[str, Any]] = None,
     safe_cb=lambda *a: None,
     stop_rules=None,
+    watchdog=None,
 ) -> Tuple[int, float]:
     """Train one population of K same-shape trials to completion.
 
@@ -1366,6 +1420,10 @@ def _run_population(
             dispatch = d
 
     epoch0 = epoch_start
+    # First dispatch of a population size traces + compiles; the watchdog
+    # grants it the first-beat grace.  Compaction changes the compiled size,
+    # so the dispatch after it is cold again.
+    cold_dispatch = True
     while epoch0 < epoch_budget:
         chunk = min(dispatch, epoch_budget - epoch0)
         _progress_note(
@@ -1374,6 +1432,24 @@ def _run_population(
         )
         c0 = tracker.thread_seconds()
         t0 = time.time()
+        if watchdog is not None:
+            # One tracked entry per blocking dispatch: the monitor thread
+            # flags it (stderr diagnostics + counter) if the device never
+            # syncs within the deadline.  A chaos-injected hang exercises
+            # exactly this path.
+            watchdog.track(
+                "dispatch",
+                info={
+                    "epoch0": epoch0, "epoch_end": epoch0 + chunk,
+                    "rows": len(rows),
+                },
+                first_beat_grace_s=None if cold_dispatch else 0.0,
+            )
+        from distributed_machine_learning_tpu import chaos as _chaos
+
+        _plan = _chaos.active_plan()
+        if _plan is not None:
+            _plan.maybe_hang_dispatch("vectorized", epoch0 + 1)
         if chunk == 1:
             epoch_keys = jax.vmap(
                 lambda key: jax.random.fold_in(key, epoch0)
@@ -1402,6 +1478,9 @@ def _run_population(
         # Materialize BEFORE reading the clocks: eval execution is part of
         # the per-epoch cost the compaction model weighs (np.asarray above
         # synced everything).
+        if watchdog is not None:
+            watchdog.untrack("dispatch")
+        cold_dispatch = False
         compile_delta = tracker.thread_seconds() - c0
         exec_s = max(time.time() - t0 - compile_delta, 0.0)
         _progress_note(
@@ -1586,6 +1665,7 @@ def _run_population(
                         pop_sharding,
                     )
                 rows = [rows[i] for i in keep]
+                cold_dispatch = True  # halved size = fresh compile next
                 log(
                     f"compacted population -> {len(rows)} rows "
                     f"({len(pos)} live) at epoch {epoch}"

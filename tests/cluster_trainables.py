@@ -60,6 +60,26 @@ def crash_once_trial(config):
         )
 
 
+def slow_resumable_trial(config):
+    """Deterministic quadratic curve, checkpoint per epoch, configurable
+    per-epoch sleep — the liveness-test workload: slow enough that trials
+    are in flight when a partition/hang lands, checkpointed so a requeued
+    incarnation resumes instead of restarting, and bit-deterministic in x
+    so a faulted sweep's best trial must equal the fault-free run's."""
+    import time
+
+    x = float(config["x"])
+    restored = tune.get_checkpoint()
+    start = int(restored["epoch"]) if restored else 0
+    for epoch in range(start + 1, int(config.get("epochs", 5)) + 1):
+        time.sleep(float(config.get("sleep_s", 0.1)))
+        loss = (x - 3.0) ** 2 + 1.0 / epoch
+        tune.report(
+            {"loss": loss, "epoch": epoch},
+            checkpoint={"x": x, "epoch": epoch},
+        )
+
+
 def slow_trial(config):
     """Reports slowly; used by the worker-death test so trials are in flight."""
     import time

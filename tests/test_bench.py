@@ -110,6 +110,80 @@ def test_probe_succeeds_midway(monkeypatch):
     assert len(info["attempts"]) == 2  # stopped at first success
 
 
+def test_probe_budget_bounds_total_wall_time(monkeypatch):
+    """A wedged tunnel (every attempt burns its full timeout) must stop at
+    the hard budget, skipping attempts that could overrun it, and record
+    the wedge forensics in the probe info — not just a log tail."""
+
+    class FakeClock:
+        now = 1000.0
+
+        @classmethod
+        def time(cls):
+            return cls.now
+
+        @classmethod
+        def sleep(cls, s):
+            cls.now += s
+
+    def fake_run_child(args, env, timeout_s):
+        FakeClock.sleep(timeout_s)  # attempt burns its whole timeout
+        return 124, "", "backend hung", True
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench.time, "time", FakeClock.time)
+    monkeypatch.setattr(bench.time, "sleep", FakeClock.sleep)
+    info = {"attempts": []}
+    t0 = FakeClock.now
+    ok, tunnel_ok = bench._probe_tpu(
+        lambda m: None, info, ((120, 0), (120, 30), (180, 60)),
+        budget_s=300.0,
+    )
+    # Attempts 1+2 (+backoff) fit in 270s; attempt 3 would need 240s more
+    # and is skipped — the whole call stays inside the budget.
+    assert ok is False and tunnel_ok is True
+    assert len(info["attempts"]) == 2
+    assert info["budget_exhausted"] is True
+    assert FakeClock.now - t0 <= 300.0
+    assert info["total_s"] == pytest.approx(270.0)
+    # Per-attempt forensics travel in the artifact.
+    assert all(a["exited"] for a in info["attempts"])
+    assert info["wedged_attempts"] == 0
+    assert [a["seconds"] for a in info["attempts"]] == [120.0, 120.0]
+
+
+def test_probe_budget_allows_full_schedule_on_fast_failures(monkeypatch):
+    """Fast non-wedged failures (rc!=0 in seconds) must still get every
+    scheduled attempt — the budget bounds wedges, not retries."""
+
+    class FakeClock:
+        now = 0.0
+
+        @classmethod
+        def time(cls):
+            return cls.now
+
+        @classmethod
+        def sleep(cls, s):
+            cls.now += s
+
+    def fake_run_child(args, env, timeout_s):
+        FakeClock.sleep(3.0)  # fails quickly
+        return 1, "", "no backend", True
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench.time, "time", FakeClock.time)
+    monkeypatch.setattr(bench.time, "sleep", FakeClock.sleep)
+    info = {"attempts": []}
+    ok, _ = bench._probe_tpu(
+        lambda m: None, info, bench.PROBE_SCHEDULE,
+        budget_s=bench.PROBE_TOTAL_BUDGET_S,
+    )
+    assert ok is False
+    assert len(info["attempts"]) == len(bench.PROBE_SCHEDULE)
+    assert "budget_exhausted" not in info
+
+
 def test_main_cpu_fallback_emit_fields(monkeypatch, capsys):
     """Parent flow with every child stubbed: no tunnel -> CPU sweep +
     torch baseline -> ONE JSON line with the diagnosis fields the verdict

@@ -75,14 +75,20 @@ def test_fault_plan_is_seed_deterministic():
     assert a.snapshot()["storage_write_errors"] == sum(ta)
 
 
-def test_retrying_storage_absorbs_transient_faults(tmp_path):
+def test_retrying_storage_absorbs_transient_faults(tmp_path, monkeypatch):
+    # Relative paths on purpose: fault decisions hash the full path, so
+    # the run-varying tmp_path prefix would re-roll the schedule each run
+    # (and ~1.4% of rolls exhaust a 6-attempt budget on some file — the
+    # "fails in the full suite, passes standalone" shape).  chdir makes
+    # the decision stream identical on every run.
+    monkeypatch.chdir(tmp_path)
     plan = chaos.FaultPlan(seed=5, write_error_rate=0.3)
     backend = RetryingStorage(
         chaos.FaultyStorage(storage_lib.LocalStorage(), plan),
         RetryPolicy(attempts=6, base_delay_s=0.001, max_delay_s=0.004),
     )
     for i in range(20):
-        p = str(tmp_path / f"f{i}.bin")
+        p = f"f{i}.bin"
         backend.write_bytes(p, b"payload-%d" % i)
         assert backend.read_bytes(p) == b"payload-%d" % i
     # The faults really happened — the retries hid them.
@@ -120,17 +126,22 @@ def test_retry_call_retries_plain_functions():
         retry_call(bad, policy=policy, key="t2")
 
 
-def test_get_storage_composes_fault_and_retry_layers(tmp_path):
+def test_get_storage_composes_fault_and_retry_layers(tmp_path, monkeypatch):
+    # chdir + relative paths for the same reason as the retry test above:
+    # a 0.4 error rate against a 4-attempt budget exhausts on ~2.6% of
+    # files, so a run-varying tmp_path prefix re-rolling the schedule
+    # would fail ~22% of runs on SOME unlucky prefix.
+    monkeypatch.chdir(tmp_path)
     plan = chaos.FaultPlan(seed=9, write_error_rate=0.4)
     with chaos.active(plan):
-        backend, p = get_storage(str(tmp_path / "a.bin"))
+        backend, p = get_storage("a.bin")
         assert isinstance(backend, RetryingStorage)
         assert isinstance(backend.inner, chaos.FaultyStorage)
         for i in range(10):
-            backend.write_bytes(str(tmp_path / f"a{i}.bin"), b"x" * 32)
+            backend.write_bytes(f"a{i}.bin", b"x" * 32)
     assert plan.snapshot()["storage_write_errors"] >= 1
     # Deactivated: plain dispatch again.
-    backend, _ = get_storage(str(tmp_path / "b.bin"))
+    backend, _ = get_storage("b.bin")
     assert not isinstance(backend.inner, chaos.FaultyStorage)
 
 
