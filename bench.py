@@ -1834,6 +1834,8 @@ def emit(value: float, vs_baseline, backend: str, extra: dict) -> None:
     probe = extra.get("probe") or {}
     if probe.get("attempts"):
         compact["probe_attempts"] = len(probe["attempts"])
+    if probe.get("probe_cached"):
+        compact["probe_cached"] = probe["probe_cached"]
     # Belt-and-braces: drop optional blocks until the line fits the
     # driver's tail capture (never the metric/value/backend core).
     out = json.dumps(compact)
@@ -1867,9 +1869,24 @@ PROBE_TOTAL_BUDGET_S = 420.0
 INTER_CHILD_GAP_S = 15.0
 
 
+# One probe verdict per bench invocation: BENCH_r05 ran FOUR separate
+# probe windows (~18 min of timeouts + backoff) in one round — the main
+# schedule, then the late re-probe — all after the CPU-fallback decision
+# was already made.  The tunnel's state does not flip between stages of
+# one run often enough to justify re-burning the budget, so the first
+# _probe_tpu call decides and every later call reuses the verdict (the
+# artifact records ``probe_cached`` so a cached reuse is visible).
+_PROBE_MEMO: dict = {}
+
+
 def _probe_tpu(log, probe_info, schedule,
                budget_s: float = PROBE_TOTAL_BUDGET_S) -> tuple:
     """Run probe attempts per ``schedule``; returns (probe_ok, tunnel_ok).
+
+    Memoized per invocation: the first call's verdict is reused by every
+    later call in this process (``probe_cached`` counts the reuses in
+    ``probe_info`` -> the BENCH artifact) — stages after the backend
+    decision never re-pay probe timeouts.
 
     Bounded: total wall time (backoffs + attempts) stays under
     ``budget_s`` — an attempt that could overrun it is skipped rather than
@@ -1879,6 +1896,14 @@ def _probe_tpu(log, probe_info, schedule,
     artifact), so a wedged round carries its own forensics instead of only
     a log tail: ``total_s``, ``budget_exhausted``, ``wedged_attempts``,
     and the per-attempt records say what happened and what it cost."""
+    if "verdict" in _PROBE_MEMO:
+        probe_ok, tunnel_ok = _PROBE_MEMO["verdict"]
+        probe_info["probe_cached"] = probe_info.get("probe_cached", 0) + 1
+        log(
+            f"probe verdict cached (probe_ok={probe_ok}, "
+            f"tunnel_ok={tunnel_ok}); reusing without re-probing"
+        )
+        return probe_ok, tunnel_ok
     probe_ok, tunnel_ok = False, True
     t_start = time.time()
     for timeout_s, backoff_s in schedule:
@@ -1925,6 +1950,7 @@ def _probe_tpu(log, probe_info, schedule,
     probe_info["wedged_attempts"] = sum(
         1 for a in probe_info["attempts"] if not a.get("exited", True)
     )
+    _PROBE_MEMO["verdict"] = (probe_ok, tunnel_ok)
     return probe_ok, tunnel_ok
 
 

@@ -54,6 +54,14 @@ class InjectedTrialCrash(InjectedFault, RuntimeError):
     """A trial killed at a scheduled epoch (preemption stand-in)."""
 
 
+class InjectedCommitKill(InjectedFault, RuntimeError):
+    """A process killed between a sharded checkpoint's chunk writes and its
+    COMMIT marker.  Deliberately NOT an OSError: the storage retry policy
+    must not absorb it — a real SIGKILL doesn't retry either.  The save
+    fails with the generation left uncommitted, exercising the ckpt/
+    commit protocol (readers skip it; the manager deletes it on start)."""
+
+
 def _hash_fraction(*parts) -> float:
     """Uniform [0, 1) value from a stable hash of the parts."""
     h = hashlib.sha256("/".join(str(p) for p in parts).encode()).digest()
@@ -77,6 +85,15 @@ class FaultPlan:
     * ``corrupt_path_substrings`` — the first write whose path contains
       each substring has its payload bit-flipped ON DISK (the manifest
       checksum is computed upstream, so restore detects the damage).
+    * ``chunk_write_error_rate`` — like ``write_error_rate`` but ONLY for
+      sharded-checkpoint chunk files (``*.chunk``, ``ckpt/format.py``):
+      per-chunk fault pressure on the new format without touching metrics
+      or state writes.  Transient (retries re-roll); rates high enough to
+      exhaust the retry budget leave the generation uncommitted.
+    * ``kill_before_commit`` — path substrings; the first write of a
+      ``COMMIT`` marker whose generation path contains each substring
+      raises :class:`InjectedCommitKill` instead of landing — the
+      kill-between-chunks-and-COMMIT preemption (fires once per entry).
     * ``trial_crashes`` — ``(trial_id, training_iteration)`` pairs; the
       executor raises :class:`InjectedTrialCrash` at that report boundary.
     * ``replica_kills`` — ``(request_index, replica_idx)`` pairs; the
@@ -111,6 +128,8 @@ class FaultPlan:
         read_error_rate: float = 0.0,
         slow_rate: float = 0.0,
         slow_s: float = 0.02,
+        chunk_write_error_rate: float = 0.0,
+        kill_before_commit: Sequence[str] = (),
         corrupt_path_substrings: Sequence[str] = (),
         trial_crashes: Iterable[Tuple[str, int]] = (),
         replica_kills: Iterable[Tuple[int, int]] = (),
@@ -125,6 +144,8 @@ class FaultPlan:
         self.read_error_rate = float(read_error_rate)
         self.slow_rate = float(slow_rate)
         self.slow_s = float(slow_s)
+        self.chunk_write_error_rate = float(chunk_write_error_rate)
+        self._commit_kill_pending: List[str] = list(kill_before_commit)
         self._corrupt_pending: List[str] = list(corrupt_path_substrings)
         self._trial_crashes = {(str(t), int(i)) for t, i in trial_crashes}
         self._kills = sorted(
@@ -191,6 +212,31 @@ class FaultPlan:
         if self._roll("slow", f"{op}:{path}", self.slow_rate):
             self._count("storage_slow")
             time.sleep(self.slow_s)
+        if op == "write" and path.rstrip("/").endswith("/COMMIT"):
+            # Kill-between-chunks-and-COMMIT: the generation's data is all
+            # on storage, its marker never lands — a preempted save.
+            with self._lock:
+                hit = next(
+                    (s for s in self._commit_kill_pending if s in path), None
+                )
+                if hit is not None:
+                    self._commit_kill_pending.remove(hit)
+                    self._counters["commit_kills"] = (
+                        self._counters.get("commit_kills", 0) + 1
+                    )
+            if hit is not None:
+                raise InjectedCommitKill(
+                    f"injected kill before COMMIT of {path}"
+                )
+        if (
+            op == "write"
+            and path.endswith(".chunk")
+            and self._roll("chunk_write", path, self.chunk_write_error_rate)
+        ):
+            self._count("chunk_write_errors")
+            raise InjectedIOError(
+                f"injected transient chunk write fault on {path}"
+            )
         rate = (self.write_error_rate if op == "write"
                 else self.read_error_rate if op == "read" else 0.0)
         if self._roll(op, path, rate):

@@ -1,0 +1,49 @@
+"""Distributed checkpointing: async, sharded, resharding-on-restore.
+
+The subsystem the Orbax paper (PAPERS.md) argues production JAX training
+stands on, grown natively here:
+
+* :mod:`~distributed_machine_learning_tpu.ckpt.format` — per-shard chunk
+  files + JSON index + atomic COMMIT marker; no pickle, topology-portable;
+* :mod:`~distributed_machine_learning_tpu.ckpt.writer` — async saves
+  (snapshot on the caller, serialize/write in the background);
+* :mod:`~distributed_machine_learning_tpu.ckpt.manager` — generations,
+  retention, newest-committed-valid fallback, uncommitted cleanup;
+* :mod:`~distributed_machine_learning_tpu.ckpt.metrics` — save/restore
+  wall, bytes, and async-overlap counters (published by every driver into
+  ``experiment_state.json["checkpoint"]`` and TensorBoard).
+
+``tune/checkpoint.py`` remains the compatibility shim over the legacy
+msgpack blobs; its generation logic now routes through this package, so a
+trial directory can mix both formats and every restore path (retry,
+cluster requeue, serve export) handles either.
+"""
+
+from distributed_machine_learning_tpu.ckpt.format import (  # noqa: F401
+    CheckpointCorruptionError,
+    COMMIT_NAME,
+    INDEX_NAME,
+    generation_name,
+    is_committed,
+    is_sharded_path,
+    load_sharded,
+    save_sharded,
+)
+from distributed_machine_learning_tpu.ckpt.manager import (  # noqa: F401
+    CheckpointManager,
+    cleanup_uncommitted,
+    latest_generation,
+    list_generations,
+    newest_valid_generation,
+    prune_generations,
+    restore_with_fallback,
+    step_of_path,
+    step_path,
+)
+from distributed_machine_learning_tpu.ckpt.metrics import (  # noqa: F401
+    get_metrics,
+    note_step,
+)
+from distributed_machine_learning_tpu.ckpt.writer import (  # noqa: F401
+    AsyncCheckpointer,
+)

@@ -1,0 +1,339 @@
+"""Generation management: retention, committed-fallback, cleanup.
+
+THE owner of checkpoint-generation semantics for both on-disk formats:
+
+* ``ckpt_NNNNNN.msgpack`` — the legacy single-blob format
+  (``tune/checkpoint.py``, which now delegates its generation walking
+  here and stays as the compatibility shim);
+* ``gen_NNNNNN/`` — the sharded chunked format (``ckpt/format.py``).
+
+Both can coexist in one directory (a trial upgraded mid-experiment keeps
+restoring), ordered by step.  "Valid" means: passes its integrity check —
+a sharded generation must be COMMITTED (chunks -> index -> COMMIT all
+landed) and checksum-clean; a msgpack file must match its manifest sidecar
+and decode.
+
+:class:`CheckpointManager` wraps one directory with save (sync or async),
+newest-committed-valid restore fallback, retention, and
+uncommitted-generation cleanup on start — the lifecycle every driver
+(executors, cluster requeue, vectorized populations) routes through.
+"""
+
+from __future__ import annotations
+
+import posixpath
+import re
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from distributed_machine_learning_tpu.ckpt import format as fmt
+from distributed_machine_learning_tpu.ckpt.metrics import get_metrics
+from distributed_machine_learning_tpu.ckpt.writer import AsyncCheckpointer
+from distributed_machine_learning_tpu.tune.storage import get_storage
+
+MSGPACK_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
+
+FORMATS = ("msgpack", "sharded")
+
+
+def _legacy():
+    # Function-level import: tune.checkpoint imports this module's helpers
+    # (the shim direction); the reverse edge must stay lazy.
+    from distributed_machine_learning_tpu.tune import checkpoint as ckpt_lib
+
+    return ckpt_lib
+
+
+def step_of_path(path: str) -> int:
+    """Step encoded in a checkpoint path of either format (0 if neither)."""
+    base = posixpath.basename(str(path).rstrip("/"))
+    m = MSGPACK_RE.match(base)
+    if m:
+        return int(m.group(1))
+    m = fmt.GEN_RE.match(base)
+    return int(m.group(1)) if m else 0
+
+
+def step_path(directory: str, step: int, checkpoint_format: str = "msgpack") -> str:
+    """The canonical path of generation ``step`` under ``directory``."""
+    if checkpoint_format not in FORMATS:
+        raise ValueError(
+            f"checkpoint_format must be one of {FORMATS}, "
+            f"got {checkpoint_format!r}"
+        )
+    backend, d = get_storage(directory)
+    name = (
+        f"ckpt_{int(step):06d}.msgpack"
+        if checkpoint_format == "msgpack"
+        else fmt.generation_name(step)
+    )
+    return backend.join(d, name)
+
+
+def list_generations(directory: str) -> List[Tuple[int, str, str]]:
+    """Sorted ``(step, full_path, kind)`` for every generation of either
+    format under ``directory`` (kind in :data:`FORMATS`)."""
+    backend, d = get_storage(directory)
+    out: List[Tuple[int, str, str]] = []
+    for name in backend.listdir(d):
+        m = MSGPACK_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), backend.join(d, name), "msgpack"))
+            continue
+        m = fmt.GEN_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), backend.join(d, name), "sharded"))
+    return sorted(out, key=lambda e: (e[0], e[2]))
+
+
+def latest_generation(directory: str) -> Tuple[Optional[str], int]:
+    """(path, step) of the newest generation BY NAME (no integrity check),
+    or (None, 0)."""
+    gens = list_generations(directory)
+    if not gens:
+        return None, 0
+    step, path, _ = gens[-1]
+    return path, step
+
+
+def newest_valid_generation(directory: str) -> Tuple[Optional[str], int]:
+    """(path, step) of the newest generation that passes its integrity
+    check (committed + checksum-clean), or (None, 0)."""
+    for step, path, kind in reversed(list_generations(directory)):
+        if kind == "sharded":
+            if fmt.is_committed(path):
+                return path, step
+        elif _legacy().verify_checkpoint(path):
+            return path, step
+    return None, 0
+
+
+def restore_with_fallback(
+    path: Optional[str], directory: Optional[str] = None, log=None,
+    shardings=None,
+) -> Tuple[Optional[Dict[str, Any]], Optional[str], int]:
+    """Restore ``path``; on corruption (torn sharded save, bad checksum,
+    undecodable blob) fall back to the newest VALID generation under
+    ``directory``.  Returns ``(tree, used_path, used_step)`` —
+    ``(None, None, 0)`` when nothing restorable survives."""
+    emit = log or (lambda msg: print(f"[ckpt] {msg}", flush=True))
+    load = _legacy().load_checkpoint
+    metrics = get_metrics()
+    if not path:
+        # No restore target = a fresh trial; never restore one by accident.
+        return None, None, 0
+    try:
+        tree = load(path, shardings=shardings)
+        if tree is not None:
+            return tree, path, step_of_path(path)
+        emit(f"restore target {path} is missing")
+    except fmt.CheckpointCorruptionError as exc:
+        emit(f"restore target is corrupt: {exc}")
+    if not directory:
+        return None, None, 0
+    fell_back = False
+    for step, full, _kind in reversed(list_generations(directory)):
+        if full == path:
+            continue  # already tried (and failed) above
+        try:
+            tree = load(full, shardings=shardings)
+        except fmt.CheckpointCorruptionError as exc:
+            emit(f"skipping corrupt generation {full}: {exc}")
+            metrics.add("corrupt_generations_skipped")
+            fell_back = True
+            continue
+        if tree is not None:
+            emit(f"fell back to valid generation {full} (step={step})")
+            metrics.add("restore_fallbacks")
+            return tree, full, step
+    if fell_back:
+        metrics.add("restore_fallbacks")
+    return None, None, 0
+
+
+def prune_generations(directory: str, keep: int, protect=None,
+                      pending_latest: Optional[str] = None) -> int:
+    """Keep only the ``keep`` newest generations (either format) under
+    ``directory``; semantics match the legacy
+    ``tune.checkpoint.prune_checkpoints`` (protect set, in-flight
+    ``pending_latest`` alias).  Returns generations deleted."""
+    if keep <= 0:
+        return 0
+    if protect is None:
+        protected = set()
+    elif isinstance(protect, str):
+        protected = {protect}
+    else:
+        protected = set(protect)
+    if pending_latest is not None:
+        protected.add(pending_latest)
+    gens = list_generations(directory)
+    excess = gens[:-keep] if len(gens) > keep else []
+    backend, _ = get_storage(directory)
+    deleted = 0
+    for _step, full, kind in excess:
+        if full in protected:
+            continue
+        if kind == "sharded":
+            fmt.delete_generation(full)
+        else:
+            backend.delete(full)
+            # Integrity sidecar rides with its checkpoint (absent for
+            # legacy generations; delete is a no-op then).
+            backend.delete(_legacy().manifest_path_for(full))
+        deleted += 1
+    if deleted:
+        get_metrics().add("generations_pruned", deleted)
+    return deleted
+
+
+def cleanup_uncommitted(directory: str, log=None) -> int:
+    """Delete sharded generations without a valid COMMIT — the debris of a
+    preempted save.  ONLY safe at start (driver/worker boot, experiment
+    resume), before any writer is live: an in-flight async save looks
+    exactly like debris until its COMMIT lands.  Returns count removed."""
+    emit = log or (lambda msg: print(f"[ckpt] {msg}", flush=True))
+    removed = 0
+    for _step, full, kind in list_generations(directory):
+        if kind != "sharded" or fmt.is_committed(full):
+            continue
+        fmt.delete_generation(full)
+        emit(f"removed uncommitted generation {full}")
+        removed += 1
+    if removed:
+        get_metrics().add("uncommitted_cleaned", removed)
+    return removed
+
+
+class CheckpointManager:
+    """Generations under one directory: save / restore / retention.
+
+    ``checkpoint_format`` picks what :meth:`save` writes; restore handles
+    both formats regardless (a directory can hold a mixed history).
+    ``async_save`` overlaps serialization+I/O with training (snapshot on
+    the caller, write on a background thread; ``wait_until_finished`` is
+    the barrier and a failed write surfaces on the next save).
+    ``keep`` > 0 prunes to the newest K after each save.  On construction
+    the manager removes uncommitted debris left by a preempted writer.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        checkpoint_format: str = "sharded",
+        keep: int = 0,
+        async_save: bool = False,
+        clean_on_start: bool = True,
+        log=None,
+    ):
+        if checkpoint_format not in FORMATS:
+            raise ValueError(
+                f"checkpoint_format must be one of {FORMATS}, "
+                f"got {checkpoint_format!r}"
+            )
+        self.directory = directory
+        self.checkpoint_format = checkpoint_format
+        self.keep = int(keep)
+        self._log = log or (lambda msg: print(f"[ckpt] {msg}", flush=True))
+        self._writer: Optional[AsyncCheckpointer] = None
+        self._async = bool(async_save)
+        self._pending_path: Optional[str] = None
+        if clean_on_start:
+            cleanup_uncommitted(directory, log=self._log)
+
+    # -- paths / listing -----------------------------------------------------
+
+    def step_path(self, step: int) -> str:
+        return step_path(self.directory, step, self.checkpoint_format)
+
+    def all_steps(self) -> List[int]:
+        return [s for s, _p, _k in list_generations(self.directory)]
+
+    def latest(self) -> Tuple[Optional[str], int]:
+        return latest_generation(self.directory)
+
+    def newest_valid(self) -> Tuple[Optional[str], int]:
+        return newest_valid_generation(self.directory)
+
+    # -- save ----------------------------------------------------------------
+
+    def save(self, step: int, tree, wait: bool = False) -> str:
+        """Write generation ``step``; returns its path.  Async unless the
+        manager is synchronous or ``wait=True``."""
+        path = self.step_path(step)
+        if self._async and not wait:
+            if self._writer is None:
+                self._writer = AsyncCheckpointer(log=self._log)
+            if self.checkpoint_format == "msgpack":
+                # The legacy blob writer is synchronous by design (its
+                # async path lives in tune.checkpoint.AsyncCheckpointWriter
+                # used by the executors); snapshot-now semantics only exist
+                # for the sharded format.
+                self._save_sync(path, tree)
+            else:
+                self._writer.save(path, tree)
+            self._pending_path = path
+        else:
+            self._save_sync(path, tree)
+            self._pending_path = None
+        if self.keep > 0:
+            try:
+                prune_generations(
+                    self.directory, self.keep,
+                    pending_latest=self._pending_path,
+                )
+            except Exception as exc:  # noqa: BLE001 - retention never kills
+                self._log(f"retention prune failed: {exc!r}")
+        return path
+
+    def _save_sync(self, path: str, tree) -> None:
+        if self.checkpoint_format == "sharded":
+            fmt.save_sharded(path, tree)
+        else:
+            t0 = time.time()
+            _legacy().save_checkpoint(path, tree)
+            # save_checkpoint records its own bytes; wall only here would
+            # double count, so nothing extra to do.
+            del t0
+
+    def wait_until_finished(self, timeout: Optional[float] = None) -> bool:
+        if self._writer is None:
+            return True
+        ok = self._writer.wait_until_finished(timeout=timeout)
+        if ok:
+            self._pending_path = None
+        return ok
+
+    # -- restore -------------------------------------------------------------
+
+    def restore(
+        self, path: Optional[str] = None, shardings=None,
+    ) -> Tuple[Optional[Dict[str, Any]], Optional[str], int]:
+        """Restore ``path`` (default: the newest generation), falling back
+        to older VALID generations on corruption.  ``shardings`` reshards
+        array leaves onto a target mesh (see ``ckpt.format.load_sharded``).
+        """
+        self.wait_until_finished(timeout=120.0)
+        if path is None:
+            path, _ = self.latest()
+            if path is None:
+                return None, None, 0
+        return restore_with_fallback(
+            path, self.directory, log=self._log, shardings=shardings,
+        )
+
+    # -- retention / teardown -------------------------------------------------
+
+    def prune(self, keep: Optional[int] = None, protect=None,
+              pending_latest: Optional[str] = None) -> int:
+        return prune_generations(
+            self.directory, self.keep if keep is None else keep,
+            protect=protect,
+            pending_latest=pending_latest or self._pending_path,
+        )
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None

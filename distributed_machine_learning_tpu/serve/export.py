@@ -44,6 +44,10 @@ class ServableBundle:
     variables: Dict[str, Any]  # {"params": ..., ["batch_stats": ...]}
     manifest: Dict[str, Any] = field(default_factory=dict)
     path: Optional[str] = None
+    # Wall seconds spent restoring params at load_bundle time — the
+    # checkpoint-to-serving cost the Gemma study (PAPERS.md) calls out;
+    # surfaced by the HTTP server's /metrics.
+    checkpoint_load_s: float = 0.0
 
     @property
     def model_family(self) -> str:
@@ -118,7 +122,13 @@ def export_bundle(
         ckpt_path, _ = ckpt_lib.find_latest_checkpoint(
             backend.join(root, trial.trial_id, "checkpoints")
         )
+    # load_checkpoint handles both formats: a sharded ``gen_NNNNNN``
+    # generation (any mesh/device count wrote it) GATHERS to full host
+    # arrays via the resharding restore — the bundle is always a
+    # single-host artifact a serving process loads without a mesh.
+    t_load = time.time()
     ckpt = ckpt_lib.load_checkpoint(ckpt_path) if ckpt_path else None
+    ckpt_load_s = time.time() - t_load
     if ckpt is None or "params" not in ckpt:
         raise ValueError(
             f"trial {trial.trial_id} has no restorable checkpoint "
@@ -143,6 +153,10 @@ def export_bundle(
             "experiment": analysis.root,
             "trial_id": trial.trial_id,
             "checkpoint": ckpt_path,
+            "checkpoint_format": (
+                "sharded" if _is_sharded_source(ckpt_path) else "msgpack"
+            ),
+            "checkpoint_load_s": round(ckpt_load_s, 4),
         },
     }
 
@@ -156,6 +170,16 @@ def export_bundle(
     # original checkpoint.
     ckpt_lib.save_checkpoint(backend.join(out, PARAMS_NAME), variables)
     return out_dir
+
+
+def _is_sharded_source(path: Optional[str]) -> bool:
+    if not path:
+        return False
+    from distributed_machine_learning_tpu.ckpt import format as _fmt
+
+    import posixpath
+
+    return bool(_fmt.GEN_RE.match(posixpath.basename(str(path).rstrip("/"))))
 
 
 def _servable_config(config: Dict[str, Any]) -> Dict[str, Any]:
@@ -190,7 +214,9 @@ def load_bundle(bundle_dir: str) -> ServableBundle:
             f"bundle at {bundle_dir!r} has version {version!r}; this "
             f"build reads version {BUNDLE_VERSION}"
         )
+    t_load = time.time()
     variables = ckpt_lib.load_checkpoint(backend.join(d, PARAMS_NAME))
+    checkpoint_load_s = time.time() - t_load
     if variables is None or "params" not in variables:
         raise FileNotFoundError(
             f"bundle at {bundle_dir!r} is missing {PARAMS_NAME}"
@@ -200,4 +226,5 @@ def load_bundle(bundle_dir: str) -> ServableBundle:
         variables=variables,
         manifest=manifest,
         path=bundle_dir,
+        checkpoint_load_s=round(checkpoint_load_s, 4),
     )

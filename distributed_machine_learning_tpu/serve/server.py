@@ -120,6 +120,11 @@ class PredictionServer:
             "num_healthy": self.replicas.num_healthy(),
             "breakers": self.replicas.breaker_stats(),
             "restarts": self.replicas.restarts,
+            # Checkpoint-to-ready cost (bundle params restore at load
+            # time): the serving-side half of the ckpt/ wall-time story.
+            "checkpoint_load_s": round(
+                getattr(self.bundle, "checkpoint_load_s", 0.0), 4
+            ),
         }
         if self._fault_plan is not None:
             # A chaos soak's injections are observable where the breaker

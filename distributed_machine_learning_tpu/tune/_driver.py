@@ -142,6 +142,15 @@ class TrialLifecycle:
             # snapshot raced the crash re-runs from its last checkpoint.
             status = meta.get("status", "PENDING") if meta else "PENDING"
             finished = status in ("TERMINATED", "ERROR")
+            # Start-of-run cleanup (safe here: no writer is live yet): a
+            # sharded save the dead driver left half-written is deleted, so
+            # find_latest below only ever names restorable generations.
+            try:
+                ckpt_lib.cleanup_uncommitted(
+                    self.store.checkpoint_dir(trial), log=self.log
+                )
+            except Exception as exc:  # noqa: BLE001 - cleanup is best-effort
+                self.log(f"uncommitted-checkpoint cleanup failed: {exc!r}")
             ck_path, ck_it = ckpt_lib.find_latest_checkpoint(
                 self.store.checkpoint_dir(trial)
             )

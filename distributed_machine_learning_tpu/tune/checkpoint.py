@@ -1,22 +1,27 @@
-"""Pytree checkpoint save/restore over pluggable storage.
+"""Pytree checkpoint save/restore over pluggable storage — the
+compatibility shim over the :mod:`distributed_machine_learning_tpu.ckpt`
+subsystem.
 
-The reference has no checkpointing at all (SURVEY.md §5: no torch.save/load,
-no ``tune.checkpoint_dir`` anywhere); PBT and preemption-aware recovery make it
-first-class here.  Format: flax msgpack for the array pytree (framework- and
-process-portable, no pickle).  Paths route through ``tune.storage`` so the
-same code writes local files (atomically — a preempted write never leaves a
-truncated checkpoint), ``gs://`` objects on a real pod, or the in-memory test
-fake, selected purely by the path's scheme.
+Two on-disk formats, one API:
 
-Integrity: every save also writes a ``<path>.manifest.json`` sidecar with
-the payload's sha256 (orbax treats checkpoint integrity as first-class for
-the same reason — shared storage bitrot and interrupted writes are real).
-``load_checkpoint`` verifies the checksum (and that the bytes decode) and
-raises :class:`CheckpointCorruptionError` on damage;
-``load_checkpoint_with_fallback`` then walks older generations newest-first
-so a trial restores from the newest checksum-valid checkpoint instead of
-crashing — retention (``keep_checkpoints_num``) keeps the last K
-generations around precisely to make that fallback possible.
+* **legacy msgpack blob** (``ckpt_NNNNNN.msgpack`` + ``.manifest.json``
+  sha256 sidecar) — flax msgpack of the whole pytree, written atomically;
+  the format every pre-``ckpt/`` experiment on disk already uses.
+* **sharded generation** (``gen_NNNNNN/`` — per-shard chunk files + JSON
+  index + COMMIT marker, ``ckpt/format.py``) — async-friendly and
+  topology-portable (restore onto a different mesh/device count).
+
+``save_checkpoint``/``load_checkpoint`` dispatch on the path;
+generation-walking logic (``find_latest_checkpoint``,
+``newest_valid_checkpoint``, ``load_checkpoint_with_fallback``,
+``prune_checkpoints``) delegates to ``ckpt.manager``, which understands
+both formats in one directory — so executors, cluster requeue, resume, and
+serve export all keep their call sites while gaining sharded checkpoints.
+Which format new checkpoints use is the caller's choice via
+``checkpoint_path(..., checkpoint_format=...)`` (``tune.run`` exposes it).
+
+No pickle anywhere on this path — both formats stay process- and
+framework-portable (enforced by the import-guard test in CI).
 """
 
 from __future__ import annotations
@@ -34,15 +39,16 @@ import jax
 import numpy as np
 from flax import serialization
 
+from distributed_machine_learning_tpu.ckpt import format as _sharded_fmt
+from distributed_machine_learning_tpu.ckpt.format import (  # noqa: F401
+    CheckpointCorruptionError,
+)
+from distributed_machine_learning_tpu.ckpt.metrics import get_metrics
 from distributed_machine_learning_tpu.tune.storage import get_storage
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
 
 MANIFEST_SUFFIX = ".manifest.json"
-
-
-class CheckpointCorruptionError(Exception):
-    """Stored checkpoint bytes fail their checksum or do not decode."""
 
 
 def manifest_path_for(path: str) -> str:
@@ -56,14 +62,29 @@ def _to_host(tree):
     )
 
 
+def _is_sharded(path: str) -> bool:
+    """Format dispatch for one path: generation-dir name, else the
+    ``.msgpack`` suffix decides cheaply, else probe for an index file."""
+    base = os.path.basename(str(path).rstrip("/"))
+    if _sharded_fmt.GEN_RE.match(base):
+        return True
+    if base.endswith(".msgpack") or base.endswith(".ckpt"):
+        return False
+    return _sharded_fmt.is_sharded_path(path)
+
+
 def save_checkpoint(path: str, tree: Dict[str, Any]) -> str:
     """Serialize a pytree dict to ``path`` (any storage scheme). Returns path.
 
-    A ``<path>.manifest.json`` sidecar (sha256 + byte count) is written
-    AFTER the payload: a crash between the two leaves a checkpoint that is
-    merely unverifiable (legacy semantics — decode-checked only), never a
-    manifest pointing at absent data.
+    A ``gen_NNNNNN`` path writes the sharded chunked format (atomic COMMIT
+    protocol); anything else writes the legacy msgpack blob whose
+    ``<path>.manifest.json`` sidecar (sha256 + byte count) lands AFTER the
+    payload — a crash between the two leaves a checkpoint that is merely
+    unverifiable, never a manifest pointing at absent data.
     """
+    if _is_sharded(path):
+        return _sharded_fmt.save_sharded(path, tree)
+    t0 = time.time()
     payload = serialization.to_bytes(_to_host(tree))
     backend, p = get_storage(path)
     backend.write_bytes(p, payload)
@@ -75,20 +96,33 @@ def save_checkpoint(path: str, tree: Dict[str, Any]) -> str:
     backend.write_bytes(
         manifest_path_for(p), json.dumps(manifest).encode()
     )
+    get_metrics().record_save(time.time() - t0, len(payload), 1)
     return path
 
 
-def load_checkpoint(path: str, verify: bool = True) -> Optional[Dict[str, Any]]:
-    """Decode a checkpoint without needing a target template (msgpack restore).
+def load_checkpoint(
+    path: str, verify: bool = True, shardings=None,
+) -> Optional[Dict[str, Any]]:
+    """Decode a checkpoint without needing a target template.
 
-    With ``verify`` (default), the sidecar manifest's sha256 is checked
-    before decoding and undecodable bytes raise
-    :class:`CheckpointCorruptionError` — a missing manifest (legacy
-    checkpoint, or a save interrupted between payload and sidecar) demotes
-    to decode-checking only.
+    Sharded generations restore through ``ckpt.format.load_sharded`` —
+    pass ``shardings`` to reshard array leaves onto a target mesh; without
+    it arrays gather to full numpy (bit-identical to what was saved,
+    whatever topology saved it).  Legacy blobs ignore ``shardings`` (they
+    are host-gathered by construction).
+
+    With ``verify`` (default) integrity is checked before decoding —
+    manifest sha256 for msgpack (a missing manifest demotes to
+    decode-checking), COMMIT + per-chunk sha256 for sharded — and damage
+    raises :class:`CheckpointCorruptionError`.
     """
     if not path:
         return None
+    if _is_sharded(path):
+        return _sharded_fmt.load_sharded(
+            path, verify=verify, shardings=shardings
+        )
+    t0 = time.time()
     backend, p = get_storage(path)
     data = backend.read_bytes(p)
     if data is None:
@@ -108,12 +142,16 @@ def load_checkpoint(path: str, verify: bool = True) -> Optional[Dict[str, Any]]:
                     f"({len(data)} bytes on storage)"
                 )
         try:
-            return serialization.msgpack_restore(data)
+            tree = serialization.msgpack_restore(data)
         except Exception as exc:  # noqa: BLE001 - damaged bytes, any decoder error
             raise CheckpointCorruptionError(
                 f"undecodable checkpoint at {path}: {exc!r}"
             ) from exc
-    return serialization.msgpack_restore(data)
+        get_metrics().record_restore(time.time() - t0, len(data))
+        return tree
+    tree = serialization.msgpack_restore(data)
+    get_metrics().record_restore(time.time() - t0, len(data))
+    return tree
 
 
 def verify_checkpoint(path: str) -> bool:
@@ -125,55 +163,31 @@ def verify_checkpoint(path: str) -> bool:
 
 
 def _iteration_of(path: str) -> int:
-    m = _CKPT_RE.match(os.path.basename(path.rstrip("/")))
-    return int(m.group(1)) if m else 0
+    from distributed_machine_learning_tpu.ckpt.manager import step_of_path
+
+    return step_of_path(path)
 
 
 def load_checkpoint_with_fallback(
     path: Optional[str], directory: Optional[str] = None, log=None,
+    shardings=None,
 ) -> Tuple[Optional[Dict[str, Any]], Optional[str], int]:
     """Restore ``path``; on corruption fall back to the newest
-    checksum-valid generation under ``directory``.
+    valid generation (either format) under ``directory``.
 
     Returns ``(tree, used_path, used_iteration)`` — ``(None, None, 0)``
-    when nothing restorable survives (the caller restarts from scratch,
-    which is the pre-integrity behavior for a missing checkpoint).  The
-    corrupt file is left in place (forensics; retention prunes it like any
-    old generation) — callers must rewind their iteration bookkeeping to
-    ``used_iteration``.
+    when nothing restorable survives (the caller restarts from scratch).
+    The corrupt file is left in place (forensics; retention prunes it like
+    any old generation) — callers must rewind their iteration bookkeeping
+    to ``used_iteration``.
     """
+    from distributed_machine_learning_tpu.ckpt.manager import (
+        restore_with_fallback,
+    )
+
     emit = log or (lambda msg: print(f"[checkpoint] {msg}", flush=True))
-    if not path:
-        # No restore target = a fresh trial; never restore one by accident.
-        return None, None, 0
-    try:
-        tree = load_checkpoint(path)
-        if tree is not None:
-            return tree, path, _iteration_of(path)
-        emit(f"restore target {path} is missing")
-    except CheckpointCorruptionError as exc:
-        emit(f"restore target is corrupt: {exc}")
-    if not directory:
-        return None, None, 0
-    backend, d = get_storage(directory)
-    generations = []
-    for name in backend.listdir(d):
-        m = _CKPT_RE.match(name)
-        if m:
-            generations.append((int(m.group(1)), name))
-    for it, name in sorted(generations, reverse=True):
-        full = backend.join(d, name)
-        if path and full == path:
-            continue  # already tried (and failed) above
-        try:
-            tree = load_checkpoint(full)
-        except CheckpointCorruptionError as exc:
-            emit(f"skipping corrupt generation {name}: {exc}")
-            continue
-        if tree is not None:
-            emit(f"fell back to checksum-valid generation {name} (it={it})")
-            return tree, full, it
-    return None, None, 0
+    return restore_with_fallback(path, directory, log=emit,
+                                 shardings=shardings)
 
 
 def restore_into(template, tree: Dict[str, Any]):
@@ -181,43 +195,46 @@ def restore_into(template, tree: Dict[str, Any]):
     return serialization.from_state_dict(template, tree)
 
 
-def checkpoint_path(directory: str, iteration: int) -> str:
-    backend, d = get_storage(directory)
-    return backend.join(d, f"ckpt_{iteration:06d}.msgpack")
+def checkpoint_path(directory: str, iteration: int,
+                    checkpoint_format: str = "msgpack") -> str:
+    from distributed_machine_learning_tpu.ckpt.manager import step_path
+
+    return step_path(directory, iteration, checkpoint_format)
 
 
 def find_latest_checkpoint(directory: str):
-    """(path, iteration) of the newest ``ckpt_*.msgpack`` under ``directory``
-    (any storage backend), or (None, 0) when there is none — how a resumed
+    """(path, iteration) of the newest generation (either format) under
+    ``directory``, or (None, 0) when there is none — how a resumed
     experiment rediscovers each trial's restore point."""
-    backend, d = get_storage(directory)
-    best_path, best_it = None, 0
-    for name in backend.listdir(d):
-        m = _CKPT_RE.match(name)
-        if m and int(m.group(1)) >= best_it:
-            best_path, best_it = backend.join(d, name), int(m.group(1))
-    return best_path, best_it
+    from distributed_machine_learning_tpu.ckpt.manager import (
+        latest_generation,
+    )
+
+    return latest_generation(directory)
 
 
 def newest_valid_checkpoint(directory: str):
-    """(path, iteration) of the newest generation that PASSES its integrity
-    check, or (None, 0).  The restore target for trials requeued off a
-    silent worker (cluster lease expiry / stall fencing): the lost
-    incarnation may have died mid-write, so the newest file on disk is not
-    necessarily a loadable one — walk generations newest-first and trust
-    only a verified checksum (legacy manifest-less files verify by
-    decodability, matching ``load_checkpoint``)."""
-    backend, d = get_storage(directory)
-    generations = []
-    for name in backend.listdir(d):
-        m = _CKPT_RE.match(name)
-        if m:
-            generations.append((int(m.group(1)), name))
-    for it, name in sorted(generations, reverse=True):
-        full = backend.join(d, name)
-        if verify_checkpoint(full):
-            return full, it
-    return None, 0
+    """(path, iteration) of the newest generation that PASSES its
+    integrity check, or (None, 0).  The restore target for trials requeued
+    off a silent worker (cluster lease expiry / stall fencing): the lost
+    incarnation may have died mid-write, so the newest entry on disk is
+    not necessarily a loadable one — sharded generations must be COMMITTED
+    and checksum-clean, msgpack blobs must match their manifest."""
+    from distributed_machine_learning_tpu.ckpt.manager import (
+        newest_valid_generation,
+    )
+
+    return newest_valid_generation(directory)
+
+
+def cleanup_uncommitted(directory: str, log=None) -> int:
+    """Remove torn sharded generations (no COMMIT) — safe only at start,
+    before any writer is live.  See ``ckpt.manager.cleanup_uncommitted``."""
+    from distributed_machine_learning_tpu.ckpt.manager import (
+        cleanup_uncommitted as _cleanup,
+    )
+
+    return _cleanup(directory, log=log)
 
 
 def _abspath_unless_remote(path: str) -> str:
@@ -231,12 +248,13 @@ def _abspath_unless_remote(path: str) -> str:
 def export_orbax(checkpoint_path: str, out_dir: str) -> str:
     """Convert a framework checkpoint to an orbax StandardCheckpoint.
 
-    Interop bridge OUT of the framework: the msgpack pytree (params /
-    opt_state / batch_stats / scalars) becomes a directory any
-    orbax-consuming JAX stack restores directly — handing a tuned model
-    to a separate serving/fine-tuning codebase without importing this
-    package. Returns ``out_dir``. Raises ImportError if orbax is absent
-    (it is an optional dependency).
+    Interop bridge OUT of the framework: the pytree (params / opt_state /
+    batch_stats / scalars) becomes a directory any orbax-consuming JAX
+    stack restores directly — handing a tuned model to a separate
+    serving/fine-tuning codebase without importing this package.  Works
+    from either format (a sharded generation gathers first).  Returns
+    ``out_dir``. Raises ImportError if orbax is absent (it is an optional
+    dependency).
     """
     import orbax.checkpoint as ocp
 
@@ -262,10 +280,12 @@ class AsyncCheckpointWriter:
     """Overlap checkpoint writes with training (orbax-style async save).
 
     ``submit(path, tree)`` returns immediately; the device->host transfer,
-    msgpack serialization, and storage write run on ONE background thread,
-    in submission order. The trial thread goes straight back to training —
-    at real checkpoint sizes the epoch that used to stall behind the write
-    now runs concurrently with it.
+    serialization, and storage write run on ONE background thread, in
+    submission order (both formats — a ``gen_NNNNNN`` path writes the
+    sharded chunked format).  The trial thread goes straight back to
+    training — at real checkpoint sizes the epoch that used to stall
+    behind the write now runs concurrently with it, which the
+    ``ckpt.metrics`` overlap counters make observable.
 
     Correctness contract (why this is safe in-process):
     * ``submit`` snapshots EVERY array leaf: jax arrays get a device-side
@@ -299,14 +319,17 @@ class AsyncCheckpointWriter:
         self._thread.start()
 
     def _worker(self):
+        metrics = get_metrics()
         while True:
             item = self._q.get()
             if item is None:
                 return
-            path, tree, done = item
+            path, tree, done, steps_at_submit = item
             try:
                 save_checkpoint(path, tree)
+                metrics.record_async_completion(steps_at_submit)
             except BaseException as exc:  # noqa: BLE001 - surfaced on wait
+                metrics.add("save_errors")
                 with self._lock:
                     self._errors[path] = exc
             finally:
@@ -324,11 +347,14 @@ class AsyncCheckpointWriter:
 
     def submit(self, path: str, tree: Dict[str, Any]) -> str:
         """Enqueue a write; returns ``path`` immediately."""
+        metrics = get_metrics()
+        t0 = time.time()
         snapshot = jax.tree.map(self._snapshot_leaf, tree)
+        metrics.add("save_block_s", time.time() - t0)
         done = threading.Event()
         with self._lock:
             self._pending[path] = done
-        self._q.put((path, snapshot, done))
+        self._q.put((path, snapshot, done, metrics.step_count()))
         return path
 
     def wait(self, path: Optional[str] = None,
@@ -421,49 +447,27 @@ class AsyncCheckpointWriter:
 
 def prune_checkpoints(directory: str, keep: int, protect=None,
                       pending_latest: Optional[str] = None) -> int:
-    """Keep only the ``keep`` newest ``ckpt_*.msgpack`` files in ``directory``.
+    """Keep only the ``keep`` newest generations (either format) in
+    ``directory``.
 
     ``protect`` (a full path, or an iterable of them) is never deleted even if
     old — e.g. a checkpoint another trial's PBT exploit is about to restore.
     ``pending_latest``: a checkpoint path submitted to the async writer but
     possibly not on disk yet — behaviorally an alias for a ``protect`` entry,
     kept as the call-site's declaration of an in-flight write.  While it is
-    in flight the newest ``keep`` DURABLE files are all retained — deleting
-    them against a write that may still fail (crash, preemption, storage
-    error) could leave the trial with zero restorable checkpoints, exactly
-    the scenario checkpointing covers.  The on-disk set transiently
+    in flight the newest ``keep`` DURABLE generations are all retained —
+    deleting them against a write that may still fail (crash, preemption,
+    storage error) could leave the trial with zero restorable checkpoints,
+    exactly the scenario checkpointing covers.  The on-disk set transiently
     overshoots by up to the executor's write-pipeline depth (``keep``+2
     with the depth-2 pipeline) while writes land; later prunes — and the
     runner's final retention pass after the writer drains — converge it
     back to exactly ``keep``.
-    Returns the number of files deleted.
+    Returns the number of generations deleted.
     """
-    if keep <= 0:
-        return 0
-    if protect is None:
-        protected = set()
-    elif isinstance(protect, str):
-        protected = {protect}
-    else:
-        protected = set(protect)
-    if pending_latest is not None:
-        protected.add(pending_latest)
-    backend, d = get_storage(directory)
-    found = []
-    for name in backend.listdir(d):
-        m = _CKPT_RE.match(name)
-        if m:
-            found.append((int(m.group(1)), name))
-    found.sort()
-    excess = found[:-keep] if len(found) > keep else []
-    deleted = 0
-    for _, name in excess:
-        full = backend.join(d, name)
-        if full in protected:
-            continue
-        backend.delete(full)
-        # Integrity sidecar rides with its checkpoint (absent for legacy
-        # generations; delete is a no-op then).
-        backend.delete(manifest_path_for(full))
-        deleted += 1
-    return deleted
+    from distributed_machine_learning_tpu.ckpt.manager import (
+        prune_generations,
+    )
+
+    return prune_generations(directory, keep, protect=protect,
+                             pending_latest=pending_latest)

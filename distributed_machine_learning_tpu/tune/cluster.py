@@ -208,6 +208,7 @@ def _worker_run_trial(state: _WorkerState, msg: Dict[str, Any], devices: List):
     trial = Trial(trial_id=trial_id, config=dict(msg["config"]))
     trial.restore_path = msg.get("restore_path")
     ckpt_dir = msg.get("checkpoint_dir")
+    ckpt_format = msg.get("checkpoint_format", "msgpack")
     iteration = [int(msg.get("start_iteration", 0))]
 
     def report_fn(metrics: Dict[str, Any], checkpoint) -> str:
@@ -227,7 +228,9 @@ def _worker_run_trial(state: _WorkerState, msg: Dict[str, Any], devices: List):
             # Storage-aware: ckpt_dir may be a local/shared filesystem path
             # or gs:// — the driver picked it (checkpoint_storage) and it
             # must be reachable from every worker host; workers just write.
-            ckpt_path = ckpt_lib.checkpoint_path(ckpt_dir, iteration[0])
+            ckpt_path = ckpt_lib.checkpoint_path(
+                ckpt_dir, iteration[0], ckpt_format
+            )
             ckpt_lib.save_checkpoint(ckpt_path, checkpoint)
         _send(
             state.sock,
@@ -674,6 +677,7 @@ def run_distributed(
     shutdown_workers: bool = False,
     keep_checkpoints_num: int = 0,
     checkpoint_storage: Optional[str] = None,
+    checkpoint_format: str = "msgpack",
     elastic_listen: Union[str, socket.socket, None] = None,
     resume: bool = False,
     points_to_evaluate: Optional[Sequence[Dict[str, Any]]] = None,
@@ -701,6 +705,12 @@ def run_distributed(
     explicit ``name``) — same semantics as ``tune.run(resume=True)``:
     finished trials kept and replayed, interrupted trials redispatched from
     their newest shared-storage checkpoint, sampling continued.
+    ``checkpoint_format``: ``"msgpack"`` (default) or ``"sharded"`` —
+    same knob as ``tune.run``; workers write whichever the driver picked,
+    and every requeue/restore path reads both.  With ``"sharded"`` each
+    worker writes per-shard chunk files + an atomic COMMIT marker, so a
+    worker preempted mid-save never leaves a half-visible checkpoint and
+    requeue lands on the newest COMMITTED generation.
     ``stop`` / ``points_to_evaluate``: same surface as ``tune.run`` (dict /
     callable / Stopper; warm-start configs run first).
     ``callbacks`` / ``verbose=2``: the same observer surface as ``tune.run``
@@ -780,7 +790,11 @@ def run_distributed(
     sched.set_experiment(metric, mode)
 
     name = name or f"dist_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:6]}"
-    store = ExperimentStore(storage_path, name, checkpoint_storage)
+    store = ExperimentStore(storage_path, name, checkpoint_storage,
+                            checkpoint_format=checkpoint_format)
+    from distributed_machine_learning_tpu.ckpt import get_metrics
+
+    ckpt_metrics_base = get_metrics().snapshot()
     store.set_context(metric, mode)
 
     events: "queue.Queue[Tuple]" = queue.Queue()
@@ -955,6 +969,7 @@ def run_distributed(
                     "trainable": trainable_spec,
                     "slot": slot,
                     "checkpoint_dir": store.checkpoint_dir(trial),
+                    "checkpoint_format": store.checkpoint_format,
                     "restore_path": trial.restore_path,
                     "start_iteration": trial.training_iteration,
                 }
@@ -1309,6 +1324,11 @@ def run_distributed(
         plan = chaos_lib.active_plan()
         if plan is not None:
             extra["injected_faults"] = plan.snapshot()
+        # Driver-side checkpoint accounting (restores during requeue and
+        # fallback walks; worker-side saves count on the workers).
+        ckpt_counters = get_metrics().delta_since(ckpt_metrics_base)
+        if any(ckpt_counters.values()):
+            extra["checkpoint"] = ckpt_counters
         try:
             store.write_state(trials, extra=extra)
             store.close()
@@ -1319,6 +1339,8 @@ def run_distributed(
                for k, v in (extra.get("liveness") or {}).items()},
             **{f"faults/{k}": v
                for k, v in (extra.get("injected_faults") or {}).items()},
+            **{f"checkpoint/{k}": v
+               for k, v in (extra.get("checkpoint") or {}).items()},
         }
         if counter_scalars:
             safe_cb("on_experiment_counters", counter_scalars)

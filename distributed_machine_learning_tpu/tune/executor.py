@@ -31,6 +31,7 @@ from typing import Callable, Dict, List, Optional
 
 import jax
 
+from distributed_machine_learning_tpu.ckpt import metrics as ckpt_metrics
 from distributed_machine_learning_tpu.tune import checkpoint as ckpt_lib
 from distributed_machine_learning_tpu.tune.session import (
     PauseTrial,
@@ -283,12 +284,17 @@ class ThreadTrialExecutor:
             metrics.setdefault(
                 "compile_cache_hits", tracker.thread_cache_hits() - hits_base
             )
+            # Every report boundary is one training step for the ckpt
+            # overlap counters: an async write still in flight when the
+            # next step reports is a demonstrably overlapped save.
+            ckpt_metrics.note_step()
             if checkpoint is not None and writer_hung[0]:
                 checkpoint = None
             if checkpoint is not None:
                 count = trial.training_iteration + 1
                 path = ckpt_lib.checkpoint_path(
-                    self.store.checkpoint_dir(trial), count
+                    self.store.checkpoint_dir(trial), count,
+                    getattr(self.store, "checkpoint_format", "msgpack"),
                 )
                 # Depth-2 write pipeline per trial: before queueing this
                 # write, drain down to one in-flight by waiting on the
@@ -593,10 +599,13 @@ class ProcessTrialExecutor:
                             trial.trial_id, trial.training_iteration + 1
                         )
                     metrics, ckpt_bytes = msg[1], msg[2]
+                    ckpt_metrics.note_step()
                     if ckpt_bytes is not None:
                         count = trial.training_iteration + 1
                         path = ckpt_lib.checkpoint_path(
-                            self.store.checkpoint_dir(trial), count
+                            self.store.checkpoint_dir(trial), count,
+                            getattr(self.store, "checkpoint_format",
+                                    "msgpack"),
                         )
                         ckpt_lib.save_checkpoint(path, pickle.loads(ckpt_bytes))
                         trial.latest_checkpoint = path

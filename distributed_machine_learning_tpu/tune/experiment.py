@@ -46,6 +46,7 @@ class ExperimentStore:
         storage_path: str,
         name: str,
         checkpoint_storage: Optional[str] = None,
+        checkpoint_format: str = "msgpack",
     ):
         self.root = self.root_for(storage_path, name)
         os.makedirs(self.root, exist_ok=True)
@@ -56,6 +57,17 @@ class ExperimentStore:
             checkpoint_storage.rstrip("/") + "/" + name
             if checkpoint_storage else None
         )
+        # What NEW checkpoints are written as ("msgpack" blob or "sharded"
+        # ckpt/ generation); every restore path reads both, so the format
+        # can change across a resume.
+        from distributed_machine_learning_tpu.ckpt.manager import FORMATS
+
+        if checkpoint_format not in FORMATS:
+            raise ValueError(
+                f"checkpoint_format must be one of {FORMATS}, "
+                f"got {checkpoint_format!r}"
+            )
+        self.checkpoint_format = checkpoint_format
         self._result_files = {}
 
     def trial_dir(self, trial: Trial) -> str:

@@ -86,6 +86,7 @@ def run(
     callbacks: Optional[List] = None,
     keep_checkpoints_num: int = 0,
     checkpoint_storage: Optional[str] = None,
+    checkpoint_format: str = "msgpack",
     compile_cache_dir: Optional[str] = "auto",
     time_limit_per_trial_s: Optional[float] = None,
     trial_executor: str = "thread",
@@ -113,6 +114,14 @@ def run(
     or retry are never pruned.
     ``checkpoint_storage``: alternate root for checkpoints (``gs://...`` for
     shared pod storage, ``mem://...`` in tests); metrics stay local.
+    ``checkpoint_format``: ``"msgpack"`` (legacy single-blob flax msgpack,
+    the default and what existing experiment directories hold) or
+    ``"sharded"`` (the ``ckpt/`` chunked format: per-shard files + JSON
+    index + atomic COMMIT marker — async-friendly and restorable onto a
+    different mesh/device count).  Restores handle both regardless, so an
+    experiment can be resumed across the switch; save/restore wall, bytes,
+    and async-overlap counters land in
+    ``experiment_state.json["checkpoint"]`` and TensorBoard either way.
     ``compile_cache_dir``: persistent XLA compile-cache directory ("auto" =
     ``$DML_TPU_COMPILE_CACHE`` or ``~/.cache/dml_tpu/xla_cache``; None
     disables).  The framework owns compile-time amortization (SURVEY.md §7):
@@ -176,8 +185,14 @@ def run(
     resources = Resources.parse(resources_per_trial)
 
     name = name or f"exp_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:6]}"
-    store = ExperimentStore(storage_path, name, checkpoint_storage)
+    store = ExperimentStore(
+        storage_path, name, checkpoint_storage,
+        checkpoint_format=checkpoint_format,
+    )
     store.set_context(metric, mode)
+    from distributed_machine_learning_tpu.ckpt import get_metrics
+
+    ckpt_metrics_base = get_metrics().snapshot()
     device_mgr = DeviceManager(devices)
     events: "queue.Queue" = queue.Queue()
     watchdog = None
@@ -483,6 +498,13 @@ def run(
             # Fail-slow observability next to the fail-fast counters: how
             # many silences were detected, killed, requeued, or recovered.
             extra["liveness"] = {**watchdog.snapshot(), **liveness_counters}
+        # Checkpoint I/O accounting for THIS run (the registry is
+        # process-wide): save/restore wall and bytes, fallbacks taken, and
+        # the async-overlap counters that prove training ran while writes
+        # were in flight.
+        ckpt_counters = get_metrics().delta_since(ckpt_metrics_base)
+        if any(ckpt_counters.values()):
+            extra["checkpoint"] = ckpt_counters
         plan = chaos.active_plan()
         if plan is not None:
             # A chaos run's state snapshot records what was injected, so
@@ -499,6 +521,8 @@ def run(
                for k, v in (extra.get("liveness") or {}).items()},
             **{f"faults/{k}": v
                for k, v in (extra.get("injected_faults") or {}).items()},
+            **{f"checkpoint/{k}": v
+               for k, v in (extra.get("checkpoint") or {}).items()},
         }
         if counter_scalars:
             safe_cb("on_experiment_counters", counter_scalars)

@@ -503,6 +503,7 @@ def run_vectorized(
     compaction: str = "auto",
     epochs_per_dispatch="auto",
     checkpoint_every_epochs: int = 0,
+    checkpoint_format: str = "msgpack",
     resume: bool = False,
     callbacks: Optional[List] = None,
     points_to_evaluate: Optional[List[Dict[str, Any]]] = None,
@@ -561,6 +562,17 @@ def run_vectorized(
     ``num_samples``.  (Chunks spanning multiple static-signature groups
     disable the population checkpoint for that chunk; the common
     fixed-architecture sweep is single-group.)
+
+    ``checkpoint_format``: ``"msgpack"`` keeps the legacy single-blob
+    ``population.ckpt`` (overwritten in place).  ``"sharded"`` routes
+    population checkpoints through a ``ckpt.CheckpointManager`` over
+    ``<experiment>/population/`` — ASYNC saves (the next chunk dispatches
+    while chunks/index/COMMIT land in the background), per-shard chunk
+    files when the population is mesh-sharded, keep-2 retention, and
+    commit-protocol crash safety: a save preempted mid-write is
+    uncommitted, so ``resume`` falls back to the previous committed
+    generation instead of dying on a torn file.  Resume auto-detects
+    whichever format the interrupted run wrote.
 
     ``force_restage``: re-upload the staged data splits even when the
     content fingerprint matches a cached program's.  Only needed for
@@ -724,10 +736,31 @@ def run_vectorized(
     row_epochs = 0  # trial-epochs actually computed (compaction shrinks this)
     exec_total_s = 0.0  # device-execute seconds across all populations
 
+    if checkpoint_format not in ("msgpack", "sharded"):
+        raise ValueError(
+            f"checkpoint_format must be 'msgpack' or 'sharded', "
+            f"got {checkpoint_format!r}"
+        )
     ckpt_path = (
         os.path.join(store.root, "population.ckpt")
         if checkpoint_every_epochs else None
     )
+    pop_manager = None
+    if checkpoint_every_epochs and checkpoint_format == "sharded":
+        from distributed_machine_learning_tpu.ckpt import CheckpointManager
+
+        # Generations under <experiment>/population/, async so the next
+        # chunk dispatches while the write lands; keep-2 retention gives
+        # the commit-protocol fallback a prior generation to land on.
+        # Construction cleans any uncommitted debris a preempted run left.
+        pop_manager = CheckpointManager(
+            os.path.join(store.root, "population"),
+            checkpoint_format="sharded", keep=2, async_save=True, log=log,
+        )
+        ckpt_path = pop_manager.directory
+    from distributed_machine_learning_tpu.ckpt import get_metrics as _ckpt_m
+
+    ckpt_metrics_base = _ckpt_m().snapshot()
     resume_state = None
     unstarted: List[Trial] = []
     if resume:
@@ -782,6 +815,17 @@ def run_vectorized(
         _plan = _chaos.active_plan()
         if _plan is not None:
             extra["injected_faults"] = _plan.snapshot()
+        if pop_manager is not None:
+            # Drain in-flight population writes so the directory resume
+            # reads is complete (a still-queued save would otherwise be
+            # silently lost with the process).
+            try:
+                pop_manager.close()
+            except Exception as exc:  # noqa: BLE001
+                log(f"population checkpoint flush failed: {exc!r}")
+        ckpt_counters = _ckpt_m().delta_since(ckpt_metrics_base)
+        if any(ckpt_counters.values()):
+            extra["checkpoint"] = ckpt_counters
         try:
             store.write_state(trials, extra=extra)
             store.close()
@@ -792,6 +836,8 @@ def run_vectorized(
                for k, v in (extra.get("liveness") or {}).items()},
             **{f"faults/{k}": v
                for k, v in (extra.get("injected_faults") or {}).items()},
+            **{f"checkpoint/{k}": v
+               for k, v in (extra.get("checkpoint") or {}).items()},
         }
         if counter_scalars:
             safe_cb("on_experiment_counters", counter_scalars)
@@ -864,6 +910,9 @@ def run_vectorized(
                         pop_sharding, repl_sharding, pbt, epochs_per_dispatch,
                         checkpoint_every_epochs, group_ckpt_path, resume_state,
                         safe_cb, stop_rules=stop, watchdog=watchdog,
+                        ckpt_manager=(
+                            pop_manager if group_ckpt_path else None
+                        ),
                     )
                     resume_state = None  # consumed by the first (only) group
                     row_epochs += pop_rows
@@ -912,13 +961,29 @@ def _load_resume_state(
     the window between a chunk's params.json writes and its
     start-of-chunk checkpoint) and re-run from scratch. Returns
     ``(resume_state, finished_trials, live_batch, unstarted)``."""
+    from distributed_machine_learning_tpu import ckpt as ckpt_pkg
     from distributed_machine_learning_tpu.tune import checkpoint as ckpt_lib
 
-    ck = ckpt_lib.load_checkpoint(os.path.join(root, "population.ckpt"))
+    # Format auto-detect: a sharded run left generations under
+    # <root>/population/ (clean torn saves first — no writer is live at
+    # resume — then restore the newest COMMITTED generation, falling back
+    # to older ones on damage); otherwise the legacy single-blob file.
+    ck = None
+    pop_dir = os.path.join(root, "population")
+    if ckpt_pkg.list_generations(pop_dir):
+        ckpt_pkg.cleanup_uncommitted(pop_dir)
+        newest, _ = ckpt_pkg.latest_generation(pop_dir)
+        if newest is not None:
+            ck, _used, _step = ckpt_pkg.restore_with_fallback(
+                newest, pop_dir
+            )
+    if ck is None:
+        ck = ckpt_lib.load_checkpoint(os.path.join(root, "population.ckpt"))
     if ck is None:
         raise ValueError(
             f"resume=True but no population checkpoint under {root} "
-            f"(was the run started with checkpoint_every_epochs > 0?)"
+            f"(neither population/gen_* nor population.ckpt; was the run "
+            f"started with checkpoint_every_epochs > 0?)"
         )
     prior = ExperimentAnalysis.from_directory(root, metric, mode)
     all_trials = sorted(prior.trials, key=lambda t: t.trial_id)
@@ -1179,6 +1244,7 @@ def _run_population(
     safe_cb=lambda *a: None,
     stop_rules=None,
     watchdog=None,
+    ckpt_manager=None,
 ) -> Tuple[int, float]:
     """Train one population of K same-shape trials to completion.
 
@@ -1309,8 +1375,10 @@ def _run_population(
                                                  repl_sharding))
             program._data_replicated = True
 
+    ckpt_seq = [ckpt_manager.latest()[1] if ckpt_manager is not None else 0]
+
     def save_population(at_epoch: int):
-        ckpt_lib.save_checkpoint(ckpt_path, {
+        tree = {
             "state": {
                 "params": params,
                 "opt_state": opt_state,
@@ -1329,7 +1397,17 @@ def _run_population(
             # in-flight chunk apart from chunks that already finished
             # (multi-chunk sweeps overwrite this file chunk by chunk).
             "trial_ids": [t.trial_id for t in batch],
-        })
+        }
+        if ckpt_manager is not None:
+            # Async sharded generation: the snapshot happens here (per
+            # shard, so a mesh-sharded population never gathers), the
+            # chunk/index/COMMIT writes land in the background while the
+            # next chunk dispatches.  A preempted write stays uncommitted
+            # and resume falls back to the previous committed generation.
+            ckpt_seq[0] += 1
+            ckpt_manager.save(ckpt_seq[0], tree)
+        else:
+            ckpt_lib.save_checkpoint(ckpt_path, tree)
         log(f"population checkpoint at epoch {at_epoch}")
 
     if ckpt_every and ckpt_path and resume_state is None:
@@ -1481,6 +1559,12 @@ def _run_population(
         if watchdog is not None:
             watchdog.untrack("dispatch")
         cold_dispatch = False
+        # Dispatch boundary = `chunk` training epochs completed: the ckpt
+        # overlap counters credit an async population save that was still
+        # writing while these epochs ran on device.
+        from distributed_machine_learning_tpu.ckpt import get_metrics
+
+        get_metrics().add("steps", chunk)
         compile_delta = tracker.thread_seconds() - c0
         exec_s = max(time.time() - t0 - compile_delta, 0.0)
         _progress_note(

@@ -26,6 +26,15 @@ spec.loader.exec_module(bench)
 
 
 @pytest.fixture(autouse=True)
+def _fresh_probe_memo():
+    """The probe verdict is memoized per invocation (one bench process =
+    one verdict); each test is its own 'invocation'."""
+    bench._PROBE_MEMO.clear()
+    yield
+    bench._PROBE_MEMO.clear()
+
+
+@pytest.fixture(autouse=True)
 def _capture_file_in_tmp(monkeypatch, tmp_path):
     """No test may write the repo's durable benchmarks/last_tpu_capture.json
     (suite stubs carry platform='tpu' and _run_tpu_suite persists them),
@@ -108,6 +117,40 @@ def test_probe_succeeds_midway(monkeypatch):
                                      ((5, 0), (5, 1), (5, 1)))
     assert ok is True and tunnel_ok is True
     assert len(info["attempts"]) == 2  # stopped at first success
+
+
+def test_probe_verdict_memoized_per_invocation(monkeypatch):
+    """BENCH_r05 regression: 4 probe windows (~18 min) in one run, all
+    after the CPU-fallback decision.  The first _probe_tpu call decides;
+    every later call reuses the verdict with ZERO child spawns and the
+    reuse count lands in the artifact as probe_cached."""
+    calls = []
+
+    def fake_run_child(args, env, timeout_s):
+        calls.append(tuple(args))
+        return 124, "", "backend hung", True
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    info = {"attempts": []}
+    ok, tunnel_ok = bench._probe_tpu(lambda m: None, info, ((5, 0), (5, 1)))
+    assert ok is False and len(calls) == 2
+    # The late re-probe stage of the same invocation: cached, no spawn.
+    ok2, tunnel_ok2 = bench._probe_tpu(lambda m: None, info, ((120, 0),))
+    assert (ok2, tunnel_ok2) == (ok, tunnel_ok)
+    assert len(calls) == 2  # no new probe child
+    assert len(info["attempts"]) == 2  # no phantom attempt records
+    assert info["probe_cached"] == 1
+    # A success verdict memoizes the same way.
+    bench._PROBE_MEMO.clear()
+    monkeypatch.setattr(
+        bench, "_run_child",
+        lambda args, env, t: (0, "probe OK: 1 x tpu", "", True),
+    )
+    info2 = {"attempts": []}
+    assert bench._probe_tpu(lambda m: None, info2, ((5, 0),))[0] is True
+    assert bench._probe_tpu(lambda m: None, info2, ((5, 0),))[0] is True
+    assert info2["probe_cached"] == 1 and len(info2["attempts"]) == 1
 
 
 def test_probe_budget_bounds_total_wall_time(monkeypatch):
@@ -436,25 +479,16 @@ def test_tpu_suite_zombie_suite_child_stops_everything(monkeypatch):
     assert ours is None and flagship["mfu"] == 0.39
 
 
-def test_main_late_reprobe_recovers_tpu(monkeypatch, capsys):
-    """First probe window fails, CPU fallback runs, the LATE re-probe
-    succeeds -> the TPU suite still runs and headlines the round."""
+def test_main_late_stage_reuses_probe_verdict(monkeypatch, capsys):
+    """BENCH_r05 regression: once the probe window decided CPU fallback,
+    the late stage must REUSE that verdict — no fourth probe child, no
+    extra backoff minutes — and the artifact records the cached reuse."""
     state = {"probes": 0}
-
-    def fake_monitored(args, env, timeout_s, hb_path, stale_s):
-        assert args == ["--child", "suite", "full"]
-        return 0, json.dumps({
-            "flagship": {"step_s": 0.03, "mfu": 0.4},
-            "sweeps": {"float32": dict(
-                _sweep_stub("float32", 8000.0), wall_s=22.0
-            )},
-        }), "", True
 
     def fake_run_child(args, env, timeout_s):
         if args == ["--child", "probe"]:
             state["probes"] += 1
-            ok = state["probes"] > 3  # the 3-attempt window fails; late OK
-            return (0 if ok else 124), ("probe OK" if ok else ""), "hung", True
+            return 124, "", "hung", True  # every real attempt fails
         if args[:2] == ["--child", "ours"] and args[2] == "small":
             return 0, json.dumps({
                 "trials_per_hour": 1000.0, "wall_s": 20.0, "done": 8,
@@ -465,17 +499,17 @@ def test_main_late_reprobe_recovers_tpu(monkeypatch, capsys):
             return 0, json.dumps({"trials_per_hour": 70.0}), "", True
         raise AssertionError(f"unexpected child {args}")
 
-    monkeypatch.setattr(bench, "_run_child_monitored", fake_monitored)
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     monkeypatch.setenv("DML_TUNNEL_PYTHONPATH", "/fake/.axon_site")
     bench.main()
     line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-    assert line["backend"] == "tpu"
-    assert line["value"] == 8000.0
+    assert line["backend"] == "cpu"
+    assert state["probes"] == 3  # the schedule's attempts, nothing more
     detail = _detail()
-    assert detail["probe"]["late_retry"] is True
-    assert "late_probe_s" in detail["phases"]
+    assert detail["probe"]["probe_cached"] == 1  # late stage reused it
+    assert len(detail["probe"]["attempts"]) == 3
+    assert detail["probe"].get("late_retry") is False
 
 
 def test_variant_partial_recovers_terminated_trials(tmp_path, monkeypatch):

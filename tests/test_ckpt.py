@@ -1,0 +1,366 @@
+"""ckpt/ subsystem units: sharded format, commit protocol, manager,
+async writer, chaos chunk/commit faults.
+
+The restore MATRIX (save topology x restore topology x damage state) and
+the end-to-end sweeps live in tests/test_ckpt_restore_matrix.py; this file
+covers the format and lifecycle invariants in isolation.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu import chaos, ckpt
+from distributed_machine_learning_tpu.ckpt import format as fmt
+from distributed_machine_learning_tpu.tune import checkpoint as ckpt_lib
+from distributed_machine_learning_tpu.tune import storage as storage_lib
+from distributed_machine_learning_tpu.tune.storage import MemoryStorage
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    MemoryStorage.clear()
+    yield
+    chaos.deactivate()
+    storage_lib.set_fault_wrapper(None)
+    MemoryStorage.clear()
+
+
+def _tree():
+    return {
+        "params": {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+                   "b": np.zeros(4, np.float32)},
+        "opt_state": ({"mu": np.ones(4, np.float32)}, {"count": 3}),
+        "epoch0": 7,
+        "rng_impl": "",
+        "trial_ids": ["trial_00000", "trial_00001"],
+    }
+
+
+# --------------------------------------------------------------------------
+# format
+# --------------------------------------------------------------------------
+
+
+def test_sharded_roundtrip_matches_msgpack_container_shapes(tmp_path):
+    """Both formats must return the SAME container shapes (flax state-dict
+    normalization: tuples/lists -> index-keyed dicts) so restore_into call
+    sites work unchanged whichever format wrote the checkpoint."""
+    tree = _tree()
+    legacy = str(tmp_path / "ckpt_000001.msgpack")
+    gen = str(tmp_path / "gen_000001")
+    ckpt_lib.save_checkpoint(legacy, tree)
+    ckpt_lib.save_checkpoint(gen, tree)
+    a = ckpt_lib.load_checkpoint(legacy)
+    b = ckpt_lib.load_checkpoint(gen)
+
+    def normalize(node):
+        if isinstance(node, dict):
+            return {k: normalize(v) for k, v in node.items()}
+        if isinstance(node, np.ndarray):
+            return ("arr", str(node.dtype), node.shape, node.tobytes())
+        return node
+
+    assert normalize(a) == normalize(b)
+    # Bit-identical array payloads.
+    assert np.array_equal(a["params"]["w"], b["params"]["w"])
+
+
+def test_commit_protocol_order_and_contents(tmp_path):
+    gen = str(tmp_path / "gen_000002")
+    fmt.save_sharded(gen, _tree())
+    names = sorted(os.listdir(gen))
+    assert fmt.INDEX_NAME in names and fmt.COMMIT_NAME in names
+    chunks = [n for n in names if n.endswith(fmt.CHUNK_SUFFIX)]
+    assert chunks  # arrays landed as chunk files
+    with open(os.path.join(gen, fmt.COMMIT_NAME)) as f:
+        commit = json.load(f)
+    with open(os.path.join(gen, fmt.INDEX_NAME), "rb") as f:
+        index_raw = f.read()
+    import hashlib
+
+    assert commit["index_sha256"] == hashlib.sha256(index_raw).hexdigest()
+    index = json.loads(index_raw)
+    # Every non-literal leaf records shape/dtype and per-chunk sha256.
+    for leaf in index["leaves"]:
+        if leaf.get("literal"):
+            continue
+        assert leaf["dtype"] and isinstance(leaf["shape"], list)
+        for rec in leaf["chunks"]:
+            assert rec["sha256"] and rec["nbytes"] > 0
+    # No pickle opcode streams anywhere: chunk files are raw array bytes.
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    chunk_bytes = {open(os.path.join(gen, c), "rb").read() for c in chunks}
+    assert w.tobytes() in chunk_bytes
+
+
+def test_uncommitted_generation_is_invisible_and_cleaned(tmp_path):
+    d = str(tmp_path)
+    fmt.save_sharded(os.path.join(d, "gen_000001"), {"x": np.ones(2)})
+    g2 = os.path.join(d, "gen_000002")
+    fmt.save_sharded(g2, {"x": np.full(2, 2.0)})
+    os.remove(os.path.join(g2, fmt.COMMIT_NAME))  # preempted save
+    # Readers: direct load raises, newest_valid skips to gen 1.
+    with pytest.raises(ckpt.CheckpointCorruptionError, match="uncommitted"):
+        ckpt_lib.load_checkpoint(g2)
+    path, it = ckpt_lib.newest_valid_checkpoint(d)
+    assert it == 1
+    tree, used, used_it = ckpt_lib.load_checkpoint_with_fallback(g2, d)
+    assert used_it == 1 and np.array_equal(tree["x"], np.ones(2))
+    # Manager start cleans the debris.
+    assert ckpt.cleanup_uncommitted(d) == 1
+    assert not os.path.exists(g2)
+    assert ckpt.cleanup_uncommitted(d) == 0  # idempotent
+
+
+def test_chunk_corruption_detected_and_falls_back(tmp_path):
+    d = str(tmp_path)
+    fmt.save_sharded(os.path.join(d, "gen_000001"), {"x": np.ones(4)})
+    g2 = os.path.join(d, "gen_000002")
+    fmt.save_sharded(g2, {"x": np.full(4, 2.0)})
+    chunk = next(
+        os.path.join(g2, n) for n in os.listdir(g2)
+        if n.endswith(fmt.CHUNK_SUFFIX)
+    )
+    with open(chunk, "rb") as f:
+        damaged = chaos.corrupt_bytes(f.read())
+    with open(chunk, "wb") as f:
+        f.write(damaged)
+    with pytest.raises(ckpt.CheckpointCorruptionError):
+        ckpt_lib.load_checkpoint(g2)
+    tree, used, it = ckpt_lib.load_checkpoint_with_fallback(g2, d)
+    assert it == 1 and np.array_equal(tree["x"], np.ones(4))
+
+
+def test_memory_storage_scheme_roundtrip():
+    gen = "mem://bucket/exp/trial/checkpoints/gen_000003"
+    fmt.save_sharded(gen, {"x": np.arange(6, dtype=np.int32)})
+    assert fmt.is_committed(gen)
+    back = ckpt_lib.load_checkpoint(gen)
+    assert np.array_equal(back["x"], np.arange(6, dtype=np.int32))
+    path, it = ckpt_lib.find_latest_checkpoint(
+        "mem://bucket/exp/trial/checkpoints"
+    )
+    assert it == 3 and path == gen
+
+
+def test_bfloat16_and_scalar_dtypes_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    gen = str(tmp_path / "gen_000001")
+    tree = {
+        "bf16": np.asarray(jnp.ones((2, 3), jnp.bfloat16)),
+        "f64": np.float64(1.5),
+        "i8": np.arange(4, dtype=np.int8),
+        "bool": np.array([True, False]),
+    }
+    fmt.save_sharded(gen, tree)
+    back = ckpt_lib.load_checkpoint(gen)
+    assert str(back["bf16"].dtype) == "bfloat16"
+    assert back["f64"] == 1.5 and back["f64"].dtype == np.float64
+    assert np.array_equal(back["i8"], tree["i8"])
+    assert np.array_equal(back["bool"], tree["bool"])
+
+
+# --------------------------------------------------------------------------
+# manager
+# --------------------------------------------------------------------------
+
+
+def test_manager_retention_and_mixed_format_listing(tmp_path):
+    d = str(tmp_path)
+    # A legacy blob survives next to sharded generations (upgraded trial).
+    ckpt_lib.save_checkpoint(
+        ckpt_lib.checkpoint_path(d, 1), {"gen": np.float32(1)}
+    )
+    mgr = ckpt.CheckpointManager(d, checkpoint_format="sharded", keep=3)
+    for step in (2, 3, 4, 5):
+        mgr.save(step, {"gen": np.float32(step)})
+    steps = mgr.all_steps()
+    assert steps == [3, 4, 5]  # keep=3 pruned the blob and gen 2
+    tree, used, step = mgr.restore()
+    assert step == 5 and float(tree["gen"]) == 5.0
+    # Restore an explicit older generation.
+    tree3, _, s3 = mgr.restore(mgr.step_path(3))
+    assert s3 == 3 and float(tree3["gen"]) == 3.0
+
+
+def test_manager_newest_committed_fallback(tmp_path):
+    d = str(tmp_path)
+    mgr = ckpt.CheckpointManager(d, checkpoint_format="sharded")
+    mgr.save(1, {"v": np.float32(1)})
+    mgr.save(2, {"v": np.float32(2)})
+    os.remove(os.path.join(mgr.step_path(2), fmt.COMMIT_NAME))
+    assert mgr.newest_valid() == (mgr.step_path(1), 1)
+    tree, used, step = mgr.restore()
+    assert step == 1 and float(tree["v"]) == 1.0
+    # A fresh manager (restart) deletes the torn generation.
+    mgr2 = ckpt.CheckpointManager(d, checkpoint_format="sharded")
+    assert mgr2.all_steps() == [1]
+
+
+# --------------------------------------------------------------------------
+# async writer
+# --------------------------------------------------------------------------
+
+
+def test_async_save_error_surfaces_on_next_save(tmp_path):
+    fail = {"on": True}
+
+    class FailingOnce(storage_lib.StorageBackend):
+        def __init__(self, inner):
+            self.inner = inner
+
+        def write_bytes(self, path, data):
+            if fail["on"] and path.endswith(fmt.CHUNK_SUFFIX):
+                raise RuntimeError("disk gone")
+            return self.inner.write_bytes(path, data)
+
+        def read_bytes(self, path):
+            return self.inner.read_bytes(path)
+
+        def exists(self, path):
+            return self.inner.exists(path)
+
+        def listdir(self, path):
+            return self.inner.listdir(path)
+
+        def delete(self, path):
+            return self.inner.delete(path)
+
+    storage_lib.set_fault_wrapper(
+        lambda backend: FailingOnce(backend)
+    )
+    try:
+        w = ckpt.AsyncCheckpointer(log=lambda m: None)
+        w.save(str(tmp_path / "gen_000001"), {"x": np.ones(2)})
+        # Drain the worker WITHOUT claiming the error (the barrier would
+        # surface it): wait on the write's completion event directly.
+        for _p, ev in list(w._pending):
+            ev.wait(30)
+        fail["on"] = False
+        with pytest.raises(RuntimeError, match="previous async checkpoint"):
+            w.save(str(tmp_path / "gen_000002"), {"x": np.ones(2)})
+        # The failed save was claimed; the retried one succeeds cleanly.
+        w.save(str(tmp_path / "gen_000002"), {"x": np.ones(2)})
+        assert w.wait_until_finished(timeout=30)
+        w.close()
+    finally:
+        storage_lib.set_fault_wrapper(None)
+    assert fmt.is_committed(str(tmp_path / "gen_000002"))
+    # Gen 1 never committed (its chunk write died) -> invisible to readers.
+    assert not fmt.is_committed(str(tmp_path / "gen_000001"))
+
+
+def test_async_overlap_counters_are_step_based(tmp_path):
+    """Counter-based overlap proof, no sleeps: the first generation's
+    chunk write BLOCKS until two training steps have been noted; when it
+    completes, the overlap counters must credit exactly those steps."""
+    release = threading.Event()
+    blocked = threading.Event()
+
+    class Gate(storage_lib.StorageBackend):
+        def __init__(self, inner):
+            self.inner = inner
+
+        def write_bytes(self, path, data):
+            if "gen_000001" in path and path.endswith(fmt.CHUNK_SUFFIX):
+                blocked.set()
+                assert release.wait(30)
+            return self.inner.write_bytes(path, data)
+
+        def read_bytes(self, path):
+            return self.inner.read_bytes(path)
+
+        def exists(self, path):
+            return self.inner.exists(path)
+
+        def listdir(self, path):
+            return self.inner.listdir(path)
+
+        def delete(self, path):
+            return self.inner.delete(path)
+
+    metrics = ckpt.get_metrics()
+    base = metrics.snapshot()
+    storage_lib.set_fault_wrapper(lambda backend: Gate(backend))
+    try:
+        w = ckpt.AsyncCheckpointer(log=lambda m: None)
+        w.save(str(tmp_path / "gen_000001"), {"x": np.ones(2)})
+        assert blocked.wait(30)  # the write is in flight, holding the gate
+        ckpt.note_step()  # training proceeds while the write is pending
+        ckpt.note_step()
+        release.set()
+        assert w.wait_until_finished(timeout=30)
+        w.close()
+    finally:
+        storage_lib.set_fault_wrapper(None)
+    delta = metrics.delta_since(base)
+    assert delta["async_saves"] == 1
+    assert delta["async_saves_overlapping"] == 1
+    assert delta["async_overlapped_steps"] == 2
+
+
+# --------------------------------------------------------------------------
+# chaos: per-chunk faults + kill-before-commit
+# --------------------------------------------------------------------------
+
+
+def test_chunk_write_faults_hit_only_chunk_files(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)  # stable fault-hash prefix (see test_chaos)
+    plan = chaos.FaultPlan(seed=3, chunk_write_error_rate=1.0)
+    # Chunk writes always fail; index/COMMIT/other writes never do.
+    with pytest.raises(chaos.InjectedIOError, match="chunk write"):
+        plan.on_storage_op("write", "t/gen_000001/L0.0.chunk")
+    plan.on_storage_op("write", "t/gen_000001/index.json")
+    plan.on_storage_op("write", "t/ckpt_000001.msgpack")
+    assert plan.snapshot()["chunk_write_errors"] == 1
+
+
+def test_chunk_fault_pressure_leaves_generation_uncommitted(
+    tmp_path, monkeypatch
+):
+    """Enough per-chunk fault pressure to exhaust the retry budget makes
+    the SAVE fail — and the commit protocol guarantees the generation is
+    invisible, so a restore lands on the previous committed one."""
+    monkeypatch.chdir(tmp_path)
+    storage_lib.set_default_retry_policy(
+        storage_lib.RetryPolicy(attempts=2, base_delay_s=0.001,
+                                max_delay_s=0.002)
+    )
+    try:
+        fmt.save_sharded("d/gen_000001", {"x": np.ones(3)})
+        with chaos.active(chaos.FaultPlan(seed=1, chunk_write_error_rate=1.0)):
+            with pytest.raises(OSError):
+                fmt.save_sharded("d/gen_000002", {"x": np.full(3, 2.0)})
+        tree, used, it = ckpt_lib.load_checkpoint_with_fallback(
+            "d/gen_000002", "d"
+        )
+        assert it == 1 and np.array_equal(tree["x"], np.ones(3))
+    finally:
+        storage_lib.set_default_retry_policy(storage_lib.DEFAULT_RETRY_POLICY)
+
+
+def test_kill_before_commit_fires_once_and_is_not_retried(
+    tmp_path, monkeypatch
+):
+    monkeypatch.chdir(tmp_path)
+    plan = chaos.FaultPlan(seed=0, kill_before_commit=["trial_00000"])
+    with chaos.active(plan):
+        fmt.save_sharded("trial_00001/checkpoints/gen_000001", {"x": np.ones(2)})
+        with pytest.raises(chaos.InjectedCommitKill):
+            fmt.save_sharded(
+                "trial_00000/checkpoints/gen_000001", {"x": np.ones(2)}
+            )
+        # Fires exactly once: the retried incarnation's save commits.
+        fmt.save_sharded(
+            "trial_00000/checkpoints/gen_000001", {"x": np.ones(2)}
+        )
+    assert plan.snapshot()["commit_kills"] == 1
+    assert fmt.is_committed("trial_00000/checkpoints/gen_000001")
+    # The killed attempt was uncommitted until the retry: readers never saw
+    # a half-visible save (chunks+index present, COMMIT absent).
+    assert fmt.is_committed("trial_00001/checkpoints/gen_000001")

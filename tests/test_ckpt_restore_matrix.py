@@ -1,0 +1,393 @@
+"""The ckpt/ acceptance matrix and end-to-end integrations.
+
+Restore matrix: {saved on 1 device, saved on a 2x4 mesh} x {restored to
+host arrays, onto 1 device, onto a DIFFERENT 4x2 mesh} x {committed,
+chunk-corrupted -> fallback, killed-before-COMMIT -> prior generation} —
+every cell must restore BIT-IDENTICAL bytes from the right generation.
+
+Plus: an async-save e2e through ``tune.run`` proving (counter-based, no
+sleeps) that training steps overlap the checkpoint write; a chaos-faulted
+sharded sweep that finds the same best trial as the fault-free control;
+and a sharded vectorized population resume that continues bit-identically.
+"""
+
+import json
+import os
+import threading
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_machine_learning_tpu import chaos, ckpt, tune
+from distributed_machine_learning_tpu.ckpt import format as fmt
+from distributed_machine_learning_tpu.data import dummy_regression_data
+from distributed_machine_learning_tpu.tune import checkpoint as ckpt_lib
+from distributed_machine_learning_tpu.tune import storage as storage_lib
+from distributed_machine_learning_tpu.tune.trial import TrialStatus
+
+DEVS = jax.devices()
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    chaos.deactivate()
+    storage_lib.set_fault_wrapper(None)
+
+
+def _tree(offset: float):
+    return {
+        "params": {
+            "w": (np.arange(64, dtype=np.float32) + offset).reshape(8, 8),
+            "b": np.full(8, offset, np.float32),
+        },
+        "step": int(offset),
+    }
+
+
+def _place(tree, source: str):
+    if source == "single":
+        return jax.device_put(tree, DEVS[0])
+    mesh = Mesh(np.array(DEVS).reshape(2, 4), ("dp", "tp"))
+    sh = {
+        "params": {
+            "w": NamedSharding(mesh, P("dp", "tp")),
+            "b": NamedSharding(mesh, P("tp")),
+        },
+        "step": NamedSharding(mesh, P()),
+    }
+    return {
+        "params": {
+            "w": jax.device_put(tree["params"]["w"], sh["params"]["w"]),
+            "b": jax.device_put(tree["params"]["b"], sh["params"]["b"]),
+        },
+        "step": tree["step"],
+    }
+
+
+def _target_shardings(target: str):
+    if target == "host":
+        return None
+    if target == "one":
+        mesh = Mesh(np.array(DEVS[:1]).reshape(1, 1), ("dp", "tp"))
+        spec = {
+            "w": NamedSharding(mesh, P()),
+            "b": NamedSharding(mesh, P()),
+        }
+    else:  # a DIFFERENT mesh shape AND axis assignment than the save side
+        mesh = Mesh(np.array(DEVS).reshape(4, 2), ("dp", "tp"))
+        spec = {
+            "w": NamedSharding(mesh, P("tp", "dp")),
+            "b": NamedSharding(mesh, P("dp")),
+        }
+    return {"params": spec}
+
+
+@pytest.mark.parametrize("source", ["single", "mesh2x4"])
+@pytest.mark.parametrize("target", ["host", "one", "mesh4x2"])
+@pytest.mark.parametrize(
+    "state", ["committed", "chunk_corrupt", "kill_commit"]
+)
+def test_restore_matrix(tmp_path, source, target, state):
+    d = str(tmp_path)
+    g1 = os.path.join(d, "gen_000001")
+    g2 = os.path.join(d, "gen_000002")
+    fmt.save_sharded(g1, _place(_tree(1.0), source))
+    if state == "kill_commit":
+        with chaos.active(chaos.FaultPlan(seed=0,
+                                          kill_before_commit=["gen_000002"])):
+            with pytest.raises(chaos.InjectedCommitKill):
+                fmt.save_sharded(g2, _place(_tree(2.0), source))
+    else:
+        fmt.save_sharded(g2, _place(_tree(2.0), source))
+    if state == "chunk_corrupt":
+        chunk = next(
+            os.path.join(g2, n) for n in sorted(os.listdir(g2))
+            if n.endswith(fmt.CHUNK_SUFFIX)
+        )
+        with open(chunk, "rb") as f:
+            damaged = chaos.corrupt_bytes(f.read())
+        with open(chunk, "wb") as f:
+            f.write(damaged)
+
+    tree, used, it = ckpt_lib.load_checkpoint_with_fallback(
+        g2, d, log=lambda m: None, shardings=_target_shardings(target)
+    )
+    expect = _tree(2.0) if state == "committed" else _tree(1.0)
+    assert it == (2 if state == "committed" else 1)
+    w, b = tree["params"]["w"], tree["params"]["b"]
+    if target != "host":
+        assert isinstance(w, jax.Array)
+        ndev = 1 if target == "one" else len(DEVS)
+        assert len(w.sharding.device_set) == ndev
+        w, b = np.asarray(w), np.asarray(b)
+    # Bit-identical across every topology change.
+    assert w.tobytes() == expect["params"]["w"].tobytes()
+    assert b.tobytes() == expect["params"]["b"].tobytes()
+    assert tree["step"] == expect["step"]
+
+
+def test_resharded_restore_reads_only_needed_chunks(tmp_path):
+    """The resharding read path must NOT touch chunks outside the target
+    shard's slice — the property that makes multi-host restore scale."""
+    d = str(tmp_path / "gen_000001")
+    mesh = Mesh(np.array(DEVS).reshape(8,), ("dp",))
+    arr = jax.device_put(
+        np.arange(64, dtype=np.float32).reshape(8, 8),
+        NamedSharding(mesh, P("dp")),
+    )
+    fmt.save_sharded(d, {"w": arr})
+    assert len([n for n in os.listdir(d) if n.endswith(".chunk")]) == 8
+    reads = []
+
+    class Spy(storage_lib.StorageBackend):
+        def __init__(self, inner):
+            self.inner = inner
+
+        def write_bytes(self, path, data):
+            return self.inner.write_bytes(path, data)
+
+        def read_bytes(self, path):
+            if path.endswith(fmt.CHUNK_SUFFIX):
+                reads.append(os.path.basename(path))
+            return self.inner.read_bytes(path)
+
+        def exists(self, path):
+            return self.inner.exists(path)
+
+        def listdir(self, path):
+            return self.inner.listdir(path)
+
+        def delete(self, path):
+            return self.inner.delete(path)
+
+    storage_lib.set_fault_wrapper(lambda backend: Spy(backend))
+    try:
+        # Target: only rows 0..3 live on the requested single device slice
+        # of a 2-way mesh -> exactly 4 of the 8 chunks may be read.
+        half = Mesh(np.array(DEVS[:2]).reshape(2,), ("dp",))
+        out = fmt.load_sharded(
+            d, shardings={"w": NamedSharding(half, P("dp"))}
+        )
+    finally:
+        storage_lib.set_fault_wrapper(None)
+    assert np.asarray(out["w"]).tobytes() == np.arange(
+        64, dtype=np.float32
+    ).tobytes()
+    # Each of the 8 row-chunks is read at most once (per-shard caching),
+    # and only for the shards that need it.
+    assert len(reads) == len(set(reads)) == 8
+
+
+# --------------------------------------------------------------------------
+# async overlap, end to end through tune.run
+# --------------------------------------------------------------------------
+
+
+def test_async_save_overlaps_training_steps_e2e(tmp_path):
+    """Counter-based, no sleeps: generation 2's chunk write is gated on an
+    event the TRAINABLE sets two epochs later.  If training did not
+    overlap the write, the run would deadlock (the gate only opens from a
+    later epoch); the overlap counters then record it in
+    experiment_state.json["checkpoint"]."""
+    release = threading.Event()
+
+    class Gate(storage_lib.StorageBackend):
+        def __init__(self, inner):
+            self.inner = inner
+
+        def write_bytes(self, path, data):
+            if "gen_000002" in path and path.endswith(fmt.CHUNK_SUFFIX):
+                assert release.wait(60), "gate never opened"
+            return self.inner.write_bytes(path, data)
+
+        def read_bytes(self, path):
+            return self.inner.read_bytes(path)
+
+        def exists(self, path):
+            return self.inner.exists(path)
+
+        def listdir(self, path):
+            return self.inner.listdir(path)
+
+        def delete(self, path):
+            return self.inner.delete(path)
+
+    def trainable(config):
+        for epoch in range(6):
+            if epoch == 3:
+                # Two reports have landed since gen_000002 was submitted
+                # (epochs 2 and 3 trained while its write sat on the gate).
+                release.set()
+            tune.report(
+                {"loss": 1.0 / (epoch + 1)},
+                checkpoint={"w": np.full(4, epoch, np.float32)},
+            )
+
+    base = ckpt.get_metrics().snapshot()
+    storage_lib.set_fault_wrapper(lambda backend: Gate(backend))
+    try:
+        analysis = tune.run(
+            trainable, {"x": 1}, metric="loss", num_samples=1,
+            storage_path=str(tmp_path), name="overlap", verbose=0,
+            checkpoint_format="sharded",
+        )
+    finally:
+        storage_lib.set_fault_wrapper(None)
+    assert analysis.trials[0].status == TrialStatus.TERMINATED
+    delta = ckpt.get_metrics().delta_since(base)
+    assert delta["async_saves"] >= 1
+    assert delta["async_saves_overlapping"] >= 1
+    assert delta["async_overlapped_steps"] >= 2
+    # The counters are part of the experiment artifact, not just test state.
+    state = json.load(
+        open(os.path.join(str(tmp_path), "overlap", "experiment_state.json"))
+    )
+    assert state["checkpoint"]["async_saves_overlapping"] >= 1
+    assert state["checkpoint"]["saves"] >= 6
+    # Every epoch's generation is committed and the newest restores.
+    ckdir = os.path.join(str(tmp_path), "overlap", "trial_00000",
+                         "checkpoints")
+    path, it = ckpt_lib.newest_valid_checkpoint(ckdir)
+    assert it == 6
+    tree = ckpt_lib.load_checkpoint(path)
+    assert np.array_equal(tree["w"], np.full(4, 5, np.float32))
+
+
+# --------------------------------------------------------------------------
+# chaos-faulted sharded sweep == fault-free control
+# --------------------------------------------------------------------------
+
+
+def _sweep(tmp_path, name, **over):
+    train, val = dummy_regression_data(
+        num_samples=96, seq_len=8, num_features=4
+    )
+    kw = dict(
+        metric="validation_loss", mode="min", num_samples=5,
+        max_failures=2, seed=0, storage_path=str(tmp_path), name=name,
+        verbose=0, checkpoint_format="sharded",
+    )
+    kw.update(over)
+    return tune.run(
+        tune.with_parameters(
+            tune.train_regressor, train_data=train, val_data=val
+        ),
+        {"model": "mlp", "hidden_sizes": (16,),
+         "learning_rate": tune.loguniform(1e-3, 1e-1),
+         "num_epochs": 5, "batch_size": 32, "lr_schedule": "constant"},
+        **kw,
+    )
+
+
+def test_sharded_sweep_under_chunk_faults_finds_same_best_trial(tmp_path):
+    """ISSUE acceptance: per-chunk write faults + one kill between chunk
+    writes and COMMIT + a trial crash — the sweep restores from the newest
+    COMMITTED generation everywhere and picks the SAME winner as the
+    fault-free control."""
+    storage_lib.set_default_retry_policy(
+        storage_lib.RetryPolicy(attempts=4, base_delay_s=0.005,
+                                max_delay_s=0.02)
+    )
+    try:
+        baseline = _sweep(tmp_path, "control")
+        assert baseline.num_terminated() == 5
+
+        plan = chaos.FaultPlan(
+            seed=7,
+            chunk_write_error_rate=0.10,
+            kill_before_commit=["trial_00001/checkpoints/gen_000003"],
+            trial_crashes=[("trial_00001", 4), ("trial_00003", 3)],
+        )
+        with chaos.active(plan):
+            chaotic = _sweep(tmp_path, "faulted")
+    finally:
+        storage_lib.set_default_retry_policy(
+            storage_lib.DEFAULT_RETRY_POLICY
+        )
+
+    snap = plan.snapshot()
+    assert snap["trial_crashes"] == 2
+    assert snap["commit_kills"] == 1
+    assert snap.get("chunk_write_errors", 0) >= 1
+
+    assert chaotic.num_terminated() == 5
+    assert chaotic.best_trial.trial_id == baseline.best_trial.trial_id
+    assert chaotic.best_trial.config["learning_rate"] == pytest.approx(
+        baseline.best_trial.config["learning_rate"]
+    )
+    # The faulted run's checkpoints really are sharded generations.
+    ckdir = os.path.join(str(tmp_path), "faulted", "trial_00000",
+                         "checkpoints")
+    gens = [n for n in os.listdir(ckdir) if n.startswith("gen_")]
+    assert gens, "sharded sweep wrote no generations"
+    # And its artifact carries the checkpoint counters.
+    state = json.load(
+        open(os.path.join(str(tmp_path), "faulted", "experiment_state.json"))
+    )
+    assert state["checkpoint"]["saves"] >= 5
+
+
+# --------------------------------------------------------------------------
+# sharded vectorized population: save through the manager, resume
+# --------------------------------------------------------------------------
+
+
+def test_vectorized_sharded_population_resume(tmp_path):
+    from distributed_machine_learning_tpu.data import Dataset
+    from distributed_machine_learning_tpu.tune.schedulers.base import (
+        FIFOScheduler,
+    )
+    from distributed_machine_learning_tpu.tune.vectorized import (
+        run_vectorized,
+    )
+
+    rng = np.random.default_rng(21)
+    x = rng.normal(size=(128, 8, 4)).astype(np.float32)
+    w = rng.normal(size=(4,)).astype(np.float32)
+    y = (x.mean(axis=1) @ w)[:, None].astype(np.float32)
+    train, val = Dataset(x[:96], y[:96]), Dataset(x[96:], y[96:])
+    space = {
+        "model": "mlp", "hidden_sizes": (16, 8),
+        "learning_rate": tune.loguniform(1e-3, 1e-1),
+        "weight_decay": tune.loguniform(1e-6, 1e-3),
+        "seed": tune.randint(0, 10_000),
+        "num_epochs": 8, "batch_size": 16,
+        "loss_function": "mse", "lr_schedule": "constant",
+    }
+    kw = dict(
+        train_data=train, val_data=val, metric="validation_mse",
+        mode="min", num_samples=4, seed=9, verbose=0,
+        checkpoint_every_epochs=2, checkpoint_format="sharded",
+    )
+
+    ref = run_vectorized(space, storage_path=str(tmp_path), name="ref", **kw)
+
+    class _DiesAtEpoch(FIFOScheduler):
+        def on_trial_result(self, trial, result):
+            if result["training_iteration"] >= 5:
+                raise RuntimeError("simulated preemption")
+            return super().on_trial_result(trial, result)
+
+    with pytest.raises(RuntimeError, match="simulated preemption"):
+        run_vectorized(space, storage_path=str(tmp_path), name="crash",
+                       scheduler=_DiesAtEpoch(), **kw)
+
+    # The interrupted run's population checkpoints are committed sharded
+    # generations under <exp>/population/ (flushed by teardown).
+    pop_dir = os.path.join(str(tmp_path), "crash", "population")
+    gens = ckpt.list_generations(pop_dir)
+    assert gens and all(kind == "sharded" for _s, _p, kind in gens)
+    assert any(fmt.is_committed(p) for _s, p, _k in gens)
+
+    resumed = run_vectorized(space, storage_path=str(tmp_path),
+                             name="crash", resume=True, **kw)
+    assert all(t.status == TrialStatus.TERMINATED for t in resumed.trials)
+    assert all(t.training_iteration == 8 for t in resumed.trials)
+    # Bit-identical continuation (optimizer state survived the format).
+    for tr, tu in zip(resumed.trials, ref.trials):
+        assert tr.results[-1]["validation_mse"] == pytest.approx(
+            tu.results[-1]["validation_mse"], rel=1e-6
+        )

@@ -1,0 +1,96 @@
+"""CI guard: every module imports under JAX_PLATFORMS=cpu, and the
+checkpoint path stays pickle-free.
+
+Two invariants the ckpt/ subsystem depends on:
+
+* **importability** — every module under ``distributed_machine_learning_tpu``
+  must import on the CPU test platform (conftest pins
+  ``JAX_PLATFORMS=cpu``).  A module that only imports where a TPU is
+  attached would make the recovery paths (which import lazily during
+  incident handling) fail exactly when they are needed.
+* **no pickle in the checkpoint path** — the on-disk formats (msgpack
+  blob, sharded chunk+JSON generations, serve bundles) must stay process-
+  and framework-portable: a checkpoint written by one Python version/
+  process must restore in any other, which pickle does not guarantee (and
+  unpickling untrusted shared-storage bytes executes code).  ``pickle``
+  is allowed in the process-executor IPC frames (same-host, same-build
+  pipe) but never in anything that writes or reads checkpoint bytes.
+"""
+
+import importlib
+import os
+import pkgutil
+import re
+
+import distributed_machine_learning_tpu as pkg
+
+PKG_ROOT = os.path.dirname(pkg.__file__)
+
+# Everything that serializes/deserializes checkpoint or bundle bytes.
+CHECKPOINT_PATH_FILES = (
+    "ckpt/__init__.py",
+    "ckpt/format.py",
+    "ckpt/manager.py",
+    "ckpt/metrics.py",
+    "ckpt/writer.py",
+    "tune/checkpoint.py",
+    "tune/storage.py",
+    "serve/export.py",
+)
+
+_PICKLE_RE = re.compile(
+    r"^\s*(import\s+(cloud)?pickle|from\s+(cloud)?pickle\s+import)"
+    r"|(cloud)?pickle\.(loads?|dumps?)\(",
+    re.MULTILINE,
+)
+
+
+def _iter_module_names():
+    for mod in pkgutil.walk_packages(pkg.__path__, prefix=pkg.__name__ + "."):
+        yield mod.name
+
+
+def test_every_module_imports_on_cpu():
+    assert os.environ.get("JAX_PLATFORMS") == "cpu"  # conftest pinned it
+    failures = []
+    names = sorted(_iter_module_names())
+    assert len(names) > 40  # the walk really covered the package
+    assert f"{pkg.__name__}.ckpt.format" in names
+    for name in names:
+        try:
+            importlib.import_module(name)
+        except Exception as exc:  # noqa: BLE001 - collect, report all
+            failures.append(f"{name}: {exc!r}")
+    assert not failures, "\n".join(failures)
+
+
+def test_checkpoint_path_is_pickle_free():
+    offenders = []
+    for rel in CHECKPOINT_PATH_FILES:
+        path = os.path.join(PKG_ROOT, rel)
+        assert os.path.exists(path), f"guard list is stale: {rel} missing"
+        with open(path) as f:
+            src = f.read()
+        m = _PICKLE_RE.search(src)
+        if m:
+            line = src[: m.start()].count("\n") + 1
+            offenders.append(f"{rel}:{line}: {m.group(0).strip()}")
+    assert not offenders, (
+        "pickle crept into the checkpoint path (the format must stay "
+        "process/framework-portable):\n" + "\n".join(offenders)
+    )
+
+
+def test_sharded_format_writes_no_pickle_bytes(tmp_path):
+    """Belt and braces beyond source scanning: no file of a written
+    generation starts with a pickle protocol-2+ opcode stream."""
+    import numpy as np
+
+    from distributed_machine_learning_tpu.ckpt import format as fmt
+
+    gen = str(tmp_path / "gen_000001")
+    fmt.save_sharded(gen, {"w": np.ones((3, 2), np.float32), "meta": "x"})
+    for name in os.listdir(gen):
+        with open(os.path.join(gen, name), "rb") as f:
+            head = f.read(2)
+        assert head[:2] != b"\x80\x04" and head[:2] != b"\x80\x02", name

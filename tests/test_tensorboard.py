@@ -110,8 +110,20 @@ def test_callback_writes_per_trial_runs(tmp_path):
         verbose=0,
     )
     tb_root = os.path.join(analysis.root, "tensorboard")
-    run_dirs = sorted(os.listdir(tb_root))
+    all_dirs = sorted(os.listdir(tb_root))
+    # One run per trial, plus the experiment-scope "_experiment" run that
+    # carries the always-on checkpoint I/O counters (ckpt.metrics).
+    run_dirs = [d for d in all_dirs if not d.startswith("_")]
     assert len(run_dirs) == 2  # one run per trial
+    if "_experiment" in all_dirs:
+        exp_files = glob.glob(
+            os.path.join(tb_root, "_experiment", "events.out.tfevents.*")
+        )
+        exp_tags = {
+            t for f in exp_files for e in read_events(f)
+            for t in e["scalars"]
+        }
+        assert any(t.startswith("checkpoint/") for t in exp_tags)
     for rd in run_dirs:
         files = glob.glob(os.path.join(tb_root, rd, "events.out.tfevents.*"))
         assert len(files) == 1
