@@ -79,6 +79,11 @@ class FaultPlan:
       retry's next attempt re-rolls).
     * ``slow_rate`` / ``slow_s`` — sleep ``slow_s`` before the operation
       (a degraded-storage stall; keep it <= 0.2s in CI-tier tests).
+    * ``artifact_fetch_error_rate`` — compile-artifact fetches from the
+      cluster head (``tune/cluster.py``) fail with :class:`InjectedIOError`
+      before the request leaves the worker; the worker MUST fall back to
+      compiling locally (counted as ``fetch_fallbacks`` in the ``compile``
+      family) and the sweep must still find the same best trial.
 
     Scheduled faults (each fires exactly once):
 
@@ -128,6 +133,7 @@ class FaultPlan:
         read_error_rate: float = 0.0,
         slow_rate: float = 0.0,
         slow_s: float = 0.02,
+        artifact_fetch_error_rate: float = 0.0,
         chunk_write_error_rate: float = 0.0,
         kill_before_commit: Sequence[str] = (),
         corrupt_path_substrings: Sequence[str] = (),
@@ -144,6 +150,7 @@ class FaultPlan:
         self.read_error_rate = float(read_error_rate)
         self.slow_rate = float(slow_rate)
         self.slow_s = float(slow_s)
+        self.artifact_fetch_error_rate = float(artifact_fetch_error_rate)
         self.chunk_write_error_rate = float(chunk_write_error_rate)
         self._commit_kill_pending: List[str] = list(kill_before_commit)
         self._corrupt_pending: List[str] = list(corrupt_path_substrings)
@@ -259,6 +266,16 @@ class FaultPlan:
                 self._counters.get("storage_corruptions", 0) + 1
             )
         return corrupt_bytes(data)
+
+    def on_artifact_fetch(self, key: str) -> None:
+        """Called by a cluster worker before asking the head for compile
+        artifacts under ``key``; may raise :class:`InjectedIOError` (the
+        worker's fallback is a local compile, never a failed trial)."""
+        if self._roll("artifact_fetch", key, self.artifact_fetch_error_rate):
+            self._count("artifact_fetch_errors")
+            raise InjectedIOError(
+                f"injected artifact fetch fault for {key}"
+            )
 
     # -- trial faults --------------------------------------------------------
 

@@ -1,0 +1,99 @@
+"""Compile-artifact layer: make the SECOND occurrence of any program free.
+
+BENCH_r05 diagnosis: the stack loses on startup, not steady state — per-trial
+jit compilation and process spin-up dominate short ASHA rungs (warm
+``vs_baseline`` 0.80 vs cold 0.67).  The classic fix is ahead-of-time
+compilation and executable reuse (PAPERS.md: the Julia-to-TPU compiler builds
+its whole story on XLA AOT executables; Podracer gets its throughput from
+compiling once and reusing the program across every actor).  This package
+owns that story end to end:
+
+* :mod:`keys` — canonical **program keys**: a config's shape-class
+  fingerprint (non-structural hparams like lr/seed ignored) plus batch
+  shape, dtype, and donation signature, hashed to a stable id that is
+  identical across processes and hosts.  One key == one XLA program.
+* :mod:`tracker` — the process-wide JAX monitoring listener (moved from
+  ``utils/compile_cache.py``): per-thread compile seconds, backend-compile
+  EVENT counts, persistent-cache hits; plus ownership of JAX's on-disk
+  compilation cache (``enable_persistent_cache``).
+* :mod:`counters` — the ``compile`` counter family (hits, misses,
+  aot_exports/imports, fetch_hits/fallbacks, prewarm/spawn counts) that
+  drivers publish into ``experiment_state.json["compile"]`` and TensorBoard
+  ``compile/*`` next to the fault/liveness/checkpoint families.
+* :mod:`aot` — :class:`ExecutableCache`: ``jax.jit(...).lower(...).compile()``
+  ahead-of-time executables with serialized export/import on backends that
+  support it, falling back to the persistent XLA cache (same keying) where
+  they don't.
+* :mod:`origin` — pack/install helpers and the head-side registry behind
+  the cluster's compile-artifact origin: workers ask the head for a
+  populated cache entry by program key before compiling locally, and
+  publish what they compile, so a 256-trial sweep compiles each distinct
+  program once per slice topology instead of once per worker.
+
+``utils/compile_cache.py`` remains as a compatibility shim re-exporting the
+tracker surface; new code should import from here.
+"""
+
+from distributed_machine_learning_tpu.compilecache.counters import (
+    CompileCounters,
+    get_counters,
+)
+from distributed_machine_learning_tpu.compilecache.keys import (
+    NON_STRUCTURAL_KEYS,
+    program_key,
+    shape_class_fingerprint,
+)
+from distributed_machine_learning_tpu.compilecache.tracker import (
+    CompileTimeTracker,
+    cache_dir,
+    cache_entry_count,
+    enable_persistent_cache,
+    get_tracker,
+)
+from distributed_machine_learning_tpu.compilecache.aot import ExecutableCache
+from distributed_machine_learning_tpu.compilecache.origin import (
+    ArtifactRegistry,
+    install_artifacts,
+    pack_artifacts,
+    snapshot_cache_dir,
+)
+
+__all__ = [
+    "ArtifactRegistry",
+    "CompileCounters",
+    "CompileTimeTracker",
+    "ExecutableCache",
+    "NON_STRUCTURAL_KEYS",
+    "cache_dir",
+    "cache_entry_count",
+    "enable_persistent_cache",
+    "get_counters",
+    "get_tracker",
+    "install_artifacts",
+    "pack_artifacts",
+    "program_key",
+    "shape_class_fingerprint",
+    "snapshot_cache_dir",
+    "state_block",
+]
+
+
+def state_block(tracker_base=None, counters_base=None) -> dict:
+    """The ``experiment_state.json["compile"]`` block for one run.
+
+    Drivers snapshot ``get_tracker().snapshot()`` and
+    ``get_counters().snapshot()`` at start and pass them here at teardown —
+    the same scoping discipline as ``ckpt.metrics`` (the registries are
+    process-wide; the block is per-run)."""
+    tracker = get_tracker()
+    tsnap = tracker.snapshot()
+    if tracker_base:
+        tsnap = {
+            k: round(v - tracker_base.get(k, 0), 4) for k, v in tsnap.items()
+        }
+    block = dict(tsnap)
+    csnap = get_counters().snapshot()
+    if counters_base is not None:
+        csnap = get_counters().delta_since(counters_base)
+    block.update({k: v for k, v in csnap.items() if v})
+    return block

@@ -1,0 +1,262 @@
+"""Ahead-of-time executable cache: compile once, load everywhere.
+
+The persistent XLA cache (``tracker.enable_persistent_cache``) already
+makes a repeated BACKEND compile free — but the repeat process still pays
+tracing and lowering, and still has to reach the compile call at all.  This
+layer goes one step further where the backend supports it:
+``jax.jit(fn).lower(*args).compile()`` produces a loaded executable, and
+``jax.experimental.serialize_executable`` round-trips it to bytes — so a
+restarted serve replica, a pre-warmed trial runner, or a second bench child
+deserializes the finished executable and skips trace/lower/compile
+entirely.
+
+Keying is :func:`compilecache.keys.program_key` — the same id the cluster
+origin and the persistent-cache layer use, so every layer agrees on what
+"the same program" means.
+
+Trust model: the serialized payload embeds pytree defs, which ride pickle
+(jax's own serialization format).  The store is therefore for
+**framework-owned directories only** — the local AOT dir and artifacts
+received over the (already pickled, optionally HMAC'd) cluster control
+plane.  Checkpoint bytes never come near this path (test_import_guard
+keeps the checkpoint formats pickle-free; this file is deliberately not in
+that list because executables are process-trust artifacts, not data).
+
+Failure posture: every load path degrades to a plain compile — a stale,
+truncated, or cross-version payload must cost a recompile, never an error.
+A deserialized executable is strict about argument dtypes/shapes; if a call
+ever rejects its inputs the entry is dropped and the call re-dispatches
+through ordinary ``jax.jit`` (counted, so drift is visible).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+import threading
+from typing import Any, Callable, Dict, Optional, Sequence
+
+from distributed_machine_learning_tpu.compilecache.counters import get_counters
+from distributed_machine_learning_tpu.compilecache import tracker as _tracker
+
+_MAGIC = b"DMLAOT1\n"
+
+
+def default_aot_dir() -> str:
+    """``$DML_TPU_AOT_CACHE``, else ``<persistent cache dir>/aot``."""
+    env = os.environ.get("DML_TPU_AOT_CACHE")
+    if env:
+        return os.path.expanduser(env)
+    base = _tracker.cache_dir() or os.path.join(
+        os.path.expanduser("~"), ".cache", "dml_tpu", "xla_cache"
+    )
+    return os.path.join(base, "aot")
+
+
+class _Entry:
+    __slots__ = ("compiled", "fallback", "make_fallback")
+
+    def __init__(self, compiled):
+        self.compiled = compiled
+        self.fallback = None
+        self.make_fallback = None
+
+
+class ExecutableCache:
+    """Program-key -> loaded executable, with a serialized on-disk tier.
+
+    ``get_or_compile(key, fn, *args)`` resolves in order:
+
+    1. in-memory (``program_hits``);
+    2. on-disk serialized executable ``<dir>/<key>.aotexec``
+       (``aot_imports`` + ``program_hits``);
+    3. compile via ``jax.jit(fn, ...).lower(*args).compile()``
+       (``program_misses``), then export the serialized executable
+       (``aot_exports``) — or mark the backend unsupported
+       (``aot_unsupported``) and rely on the persistent XLA cache for the
+       cross-process story.
+
+    The returned callable accepts the same concrete arguments as ``fn``.
+    """
+
+    def __init__(self, directory: Optional[str] = None,
+                 persist: bool = True):
+        self._dir = directory or default_aot_dir()
+        self._persist = persist
+        self._lock = threading.Lock()
+        self._mem: Dict[str, _Entry] = {}
+        self._serialize_supported: Optional[bool] = None
+
+    @property
+    def directory(self) -> str:
+        return self._dir
+
+    # -- disk tier -----------------------------------------------------------
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self._dir, f"{key}.aotexec")
+
+    def _load_from_disk(self, key: str):
+        path = self._path(key)
+        if not self._persist or not os.path.exists(path):
+            return None
+        try:
+            with open(path, "rb") as f:
+                magic = f.read(len(_MAGIC))
+                if magic != _MAGIC:
+                    return None
+                payload, in_tree, out_tree = pickle.load(f)
+            from jax.experimental import serialize_executable as se
+
+            return se.deserialize_and_load(payload, in_tree, out_tree)
+        except Exception:  # noqa: BLE001 - stale/cross-version payloads
+            # A damaged entry must cost a recompile, never an error; drop
+            # it so the fresh export below replaces it.
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None
+
+    def _export_to_disk(self, key: str, compiled) -> bool:
+        if not self._persist:
+            return False
+        try:
+            from jax.experimental import serialize_executable as se
+
+            payload, in_tree, out_tree = se.serialize(compiled)
+            os.makedirs(self._dir, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=self._dir, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(_MAGIC)
+                    pickle.dump((payload, in_tree, out_tree), f,
+                                protocol=pickle.HIGHEST_PROTOCOL)
+                os.replace(tmp, self._path(key))  # atomic: no torn entries
+            finally:
+                if os.path.exists(tmp):
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+            self._serialize_supported = True
+            return True
+        except Exception:  # noqa: BLE001 - backend without serialization
+            self._serialize_supported = False
+            return False
+
+    # -- resolution ----------------------------------------------------------
+
+    def get_or_compile(
+        self,
+        key: str,
+        fn: Callable,
+        *args,
+        static_argnums: Sequence[int] = (),
+        donate_argnums: Sequence[int] = (),
+    ) -> Callable:
+        """Resolve ``key`` to a callable executable for ``fn(*args)``.
+
+        ``args`` are example arguments of the exact shapes/dtypes the
+        program will be called with (they are only traced/lowered on a
+        miss, never executed)."""
+        counters = get_counters()
+        with self._lock:
+            entry = self._mem.get(key)
+        if entry is not None:
+            counters.add("program_hits")
+            return self._wrap(key, entry)
+
+        compiled = self._load_from_disk(key)
+        if compiled is not None:
+            counters.add("program_hits")
+            counters.add("aot_imports")
+            entry = self._remember(key, compiled, fn, static_argnums,
+                                   donate_argnums)
+            return self._wrap(key, entry)
+
+        counters.add("program_misses")
+        jitted = self._jit(fn, static_argnums, donate_argnums)
+        compiled = jitted.lower(*args).compile()
+        if self._export_to_disk(key, compiled):
+            counters.add("aot_exports")
+        else:
+            counters.add("aot_unsupported")
+        entry = self._remember(key, compiled, fn, static_argnums,
+                               donate_argnums)
+        return self._wrap(key, entry)
+
+    @staticmethod
+    def _jit(fn, static_argnums, donate_argnums):
+        import jax
+
+        kwargs = {}
+        if static_argnums:
+            kwargs["static_argnums"] = tuple(static_argnums)
+        if donate_argnums:
+            kwargs["donate_argnums"] = tuple(donate_argnums)
+        return jax.jit(fn, **kwargs)
+
+    def _remember(self, key, compiled, fn, static_argnums, donate_argnums):
+        # The fallback is built lazily: a plain jit of the original fn, used
+        # only if the AOT executable ever rejects its arguments (dtype /
+        # weak-type drift between the exporting and importing process).
+        entry = _Entry(compiled)
+
+        def fallback(*call_args):
+            if entry.fallback is None:
+                entry.fallback = self._jit(fn, static_argnums, donate_argnums)
+            return entry.fallback(*call_args)
+
+        entry.make_fallback = fallback
+        with self._lock:
+            self._mem[key] = entry
+        return entry
+
+    def _wrap(self, key: str, entry: _Entry) -> Callable:
+        def call(*args):
+            try:
+                return entry.compiled(*args)
+            except (TypeError, ValueError):
+                # Strict AOT signature mismatch: drop the entry and serve
+                # through ordinary jit (persistent cache still applies).
+                get_counters().add("aot_unsupported")
+                with self._lock:
+                    self._mem.pop(key, None)
+                try:
+                    os.unlink(self._path(key))
+                except OSError:
+                    pass
+                return entry.make_fallback(*args)
+
+        return call
+
+    # -- introspection ---------------------------------------------------------
+
+    def __contains__(self, key: str) -> bool:
+        with self._lock:
+            if key in self._mem:
+                return True
+        return self._persist and os.path.exists(self._path(key))
+
+    def mem_size(self) -> int:
+        with self._lock:
+            return len(self._mem)
+
+    def disk_keys(self) -> Sequence[str]:
+        if not self._persist or not os.path.isdir(self._dir):
+            return []
+        return sorted(
+            n[: -len(".aotexec")]
+            for n in os.listdir(self._dir)
+            if n.endswith(".aotexec")
+        )
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "mem_programs": self.mem_size(),
+            "disk_programs": len(self.disk_keys()),
+            "directory": self._dir,
+            "serialize_supported": self._serialize_supported,
+        }

@@ -1,0 +1,88 @@
+"""Process-wide compile-artifact counters (the ``compile`` counter family).
+
+Same registry discipline as ``ckpt/metrics.py``: one process-wide instance,
+drivers snapshot at start and publish ``delta_since`` at teardown into
+``experiment_state.json["compile"]`` and TensorBoard ``compile/*`` — so
+"compile-once actually happened" is a property of the artifact, not of
+test logs or a hunch.
+
+Counter semantics:
+
+* ``program_hits`` / ``program_misses`` — program-key lookups that found /
+  did not find a ready executable (any layer: in-memory, AOT disk, or a
+  cache-dir artifact installed by the origin).
+* ``aot_exports`` / ``aot_imports`` — serialized executables written to /
+  loaded from the AOT disk store (``aot.ExecutableCache``).
+* ``aot_unsupported`` — the backend refused serialization; the persistent
+  XLA cache carries the key instead.
+* ``fetch_hits`` / ``fetch_misses`` — cluster-origin artifact fetches that
+  returned / lacked files for the key.
+* ``fetch_fallbacks`` — fetches that FAILED (fault, timeout, partition) and
+  fell back to local compilation — the chaos-exercised path.
+* ``publishes`` — artifacts this process published to the origin.
+* ``prewarmed_spawns`` / ``cold_spawns`` — process-executor trials started
+  on a pre-warmed runner vs a cold ``Popen``.
+* ``prewarm_compiles`` — programs compiled ahead of dispatch during
+  scheduler think-time.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class CompileCounters:
+    """Thread-safe counter registry for compile-artifact activity."""
+
+    _FIELDS = (
+        "program_hits",
+        "program_misses",
+        "aot_exports",
+        "aot_imports",
+        "aot_unsupported",
+        "fetch_hits",
+        "fetch_misses",
+        "fetch_fallbacks",
+        "publishes",
+        "prewarmed_spawns",
+        "cold_spawns",
+        "prewarm_compiles",
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c: Dict[str, float] = {k: 0 for k in self._FIELDS}
+
+    def add(self, name: str, value: float = 1) -> None:
+        with self._lock:
+            self._c[name] = self._c.get(name, 0) + value
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._c.get(name, 0)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in self._c.items()
+            }
+
+    def delta_since(self, baseline: Dict[str, float]) -> Dict[str, float]:
+        snap = self.snapshot()
+        return {k: round(v - baseline.get(k, 0), 4) for k, v in snap.items()}
+
+    def reset(self) -> None:
+        """Test hook: zero every counter."""
+        with self._lock:
+            self._c = {k: 0 for k in self._FIELDS}
+
+
+_counters = CompileCounters()
+
+
+def get_counters() -> CompileCounters:
+    """The process-wide registry (one per process, like the compile-time
+    tracker in ``compilecache/tracker.py``)."""
+    return _counters

@@ -1,0 +1,104 @@
+"""Canonical program keys: config -> shape-class fingerprint -> stable id.
+
+An XLA program is determined by everything that shapes the traced
+computation: model family and architecture knobs, batch/sequence shapes,
+dtypes, optimizer FAMILY (the chain's structure), and the donation
+signature.  It is NOT determined by the hyperparameters that ride in state
+— ``learning_rate`` and ``weight_decay`` live in the injected optimizer
+hyperparams (``ops/optimizers.py``) and ``seed`` enters as a traced PRNG
+key argument — so two trials differing only in those trace to IDENTICAL
+HLO.  The key must say so: that identity is what lets the second trial, the
+second worker, and the restarted replica skip compilation entirely.
+
+The fingerprint must also be **stable across processes and hosts** (the
+cluster origin exchanges artifacts by key; the bench compares keys across
+child processes), so it is a sha256 over a canonical JSON rendering, never
+``hash()`` (salted per process) or ``repr`` of dicts (order-dependent
+pre-3.7 idioms).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+# Hyperparameters that never shape the traced program: they are carried in
+# optimizer state / PRNG arguments (the vectorized runner's VECTOR_KEYS is
+# this same set — tune/vectorized.py asserts they agree).
+NON_STRUCTURAL_KEYS = frozenset({"learning_rate", "weight_decay", "seed"})
+
+# Driver-level knobs that select HOW a program is built/cached but never
+# appear in the traced computation itself.
+_DRIVER_KEYS = frozenset({"share_programs", "checkpoint_freq"})
+
+
+def _canonical(value: Any) -> Any:
+    """JSON-stable rendering: tuples -> lists, sets sorted, floats via repr
+    (json floats are already deterministic in CPython, but -0.0 vs 0.0 and
+    int-valued floats must not alias ints)."""
+    if isinstance(value, (list, tuple)):
+        return [_canonical(v) for v in value]
+    if isinstance(value, (set, frozenset)):
+        return sorted(_canonical(v) for v in value)
+    if isinstance(value, dict):
+        return {str(k): _canonical(v) for k, v in sorted(value.items())}
+    if isinstance(value, float):
+        return f"f:{value!r}"
+    if isinstance(value, bool):
+        return f"b:{value}"
+    return value
+
+
+def shape_class_fingerprint(config: Dict[str, Any]) -> Tuple:
+    """The structural slice of a trial config, as a sorted item tuple.
+
+    Everything except :data:`NON_STRUCTURAL_KEYS` and pure driver knobs is
+    structural — d_model, heads, layers, batch_size, optimizer family,
+    schedule family, interval/steps counts, dtypes all change the traced
+    program.  EXCEPTION: with ``inject_hyperparams=False`` the optimizer
+    bakes lr/wd into the HLO as constants, so they become structural again
+    (the key must split what the compiler splits)."""
+    injected = bool(config.get("inject_hyperparams", True))
+    skip = set(_DRIVER_KEYS)
+    skip.update(
+        k for k in NON_STRUCTURAL_KEYS
+        if injected or k == "seed"  # seed is a traced argument either way
+    )
+    items = []
+    for k in sorted(config):
+        if k in skip:
+            continue
+        items.append((k, _canonical(config[k])))
+    return tuple(items)
+
+
+def program_key(
+    config: Dict[str, Any],
+    *,
+    batch_shape: Optional[Sequence[Sequence[int]]] = None,
+    dtype: Optional[str] = None,
+    donation: Sequence[int] = (),
+    extra: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Stable id for one (shape class, batch shape, dtype, donation) program.
+
+    ``batch_shape``: the data shapes the program closes over / is called
+    with (e.g. staged train/val split shapes, or a serve bucket's padded
+    input shape).  ``donation``: the ``donate_argnums`` signature — a
+    donated and an undonated build of the same computation are different
+    executables.  ``extra``: any additional identity the caller knows
+    (population row count, scan trip count, mesh topology).
+    """
+    payload = {
+        "v": 1,  # key-format version: bump if the canonicalization changes
+        "fingerprint": _canonical(list(shape_class_fingerprint(config))),
+        "batch_shape": _canonical(
+            [list(s) for s in batch_shape] if batch_shape else []
+        ),
+        "dtype": dtype or "",
+        "donation": sorted(int(d) for d in donation),
+        "extra": _canonical(extra or {}),
+    }
+    blob = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+    return "pk_" + hashlib.sha256(blob.encode()).hexdigest()[:32]

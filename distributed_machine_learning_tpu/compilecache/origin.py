@@ -1,0 +1,161 @@
+"""Compile-artifact origin: pack, ship, and install cache entries by key.
+
+The cluster head acts as the origin (``tune/cluster.py``): a worker about
+to run a trial asks the head for artifacts under the trial's program key
+BEFORE compiling locally; a worker that did compile publishes what the
+compile produced.  What travels is the set of files the compile added to
+the worker's local cache directories — persistent-XLA-cache entries and/or
+AOT serialized executables — so the receiving worker's next jit call
+resolves as a cache hit instead of a backend compile.
+
+These helpers are deliberately transport-agnostic (the cluster reuses its
+existing length-prefixed control-plane frames): ``snapshot_cache_dir`` /
+``pack_artifacts`` on the publishing side, ``install_artifacts`` on the
+receiving side, :class:`ArtifactRegistry` on the head.
+
+Paths are flattened to basenames and re-rooted under the receiver's own
+cache directory; ``install_artifacts`` rejects any name that would escape
+it (the control plane is trusted-network, but a path traversal bug would
+be a path traversal bug regardless).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Dict, List, Optional, Sequence, Set
+
+
+def snapshot_cache_dir(directory: Optional[str]) -> Set[str]:
+    """The file names currently in ``directory`` (recursive, relative
+    paths) — diffed after a compile to find what it produced."""
+    names: Set[str] = set()
+    if not directory or not os.path.isdir(directory):
+        return names
+    for root, _dirs, files in os.walk(directory):
+        rel_root = os.path.relpath(root, directory)
+        for f in files:
+            if f.endswith(".tmp"):
+                continue
+            names.add(f if rel_root == "." else os.path.join(rel_root, f))
+    return names
+
+
+def pack_artifacts(
+    directory: Optional[str], names: Sequence[str],
+    max_bytes: int = 64 * 1024 * 1024,
+) -> Dict[str, bytes]:
+    """Read ``names`` (relative paths from :func:`snapshot_cache_dir`) into
+    a {name: bytes} payload, skipping anything missing or oversize (a
+    multi-GB executable must not wedge the control plane)."""
+    out: Dict[str, bytes] = {}
+    if not directory:
+        return out
+    total = 0
+    for name in sorted(names):
+        path = os.path.join(directory, name)
+        try:
+            size = os.path.getsize(path)
+            if total + size > max_bytes:
+                continue
+            with open(path, "rb") as f:
+                out[name] = f.read()
+            total += size
+        except OSError:
+            continue
+    return out
+
+
+def install_artifacts(directory: str, files: Dict[str, bytes]) -> int:
+    """Write fetched artifacts under ``directory`` (atomic per file; an
+    existing file is left alone — first writer wins, contents are
+    content-addressed upstream anyway).  Returns how many files landed."""
+    installed = 0
+    base = os.path.realpath(directory)
+    for name, data in files.items():
+        dest = os.path.realpath(os.path.join(base, name))
+        if not dest.startswith(base + os.sep):
+            continue  # traversal attempt; drop it
+        if os.path.exists(dest):
+            continue
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(dest), suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, dest)
+            installed += 1
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    return installed
+
+
+class ArtifactRegistry:
+    """Head-side store: program key -> published artifact files.
+
+    Thread-compatible with the cluster driver's single event-loop thread;
+    the lock makes it safe for tests that poke it directly.  Counters feed
+    ``experiment_state.json["compile"]``:
+
+    * ``origin_publishes`` — distinct (key, publish) events accepted; the
+      "<= K head-side compiles for K shape classes" acceptance bound reads
+      this.
+    * ``origin_fetch_hits`` / ``origin_fetch_misses`` — fetches answered
+      with / without files.
+    """
+
+    def __init__(self, max_bytes: int = 256 * 1024 * 1024):
+        self._lock = threading.Lock()
+        self._by_key: Dict[str, Dict[str, bytes]] = {}
+        self._bytes = 0
+        self._max_bytes = max_bytes
+        self.counters: Dict[str, int] = {
+            "origin_publishes": 0,
+            "origin_fetch_hits": 0,
+            "origin_fetch_misses": 0,
+        }
+
+    def publish(self, key: str, files: Dict[str, bytes]) -> bool:
+        """Accept a worker's published artifacts.  First publish per key
+        wins (every publisher compiled the SAME program; later copies add
+        nothing).  Returns whether the publish was stored."""
+        if not files:
+            return False
+        size = sum(len(b) for b in files.values())
+        with self._lock:
+            if key in self._by_key:
+                return False
+            if self._bytes + size > self._max_bytes:
+                # Evict oldest entries (dict order) until it fits; the
+                # registry is a warm-start accelerator, not a durability
+                # contract.
+                for old in list(self._by_key):
+                    if self._bytes + size <= self._max_bytes:
+                        break
+                    dropped = self._by_key.pop(old)
+                    self._bytes -= sum(len(b) for b in dropped.values())
+            self._by_key[key] = dict(files)
+            self._bytes += size
+            self.counters["origin_publishes"] += 1
+            return True
+
+    def fetch(self, key: str) -> Optional[Dict[str, bytes]]:
+        with self._lock:
+            files = self._by_key.get(key)
+            if files:
+                self.counters["origin_fetch_hits"] += 1
+                return dict(files)
+            self.counters["origin_fetch_misses"] += 1
+            return None
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return sorted(self._by_key)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.counters, distinct_keys=len(self._by_key))

@@ -285,9 +285,11 @@ class ReplicaSet:
     """N replicas behind one ``submit()`` — round-robin over the healthy.
 
     ``restart=True`` runs a monitor thread that respawns dead replicas on
-    their original leased device (a fresh engine re-jits from the shared
-    persistent compile cache, so recovery does not re-pay backend
-    compiles).  ``kill()`` hard-stops one replica's worker — dispatch
+    their original leased device (a fresh engine loads its bucket programs
+    through the AOT executable cache — ``compilecache.ExecutableCache``,
+    same program keys as tune — deserializing finished executables, with
+    the shared persistent XLA cache as the fallback tier; recovery
+    re-pays neither tracing nor backend compiles).  ``kill()`` hard-stops one replica's worker — dispatch
     fails over to the survivors immediately, and the monitor treats the
     gap like any other death; pass ``restart=False`` for an operator
     drain that should stay down.

@@ -191,6 +191,82 @@ class _WorkerState:
         # worker never swallow each other's decisions.
         self.decisions: Dict[Tuple[str, int], "queue.Queue[str]"] = {}
         self.dec_lock = threading.Lock()
+        # program key -> reply queue for in-flight compile-artifact fetches
+        # (the trial thread blocks on it; the recv loop answers).
+        self.artifact_replies: Dict[str, "queue.Queue"] = {}
+        self.art_lock = threading.Lock()
+
+
+# Program keys this worker PROCESS has already fetched-or-compiled: the
+# first trial of a shape class talks to the origin; its siblings on this
+# host ride the local jit/persistent caches without another round trip.
+_SEEN_PROGRAM_KEYS: set = set()
+_SEEN_KEYS_LOCK = threading.Lock()
+
+_ARTIFACT_FETCH_TIMEOUT_S = float(
+    os.environ.get("DML_ARTIFACT_FETCH_TIMEOUT_S", "10.0")
+)
+
+
+def _fetch_artifacts(state: _WorkerState, key: str) -> bool:
+    """Ask the head for compile artifacts under ``key`` and install them
+    into this process's compile-cache directory.  EVERY failure — injected
+    fault, timeout, dead driver, bad payload — degrades to a local compile
+    (counted ``fetch_fallbacks``); a fetch can slow a trial start, never
+    fail a trial."""
+    from distributed_machine_learning_tpu import chaos
+    from distributed_machine_learning_tpu import compilecache as cc
+
+    counters = cc.get_counters()
+    q: "queue.Queue" = queue.Queue()
+    try:
+        plan = chaos.active_plan()
+        if plan is not None:
+            plan.on_artifact_fetch(key)
+        with state.art_lock:
+            state.artifact_replies[key] = q
+        _send(state.sock, state.send_lock,
+              {"type": "artifact_get", "key": key}, state.secret)
+        files = q.get(timeout=_ARTIFACT_FETCH_TIMEOUT_S)
+    except Exception as exc:  # noqa: BLE001 - fall back to local compile
+        counters.add("fetch_fallbacks")
+        print(f"[worker] artifact fetch for {key} failed ({exc!r}); "
+              f"compiling locally", flush=True)
+        return False
+    finally:
+        with state.art_lock:
+            state.artifact_replies.pop(key, None)
+    cache = cc.cache_dir()
+    if files and cache:
+        cc.install_artifacts(cache, files)
+        counters.add("fetch_hits")
+        return True
+    counters.add("fetch_misses")
+    return False
+
+
+def _publish_artifacts(state: _WorkerState, key: str,
+                       pre_files: set) -> None:
+    """Diff the compile-cache directory against its pre-trial snapshot and
+    publish what THIS compile produced to the head's artifact registry."""
+    from distributed_machine_learning_tpu import compilecache as cc
+
+    cache = cc.cache_dir()
+    if not cache:
+        return
+    new = cc.snapshot_cache_dir(cache) - pre_files
+    if not new:
+        return
+    files = cc.pack_artifacts(cache, new)
+    if not files:
+        return
+    try:
+        _send(state.sock, state.send_lock,
+              {"type": "artifact_put", "key": key, "files": files},
+              state.secret)
+        cc.get_counters().add("publishes")
+    except OSError:
+        pass  # driver gone; nothing to publish to
 
 
 def _worker_run_trial(state: _WorkerState, msg: Dict[str, Any], devices: List):
@@ -211,7 +287,31 @@ def _worker_run_trial(state: _WorkerState, msg: Dict[str, Any], devices: List):
     ckpt_format = msg.get("checkpoint_format", "msgpack")
     iteration = [int(msg.get("start_iteration", 0))]
 
+    # Compile-artifact origin (compile-once tentpole): the FIRST trial of a
+    # program key on this host asks the head for the key's artifacts before
+    # compiling locally; if it does compile, the first report boundary
+    # (compiles complete by then) diffs the cache dir and publishes the new
+    # entries.  Siblings on this host skip the round trip entirely.
+    publish_key = [None]  # set -> publish at the first report boundary
+    pre_files: set = set()
+    if msg.get("artifact_origin"):
+        from distributed_machine_learning_tpu import compilecache as cc
+
+        key = cc.program_key(trial.config)
+        with _SEEN_KEYS_LOCK:
+            first_here = key not in _SEEN_PROGRAM_KEYS
+            _SEEN_PROGRAM_KEYS.add(key)
+        if first_here:
+            pre_files = cc.snapshot_cache_dir(cc.cache_dir())
+            if not _fetch_artifacts(state, key):
+                publish_key[0] = key
+
     def report_fn(metrics: Dict[str, Any], checkpoint) -> str:
+        if publish_key[0] is not None:
+            # First report of the compiling incarnation: everything this
+            # program needed is compiled; ship the fresh cache entries.
+            _publish_artifacts(state, publish_key[0], pre_files)
+            publish_key[0] = None
         # Chaos hooks (plan activated from DML_CHAOS_PLAN on this worker —
         # supervisors are separate processes): a hang sleeps HERE so the
         # driver-side progress watchdog sees real silence from a real
@@ -355,11 +455,18 @@ def serve_worker(
     import jax
 
     from distributed_machine_learning_tpu import chaos
+    from distributed_machine_learning_tpu import compilecache as _cc
 
     # Supervisors are separate processes — a chaos harness reaches them
     # through the spawn environment, not chaos.activate() in the driver.
     if chaos.activate_from_env() is not None:
         print("[worker] chaos plan activated from environment", flush=True)
+
+    # Workers own compile amortization the way tune.run does: the host's
+    # persistent cache catches repeats across trials AND across sweeps
+    # ($DML_TPU_COMPILE_CACHE scopes it per host), and the artifact origin
+    # fetches/publishes entries for it by program key.
+    _cc.enable_persistent_cache()
 
     devices = list(jax.devices())
     slots = slots or len(devices)
@@ -455,6 +562,13 @@ def _serve_driver_connection(
                 )
             if dq is not None:
                 dq.put(msg["decision"])
+        elif mtype == "artifact":
+            # Head's answer to an artifact_get: wake the trial thread
+            # blocked in _fetch_artifacts (None files = origin miss).
+            with state.art_lock:
+                aq = state.artifact_replies.get(msg.get("key", ""))
+            if aq is not None:
+                aq.put(msg.get("files"))
         elif mtype == "fence":
             # Self-fencing: the driver requeued this trial elsewhere (we
             # looked hung or partitioned).  Pre-load a stop decision so the
@@ -518,6 +632,10 @@ def join_driver(
     sock.settimeout(None)
 
     import jax
+
+    from distributed_machine_learning_tpu import compilecache as _cc
+
+    _cc.enable_persistent_cache()  # same amortization as serve_worker
 
     devices = list(jax.devices())
     slots = slots or len(devices)
@@ -679,6 +797,7 @@ def run_distributed(
     checkpoint_storage: Optional[str] = None,
     checkpoint_format: str = "msgpack",
     elastic_listen: Union[str, socket.socket, None] = None,
+    artifact_origin: Union[bool, "ArtifactRegistry"] = True,
     resume: bool = False,
     points_to_evaluate: Optional[Sequence[Dict[str, Any]]] = None,
     stop=None,
@@ -701,6 +820,22 @@ def run_distributed(
     joiner the moment its hello lands, and ``workers`` may be empty (the
     driver then waits for the first joiner instead of failing).
 
+    ``artifact_origin``: the head doubles as a **compile-artifact origin**
+    (compile-once tentpole).  Before compiling a program key it has not
+    seen, a worker asks the head for that key's cache artifacts
+    (``artifact_get``/``artifact`` frames); a worker that does compile
+    publishes the new cache entries (``artifact_put``), so a sweep of N
+    trials over K distinct shape classes compiles each program once per
+    slice topology instead of once per worker.  Fetch failures (chaos
+    ``artifact_fetch_error_rate``, timeouts, partitions) always fall back
+    to local compilation.  Head counters (``origin_publishes``,
+    ``origin_fetch_hits``/``misses``, ``distinct_keys``) land in
+    ``experiment_state.json["compile"]``; worker-side fetch/publish
+    counters stay on the workers.  ``False`` answers every fetch empty and
+    drops publishes.  Pass a ``compilecache.ArtifactRegistry`` instead of
+    ``True`` to keep the registry alive ACROSS sweeps on a long-lived
+    head — the next experiment's workers then warm-start from everything
+    earlier sweeps compiled.
     ``resume``: continue an interrupted distributed experiment (requires an
     explicit ``name``) — same semantics as ``tune.run(resume=True)``:
     finished trials kept and replayed, interrupted trials redispatched from
@@ -793,8 +928,21 @@ def run_distributed(
     store = ExperimentStore(storage_path, name, checkpoint_storage,
                             checkpoint_format=checkpoint_format)
     from distributed_machine_learning_tpu.ckpt import get_metrics
+    from distributed_machine_learning_tpu import compilecache
 
     ckpt_metrics_base = get_metrics().snapshot()
+    compile_tracker_base = compilecache.get_tracker().snapshot()
+    compile_counters_base = compilecache.get_counters().snapshot()
+    # Head-side artifact registry: program key -> the cache files the first
+    # compiling worker published (see the artifact_origin docstring).  A
+    # caller-provided registry persists across runs; counters are scoped to
+    # this run via the baseline snapshot.
+    if isinstance(artifact_origin, compilecache.ArtifactRegistry):
+        artifacts = artifact_origin
+        artifact_origin = True
+    else:
+        artifacts = compilecache.ArtifactRegistry()
+    artifacts_base = artifacts.snapshot()
     store.set_context(metric, mode)
 
     events: "queue.Queue[Tuple]" = queue.Queue()
@@ -972,6 +1120,7 @@ def run_distributed(
                     "checkpoint_format": store.checkpoint_format,
                     "restore_path": trial.restore_path,
                     "start_iteration": trial.training_iteration,
+                    "artifact_origin": artifact_origin,
                 }
             )
         except OSError:
@@ -1189,6 +1338,32 @@ def run_distributed(
             if mtype == "heartbeat":
                 continue  # liveness only; last_seen already stamped
 
+            if mtype == "artifact_get":
+                # Compile-artifact origin: answer from the registry (None =
+                # miss; the worker compiles locally and publishes).  Served
+                # inline on the event loop — payloads are cache entries
+                # (KBs..MBs), not checkpoints.
+                files = (
+                    artifacts.fetch(msg.get("key", ""))
+                    if artifact_origin else None
+                )
+                try:
+                    worker.send({
+                        "type": "artifact",
+                        "key": msg.get("key", ""),
+                        "files": files,
+                    })
+                except OSError:
+                    worker.alive = False
+                continue
+
+            if mtype == "artifact_put":
+                if artifact_origin:
+                    artifacts.publish(
+                        msg.get("key", ""), msg.get("files") or {}
+                    )
+                continue
+
             trial = by_id.get(msg.get("trial_id", ""))
             if trial is None:
                 continue
@@ -1329,6 +1504,18 @@ def run_distributed(
         ckpt_counters = get_metrics().delta_since(ckpt_metrics_base)
         if any(ckpt_counters.values()):
             extra["checkpoint"] = ckpt_counters
+        # Compile block: head-side tracker/counter deltas + the origin
+        # registry ("<= K head-side compiles for K shape classes" reads
+        # origin_publishes; worker-side fetch counters stay worker-local).
+        reg = artifacts.snapshot()
+        extra["compile"] = {
+            **compilecache.state_block(
+                compile_tracker_base, compile_counters_base
+            ),
+            **{k: v - artifacts_base.get(k, 0) for k, v in reg.items()
+               if k != "distinct_keys"},
+            "distinct_keys": reg["distinct_keys"],
+        }
         try:
             store.write_state(trials, extra=extra)
             store.close()
@@ -1341,6 +1528,8 @@ def run_distributed(
                for k, v in (extra.get("injected_faults") or {}).items()},
             **{f"checkpoint/{k}": v
                for k, v in (extra.get("checkpoint") or {}).items()},
+            **{f"compile/{k}": v
+               for k, v in (extra.get("compile") or {}).items()},
         }
         if counter_scalars:
             safe_cb("on_experiment_counters", counter_scalars)

@@ -27,7 +27,7 @@ import threading
 import time
 import traceback
 from collections import deque
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 
@@ -40,7 +40,10 @@ from distributed_machine_learning_tpu.tune.session import (
     set_session,
 )
 from distributed_machine_learning_tpu.tune.trial import Trial
-from distributed_machine_learning_tpu.utils.compile_cache import get_tracker
+from distributed_machine_learning_tpu.compilecache import (
+    get_counters as get_compile_counters,
+    get_tracker,
+)
 
 
 class DeviceManager:
@@ -425,7 +428,8 @@ class ProcessTrialExecutor:
 
     supports_kill = True
 
-    def __init__(self, store, event_queue: "queue.Queue", watchdog=None):
+    def __init__(self, store, event_queue: "queue.Queue", watchdog=None,
+                 prewarm: int = 0):
         self.store = store
         self.events = event_queue
         # Optional liveness.DispatchWatchdog: result and "beat" frames from
@@ -434,6 +438,29 @@ class ProcessTrialExecutor:
         self.watchdog = watchdog
         self._procs: Dict[str, subprocess.Popen] = {}
         self._pumps: Dict[str, threading.Thread] = {}
+        # Pre-warmed runner pool (compile-once tentpole): children spawned
+        # BEFORE their trial is assigned, with DML_PREWARM=1 so they
+        # front-load jax import + device enumeration + compile-cache attach
+        # and then block on stdin.  start_trial hands a pending init frame
+        # to a matching warm child instead of paying a cold Popen + import;
+        # the pool replenishes in the background after each take.  Entries
+        # are keyed by their exact child environment — a warm child is only
+        # usable for a lease that produces the SAME env (device visibility
+        # is per-process), so on multi-chip leases the pool simply misses
+        # and the cold path runs.
+        self._prewarm = max(int(prewarm), 0)
+        self._pool_lock = threading.Lock()
+        self._pool: List[Tuple[tuple, subprocess.Popen]] = []
+        self._prewarmed_keys: set = set()
+        self._closing = False
+        if self._prewarm:
+            try:
+                env = self._child_env([jax.devices()[0]])
+            except Exception:  # noqa: BLE001 - no backend yet; pool idles
+                env = None
+            if env is not None:
+                for _ in range(self._prewarm):
+                    self._add_warm_child(env)
 
     # -- env -----------------------------------------------------------------
     def _child_env(self, devices: List) -> dict:
@@ -470,19 +497,111 @@ class ProcessTrialExecutor:
             ).rstrip(os.pathsep)
         return env
 
-    # -- lifecycle -----------------------------------------------------------
-    def start_trial(self, trial: Trial, trainable: Callable, leased_devices: List):
-        trial.assigned_devices = leased_devices
-        trial._kill_reason = None  # fresh incarnation, fresh diagnosis
-        proc = subprocess.Popen(
+    # -- pre-warmed pool -----------------------------------------------------
+    @staticmethod
+    def _env_key(env: dict) -> tuple:
+        from distributed_machine_learning_tpu.tune._process_child import (
+            PREWARM_ENV,
+        )
+
+        return tuple(sorted(
+            (k, v) for k, v in env.items() if k != PREWARM_ENV
+        ))
+
+    def _spawn(self, env: dict, warm: bool) -> subprocess.Popen:
+        from distributed_machine_learning_tpu.tune._process_child import (
+            PREWARM_ENV,
+        )
+
+        if warm:
+            env = dict(env, **{PREWARM_ENV: "1"})
+        return subprocess.Popen(
             [sys.executable, "-m",
              "distributed_machine_learning_tpu.tune._process_child"],
             stdin=subprocess.PIPE,
             stdout=subprocess.PIPE,
             stderr=None,  # trainable prints/tracebacks pass through
-            env=self._child_env([d for _, d in leased_devices]),
+            env=env,
             cwd=_REPO_ROOT,
         )
+
+    def _add_warm_child(self, env: dict) -> None:
+        proc = self._spawn(env, warm=True)
+        with self._pool_lock:
+            if self._closing:
+                proc.terminate()
+                return
+            self._pool.append((self._env_key(env), proc))
+
+    def _take_warm_child(self, env: dict) -> Optional[subprocess.Popen]:
+        """Pop a live warm child whose environment matches ``env`` exactly
+        (device visibility is baked into the child process) and replenish
+        the slot in the background — by the next dispatch the pool is hot
+        for THIS lease shape, even if the initial fill guessed another."""
+        want = self._env_key(env)
+        with self._pool_lock:
+            for i, (key, proc) in enumerate(self._pool):
+                if key == want and proc.poll() is None:
+                    del self._pool[i]
+                    break
+            else:
+                proc = None
+        if self._prewarm and not self._closing:
+            threading.Thread(
+                target=self._add_warm_child, args=(dict(env),),
+                name="runner-prewarm", daemon=True,
+            ).start()
+        return proc
+
+    def prewarm_program(self, trainable: Callable, config: Dict,
+                        key: str) -> bool:
+        """Think-time precompile: ask an idle warm child to trace + compile
+        the programs ``config`` needs (it stops at the first report
+        boundary), populating the shared persistent/AOT caches before any
+        trial with this program key is dispatched.  Fire-and-forget: the
+        ack frame is consumed (and skipped) by whichever pump later adopts
+        the child.  Returns whether a request was sent."""
+        if key in self._prewarmed_keys:
+            return False
+        with self._pool_lock:
+            target = next(
+                (proc for _, proc in self._pool if proc.poll() is None), None
+            )
+        if target is None:
+            return False
+        try:
+            import cloudpickle
+
+            from distributed_machine_learning_tpu.tune import (
+                _process_child as pc,
+            )
+
+            pc.write_frame(
+                target.stdin,
+                ("precompile", {
+                    "key": key,
+                    "trainable": cloudpickle.dumps(trainable),
+                    "config": dict(config),
+                    "sys_path": list(sys.path),
+                }),
+            )
+        except (OSError, ValueError):
+            return False  # child died or stdin closed; pool self-heals
+        self._prewarmed_keys.add(key)
+        get_compile_counters().add("prewarm_compiles")
+        return True
+
+    # -- lifecycle -----------------------------------------------------------
+    def start_trial(self, trial: Trial, trainable: Callable, leased_devices: List):
+        trial.assigned_devices = leased_devices
+        trial._kill_reason = None  # fresh incarnation, fresh diagnosis
+        env = self._child_env([d for _, d in leased_devices])
+        proc = self._take_warm_child(env) if self._prewarm else None
+        if proc is not None:
+            get_compile_counters().add("prewarmed_spawns")
+        else:
+            get_compile_counters().add("cold_spawns")
+            proc = self._spawn(env, warm=False)
         self._procs[trial.trial_id] = proc
         # The init frame (cloudpickled trainable + restore checkpoint) is
         # written by the pump thread, not here: a dead child's BrokenPipe or
@@ -525,19 +644,32 @@ class ProcessTrialExecutor:
         """Terminate every still-running child, then wait for the pumps
         (shared deadline).  Runner teardown calls this so an interrupted
         sweep never leaves orphan trial processes holding devices."""
+        with self._pool_lock:
+            self._closing = True
+            pool = list(self._pool)
+            self._pool.clear()
+        for _, proc in pool:
+            # Unassigned warm children: close stdin (EOF is their exit
+            # signal) and terminate; nothing of value is lost.
+            try:
+                proc.stdin.close()
+            except OSError:
+                pass
+            if proc.poll() is None:
+                proc.terminate()
         for proc in list(self._procs.values()):
             if proc.poll() is None:
                 proc.terminate()
         deadline = time.time() + timeout
         for t in list(self._pumps.values()):
             t.join(timeout=max(deadline - time.time(), 0.0))
-        for proc in list(self._procs.values()):
+        for proc in list(self._procs.values()) + [p for _, p in pool]:
             if proc.poll() is None:
                 proc.kill()
-                try:
-                    proc.wait(timeout=5.0)  # reap — no zombies, chips freed
-                except subprocess.TimeoutExpired:  # pragma: no cover
-                    pass
+            try:
+                proc.wait(timeout=5.0)  # reap — no zombies, chips freed
+            except subprocess.TimeoutExpired:  # pragma: no cover
+                pass
 
     # -- parent-side pump thread --------------------------------------------
     def _pump(self, trial: Trial, trainable: Callable, proc: subprocess.Popen,
@@ -577,6 +709,16 @@ class ProcessTrialExecutor:
             while True:
                 msg = pc.read_frame(proc.stdout)
                 kind = msg[0]
+                if kind in ("warm", "prewarmed", "prewarm_error"):
+                    # Pool bookkeeping frames from this child's pre-trial
+                    # life (readiness ack, think-time precompile results);
+                    # queued in the pipe until this pump adopted it.
+                    if kind == "prewarm_error":
+                        print(
+                            f"[executor] prewarm of {msg[1]} failed:\n"
+                            f"{msg[2]}", flush=True,
+                        )
+                    continue
                 if kind == "beat":
                     # Mid-epoch tune.heartbeat() from the child: liveness
                     # only — no runner event, no decision.

@@ -90,6 +90,7 @@ def run(
     compile_cache_dir: Optional[str] = "auto",
     time_limit_per_trial_s: Optional[float] = None,
     trial_executor: str = "thread",
+    prewarm_runners: int = 0,
     resume: bool = False,
     points_to_evaluate: Optional[List[Dict[str, Any]]] = None,
     progress_deadline_s: Optional[float] = None,
@@ -137,6 +138,16 @@ def run(
     ``trial_executor``: "thread" (default; lowest overhead, no preemption) or
     "process" (one OS process per trial with per-process device visibility;
     requires picklable trainables).
+    ``prewarm_runners``: with ``trial_executor="process"``, keep this many
+    PRE-WARMED runner children pooled: spawned before any trial is
+    assigned, they front-load jax import + device enumeration + compile-
+    cache attach, so dispatch-to-first-step latency collapses to frame
+    parsing.  During scheduler think-time the runner also asks an idle
+    warm child to PRE-COMPILE the next pending trial's program (it stops
+    at the first report boundary), so a cold program key is hot in the
+    shared persistent/AOT caches before its trial ever launches.
+    Counters (``prewarmed_spawns``/``cold_spawns``/``prewarm_compiles``)
+    land in ``experiment_state.json["compile"]``.  0 disables (default).
     ``progress_deadline_s``: fail-SLOW detection (liveness.py).  Where
     ``time_limit_per_trial_s`` bounds total runtime, this bounds SILENCE:
     a trial that produces no progress signal (``tune.report`` or
@@ -191,8 +202,13 @@ def run(
     )
     store.set_context(metric, mode)
     from distributed_machine_learning_tpu.ckpt import get_metrics
+    from distributed_machine_learning_tpu import compilecache
 
     ckpt_metrics_base = get_metrics().snapshot()
+    # Scope the process-wide compile registries to THIS run (same
+    # discipline as the checkpoint counters).
+    compile_tracker_base = compilecache.get_tracker().snapshot()
+    compile_counters_base = compilecache.get_counters().snapshot()
     device_mgr = DeviceManager(devices)
     events: "queue.Queue" = queue.Queue()
     watchdog = None
@@ -207,7 +223,8 @@ def run(
     if trial_executor == "thread":
         executor = ThreadTrialExecutor(store, events, watchdog=watchdog)
     elif trial_executor == "process":
-        executor = ProcessTrialExecutor(store, events, watchdog=watchdog)
+        executor = ProcessTrialExecutor(store, events, watchdog=watchdog,
+                                        prewarm=prewarm_runners)
     else:
         raise ValueError(
             f"trial_executor must be 'thread' or 'process', got {trial_executor!r}"
@@ -380,6 +397,16 @@ def run(
             try:
                 event = events.get(timeout=0.5)
             except queue.Empty:
+                # Scheduler think-time: ask an idle pre-warmed runner to
+                # compile the next pending trial's program so its launch
+                # finds every cache hot (no-op without a warm pool; deduped
+                # per program key inside the executor).
+                if pending and hasattr(executor, "prewarm_program"):
+                    cand = pending[0]
+                    executor.prewarm_program(
+                        trainable, cand.config,
+                        compilecache.program_key(cand.config),
+                    )
                 if verbose and time.time() - last_status_print > 15:
                     last_status_print = time.time()
                     log(
@@ -493,6 +520,12 @@ def run(
             "compile_time_total_s": round(cc.get_tracker().total_seconds(), 3),
             "compile_cache_hits": cc.get_tracker().total_cache_hits(),
             "compile_cache_entries": cc.cache_entry_count(),
+            # The compile counter family for THIS run (tracker event counts
+            # + artifact-layer counters) — the block the compile-once
+            # acceptance checks read.
+            "compile": compilecache.state_block(
+                compile_tracker_base, compile_counters_base
+            ),
         }
         if watchdog is not None:
             # Fail-slow observability next to the fail-fast counters: how
@@ -523,6 +556,8 @@ def run(
                for k, v in (extra.get("injected_faults") or {}).items()},
             **{f"checkpoint/{k}": v
                for k, v in (extra.get("checkpoint") or {}).items()},
+            **{f"compile/{k}": v
+               for k, v in (extra.get("compile") or {}).items()},
         }
         if counter_scalars:
             safe_cb("on_experiment_counters", counter_scalars)

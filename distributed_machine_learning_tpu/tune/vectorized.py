@@ -105,7 +105,18 @@ from distributed_machine_learning_tpu.tune.trial import Trial, TrialStatus
 from distributed_machine_learning_tpu.utils.seeding import rng_from
 
 # Hyperparameters that vary across trials *within* one vmapped program.
+# Must agree with compilecache.NON_STRUCTURAL_KEYS: the grouping that
+# decides what shares one vmapped program is the same identity the
+# compile-artifact layer keys programs by.
 VECTOR_KEYS = ("learning_rate", "weight_decay", "seed")
+
+from distributed_machine_learning_tpu.compilecache import (  # noqa: E402
+    NON_STRUCTURAL_KEYS as _NON_STRUCTURAL_KEYS,
+)
+
+assert frozenset(VECTOR_KEYS) == _NON_STRUCTURAL_KEYS, (
+    "vectorized VECTOR_KEYS and compilecache.NON_STRUCTURAL_KEYS diverged"
+)
 
 
 def _static_signature(config: Dict[str, Any]) -> Tuple:
@@ -133,6 +144,19 @@ class _GroupProgram:
                  val_data: Dataset, pop_sharding=None):
         cfg = static_cfg
         self._static_cfg = dict(static_cfg)
+        # Canonical program identity (compilecache): what the persistent
+        # XLA cache amortizes across sweeps/processes and what a cluster
+        # origin would exchange — lr/wd/seed are vmapped state, so they
+        # are absent by construction.
+        from distributed_machine_learning_tpu.compilecache import (
+            program_key as _program_key,
+        )
+
+        self.program_key = _program_key(
+            self._static_cfg,
+            batch_shape=[tuple(train_data.x.shape), tuple(val_data.x.shape)],
+            extra={"vectorized": 1},
+        )
         self.loss_name = str(cfg.get("loss_function", "mse"))
         self.num_epochs = int(cfg.get("num_epochs", 20))
         from distributed_machine_learning_tpu.models import compute_dtype_of
@@ -337,7 +361,10 @@ def _group_program_for(sig: Tuple, static_cfg: Dict[str, Any],
                        train_data: Dataset, val_data: Dataset,
                        pop_sharding, device, log,
                        force_restage: bool = False) -> "_GroupProgram":
+    from distributed_machine_learning_tpu.compilecache import get_counters
+
     if pop_sharding is not None:
+        get_counters().add("program_misses")
         return _GroupProgram(static_cfg, train_data, val_data, pop_sharding)
     # Device identity is part of the key (advisor r4): on a multi-device
     # host, a run with a different explicit device= must not silently hit
@@ -346,10 +373,12 @@ def _group_program_for(sig: Tuple, static_cfg: Dict[str, Any],
     key = (sig, _data_fingerprint(train_data, val_data), dev_id)
     prog = _PROGRAM_CACHE.pop(key, None)
     if prog is not None:
+        get_counters().add("program_hits")
         prog.rebind_data(train_data, val_data, force=force_restage)
         log("program cache hit: reusing traced group program"
             + (" (forced re-stage)" if force_restage else ""))
     else:
+        get_counters().add("program_misses")
         prog = _GroupProgram(static_cfg, train_data, val_data, None)
     _PROGRAM_CACHE[key] = prog  # re-insert = LRU touch (dicts are ordered)
     while len(_PROGRAM_CACHE) > 1 and (
@@ -593,7 +622,7 @@ def run_vectorized(
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
-    from distributed_machine_learning_tpu.utils import compile_cache as cc
+    from distributed_machine_learning_tpu import compilecache as cc
 
     if compile_cache_dir is not None:
         # One sweep = one compile per static-signature group; the persistent
@@ -603,6 +632,8 @@ def run_vectorized(
         )
     tracker = cc.get_tracker()
     compile_s_at_start = tracker.total_seconds()
+    compile_tracker_base = tracker.snapshot()
+    compile_counters_base = cc.get_counters().snapshot()
     if compaction not in ("auto", "always", "never"):
         raise ValueError(
             f"compaction must be 'auto', 'always' or 'never', got {compaction!r}"
@@ -806,6 +837,13 @@ def run_vectorized(
             ),
             "compile_cache_hits": tracker.total_cache_hits(),
             "compile_cache_entries": cc.cache_entry_count(),
+            # Compile counter family for THIS run: tracker event deltas
+            # (uncached backend compiles, persistent-cache hits) plus the
+            # group-program hit/miss counters — population programs load
+            # through the same key space as every other driver.
+            "compile": cc.state_block(
+                compile_tracker_base, compile_counters_base
+            ),
         }
         if watchdog is not None:
             watchdog.close()
@@ -838,6 +876,8 @@ def run_vectorized(
                for k, v in (extra.get("injected_faults") or {}).items()},
             **{f"checkpoint/{k}": v
                for k, v in (extra.get("checkpoint") or {}).items()},
+            **{f"compile/{k}": v
+               for k, v in (extra.get("compile") or {}).items()},
         }
         if counter_scalars:
             safe_cb("on_experiment_counters", counter_scalars)

@@ -1,178 +1,24 @@
-"""Persistent XLA compile-cache ownership + per-trial compile-time accounting.
+"""Compatibility shim: the compile-cache layer grew into ``compilecache/``.
 
-HPO over architecture knobs means every distinct config recompiles under jit
-— SURVEY.md §7 names compile-time amortization the make-or-break hard part of
-HPO-on-TPU (the reference never faced this: torch eager recompiles nothing,
-`ray-tune-hpo-regression.py:322-350`).  Two mechanisms live here:
-
-1. :func:`enable_persistent_cache` — turns on JAX's on-disk compilation cache
-   so that a trial whose traced program matches ANY earlier trial (this run or
-   a previous one) skips XLA backend compilation entirely.  ``tune.run`` and
-   ``tune.run_vectorized`` call this at startup; it is not left to the user.
-
-2. :class:`CompileTimeTracker` — a process-wide listener on JAX's monitoring
-   events that attributes compile seconds and cache hits to the thread that
-   triggered them.  Trial threads each jit their own programs, so per-thread
-   attribution IS per-trial attribution; the executor stamps
-   ``compile_time_s`` into every result record, making compile-vs-execute
-   time visible per trial (and testable: an identical-architecture second
-   trial must report ~zero backend-compile time).
+The tracker and persistent-cache surface this module used to own now lives
+in :mod:`distributed_machine_learning_tpu.compilecache` (which adds program
+keys, AOT executables, the artifact origin, and the ``compile`` counter
+family on top).  Every symbol importable from here keeps working; new code
+should import from the package.
 """
 
-from __future__ import annotations
-
-import os
-import threading
-from typing import Dict, Optional
-
-_DEFAULT_DIR = os.path.join(
-    os.path.expanduser("~"), ".cache", "dml_tpu", "xla_cache"
+from distributed_machine_learning_tpu.compilecache.tracker import (  # noqa: F401
+    CompileTimeTracker,
+    cache_dir,
+    cache_entry_count,
+    enable_persistent_cache,
+    get_tracker,
 )
 
-_lock = threading.Lock()
-_enabled_dir: Optional[str] = None
-
-# Monitoring event names (jax 0.9 `/jax/core/compile/*`,
-# `/jax/compilation_cache/*`) — verified against this image's jax.
-_DURATION_EVENTS = (
-    "/jax/core/compile/backend_compile_duration",
-    "/jax/core/compile/jaxpr_trace_duration",
-    "/jax/core/compile/jaxpr_to_mlir_module_duration",
-)
-_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
-
-
-def enable_persistent_cache(cache_dir: Optional[str] = None) -> str:
-    """Point JAX's persistent compilation cache at ``cache_dir`` (created if
-    missing) and drop the min-size/min-time thresholds so even small HPO
-    programs are cached.  Idempotent; returns the resolved directory.
-
-    Default: ``$DML_TPU_COMPILE_CACHE`` or ``~/.cache/dml_tpu/xla_cache``.
-    """
-    global _enabled_dir
-    resolved = os.path.expanduser(
-        cache_dir
-        or os.environ.get("DML_TPU_COMPILE_CACHE")
-        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
-        or _DEFAULT_DIR
-    )
-    with _lock:
-        if _enabled_dir == resolved:
-            return resolved
-        os.makedirs(resolved, exist_ok=True)
-        import jax
-
-        jax.config.update("jax_compilation_cache_dir", resolved)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-        if _enabled_dir is not None and _enabled_dir != resolved:
-            # JAX instantiates the cache object lazily ONCE; re-pointing the
-            # config after that is silently ignored without a reset.
-            from jax.experimental.compilation_cache import compilation_cache
-
-            compilation_cache.reset_cache()
-        _enabled_dir = resolved
-    return resolved
-
-
-def cache_dir() -> Optional[str]:
-    """The directory the persistent cache is enabled at (None if not)."""
-    return _enabled_dir
-
-
-def cache_entry_count() -> int:
-    """Number of compiled executables currently in the persistent cache."""
-    if not _enabled_dir or not os.path.isdir(_enabled_dir):
-        return 0
-    return sum(1 for name in os.listdir(_enabled_dir) if name.endswith("-cache"))
-
-
-class CompileTimeTracker:
-    """Attributes JAX compile seconds + persistent-cache hits per thread.
-
-    JAX runs monitoring listeners inline on the thread that compiles, so
-    ``threading.get_ident()`` inside the listener identifies which trial
-    thread paid for a compilation.  A single process-wide instance is
-    installed lazily (:func:`get_tracker`); the executor snapshots a thread's
-    counters before a trial starts and diffs after each report.
-    """
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._seconds: Dict[int, float] = {}
-        self._hits: Dict[int, int] = {}
-        self._backend_seconds: Dict[int, float] = {}
-        self._max_backend_s: float = 0.0
-
-    # -- listener callbacks (run on the compiling thread) -------------------
-
-    def _on_duration(self, event: str, duration: float, **_kw):
-        if event not in _DURATION_EVENTS:
-            return
-        ident = threading.get_ident()
-        with self._lock:
-            self._seconds[ident] = self._seconds.get(ident, 0.0) + duration
-            if event == _DURATION_EVENTS[0]:
-                self._backend_seconds[ident] = (
-                    self._backend_seconds.get(ident, 0.0) + duration
-                )
-                self._max_backend_s = max(self._max_backend_s, duration)
-
-    def _on_event(self, event: str, **_kw):
-        if event != _CACHE_HIT_EVENT:
-            return
-        ident = threading.get_ident()
-        with self._lock:
-            self._hits[ident] = self._hits.get(ident, 0) + 1
-
-    # -- queries ------------------------------------------------------------
-
-    def thread_seconds(self, ident: Optional[int] = None) -> float:
-        """Cumulative compile seconds (trace + lower + backend) on a thread."""
-        ident = ident if ident is not None else threading.get_ident()
-        with self._lock:
-            return self._seconds.get(ident, 0.0)
-
-    def thread_backend_seconds(self, ident: Optional[int] = None) -> float:
-        """Cumulative XLA backend-compile seconds on a thread (the part a
-        persistent-cache hit eliminates)."""
-        ident = ident if ident is not None else threading.get_ident()
-        with self._lock:
-            return self._backend_seconds.get(ident, 0.0)
-
-    def thread_cache_hits(self, ident: Optional[int] = None) -> int:
-        ident = ident if ident is not None else threading.get_ident()
-        with self._lock:
-            return self._hits.get(ident, 0)
-
-    def total_seconds(self) -> float:
-        with self._lock:
-            return sum(self._seconds.values())
-
-    def total_cache_hits(self) -> int:
-        with self._lock:
-            return sum(self._hits.values())
-
-    def max_backend_compile_s(self) -> float:
-        """Longest single XLA backend compile seen in this process — the
-        pessimistic price of compiling a program no cache has seen."""
-        with self._lock:
-            return self._max_backend_s
-
-
-_tracker: Optional[CompileTimeTracker] = None
-
-
-def get_tracker() -> CompileTimeTracker:
-    """The process-wide tracker, installing the JAX listeners on first use."""
-    global _tracker
-    with _lock:
-        if _tracker is None:
-            import jax.monitoring
-
-            _tracker = CompileTimeTracker()
-            jax.monitoring.register_event_duration_secs_listener(
-                _tracker._on_duration
-            )
-            jax.monitoring.register_event_listener(_tracker._on_event)
-    return _tracker
+__all__ = [
+    "CompileTimeTracker",
+    "cache_dir",
+    "cache_entry_count",
+    "enable_persistent_cache",
+    "get_tracker",
+]

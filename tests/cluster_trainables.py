@@ -144,6 +144,39 @@ def pbt_trial(config):
             wait_for_peers(epoch)
 
 
+def compiling_trial(config):
+    """Jit-compiles a program whose SHAPE depends on ``config['width']``
+    (the shape class; ``learning_rate`` is the non-structural knob, so
+    same-width trials share one program key) and reports compile/fetch
+    accounting — the workload behind the compile-artifact-origin tests:
+    the first trial of a width must compile (and publish), its siblings
+    must hit the local or fetched cache instead.  (Deterministic fetch-hit
+    tests run two sweeps against one shared ``ArtifactRegistry`` — sweep 1
+    publishes, sweep 2's fresh-cache worker fetches — rather than racing
+    two workers inside one sweep.)"""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_machine_learning_tpu import compilecache as cc
+
+    width = int(config["width"])
+    lr = float(config.get("learning_rate", 1.0))
+    tracker = cc.get_tracker()
+    before = tracker.total_uncached_compiles()
+    x = jnp.full((width, width), lr, jnp.float32)
+    y = float(jax.jit(lambda v: jnp.tanh(v @ v.T).sum())(x))
+    counters = cc.get_counters()
+    for epoch in range(1, int(config.get("epochs", 2)) + 1):
+        tune.report({
+            "loss": abs(y) / epoch + (lr - 1.5) ** 2,
+            "epoch": epoch,
+            "uncached_compiles": tracker.total_uncached_compiles() - before,
+            "worker_fetch_hits": counters.get("fetch_hits"),
+            "worker_fetch_fallbacks": counters.get("fetch_fallbacks"),
+            "worker_publishes": counters.get("publishes"),
+        })
+
+
 def jax_device_trial(config):
     """Touches jax on the worker host to prove device-pinned execution."""
     import jax

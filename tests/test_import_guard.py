@@ -1,13 +1,19 @@
-"""CI guard: every module imports under JAX_PLATFORMS=cpu, and the
-checkpoint path stays pickle-free.
+"""CI guard: every module imports under JAX_PLATFORMS=cpu, imports trigger
+ZERO jit compilation, and the checkpoint path stays pickle-free.
 
-Two invariants the ckpt/ subsystem depends on:
+Invariants the ckpt/ and compilecache/ subsystems depend on:
 
 * **importability** — every module under ``distributed_machine_learning_tpu``
   must import on the CPU test platform (conftest pins
   ``JAX_PLATFORMS=cpu``).  A module that only imports where a TPU is
   attached would make the recovery paths (which import lazily during
   incident handling) fail exactly when they are needed.
+* **no jit work at import** — import-time tracing/compilation is hidden
+  startup cost that EVERY process pays before doing any work (trial
+  children, serve replicas, bench children, cluster workers), exactly the
+  latency the compile-artifact layer exists to kill.  The import sweep
+  runs under a compile-counter hook (``compilecache.get_tracker``) and any
+  trace or backend-compile event it records is a failure naming the cost.
 * **no pickle in the checkpoint path** — the on-disk formats (msgpack
   blob, sharded chunk+JSON generations, serve bundles) must stay process-
   and framework-portable: a checkpoint written by one Python version/
@@ -52,16 +58,32 @@ def _iter_module_names():
 
 def test_every_module_imports_on_cpu():
     assert os.environ.get("JAX_PLATFORMS") == "cpu"  # conftest pinned it
+    # Compile-counter hook BEFORE the sweep: any jit tracing or backend
+    # compilation triggered by an import is hidden startup cost — the
+    # event deltas across the sweep must be zero.
+    from distributed_machine_learning_tpu.compilecache import get_tracker
+
+    tracker = get_tracker()
+    traces_before = tracker.total_traces()
+    compiles_before = tracker.total_backend_compiles()
     failures = []
     names = sorted(_iter_module_names())
     assert len(names) > 40  # the walk really covered the package
     assert f"{pkg.__name__}.ckpt.format" in names
+    assert f"{pkg.__name__}.compilecache.aot" in names
     for name in names:
         try:
             importlib.import_module(name)
         except Exception as exc:  # noqa: BLE001 - collect, report all
             failures.append(f"{name}: {exc!r}")
     assert not failures, "\n".join(failures)
+    traced = tracker.total_traces() - traces_before
+    compiled = tracker.total_backend_compiles() - compiles_before
+    assert traced == 0 and compiled == 0, (
+        f"importing the package traced {traced} program(s) and compiled "
+        f"{compiled} — import-time jit work is startup cost every process "
+        f"pays; move it behind a function"
+    )
 
 
 def test_checkpoint_path_is_pickle_free():
