@@ -190,6 +190,69 @@ def _analyze(rest) -> None:
     rep.on_experiment_end(analysis.trials, state.get("wall_clock_s", 0.0))
 
 
+def _lint(rest) -> None:
+    import argparse
+    import os
+
+    p = argparse.ArgumentParser(
+        prog="lint",
+        description="dmlint: project-native static analysis "
+                    "(docs/static-analysis.md)",
+    )
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/directories to lint (default: the installed "
+                        "package tree)")
+    p.add_argument("--rule", action="append", default=None,
+                   help="run only this rule (name or id; repeatable)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: analysis/baseline.json; "
+                        "'none' disables)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline to absorb every current "
+                        "unsuppressed finding (burn-down workflow)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings (includes suppressed/"
+                        "baselined, marked)")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="also show suppressed and baselined findings")
+    args = p.parse_args(rest)
+
+    # The linter is stdlib-only on purpose: importing the analysis package
+    # pulls in no jax (engine.py docstring) — `dml-tpu lint` stays usable
+    # on hosts where backend init is broken (which is WHEN you lint).
+    from distributed_machine_learning_tpu import analysis
+
+    paths = args.paths or [
+        os.path.dirname(os.path.abspath(analysis.__file__)) + "/.."
+    ]
+    rules = None
+    if args.rule:
+        rules = [analysis.get_rule(r) for r in args.rule]
+    baseline = args.baseline or analysis.DEFAULT_BASELINE
+    if baseline == "none":
+        baseline = None
+    result = analysis.lint_paths(paths, rules=rules, baseline_path=baseline)
+    if args.update_baseline:
+        if baseline is None:
+            print("error: --update-baseline needs a baseline path",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        analysis.save_baseline(baseline, result.unsuppressed())
+        print(f"baseline rewritten: {baseline} "
+              f"({len(result.unsuppressed())} entries)")
+        return
+    if args.json:
+        print(json.dumps({
+            "files_checked": result.files_checked,
+            "findings": [f.to_json() for f in result.findings],
+            "errors": result.errors,
+            "ok": result.ok,
+        }, indent=2))
+    else:
+        print(analysis.render(result, verbose=args.verbose))
+    raise SystemExit(0 if result.ok else 1)
+
+
 def _export_bundle(rest) -> None:
     import argparse
 
@@ -288,9 +351,11 @@ def main(argv=None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
     usage = (
         "usage: python -m distributed_machine_learning_tpu "
-        "{worker|info|probe|analyze|serve|export-bundle|export-orbax} "
+        "{worker|info|probe|analyze|lint|serve|export-bundle|export-orbax} "
         "[args]\n"
         "  worker         host trial supervisor (see 'worker --help')\n"
+        "  lint           dmlint static analysis over the package (or given\n"
+        "                 paths); exit 1 on any unsuppressed finding\n"
         "  info           jax backend/device summary for this process\n"
         "  probe          bounded accelerator health check (child process)\n"
         "  analyze        <experiment_dir>: best config + trial table of a\n"
@@ -316,6 +381,8 @@ def main(argv=None) -> None:
         _probe(rest)
     elif cmd == "analyze":
         _analyze(rest)
+    elif cmd == "lint":
+        _lint(rest)
     elif cmd == "serve":
         _serve(rest)
     elif cmd == "export-bundle":

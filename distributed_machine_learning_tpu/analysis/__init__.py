@@ -1,0 +1,46 @@
+"""Project-native static analysis (dmlint) + runtime lock-order checking.
+
+Two halves, one goal — every hard bug this codebase has shipped was an
+invariant violation that could have been caught mechanically (ISSUE 6):
+
+* ``dmlint`` (:mod:`engine`, :mod:`rules`, :mod:`findings`): an AST rules
+  engine encoding the repo's JAX/concurrency invariants — donation
+  aliasing, unlocked dispatch, chaos determinism, wall-clock deadlines,
+  pickle-free checkpoints, import-time tracing, swallowed thread
+  exceptions.  Run it with ``dml-tpu lint`` (exits non-zero on any
+  unsuppressed finding) or via :func:`lint_paths`.
+* lock-order recording (:mod:`locks`): ``named_lock()``-created locks
+  record per-thread acquisition edges; a cycle in the role graph is a
+  deadlock precondition detectable from single-threaded tests.
+
+This package imports NO jax (and must stay that way): the linter runs in
+environments where initializing a backend is wrong or impossible, and
+``locks`` is imported by low-level modules everywhere.
+
+Catalog, severities, and the suppression/baseline workflow:
+docs/static-analysis.md.
+"""
+
+from distributed_machine_learning_tpu.analysis.engine import (  # noqa: F401
+    DEFAULT_BASELINE,
+    LintResult,
+    iter_python_files,
+    lint_paths,
+    render,
+)
+from distributed_machine_learning_tpu.analysis.findings import (  # noqa: F401
+    Finding,
+    save_baseline,
+)
+from distributed_machine_learning_tpu.analysis.locks import (  # noqa: F401
+    LockOrderRecorder,
+    LockOrderViolation,
+    NamedLock,
+    get_recorder,
+    named_lock,
+)
+from distributed_machine_learning_tpu.analysis.rules import (  # noqa: F401
+    ALL_RULES,
+    CHECKPOINT_PATH_PATTERNS,
+    get_rule,
+)

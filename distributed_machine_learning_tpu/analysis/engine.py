@@ -1,0 +1,165 @@
+"""dmlint engine: walk files, parse, run rules, apply suppressions/baseline.
+
+Deliberately stdlib-only (ast + re + json): the linter must run in every
+environment the package does — CI containers where nothing may be pip
+installed, incident laptops, pre-commit hooks — and the analysis modules
+import no jax of their own (a backend init to lint a file would be a
+DML006 violation in spirit; the eager package ``__init__`` that ``-m``
+pays regardless is __main__.py's documented cross).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from distributed_machine_learning_tpu.analysis import findings as findings_lib
+from distributed_machine_learning_tpu.analysis import rules as rules_lib
+from distributed_machine_learning_tpu.analysis.findings import Finding
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+# ``# dmlint-scope: checkpoint-path, chaos-decisions`` in the first lines
+# of a file opts it into path/name-scoped rules regardless of location —
+# how a new module joins an allowlist (and how fixtures exercise scoped
+# rules from outside the package tree).
+_SCOPE_RE = re.compile(r"#\s*dmlint-scope:\s*([a-z0-9_,\-\s]+)")
+_SCOPE_SCAN_LINES = 15
+
+
+@dataclass
+class FileContext:
+    """Everything a rule may look at for one file."""
+
+    path: str            # as discovered on disk
+    display_path: str    # as reported in findings (relative when possible)
+    source: str
+    lines: List[str]
+    tree: ast.AST
+    scopes: frozenset
+    suppressions: Dict[int, frozenset]
+
+    @property
+    def basename(self) -> str:
+        return os.path.basename(self.path)
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)  # unreadable files
+    files_checked: int = 0
+
+    def unsuppressed(self) -> List[Finding]:
+        return findings_lib.unsuppressed(self.findings)
+
+    @property
+    def ok(self) -> bool:
+        return not self.unsuppressed() and not self.errors
+
+
+def _display_path(path: str) -> str:
+    abspath = os.path.abspath(path)
+    rel = os.path.relpath(abspath)
+    return abspath if rel.startswith("..") else rel
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(
+                d for d in dirs
+                if not d.startswith(".") and d != "__pycache__"
+            )
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def load_context(path: str) -> FileContext:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=path)
+    scopes: set = set()
+    for raw in lines[:_SCOPE_SCAN_LINES]:
+        m = _SCOPE_RE.search(raw)
+        if m:
+            scopes.update(
+                s.strip() for s in m.group(1).split(",") if s.strip()
+            )
+    return FileContext(
+        path=path,
+        display_path=_display_path(path),
+        source=source,
+        lines=lines,
+        tree=tree,
+        scopes=frozenset(scopes),
+        suppressions=findings_lib.parse_suppressions(lines),
+    )
+
+
+def lint_paths(
+    paths: Sequence[str],
+    rules: Optional[Sequence[rules_lib.Rule]] = None,
+    baseline_path: Optional[str] = DEFAULT_BASELINE,
+) -> LintResult:
+    """Run ``rules`` (default: all) over every ``.py`` under ``paths``.
+
+    Findings matching an inline suppression or a baseline entry are kept in
+    the result (marked), so callers can audit what is being silenced; the
+    gate is :meth:`LintResult.unsuppressed`.
+    """
+    active = list(rules) if rules is not None else list(rules_lib.ALL_RULES)
+    result = LintResult()
+    for path in iter_python_files(paths):
+        try:
+            ctx = load_context(path)
+        except SyntaxError as exc:
+            result.errors.append(
+                f"{_display_path(path)}:{exc.lineno or 0}: syntax error: "
+                f"{exc.msg}"
+            )
+            continue
+        except OSError as exc:
+            result.errors.append(f"{_display_path(path)}: unreadable: {exc}")
+            continue
+        result.files_checked += 1
+        for rule in active:
+            if not rule.applies(ctx):
+                continue
+            for finding in rule.check(ctx):
+                finding.suppressed = findings_lib.is_suppressed(
+                    finding, ctx.suppressions
+                )
+                result.findings.append(finding)
+    if baseline_path:
+        findings_lib.apply_baseline(
+            result.findings, findings_lib.load_baseline(baseline_path)
+        )
+    result.findings.sort(key=lambda f: (f.file, f.line, f.rule_id))
+    return result
+
+
+def render(result: LintResult, verbose: bool = False) -> str:
+    out: List[str] = []
+    out.extend(result.errors)
+    for f in result.findings:
+        if f.suppressed or f.baselined:
+            if verbose:
+                tag = "suppressed" if f.suppressed else "baselined"
+                out.append(f"[{tag}] {f.format()}")
+            continue
+        out.append(f.format())
+    out.append(
+        f"dmlint: {result.files_checked} file(s), "
+        f"{findings_lib.summarize(result.findings)}"
+        + (f", {len(result.errors)} unreadable" if result.errors else "")
+    )
+    return "\n".join(out)

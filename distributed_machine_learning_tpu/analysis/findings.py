@@ -1,0 +1,175 @@
+"""dmlint findings model: what a rule reports and how a report is silenced.
+
+Three layers, checked in this order (docs/static-analysis.md):
+
+1. **Inline suppression** — ``# dmlint: disable=rule-name[,other-rule]`` on
+   the offending line (or alone on the line directly above, for statements
+   whose line is already at the width budget).  Everything after the rule
+   list is the REASON and is mandatory by convention: a suppression without
+   a reason is a review question waiting to happen.
+2. **Baseline** — ``analysis/baseline.json``: grandfathered findings keyed
+   by ``(rule, file, stripped source line)`` so entries survive unrelated
+   line-number drift.  The goal state is an EMPTY baseline; it exists so a
+   new rule can land gating CI on day one while its historical findings are
+   burned down in follow-ups.
+3. Anything else is an **unsuppressed finding** and fails the gate
+   (``dml-tpu lint`` exits 1; ``tests/test_analysis.py`` is the tier-1
+   enforcement).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence
+
+SEVERITIES = ("error", "warning")
+
+# ``# dmlint: disable=rule-a,rule-b <free-text reason>``
+_DISABLE_RE = re.compile(
+    r"#\s*dmlint:\s*disable=([A-Za-z0-9_,\-\s]+?)(?:\s+\S.*)?$"
+)
+
+
+@dataclass
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str            # rule name, e.g. "wallclock-deadline"
+    rule_id: str         # stable id, e.g. "DML004"
+    severity: str        # "error" | "warning"
+    file: str            # path as given to the engine (repo-relative in CI)
+    line: int            # 1-based
+    message: str         # what is wrong, in this file's terms
+    hint: str = ""       # the idiomatic fix
+    code: str = ""       # stripped source line (baseline key material)
+    suppressed: bool = field(default=False, compare=False)
+    baselined: bool = field(default=False, compare=False)
+
+    def format(self) -> str:
+        loc = f"{self.file}:{self.line}"
+        out = f"{loc}: {self.rule_id} [{self.rule}] {self.message}"
+        if self.hint:
+            out += f"\n    fix: {self.hint}"
+        return out
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "rule_id": self.rule_id,
+            "severity": self.severity,
+            "file": self.file,
+            "line": self.line,
+            "message": self.message,
+            "hint": self.hint,
+            "code": self.code,
+            "suppressed": self.suppressed,
+            "baselined": self.baselined,
+        }
+
+    def baseline_key(self) -> Dict[str, str]:
+        # Keyed on the stripped source line, not the line NUMBER, so a
+        # baseline survives edits elsewhere in the file; a finding whose
+        # offending line itself changes must be re-justified.
+        return {"rule": self.rule, "file": self.file, "code": self.code}
+
+
+def parse_suppressions(lines: Sequence[str]) -> Dict[int, frozenset]:
+    """Map 1-based line number -> rule names suppressed there.
+
+    A directive on its own line suppresses the NEXT line too (the directive
+    line has no code of its own to suppress, and long statements need
+    somewhere to hang the comment).  ``disable=all`` suppresses every rule.
+    """
+    out: Dict[int, frozenset] = {}
+    for i, raw in enumerate(lines, start=1):
+        m = _DISABLE_RE.search(raw)
+        if not m:
+            continue
+        rules = frozenset(
+            r.strip() for r in m.group(1).split(",") if r.strip()
+        )
+        if not rules:
+            continue
+        out[i] = out.get(i, frozenset()) | rules
+        if raw.split("#", 1)[0].strip() == "":  # directive-only line
+            out[i + 1] = out.get(i + 1, frozenset()) | rules
+    return out
+
+
+def is_suppressed(finding: Finding, suppressions: Dict[int, frozenset]) -> bool:
+    rules = suppressions.get(finding.line)
+    if not rules:
+        return False
+    return "all" in rules or finding.rule in rules or (
+        finding.rule_id in rules
+    )
+
+
+# -- baseline -----------------------------------------------------------------
+
+
+def load_baseline(path: str) -> List[Dict[str, str]]:
+    """Entries of a baseline file ([] for a missing file — an absent
+    baseline and an empty one mean the same thing: nothing grandfathered)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return []
+    if not isinstance(data, dict) or not isinstance(
+        data.get("findings"), list
+    ):
+        raise ValueError(
+            f"malformed baseline {path}: expected {{'findings': [...]}}"
+        )
+    return list(data["findings"])
+
+
+def save_baseline(path: str, findings: Sequence[Finding]) -> None:
+    entries = [f.baseline_key() for f in findings]
+    entries.sort(key=lambda e: (e["file"], e["rule"], e["code"]))
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "comment": (
+                    "Grandfathered dmlint findings. The goal state is an "
+                    "empty list: fix the finding or convert it to an inline "
+                    "'# dmlint: disable=<rule> <reason>' (see "
+                    "docs/static-analysis.md)."
+                ),
+                "findings": entries,
+            },
+            f,
+            indent=2,
+        )
+        f.write("\n")
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: Sequence[Dict[str, str]]
+) -> None:
+    """Mark findings matching a baseline entry (each entry absorbs any
+    number of identical findings in its file — a rule firing twice on two
+    copies of the same line is the same grandfathered debt)."""
+    keys = {(e.get("rule"), e.get("file"), e.get("code")) for e in baseline}
+    for f in findings:
+        if (f.rule, f.file, f.code) in keys:
+            f.baselined = True
+
+
+def unsuppressed(findings: Sequence[Finding]) -> List[Finding]:
+    return [f for f in findings if not f.suppressed and not f.baselined]
+
+
+def summarize(findings: Sequence[Finding]) -> str:
+    live = unsuppressed(findings)
+    n_sup = sum(1 for f in findings if f.suppressed)
+    n_base = sum(1 for f in findings if f.baselined)
+    parts = [f"{len(live)} finding(s)"]
+    if n_sup:
+        parts.append(f"{n_sup} suppressed")
+    if n_base:
+        parts.append(f"{n_base} baselined")
+    return ", ".join(parts)

@@ -1,0 +1,265 @@
+"""Runtime lock-order recorder: deadlock potential as a testable property.
+
+The static rules catch single-site invariants; lock-order inversions are a
+RELATIONSHIP between sites, visible only when threads actually interleave.
+This module makes the relationship observable without provoking the hang:
+
+* :func:`named_lock` — the project's locks are created through this
+  instead of ``threading.Lock()``.  The NAME is the lock's **role**
+  (``"ckpt.writer"``, ``"serve.batcher"``, ``"cluster.worker.send"``):
+  order is a property of roles, not instances — every replica's batcher
+  lock is the same node in the order graph.
+* :class:`LockOrderRecorder` — per-thread stack of held roles; acquiring
+  ``B`` while holding ``A`` records the edge ``A -> B``.  A cycle in the
+  accumulated graph means two code paths disagree about acquisition order:
+  the classic deadlock precondition, detected from ANY single-threaded
+  test that exercises both paths — no lucky interleaving required.
+
+Recording is off by default (a few dict ops per acquisition is nothing
+next to a lock, but the hot paths owe nobody even that).  Tests enable it
+process-wide via ``DML_LOCK_ORDER=1`` (tests/conftest.py) or
+:func:`enable`; ``tests/test_analysis.py`` then drives the
+executor/cluster/serve/ckpt paths and asserts the union graph is acyclic.
+
+Same-role nesting (holding two instances of one role, e.g. two replicas'
+locks) is tracked separately in :attr:`LockOrderRecorder.self_edges`
+rather than reported as a cycle: instance-level order within a role needs
+an instance key, and no current code path nests a role inside itself —
+the counter existing (and asserted zero for the instrumented roles) is
+what keeps it that way.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+_enabled = os.environ.get("DML_LOCK_ORDER", "").strip() in ("1", "true", "on")
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+class LockOrderRecorder:
+    """Accumulates acquisition edges across every NamedLock in-process."""
+
+    def __init__(self):
+        self._mu = threading.Lock()  # guards the graph, NOT a NamedLock
+        self._edges: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        self.self_edges: Dict[str, int] = {}
+        # Every role acquired at least once while recording — coverage
+        # evidence for "the checker was actually active across subsystem X"
+        # (roles acquired only un-nested never appear in the edge graph).
+        self.roles_seen: Set[str] = set()
+        self._tls = threading.local()
+
+    # -- per-thread held stack ----------------------------------------------
+
+    def _held(self) -> List[str]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = []
+            self._tls.stack = stack
+        return stack
+
+    def on_acquired(self, name: str) -> None:
+        if name not in self.roles_seen:  # racy de-dup; set add is atomic
+            self.roles_seen.add(name)
+        stack = self._held()
+        if stack:
+            holder = stack[-1]
+            if holder == name:
+                # RLock reentrancy / same-role instance nesting: not an
+                # order edge (see module docstring).
+                with self._mu:
+                    self.self_edges[name] = self.self_edges.get(name, 0) + 1
+            else:
+                edge = (holder, name)
+                if edge not in self._edges:  # racy pre-check, exact below
+                    with self._mu:
+                        self._edges.setdefault(edge, self._where())
+        stack.append(name)
+
+    def on_released(self, name: str) -> None:
+        stack = self._held()
+        # Locks are overwhelmingly released LIFO, but e.g. Condition.wait
+        # releases out of band — drop the newest matching hold.
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                return
+
+    @staticmethod
+    def _where() -> Tuple[str, str]:
+        """(filename:lineno, function) of the acquiring frame — enough to
+        find the site without hauling full tracebacks around."""
+        import sys
+
+        f = sys._getframe(1) if hasattr(sys, "_getframe") else None
+        this_file = __file__.replace("\\", "/")
+        while f is not None and (
+            f.f_code.co_filename.replace("\\", "/") == this_file
+        ):
+            f = f.f_back
+        if f is None:
+            return ("?", "?")
+        return (
+            f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}",
+            f.f_code.co_name,
+        )
+
+    # -- graph queries -------------------------------------------------------
+
+    def edges(self) -> Dict[Tuple[str, str], Tuple[str, str]]:
+        with self._mu:
+            return dict(self._edges)
+
+    def nodes(self) -> Set[str]:
+        out: Set[str] = set()
+        for a, b in self.edges():
+            out.add(a)
+            out.add(b)
+        return out
+
+    def cycles(self) -> List[List[str]]:
+        """Elementary cycles in the role graph (DFS back-edge walk; the
+        graph is tiny — tens of roles — so simplicity wins)."""
+        adj: Dict[str, Set[str]] = {}
+        for a, b in self.edges():
+            adj.setdefault(a, set()).add(b)
+        WHITE, GREY, BLACK = 0, 1, 2
+        color: Dict[str, int] = {}
+        found: List[List[str]] = []
+        seen_keys: Set[Tuple[str, ...]] = set()
+
+        def visit(node: str, path: List[str]) -> None:
+            color[node] = GREY
+            path.append(node)
+            for nxt in sorted(adj.get(node, ())):
+                c = color.get(nxt, WHITE)
+                if c == GREY:
+                    cyc = path[path.index(nxt):] + [nxt]
+                    lo = min(range(len(cyc) - 1), key=lambda i: cyc[i])
+                    key = tuple(cyc[lo:-1] + cyc[:lo])
+                    if key not in seen_keys:
+                        seen_keys.add(key)
+                        found.append(cyc)
+                elif c == WHITE:
+                    visit(nxt, path)
+            path.pop()
+            color[node] = BLACK
+
+        for node in sorted(adj):
+            if color.get(node, WHITE) == WHITE:
+                visit(node, [])
+        return found
+
+    def assert_acyclic(self) -> None:
+        cycles = self.cycles()
+        if cycles:
+            edges = self.edges()
+            detail = []
+            for cyc in cycles:
+                hops = " -> ".join(cyc)
+                sites = "; ".join(
+                    f"{a}->{b} at {edges.get((a, b), ('?', '?'))[0]}"
+                    for a, b in zip(cyc, cyc[1:])
+                )
+                detail.append(f"  {hops}  ({sites})")
+            raise LockOrderViolation(
+                "lock-order cycle(s) — two code paths disagree about "
+                "acquisition order (deadlock precondition):\n"
+                + "\n".join(detail)
+            )
+
+    def snapshot(self) -> Dict[str, object]:
+        edges = self.edges()
+        return {
+            "roles": sorted(self.roles_seen | self.nodes()),
+            "edges": sorted(f"{a} -> {b}" for a, b in edges),
+            "self_edges": dict(self.self_edges),
+            "cycles": [" -> ".join(c) for c in self.cycles()],
+        }
+
+    def reset(self) -> None:
+        with self._mu:
+            self._edges.clear()
+            self.self_edges.clear()
+            self.roles_seen.clear()
+
+
+class LockOrderViolation(RuntimeError):
+    """A cycle exists in the recorded acquisition graph."""
+
+
+_recorder = LockOrderRecorder()
+
+
+def get_recorder() -> LockOrderRecorder:
+    return _recorder
+
+
+class NamedLock:
+    """threading.Lock/RLock with a role name and order recording.
+
+    Duck-types the lock protocol (``acquire``/``release``/context manager/
+    ``locked``) so it drops into ``threading.Condition`` — the fallback
+    ``Condition._is_owned`` probes with ``acquire(False)``, which records
+    nothing here because failed acquisitions never reach the recorder.
+    """
+
+    __slots__ = ("name", "_inner", "_recorder")
+
+    def __init__(self, name: str, *, reentrant: bool = False,
+                 recorder: Optional[LockOrderRecorder] = None):
+        self.name = name
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+        self._recorder = recorder or _recorder
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got and _enabled:
+            self._recorder.on_acquired(self.name)
+        return got
+
+    def release(self) -> None:
+        if _enabled:
+            self._recorder.on_released(self.name)
+        self._inner.release()
+
+    def __enter__(self) -> "NamedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        inner = self._inner
+        return inner.locked() if hasattr(inner, "locked") else False
+
+    def __repr__(self) -> str:
+        return f"NamedLock({self.name!r})"
+
+
+def named_lock(name: str, *, reentrant: bool = False) -> NamedLock:
+    """A lock participating in order recording under role ``name``.
+
+    Always returns the wrapper (instances outlive enable/disable
+    toggling); when recording is off the per-acquire overhead is one
+    module-global bool test.
+    """
+    return NamedLock(name, reentrant=reentrant)

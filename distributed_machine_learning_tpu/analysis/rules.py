@@ -1,0 +1,842 @@
+"""dmlint rules: the invariants this codebase has already been bitten by.
+
+Every rule here is a postmortem turned executable (ISSUE 6; rule catalog
+with the war stories in docs/static-analysis.md):
+
+* DML001 ``donation-alias`` — PR 4's epoch-6 checkpoint carrying epoch-8
+  optimizer counts: ``np.asarray`` on a CPU-backed ``jax.Array`` aliases
+  the device buffer, and a donated buffer is overwritten in place by the
+  next step.
+* DML002 ``unlocked-dispatch`` — both recorded tunnel wedges came from
+  multi-threaded device dispatch outside ``dispatch_lock``
+  (utils/dispatch.py).
+* DML003 ``chaos-determinism`` — PR 3 shipped two flaky tests because
+  fault decisions hashed run-varying absolute paths; a fault plan that
+  consults wall time, PIDs, or ``random`` is a flake generator.
+* DML004 ``wallclock-deadline`` — lease expiry and wait deadlines on
+  ``time.time()`` break under NTP steps; ``liveness.py`` got this right,
+  ``tune/cluster.py`` and ``ckpt/writer.py`` did not.
+* DML005 ``pickle-checkpoint`` — checkpoint bytes must stay process- and
+  framework-portable (and unpickling shared-storage bytes executes code);
+  previously an ad-hoc source scan in tests/test_import_guard.py.
+* DML006 ``import-trace`` — module-level jit/jnp work is hidden startup
+  cost every process pays (trial children, serve replicas, workers).
+* DML007 ``thread-swallow`` — a background thread whose broad ``except``
+  body is just ``pass`` turns failures into silence; silence is the fault
+  class the whole liveness layer exists to catch.
+
+Rules are deliberately project-native: they encode THIS repo's idioms
+(``dispatch_lock`` with-blocks, ``_is_jax_array`` guards, FaultPlan
+decision methods) rather than generic lint heuristics, which is what keeps
+the false-positive rate at zero on the gate (tests/test_analysis.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set
+
+from distributed_machine_learning_tpu.analysis.findings import Finding
+
+# Modules that serialize/deserialize checkpoint or bundle bytes — the ONE
+# allowlist for the pickle-free invariant (tests/test_import_guard.py
+# consumes this rule instead of keeping its own copy).
+CHECKPOINT_PATH_PATTERNS = (
+    "ckpt/",
+    "tune/checkpoint.py",
+    "tune/storage.py",
+    "serve/export.py",
+)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for an Attribute/Name chain; None for computed bases."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    return _dotted(node.func)
+
+
+def _identifiers(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+class Rule:
+    """One invariant.  Subclasses set the metadata and implement check()."""
+
+    name: str = ""
+    rule_id: str = ""
+    severity: str = "error"
+    description: str = ""
+
+    def applies(self, ctx) -> bool:
+        return True
+
+    def check(self, ctx) -> Iterator[Finding]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def finding(self, ctx, node: ast.AST, message: str,
+                hint: str = "") -> Finding:
+        line = getattr(node, "lineno", 1)
+        code = ""
+        if 1 <= line <= len(ctx.lines):
+            code = ctx.lines[line - 1].strip()
+        return Finding(
+            rule=self.name,
+            rule_id=self.rule_id,
+            severity=self.severity,
+            file=ctx.display_path,
+            line=line,
+            message=message,
+            hint=hint,
+            code=code,
+        )
+
+
+# --------------------------------------------------------------------------
+# DML001 donation-alias
+# --------------------------------------------------------------------------
+
+
+_JAX_ARRAY_GUARD_FNS = re.compile(r"^_?is_jax_array$")
+
+
+class DonationAliasRule(Rule):
+    name = "donation-alias"
+    rule_id = "DML001"
+    severity = "error"
+    description = (
+        "np.asarray / np.array(copy=False) / .view() on a value that is (or "
+        "may be) a jax.Array aliases the device buffer zero-copy on CPU "
+        "backends; if that buffer was donated (donate_argnums) the next "
+        "step overwrites it in place and the 'snapshot' silently mutates."
+    )
+    _HINT = (
+        "take a real copy: np.array(x, copy=True) (or np.asarray(x).copy() "
+        "before the next dispatch)"
+    )
+
+    def check(self, ctx) -> Iterator[Finding]:
+        tree = ctx.tree
+        # Pass 1 (module-wide): names bound to jit-with-donation programs,
+        # then names bound to their call results.
+        donated_fns: Set[str] = set()
+        donated_results: Set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Call):
+                continue
+            callee = _call_name(value)
+            targets = [
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            ]
+            if callee in ("jax.jit", "jit", "pjit", "jax.pjit") and any(
+                kw.arg in ("donate_argnums", "donate_argnames")
+                for kw in value.keywords
+            ):
+                donated_fns.update(targets)
+            elif callee in donated_fns:
+                donated_results.update(targets)
+                for t in node.targets:  # tuple-unpacked results taint all
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        donated_results.update(
+                            e.id for e in t.elts if isinstance(e, ast.Name)
+                        )
+        # Pass 2: aliasing ops on tainted or isinstance-guarded names.
+        yield from self._walk_stmts(tree.body, frozenset(), donated_fns,
+                                    donated_results, ctx)
+
+    def _guarded_names(self, test: ast.AST) -> Set[str]:
+        """Names proven to be jax.Arrays by this if-test."""
+        out: Set[str] = set()
+        tests = (
+            test.values if isinstance(test, ast.BoolOp)
+            and isinstance(test.op, ast.And) else [test]
+        )
+        for t in tests:
+            if not isinstance(t, ast.Call):
+                continue
+            callee = _call_name(t) or ""
+            arg = t.args[0] if t.args else None
+            if not isinstance(arg, ast.Name):
+                continue
+            if callee == "isinstance" and len(t.args) == 2:
+                cls = _dotted(t.args[1]) or ""
+                if cls.endswith("Array") and cls.startswith("jax"):
+                    out.add(arg.id)
+            elif _JAX_ARRAY_GUARD_FNS.match(callee.rsplit(".", 1)[-1]):
+                out.add(arg.id)
+        return out
+
+    def _walk_stmts(self, stmts: Sequence[ast.stmt], guarded: frozenset,
+                    donated_fns: Set[str], donated_results: Set[str],
+                    ctx) -> Iterator[Finding]:
+        """Statement-list walk threading the set of names an enclosing
+        ``isinstance(x, jax.Array)`` / ``_is_jax_array(x)`` test proved to
+        be device arrays: the guard holds inside the if-arm (including
+        nested compound statements) and is dropped in the else-arm."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._walk_stmts(
+                    stmt.body, frozenset(), donated_fns, donated_results, ctx
+                )
+                continue
+            if isinstance(stmt, ast.If):
+                extra = frozenset(self._guarded_names(stmt.test))
+                yield from self._check_expr(
+                    stmt.test, guarded, donated_fns, donated_results, ctx
+                )
+                yield from self._walk_stmts(
+                    stmt.body, guarded | extra, donated_fns,
+                    donated_results, ctx
+                )
+                yield from self._walk_stmts(
+                    stmt.orelse, guarded - extra, donated_fns,
+                    donated_results, ctx
+                )
+                continue
+            header_exprs: List[ast.AST] = []
+            bodies: List[Sequence[ast.stmt]] = []
+            for _, value in ast.iter_fields(stmt):
+                if isinstance(value, list) and value:
+                    if isinstance(value[0], ast.stmt):
+                        bodies.append(value)
+                    elif isinstance(value[0], ast.excepthandler):
+                        bodies.extend(h.body for h in value)
+                    else:
+                        header_exprs.extend(
+                            v for v in value if isinstance(v, ast.AST)
+                        )
+                elif isinstance(value, ast.AST):
+                    header_exprs.append(value)
+            if not bodies:  # simple statement: scan the whole subtree
+                yield from self._check_expr(
+                    stmt, guarded, donated_fns, donated_results, ctx
+                )
+                continue
+            for expr in header_exprs:
+                yield from self._check_expr(
+                    expr, guarded, donated_fns, donated_results, ctx
+                )
+            for body in bodies:
+                yield from self._walk_stmts(
+                    body, guarded, donated_fns, donated_results, ctx
+                )
+
+    def _check_expr(self, node: ast.AST, guarded: frozenset, donated_fns,
+                    donated_results, ctx) -> Iterator[Finding]:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                yield from self._check_call(
+                    sub, guarded, donated_fns, donated_results, ctx
+                )
+
+    def _check_call(self, node: ast.Call, guarded: frozenset, donated_fns,
+                    donated_results, ctx) -> Iterator[Finding]:
+        tainted = set(guarded) | donated_results
+        callee = _call_name(node) or ""
+
+        def _is_tainted(arg: ast.AST) -> Optional[str]:
+            if isinstance(arg, ast.Name) and arg.id in tainted:
+                return arg.id
+            if (
+                isinstance(arg, ast.Call)
+                and (_call_name(arg) or "") in donated_fns
+            ):
+                return _call_name(arg)
+            return None
+
+        arg = node.args[0] if node.args else None
+        if callee in ("np.asarray", "numpy.asarray") and arg is not None:
+            who = _is_tainted(arg)
+            if who:
+                yield self.finding(
+                    ctx, node,
+                    f"np.asarray({who}) may alias a donated device buffer "
+                    f"({who} is a jax.Array here); the next donated step "
+                    f"mutates the 'snapshot' in place",
+                    self._HINT,
+                )
+        elif callee in ("np.array", "numpy.array") and arg is not None:
+            copy_kw = next(
+                (kw for kw in node.keywords if kw.arg == "copy"), None
+            )
+            explicit_no_copy = (
+                copy_kw is not None
+                and isinstance(copy_kw.value, ast.Constant)
+                and copy_kw.value.value is False
+            )
+            who = _is_tainted(arg)
+            if who and explicit_no_copy:
+                yield self.finding(
+                    ctx, node,
+                    f"np.array({who}, copy=False) aliases a donated device "
+                    f"buffer",
+                    self._HINT,
+                )
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "view"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in tainted
+        ):
+            yield self.finding(
+                ctx, node,
+                f"{node.func.value.id}.view() aliases a donated device "
+                f"buffer",
+                self._HINT,
+            )
+
+
+# --------------------------------------------------------------------------
+# DML002 unlocked-dispatch
+# --------------------------------------------------------------------------
+
+
+_DISPATCH_PREFIXES = ("jnp.", "jax.numpy.", "jax.random.")
+_DISPATCH_EXACT = {
+    "jax.device_put", "jax.device_get", "jax.block_until_ready",
+}
+_SCHEDULE_BUILDER = re.compile(r"^(get_|make_|resolve_|register_)")
+
+
+class UnlockedDispatchRule(Rule):
+    name = "unlocked-dispatch"
+    rule_id = "DML002"
+    severity = "error"
+    description = (
+        "Device dispatch (jnp ops, jax.random key creation, schedule "
+        "evaluation, calling a jitted program) in a module that opted into "
+        "dispatch serialization must happen inside `with dispatch_lock():` "
+        "— concurrent trial threads dispatching freely is the recorded "
+        "tunnel-wedge failure mode (utils/dispatch.py)."
+    )
+    _HINT = "move the call inside a `with dispatch_lock():` block"
+
+    def applies(self, ctx) -> bool:
+        if "dispatch-serialized" in ctx.scopes:
+            return True
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom):
+                if any(a.name == "dispatch_lock" for a in node.names):
+                    return True
+        return False
+
+    def check(self, ctx) -> Iterator[Finding]:
+        for node in ctx.tree.body:
+            yield from self._visit(node, in_function=False, lock_depth=0,
+                                   ctx=ctx)
+
+    def _is_lock_with(self, node: ast.With) -> bool:
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                callee = _call_name(expr) or ""
+                if callee.rsplit(".", 1)[-1] == "dispatch_lock":
+                    return True
+        return False
+
+    def _dispatchy(self, node: ast.Call) -> Optional[str]:
+        # jax.jit(...)(...) — compiling AND calling in one expression (the
+        # callee is itself a Call, so check before the dotted-name paths).
+        if isinstance(node.func, ast.Call):
+            inner = _call_name(node.func) or ""
+            if inner in ("jax.jit", "jit", "pjit", "jax.pjit"):
+                return f"{inner}(...)(...)"
+        callee = _call_name(node)
+        if callee is None:
+            return None
+        if callee.startswith(_DISPATCH_PREFIXES) or callee in _DISPATCH_EXACT:
+            return callee
+        # Schedule evaluation: optax schedules are jnp-backed, so calling
+        # one IS a (small) device dispatch.  Builders (get_/make_*) only
+        # construct the closure and stay host-side.
+        if (
+            isinstance(node.func, ast.Name)
+            and "schedule" in node.func.id
+            and not _SCHEDULE_BUILDER.match(node.func.id)
+        ):
+            return node.func.id
+        return None
+
+    def _visit(self, node: ast.AST, in_function: bool, lock_depth: int,
+               ctx) -> Iterator[Finding]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if in_function:
+                # Nested defs are this codebase's traced-closure idiom
+                # (epoch fns, schedule shapes): their jnp ops run under
+                # jit tracing, not as eager dispatches.
+                return
+            for stmt in node.body:
+                yield from self._visit(stmt, True, lock_depth, ctx)
+            return
+        if isinstance(node, ast.Lambda):
+            return  # lambdas here are jit payloads
+        if isinstance(node, ast.With):
+            depth = lock_depth + (1 if self._is_lock_with(node) else 0)
+            for item in node.items:
+                yield from self._visit(item.context_expr, in_function,
+                                       lock_depth, ctx)
+            for stmt in node.body:
+                yield from self._visit(stmt, in_function, depth, ctx)
+            return
+        if isinstance(node, ast.Call) and in_function and lock_depth == 0:
+            what = self._dispatchy(node)
+            if what:
+                yield self.finding(
+                    ctx, node,
+                    f"device dispatch `{what}` outside dispatch_lock() in a "
+                    f"serialized-dispatch module",
+                    self._HINT,
+                )
+        for child in ast.iter_child_nodes(node):
+            yield from self._visit(child, in_function, lock_depth, ctx)
+
+
+# --------------------------------------------------------------------------
+# DML003 chaos-determinism
+# --------------------------------------------------------------------------
+
+
+_NONDET_CALLS = {
+    "time.time": "wall-clock time varies per run",
+    "time.time_ns": "wall-clock time varies per run",
+    "os.getpid": "PIDs vary per run",
+    "os.urandom": "OS entropy is nondeterministic",
+    "os.getcwd": "the working directory varies per run/host",
+    "os.path.abspath": "absolute paths embed run-varying directories",
+    "os.path.realpath": "absolute paths embed run-varying directories",
+    "uuid.uuid1": "uuid1 mixes host/time state",
+    "uuid.uuid4": "uuid4 is OS entropy",
+    "datetime.now": "wall-clock time varies per run",
+    "datetime.datetime.now": "wall-clock time varies per run",
+}
+_NONDET_PREFIXES = ("random.", "secrets.", "tempfile.")
+_NONDET_BUILTINS = {
+    "hash": "hash() is salted per process (PYTHONHASHSEED)",
+    "id": "id() is an address — varies per run",
+}
+
+
+class ChaosDeterminismRule(Rule):
+    name = "chaos-determinism"
+    rule_id = "DML003"
+    severity = "error"
+    description = (
+        "Fault-injection decisions must be a pure function of "
+        "(seed, op, key, call-count): wall time, PIDs, random state, or "
+        "absolute paths in a decision make the chaos schedule — and every "
+        "test built on it — flaky (the PR 3 postmortem)."
+    )
+    _HINT = (
+        "derive the decision from the seeded hash of stable keys "
+        "(_hash_fraction) — normalize paths relative to the storage root "
+        "before keying on them"
+    )
+
+    def applies(self, ctx) -> bool:
+        if "chaos-decisions" in ctx.scopes:
+            return True
+        return ctx.basename == "chaos.py"
+
+    def check(self, ctx) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _call_name(node)
+            if callee is None:
+                continue
+            why = _NONDET_CALLS.get(callee)
+            if why is None and callee.startswith(_NONDET_PREFIXES):
+                why = f"{callee.split('.', 1)[0]} state varies per run"
+            if why is None and callee in _NONDET_BUILTINS:
+                why = _NONDET_BUILTINS[callee]
+            if why is None:
+                continue
+            yield self.finding(
+                ctx, node,
+                f"nondeterministic `{callee}()` in fault-decision code "
+                f"({why})",
+                self._HINT,
+            )
+
+
+# --------------------------------------------------------------------------
+# DML004 wallclock-deadline
+# --------------------------------------------------------------------------
+
+
+_DEADLINE_NAMES = re.compile(
+    r"deadline|expir|lease|until|last_seen|last_beat|opened_at"
+)
+_DEADLINE_EXEMPT = {"leased_at", "_leased_at"}
+
+
+def _is_wallclock_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr in ("time", "time_ns"):
+        base = _dotted(func.value) or ""
+        return base in ("time", "_time") or base.endswith(".time")
+    return False
+
+
+class WallclockDeadlineRule(Rule):
+    name = "wallclock-deadline"
+    rule_id = "DML004"
+    severity = "error"
+    description = (
+        "time.time() feeding a deadline, lease, or liveness age breaks "
+        "under NTP steps and clock slew: a backwards jump can expire a "
+        "live worker's lease or stretch a wait forever.  time.monotonic() "
+        "is the only clock deadlines may read; keep time.time() for "
+        "logged timestamps and durations-for-metrics."
+    )
+    _HINT = "use time.monotonic() for deadlines/leases/liveness ages"
+
+    def check(self, ctx) -> Iterator[Finding]:
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(ctx.tree):
+            if not _is_wallclock_call(node):
+                continue
+            region = self._statement_region(node, parents)
+            if region is None:
+                continue
+            idents = set()
+            for r in region:
+                idents |= _identifiers(r)
+            idents -= _DEADLINE_EXEMPT
+            hits = sorted(
+                i for i in idents if _DEADLINE_NAMES.search(i)
+            )
+            if hits:
+                yield self.finding(
+                    ctx, node,
+                    f"wall-clock time.time() used with "
+                    f"{', '.join(repr(h) for h in hits)} — deadlines and "
+                    f"liveness ages must survive clock steps",
+                    self._HINT,
+                )
+
+    def _statement_region(
+        self, node: ast.AST, parents: Dict[ast.AST, ast.AST]
+    ) -> Optional[List[ast.AST]]:
+        """The expressions evaluated WITH the time.time() call: the whole
+        simple statement, or just the header of a compound one (examining
+        a compound statement's body would charge child statements'
+        identifiers to this call)."""
+        cur: Optional[ast.AST] = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = parents.get(cur)
+        if cur is None:
+            return None
+        if isinstance(cur, (ast.If, ast.While)):
+            return [cur.test]
+        if isinstance(cur, ast.For):
+            return [cur.iter]
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            return [i.context_expr for i in cur.items]
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return list(cur.args.defaults) + list(cur.args.kw_defaults or [])
+        return [cur]
+
+
+# --------------------------------------------------------------------------
+# DML005 pickle-checkpoint
+# --------------------------------------------------------------------------
+
+
+_PICKLE_MODULES = {"pickle", "cloudpickle", "dill", "shelve"}
+
+
+class PickleCheckpointRule(Rule):
+    name = "pickle-checkpoint"
+    rule_id = "DML005"
+    severity = "error"
+    description = (
+        "Checkpoint/bundle bytes must stay process- and framework-portable "
+        "(msgpack blob, sharded chunk+JSON, bundle manifests): pickle ties "
+        "the format to one Python build, and unpickling shared-storage "
+        "bytes executes code.  Pickle stays legal in the process-executor "
+        "IPC frames — same host, same build, private pipe — but never in "
+        "anything that writes or reads checkpoint bytes."
+    )
+    _HINT = (
+        "serialize through ckpt/format.py (msgpack / chunk+JSON) instead"
+    )
+
+    def applies(self, ctx) -> bool:
+        if "checkpoint-path" in ctx.scopes:
+            return True
+        rel = ctx.display_path.replace("\\", "/")
+        return any(
+            f"/{pat}" in f"/{rel}" or rel.endswith(pat.rstrip("/"))
+            or f"/{pat.rstrip('/')}/" in f"/{rel}"
+            for pat in CHECKPOINT_PATH_PATTERNS
+        )
+
+    def check(self, ctx) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    root = alias.name.split(".", 1)[0]
+                    if root in _PICKLE_MODULES:
+                        yield self.finding(
+                            ctx, node,
+                            f"`import {alias.name}` on a checkpoint-path "
+                            f"module",
+                            self._HINT,
+                        )
+            elif isinstance(node, ast.ImportFrom):
+                root = (node.module or "").split(".", 1)[0]
+                if root in _PICKLE_MODULES:
+                    yield self.finding(
+                        ctx, node,
+                        f"`from {node.module} import ...` on a "
+                        f"checkpoint-path module",
+                        self._HINT,
+                    )
+            elif isinstance(node, ast.Call):
+                callee = _call_name(node) or ""
+                base, _, attr = callee.rpartition(".")
+                if base in _PICKLE_MODULES and attr in (
+                    "load", "loads", "dump", "dumps", "Pickler", "Unpickler",
+                ):
+                    yield self.finding(
+                        ctx, node,
+                        f"`{callee}()` on a checkpoint-path module",
+                        self._HINT,
+                    )
+
+
+# --------------------------------------------------------------------------
+# DML006 import-trace
+# --------------------------------------------------------------------------
+
+
+_IMPORT_TRACE_EXACT = {
+    "jax.device_put", "jax.device_get", "jax.devices",
+    "jax.local_devices", "jax.eval_shape", "jax.make_jaxpr",
+    "jax.block_until_ready",
+}
+
+
+class ImportTraceRule(Rule):
+    name = "import-trace"
+    rule_id = "DML006"
+    severity = "error"
+    description = (
+        "Module-level jnp/jax work (array ops, key creation, device "
+        "enumeration, calling a jitted program) runs at import: hidden "
+        "startup cost EVERY process pays — trial children, serve replicas, "
+        "cluster workers — exactly the latency compilecache/ exists to "
+        "kill.  Enforced dynamically by tests/test_import_guard.py's "
+        "compile-counter sweep; this rule names the offending line."
+    )
+    _HINT = "move the computation behind a function (lazy, per first use)"
+
+    def check(self, ctx) -> Iterator[Finding]:
+        yield from self._visit_module_level(ctx.tree, ctx)
+
+    def _trace_worthy(self, node: ast.Call) -> Optional[str]:
+        if isinstance(node.func, ast.Call):  # jitted-and-called in one go
+            inner = _call_name(node.func) or ""
+            if inner in ("jax.jit", "jit", "pjit", "jax.pjit", "jax.pmap"):
+                return f"{inner}(...)(...)"
+        callee = _call_name(node)
+        if callee is None:
+            return None
+        if callee.startswith(_DISPATCH_PREFIXES):
+            return callee
+        if callee in _IMPORT_TRACE_EXACT:
+            return callee
+        return None
+
+    def _visit_module_level(self, node: ast.AST, ctx) -> Iterator[Finding]:
+        """Walk code that executes at import: module body, class bodies,
+        module-level control flow — NOT function bodies (deferred), but
+        including function DEFAULT arguments (evaluated at def time)."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for default in list(child.args.defaults) + [
+                    d for d in (child.args.kw_defaults or []) if d is not None
+                ]:
+                    for sub in ast.walk(default):
+                        if isinstance(sub, ast.Call):
+                            what = self._trace_worthy(sub)
+                            if what:
+                                yield self.finding(
+                                    ctx, sub,
+                                    f"`{what}` in a default argument runs "
+                                    f"at import",
+                                    self._HINT,
+                                )
+                continue  # body is deferred
+            if isinstance(child, ast.Lambda):
+                continue
+            if isinstance(child, ast.Call):
+                what = self._trace_worthy(child)
+                if what:
+                    yield self.finding(
+                        ctx, child,
+                        f"module-level `{what}` runs at import — startup "
+                        f"cost for every process",
+                        self._HINT,
+                    )
+            yield from self._visit_module_level(child, ctx)
+
+
+# --------------------------------------------------------------------------
+# DML007 thread-swallow
+# --------------------------------------------------------------------------
+
+
+_BROAD_EXC = {"Exception", "BaseException"}
+
+
+class ThreadSwallowRule(Rule):
+    name = "thread-swallow"
+    rule_id = "DML007"
+    severity = "error"
+    description = (
+        "A bare/over-broad `except` whose body is just `pass` inside a "
+        "thread target converts failures into the exact silence the "
+        "liveness layer exists to detect.  Swallowing is sometimes right "
+        "(observer isolation) — but then it must COUNT: increment a "
+        "counter, log, or re-raise, so /metrics and snapshots can surface "
+        "that it happened."
+    )
+    _HINT = (
+        "count it (metrics counter), log it, narrow the except, or "
+        "re-raise; if the swallow is deliberate, say why inline: "
+        "# dmlint: disable=thread-swallow <reason>"
+    )
+
+    def check(self, ctx) -> Iterator[Finding]:
+        targets = self._thread_targets(ctx.tree)
+        if not targets:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name not in targets:
+                continue
+            # Nested defs stay in scope: a closure called by the target
+            # still runs on the thread.
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.ExceptHandler):
+                    continue
+                if not self._is_broad(sub):
+                    continue
+                if self._body_is_silent(sub.body):
+                    yield self.finding(
+                        ctx, sub,
+                        f"broad `except` swallowed silently inside thread "
+                        f"target `{node.name}` — the thread keeps running "
+                        f"with no record the failure happened",
+                        self._HINT,
+                    )
+
+    def _thread_targets(self, tree: ast.AST) -> Set[str]:
+        """Function names used as thread entry points in this module."""
+        out: Set[str] = set()
+        thread_classes: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                callee = (_call_name(node) or "").rsplit(".", 1)[-1]
+                if callee in ("Thread", "Timer"):
+                    for kw in node.keywords:
+                        if kw.arg in ("target", "function"):
+                            name = self._callable_name(kw.value)
+                            if name:
+                                out.add(name)
+                    if callee == "Timer" and len(node.args) >= 2:
+                        name = self._callable_name(node.args[1])
+                        if name:
+                            out.add(name)
+            elif isinstance(node, ast.ClassDef):
+                bases = {(_dotted(b) or "").rsplit(".", 1)[-1]
+                         for b in node.bases}
+                if "Thread" in bases:
+                    thread_classes.add(node.name)
+        if thread_classes:
+            for node in ast.walk(tree):
+                if (
+                    isinstance(node, ast.ClassDef)
+                    and node.name in thread_classes
+                ):
+                    out.add("run")
+        return out
+
+    @staticmethod
+    def _callable_name(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        return None
+
+    @staticmethod
+    def _is_broad(handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:
+            return True
+        types = (
+            handler.type.elts
+            if isinstance(handler.type, ast.Tuple) else [handler.type]
+        )
+        for t in types:
+            name = (_dotted(t) or "").rsplit(".", 1)[-1]
+            if name in _BROAD_EXC:
+                return True
+        return False
+
+    @staticmethod
+    def _body_is_silent(body: Sequence[ast.stmt]) -> bool:
+        for stmt in body:
+            if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+                continue
+            if isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, ast.Constant
+            ):
+                continue  # docstring / ellipsis
+            return False
+        return True
+
+
+ALL_RULES: List[Rule] = [
+    DonationAliasRule(),
+    UnlockedDispatchRule(),
+    ChaosDeterminismRule(),
+    WallclockDeadlineRule(),
+    PickleCheckpointRule(),
+    ImportTraceRule(),
+    ThreadSwallowRule(),
+]
+
+
+def get_rule(name: str) -> Rule:
+    for rule in ALL_RULES:
+        if rule.name == name or rule.rule_id == name:
+            return rule
+    raise KeyError(f"no dmlint rule named {name!r}")

@@ -38,6 +38,7 @@ import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from distributed_machine_learning_tpu.tune import storage as storage_lib
+from distributed_machine_learning_tpu.analysis.locks import named_lock
 
 
 class InjectedFault(Exception):
@@ -169,7 +170,7 @@ class FaultPlan:
             ((int(n), int(w), float(d)) for n, w, d in partition_worker),
             reverse=True,
         )
-        self._lock = threading.Lock()
+        self._lock = named_lock("chaos.plan")
         self._op_counts: Dict[Tuple[str, str], int] = {}
         self._counters: Dict[str, int] = {}
         self._submit_count = 0

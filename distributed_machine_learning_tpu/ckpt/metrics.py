@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import threading
 from typing import Dict
+from distributed_machine_learning_tpu.analysis.locks import named_lock
 
 
 class CheckpointMetrics:
@@ -48,7 +49,7 @@ class CheckpointMetrics:
     )
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("ckpt.metrics")
         self._c: Dict[str, float] = {k: 0 for k in self._FIELDS}
 
     def add(self, name: str, value: float = 1) -> None:

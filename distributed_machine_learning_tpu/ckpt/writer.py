@@ -24,6 +24,7 @@ from typing import Callable, List, Optional, Tuple
 
 from distributed_machine_learning_tpu.ckpt import format as fmt
 from distributed_machine_learning_tpu.ckpt.metrics import get_metrics
+from distributed_machine_learning_tpu.analysis.locks import named_lock
 
 
 class AsyncCheckpointer:
@@ -31,7 +32,7 @@ class AsyncCheckpointer:
 
     def __init__(self, log: Optional[Callable[[str], None]] = None):
         self._q: "queue.Queue" = queue.Queue()
-        self._lock = threading.Lock()
+        self._lock = named_lock("ckpt.writer")
         self._pending: List[Tuple[str, threading.Event]] = []
         self._error: Optional[BaseException] = None
         self._error_path: Optional[str] = None
@@ -102,11 +103,13 @@ class AsyncCheckpointer:
         first unclaimed write error.  Returns False on timeout."""
         import time as _time
 
-        deadline = None if timeout is None else _time.time() + timeout
+        # Monotonic: this is a wait DEADLINE — a wall-clock step must not
+        # stretch or collapse the barrier (dmlint DML004).
+        deadline = None if timeout is None else _time.monotonic() + timeout
         with self._lock:
             events = [ev for _, ev in self._pending]
         for ev in events:
-            left = None if deadline is None else deadline - _time.time()
+            left = None if deadline is None else deadline - _time.monotonic()
             if left is not None and left <= 0:
                 return False
             if not ev.wait(left):

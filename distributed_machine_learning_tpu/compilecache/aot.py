@@ -37,6 +37,7 @@ import tempfile
 import threading
 from typing import Any, Callable, Dict, Optional, Sequence
 
+from distributed_machine_learning_tpu.analysis.locks import named_lock
 from distributed_machine_learning_tpu.compilecache.counters import get_counters
 from distributed_machine_learning_tpu.compilecache import tracker as _tracker
 
@@ -84,7 +85,7 @@ class ExecutableCache:
                  persist: bool = True):
         self._dir = directory or default_aot_dir()
         self._persist = persist
-        self._lock = threading.Lock()
+        self._lock = named_lock("compilecache.aot")
         self._mem: Dict[str, _Entry] = {}
         self._serialize_supported: Optional[bool] = None
 

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import threading
 from typing import Dict
+from distributed_machine_learning_tpu.analysis.locks import named_lock
 
 
 class CompileCounters:
@@ -51,7 +52,7 @@ class CompileCounters:
     )
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("compilecache.counters")
         self._c: Dict[str, float] = {k: 0 for k in self._FIELDS}
 
     def add(self, name: str, value: float = 1) -> None:

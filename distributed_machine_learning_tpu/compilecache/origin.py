@@ -25,6 +25,7 @@ import os
 import tempfile
 import threading
 from typing import Dict, List, Optional, Sequence, Set
+from distributed_machine_learning_tpu.analysis.locks import named_lock
 
 
 def snapshot_cache_dir(directory: Optional[str]) -> Set[str]:
@@ -109,7 +110,7 @@ class ArtifactRegistry:
     """
 
     def __init__(self, max_bytes: int = 256 * 1024 * 1024):
-        self._lock = threading.Lock()
+        self._lock = named_lock("compilecache.origin")
         self._by_key: Dict[str, Dict[str, bytes]] = {}
         self._bytes = 0
         self._max_bytes = max_bytes

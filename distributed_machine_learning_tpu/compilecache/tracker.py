@@ -24,12 +24,13 @@ from __future__ import annotations
 import os
 import threading
 from typing import Dict, Optional
+from distributed_machine_learning_tpu.analysis.locks import named_lock
 
 _DEFAULT_DIR = os.path.join(
     os.path.expanduser("~"), ".cache", "dml_tpu", "xla_cache"
 )
 
-_lock = threading.Lock()
+_lock = named_lock("compilecache.tracker.registry")
 _enabled_dir: Optional[str] = None
 
 # Monitoring event names (`/jax/core/compile/*`,
@@ -109,7 +110,7 @@ class CompileTimeTracker:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("compilecache.tracker")
         self._seconds: Dict[int, float] = {}
         self._hits: Dict[int, int] = {}
         self._backend_seconds: Dict[int, float] = {}

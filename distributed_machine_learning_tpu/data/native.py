@@ -22,13 +22,14 @@ import threading
 from typing import Optional, Tuple
 
 import numpy as np
+from distributed_machine_learning_tpu.analysis.locks import named_lock
 
 _SRC = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native", "window_ops.cpp")
 _CACHE_DIR = os.environ.get(
     "DML_TPU_NATIVE_CACHE", os.path.join(os.path.expanduser("~"), ".cache", "dml_tpu")
 )
 
-_lock = threading.Lock()
+_lock = named_lock("data.native")
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 

@@ -38,6 +38,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from distributed_machine_learning_tpu.analysis.locks import named_lock
+
 
 class Heartbeat:
     """Thread-safe monotonic progress marker."""
@@ -45,7 +47,7 @@ class Heartbeat:
     __slots__ = ("_lock", "_last", "beats", "created")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("liveness.heartbeat")
         now = time.monotonic()
         self._last = now
         self.created = now
@@ -131,10 +133,11 @@ class DispatchWatchdog:
         )
         self._on_stall = on_stall
         self._poll_s = poll_s or max(min(self.deadline_s / 4.0, 1.0), 0.02)
-        self._lock = threading.Lock()
+        self._lock = named_lock("liveness.watchdog")
         self._tracked: Dict[str, _Tracked] = {}
         self.stalls_total = 0
         self.recoveries_total = 0
+        self.observer_errors = 0
         self._monitor: Optional[threading.Thread] = None
         self._closing = threading.Event()
 
@@ -224,7 +227,10 @@ class DispatchWatchdog:
                     try:
                         self._on_stall(event)
                     except Exception:  # noqa: BLE001 - observer isolation
-                        pass
+                        # Isolated on purpose (a broken observer must not
+                        # kill stall detection) but never silent: the count
+                        # surfaces in snapshot()/experiment_state.json.
+                        self.observer_errors += 1
 
     # -- observability -------------------------------------------------------
 
@@ -236,6 +242,7 @@ class DispatchWatchdog:
                 "currently_stalled": sum(
                     1 for e in self._tracked.values() if e.stalled
                 ),
+                "observer_errors": self.observer_errors,
             }
 
 

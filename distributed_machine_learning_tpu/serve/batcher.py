@@ -25,20 +25,22 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
+from distributed_machine_learning_tpu.analysis.locks import named_lock
 
 
 @dataclass
 class _Pending:
     x: np.ndarray
     future: Future
-    enqueued_at: float = field(default_factory=time.time)
+    # Monotonic: feeds the max_latency flush deadline (dmlint DML004).
+    enqueued_at: float = field(default_factory=time.monotonic)
 
 
 class BatcherStats:
     """Thread-safe flush accounting (fill ratio, trigger mix, depth)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.batcher.stats")
         self.batches = 0
         self.rows = 0
         self.size_flushes = 0
@@ -92,7 +94,8 @@ class MicroBatcher:
         self.max_latency_s = float(max_latency_ms) / 1000.0
         self.stats = BatcherStats()
         self._queue: List[_Pending] = []
-        self._lock = threading.Lock()
+        # NamedLock ducks the lock protocol threading.Condition needs.
+        self._lock = named_lock("serve.batcher.queue")
         self._wake = threading.Condition(self._lock)
         self._stop = False
         self._thread = threading.Thread(
@@ -134,7 +137,7 @@ class MicroBatcher:
                 if self._queue:
                     rows = sum(p.x.shape[0] for p in self._queue)
                     oldest = self._queue[0].enqueued_at
-                    now = time.time()
+                    now = time.monotonic()
                     if self._stop or rows >= self.max_batch_size:
                         return self._drain("size")
                     remaining = self.max_latency_s - (now - oldest)

@@ -22,6 +22,7 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from distributed_machine_learning_tpu.analysis.locks import named_lock
 from distributed_machine_learning_tpu.compilecache import (
     ExecutableCache,
     enable_persistent_cache,
@@ -74,7 +75,7 @@ class InferenceEngine:
         self._device = device
         self._buckets = tuple(sorted(set(buckets or bucket_sizes(max_bucket))))
         self._flag_name: Optional[str] = None
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.engine")
         self._programs: Dict[Tuple, Any] = {}
         self._program_hits = 0
         self._tracker = get_tracker()

@@ -12,6 +12,7 @@ import threading
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
+from distributed_machine_learning_tpu.analysis.locks import named_lock
 
 
 def percentile(sorted_vals: List[float], q: float) -> float:
@@ -31,7 +32,7 @@ class ServeMetrics:
     """
 
     def __init__(self, window: int = 4096):
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.metrics")
         self._latencies_ms: deque = deque(maxlen=window)
         self._started_at = time.time()
         self.requests = 0
@@ -97,7 +98,7 @@ class TensorBoardEmitter:
     def __init__(self, logdir: Optional[str]):
         self._logdir = logdir
         self._writer = None
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.metrics.tb")
 
     def emit(self, metrics: ServeMetrics, extra: Optional[Dict] = None):
         if not self._logdir:

@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from distributed_machine_learning_tpu.analysis.locks import named_lock
 from distributed_machine_learning_tpu.serve.batcher import MicroBatcher
 from distributed_machine_learning_tpu.serve.engine import InferenceEngine
 from distributed_machine_learning_tpu.serve.export import ServableBundle
@@ -92,7 +93,7 @@ class _RequestOutcome:
 
     def __init__(self, breaker: "CircuitBreaker"):
         self._breaker = breaker
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.request_outcome")
         self._recorded = False
 
     def _claim(self) -> bool:
@@ -142,7 +143,7 @@ class CircuitBreaker:
         self.failure_threshold = int(failure_threshold)
         self.recovery_s = float(recovery_s)
         self.half_open_probes = int(half_open_probes)
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.breaker")
         self._state = self.CLOSED
         self._consecutive_failures = 0
         self._opened_at = 0.0
@@ -161,7 +162,7 @@ class CircuitBreaker:
     def allow(self) -> bool:
         """May a request be dispatched now?  In half-open, a True answer
         consumes a probe slot (released by the request's outcome)."""
-        now = time.time()
+        now = time.monotonic()
         with self._lock:
             if self._state == self.OPEN:
                 if now - self._opened_at < self.recovery_s:
@@ -185,7 +186,7 @@ class CircuitBreaker:
                 self._state = self.CLOSED
 
     def record_failure(self):
-        now = time.time()
+        now = time.monotonic()
         with self._lock:
             self.failures_total += 1
             self._consecutive_failures += 1
@@ -204,7 +205,7 @@ class CircuitBreaker:
             # half-open to the next caller.
             if (
                 self._state == self.OPEN
-                and time.time() - self._opened_at >= self.recovery_s
+                and time.monotonic() - self._opened_at >= self.recovery_s
             ):
                 return self.HALF_OPEN
             return self._state
@@ -215,7 +216,9 @@ class CircuitBreaker:
         with self._lock:
             if self._state != self.OPEN:
                 return 0.0
-            return max(self.recovery_s - (time.time() - self._opened_at), 0.0)
+            return max(
+                self.recovery_s - (time.monotonic() - self._opened_at), 0.0
+            )
 
     def stats(self) -> Dict[str, Any]:
         return {
@@ -245,7 +248,8 @@ class Replica:
             bundle, max_bucket=max_bucket, device=device
         )
         self.processed_batches = 0
-        self.last_beat = time.time()
+        # Monotonic: last_beat is a liveness age (dmlint DML004).
+        self.last_beat = time.monotonic()
         self.batcher = MicroBatcher(
             self._infer,
             max_batch_size=max_batch_size,
@@ -256,7 +260,7 @@ class Replica:
     def _infer(self, x: np.ndarray) -> np.ndarray:
         out = self.engine.predict(x)
         self.processed_batches += 1
-        self.last_beat = time.time()
+        self.last_beat = time.monotonic()
         return out
 
     def submit(self, x):
@@ -277,7 +281,7 @@ class Replica:
             "alive": self.alive(),
             "queue_depth": self.batcher.queue_depth,
             "processed_batches": self.processed_batches,
-            "last_beat_age_s": round(time.time() - self.last_beat, 3),
+            "last_beat_age_s": round(time.monotonic() - self.last_beat, 3),
         }
 
 
@@ -342,7 +346,7 @@ class ReplicaSet:
                 # More replicas than devices: share round-robin (CPU dev
                 # boxes; on TPU, size the replica count to the slice).
                 self._devices.append(self._dm.devices[r % self._dm.num_devices])
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.replicaset")
         self._rr = 0
         self.restarts = 0
         self.timeouts = 0  # requests that missed their deadline (predict)

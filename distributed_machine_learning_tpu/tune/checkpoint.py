@@ -39,6 +39,7 @@ import jax
 import numpy as np
 from flax import serialization
 
+from distributed_machine_learning_tpu.analysis.locks import named_lock
 from distributed_machine_learning_tpu.ckpt import format as _sharded_fmt
 from distributed_machine_learning_tpu.ckpt.format import (  # noqa: F401
     CheckpointCorruptionError,
@@ -307,7 +308,7 @@ class AsyncCheckpointWriter:
 
     def __init__(self, log=None):
         self._q: "queue.Queue" = queue.Queue()
-        self._lock = threading.Lock()
+        self._lock = named_lock("tune.checkpoint.writer")
         self._pending: Dict[str, threading.Event] = {}
         self._errors: Dict[str, BaseException] = {}
         self._log = log or (lambda msg: print(
@@ -362,12 +363,12 @@ class AsyncCheckpointWriter:
         """Block until ``path`` (or every pending write) is durable; re-raise
         its write error if one occurred. Returns False if ``timeout``
         expired with writes still pending."""
-        deadline = None if timeout is None else time.time() + timeout
+        deadline = None if timeout is None else time.monotonic() + timeout
         if path is None:
             with self._lock:
                 events = list(self._pending.values())
             for ev in events:
-                left = None if deadline is None else deadline - time.time()
+                left = None if deadline is None else deadline - time.monotonic()
                 if left is not None and left <= 0:
                     return False
                 if not ev.wait(left):
@@ -386,7 +387,7 @@ class AsyncCheckpointWriter:
         with self._lock:
             ev = self._pending.get(path)
         if ev is not None and not ev.wait(
-            None if deadline is None else max(deadline - time.time(), 0.0)
+            None if deadline is None else max(deadline - time.monotonic(), 0.0)
         ):
             return False
         with self._lock:

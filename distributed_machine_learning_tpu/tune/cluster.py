@@ -59,6 +59,7 @@ import traceback
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
+from distributed_machine_learning_tpu.analysis.locks import named_lock
 from distributed_machine_learning_tpu.tune import checkpoint as ckpt_lib
 from distributed_machine_learning_tpu.tune.experiment import (
     ExperimentAnalysis,
@@ -185,23 +186,23 @@ class _WorkerState:
     def __init__(self, sock: socket.socket, secret: Optional[bytes] = None):
         self.sock = sock
         self.secret = secret
-        self.send_lock = threading.Lock()
+        self.send_lock = named_lock("cluster.worker.send")
         # (trial_id, incarnation) -> decision queue; incarnation-keyed so a
         # fenced incarnation and its redispatched replacement on this same
         # worker never swallow each other's decisions.
         self.decisions: Dict[Tuple[str, int], "queue.Queue[str]"] = {}
-        self.dec_lock = threading.Lock()
+        self.dec_lock = named_lock("cluster.worker.decisions")
         # program key -> reply queue for in-flight compile-artifact fetches
         # (the trial thread blocks on it; the recv loop answers).
         self.artifact_replies: Dict[str, "queue.Queue"] = {}
-        self.art_lock = threading.Lock()
+        self.art_lock = named_lock("cluster.worker.artifacts")
 
 
 # Program keys this worker PROCESS has already fetched-or-compiled: the
 # first trial of a shape class talks to the origin; its siblings on this
 # host ride the local jit/persistent caches without another round trip.
 _SEEN_PROGRAM_KEYS: set = set()
-_SEEN_KEYS_LOCK = threading.Lock()
+_SEEN_KEYS_LOCK = named_lock("cluster.seen_keys")
 
 _ARTIFACT_FETCH_TIMEOUT_S = float(
     os.environ.get("DML_ARTIFACT_FETCH_TIMEOUT_S", "10.0")
@@ -682,7 +683,7 @@ class RemoteWorker:
 
     def _handshake(self):
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self.send_lock = threading.Lock()
+        self.send_lock = named_lock("cluster.head.send")
         # The hello frame waits on the worker's jax cold-import; give it time.
         self.sock.settimeout(300)
         hello = _recv(self.sock, self.secret)
@@ -699,14 +700,17 @@ class RemoteWorker:
         # suspect state a silent worker enters when its lease expires —
         # no dispatches, trials requeued, connection kept for the
         # reconnect-grace window (a partition heals; a dead host doesn't).
-        self.last_seen = time.time()
+        # Monotonic clock throughout: lease expiry and reconnect grace are
+        # DEADLINES, and an NTP step must not expire a live worker
+        # (dmlint DML004 wallclock-deadline).
+        self.last_seen = time.monotonic()
         self.suspect = False
         self.expired_at = 0.0
         # Chaos partition (injected by the driver's fault plan): while
         # active, frames in BOTH directions are buffered, not dropped —
         # TCP delays delivery across a real partition, so on heal the
         # backlog lands all at once and stale frames get fenced.
-        self._pt_lock = threading.Lock()
+        self._pt_lock = named_lock("cluster.head.partition")
         self._partition_until = 0.0
         self._in_buffer: List[Dict[str, Any]] = []
         self._out_buffer: List[Dict[str, Any]] = []
@@ -719,7 +723,7 @@ class RemoteWorker:
 
     def send(self, msg: Dict[str, Any]):
         with self._pt_lock:
-            if time.time() < self._partition_until:
+            if time.monotonic() < self._partition_until:
                 self._out_buffer.append(msg)
                 return
         _send(self.sock, self.send_lock, msg, self.secret)
@@ -728,7 +732,7 @@ class RemoteWorker:
 
     def partition(self, duration_s: float):
         with self._pt_lock:
-            self._partition_until = time.time() + float(duration_s)
+            self._partition_until = time.monotonic() + float(duration_s)
 
     def receive_frames(self, msg: Dict[str, Any]) -> List[Dict[str, Any]]:
         """Reader-thread choke point: buffer ``msg`` while partitioned;
@@ -736,7 +740,7 @@ class RemoteWorker:
         outgoing frames to the worker and release the held incoming ones
         (in arrival order, before ``msg``)."""
         with self._pt_lock:
-            if time.time() < self._partition_until:
+            if time.monotonic() < self._partition_until:
                 self._in_buffer.append(msg)
                 return []
             if not self._in_buffer and not self._out_buffer:
@@ -972,7 +976,7 @@ def run_distributed(
             # a partition frames are held (last_seen frozen — the lease
             # expiry this exercises), and the heal flushes the backlog.
             for held in worker.receive_frames(msg):
-                worker.last_seen = time.time()
+                worker.last_seen = time.monotonic()
                 events.put(("msg", worker, held))
 
     def add_worker(w: RemoteWorker):
@@ -1178,12 +1182,12 @@ def run_distributed(
         fenced); past the grace it is closed as presumed dead."""
         if not worker.suspect or not worker.alive:
             return
-        if time.time() - worker.expired_at <= worker_reconnect_grace_s:
+        if time.monotonic() - worker.expired_at <= worker_reconnect_grace_s:
             worker.suspect = False
             liveness["worker_reconnects"] += 1
             log(
                 f"worker {worker.address} reconnected within grace "
-                f"({time.time() - worker.expired_at:.1f}s after lease "
+                f"({time.monotonic() - worker.expired_at:.1f}s after lease "
                 f"expiry); rejoining pool"
             )
             launch_ready()
@@ -1198,7 +1202,7 @@ def run_distributed(
         """Lease expiry for silent WORKERS + progress deadlines for
         dispatched TRIALS.  Rate-limited; runs every loop iteration so a
         busy event stream cannot starve detection."""
-        now = time.time()
+        now = time.monotonic()
         if now - last_enforce[0] < 0.25:
             return
         last_enforce[0] = now
@@ -1600,11 +1604,11 @@ def start_local_workers(
         log_f.close()
         proc.log_path = log_path  # type: ignore[attr-defined]
         procs.append(proc)
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         while not os.path.exists(ready):
             if proc.poll() is not None:
                 raise RuntimeError(f"worker {i} exited rc={proc.returncode}")
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 raise TimeoutError(f"worker {i} did not become ready")
             time.sleep(0.05)
         with open(ready) as f:

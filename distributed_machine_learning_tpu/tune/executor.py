@@ -31,6 +31,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 
+from distributed_machine_learning_tpu.analysis.locks import named_lock
 from distributed_machine_learning_tpu.ckpt import metrics as ckpt_metrics
 from distributed_machine_learning_tpu.tune import checkpoint as ckpt_lib
 from distributed_machine_learning_tpu.tune.session import (
@@ -241,9 +242,9 @@ class ThreadTrialExecutor:
     def join_all(self, timeout: float = 5.0):
         """Best-effort wait (shared deadline): daemon threads can't be
         preempted, so a still-running trial is simply abandoned."""
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         for t in self._threads.values():
-            t.join(timeout=max(deadline - time.time(), 0.0))
+            t.join(timeout=max(deadline - time.monotonic(), 0.0))
         # Flush pending checkpoint writes so the experiment directory is
         # complete (resume reads it) before the runner returns.
         self._ckpt_writer.close()
@@ -449,7 +450,7 @@ class ProcessTrialExecutor:
         # is per-process), so on multi-chip leases the pool simply misses
         # and the cold path runs.
         self._prewarm = max(int(prewarm), 0)
-        self._pool_lock = threading.Lock()
+        self._pool_lock = named_lock("tune.executor.prewarm_pool")
         self._pool: List[Tuple[tuple, subprocess.Popen]] = []
         self._prewarmed_keys: set = set()
         self._closing = False
@@ -660,9 +661,9 @@ class ProcessTrialExecutor:
         for proc in list(self._procs.values()):
             if proc.poll() is None:
                 proc.terminate()
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         for t in list(self._pumps.values()):
-            t.join(timeout=max(deadline - time.time(), 0.0))
+            t.join(timeout=max(deadline - time.monotonic(), 0.0))
         for proc in list(self._procs.values()) + [p for _, p in pool]:
             if proc.poll() is None:
                 proc.kill()

@@ -41,6 +41,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
+from distributed_machine_learning_tpu.analysis.locks import named_lock
 
 
 class StorageBackend:
@@ -113,7 +114,7 @@ class MemoryStorage(StorageBackend):
     """
 
     _store: Dict[str, bytes] = {}
-    _lock = threading.Lock()
+    _lock = named_lock("tune.storage.mem")
 
     def write_bytes(self, path: str, data: bytes) -> str:
         with self._lock:

@@ -44,6 +44,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from distributed_machine_learning_tpu.analysis.locks import named_lock
 from distributed_machine_learning_tpu.data.loader import Dataset
 from distributed_machine_learning_tpu.models import build_model
 from distributed_machine_learning_tpu.ops.losses import get_loss
@@ -110,7 +111,7 @@ _COHORT_CACHE_MAX = 8
 # Entries pin their staged splits in device memory: cap total staged
 # bytes too (same rationale and limit as vectorized._PROGRAM_CACHE).
 _COHORT_CACHE_MAX_BYTES = 256 * 1024 * 1024
-_COHORT_GUARD = threading.Lock()
+_COHORT_GUARD = named_lock("trainable.cohort_guard")
 
 
 def _bundle_nbytes(bundle) -> int:
@@ -157,7 +158,9 @@ def _cohort_bundle_for(config, train_data, val_data, device, build):
         if bundle is not None:
             _COHORT_CACHE[key] = bundle  # re-insert = LRU touch
             return bundle
-        lock = _COHORT_LOCKS.setdefault(key, threading.Lock())
+        lock = _COHORT_LOCKS.setdefault(
+            key, named_lock("trainable.cohort")
+        )
     with lock:  # exactly-once build; the cohort's other trials wait here
         with _COHORT_GUARD:
             bundle = _COHORT_CACHE.get(key)

@@ -37,7 +37,10 @@ import contextlib
 import os
 import threading
 
-_LOCK = threading.RLock()
+from distributed_machine_learning_tpu.analysis.locks import named_lock
+# Named + reentrant: participates in the lock-order graph
+# (analysis/locks.py) under the role "dispatch".
+_LOCK = named_lock("dispatch", reentrant=True)
 _resolved: bool | None = None
 
 

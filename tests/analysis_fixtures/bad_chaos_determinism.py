@@ -1,0 +1,26 @@
+# dmlint-scope: chaos-decisions
+"""Historical bug (PR 3): two chaos tests flaked because fault decisions
+hashed run-varying state — here every classic source of run-variance
+appears in a FaultPlan's decision path."""
+
+import os
+import random
+import time
+
+
+class FaultPlan:
+    def __init__(self, seed, rate):
+        self.seed = seed
+        self.rate = rate
+
+    def _roll(self, op, key):
+        return random.random() < self.rate  # EXPECT: chaos-determinism
+
+    def on_storage_op(self, op, path):
+        key = os.path.abspath(path)  # EXPECT: chaos-determinism
+        return hash(key) % 100 < self.rate * 100  # EXPECT: chaos-determinism
+
+    def maybe_crash_trial(self, trial_id, iteration):
+        jitter = time.time() % 1.0  # EXPECT: chaos-determinism
+        salt = os.getpid()  # EXPECT: chaos-determinism
+        return (jitter + salt) % 2 == 0

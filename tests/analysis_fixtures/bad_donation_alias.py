@@ -1,0 +1,43 @@
+"""Historical bug (PR 4): an epoch-6 population checkpoint carried epoch-8
+optimizer counts, because the async writer 'snapshotted' donated buffers
+with np.asarray — zero-copy aliases of device memory the next train step
+reuses in place."""
+
+import jax
+import numpy as np
+
+
+def _is_jax_array(x):
+    return isinstance(x, jax.Array)
+
+
+def snapshot_leaf(x):
+    if _is_jax_array(x):
+        arr = np.asarray(x)  # EXPECT: donation-alias
+        return arr
+    return x
+
+
+def snapshot_leaf_isinstance(x):
+    if isinstance(x, jax.Array):
+        flat = np.array(x, copy=False)  # EXPECT: donation-alias
+        return flat.view(np.uint8)
+    return x
+
+
+def checksum(x):
+    if _is_jax_array(x):
+        return x.view(np.uint8)  # EXPECT: donation-alias
+    return x
+
+
+def make_programs(step_fn):
+    train_epoch = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+    return train_epoch
+
+
+def run_epoch(train_epoch, params, opt_state, key):
+    train_epoch = jax.jit(lambda p, o, k: (p, o), donate_argnums=(0, 1))
+    params, opt_state = train_epoch(params, opt_state, key)
+    host = np.asarray(params)  # EXPECT: donation-alias
+    return host, opt_state

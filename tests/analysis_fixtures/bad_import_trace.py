@@ -1,0 +1,20 @@
+"""Historical hazard (tests/test_import_guard.py's dynamic sweep): jit or
+jnp work at module level runs at import — startup cost for every trial
+child, serve replica, and cluster worker before it does anything."""
+
+import jax
+import jax.numpy as jnp
+
+_INIT_TABLE = jnp.zeros((1024, 1024))  # EXPECT: import-trace
+
+_KEY = jax.random.PRNGKey(0)  # EXPECT: import-trace
+
+_WARM = jax.jit(lambda x: x * 2)(jnp.ones(8))  # EXPECT: import-trace, import-trace
+
+
+class Defaults:
+    scale = jnp.float32(1.0)  # EXPECT: import-trace
+
+
+def forward(x, table=jnp.eye(4)):  # EXPECT: import-trace
+    return x @ table

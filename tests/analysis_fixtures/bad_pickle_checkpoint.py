@@ -1,0 +1,22 @@
+# dmlint-scope: checkpoint-path
+"""Historical hazard (tests/test_import_guard.py's original source scan):
+pickle on a checkpoint path ties the on-disk format to one Python build
+and executes code on load from shared storage."""
+
+import pickle  # EXPECT: pickle-checkpoint
+
+import cloudpickle  # EXPECT: pickle-checkpoint
+
+
+def save_checkpoint(state, path):
+    with open(path, "wb") as f:
+        pickle.dump(state, f)  # EXPECT: pickle-checkpoint
+
+
+def load_checkpoint(path):
+    with open(path, "rb") as f:
+        return pickle.load(f)  # EXPECT: pickle-checkpoint
+
+
+def clone(state):
+    return cloudpickle.loads(cloudpickle.dumps(state))  # EXPECT: pickle-checkpoint, pickle-checkpoint

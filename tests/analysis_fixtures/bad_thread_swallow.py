@@ -1,0 +1,41 @@
+"""Historical hazard (liveness.py's original monitor loop): a broad
+except whose body is just `pass` inside a thread target converts failures
+into the silence the liveness layer exists to detect."""
+
+import threading
+
+
+def _writer_loop(q):
+    while True:
+        item = q.get()
+        if item is None:
+            return
+        try:
+            item.run()
+        except Exception:  # EXPECT: thread-swallow
+            pass
+
+
+class Monitor:
+    def _monitor_loop(self):
+        while not self._closing.wait(0.5):
+            try:
+                self._on_stall()
+            except BaseException:  # EXPECT: thread-swallow
+                continue
+
+    def start(self):
+        threading.Thread(target=self._monitor_loop, daemon=True).start()
+
+
+class Poller(threading.Thread):
+    def run(self):
+        while True:
+            try:
+                self.poll()
+            except:  # noqa: E722  # EXPECT: thread-swallow
+                pass
+
+
+def start_writer(q):
+    threading.Thread(target=_writer_loop, args=(q,), daemon=True).start()

@@ -1,0 +1,26 @@
+"""Historical bug (ISSUE 6 satellite): tune/cluster.py lease bookkeeping
+and ckpt/writer.py wait deadlines read time.time() — an NTP step could
+expire a live worker's lease or stretch a checkpoint barrier forever."""
+
+import time
+
+
+class Worker:
+    def __init__(self):
+        self.last_seen = time.time()  # EXPECT: wallclock-deadline
+        self.expired_at = 0.0
+
+    def partition(self, duration_s):
+        self._partition_until = time.time() + duration_s  # EXPECT: wallclock-deadline
+
+    def in_grace(self, grace_s):
+        return time.time() - self.expired_at <= grace_s  # EXPECT: wallclock-deadline
+
+
+def wait_all(events, timeout):
+    deadline = time.time() + timeout  # EXPECT: wallclock-deadline
+    for ev in events:
+        left = deadline - time.time()  # EXPECT: wallclock-deadline
+        if left <= 0 or not ev.wait(left):
+            return False
+    return True

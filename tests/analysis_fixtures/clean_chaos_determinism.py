@@ -1,0 +1,31 @@
+# dmlint-scope: chaos-decisions
+"""Idiomatic twin (chaos.py): decisions are a pure hash of
+(seed, op, key, per-key call count); sleeping IS the injected fault, not a
+decision, so time.sleep stays legal."""
+
+import hashlib
+import time
+
+
+def _hash_fraction(*parts):
+    h = hashlib.sha256("/".join(str(p) for p in parts).encode()).digest()
+    return int.from_bytes(h[:8], "little") / 2**64
+
+
+class FaultPlan:
+    def __init__(self, seed, rate, slow_s):
+        self.seed = seed
+        self.rate = rate
+        self.slow_s = slow_s
+        self._counts = {}
+
+    def _roll(self, op, key):
+        n = self._counts.get((op, key), 0)
+        self._counts[(op, key)] = n + 1
+        return _hash_fraction(self.seed, op, key, n) < self.rate
+
+    def on_storage_op(self, op, path):
+        # Keyed on the path as the storage layer names it (relative to the
+        # storage root), never the absolute form.
+        if self._roll("slow", f"{op}:{path}"):
+            time.sleep(self.slow_s)  # the fault itself — not a decision

@@ -1,0 +1,31 @@
+"""Idiomatic twin: real copies before the next donated dispatch (the
+ckpt/format.py snapshot_leaf convention), and np.asarray stays legal on
+values that are NOT jax Arrays."""
+
+import jax
+import numpy as np
+
+
+def _is_jax_array(x):
+    return isinstance(x, jax.Array)
+
+
+def snapshot_leaf(x):
+    if _is_jax_array(x):
+        return np.array(x, copy=True)  # a real copy: donation-safe
+    if isinstance(x, (np.ndarray, np.generic)):
+        return np.asarray(x).copy()
+    return x
+
+
+def host_stats(batch):
+    # batch is plain host data here — asarray on non-jax values is fine.
+    arr = np.asarray(batch)
+    return arr.mean()
+
+
+def run_epoch(params, opt_state, key):
+    train_epoch = jax.jit(lambda p, o, k: (p, o), donate_argnums=(0, 1))
+    params, opt_state = train_epoch(params, opt_state, key)
+    host = np.array(params, copy=True)  # copies before the next step
+    return host, opt_state

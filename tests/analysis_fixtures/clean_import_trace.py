@@ -1,0 +1,31 @@
+"""Idiomatic twin: wrapping with jax.jit at module level is free (tracing
+happens at first call); arrays and keys are built lazily inside
+functions."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def double(x):
+    return x * 2
+
+
+_step = jax.jit(lambda p, g: p - 0.1 * g)  # wrap only: no trace yet
+
+
+@functools.lru_cache(maxsize=1)
+def init_table():
+    return jnp.zeros((1024, 1024))
+
+
+def fresh_key(seed):
+    return jax.random.PRNGKey(seed)
+
+
+def forward(x, table=None):
+    if table is None:
+        table = init_table()
+    return x @ table

@@ -1,0 +1,20 @@
+# dmlint-scope: checkpoint-path
+"""Idiomatic twin: checkpoint bytes go through the portable formats
+(msgpack blob / sharded chunk+JSON with sha256 sidecars), json for
+manifests — nothing executes on load."""
+
+import hashlib
+import json
+
+
+def save_manifest(path, index):
+    payload = json.dumps(index, sort_keys=True).encode()
+    digest = hashlib.sha256(payload).hexdigest()
+    with open(path, "wb") as f:
+        f.write(payload)
+    return digest
+
+
+def load_manifest(path):
+    with open(path, "rb") as f:
+        return json.loads(f.read())

@@ -1,0 +1,48 @@
+"""Idiomatic twin: isolation without silence — the failure is counted,
+logged, stashed for re-raise at the next call boundary (ckpt/writer.py's
+contract), or the except is narrowed to what the code can actually
+handle."""
+
+import threading
+
+
+def _writer_loop(q, state, log):
+    while True:
+        item = q.get()
+        if item is None:
+            return
+        try:
+            item.run()
+        except Exception as exc:  # surfaced on the next save boundary
+            state["error"] = exc
+            state["errors_total"] = state.get("errors_total", 0) + 1
+
+
+class Monitor:
+    observer_errors = 0
+
+    def _monitor_loop(self):
+        while not self._closing.wait(0.5):
+            try:
+                self._on_stall()
+            except Exception:
+                # Isolated on purpose, but it COUNTS (snapshot surfaces it).
+                self.observer_errors += 1
+
+    def start(self):
+        threading.Thread(target=self._monitor_loop, daemon=True).start()
+
+
+class Poller(threading.Thread):
+    def run(self):
+        while True:
+            try:
+                self.poll()
+            except OSError:  # narrowed: transient socket errors only
+                continue
+
+
+def start_writer(q, state, log):
+    threading.Thread(
+        target=_writer_loop, args=(q, state, log), daemon=True
+    ).start()

@@ -1,0 +1,36 @@
+"""Idiomatic twin: one dispatch_lock hold per epoch covering key creation,
+schedule evaluation, and the compiled programs (tune/trainable.py); jit
+WRAPPING and traced closures stay lock-free — they dispatch nothing."""
+
+import jax
+import jax.numpy as jnp
+
+from distributed_machine_learning_tpu.utils.dispatch import dispatch_lock
+
+
+def make_epoch_fn(forward):
+    # Traced closure: its jnp ops run under jit tracing, not eagerly.
+    def epoch_fn(params, batch):
+        return jnp.sum(forward(params, batch))
+
+    return epoch_fn
+
+
+def build_programs(forward, tx):
+    train_epoch = jax.jit(make_epoch_fn(forward), donate_argnums=(0,))
+    init_opt = jax.jit(tx.init)  # wrapping only — no dispatch
+    return train_epoch, init_opt
+
+
+def epoch_body(params, lr, shape_schedule, step, train_epoch):
+    with dispatch_lock():
+        epoch_key = jax.random.key(step)
+        lr_now = lr * float(shape_schedule(step))
+        loss = jnp.sum(train_epoch(params, epoch_key))
+    return epoch_key, lr_now, loss
+
+
+def legacy_restore(tx, params):
+    with dispatch_lock():
+        opt_state = jax.jit(tx.init)(params)
+    return opt_state

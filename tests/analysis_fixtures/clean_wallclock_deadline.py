@@ -1,0 +1,30 @@
+"""Idiomatic twin: monotonic for every deadline/lease/liveness age;
+time.time() stays for what it is good at — logged timestamps and
+durations-for-metrics (liveness.py got this right from day one)."""
+
+import time
+
+
+class Worker:
+    def __init__(self):
+        self.last_seen = time.monotonic()
+        self.expired_at = 0.0
+        self.joined_at_unix = time.time()  # logged timestamp: wall is right
+
+    def in_grace(self, grace_s):
+        return time.monotonic() - self.expired_at <= grace_s
+
+
+def wait_all(events, timeout):
+    deadline = time.monotonic() + timeout
+    for ev in events:
+        left = deadline - time.monotonic()
+        if left <= 0 or not ev.wait(left):
+            return False
+    return True
+
+
+def timed_save(save_fn):
+    t0 = time.time()
+    save_fn()
+    return {"save_s": time.time() - t0, "timestamp": time.time()}

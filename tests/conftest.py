@@ -11,6 +11,11 @@ import warnings
 # Hard override: the image pins JAX_PLATFORMS=axon (the real-TPU tunnel);
 # tests must run on virtual CPU devices regardless.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Record lock acquisition order across every named_lock in the suite
+# (analysis/locks.py): tests/test_analysis.py asserts the union graph is
+# acyclic.  Before the package import below so module-level locks record.
+os.environ.setdefault("DML_LOCK_ORDER", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

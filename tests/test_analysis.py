@@ -1,0 +1,378 @@
+"""Tier-1 gate for the analysis/ package (ISSUE 6).
+
+Three layers of enforcement:
+
+* **the lint gate** — dmlint over the whole installed package must report
+  ZERO unsuppressed findings (and the checked-in baseline must be empty:
+  grandfathering is a burn-down device, not a parking lot);
+* **rule fidelity** — every rule fires on its historical bug pattern
+  (``tests/analysis_fixtures/bad_*.py``, golden ``# EXPECT: <rule>``
+  markers matched on rule AND line) and stays silent on the idiomatic
+  twin (``clean_*.py``, zero findings under ALL rules);
+* **lock order** — the runtime recorder (enabled suite-wide by conftest's
+  ``DML_LOCK_ORDER=1``) sees a deliberately inverted acquisition as a
+  cycle, and the union graph across the instrumented
+  executor/cluster/serve/ckpt/dispatch locks stays acyclic.
+"""
+
+import ast
+import collections
+import os
+import re
+import threading
+
+import pytest
+
+import distributed_machine_learning_tpu as pkg
+from distributed_machine_learning_tpu import analysis
+from distributed_machine_learning_tpu.analysis import locks as locks_lib
+from distributed_machine_learning_tpu.analysis.engine import load_context
+
+PKG_ROOT = os.path.dirname(os.path.abspath(pkg.__file__))
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+RULE_NAMES = [r.name for r in analysis.ALL_RULES]
+
+_EXPECT_RE = re.compile(r"#\s*EXPECT:\s*([a-z\-,\s]+?)\s*$")
+
+
+# --------------------------------------------------------------------------
+# the gate
+# --------------------------------------------------------------------------
+
+
+def test_package_has_zero_unsuppressed_findings():
+    result = analysis.lint_paths([PKG_ROOT])
+    assert result.files_checked > 40  # the walk really covered the package
+    assert not result.errors, result.errors
+    live = result.unsuppressed()
+    assert not live, "unsuppressed dmlint finding(s):\n" + "\n".join(
+        f.format() for f in live
+    )
+
+
+def test_baseline_is_empty():
+    """Satellite goal state: nothing grandfathered.  A PR that wants to
+    baseline a new finding must consciously argue with this test —
+    inline `# dmlint: disable=<rule> <reason>` is the sanctioned escape
+    hatch for intentional exceptions."""
+    from distributed_machine_learning_tpu.analysis.findings import (
+        load_baseline,
+    )
+
+    entries = load_baseline(analysis.DEFAULT_BASELINE)
+    assert entries == [], (
+        f"baseline should be empty; fix or inline-suppress: {entries}"
+    )
+
+
+def test_lint_cli_exits_nonzero_on_findings(capsys):
+    from distributed_machine_learning_tpu.__main__ import main
+
+    bad = os.path.join(FIXTURES, "bad_wallclock_deadline.py")
+    with pytest.raises(SystemExit) as exc:
+        main(["lint", bad, "--baseline", "none"])
+    assert exc.value.code == 1
+    out = capsys.readouterr().out
+    assert "wallclock-deadline" in out and "DML004" in out
+    with pytest.raises(SystemExit) as exc:
+        main(["lint", os.path.join(FIXTURES, "clean_wallclock_deadline.py"),
+              "--baseline", "none"])
+    assert exc.value.code == 0
+
+
+# --------------------------------------------------------------------------
+# rule fidelity: bad fixture fires exactly as marked; clean twin is silent
+# --------------------------------------------------------------------------
+
+
+def _expected_markers(path):
+    """Multiset of (line, rule) from # EXPECT: comments."""
+    expected = collections.Counter()
+    with open(path) as f:
+        for lineno, line in enumerate(f, start=1):
+            m = _EXPECT_RE.search(line)
+            if m:
+                for rule in m.group(1).split(","):
+                    expected[(lineno, rule.strip())] += 1
+    return expected
+
+
+@pytest.mark.parametrize("rule_name", RULE_NAMES)
+def test_rule_fires_on_historical_bug_pattern(rule_name):
+    path = os.path.join(FIXTURES, f"bad_{rule_name.replace('-', '_')}.py")
+    assert os.path.exists(path), f"missing fixture for {rule_name}"
+    expected = _expected_markers(path)
+    assert expected, f"{path} has no EXPECT markers"
+    assert {r for _, r in expected} == {rule_name}, (
+        "a bad fixture exercises exactly its own rule"
+    )
+    result = analysis.lint_paths([path], baseline_path=None)
+    got = collections.Counter((f.line, f.rule) for f in result.findings)
+    assert got == expected, (
+        f"{rule_name}: expected {dict(expected)}, got {dict(got)}\n"
+        + "\n".join(f.format() for f in result.findings)
+    )
+
+
+@pytest.mark.parametrize("rule_name", RULE_NAMES)
+def test_rule_is_silent_on_idiomatic_twin(rule_name):
+    path = os.path.join(FIXTURES, f"clean_{rule_name.replace('-', '_')}.py")
+    assert os.path.exists(path), f"missing clean twin for {rule_name}"
+    result = analysis.lint_paths([path], baseline_path=None)
+    assert not result.findings, (
+        f"false positive(s) on the idiomatic form:\n"
+        + "\n".join(f.format() for f in result.findings)
+    )
+
+
+# --------------------------------------------------------------------------
+# suppression + baseline mechanics
+# --------------------------------------------------------------------------
+
+
+def _lint_source(tmp_path, source, baseline_path=None):
+    p = tmp_path / "case.py"
+    p.write_text(source)
+    return analysis.lint_paths([str(p)], baseline_path=baseline_path)
+
+
+def test_inline_suppression_same_line(tmp_path):
+    src = (
+        "import time\n"
+        "deadline = time.time() + 5  "
+        "# dmlint: disable=wallclock-deadline test-only clock\n"
+    )
+    result = _lint_source(tmp_path, src)
+    assert len(result.findings) == 1
+    assert result.findings[0].suppressed
+    assert not result.unsuppressed()
+
+
+def test_inline_suppression_directive_line_above(tmp_path):
+    src = (
+        "import time\n"
+        "# dmlint: disable=wallclock-deadline reason: fixture\n"
+        "deadline = time.time() + 5\n"
+    )
+    result = _lint_source(tmp_path, src)
+    assert result.findings and result.findings[0].suppressed
+
+
+def test_suppression_for_other_rule_does_not_apply(tmp_path):
+    src = (
+        "import time\n"
+        "deadline = time.time() + 5  # dmlint: disable=import-trace nope\n"
+    )
+    result = _lint_source(tmp_path, src)
+    assert result.unsuppressed(), "wrong-rule suppression must not silence"
+
+
+def test_baseline_roundtrip_absorbs_then_burns_down(tmp_path):
+    src = "import time\ndeadline = time.time() + 5\n"
+    p = tmp_path / "case.py"
+    p.write_text(src)
+    base = tmp_path / "baseline.json"
+    first = analysis.lint_paths([str(p)], baseline_path=None)
+    assert len(first.unsuppressed()) == 1
+    analysis.save_baseline(str(base), first.unsuppressed())
+    second = analysis.lint_paths([str(p)], baseline_path=str(base))
+    assert not second.unsuppressed()
+    assert second.findings[0].baselined
+    # the fix lands: baseline entry goes stale harmlessly, nothing fires
+    p.write_text("import time\ndeadline = time.monotonic() + 5\n")
+    third = analysis.lint_paths([str(p)], baseline_path=str(base))
+    assert not third.findings
+
+
+def test_scope_marker_opts_file_into_scoped_rules(tmp_path):
+    src = "# dmlint-scope: checkpoint-path\nimport pickle\n"
+    result = _lint_source(tmp_path, src)
+    assert any(f.rule == "pickle-checkpoint" for f in result.findings)
+    # without the marker, an arbitrary file is out of the pickle scope
+    result = _lint_source(tmp_path, "import pickle\n")
+    assert not result.findings
+
+
+# --------------------------------------------------------------------------
+# lock-order recorder
+# --------------------------------------------------------------------------
+
+
+def test_inverted_acquisition_is_detected_as_cycle():
+    """The acceptance fixture: two locks taken a->b on one code path and
+    b->a on another (fresh recorder: the deliberate inversion must not
+    poison the suite-wide graph)."""
+    locks_lib.enable()  # conftest sets the env; make the invariant local
+    rec = locks_lib.LockOrderRecorder()
+    a = locks_lib.NamedLock("fix.a", recorder=rec)
+    b = locks_lib.NamedLock("fix.b", recorder=rec)
+    with a:
+        with b:
+            pass
+    rec.assert_acyclic()  # one direction alone is fine
+
+    def inverted():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=inverted)
+    t.start()
+    t.join()
+    with pytest.raises(locks_lib.LockOrderViolation) as exc:
+        rec.assert_acyclic()
+    msg = str(exc.value)
+    assert "fix.a" in msg and "fix.b" in msg and "->" in msg
+    assert rec.cycles()
+
+
+def test_same_role_nesting_is_tracked_not_a_cycle():
+    rec = locks_lib.LockOrderRecorder()
+    outer = locks_lib.NamedLock("fix.role", recorder=rec)
+    inner = locks_lib.NamedLock("fix.role", recorder=rec)
+    with outer:
+        with inner:
+            pass
+    assert rec.cycles() == []
+    assert rec.self_edges.get("fix.role") == 1
+
+
+def test_named_lock_backs_a_condition():
+    lock = locks_lib.named_lock("fix.cond")
+    cond = threading.Condition(lock)
+    hits = []
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=5.0)
+            hits.append(1)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    # Let the waiter reach wait(); notify under the lock.
+    import time
+
+    deadline = time.monotonic() + 5.0
+    while not hits and time.monotonic() < deadline:
+        with cond:
+            cond.notify_all()
+        time.sleep(0.01)
+    t.join(timeout=5.0)
+    assert hits == [1]
+
+
+def test_instrumented_subsystems_record_and_stay_acyclic(tmp_path):
+    """Drive a small workload through each instrumented subsystem, then
+    assert (a) the recorder saw their lock roles and (b) the union
+    acquisition graph — including everything earlier tests recorded — has
+    no cycle.  This is the tier-1 'acyclic across executor/cluster/serve/
+    ckpt' acceptance; the rest of the suite keeps feeding the same global
+    recorder."""
+    import numpy as np
+
+    assert locks_lib.enabled(), "conftest must enable DML_LOCK_ORDER"
+    rec = locks_lib.get_recorder()
+
+    # ckpt: async writer + metrics
+    from distributed_machine_learning_tpu.ckpt.writer import AsyncCheckpointer
+
+    w = AsyncCheckpointer(log=lambda msg: None)
+    w.save(str(tmp_path / "ck.msgpack"), {"w": np.ones((2, 2), np.float32)})
+    assert w.wait_until_finished(timeout=30)
+    w.close()
+
+    # serve: micro-batcher (Condition over a NamedLock) + circuit breaker
+    from distributed_machine_learning_tpu.serve.batcher import MicroBatcher
+    from distributed_machine_learning_tpu.serve.replica import CircuitBreaker
+
+    mb = MicroBatcher(lambda x: x * 2, max_batch_size=4, max_latency_ms=1.0)
+    fut = mb.submit(np.ones((1, 3), np.float32))
+    assert fut.result(timeout=10) is not None
+    mb.stop()
+    br = CircuitBreaker(failure_threshold=1, recovery_s=60.0)
+    assert br.allow()
+    br.record_failure()
+    assert not br.allow()
+
+    # chaos: a seeded plan decision
+    from distributed_machine_learning_tpu import chaos
+
+    plan = chaos.FaultPlan(seed=3, write_error_rate=1.0)
+    with pytest.raises(IOError):
+        plan.on_storage_op("write", "exp/trial/checkpoint_1")
+    assert plan.snapshot()["storage_write_errors"] == 1
+
+    # tune: the in-memory storage backend's shared-namespace lock
+    from distributed_machine_learning_tpu.tune.storage import MemoryStorage
+
+    mem = MemoryStorage()
+    mem.write_bytes("mem://fix/blob", b"bytes")
+    assert mem.read_bytes("mem://fix/blob") == b"bytes"
+
+    # dispatch + cluster + executor-side liveness primitives
+    from distributed_machine_learning_tpu.utils import dispatch
+    from distributed_machine_learning_tpu.tune import cluster
+    from distributed_machine_learning_tpu import liveness
+
+    with dispatch._LOCK:
+        pass
+    with cluster._SEEN_KEYS_LOCK:
+        pass
+    dog = liveness.DispatchWatchdog(1.0)
+    dog.track("k")
+    dog.beat("k")
+    dog.expired()
+
+    seen = rec.roles_seen
+    for role in (
+        "ckpt.writer", "ckpt.metrics", "serve.batcher.queue",
+        "serve.batcher.stats", "serve.breaker", "chaos.plan", "dispatch",
+        "cluster.seen_keys", "liveness.watchdog", "liveness.heartbeat",
+        "tune.storage.mem",
+    ):
+        assert role in seen, f"lock role {role!r} never recorded"
+    rec.assert_acyclic()
+    # Same-role nesting would be an instance-order hazard the role graph
+    # cannot see — the instrumented roles must not develop one silently.
+    assert not any(
+        rec.self_edges.get(r) for r in seen if not r.startswith("fix.")
+    ), rec.self_edges
+
+
+def test_recorder_snapshot_shape():
+    rec = locks_lib.LockOrderRecorder()
+    a = locks_lib.NamedLock("s.a", recorder=rec)
+    b = locks_lib.NamedLock("s.b", recorder=rec)
+    with a:
+        with b:
+            pass
+    snap = rec.snapshot()
+    assert snap["edges"] == ["s.a -> s.b"]
+    assert set(snap["roles"]) == {"s.a", "s.b"}
+    assert snap["cycles"] == []
+
+
+# --------------------------------------------------------------------------
+# engine hygiene
+# --------------------------------------------------------------------------
+
+
+def test_every_package_file_parses_for_the_linter():
+    count = 0
+    for path in analysis.iter_python_files([PKG_ROOT]):
+        load_context(path)  # raises on syntax error
+        count += 1
+    assert count > 40
+
+
+def test_rule_catalog_is_documented():
+    """docs/static-analysis.md must name every rule (id + name): the doc
+    IS the catalog, and a rule landing without docs is how suppression
+    reasons rot."""
+    doc = os.path.join(os.path.dirname(PKG_ROOT), "docs",
+                       "static-analysis.md")
+    assert os.path.exists(doc)
+    text = open(doc).read()
+    for rule in analysis.ALL_RULES:
+        assert rule.rule_id in text, f"{rule.rule_id} missing from catalog"
+        assert rule.name in text, f"{rule.name} missing from catalog"

@@ -23,32 +23,14 @@ Invariants the ckpt/ and compilecache/ subsystems depend on:
   pipe) but never in anything that writes or reads checkpoint bytes.
 """
 
+import glob
 import importlib
 import os
 import pkgutil
-import re
 
 import distributed_machine_learning_tpu as pkg
 
 PKG_ROOT = os.path.dirname(pkg.__file__)
-
-# Everything that serializes/deserializes checkpoint or bundle bytes.
-CHECKPOINT_PATH_FILES = (
-    "ckpt/__init__.py",
-    "ckpt/format.py",
-    "ckpt/manager.py",
-    "ckpt/metrics.py",
-    "ckpt/writer.py",
-    "tune/checkpoint.py",
-    "tune/storage.py",
-    "serve/export.py",
-)
-
-_PICKLE_RE = re.compile(
-    r"^\s*(import\s+(cloud)?pickle|from\s+(cloud)?pickle\s+import)"
-    r"|(cloud)?pickle\.(loads?|dumps?)\(",
-    re.MULTILINE,
-)
 
 
 def _iter_module_names():
@@ -87,16 +69,26 @@ def test_every_module_imports_on_cpu():
 
 
 def test_checkpoint_path_is_pickle_free():
-    offenders = []
-    for rel in CHECKPOINT_PATH_FILES:
-        path = os.path.join(PKG_ROOT, rel)
-        assert os.path.exists(path), f"guard list is stale: {rel} missing"
-        with open(path) as f:
-            src = f.read()
-        m = _PICKLE_RE.search(src)
-        if m:
-            line = src[: m.start()].count("\n") + 1
-            offenders.append(f"{rel}:{line}: {m.group(0).strip()}")
+    """One implementation, one allowlist: the ``pickle-checkpoint`` dmlint
+    rule (analysis/rules.py) owns both the detection (AST, not regex) and
+    the list of checkpoint-path modules; this test just points it at the
+    package.  ``dml-tpu lint`` enforces the same rule outside pytest."""
+    from distributed_machine_learning_tpu import analysis
+
+    # Guard-list staleness: every allowlist pattern must still match at
+    # least one real file (a renamed module must not silently fall out of
+    # the pickle scope).
+    for pat in analysis.CHECKPOINT_PATH_PATTERNS:
+        root = os.path.join(PKG_ROOT, pat)
+        hits = glob.glob(root) or glob.glob(root.rstrip("/") + "/*.py")
+        assert hits, f"pickle allowlist is stale: {pat} matches nothing"
+
+    rule = analysis.get_rule("pickle-checkpoint")
+    result = analysis.lint_paths(
+        [PKG_ROOT], rules=[rule], baseline_path=analysis.DEFAULT_BASELINE
+    )
+    assert result.files_checked > 40
+    offenders = [f.format() for f in result.unsuppressed()]
     assert not offenders, (
         "pickle crept into the checkpoint path (the format must stay "
         "process/framework-portable):\n" + "\n".join(offenders)

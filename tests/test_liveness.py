@@ -298,6 +298,64 @@ def test_cluster_stalled_trial_is_fenced_and_requeued(tmp_path):
         _terminate(procs)
 
 
+def test_wallclock_jump_does_not_expire_live_worker_lease(
+    tmp_path, monkeypatch
+):
+    """Regression for the dmlint ``wallclock-deadline`` fix sites (ISSUE 6
+    satellite): lease expiry / last_seen / reconnect-grace arithmetic in
+    tune/cluster.py must ride time.monotonic().  The driver's view of the
+    wall clock flip-flops between now and now-2h — every consecutive pair
+    of reads sees a +/-7200 s NTP-style step, so the old time.time() lease
+    math would observe a worker 'silent' for two hours within the first
+    few frames and expire it.  The monotonic clock is proxied through
+    untouched; a healthy worker's lease must survive the whole sweep."""
+    import time as real_time
+
+    from distributed_machine_learning_tpu.tune import cluster as cluster_mod
+
+    class JumpyTime:
+        """time-module proxy scoped to cluster.py: wall jumps, the rest
+        (monotonic, sleep, strftime) passes through."""
+
+        def __init__(self):
+            self.calls = 0
+
+        def time(self):
+            self.calls += 1
+            return real_time.time() - (7200.0 if self.calls % 2 else 0.0)
+
+        def __getattr__(self, name):
+            return getattr(real_time, name)
+
+    jumpy = JumpyTime()
+    monkeypatch.setattr(cluster_mod, "time", jumpy)
+
+    procs, addrs = start_local_workers(1, slots=2, env=_worker_env())
+    try:
+        analysis = run_distributed(
+            "cluster_trainables:resumable_quadratic_trial",
+            {"x": tune.uniform(0.0, 6.0), "epochs": 3},
+            metric="loss", mode="min", num_samples=3,
+            workers=addrs, storage_path=str(tmp_path), name="lv_ntp",
+            seed=11, verbose=0,
+            worker_heartbeat_timeout_s=60.0,
+            worker_reconnect_grace_s=30.0,
+        )
+        assert analysis.num_terminated() == 3
+        state = json.load(open(f"{analysis.root}/experiment_state.json"))
+        lv = state.get("liveness", {})
+        assert lv.get("lease_expiries", 0) == 0, (
+            f"a wall-clock step expired a live worker's lease: {lv}"
+        )
+        assert lv.get("worker_requeues", 0) == 0
+        # The proxy really was consulted (the sweep records wall_clock_s
+        # through it), so a silent revert to raw time.time() cannot pass
+        # by never exercising the jump.
+        assert jumpy.calls > 0
+    finally:
+        _terminate(procs)
+
+
 def test_cluster_partition_e2e_same_best_as_fault_free(tmp_path):
     """The acceptance e2e (ISSUE 3): one worker hangs a dispatch AND one
     worker is partition-injected mid-sweep — the faulted sweep requeues
