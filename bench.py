@@ -32,6 +32,7 @@ SIGTERM (never SIGKILL) so a wedged child cannot take the relay down with it.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import signal
@@ -194,10 +195,12 @@ def _record_tpu_capture(suite: dict) -> None:
                                prev_suite.get("flagship"))
     merged["quality"] = _pick(suite.get("quality"),
                               prev_suite.get("quality"))
+    merged["sharded_flagship"] = _pick(suite.get("sharded_flagship"),
+                                       prev_suite.get("sharded_flagship"))
     merged["sweeps"] = dict(prev_suite.get("sweeps") or {})
     for dtype, res in (suite.get("sweeps") or {}).items():
         merged["sweeps"][dtype] = _pick(res, merged["sweeps"].get(dtype))
-    for k in ("flagship", "quality"):
+    for k in ("flagship", "quality", "sharded_flagship"):
         if merged.get(k) is None:
             merged.pop(k, None)
     try:
@@ -1357,6 +1360,226 @@ def child_flagship() -> None:
     _flagship_result(lambda snap: print(json.dumps(snap), flush=True))
 
 
+def child_sharded_flagship() -> None:
+    _sharded_flagship_result(lambda snap: print(json.dumps(snap), flush=True))
+
+
+def _sharded_flagship_result(progress_cb) -> dict:
+    """Per-mesh-shape step time + MFU for the SHARDED flagship (ISSUE 7):
+    the config whose params + adam moments exceed one chip's HBM
+    (``models/flagship.py`` derives it from the measured budget), trained
+    as the fused donated epoch program over 2-D (dp, tp) meshes built
+    from the model family's partition rules.
+
+    Per mesh shape: ``step_s`` (median of timed cells over the scan),
+    ``mfu`` against the WHOLE mesh's peak (n_devices × per-chip peak —
+    collective overhead reads as lost MFU, which is the honest number),
+    and the ``compile_s``/``exec_s`` split from the compilecache
+    tracker's counters.  Only meaningful on the MXU: the parent records
+    a skipped-with-reason stub on CPU fallback instead of a
+    non-comparable number.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributed_machine_learning_tpu import compilecache
+    from distributed_machine_learning_tpu.models import build_model
+    from distributed_machine_learning_tpu.models.flagship import (
+        flagship_sharded_config,
+        param_opt_bytes,
+        single_chip_hbm_bytes,
+    )
+    from distributed_machine_learning_tpu.models.partition_rules import (
+        rules_for,
+    )
+    from distributed_machine_learning_tpu.ops.flops import (
+        device_peak_flops,
+        train_step_flops,
+    )
+    from distributed_machine_learning_tpu.parallel.mesh import make_mesh
+    from distributed_machine_learning_tpu.parallel.partition import (
+        mesh_axis_sizes,
+        rules_fingerprint,
+    )
+    from distributed_machine_learning_tpu.parallel.sharding import (
+        opt_state_shardings,
+        param_shardings,
+    )
+    from distributed_machine_learning_tpu.tune.trainable_sharded import (
+        _partitionable_threefry,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    n = len(devices)
+    budget = single_chip_hbm_bytes(devices[0])
+    cfg = flagship_sharded_config(budget)
+    F = FLAGSHIP["features"]
+    B, S = int(cfg["batch_size"]), int(cfg["max_seq_length"])
+    num_batches = 4  # scan trip count per fused epoch program
+    peak = device_peak_flops(devices[0], compute_dtype="float32")
+    rules = rules_for(cfg)
+    out = {
+        "platform": devices[0].platform,
+        "num_devices": n,
+        "single_chip_hbm_bytes": budget,
+        "param_opt_bytes": param_opt_bytes(cfg, features=F),
+        "config": {k: v for k, v in cfg.items() if k != "mesh_shape"},
+        "rules_fp": rules_fingerprint(rules),
+        "meshes": {},
+    }
+    assert out["param_opt_bytes"] > budget  # the point of the section
+
+    # Candidate 2-D shapes: every tp that divides both the device count
+    # and the head count, dp = n // tp (dp and tp both > 1 = genuinely
+    # 2-D; at most three shapes so the phase stays minutes, not hours).
+    shapes = [
+        {"dp": n // tp, "tp": tp}
+        for tp in (2, 4, 8)
+        if n % tp == 0 and n // tp > 1 and cfg["num_heads"] % tp == 0
+    ][:3]
+
+    tracker = compilecache.get_tracker()
+    for mesh_shape in shapes:
+        tag = "x".join(f"{k}{v}" for k, v in mesh_shape.items())
+        _touch_heartbeat()
+        try:
+            with _partitionable_threefry():
+                compile_base = tracker.total_seconds()
+                mesh = make_mesh(mesh_shape, devices)
+                model = build_model(dict(cfg, mesh=mesh))
+                rng = jax.random.key(0)
+                x1 = jnp.zeros((1, S, F), jnp.float32)
+                shapes_v = jax.eval_shape(
+                    lambda r, x: model.init(r, x, deterministic=True),
+                    {"params": rng, "dropout": rng}, x1,
+                )
+                p_sh = param_shardings(shapes_v["params"], mesh, rules)
+                params = jax.jit(
+                    lambda r, x: model.init(r, x, deterministic=True),
+                    out_shardings={"params": p_sh},
+                )({"params": rng, "dropout": rng}, x1)["params"]
+                tx = optax.adam(1e-3)
+                o_sh = opt_state_shardings(
+                    jax.eval_shape(tx.init, params), p_sh, mesh
+                )
+                opt_state = jax.jit(
+                    tx.init, in_shardings=(p_sh,), out_shardings=o_sh
+                )(params)
+                repl = NamedSharding(mesh, P())
+                xb_sh = NamedSharding(mesh, P(None, "dp"))
+
+                def epoch(params, opt_state, xb, yb, key):
+                    def step(carry, batch):
+                        params, opt_state, i = carry
+                        x, y = batch
+
+                        def loss_of(p):
+                            preds = model.apply(
+                                {"params": p}, x,
+                                rngs={"dropout": jax.random.fold_in(key, i)},
+                                deterministic=False,
+                            )
+                            return jnp.mean(
+                                (preds.astype(jnp.float32) - y) ** 2
+                            )
+
+                        loss, grads = jax.value_and_grad(loss_of)(params)
+                        updates, opt_state = tx.update(
+                            grads, opt_state, params
+                        )
+                        params = optax.apply_updates(params, updates)
+                        return (params, opt_state, i + 1), loss
+
+                    (params, opt_state, _), losses = jax.lax.scan(
+                        step, (params, opt_state, jnp.int32(0)), (xb, yb)
+                    )
+                    return params, opt_state, losses.mean()
+
+                train_epoch = jax.jit(
+                    epoch,
+                    donate_argnums=(0, 1, 2, 3),
+                    in_shardings=(p_sh, o_sh, xb_sh, xb_sh, repl),
+                    out_shardings=(p_sh, o_sh, repl),
+                )
+
+                rs = np.random.RandomState(0)
+
+                def batches():
+                    xb = jax.device_put(
+                        rs.randn(num_batches, B, S, F).astype(np.float32),
+                        xb_sh,
+                    )
+                    yb = jax.device_put(
+                        rs.randn(num_batches, B, 1).astype(np.float32),
+                        xb_sh,
+                    )
+                    return xb, yb
+
+                t0 = time.time()
+                xb, yb = batches()
+                params, opt_state, loss = train_epoch(
+                    params, opt_state, xb, yb, jax.random.key(1)
+                )
+                float(loss)
+                compile_plus_first = time.time() - t0
+                compile_s = tracker.total_seconds() - compile_base
+
+                cells = []
+                for _ in range(4):
+                    _touch_heartbeat()
+                    xb, yb = batches()  # donated each epoch: restage
+                    t0 = time.time()
+                    params, opt_state, loss = train_epoch(
+                        params, opt_state, xb, yb, jax.random.key(2)
+                    )
+                    float(loss)
+                    cells.append((time.time() - t0) / num_batches)
+                step_s = _median(cells)
+                cells.sort()
+                flops = train_step_flops(cfg, B, S, F)
+                mesh_peak = (peak or 0) * n
+                out["meshes"][tag] = {
+                    "mesh_shape": dict(mesh_shape),
+                    "step_s": round(step_s, 5),
+                    "step_s_spread": [round(cells[0], 5),
+                                      round(cells[-1], 5)],
+                    "flops_per_step": flops,
+                    "mfu": (round(flops / step_s / mesh_peak, 4)
+                            if mesh_peak else None),
+                    "tflops_per_s": round(flops / step_s / 1e12, 2),
+                    # compile_s: backend-compile seconds from the
+                    # compilecache tracker (event durations fire on hits
+                    # too, so this can exceed the first-call wall on
+                    # cache-warm hosts); exec_s: one steady-state epoch's
+                    # measured execute wall.
+                    "compile_s": round(compile_s, 1),
+                    "exec_s": round(step_s * num_batches, 2),
+                    "compile_plus_first_epoch_s": round(
+                        compile_plus_first, 1
+                    ),
+                }
+                # Free the mesh's buffers before the next shape compiles.
+                del params, opt_state, xb, yb
+        except Exception as exc:  # noqa: BLE001 - smaller shapes still count
+            out["meshes"][tag] = {"error": repr(exc)[-300:]}
+        progress_cb(out)
+    best = max(
+        (m for m in out["meshes"].values() if m.get("mfu")),
+        key=lambda m: m["mfu"], default=None,
+    )
+    if best:
+        out["mfu"] = best["mfu"]
+        out["step_s"] = best["step_s"]
+        out["best_mesh"] = best["mesh_shape"]
+    out["compile_cache"] = compilecache.get_counters().snapshot()
+    out["complete"] = True
+    progress_cb(out)
+    return out
+
+
 def _flagship_result(progress_cb) -> dict:
     """Train-step time + MFU at the MXU-bound shape (FLAGSHIP): d_model 512,
     seq 2048, bf16 compute, explicit Pallas flash attention.  The sweep
@@ -1406,7 +1629,10 @@ def _flagship_result(progress_cb) -> dict:
         tx = optax.adam(1e-3)
         opt_state = tx.init(params)
 
-        @jax.jit
+        # donate_argnums: the old params/opt buffers alias the outputs —
+        # undonated, every measured step pays an extra params+opt HBM
+        # copy and the MFU reads low (dmlint DML008 caught this).
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def step(params, opt_state, x, y, rng):
             def loss_of(p):
                 preds = model.apply({"params": p}, x, rngs={"dropout": rng},
@@ -1708,6 +1934,30 @@ def child_suite(scale_name: str) -> None:
     else:
         note("flagship already in partial; skipping")
 
+    # Sharded-flagship phase (ISSUE 7): per-mesh-shape step/MFU for the
+    # over-HBM config.  After the single-chip flagship (its MFU is the
+    # headline comparison), before bf16 (scarcer evidence first).
+    prev_sf = suite.get("sharded_flagship")
+    if prev_sf and "error" not in prev_sf and prev_sf.get("complete"):
+        note("sharded_flagship already in partial; skipping")
+    elif remaining_s() < 240:
+        note(f"skipping sharded_flagship: {remaining_s():.0f}s left")
+    else:
+        note("sharded_flagship start")
+        try:
+            def on_sf(snap):
+                suite["sharded_flagship"] = snap
+                checkpoint(suite)
+            _sharded_flagship_result(on_sf)
+        except Exception:  # noqa: BLE001 - earlier phases still stand
+            import traceback
+
+            suite["sharded_flagship"] = {
+                "error": traceback.format_exc()[-800:]
+            }
+            checkpoint(suite)
+        note("sharded_flagship done")
+
     run_sweep_phase("bfloat16")
 
     # Quality-at-budget phase (BASELINE.md row 4): our side of the equal-
@@ -1832,6 +2082,20 @@ def emit(value: float, vs_baseline, backend: str, extra: dict) -> None:
         compact["error"] = str(extra["error"])[:200]
     if extra.get("flagship"):
         compact["flagship"] = _compact_flagship(extra["flagship"])
+    sf = extra.get("sharded_flagship")
+    if sf:
+        if sf.get("skipped"):
+            compact["sharded_flagship"] = {"skipped": sf["skipped"][:80]}
+        elif sf.get("error"):
+            compact["sharded_flagship"] = {"error": str(sf["error"])[-120:]}
+        else:
+            compact["sharded_flagship"] = {
+                "mfu": sf.get("mfu"),
+                "step_s": sf.get("step_s"),
+                "best_mesh": sf.get("best_mesh"),
+                "num_devices": sf.get("num_devices"),
+                **({"partial": True} if sf.get("partial") else {}),
+            }
     elif extra.get("flagship_prev"):
         compact["flagship_prev"] = _compact_flagship(extra["flagship_prev"])
     asha = extra.get("asha")
@@ -2013,9 +2277,9 @@ def _run_tpu_suite(log, phases):
     child finishes the remaining phases with chunked dispatch (short device
     calls), picking up the completed phases from the shared partial file.
 
-    Returns (ours, others, flagship, quality, tunnel_ok) — ours=None means
-    no sweep landed; quality is the suite's quality-at-budget phase result
-    (None when skipped or errored)."""
+    Returns (ours, others, flagship, sharded_flagship, quality,
+    tunnel_ok) — ours=None means no sweep landed; quality is the suite's
+    quality-at-budget phase result (None when skipped or errored)."""
     partial_path = f"/tmp/bench_suite_partial_{os.getpid()}.json"
     hb_path = f"/tmp/bench_suite_hb_{os.getpid()}"
     # A stale file from a previous run must not masquerade as ours.
@@ -2097,7 +2361,7 @@ def _run_tpu_suite(log, phases):
     for path in (partial_path, hb_path):
         _unlink_quiet(path)
     if res is None:
-        return None, [], None, None, tunnel_ok
+        return None, [], None, None, None, tunnel_ok
     flagship = res.get("flagship")
     if flagship and not flagship.pop("complete", False) \
             and "error" not in flagship:
@@ -2114,7 +2378,12 @@ def _run_tpu_suite(log, phases):
     quality = res.get("quality")
     if quality and "error" in quality:
         quality = None
-    return ours, candidates[1:], flagship, quality, tunnel_ok
+    sharded_flagship = res.get("sharded_flagship")
+    if sharded_flagship and not sharded_flagship.pop("complete", False) \
+            and "error" not in sharded_flagship:
+        sharded_flagship["partial"] = True
+    return (ours, candidates[1:], flagship, sharded_flagship, quality,
+            tunnel_ok)
 
 
 def main() -> None:
@@ -2136,10 +2405,10 @@ def main() -> None:
         probe_info["skipped"] = "no tunnel PYTHONPATH"
 
     ours, others, flagship, quality_ours = None, [], None, None
+    sharded_flagship = None
     if backend == "tpu" and tunnel_ok:
-        ours, others, flagship, quality_ours, tunnel_ok = _run_tpu_suite(
-            log, phases
-        )
+        (ours, others, flagship, sharded_flagship, quality_ours,
+         tunnel_ok) = _run_tpu_suite(log, phases)
         if ours is None:
             backend = "cpu"
     # Compile-cache dir shared by the CPU "ours" children, FRESH per bench
@@ -2174,9 +2443,8 @@ def main() -> None:
             probe_info["late_retry"] = late_ok
             if late_ok and tunnel_ok:
                 backend = "tpu"
-                tpu_ours, others, flagship, quality_ours, tunnel_ok = (
-                    _run_tpu_suite(log, phases)
-                )
+                (tpu_ours, others, flagship, sharded_flagship,
+                 quality_ours, tunnel_ok) = _run_tpu_suite(log, phases)
                 if tpu_ours is not None:
                     ours = tpu_ours
                 else:
@@ -2383,6 +2651,21 @@ def main() -> None:
     for flag in ("partial", "warm_skipped_after", "epochs_per_dispatch"):
         if flag in ours:
             extra[flag] = ours[flag]
+    # sharded_flagship section: a real per-mesh capture on TPU, an
+    # explicit skipped-with-reason stub on CPU fallback (a CPU step time
+    # has no MXU to be a fraction of — emitting a number would invite
+    # comparing it against on-chip MFU).
+    if sharded_flagship is not None:
+        extra["sharded_flagship"] = sharded_flagship
+    elif backend == "cpu":
+        extra["sharded_flagship"] = {
+            "skipped": (
+                "cpu fallback: per-mesh step time and MFU are only "
+                "comparable on the MXU; the partition-rule path itself "
+                "is tier-1-verified on 8 virtual devices "
+                "(tests/test_sharded_flagship.py)"
+            ),
+        }
     if flagship is not None:
         extra["flagship"] = flagship
     elif backend == "tpu":
@@ -2446,6 +2729,8 @@ if __name__ == "__main__":
             child_probe()
         elif kind == "flagship":
             child_flagship()
+        elif kind == "sharded_flagship":
+            child_sharded_flagship()
         elif kind == "suite":
             child_suite(argv[2] if len(argv) > 2 else "full")
         elif kind == "ours":

@@ -7,6 +7,7 @@ before promoting it to FLAGSHIP.  Run from the repo root with the default
 (tunnel) env; one claimant at a time (memory: axon-tunnel-environment).
 """
 
+import functools
 import json
 import sys
 import time
@@ -45,7 +46,7 @@ def main():
         tx = optax.adam(1e-3)
         opt_state = tx.init(params)
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def step(params, opt_state, x, y):
             def loss_of(p):
                 preds = model.apply({"params": p}, x, deterministic=True)

@@ -824,6 +824,144 @@ class ThreadSwallowRule(Rule):
         return True
 
 
+# --------------------------------------------------------------------------
+# DML008 undonated-hot-jit
+# --------------------------------------------------------------------------
+
+
+# Hot-path modules that opted in: every train-step-shaped jit here must
+# donate its state buffers (ISSUE 7's donation audit — an undonated hot
+# program doubles params+opt HBM on every step and was part of the 0.31
+# flagship MFU).
+HOT_JIT_PATH_PATTERNS = (
+    "parallel/",
+    "tune/vectorized.py",
+    "tune/trainable",
+    "bench.py",       # the flagship measure loops ARE the MFU evidence
+    "benchmarks/",
+)
+
+_PARAMS_ARG = re.compile(r"^params?$")
+_OPT_ARG = re.compile(r"^(opt|opt_state|optimizer_state)$")
+_JIT_NAMES = ("jax.jit", "jit", "pjit", "jax.pjit")
+
+
+class UndonatedHotJitRule(Rule):
+    name = "undonated-hot-jit"
+    rule_id = "DML008"
+    severity = "error"
+    description = (
+        "A jax.jit that threads BOTH params and optimizer state "
+        "positionally is a train step: it must pass donate_argnums (or "
+        "donate_argnames) so the old params/opt buffers are reused in "
+        "place — undonated, every step holds two copies of the largest "
+        "arrays in HBM and the copy shows up as step time.  Enforced in "
+        "opted-in hot-path modules (parallel/, tune/vectorized.py, "
+        "tune/trainable*.py) and for ANY jit with explicit "
+        "in_shardings/out_shardings (a sharded program's state is by "
+        "definition the big memory).  Eval-shaped programs (params only, "
+        "no optimizer state) are exempt — donating read-only params "
+        "would destroy them."
+    )
+    _HINT = (
+        "add donate_argnums covering the params/opt_state arguments "
+        "(and pin matching out_shardings so the alias is realizable)"
+    )
+
+    def applies(self, ctx) -> bool:
+        return True  # the sharded-jit trigger is location-independent
+
+    def _in_hot_module(self, ctx) -> bool:
+        if "hot-jit" in ctx.scopes:
+            return True
+        rel = ctx.display_path.replace("\\", "/")
+        return any(pat in rel for pat in HOT_JIT_PATH_PATTERNS)
+
+    @staticmethod
+    def _has_kw(call: ast.Call, *names) -> bool:
+        return any(kw.arg in names for kw in call.keywords)
+
+    @staticmethod
+    def _positional_params(fn) -> List[str]:
+        args = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        return [a for a in args if a != "self"]
+
+    def _is_train_step_signature(self, names: List[str]) -> bool:
+        return any(_PARAMS_ARG.match(n) for n in names) and any(
+            _OPT_ARG.match(n) for n in names
+        )
+
+    def _resolve_fn(self, node: ast.AST, defs: Dict[str, ast.AST]):
+        """The traced callable's def, when statically resolvable: an
+        inline lambda, or a Name bound to a def in this module.  Attribute
+        callees (tx.init, self.step) are unresolvable -> never flagged."""
+        if isinstance(node, ast.Lambda):
+            return node
+        if isinstance(node, ast.Name):
+            return defs.get(node.id)
+        if isinstance(node, ast.Call):
+            # jit(make_epoch_fn(...)) — the factory's return signature is
+            # not visible here; skip rather than guess.
+            return None
+        return None
+
+    def check(self, ctx) -> Iterator[Finding]:
+        hot = self._in_hot_module(ctx)
+        defs: Dict[str, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                callee = _call_name(node) or ""
+                if callee not in _JIT_NAMES or not node.args:
+                    continue
+                if self._has_kw(node, "donate_argnums", "donate_argnames"):
+                    continue
+                sharded = self._has_kw(node, "in_shardings", "out_shardings")
+                if not (hot or sharded):
+                    continue
+                fn = self._resolve_fn(node.args[0], defs)
+                if fn is None:
+                    continue
+                names = self._positional_params(fn)
+                if not self._is_train_step_signature(names):
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f"`{callee}` of a train-step-shaped function "
+                    f"({', '.join(names[:3])}, ...) without donate_argnums"
+                    + (" on a sharded program" if sharded else
+                       " in a hot-path module"),
+                    self._HINT,
+                )
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    callee = _dotted(target) or ""
+                    if callee not in _JIT_NAMES:
+                        continue
+                    if isinstance(dec, ast.Call) and self._has_kw(
+                        dec, "donate_argnums", "donate_argnames"
+                    ):
+                        continue
+                    sharded = isinstance(dec, ast.Call) and self._has_kw(
+                        dec, "in_shardings", "out_shardings"
+                    )
+                    if not (hot or sharded):
+                        continue
+                    names = self._positional_params(node)
+                    if not self._is_train_step_signature(names):
+                        continue
+                    yield self.finding(
+                        ctx, dec,
+                        f"@{callee} on train-step-shaped `{node.name}"
+                        f"({', '.join(names[:3])}, ...)` without "
+                        f"donate_argnums",
+                        self._HINT,
+                    )
+
+
 ALL_RULES: List[Rule] = [
     DonationAliasRule(),
     UnlockedDispatchRule(),
@@ -832,6 +970,7 @@ ALL_RULES: List[Rule] = [
     PickleCheckpointRule(),
     ImportTraceRule(),
     ThreadSwallowRule(),
+    UndonatedHotJitRule(),
 ]
 
 

@@ -118,17 +118,45 @@ class HostLeaf:
     """Host-side snapshot of one array leaf: global shape/dtype plus the
     chunks THIS process holds, each a ``(start, stop, ndarray)`` triple in
     global coordinates.  ``remote_chunks`` lists (start, stop) of shards
-    owned by other hosts (chunk table entries without local bytes)."""
+    owned by other hosts (chunk table entries without local bytes).
+    ``partition`` records the leaf's PartitionSpec (JSON-rendered, with
+    the mesh axis sizes) when the source array carried a NamedSharding —
+    the rule-derived layout rides in the index so a restore can rebuild
+    it without re-resolving the rule table."""
 
-    __slots__ = ("shape", "dtype", "chunks", "remote_chunks")
+    __slots__ = ("shape", "dtype", "chunks", "remote_chunks", "partition")
 
-    def __init__(self, shape, dtype, chunks, remote_chunks=()):
+    def __init__(self, shape, dtype, chunks, remote_chunks=(),
+                 partition=None):
         self.shape = tuple(int(d) for d in shape)
         self.dtype = _dtype_str(dtype)
         self.chunks: List[Tuple[Tuple[int, ...], Tuple[int, ...], np.ndarray]] = chunks
         self.remote_chunks: List[Tuple[Tuple[int, ...], Tuple[int, ...]]] = list(
             remote_chunks
         )
+        self.partition = partition
+
+
+def _partition_of(x) -> Optional[Dict[str, Any]]:
+    """``{"spec": [...], "mesh": {axis: size}}`` for a NamedSharding-backed
+    jax.Array; None otherwise (host arrays, single-device placements)."""
+    sharding = getattr(x, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    mesh = getattr(sharding, "mesh", None)
+    if spec is None or mesh is None:
+        return None
+    from distributed_machine_learning_tpu.parallel.partition import (
+        mesh_axis_sizes,
+        spec_to_jsonable,
+    )
+
+    try:
+        return {
+            "spec": spec_to_jsonable(spec),
+            "mesh": mesh_axis_sizes(mesh),
+        }
+    except Exception:  # noqa: BLE001 - layout metadata is best-effort
+        return None
 
 
 def _norm_index(index, shape) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
@@ -186,11 +214,13 @@ def snapshot_leaf(x):
                             remote.append((start, stop))
             except Exception:
                 remote = []
-            return HostLeaf(shape, x.dtype, list(chunks.values()), remote)
+            return HostLeaf(shape, x.dtype, list(chunks.values()), remote,
+                            partition=_partition_of(x))
         arr = np.array(x, copy=True)
         return HostLeaf(
             arr.shape, arr.dtype,
             [(tuple(0 for _ in arr.shape), tuple(arr.shape), arr)],
+            partition=_partition_of(x),
         )
     if isinstance(x, (np.ndarray, np.generic)):
         arr = np.asarray(x)
@@ -278,11 +308,14 @@ def write_snapshot(path: str, skeleton, leaves: List[Any]) -> Tuple[int, int]:
                 "nbytes": None,
                 "sha256": None,
             })
-        index_leaves.append({
+        rec = {
             "shape": list(leaf.shape),
             "dtype": leaf.dtype,
             "chunks": chunk_recs,
-        })
+        }
+        if leaf.partition is not None:
+            rec["partition"] = leaf.partition
+        index_leaves.append(rec)
     try:
         import jax
 
@@ -549,3 +582,42 @@ def delete_generation(path: str) -> int:
         except OSError:
             pass
     return removed
+
+
+def saved_partition_specs(path: str) -> Optional[Dict[str, Any]]:
+    """The rule-derived layout a generation was SAVED under: a pytree (same
+    skeleton as the checkpoint) of ``jax.sharding.PartitionSpec`` for every
+    leaf that recorded one (None for host/replicated leaves), plus the
+    saving mesh's axis sizes under the ``"__mesh__"`` key of the returned
+    dict.  Returns None for uncommitted/absent generations.
+
+    This is what lets a restore re-derive NamedShardings on a NEW mesh
+    from the same specs (``load_sharded(shardings=...)``) without
+    re-resolving the rule table that produced them."""
+    index = read_index(path)
+    if index is None:
+        return None
+    from distributed_machine_learning_tpu.parallel.partition import (
+        spec_from_jsonable,
+    )
+
+    leaves = index["leaves"]
+    mesh_axes: Dict[str, int] = {}
+
+    def rebuild(node):
+        if isinstance(node, dict) and set(node) == {_LEAF_KEY}:
+            rec = leaves[int(node[_LEAF_KEY])]
+            part = rec.get("partition")
+            if not part:
+                return None
+            for k, v in (part.get("mesh") or {}).items():
+                mesh_axes.setdefault(str(k), int(v))
+            return spec_from_jsonable(part.get("spec"))
+        if isinstance(node, dict):
+            return {k: rebuild(v) for k, v in node.items()}
+        if isinstance(node, list):
+            return [rebuild(v) for v in node]
+        return None
+
+    tree = rebuild(index["tree"])
+    return {"specs": tree, "__mesh__": mesh_axes}

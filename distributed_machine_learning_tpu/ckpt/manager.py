@@ -96,16 +96,101 @@ def latest_generation(directory: str) -> Tuple[Optional[str], int]:
     return path, step
 
 
-def newest_valid_generation(directory: str) -> Tuple[Optional[str], int]:
+def newest_valid_generation(
+    directory: str, max_step: Optional[int] = None
+) -> Tuple[Optional[str], int]:
     """(path, step) of the newest generation that passes its integrity
-    check (committed + checksum-clean), or (None, 0)."""
+    check (committed + checksum-clean), or (None, 0).
+
+    ``max_step`` bounds the search: generations ABOVE it are skipped —
+    the at-least-once fencing guard (a fenced zombie incarnation saves
+    its checkpoint BEFORE its report frame, so at requeue time the newest
+    valid generation can be one the driver never saw reported; restoring
+    it would skip that report forever).  Callers pass the trial's last
+    REPORTED iteration."""
     for step, path, kind in reversed(list_generations(directory)):
+        if max_step is not None and step > max_step:
+            continue
         if kind == "sharded":
             if fmt.is_committed(path):
                 return path, step
         elif _legacy().verify_checkpoint(path):
             return path, step
     return None, 0
+
+
+# Quarantined generations are renamed under this prefix: the name no
+# longer matches MSGPACK_RE / GEN_RE, so every generation walk (restore
+# fallback, retention, resume discovery) is blind to them — but the bytes
+# stay on storage for forensics until retention-by-hand removes them.
+QUARANTINE_PREFIX = "fenced"
+
+
+def quarantine_generations_above(
+    directory: str, step: int, tag: str = "", log=None
+) -> int:
+    """Rename (quarantine) every generation with step > ``step``.
+
+    The at-least-once fencing fix (docs/operations.md): when a trial is
+    requeued off a fenced/expired incarnation, any checkpoint NEWER than
+    its last reported iteration was written by the zombie for an epoch
+    the driver never processed.  Left in place, a later corruption
+    fallback — or the requeue's own newest-valid scan — could restore
+    past the last report and the retry would never re-report that epoch.
+    Renaming moves them out of every generation pattern while keeping the
+    bytes for forensics.  Storage backends have no rename, so this is
+    copy+delete per file — on the driver, off the hot path.  Returns the
+    number of generations quarantined.
+    """
+    emit = log or (lambda msg: print(f"[ckpt] {msg}", flush=True))
+    backend, d = get_storage(directory)
+    suffix = f".{tag}" if tag else ""
+    count = 0
+    for gstep, full, kind in list_generations(directory):
+        if gstep <= step:
+            continue
+        base = posixpath.basename(full.rstrip("/"))
+        dest = backend.join(d, f"{QUARANTINE_PREFIX}{suffix}.{base}")
+        if kind == "msgpack":
+            data = backend.read_bytes(full)
+            if data is not None:
+                backend.write_bytes(dest, data)
+            man = _legacy().manifest_path_for(full)
+            mdata = backend.read_bytes(man)
+            if mdata is not None:
+                backend.write_bytes(
+                    _legacy().manifest_path_for(dest), mdata
+                )
+            backend.delete(man)
+            backend.delete(full)
+        else:
+            # Sharded generation: drop the COMMIT first so a racing
+            # reader sees "uncommitted" (= nonexistent), never torn.
+            names = fmt.list_files(full)
+            ordered = sorted(
+                names, key=lambda n: (n != fmt.COMMIT_NAME, n)
+            )
+            for name in ordered:
+                src_p = backend.join(full, name)
+                data = backend.read_bytes(src_p)
+                if data is not None:
+                    backend.write_bytes(backend.join(dest, name), data)
+                backend.delete(src_p)
+            import os as _os
+
+            if _os.path.isdir(full):  # local scheme: clear the empty dir
+                try:
+                    _os.rmdir(full)
+                except OSError:
+                    pass
+        emit(
+            f"quarantined unreported generation {base} (step {gstep} > "
+            f"last reported {step}) -> {posixpath.basename(dest)}"
+        )
+        count += 1
+    if count:
+        get_metrics().add("generations_quarantined", count)
+    return count
 
 
 def restore_with_fallback(

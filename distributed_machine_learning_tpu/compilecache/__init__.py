@@ -41,6 +41,7 @@ from distributed_machine_learning_tpu.compilecache.counters import (
 from distributed_machine_learning_tpu.compilecache.keys import (
     NON_STRUCTURAL_KEYS,
     program_key,
+    sharded_program_key,
     shape_class_fingerprint,
 )
 from distributed_machine_learning_tpu.compilecache.tracker import (
@@ -72,6 +73,7 @@ __all__ = [
     "install_artifacts",
     "pack_artifacts",
     "program_key",
+    "sharded_program_key",
     "shape_class_fingerprint",
     "snapshot_cache_dir",
     "state_block",

@@ -156,12 +156,17 @@ class ExecutableCache:
         *args,
         static_argnums: Sequence[int] = (),
         donate_argnums: Sequence[int] = (),
+        jit_kwargs: Optional[Dict[str, Any]] = None,
     ) -> Callable:
         """Resolve ``key`` to a callable executable for ``fn(*args)``.
 
         ``args`` are example arguments of the exact shapes/dtypes the
         program will be called with (they are only traced/lowered on a
-        miss, never executed)."""
+        miss, never executed).  ``jit_kwargs`` passes extra ``jax.jit``
+        options through (``in_shardings``/``out_shardings`` for programs
+        compiled under a named mesh) — they shape the executable, so the
+        caller's ``key`` must already encode them
+        (``keys.sharded_program_key``)."""
         counters = get_counters()
         with self._lock:
             entry = self._mem.get(key)
@@ -174,32 +179,33 @@ class ExecutableCache:
             counters.add("program_hits")
             counters.add("aot_imports")
             entry = self._remember(key, compiled, fn, static_argnums,
-                                   donate_argnums)
+                                   donate_argnums, jit_kwargs)
             return self._wrap(key, entry)
 
         counters.add("program_misses")
-        jitted = self._jit(fn, static_argnums, donate_argnums)
+        jitted = self._jit(fn, static_argnums, donate_argnums, jit_kwargs)
         compiled = jitted.lower(*args).compile()
         if self._export_to_disk(key, compiled):
             counters.add("aot_exports")
         else:
             counters.add("aot_unsupported")
         entry = self._remember(key, compiled, fn, static_argnums,
-                               donate_argnums)
+                               donate_argnums, jit_kwargs)
         return self._wrap(key, entry)
 
     @staticmethod
-    def _jit(fn, static_argnums, donate_argnums):
+    def _jit(fn, static_argnums, donate_argnums, jit_kwargs=None):
         import jax
 
-        kwargs = {}
+        kwargs = dict(jit_kwargs or {})
         if static_argnums:
             kwargs["static_argnums"] = tuple(static_argnums)
         if donate_argnums:
             kwargs["donate_argnums"] = tuple(donate_argnums)
         return jax.jit(fn, **kwargs)
 
-    def _remember(self, key, compiled, fn, static_argnums, donate_argnums):
+    def _remember(self, key, compiled, fn, static_argnums, donate_argnums,
+                  jit_kwargs=None):
         # The fallback is built lazily: a plain jit of the original fn, used
         # only if the AOT executable ever rejects its arguments (dtype /
         # weak-type drift between the exporting and importing process).
@@ -207,7 +213,8 @@ class ExecutableCache:
 
         def fallback(*call_args):
             if entry.fallback is None:
-                entry.fallback = self._jit(fn, static_argnums, donate_argnums)
+                entry.fallback = self._jit(fn, static_argnums,
+                                           donate_argnums, jit_kwargs)
             return entry.fallback(*call_args)
 
         entry.make_fallback = fallback

@@ -49,6 +49,11 @@ class CompileCounters:
         "prewarmed_spawns",
         "cold_spawns",
         "prewarm_compiles",
+        # Donation audit (sharded trainable): donated inputs of the fused
+        # epoch program OBSERVED consumed after its first call — runtime
+        # proof the buffer alias took effect, not just that donate_argnums
+        # was requested (docs/performance.md donation audit table).
+        "donation_aliased_buffers",
     )
 
     def __init__(self):

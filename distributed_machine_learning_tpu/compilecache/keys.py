@@ -102,3 +102,40 @@ def program_key(
     }
     blob = json.dumps(payload, sort_keys=True, separators=(",", ":"))
     return "pk_" + hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+
+def sharded_program_key(
+    config: Dict[str, Any],
+    *,
+    mesh_shape: Dict[str, int],
+    rules_fingerprint: str,
+    batch_shape: Optional[Sequence[Sequence[int]]] = None,
+    dtype: Optional[str] = None,
+    donation: Sequence[int] = (),
+    extra: Optional[Dict[str, Any]] = None,
+) -> str:
+    """:func:`program_key` for a program compiled under a named mesh.
+
+    Two additional identities fold into the key because the compiler
+    splits on both: the **mesh shape** (``{"dp": 2, "tp": 4}`` and
+    ``{"dp": 4, "tp": 2}`` lower to different collectives even over the
+    same 8 devices) and the **partition-rule fingerprint**
+    (``parallel.partition.rules_fingerprint`` — a rule-table edit changes
+    every layout the traced program bakes in).  With these in the key,
+    sharded programs AOT-cache and cross-worker-dedup exactly like
+    unsharded ones: same mesh shape + same rule table on another worker
+    ⇒ artifact fetch, anything else ⇒ honest recompile.
+    """
+    merged = {
+        "mesh_shape": {str(k): int(v) for k, v in (mesh_shape or {}).items()},
+        "rules_fp": str(rules_fingerprint),
+    }
+    if extra:
+        merged.update(extra)
+    return program_key(
+        config,
+        batch_shape=batch_shape,
+        dtype=dtype,
+        donation=donation,
+        extra=merged,
+    )

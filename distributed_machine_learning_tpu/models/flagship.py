@@ -1,0 +1,153 @@
+"""The sharded flagship: a config that CANNOT fit one device.
+
+ROADMAP item 1's proof obligation — "a flagship config that cannot fit one
+chip's HBM trains end to end through tune.run on a 2-D mesh" — needs the
+claim to be *checkable*, not asserted: :func:`param_opt_bytes` prices a
+config's parameter + optimizer state via ``jax.eval_shape`` (pure shape
+math, nothing allocated), :func:`single_chip_hbm_bytes` reads the device's
+budget, and :func:`flagship_sharded_config` grows ``d_model`` by doublings
+until the price exceeds the budget — so the returned config provably needs
+the mesh it asks for.  Tests assert ``param_opt_bytes(cfg) >
+single_chip_hbm_bytes()`` instead of trusting a hand-picked shape.
+
+On the CPU test platform the 8 virtual devices share host RAM, so the
+"HBM" budget is a virtual one (``DML_CPU_DEVICE_BUDGET_BYTES``, default
+8 MiB) — small enough that the derived flagship trains in seconds in
+tier-1 while still exercising the exact code path: params + adam moments
+genuinely exceed the per-device budget and only the dp×tp layout spreads
+them.  On TPU the budget is the real per-chip HBM (``memory_stats`` when
+the runtime exposes it, a per-generation fallback otherwise) and the same
+derivation yields a multi-billion-parameter config for the bench
+``sharded_flagship`` section.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+# Per-chip HBM when the runtime exposes no memory_stats: v2/v3 8/16 GiB
+# cores, v4 32 GiB, v5e 16 GiB — 16 GiB is the safe middle.  The CPU test
+# platform gets a deliberately tiny VIRTUAL budget (see module docstring).
+_TPU_HBM_FALLBACK_BYTES = 16 << 30
+_CPU_VIRTUAL_BUDGET_BYTES = 8 << 20
+
+
+def single_chip_hbm_bytes(device=None) -> int:
+    """The accelerator-memory budget of one device, in bytes."""
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    platform = getattr(device, "platform", "cpu")
+    if platform == "cpu":
+        return int(
+            os.environ.get(
+                "DML_CPU_DEVICE_BUDGET_BYTES", _CPU_VIRTUAL_BUDGET_BYTES
+            )
+        )
+    try:
+        stats = device.memory_stats()
+        limit = int(stats.get("bytes_limit", 0))
+        if limit > 0:
+            return limit
+    except Exception:  # noqa: BLE001 - not every runtime exposes stats
+        pass
+    return _TPU_HBM_FALLBACK_BYTES
+
+
+def param_opt_bytes(config: Dict[str, Any], features: int = 16,
+                    optimizer: Optional[str] = None) -> int:
+    """Parameter + optimizer-state bytes of ``config``, by shape math only.
+
+    ``jax.eval_shape`` traces ``model.init`` and ``tx.init`` abstractly —
+    no array is ever materialized, so pricing a 100 GiB config costs
+    milliseconds (safe to call in tests and at trainable startup).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_machine_learning_tpu.models import build_model
+    from distributed_machine_learning_tpu.ops.optimizers import make_optimizer
+
+    model = build_model(dict(config, mesh=None))
+    sample = jax.ShapeDtypeStruct(
+        (1, int(config.get("max_seq_length", 64)), int(features)),
+        jnp.float32,
+    )
+
+    def init(x):
+        return model.init(
+            {"params": jax.random.key(0), "dropout": jax.random.key(1)},
+            x, deterministic=True,
+        )
+
+    variables = jax.eval_shape(init, sample)
+    params = variables["params"]
+    tx = make_optimizer(
+        str(optimizer or config.get("optimizer", "adam")),
+        learning_rate=1e-3,
+    )
+    opt_state = jax.eval_shape(tx.init, params)
+
+    def nbytes(tree) -> int:
+        return sum(
+            int(np.prod(leaf.shape, dtype=np.int64)) * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(tree)
+            if hasattr(leaf, "shape")
+        )
+
+    return nbytes(params) + nbytes(opt_state)
+
+
+def flagship_sharded_config(
+    budget_bytes: Optional[int] = None,
+    *,
+    mesh_shape: Optional[Dict[str, int]] = None,
+    seq_len: int = 16,
+    features: int = 16,
+    batch_size: int = 32,
+    num_layers: int = 2,
+    max_d_model: int = 1 << 15,
+) -> Dict[str, Any]:
+    """The smallest power-of-two ``d_model`` transformer whose params +
+    adam moments exceed ``budget_bytes`` (default: this platform's
+    :func:`single_chip_hbm_bytes`), configured for a 2-D (dp, tp) mesh.
+
+    The returned dict is a complete trial config for
+    ``tune.train_sharded_regressor`` — callers add data-dependent keys
+    (``num_epochs``, lr) and pass ``resources_per_trial`` matching
+    ``mesh_shape`` (default ``{"dp": 2, "tp": 4}``, the 8-device tier-1
+    mesh).  Raises if no ``d_model`` up to ``max_d_model`` exceeds the
+    budget — a mis-set budget must fail loudly, not silently return a
+    config that fits one chip.
+    """
+    if budget_bytes is None:
+        budget_bytes = single_chip_hbm_bytes()
+    mesh_shape = dict(mesh_shape or {"dp": 2, "tp": 4})
+    d_model = 64
+    while d_model <= max_d_model:
+        config = {
+            "model": "transformer",
+            "d_model": d_model,
+            "num_heads": 8,
+            "num_layers": num_layers,
+            "dim_feedforward": 4 * d_model,
+            "dropout": 0.0,
+            "max_seq_length": seq_len,
+            "batch_size": batch_size,
+            "optimizer": "adam",
+            "mesh_shape": mesh_shape,
+            # Remat keeps the per-block activation footprint O(1) blocks —
+            # the knob that makes the over-budget config schedulable at
+            # all on real HBM (dots_saveable: recompute elementwise only).
+            "remat": True,
+            "remat_policy": "dots_saveable",
+        }
+        if param_opt_bytes(config, features=features) > budget_bytes:
+            return config
+        d_model *= 2
+    raise ValueError(
+        f"no d_model <= {max_d_model} exceeds budget_bytes={budget_bytes}"
+    )

@@ -25,7 +25,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_machine_learning_tpu.models.moe import MoEFF
 from distributed_machine_learning_tpu.ops.attention import (
@@ -42,6 +42,66 @@ ATTENTION_TYPES = (
     "blockwise",
     "flash",
 )
+
+
+def resolve_remat_policy(name):
+    """A ``jax.checkpoint_policies`` policy from its config name.
+
+    Accepted: None/""/"none" (no policy — full remat when remat is on) or
+    any attribute of ``jax.checkpoint_policies`` ("dots_saveable",
+    "nothing_saveable", "everything_saveable",
+    "dots_with_no_batch_dims_saveable", ...).  The knob that trades
+    recompute FLOPs against activation HBM per block — wired from
+    ``config["remat_policy"]`` (docs/performance.md).
+    """
+    if name is None or name in ("", "none", False):
+        return None
+    policy = getattr(jax.checkpoint_policies, str(name), None)
+    if policy is None:
+        valid = sorted(
+            n for n in dir(jax.checkpoint_policies) if not n.startswith("_")
+        )
+        raise ValueError(
+            f"Unknown remat policy {name!r}; expected one of {valid}"
+        )
+    return policy
+
+
+def activation_spec(mesh: Mesh, shape, *axes) -> P:
+    """A per-dim mesh-axis intent cleaned against an activation's shape:
+    axes the mesh lacks or whose size does not divide the dim drop to None
+    (same reconciliation rule as ``parallel.partition.clean_spec``,
+    duplicated here so the model zoo never imports the parallel package at
+    module level)."""
+    cleaned = []
+    for dim, axis in zip(shape, axes):
+        if (
+            axis is None
+            or mesh is None
+            or axis not in mesh.axis_names
+            or int(dim) % int(mesh.shape[axis]) != 0
+        ):
+            cleaned.append(None)
+        else:
+            cleaned.append(axis)
+    return P(*cleaned)
+
+
+def constrain_activation(x: jnp.ndarray, mesh: Optional[Mesh], *axes):
+    """Pin an activation's layout at a block boundary (residual stream,
+    attention q/k/v) with ``with_sharding_constraint``.
+
+    Without the pin, GSPMD is free to resolve the layout from whichever
+    neighboring op it propagates first — on dp×tp meshes that can
+    materialize a replicated [B, S, H, D] attention intermediate or bounce
+    the residual stream through an unnecessary all-gather.  No-op without
+    a mesh (single-device / unsharded paths build models with mesh=None).
+    """
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, activation_spec(mesh, x.shape, *axes))
+    )
 
 
 def _on_tpu() -> bool:
@@ -231,6 +291,21 @@ class MultiHeadAttention(nn.Module):
         q = proj("query", self.num_heads)
         k = proj("key", kv_heads)
         v = proj("value", kv_heads)
+        if self.seq_axis is None:
+            # Attention-boundary pins (dp×tp meshes): heads over head_axis,
+            # batch over batch_axis — with head-sharded projection kernels
+            # this keeps the whole attention block head-local so GSPMD
+            # never materializes a replicated [B, S, H, D] intermediate.
+            # The seq-parallel paths (ring/ulysses) own their layouts.
+            q = constrain_activation(
+                q, self.mesh, self.batch_axis, None, self.head_axis, None
+            )
+            k = constrain_activation(
+                k, self.mesh, self.batch_axis, None, self.head_axis, None
+            )
+            v = constrain_activation(
+                v, self.mesh, self.batch_axis, None, self.head_axis, None
+            )
 
         def full_kv(k, v):
             # Broadcast each kv head over its query group for paths WITHOUT
@@ -485,6 +560,11 @@ class EncoderLayer(nn.Module):
         )(x, deterministic=deterministic)
         attn = StochasticDepth(self.stochastic_depth_rate)(attn, deterministic)
         x = nn.LayerNorm(name="norm1", dtype=self.dtype)(x + attn)
+        # Residual-stream pin: batch over dp (seq over sp when used),
+        # d_model replicated — the Megatron layout the TP rules assume.
+        x = constrain_activation(
+            x, self.mesh, self.batch_axis, self.seq_axis, None
+        )
 
         ff_type = self.feedforward_type or (
             "depthwise_separable" if self.depthwise_separable_conv else "linear"
@@ -520,4 +600,7 @@ class EncoderLayer(nn.Module):
             )
         ff = nn.Dropout(self.dropout_rate)(ff, deterministic=deterministic)
         ff = StochasticDepth(self.stochastic_depth_rate)(ff, deterministic)
-        return nn.LayerNorm(name="norm2", dtype=self.dtype)(x + ff)
+        out = nn.LayerNorm(name="norm2", dtype=self.dtype)(x + ff)
+        return constrain_activation(
+            out, self.mesh, self.batch_axis, self.seq_axis, None
+        )

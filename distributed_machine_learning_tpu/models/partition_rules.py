@@ -1,0 +1,106 @@
+"""Per-model-family partition-rule tables.
+
+Supersedes the era when ``TRANSFORMER_TP_RULES`` was the ONLY spec table:
+every model family registers its own rule list here (first match wins,
+``re.search`` semantics — ``parallel/partition.py``; the transformer entry
+re-exports the canonical table from ``parallel/sharding.py``, whose layer
+owns no model imports), and the sharded trainable / bench / ckpt surfaces
+resolve the table from the trial config via :func:`rules_for`.  Adding a
+family = registering a table, not editing the trainable.
+
+Rule anatomy (docs/performance.md "Partition rules, donation, and remat"):
+shard the two big matmuls of each block column-then-row over ``tp`` so one
+reduce per block suffices; shard MoE expert stacks over ``ep``; replicate
+everything small (norms, biases that would cut against their dim,
+routers).  Specs are intent — ``partition.clean_spec`` drops axes the
+actual mesh/leaf cannot honor, so one table serves every mesh shape.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+from jax.sharding import PartitionSpec as P
+
+from distributed_machine_learning_tpu.parallel.partition import (
+    RuleList,
+    rules_fingerprint,
+)
+from distributed_machine_learning_tpu.parallel.sharding import (
+    TRANSFORMER_TP_RULES,
+)
+
+TRANSFORMER_RULES = TRANSFORMER_TP_RULES
+
+# MLP: column/row-alternate the big Dense kernels.  Written in the
+# TUPLE-PATH dialect (component regexes) — exercising the second rule
+# dialect on a real table keeps the parity golden tests honest.
+MLP_RULES: Tuple = (
+    (("Dense_0", "kernel"), P(None, "tp")),
+    (("Dense_1", "kernel"), P("tp", None)),
+    ((r"Dense_\d+", "bias"), P()),
+    (r".*", P()),
+)
+
+# Conv families: channel dims are small relative to tp on realistic
+# meshes; replicate (dp carries the parallelism).  Dense heads column-
+# shard where divisible.
+CNN_RULES: Tuple = (
+    (r"Dense_0/kernel$", P(None, "tp")),
+    (r".*", P()),
+)
+
+RNN_RULES: Tuple = (
+    (r".*", P()),
+)
+
+RESNET_RULES: Tuple = (
+    (r".*", P()),
+)
+
+# family name (models.build_model's config["model"]) -> rule table
+PARTITION_RULE_TABLES: Dict[str, RuleList] = {
+    "transformer": TRANSFORMER_RULES,
+    "simple_transformer": TRANSFORMER_RULES,
+    "mlp": MLP_RULES,
+    "cnn1d": CNN_RULES,
+    "rnn": RNN_RULES,
+    "resnet18": RESNET_RULES,
+}
+
+DEFAULT_RULES: RuleList = ((r".*", P()),)
+
+
+def register_partition_rules(family: str, rules: RuleList) -> None:
+    """Register (or replace) a family's rule table."""
+    PARTITION_RULE_TABLES[str(family)] = tuple(rules)
+
+
+def rules_for(config: Dict[str, Any]) -> RuleList:
+    """The rule table a trial config's model family shards under.
+
+    ``config["partition_rules"]`` overrides per trial (a list of
+    ``(pattern, spec-as-list)`` pairs is accepted for JSON-carried
+    configs); otherwise the family registry decides, falling back to
+    replicate-everything for unknown families.
+    """
+    override = config.get("partition_rules")
+    if override is not None:
+        from distributed_machine_learning_tpu.parallel.partition import (
+            spec_from_jsonable,
+        )
+
+        out = []
+        for pattern, spec in override:
+            if not isinstance(spec, P):
+                spec = spec_from_jsonable(spec)
+            out.append((pattern, spec))
+        return tuple(out)
+    family = str(config.get("model", "transformer"))
+    return PARTITION_RULE_TABLES.get(family, DEFAULT_RULES)
+
+
+def rules_fingerprint_for(config: Dict[str, Any]) -> str:
+    """Stable fingerprint of the table :func:`rules_for` resolves —
+    compile-key material (``compilecache.keys.sharded_program_key``)."""
+    return rules_fingerprint(rules_for(config))

@@ -25,6 +25,7 @@ from jax.sharding import Mesh
 from distributed_machine_learning_tpu.models.layers import (
     EncoderLayer,
     PositionalEncoding,
+    resolve_remat_policy,
 )
 
 
@@ -112,6 +113,13 @@ class TransformerRegressor(nn.Module):
     # extra FLOPs. The knob that fits long-context/big-batch configs into
     # HBM; numerics are identical (tested).
     remat: bool = False
+    # Remat POLICY (jax.checkpoint_policies name, e.g. "dots_saveable"):
+    # with remat on, selects which intermediates each block may keep —
+    # "dots_saveable" keeps matmul outputs (recompute only the cheap
+    # elementwise ops), "nothing_saveable" is the full-recompute default.
+    # The HBM-vs-FLOPs dial for the sharded flagship (config key
+    # "remat_policy"; docs/performance.md).
+    remat_policy: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
@@ -167,11 +175,14 @@ class TransformerRegressor(nn.Module):
         # inside the backward instead of keeping its activations live.
         # deterministic is argnum 2 (self counts) and must be STATIC —
         # Dropout branches on it in Python, which a traced bool would break.
+        remat_kwargs = dict(static_argnums=(2,))
+        if self.remat and self.remat_policy:
+            remat_kwargs["policy"] = resolve_remat_policy(self.remat_policy)
         if self.shared_weights:
             # ALBERT-style: one EncoderLayer parameter set applied num_layers
             # times, rolled with nn.scan so XLA compiles the body once.
             body = (
-                nn.remat(_ScanEncoderBody, static_argnums=(2,))
+                nn.remat(_ScanEncoderBody, **remat_kwargs)
                 if self.remat else _ScanEncoderBody
             )
             ScanLayer = nn.scan(
@@ -189,7 +200,7 @@ class TransformerRegressor(nn.Module):
             )
         else:
             Layer = (
-                nn.remat(EncoderLayer, static_argnums=(2,))
+                nn.remat(EncoderLayer, **remat_kwargs)
                 if self.remat else EncoderLayer
             )
             for i in range(self.num_layers):

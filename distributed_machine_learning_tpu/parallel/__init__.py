@@ -14,6 +14,13 @@ from distributed_machine_learning_tpu.parallel.mesh import (
     replicated,
 )
 from distributed_machine_learning_tpu.parallel import multihost
+from distributed_machine_learning_tpu.parallel.partition import (
+    clean_spec,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+    rules_fingerprint,
+    shardings_from_rules,
+)
 from distributed_machine_learning_tpu.parallel.pipeline import (
     make_stacked_stage_fn,
     pipeline_apply,
@@ -27,7 +34,9 @@ from distributed_machine_learning_tpu.parallel.sharding import (
 )
 from distributed_machine_learning_tpu.parallel.train_step import (
     make_data_parallel_eval,
+    make_fused_epoch_step,
     make_sharded_train_step,
+    resolve_remat_policy,
 )
 from distributed_machine_learning_tpu.parallel.ulysses import ulysses_attention
 
@@ -46,6 +55,13 @@ __all__ = [
     "TRANSFORMER_TP_RULES",
     "param_shardings",
     "shard_params",
+    "clean_spec",
+    "make_shard_and_gather_fns",
+    "match_partition_rules",
+    "rules_fingerprint",
+    "shardings_from_rules",
     "make_data_parallel_eval",
+    "make_fused_epoch_step",
     "make_sharded_train_step",
+    "resolve_remat_policy",
 ]

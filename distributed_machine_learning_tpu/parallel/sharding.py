@@ -1,97 +1,100 @@
-"""Parameter/activation sharding rules (Megatron-style tensor parallelism).
+"""Parameter/activation sharding over the (dp, sp, tp, ep) mesh.
 
-Rules map flax param paths to PartitionSpecs over the (dp, sp, tp) mesh:
+Since the partition-rule layer landed (``parallel/partition.py``), this
+module is the thin param/opt-state surface over it: rule lists live in
+``models/partition_rules.py`` (one table per model family — the
+``TRANSFORMER_TP_RULES`` name re-exports the transformer table), matching
+and spec-cleaning are :func:`partition.match_partition_rules` /
+:func:`partition.clean_spec` (``re.search`` semantics, first match wins,
+scalars never partition).
 
-* attention q/k/v DenseGeneral kernels  [d_model, heads, head_dim] -> shard
-  heads on ``tp`` (each core owns a head group; attention is embarrassingly
-  parallel over heads, no collective inside the core attention op);
-* attention out kernel [heads, head_dim, d_model] -> shard heads on ``tp``
-  (row-parallel; XLA inserts the psum on the output);
-* feed-forward in kernel [d_model, dim_ff] -> column-parallel on ``tp``;
-  feed-forward out kernel [dim_ff, d_model] -> row-parallel on ``tp``;
-* embeddings/projections/norms/heads -> replicated.
-
-This is the standard 1D-TP recipe (shard the two big matmuls of each block
-column-then-row so only one reduce per block is needed); XLA GSPMD propagates
-the activation shardings and places the collectives on ICI.
+The transformer recipe itself is unchanged (standard 1D TP): shard
+attention q/k/v heads and the FF column/row pair over ``tp`` so each block
+needs one reduce; XLA GSPMD propagates activation shardings and places the
+collectives on ICI.
 """
 
 from __future__ import annotations
 
-import re
-from typing import Any, Tuple
+from typing import Any
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# (path regex, spec) — first match wins. Paths look like
-# "layer_0/attention/query/kernel" (flax param tree joined with '/').
-TRANSFORMER_TP_RULES: Tuple[Tuple[str, P], ...] = (
-    (r".*attention/(query|key|value)/kernel$", P(None, "tp", None)),
-    (r".*attention/(query|key|value)/bias$", P("tp", None)),
-    (r".*attention/out/kernel$", P("tp", None, None)),
-    (r".*attention/out/bias$", P()),
-    (r".*ff/Dense_0/kernel$", P(None, "tp")),   # column parallel
-    (r".*ff/Dense_0/bias$", P("tp")),
-    (r".*ff/Dense_1/kernel$", P("tp", None)),   # row parallel
-    (r".*ff/Dense_1/bias$", P()),
-    (r".*ff/pointwise/kernel$", P(None, None, "tp")),
-    (r".*ff/pointwise/bias$", P("tp")),
-    (r".*ff/out_proj/kernel$", P("tp", None)),
-    (r".*ff/out_proj/bias$", P()),
+from distributed_machine_learning_tpu.parallel.partition import (
+    clean_spec,
+    match_partition_rules,
+    path_str as _path_str,
+    shardings_from_rules,
+)
+
+# The transformer family table: the 1D-TP recipe over attention heads +
+# the FF column/row pair, MoE expert stacks over 'ep' x 'tp', wide
+# head/input projections sharded where divisible.  ``re.search``
+# semantics, first match wins.  Canonical home is HERE (the parallel
+# layer owns no model imports); ``models/partition_rules.py`` re-exports
+# it as the "transformer" entry of the per-family registry.
+TRANSFORMER_TP_RULES = (
+    (r"attention/(query|key|value)/kernel$", P(None, "tp", None)),
+    (r"attention/(query|key|value)/bias$", P("tp", None)),
+    (r"attention/out/kernel$", P("tp", None, None)),
+    (r"attention/out/bias$", P()),
+    (r"ff/Dense_0/kernel$", P(None, "tp")),   # column parallel
+    (r"ff/Dense_0/bias$", P("tp")),
+    (r"ff/Dense_1/kernel$", P("tp", None)),   # row parallel
+    (r"ff/Dense_1/bias$", P()),
+    (r"ff/pointwise/kernel$", P(None, None, "tp")),
+    (r"ff/pointwise/bias$", P("tp")),
+    (r"ff/out_proj/kernel$", P("tp", None)),
+    (r"ff/out_proj/bias$", P()),
     # MoE expert stacks (models/moe.py): expert dim over 'ep', and the
     # per-expert matmul dims over 'tp' (column-parallel in, row-parallel
     # out) — experts and attention-head groups shard over different axes,
     # so ep x tp runs expert-parallel and tensor-parallel together.
-    (r".*ff/w_in$", P("ep", None, "tp")),
-    (r".*ff/b_in$", P("ep", "tp")),
-    (r".*ff/w_out$", P("ep", "tp", None)),
-    (r".*ff/b_out$", P("ep", None)),
-    (r".*ff/router/.*", P()),  # router is tiny; replicate
+    (r"ff/w_in$", P("ep", None, "tp")),
+    (r"ff/b_in$", P("ep", "tp")),
+    (r"ff/w_out$", P("ep", "tp", None)),
+    (r"ff/b_out$", P("ep", None)),
+    (r"ff/router/", P()),  # router is tiny; replicate
+    # Wide head/input projections (the sharded flagship's d_model-sized
+    # matmuls) shard their d_model dim when divisible; clean_spec
+    # replicates them on meshes where they don't.
+    (r"head/Dense_0/kernel$", P("tp", None)),
+    (r"input_projection/kernel$", P(None, "tp")),
     (r".*", P()),  # everything else replicated
 )
 
 
-def _path_str(path) -> str:
-    parts = []
-    for p in path:
-        parts.append(str(getattr(p, "key", getattr(p, "idx", p))))
-    return "/".join(parts)
-
-
 def partition_spec_for(path: str, rules=TRANSFORMER_TP_RULES) -> P:
+    """First-match spec for one ``'/'``-joined param path (search
+    semantics; unmatched -> replicated)."""
+    from distributed_machine_learning_tpu.parallel.partition import (
+        _pattern_matches,
+    )
+
     for pattern, spec in rules:
-        if re.fullmatch(pattern, path):
+        if _pattern_matches(pattern, path):
             return spec
     return P()
 
 
 def param_shardings(params: Any, mesh: Mesh, rules=TRANSFORMER_TP_RULES):
-    """A pytree of NamedShardings matching ``params``' structure."""
-
-    def assign(path, leaf):
-        spec = partition_spec_for(_path_str(path), rules)
-        # Drop axes the mesh doesn't have / that exceed the leaf's rank.
-        cleaned = []
-        for i, axis in enumerate(spec):
-            if i >= leaf.ndim:
-                break
-            cleaned.append(axis if axis in (None,) or axis in mesh.axis_names else None)
-        # Avoid sharding a dim the axis size doesn't divide.
-        final = []
-        for i, axis in enumerate(cleaned):
-            if axis is not None and leaf.shape[i] % mesh.shape[axis] != 0:
-                axis = None
-            final.append(axis)
-        return NamedSharding(mesh, P(*final))
-
-    return jax.tree_util.tree_map_with_path(assign, params)
+    """A pytree of NamedShardings matching ``params``' structure (rule
+    specs cleaned per leaf: missing mesh axes, excess rank, and
+    non-dividing dims fall back to replication)."""
+    return shardings_from_rules(params, mesh, rules)
 
 
 def shard_params(params: Any, mesh: Mesh, rules=TRANSFORMER_TP_RULES):
     """device_put the param pytree according to the rules."""
     shardings = param_shardings(params, mesh, rules)
     return jax.device_put(params, shardings)
+
+
+def param_partition_specs(params: Any, rules=TRANSFORMER_TP_RULES):
+    """Raw (uncleaned) PartitionSpec pytree for ``params`` — what ckpt/
+    indexes and compile keys record."""
+    return match_partition_rules(rules, params)
 
 
 def opt_state_shardings(opt_shape: Any, p_shardings: Any, mesh: Mesh):
@@ -125,3 +128,15 @@ def opt_state_shardings(opt_shape: Any, p_shardings: Any, mesh: Mesh):
         return replicated
 
     return jax.tree_util.tree_map_with_path(assign, opt_shape)
+
+
+__all__ = [
+    "TRANSFORMER_TP_RULES",
+    "partition_spec_for",
+    "param_shardings",
+    "param_partition_specs",
+    "shard_params",
+    "opt_state_shardings",
+    "clean_spec",
+    "_path_str",
+]

@@ -1,11 +1,25 @@
-"""Sharded training step over a named mesh (dp × sp × tp).
+"""Sharded training programs over a named mesh (dp × sp × tp).
 
-This is the multi-core trial path (SURVEY.md §2c: data parallelism *within a
-trial* — BASELINE.json config 5 — plus tensor/sequence parallelism the
-reference never had).  Design per the standard JAX recipe: pick a mesh,
-annotate param + batch shardings, jit, and let XLA GSPMD insert the
-collectives (psum for row-parallel matmuls and the gradient all-reduce over
-dp; all-gathers where seq-sharded activations meet attention) on ICI.
+Two tiers:
+
+* :func:`make_sharded_train_step` — one jitted step per batch (the
+  original multi-core trial path; kept for callers that drive their own
+  step loop: ring-attention/multihost tests, examples).
+* :func:`make_fused_epoch_step` — the FUSED tier (ISSUE 7): one jitted
+  program runs a whole epoch as ``lax.scan`` over pre-sharded batch
+  chunks, with ``donate_argnums`` covering params, opt-state, AND the
+  epoch's batch arrays — N steps of per-step dispatch collapse to one
+  dispatch + one compile, and the donated batch buffers mean the staged
+  epoch costs no second HBM copy.  Layouts come from a partition-rule
+  table (``models/partition_rules.py``) instead of a hard-coded spec
+  table; ``with_sharding_constraint`` pins the batch layout at the program
+  boundary and the model pins the residual stream/attention activations
+  (``models/layers.py``).
+
+Design per the standard JAX recipe: pick a mesh, annotate param + batch
+shardings, jit, and let XLA GSPMD insert the collectives (psum for
+row-parallel matmuls and the gradient all-reduce over dp; all-gathers
+where seq-sharded activations meet attention) on ICI.
 """
 
 from __future__ import annotations
@@ -24,6 +38,29 @@ from distributed_machine_learning_tpu.parallel.sharding import (
     param_shardings,
     shard_params,
 )
+
+
+def resolve_remat_policy(name) -> Optional[Any]:
+    """A ``jax.checkpoint_policies`` policy from its config name.
+
+    Accepted: None/""/"none" (no policy — full remat when remat is on),
+    or any attribute of ``jax.checkpoint_policies`` ("dots_saveable",
+    "nothing_saveable", "everything_saveable",
+    "dots_with_no_batch_dims_saveable", ...).  The knob that trades
+    recompute FLOPs against activation HBM per block
+    (docs/performance.md).
+    """
+    if name is None or name in ("", "none", False):
+        return None
+    policy = getattr(jax.checkpoint_policies, str(name), None)
+    if policy is None:
+        valid = sorted(
+            n for n in dir(jax.checkpoint_policies) if not n.startswith("_")
+        )
+        raise ValueError(
+            f"Unknown remat policy {name!r}; expected one of {valid}"
+        )
+    return policy
 
 
 def make_sharded_train_step(
@@ -47,13 +84,27 @@ def make_sharded_train_step(
     repl = NamedSharding(mesh, P())
 
     def init_fn(rng, sample_x):
-        variables = model.init(
-            {"params": rng, "dropout": jax.random.fold_in(rng, 1)},
-            sample_x,
-            **{flag_name: True if flag_name == "deterministic" else False},
+        flag = {flag_name: True if flag_name == "deterministic" else False}
+
+        def build(r, x):
+            return model.init(
+                {"params": r, "dropout": jax.random.fold_in(r, 1)}, x, **flag
+            )
+
+        # Born sharded: derive the rule shardings from the ABSTRACT init
+        # (eval_shape allocates nothing) and jit the real init with them
+        # as out_shardings — an over-HBM flagship's params never
+        # materialize unsharded on one device.
+        abstract = jax.eval_shape(build, rng, sample_x)
+        p_shardings = param_shardings(abstract["params"], mesh, rules)
+        repl = NamedSharding(mesh, P())
+        v_shardings = dict(
+            jax.tree_util.tree_map(lambda _: repl, abstract),
+            params=p_shardings,
         )
-        params = shard_params(variables["params"], mesh, rules)
-        p_shardings = param_shardings(params, mesh, rules)
+        params = jax.jit(build, out_shardings=v_shardings)(
+            rng, sample_x
+        )["params"]
 
         # jit the optimizer init with explicit out shardings so the moments
         # inherit the TP layout (without out_shardings, XLA may place the
@@ -94,6 +145,74 @@ def make_sharded_train_step(
         in_shardings=(None, None, x_sharding, y_sharding, repl),
     )
     return init_fn, step_fn
+
+
+def make_fused_epoch_step(
+    model,
+    tx: optax.GradientTransformation,
+    loss_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    mesh: Mesh,
+    rules=TRANSFORMER_TP_RULES,
+    shard_seq: bool = False,
+    flag_name: str = "deterministic",
+):
+    """Returns (init_fn, epoch_fn): the fused tier.
+
+    ``epoch_fn(params, opt_state, xb, yb, epoch_key)`` consumes the whole
+    epoch as ``[num_batches, batch, ...]`` arrays (in-batch dim sharded
+    over ``dp``), scans the train step across them inside ONE jitted
+    program, and returns ``(params, opt_state, mean_loss)``.  Donation
+    covers every large input — params (0), opt_state (1), and both batch
+    arrays (2, 3) — so the epoch runs with zero redundant HBM copies; the
+    donated batch is consumed exactly once per epoch by construction.
+    """
+    seq_axis = "sp" if (shard_seq and "sp" in mesh.axis_names) else None
+    xb_sharding = NamedSharding(mesh, P(None, "dp", seq_axis))
+    yb_sharding = NamedSharding(mesh, P(None, "dp"))
+    repl = NamedSharding(mesh, P())
+    init_fn, _ = make_sharded_train_step(
+        model, tx, loss_fn, mesh, rules=rules, shard_seq=shard_seq,
+        flag_name=flag_name,
+    )
+
+    def _epoch(params, opt_state, xb, yb, epoch_key):
+        xb = jax.lax.with_sharding_constraint(xb, xb_sharding)
+        yb = jax.lax.with_sharding_constraint(yb, yb_sharding)
+
+        def step(carry, batch):
+            params, opt_state, i = carry
+            x, y = batch
+            rng = jax.random.fold_in(epoch_key, i)
+
+            def loss_of(p):
+                preds, mut = model.apply(
+                    {"params": p},
+                    x,
+                    rngs={"dropout": rng},
+                    mutable=["moe"],
+                    **{
+                        flag_name: False
+                        if flag_name == "deterministic" else True
+                    },
+                )
+                return loss_fn(preds.astype(jnp.float32), y) + collect_aux(mut)
+
+            loss, grads = jax.value_and_grad(loss_of)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state, i + 1), loss
+
+        (params, opt_state, _), losses = jax.lax.scan(
+            step, (params, opt_state, jnp.int32(0)), (xb, yb)
+        )
+        return params, opt_state, losses.mean()
+
+    epoch_fn = jax.jit(
+        _epoch,
+        donate_argnums=(0, 1, 2, 3),
+        in_shardings=(None, None, xb_sharding, yb_sharding, repl),
+    )
+    return init_fn, epoch_fn
 
 
 def make_data_parallel_eval(

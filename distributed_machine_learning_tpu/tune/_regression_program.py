@@ -222,7 +222,8 @@ def _call_lacks_deterministic(model) -> bool:
     return "deterministic" not in params
 
 
-def detect_call_convention(model, sample_x, init_rngs=None):
+def detect_call_convention(model, sample_x, init_rngs=None,
+                           abstract=False):
     """Init the model and learn (variables, train-flag kwarg name).
 
     The init is jitted: eager ``model.init`` dispatches hundreds of tiny ops
@@ -231,14 +232,24 @@ def detect_call_convention(model, sample_x, init_rngs=None):
     a traced ARGUMENT, so trials with different ``init_rngs`` (per-trial
     init diversity — the reference's torch trials each start from their own
     random init) share one compiled init program.
+
+    ``abstract=True`` runs the probe under ``jax.eval_shape`` instead:
+    ``variables`` come back as ShapeDtypeStructs and NOTHING is allocated —
+    the sharded trainable uses this to derive partition-rule shardings
+    BEFORE the real init, so an over-HBM flagship's params are born sharded
+    (a concrete unsharded init would be the OOM).
     """
     rng = init_rngs or {
         "params": jax.random.key(0), "dropout": jax.random.key(1)
     }
+
+    def run(f):
+        if abstract:
+            return jax.eval_shape(f, rng, sample_x)
+        return jax.jit(f)(rng, sample_x)
+
     try:
-        variables = jax.jit(
-            lambda r, x: model.init(r, x, deterministic=True)
-        )(rng, sample_x)
+        variables = run(lambda r, x: model.init(r, x, deterministic=True))
         return variables, "deterministic"
     except TypeError as exc:
         # Only a rejected 'deterministic' kwarg means "wrong convention".
@@ -258,7 +269,5 @@ def detect_call_convention(model, sample_x, init_rngs=None):
         )
         if not mentions_flag and not _call_lacks_deterministic(model):
             raise
-        variables = jax.jit(
-            lambda r, x: model.init(r, x, train=False)
-        )(rng, sample_x)
+        variables = run(lambda r, x: model.init(r, x, train=False))
         return variables, "train"

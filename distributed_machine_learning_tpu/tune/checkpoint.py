@@ -214,18 +214,37 @@ def find_latest_checkpoint(directory: str):
     return latest_generation(directory)
 
 
-def newest_valid_checkpoint(directory: str):
+def newest_valid_checkpoint(directory: str, max_iteration=None):
     """(path, iteration) of the newest generation that PASSES its
     integrity check, or (None, 0).  The restore target for trials requeued
     off a silent worker (cluster lease expiry / stall fencing): the lost
     incarnation may have died mid-write, so the newest entry on disk is
     not necessarily a loadable one — sharded generations must be COMMITTED
-    and checksum-clean, msgpack blobs must match their manifest."""
+    and checksum-clean, msgpack blobs must match their manifest.
+    ``max_iteration`` skips generations above it (the at-least-once
+    fencing guard — see ``quarantine_unreported``)."""
     from distributed_machine_learning_tpu.ckpt.manager import (
         newest_valid_generation,
     )
 
-    return newest_valid_generation(directory)
+    return newest_valid_generation(directory, max_step=max_iteration)
+
+
+def quarantine_unreported(directory: str, last_reported_iteration: int,
+                          tag: str = "", log=None) -> int:
+    """Rename every generation newer than ``last_reported_iteration`` out
+    of the generation namespace (prefix ``fenced[.tag].``) — they were
+    written by a fenced/expired incarnation for epochs whose reports never
+    reached the driver, and restoring one would skip those reports forever
+    (the at-least-once fencing race, docs/operations.md).  Returns the
+    count quarantined; bytes stay on storage for forensics."""
+    from distributed_machine_learning_tpu.ckpt.manager import (
+        quarantine_generations_above,
+    )
+
+    return quarantine_generations_above(
+        directory, last_reported_iteration, tag=tag, log=log
+    )
 
 
 def cleanup_uncommitted(directory: str, log=None) -> int:

@@ -547,9 +547,19 @@ def _serve_driver_connection(
         dbg(f"recv {mtype} {msg.get('trial_id', '')}")
         if mtype == "run_trial":
             # Round-robin device assignment by slot index keeps concurrent
-            # trials on distinct cores.
+            # trials on distinct cores.  A mesh trial (num_devices > 1)
+            # takes a contiguous GROUP of local devices — contiguous
+            # enumeration order is ICI-adjacent on TPU (same preference as
+            # DeviceManager._pick_adjacent); start workers with
+            # slots = len(devices) // num_devices so groups never overlap.
             slot = int(msg.get("slot", 0))
-            dev = [devices[slot % len(devices)]]
+            n = max(int(msg.get("num_devices", 1)), 1)
+            if n <= 1:
+                dev = [devices[slot % len(devices)]]
+            else:
+                groups = max(len(devices) // n, 1)
+                g = slot % groups
+                dev = devices[g * n:(g + 1) * n] or devices[:n]
             threading.Thread(
                 target=_worker_run_trial,
                 args=(state, msg, dev),
@@ -800,6 +810,7 @@ def run_distributed(
     keep_checkpoints_num: int = 0,
     checkpoint_storage: Optional[str] = None,
     checkpoint_format: str = "msgpack",
+    mesh_shape: Optional[Dict[str, int]] = None,
     elastic_listen: Union[str, socket.socket, None] = None,
     artifact_origin: Union[bool, "ArtifactRegistry"] = True,
     resume: bool = False,
@@ -850,6 +861,14 @@ def run_distributed(
     worker writes per-shard chunk files + an atomic COMMIT marker, so a
     worker preempted mid-save never leaves a half-visible checkpoint and
     requeue lands on the newest COMMITTED generation.
+    ``mesh_shape``: sweep-wide per-trial device mesh (same knob as
+    ``tune.run``), e.g. ``{"dp": 2, "tp": 2}`` — stamped into every
+    sampled config, and each dispatch asks its worker for the mesh's
+    total device count: the worker assigns that many distinct local
+    devices to the trial's slot group (start workers with
+    ``slots = len(devices) // prod(mesh_shape)`` so slot groups never
+    overlap).  The sharded trainable then builds the named mesh from the
+    model family's partition rules (``models/partition_rules.py``).
     ``stop`` / ``points_to_evaluate``: same surface as ``tune.run`` (dict /
     callable / Stopper; warm-start configs run first).
     ``callbacks`` / ``verbose=2``: the same observer surface as ``tune.run``
@@ -1070,6 +1089,7 @@ def run_distributed(
         "silent_worker_requeues": 0,
         "fenced_frames": 0,
         "worker_reconnects": 0,
+        "quarantined_checkpoints": 0,
     }
 
     lifecycle = TrialLifecycle(
@@ -1088,6 +1108,9 @@ def run_distributed(
         # the local process executor, runner.py).
         time_limit_per_trial_s=time_limit_per_trial_s,
         log=log,
+        config_overlay=(
+            {"mesh_shape": dict(mesh_shape)} if mesh_shape else None
+        ),
     )
     trials = lifecycle.trials
     by_id = lifecycle.by_id
@@ -1112,6 +1135,10 @@ def run_distributed(
             watchdog.track(trial.trial_id)
         safe_cb("on_trial_start", trial)
         try:
+            trial_mesh = trial.config.get("mesh_shape") or {}
+            num_devices = 1
+            for v in trial_mesh.values():
+                num_devices *= max(int(v), 1)
             worker.send(
                 {
                     "type": "run_trial",
@@ -1120,6 +1147,7 @@ def run_distributed(
                     "config": dict(trial.config),
                     "trainable": trainable_spec,
                     "slot": slot,
+                    "num_devices": num_devices,
                     "checkpoint_dir": store.checkpoint_dir(trial),
                     "checkpoint_format": store.checkpoint_format,
                     "restore_path": trial.restore_path,
@@ -1152,12 +1180,30 @@ def run_distributed(
                      counter: str = "silent_worker_requeues"):
         """Requeue a trial whose worker went silent or whose dispatch
         stalled: rewind the restore target to the newest CHECKSUM-VALID
-        generation (the silent incarnation may have left a torn or
-        damaged newest file) and route through fail_trial so the
-        per-trial retry budget bounds requeue storms."""
+        generation AT OR BELOW the trial's last REPORTED iteration and
+        route through fail_trial so the per-trial retry budget bounds
+        requeue storms.
+
+        The bound + quarantine fix the at-least-once fencing race: the
+        lost incarnation saves each checkpoint BEFORE its report frame,
+        so (especially across a partition, where checkpoint writes reach
+        shared storage while frames sit buffered) the newest valid
+        generation can be one whose report the driver never processed.
+        Restoring it would resume PAST the last report and that epoch
+        would never be re-reported.  Unreported generations are renamed
+        (quarantined — forensics, not deletion) so the worker-side
+        corruption fallback can't rediscover them either; the retry
+        replays from the last *reported* generation."""
         release(trial)
+        quarantined = ckpt_lib.quarantine_unreported(
+            store.checkpoint_dir(trial), trial.training_iteration,
+            tag=f"i{trial.incarnation}", log=log,
+        )
+        if quarantined:
+            liveness["quarantined_checkpoints"] += quarantined
         path, it = ckpt_lib.newest_valid_checkpoint(
-            store.checkpoint_dir(trial)
+            store.checkpoint_dir(trial),
+            max_iteration=trial.training_iteration,
         )
         trial.restore_path = None
         trial.latest_checkpoint = path

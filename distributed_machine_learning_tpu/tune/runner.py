@@ -74,6 +74,7 @@ def run(
     scheduler: Optional[TrialScheduler] = None,
     search_alg: Optional[Searcher] = None,
     resources_per_trial: Optional[Dict[str, int]] = None,
+    mesh_shape: Optional[Dict[str, int]] = None,
     max_concurrent: Optional[int] = None,
     storage_path: str = DEFAULT_STORAGE,
     name: Optional[str] = None,
@@ -103,6 +104,14 @@ def run(
     model-based searchers observe their results (Ray's knob of the same
     name).
 
+    ``mesh_shape``: sweep-wide 2-D (or N-D) device mesh per trial, e.g.
+    ``{"dp": 2, "tp": 4}`` — stamped into every sampled config (a config
+    that carries its own ``mesh_shape`` wins) and, when
+    ``resources_per_trial`` is omitted, the per-trial device lease
+    defaults to the mesh's total size, so
+    ``tune.run(trainable, space, mesh_shape={"dp": 2, "tp": 4})`` leases
+    8 devices per trial and the sharded trainable builds the mesh from
+    its model family's partition rules (``models/partition_rules.py``).
     ``stop``: dict of result-key -> threshold (a trial stops once any key's
     reported value reaches it, e.g. ``{"training_iteration": 20}``), a
     callable ``(trial_id, result) -> bool``, or a ``tune.Stopper``
@@ -193,6 +202,14 @@ def run(
     searcher.set_search_space(space, seed)
     sched = scheduler or FIFOScheduler()
     sched.set_experiment(metric, mode)
+    if mesh_shape is not None and resources_per_trial is None:
+        # The mesh IS the resource request: lease exactly as many devices
+        # as the axes multiply out to.
+        import math
+
+        resources_per_trial = {
+            "devices": math.prod(int(v) for v in mesh_shape.values())
+        }
     resources = Resources.parse(resources_per_trial)
 
     name = name or f"exp_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:6]}"
@@ -256,6 +273,9 @@ def run(
         keep_checkpoints_num=keep_checkpoints_num,
         time_limit_per_trial_s=time_limit_per_trial_s,
         log=log,
+        config_overlay=(
+            {"mesh_shape": dict(mesh_shape)} if mesh_shape else None
+        ),
     )
     trials = lifecycle.trials
     pending = lifecycle.pending

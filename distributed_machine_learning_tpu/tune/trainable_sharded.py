@@ -1,29 +1,42 @@
 """Built-in multi-device (sharded) regression trainable.
 
 The multi-core-per-trial path (BASELINE config 5: N cores per trial via
-``resources_per_trial={"devices": N}``; the reference's analogue is Ray's
-``resources_per_trial`` at `/root/reference/ray-tune-hpo-regression.py:475`,
-which only ever granted a single GPU).  The executor leases N devices to the
-trial; this trainable builds a named mesh over exactly those devices and runs
-the same epoch-is-one-program design as ``train_regressor``, jitted with
-GSPMD shardings:
+``resources_per_trial={"devices": N}``).  The executor leases N devices to
+the trial; this trainable builds a named mesh over exactly those devices
+and runs the whole epoch as ONE jitted program:
 
-* batch dim sharded over ``dp`` (XLA inserts the gradient all-reduce);
-* transformer params optionally tensor-parallel over ``tp``
-  (``parallel/sharding.py`` rules; column/row-parallel FF, head-sharded
-  attention);
+* layouts come from the model family's **partition-rule table**
+  (``models/partition_rules.py`` -> ``parallel/partition.py``), not a
+  hard-coded spec table: params born sharded (abstract convention probe ->
+  rule shardings -> ``out_shardings`` on the jitted init, so an over-HBM
+  flagship never materializes unsharded), optimizer moments inherit the
+  layout, activations pinned at the residual-stream/attention boundaries
+  (``models/layers.constrain_activation`` — the model gets the mesh);
+* the **fused epoch loop**: ``lax.scan`` over pre-sharded batch chunks
+  inside one program, ``donate_argnums`` covering params, opt-state,
+  batch-stats AND the epoch's batch arrays — N per-step dispatches
+  collapse to one, donated buffers are reused in place (audited: the
+  ``donation_aliased_buffers`` counter records donated inputs observed
+  consumed after the first call);
+* the epoch program resolves through the **AOT executable cache** under a
+  ``sharded_program_key`` that folds in the mesh shape and the rule-table
+  fingerprint, so sharded programs compile-once/cross-worker-dedup like
+  everything else (``compilecache/``);
 * BatchNorm models get synchronized BN for free: under jit the batch mean
-  over a dp-sharded axis is the *global* mean (GSPMD adds the psum), so
-  multi-device BN statistics match the single-device run.
+  over a dp-sharded axis is the *global* mean (GSPMD adds the psum).
 
-Config keys, beyond ``train_regressor``'s: ``mesh_shape`` — dict of mesh axis
-sizes, e.g. ``{"dp": 4}`` (default: pure dp over all leased devices) or
-``{"dp": 2, "tp": 2}``.  ``batch_size`` is the *global* batch and must be
-divisible by dp.
+Config keys beyond ``train_regressor``'s: ``mesh_shape`` — dict of mesh
+axis sizes, e.g. ``{"dp": 4}`` (default: pure dp over all leased devices)
+or ``{"dp": 2, "tp": 2}`` (also settable sweep-wide via
+``tune.run(mesh_shape=...)``); ``remat``/``remat_policy`` — per-block
+rematerialization and its ``jax.checkpoint_policies`` name;
+``partition_rules`` — per-trial rule-table override.  ``batch_size`` is
+the *global* batch and must be divisible by dp.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, Optional
 
 import jax
@@ -32,8 +45,13 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from distributed_machine_learning_tpu.compilecache import (
+    get_counters as get_compile_counters,
+    sharded_program_key,
+)
 from distributed_machine_learning_tpu.data.loader import Dataset
 from distributed_machine_learning_tpu.models import build_model
+from distributed_machine_learning_tpu.models.partition_rules import rules_for
 from distributed_machine_learning_tpu.ops.losses import get_loss
 from distributed_machine_learning_tpu.ops.optimizers import (
     INJECTABLE_OPTIMIZERS,
@@ -43,11 +61,13 @@ from distributed_machine_learning_tpu.ops.optimizers import (
 )
 from distributed_machine_learning_tpu.ops.schedules import get_schedule
 from distributed_machine_learning_tpu.parallel.mesh import make_mesh
+from distributed_machine_learning_tpu.parallel.partition import (
+    mesh_axis_sizes,
+    rules_fingerprint,
+)
 from distributed_machine_learning_tpu.parallel.sharding import (
-    TRANSFORMER_TP_RULES,
     opt_state_shardings,
     param_shardings,
-    shard_params,
 )
 from distributed_machine_learning_tpu.tune import session
 from distributed_machine_learning_tpu.tune._regression_program import (
@@ -67,6 +87,40 @@ def _host(tree):
     return jax.tree.map(lambda a: np.asarray(a), tree)
 
 
+@functools.lru_cache(maxsize=1)
+def _epoch_aot_cache():
+    """One process-wide AOT store for fused epoch programs: a second trial
+    of the same shape class (or a restarted runner) deserializes the
+    finished executable instead of re-tracing (``compilecache/aot.py``)."""
+    from distributed_machine_learning_tpu.compilecache.aot import (
+        ExecutableCache,
+    )
+
+    return ExecutableCache()
+
+
+def _partitionable_threefry():
+    """Scope ``jax_threefry_partitionable`` over this trainable's programs.
+
+    Params are born sharded (``out_shardings`` on the init jit), and the
+    default threefry lowering makes sharded random draws depend on the
+    OUTPUT LAYOUT — the same seed would produce a different model on a
+    dp×tp mesh than on pure dp (observed: tp-sharded kernels diverged,
+    breaking the "TP is a layout, not a numerics change" contract).
+    Partitionable threefry is jax's mesh-invariant stream: same key ⇒
+    same values on any mesh, any sharding.  Scoped here (thread-local)
+    so the unsharded trainables' recorded numerics stay untouched.
+    """
+    try:
+        from jax._src.config import threefry_partitionable
+
+        return threefry_partitionable(True)
+    except Exception:  # noqa: BLE001 - private flag moved; fall through
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
 def train_sharded_regressor(
     config: Dict[str, Any],
     train_data: Optional[Dataset] = None,
@@ -75,11 +129,22 @@ def train_sharded_regressor(
     """Multi-device trainable. Bind datasets with ``tune.with_parameters``."""
     if train_data is None or val_data is None:
         raise ValueError("train_sharded_regressor needs train_data/val_data")
+    with _partitionable_threefry():
+        return _train_sharded(config, train_data, val_data)
+
+
+def _train_sharded(
+    config: Dict[str, Any],
+    train_data: Dataset,
+    val_data: Dataset,
+):
 
     devices = session.get_devices() or list(jax.devices())
     mesh_shape = dict(config.get("mesh_shape") or {"dp": len(devices)})
     mesh = make_mesh(mesh_shape, devices)
     dp = int(mesh.shape.get("dp", 1))
+    rules = rules_for(config)
+    rules_fp = rules_fingerprint(rules)
 
     num_epochs = int(config.get("num_epochs", 20))
     seed = int(config.get("seed", 0))
@@ -149,26 +214,49 @@ def train_sharded_regressor(
         )
     loss_fn = get_loss(loss_name)
 
-    model = build_model(config)
+    # The model carries the mesh so the activation sharding constraints
+    # (residual stream, attention q/k/v — models/layers.py) are live; the
+    # local copy keeps Mesh objects out of the stored trial config.
+    model = build_model(dict(config, mesh=mesh))
     sample_x = x_np[:1]
+    repl = NamedSharding(mesh, P())
+
     # Device-call section (init dispatch, shard placement, jit init):
     # serialized across concurrent trial threads on fragile backends
     # (utils/dispatch.py — the tunnel-wedge mitigation, same coverage
     # as tune/trainable.py's init block).
     with dispatch_lock():
-        # Per-trial init diversity, same as train_regressor (the rng is a
-        # traced argument — one compiled init program per architecture).
-        variables, flag_name = detect_call_convention(
-            model, sample_x,
-            init_rngs=init_rngs_for(seed),
+        # Abstract convention probe: flag kwarg + BN detection via
+        # eval_shape — nothing allocated, so the rule shardings below
+        # exist BEFORE any parameter is materialized (an over-HBM
+        # flagship must be born sharded, not placed then re-placed).
+        abstract_vars, flag_name = detect_call_convention(
+            model, sample_x, abstract=True,
         )
-        has_bn = "batch_stats" in variables
+        has_bn = "batch_stats" in abstract_vars
         forward = make_forward(model, flag_name, has_bn)
 
-        # Shard params per the TP rules (pure-dp meshes leave everything
-        # replicated); optimizer state inherits the layout via jit init.
-        params = shard_params(variables["params"], mesh, TRANSFORMER_TP_RULES)
-        p_shardings = param_shardings(params, mesh, TRANSFORMER_TP_RULES)
+        p_shardings = param_shardings(
+            abstract_vars["params"], mesh, rules
+        )
+        bs_shardings = jax.tree.map(
+            lambda _: repl, abstract_vars.get("batch_stats", {})
+        )
+        v_shardings = jax.tree.map(lambda _: repl, abstract_vars)
+        v_shardings = dict(v_shardings, params=p_shardings)
+        if has_bn:
+            v_shardings["batch_stats"] = bs_shardings
+        init_kwargs = {
+            flag_name: True if flag_name == "deterministic" else False
+        }
+        # Per-trial init diversity, same as train_regressor (the rng is a
+        # traced argument — one compiled init program per architecture);
+        # out_shardings = the rule layout, so params are born sharded.
+        variables = jax.jit(
+            lambda r, x: model.init(r, x, **init_kwargs),
+            out_shardings=v_shardings,
+        )(init_rngs_for(seed), sample_x)
+        params = variables["params"]
         o_shardings = opt_state_shardings(
             jax.eval_shape(tx.init, params), p_shardings, mesh
         )
@@ -177,11 +265,7 @@ def train_sharded_regressor(
         )(params)
         if injected:
             opt_state = set_injected_hyperparams(opt_state, lr, wd)
-        batch_stats = jax.device_put(
-            variables.get("batch_stats", {}),
-            jax.tree.map(lambda _: NamedSharding(mesh, P()),
-                         variables.get("batch_stats", {})),
-        )
+        batch_stats = variables.get("batch_stats", {})
 
     # Batched-epoch shardings: [num_batches, global_batch, ...] with the
     # in-batch dim over dp.
@@ -191,6 +275,8 @@ def train_sharded_regressor(
     xb_sharding = batched_sharding(x_np.ndim + 1)
     yb_sharding = batched_sharding(y_np.ndim + 1)
     xv_sharding = NamedSharding(mesh, P("dp"))
+    xb_shape = (num_batches, global_batch) + x_np.shape[1:]
+    yb_shape = (num_batches, global_batch) + y_np.shape[1:]
 
     def epoch_fn(params, opt_state, batch_stats, xb, yb, epoch_key):
         def step(carry, batch):
@@ -214,11 +300,64 @@ def train_sharded_regressor(
         )
         return params, opt_state, batch_stats, losses.mean()
 
-    train_epoch = jax.jit(
-        epoch_fn,
-        donate_argnums=(0, 1, 2),
-        in_shardings=(None, None, None, xb_sharding, yb_sharding, None),
+    # The fused epoch program: donation covers EVERY large input — params
+    # (0), opt_state (1), batch_stats (2), and the staged epoch batches
+    # (3, 4): the batch chunks are consumed exactly once per epoch, so
+    # donating them saves a full epoch-sized HBM copy per epoch.
+    _EPOCH_DONATE = (0, 1, 2, 3, 4)
+    # out_shardings pinned to the SAME rule layout as the inputs: without
+    # the pin GSPMD may propagate a different layout onto the returned
+    # params (observed: head params pulled onto 'tp' by the head-kernel
+    # rule), which both breaks the next call's in_shardings contract and
+    # defeats donation (an input can only alias an identically-laid-out
+    # output).
+    epoch_jit_kwargs = {
+        "in_shardings": (
+            p_shardings, o_shardings, bs_shardings,
+            xb_sharding, yb_sharding, repl,
+        ),
+        "out_shardings": (p_shardings, o_shardings, bs_shardings, repl),
+    }
+
+    def jit_epoch():
+        return jax.jit(
+            epoch_fn, donate_argnums=_EPOCH_DONATE, **epoch_jit_kwargs
+        )
+
+    # AOT tier: the program key folds in mesh shape + rule-table
+    # fingerprint (sharded_program_key) so a reshaped mesh or edited rule
+    # table can never alias a stale executable; any resolution failure
+    # degrades to the plain jit (persistent XLA cache still applies).
+    program_key = sharded_program_key(
+        config,
+        mesh_shape=mesh_axis_sizes(mesh),
+        rules_fingerprint=rules_fp,
+        batch_shape=[list(xb_shape), list(yb_shape)],
+        dtype=str(config.get("compute_dtype") or "float32"),
+        donation=_EPOCH_DONATE,
+        # A loaded executable is bound to CONCRETE devices: two same-class
+        # trials leased onto different 4-device groups of one host must
+        # not share an AOT entry (the collision hands trial B outputs
+        # placed on trial A's devices).  Cross-worker dedup is unaffected
+        # — it rides the persistent-cache/artifact-origin key, not this
+        # executable-level one.
+        extra={"device_ids": [
+            int(getattr(d, "id", i)) for i, d in enumerate(devices)
+        ]},
     )
+    with dispatch_lock():
+        try:
+            train_epoch = _epoch_aot_cache().get_or_compile(
+                program_key, epoch_fn,
+                params, opt_state, batch_stats,
+                jax.ShapeDtypeStruct(xb_shape, jnp.float32),
+                jax.ShapeDtypeStruct(yb_shape, jnp.float32),
+                jax.random.key(0),
+                donate_argnums=_EPOCH_DONATE,
+                jit_kwargs=epoch_jit_kwargs,
+            )
+        except Exception:  # noqa: BLE001 - AOT must never fail a trial
+            train_epoch = jit_epoch()
 
     # Eval: pad the val set to a multiple of dp, mask the padding out.
     xv_np = np.asarray(val_data.x, np.float32)
@@ -272,7 +411,8 @@ def train_sharded_regressor(
             # layout — rebuild the baked chain for this incarnation (same
             # fallback as tune/trainable.py).  epoch_fn closes over `tx`
             # late-bound, so re-jitting after the rebind traces the baked
-            # update.
+            # update (plain jit: the AOT key describes the injected
+            # layout, not this incarnation's).
             injected = False
             schedule = get_schedule(
                 str(config.get("lr_schedule", "warmup_linear_decay")),
@@ -297,12 +437,14 @@ def train_sharded_regressor(
                 tx.init, in_shardings=(p_shardings,),
                 out_shardings=o_shardings,
             )(params)
-            train_epoch = jax.jit(
-                epoch_fn,
-                donate_argnums=(0, 1, 2),
-                in_shardings=(None, None, None, xb_sharding, yb_sharding,
-                              None),
+            epoch_jit_kwargs["in_shardings"] = (
+                p_shardings, o_shardings, bs_shardings,
+                xb_sharding, yb_sharding, repl,
             )
+            epoch_jit_kwargs["out_shardings"] = (
+                p_shardings, o_shardings, bs_shardings, repl,
+            )
+            train_epoch = jit_epoch()
             template["opt_state"] = _host(opt_state)
             restored = restore_into(template, ckpt)
         # Re-shard restored host arrays into the live mesh layout.
@@ -314,13 +456,13 @@ def train_sharded_regressor(
             opt_state = set_injected_hyperparams(opt_state, lr, wd)
         batch_stats = jax.device_put(
             restored["batch_stats"],
-            jax.tree.map(lambda _: NamedSharding(mesh, P()),
-                         restored["batch_stats"]),
+            jax.tree.map(lambda _: repl, restored["batch_stats"]),
         )
         start_epoch = int(restored["epoch"]) + 1
 
     checkpoint_freq = int(config.get("checkpoint_freq", 1))
     rng = np.random.default_rng(fold_seed(seed, "shuffle"))
+    audit_donation = True
 
     # ---- epoch loop: host-driven so the scheduler can interrupt ------------
     for epoch in range(start_epoch, num_epochs):
@@ -347,29 +489,40 @@ def train_sharded_regressor(
                 else float(schedule(min(opt_steps, total_steps)))
             )
             xb = jax.device_put(
-                x_np[perm].reshape(
-                    num_batches, global_batch, *x_np.shape[1:]
-                ),
-                xb_sharding,
+                x_np[perm].reshape(xb_shape), xb_sharding,
             )
             yb = jax.device_put(
-                y_np[perm].reshape(
-                    num_batches, global_batch, *y_np.shape[1:]
-                ),
-                yb_sharding,
+                y_np[perm].reshape(yb_shape), yb_sharding,
             )
+            if audit_donation:
+                # Donation audit probes: references to donated inputs,
+                # checked for consumption right after the first call —
+                # runtime proof the buffer aliases took effect.
+                probes = [xb, yb] + jax.tree.leaves(params)[:1] \
+                    + jax.tree.leaves(opt_state)[:1]
             params, opt_state, batch_stats, train_loss = train_epoch(
                 params, opt_state, batch_stats, xb, yb, epoch_key
             )
             metrics = evaluate(params, batch_stats, xv, yv, mask)
             train_loss = float(train_loss)
             metrics = {k: float(v) for k, v in metrics.items()}
+            if audit_donation:
+                audit_donation = False
+                consumed = sum(
+                    1 for a in probes
+                    if isinstance(a, jax.Array) and a.is_deleted()
+                )
+                if consumed:
+                    get_compile_counters().add(
+                        "donation_aliased_buffers", consumed
+                    )
         record = {
             "epoch": epoch,
             "train_loss": train_loss,
             "lr": lr_now,
             "steps": step_count,
             "num_devices": len(devices),
+            "mesh_shape": dict(mesh_shape),
             **metrics,
         }
         checkpoint = None

@@ -1,0 +1,33 @@
+# dmlint-scope: hot-jit
+"""Historical bug (ISSUE 7 donation audit): the bench flagship's train
+step jitted WITHOUT donate_argnums — every measured step paid an extra
+params+opt HBM copy, silently depressing the recorded MFU.  A jit that
+threads params AND optimizer state is a train step and must donate."""
+
+import jax
+
+
+def train_step(params, opt_state, x, y):
+    return params, opt_state
+
+
+def make_programs():
+    step = jax.jit(train_step)  # EXPECT: undonated-hot-jit
+    return step
+
+
+def make_sharded_program(p_shardings):
+    # Sharded in/out is the location-independent trigger: the state IS
+    # the big memory on a mesh.
+    return jax.jit(  # EXPECT: undonated-hot-jit
+        train_step, in_shardings=(p_shardings, None, None, None)
+    )
+
+
+@jax.jit  # EXPECT: undonated-hot-jit
+def decorated_step(params, opt_state, grads):
+    return params, opt_state
+
+
+def make_lambda_program():
+    return jax.jit(lambda params, opt: (params, opt))  # EXPECT: undonated-hot-jit

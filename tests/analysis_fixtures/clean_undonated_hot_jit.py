@@ -1,0 +1,35 @@
+# dmlint-scope: hot-jit
+"""Idiomatic twin: donated train steps, eval-shaped programs (params
+only — donating read-only params would destroy them), optimizer inits,
+and unresolvable callees stay silent."""
+
+import functools
+
+import jax
+
+
+def train_step(params, opt_state, x, y):
+    return params, opt_state
+
+
+def eval_step(params, x):
+    return x
+
+
+def make_programs(tx):
+    donated = jax.jit(train_step, donate_argnums=(0, 1))
+    by_name = jax.jit(train_step, donate_argnames=("params", "opt_state"))
+    evaluate = jax.jit(eval_step)  # params only: eval-shaped, exempt
+    init_opt = jax.jit(tx.init)  # attribute callee: unresolvable, exempt
+    return donated, by_name, evaluate, init_opt
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def decorated_step(params, opt_state, grads):
+    return params, opt_state
+
+
+def make_sharded_eval(p_shardings):
+    # Sharded but eval-shaped: no optimizer state threaded, no donation
+    # wanted.
+    return jax.jit(eval_step, in_shardings=(p_shardings, None))

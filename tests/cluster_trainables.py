@@ -185,3 +185,74 @@ def jax_device_trial(config):
     x = jnp.arange(8.0) * float(config["x"])
     y = float(jax.jit(lambda v: (v**2).sum())(x))
     tune.report({"loss": y, "device": str(jax.devices()[0])})
+
+
+def mesh_probe_trial(config):
+    """Reports the slot's device lease — the cluster ``mesh_shape``
+    plumbing test: a mesh trial must receive prod(mesh_shape) DISTINCT
+    local devices (worker slot groups), and the stamped config must carry
+    the sweep-wide mesh shape."""
+    from distributed_machine_learning_tpu.tune import session
+
+    devices = session.get_devices()
+    for epoch in range(1, 3):
+        tune.report({
+            "loss": float(config["x"]) + 1.0 / epoch,
+            "epoch": epoch,
+            "n_devices": len(devices),
+            "n_distinct": len({getattr(d, "id", i)
+                               for i, d in enumerate(devices)}),
+            "mesh_shape": dict(config.get("mesh_shape") or {}),
+        })
+
+
+def sharded_compiling_trial(config):
+    """Sharded-program analogue of ``compiling_trial`` (ISSUE 7): jits a
+    program with explicit NamedSharding in_shardings over the mesh built
+    from ``config['mesh_shape']`` via the partition-rule layer, and
+    reports compile/fetch accounting.  ``mesh_shape`` is stamped into the
+    trial config by the driver, so the artifact-origin program key splits
+    on it — same mesh shape on another worker = fetch + zero compiles;
+    a different mesh shape = honest recompile."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_machine_learning_tpu import compilecache as cc
+    from distributed_machine_learning_tpu.parallel.mesh import make_mesh
+    from distributed_machine_learning_tpu.parallel.partition import (
+        mesh_axis_sizes,
+        rules_fingerprint,
+        shardings_from_rules,
+    )
+    from distributed_machine_learning_tpu.tune import session
+
+    devices = session.get_devices()
+    mesh = make_mesh(dict(config["mesh_shape"]), devices)
+    width = int(config.get("width", 16))
+    lr = float(config.get("learning_rate", 1.0))
+    rules = ((r"w$", P(None, "tp")), (r".*", P()),)
+    tree = {"w": jnp.full((width, width), lr, jnp.float32)}
+    sh = shardings_from_rules(tree, mesh, rules)
+    tracker = cc.get_tracker()
+    before = tracker.total_uncached_compiles()
+    program = jax.jit(
+        lambda t: jnp.tanh(t["w"] @ t["w"].T).sum(), in_shardings=(sh,)
+    )
+    y = float(program(jax.device_put(tree, sh)))
+    counters = cc.get_counters()
+    key = cc.sharded_program_key(
+        {k: v for k, v in config.items() if k != "mesh_shape"},
+        mesh_shape=mesh_axis_sizes(mesh),
+        rules_fingerprint=rules_fingerprint(rules),
+    )
+    for epoch in range(1, int(config.get("epochs", 2)) + 1):
+        tune.report({
+            "loss": abs(y) / epoch + (lr - 1.5) ** 2,
+            "epoch": epoch,
+            "uncached_compiles": tracker.total_uncached_compiles() - before,
+            "worker_fetch_hits": counters.get("fetch_hits"),
+            "worker_publishes": counters.get("publishes"),
+            "n_devices": len(devices),
+            "sharded_key": key,
+        })

@@ -356,7 +356,8 @@ def test_tpu_suite_resumes_after_stall_with_partial(monkeypatch):
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     phases = {}
-    ours, others, flagship, _quality, tunnel_ok = bench._run_tpu_suite(
+    (ours, others, flagship, _sharded, _quality,
+     tunnel_ok) = bench._run_tpu_suite(
         lambda m: None, phases
     )
     assert calls == [("suite", None), ("probe", None), ("suite", "1")]
@@ -389,7 +390,8 @@ def test_tpu_suite_keeps_flagship_when_resume_also_stalls(monkeypatch):
     monkeypatch.setattr(bench, "_run_child_monitored", fake_monitored)
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
-    ours, others, flagship, _quality, tunnel_ok = bench._run_tpu_suite(
+    (ours, others, flagship, _sharded, _quality,
+     tunnel_ok) = bench._run_tpu_suite(
         lambda m: None, {}
     )
     assert calls == [("suite", None), ("probe", None), ("suite", "1")]
@@ -422,7 +424,8 @@ def test_tpu_suite_skips_resume_when_tunnel_wedged(monkeypatch):
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     phases = {}
-    ours, others, flagship, _quality, tunnel_ok = bench._run_tpu_suite(
+    (ours, others, flagship, _sharded, _quality,
+     tunnel_ok) = bench._run_tpu_suite(
         lambda m: None, phases
     )
     assert calls == ["suite", "probe"]  # no resume against a wedge
@@ -450,7 +453,8 @@ def test_tpu_suite_zombie_post_stall_probe_stops_suite(monkeypatch):
     monkeypatch.setattr(bench, "_run_child_monitored", fake_monitored)
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
-    ours, others, flagship, _quality, tunnel_ok = bench._run_tpu_suite(
+    (ours, others, flagship, _sharded, _quality,
+     tunnel_ok) = bench._run_tpu_suite(
         lambda m: None, {}
     )
     assert calls == ["suite", "probe"]  # nothing launched past the zombie
@@ -472,7 +476,8 @@ def test_tpu_suite_zombie_suite_child_stops_everything(monkeypatch):
 
     monkeypatch.setattr(bench, "_run_child_monitored", fake_monitored)
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
-    ours, others, flagship, _quality, tunnel_ok = bench._run_tpu_suite(
+    (ours, others, flagship, _sharded, _quality,
+     tunnel_ok) = bench._run_tpu_suite(
         lambda m: None, {}
     )
     assert tunnel_ok is False

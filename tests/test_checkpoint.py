@@ -406,3 +406,85 @@ def test_final_retention_converges_with_inflight_writes(tmp_path, monkeypatch):
         d = os.path.dirname(t.latest_checkpoint)
         files = sorted(f for f in os.listdir(d) if f.endswith(".msgpack"))
         assert files == ["ckpt_000004.msgpack"], files
+
+
+# ---------------------------------------------------------------------------
+# At-least-once fencing: quarantine of unreported generations (ISSUE 7)
+
+
+def _write_gens(tmp_path, steps, fmt="msgpack"):
+    from distributed_machine_learning_tpu.tune.checkpoint import (
+        checkpoint_path,
+    )
+
+    d = str(tmp_path / "trial_ckpts")
+    paths = {}
+    for s in steps:
+        p = checkpoint_path(d, s, fmt)
+        save_checkpoint(p, {"params": {"w": np.full(4, float(s))},
+                            "epoch": s - 1})
+        paths[s] = p
+    return d, paths
+
+
+@pytest.mark.parametrize("fmt", ["msgpack", "sharded"])
+def test_quarantine_unreported_generations(tmp_path, fmt):
+    """A fenced zombie's checkpoint (step > last reported) is renamed out
+    of the generation namespace; the newest-valid walk then lands on the
+    last REPORTED generation — the retry re-reports the fenced epoch
+    instead of silently skipping it."""
+    from distributed_machine_learning_tpu.tune.checkpoint import (
+        newest_valid_checkpoint,
+        quarantine_unreported,
+    )
+
+    d, _ = _write_gens(tmp_path, [1, 2, 3], fmt)
+    # Driver processed 2 reports; the step-3 generation is the zombie's.
+    path, it = newest_valid_checkpoint(d)
+    assert it == 3  # without the guard, the requeue would restore this
+    n = quarantine_unreported(d, 2, tag="i0", log=lambda m: None)
+    assert n == 1
+    path, it = newest_valid_checkpoint(d)
+    assert it == 2
+    tree = load_checkpoint(path)
+    assert int(tree["epoch"]) == 1
+    # The zombie's bytes survive for forensics, under the fenced prefix.
+    import os
+
+    fenced = [f for f in os.listdir(str(tmp_path / "trial_ckpts"))
+              if f.startswith("fenced")]
+    assert fenced, "quarantined generation should remain on storage"
+    # A second quarantine pass is a no-op (idempotent at requeue time).
+    assert quarantine_unreported(d, 2, tag="i1", log=lambda m: None) == 0
+
+
+def test_newest_valid_checkpoint_max_iteration(tmp_path):
+    """The max_iteration bound skips unreported generations even before
+    (or racing) the quarantine rename."""
+    from distributed_machine_learning_tpu.tune.checkpoint import (
+        newest_valid_checkpoint,
+    )
+
+    d, _ = _write_gens(tmp_path, [1, 2, 4])
+    path, it = newest_valid_checkpoint(d, max_iteration=3)
+    assert it == 2
+    path, it = newest_valid_checkpoint(d, max_iteration=0)
+    assert path is None and it == 0
+
+
+def test_quarantined_generations_invisible_to_fallback(tmp_path):
+    """load_checkpoint_with_fallback (the worker-side corruption path)
+    cannot rediscover a quarantined generation."""
+    from distributed_machine_learning_tpu.tune.checkpoint import (
+        load_checkpoint_with_fallback,
+        quarantine_unreported,
+    )
+
+    d, paths = _write_gens(tmp_path, [1, 2, 3])
+    quarantine_unreported(d, 1, log=lambda m: None)
+    # Restore target itself was quarantined -> fallback walks the
+    # remaining generations and lands on step 1, never 2 or 3.
+    tree, used, it = load_checkpoint_with_fallback(paths[3], d,
+                                                   log=lambda m: None)
+    assert it == 1
+    assert int(tree["epoch"]) == 0

@@ -391,3 +391,71 @@ def test_vectorized_sharded_population_resume(tmp_path):
         assert tr.results[-1]["validation_mse"] == pytest.approx(
             tu.results[-1]["validation_mse"], rel=1e-6
         )
+
+
+# ---------------------------------------------------------------------------
+# Rule-sharded saves (ISSUE 7): the partition-rule layer's layouts ride
+# the index, and restores land bit-identically on any target mesh.
+
+
+@pytest.mark.parametrize("target_mesh", ["one_device", "4x2"])
+def test_rule_sharded_save_restores_bit_identically(tmp_path, target_mesh):
+    """Save a rule-sharded pytree on a 2x4 dp·tp mesh; restore onto one
+    device and onto a transposed 4x2 mesh — bit-identical both ways, and
+    the index carries the rule-derived PartitionSpecs + saving mesh."""
+    from distributed_machine_learning_tpu.models.partition_rules import (
+        MLP_RULES,
+    )
+    from distributed_machine_learning_tpu.parallel.partition import (
+        shardings_from_rules,
+    )
+
+    rng = np.random.default_rng(7)
+    host = {
+        "params": {
+            "Dense_0": {"kernel": rng.normal(size=(8, 16)).astype(np.float32),
+                        "bias": rng.normal(size=16).astype(np.float32)},
+            "Dense_1": {"kernel": rng.normal(size=(16, 8)).astype(np.float32),
+                        "bias": rng.normal(size=8).astype(np.float32)},
+        },
+        "epoch": 3,
+    }
+    save_mesh = Mesh(np.array(DEVS).reshape(2, 4), ("dp", "tp"))
+    sh = shardings_from_rules(host["params"], save_mesh, MLP_RULES)
+    placed = {
+        "params": jax.device_put(host["params"], sh),
+        "epoch": 3,
+    }
+    assert placed["params"]["Dense_0"]["kernel"].sharding.spec == \
+        P(None, "tp")
+    gen = str(tmp_path / "ck" / "gen_000003")
+    ckpt_lib.save_checkpoint(gen, placed)
+
+    # The index recorded the rule-derived layout + the saving mesh.
+    saved = fmt.saved_partition_specs(gen)
+    assert saved["__mesh__"] == {"dp": 2, "tp": 4}
+    assert saved["specs"]["params"]["Dense_0"]["kernel"] == P(None, "tp")
+    assert saved["specs"]["params"]["Dense_1"]["kernel"] == P("tp", None)
+
+    if target_mesh == "one_device":
+        mesh = Mesh(np.array(DEVS[:1]).reshape(1, 1), ("dp", "tp"))
+    else:
+        mesh = Mesh(np.array(DEVS).reshape(4, 2), ("dp", "tp"))
+    # Rebuild target shardings from the SAVED specs on the NEW mesh —
+    # no rule table needed on the restore side.
+    target_sh = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        saved["specs"]["params"],
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    restored = ckpt_lib.load_checkpoint(
+        gen, shardings={"params": target_sh}
+    )
+    assert int(restored["epoch"]) == 3
+    for name in ("Dense_0", "Dense_1"):
+        for leaf in ("kernel", "bias"):
+            got = restored["params"][name][leaf]
+            assert isinstance(got, jax.Array)
+            np.testing.assert_array_equal(
+                np.asarray(got), host["params"][name][leaf]
+            )

@@ -433,3 +433,35 @@ def test_distributed_callbacks_and_reporter(worker_pool, tmp_path, capsys):
     assert kinds.count("start") == 3
     assert kinds.count("complete") == 3
     assert kinds.count("result") == 6  # 3 trials x 2 epochs
+
+
+def test_distributed_mesh_shape_leases_device_groups(tmp_path):
+    """run_distributed(mesh_shape=...) stamps the mesh into every config
+    and each dispatch hands the trial prod(mesh_shape) DISTINCT local
+    devices (worker slot groups) — the cluster side of the partition-rule
+    sharding tentpole (ISSUE 7)."""
+    procs, addrs = start_local_workers(1, slots=2, env=_worker_env())
+    try:
+        analysis = run_distributed(
+            "cluster_trainables:mesh_probe_trial",
+            {"x": tune.uniform(0.0, 1.0)},
+            metric="loss", mode="min", num_samples=3,
+            workers=addrs, storage_path=str(tmp_path), name="mesh_cluster",
+            seed=2, verbose=0,
+            mesh_shape={"dp": 2, "tp": 2},
+        )
+        assert analysis.num_terminated() == 3
+        for t in analysis.trials:
+            assert t.config["mesh_shape"] == {"dp": 2, "tp": 2}
+            last = t.last_result
+            assert last["n_devices"] == 4
+            assert last["n_distinct"] == 4
+            assert last["mesh_shape"] == {"dp": 2, "tp": 2}
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()

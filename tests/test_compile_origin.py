@@ -157,3 +157,48 @@ def test_faulted_artifact_fetch_falls_back_to_local_compile(tmp_path):
     assert faulted.best_result["loss"] == pytest.approx(
         control.best_result["loss"], rel=1e-6
     )
+
+
+def test_origin_second_worker_compiles_nothing_sharded(tmp_path):
+    """ISSUE 7 acceptance: compile-once holds for SHARDED programs.
+    Worker A compiles the mesh-sharded program and publishes; worker B —
+    fresh process, empty cache dir, SAME mesh shape — fetches and records
+    ZERO uncached backend compiles.  Worker C on a DIFFERENT mesh shape
+    over the same devices must NOT reuse it: the program key folds in the
+    mesh shape, so C honestly recompiles."""
+    registry = cc.ArtifactRegistry()
+
+    def sweep(i, mesh_shape, seed):
+        procs, addrs = cluster.start_local_workers(
+            1, slots=1, env=_worker_env(tmp_path / f"shcache_w{i}"),
+        )
+        try:
+            analysis = cluster.run_distributed(
+                "cluster_trainables:sharded_compiling_trial",
+                {"width": 16, "learning_rate": tune.uniform(0.5, 2.5),
+                 "epochs": 2},
+                metric="loss", workers=addrs, num_samples=1, seed=seed,
+                mesh_shape=mesh_shape,
+                storage_path=str(tmp_path / "results"),
+                name=f"sh_origin_run{i}", verbose=0,
+                shutdown_workers=True, artifact_origin=registry,
+            )
+            return analysis.trials[0].last_result
+        finally:
+            for p in procs:
+                p.terminate()
+
+    first = sweep(0, {"dp": 2, "tp": 2}, seed=3)
+    second = sweep(1, {"dp": 2, "tp": 2}, seed=4)
+    third = sweep(2, {"dp": 4, "tp": 1}, seed=5)
+
+    assert first["n_devices"] == 4
+    assert first["uncached_compiles"] > 0        # A really compiled
+    assert first["worker_publishes"] >= 1        # ... and published
+    assert second["worker_fetch_hits"] >= 1      # B fetched instead
+    assert second["uncached_compiles"] == 0, second  # ... compiled NOTHING
+    # Same (config, rules) on a reshaped mesh is a DIFFERENT program:
+    # the sharded key splits, and the worker honestly recompiles.
+    assert third["sharded_key"] != first["sharded_key"]
+    assert third["uncached_compiles"] > 0, third
+    assert first["sharded_key"] == second["sharded_key"]

@@ -298,6 +298,54 @@ def test_cluster_stalled_trial_is_fenced_and_requeued(tmp_path):
         _terminate(procs)
 
 
+def test_partition_requeue_replays_from_last_reported_generation(tmp_path):
+    """Regression for the at-least-once fencing race (ISSUE 7, documented
+    in docs/operations.md): a partitioned worker's checkpoint write
+    reaches shared storage while its report frame sits buffered, so at
+    requeue time the newest VALID generation is one the driver never saw
+    reported.  Pre-fix, ``requeue_lost`` restored it and the retry
+    resumed PAST the lost report — that epoch vanished from the stream
+    forever (the 1-in-8 flake in the partition e2e).  Post-fix the
+    unreported generation is quarantined (renamed) and the retry replays
+    from the last *reported* generation, so every trial's epoch stream
+    stays exactly once-per-epoch across incarnations."""
+    procs, addrs = start_local_workers(2, slots=2, env=_worker_env())
+    # Driver-side partition: at the 3rd result frame, worker 1's frames
+    # (both directions) buffer for 2.5s.  Its running trials each save
+    # their next checkpoint straight to tmp_path storage, send the report
+    # into the buffer, and block on the decision — the exact
+    # checkpoint-durable / report-lost state the race needs.
+    plan = chaos.FaultPlan(seed=11, partition_worker=[(3, 1, 2.5)])
+    try:
+        with chaos.active(plan):
+            analysis = run_distributed(
+                "cluster_trainables:slow_resumable_trial",
+                {"x": tune.uniform(0.0, 6.0), "epochs": 5, "sleep_s": 0.2},
+                metric="loss", mode="min", num_samples=4,
+                workers=addrs, max_failures=2,
+                storage_path=str(tmp_path), name="lv_quarantine", seed=3,
+                verbose=0,
+                worker_heartbeat_timeout_s=0.8,
+                worker_reconnect_grace_s=15.0,
+            )
+        assert plan.snapshot()["worker_partitions"] == 1
+        assert analysis.num_terminated() == 4
+        requeued = [t for t in analysis.trials if t.num_failures > 0]
+        assert requeued, "the partition should have requeued something"
+        for t in analysis.trials:
+            # THE regression assertion: no epoch ever skipped (pre-fix:
+            # the unreported epoch was missing) and none double-reported.
+            assert [r["epoch"] for r in t.results] == [1, 2, 3, 4, 5], (
+                t.trial_id
+            )
+        state = json.load(open(f"{analysis.root}/experiment_state.json"))
+        lv = state["liveness"]
+        assert lv["lease_expiries"] >= 1
+        assert lv["quarantined_checkpoints"] >= 1
+    finally:
+        _terminate(procs)
+
+
 def test_wallclock_jump_does_not_expire_live_worker_lease(
     tmp_path, monkeypatch
 ):

@@ -1,0 +1,295 @@
+"""Golden tests for the partition-rule layer (ISSUE 7 tentpole).
+
+The matcher's semantics are a CONTRACT shared by the trainables, the
+ckpt index, and the compile keys: ``re.search``, first match wins,
+scalars never partition, unmatched leaves default to replicated (or
+raise in strict mode), and the tuple-path dialect resolves identically
+to its regex rendering (SNIPPETS [1] ``match_partition_rules`` lineage).
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_machine_learning_tpu.models.partition_rules import (
+    MLP_RULES,
+    PARTITION_RULE_TABLES,
+    TRANSFORMER_RULES,
+    register_partition_rules,
+    rules_for,
+    rules_fingerprint_for,
+)
+from distributed_machine_learning_tpu.parallel.mesh import make_mesh
+from distributed_machine_learning_tpu.parallel.partition import (
+    clean_spec,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+    mesh_axis_sizes,
+    rules_fingerprint,
+    shardings_from_rules,
+    spec_from_jsonable,
+    spec_to_jsonable,
+)
+
+
+TREE = {
+    "layer_0": {
+        "attention": {"query": {"kernel": np.zeros((8, 4, 2)),
+                                "bias": np.zeros((4, 2))}},
+        "ff": {"Dense_0": {"kernel": np.zeros((8, 16)),
+                           "bias": np.zeros(16)},
+               "Dense_1": {"kernel": np.zeros((16, 8)),
+                           "bias": np.zeros(8)}},
+    },
+    "scalar": np.float32(1.0),
+    "one_element": np.zeros((1,)),
+}
+
+
+# -- matcher semantics -------------------------------------------------------
+
+
+def test_first_match_wins_rule_order_precedence():
+    rules = (
+        (r"ff/Dense_0/kernel$", P(None, "tp")),
+        (r"Dense_0", P("dp")),          # broader, later: must NOT win
+        (r".*", P()),
+    )
+    specs = match_partition_rules(rules, TREE)
+    assert specs["layer_0"]["ff"]["Dense_0"]["kernel"] == P(None, "tp")
+    # The broader rule still catches what the narrow one does not.
+    assert specs["layer_0"]["ff"]["Dense_0"]["bias"] == P("dp")
+
+
+def test_search_semantics_substring_match():
+    """Patterns match anywhere in the '/'-joined path (re.search, the
+    snippet's semantics) — no implicit anchoring."""
+    specs = match_partition_rules(((r"attention", P("tp")),), TREE,
+                                  default=P())
+    assert specs["layer_0"]["attention"]["query"]["kernel"] == P("tp")
+    assert specs["layer_0"]["ff"]["Dense_0"]["kernel"] == P()
+
+
+def test_unmatched_leaf_default_and_error_mode():
+    specs = match_partition_rules(((r"attention", P("tp")),), TREE)
+    assert specs["layer_0"]["ff"]["Dense_1"]["kernel"] == P()  # default
+    with pytest.raises(ValueError, match="Partition rule not found"):
+        match_partition_rules(((r"attention", P("tp")),), TREE,
+                              on_unmatched="error")
+    # A catch-all satisfies strict mode (the snippet's table shape).
+    match_partition_rules(((r".*", P()),), TREE, on_unmatched="error")
+
+
+def test_scalars_never_partition():
+    specs = match_partition_rules(((r".*", P("dp")),), TREE)
+    assert specs["scalar"] == P()
+    assert specs["one_element"] == P()  # one-element arrays count too
+    assert specs["layer_0"]["ff"]["Dense_0"]["bias"] == P("dp")
+
+
+def test_regex_vs_tuple_path_parity():
+    """The tuple-path dialect (component regexes over adjacent path
+    components) resolves identically to its regex rendering."""
+    regex_rules = (
+        (r"(^|/)Dense_0/kernel(/|$)", P(None, "tp")),
+        (r"(^|/)Dense_1/kernel(/|$)", P("tp", None)),
+        (r".*", P()),
+    )
+    tuple_rules = (
+        (("Dense_0", "kernel"), P(None, "tp")),
+        (("Dense_1", "kernel"), P("tp", None)),
+        (r".*", P()),
+    )
+    a = match_partition_rules(regex_rules, TREE)
+    b = match_partition_rules(tuple_rules, TREE)
+    assert jax.tree.map(lambda x, y: x == y, a, b,
+                        is_leaf=lambda x: isinstance(x, P))
+    flat_a = jax.tree.leaves(a, is_leaf=lambda x: isinstance(x, P))
+    flat_b = jax.tree.leaves(b, is_leaf=lambda x: isinstance(x, P))
+    assert flat_a == flat_b
+
+
+def test_tuple_components_are_anchored_per_component():
+    """Each tuple component fullmatches ONE path component — 'Dense' must
+    not match 'Dense_0' (that is what the regex dialect's substring
+    semantics are for)."""
+    specs = match_partition_rules(((("Dense", "kernel"), P("tp")),), TREE)
+    assert specs["layer_0"]["ff"]["Dense_0"]["kernel"] == P()
+    specs = match_partition_rules(
+        (((r"Dense_\d+", "kernel"), P("tp")),), TREE
+    )
+    assert specs["layer_0"]["ff"]["Dense_0"]["kernel"] == P("tp")
+
+
+# -- spec cleaning against a concrete mesh ----------------------------------
+
+
+def test_clean_spec_drops_missing_axes_excess_rank_and_nondividing():
+    mesh = make_mesh({"dp": 2, "tp": 4}, jax.devices())
+    leaf = np.zeros((8, 6))
+    # 'ep' absent from mesh -> None; 6 % 4 != 0 -> None.
+    assert clean_spec(P("ep", "tp"), leaf, mesh) == P(None, None)
+    # rank-2 leaf, rank-3 spec -> truncated.
+    assert clean_spec(P("dp", None, "tp"), leaf, mesh) == P("dp", None)
+    # dividing dims survive.
+    assert clean_spec(P("dp", None), np.zeros((4, 3)), mesh) == P("dp", None)
+
+
+def test_shardings_from_rules_places_on_mesh():
+    mesh = make_mesh({"dp": 2, "tp": 4}, jax.devices())
+    sh = shardings_from_rules(TREE, mesh, TRANSFORMER_RULES)
+    assert sh["layer_0"]["ff"]["Dense_0"]["kernel"].spec == P(None, "tp")
+    # query kernel heads dim is 4 -> divisible by tp=4 -> sharded.
+    assert sh["layer_0"]["attention"]["query"]["kernel"].spec == \
+        P(None, "tp", None)
+    assert sh["scalar"].spec == P()
+
+
+def test_make_shard_and_gather_fns_roundtrip():
+    mesh = make_mesh({"dp": 2, "tp": 4}, jax.devices())
+    specs = match_partition_rules(MLP_RULES, {"Dense_0": {
+        "kernel": np.arange(32.0).reshape(8, 4)}})
+    shard_fns, gather_fns = make_shard_and_gather_fns(specs, mesh)
+    src = np.arange(32.0).reshape(8, 4).astype(np.float32)
+    placed = shard_fns["Dense_0"]["kernel"](src)
+    assert placed.sharding.spec == P(None, "tp")
+    back = gather_fns["Dense_0"]["kernel"](placed)
+    np.testing.assert_array_equal(back, src)
+    assert isinstance(back, np.ndarray)
+
+
+# -- fingerprints / key material --------------------------------------------
+
+
+def test_rules_fingerprint_stable_and_sensitive():
+    fp = rules_fingerprint(MLP_RULES)
+    assert fp == rules_fingerprint(tuple(MLP_RULES))  # pure content hash
+    assert fp.startswith("pr_")
+    # Order is significant (first match wins -> reorder = different table)
+    assert rules_fingerprint(tuple(reversed(MLP_RULES))) != fp
+    # Spec edits are significant.
+    edited = ((MLP_RULES[0][0], P("dp", None)),) + tuple(MLP_RULES[1:])
+    assert rules_fingerprint(edited) != fp
+    # Dialect is significant (a tuple path is not its regex rendering —
+    # the fingerprint hashes the table as written).
+    assert rules_fingerprint(((("a", "b"), P()),)) != rules_fingerprint(
+        (((r"(^|/)a/b(/|$)"), P()),)
+    )
+
+
+def test_spec_jsonable_roundtrip():
+    for spec in (P(), P("dp"), P(None, "tp", None), P(("dp", "tp"), None)):
+        assert spec_from_jsonable(spec_to_jsonable(spec)) == spec
+
+
+def test_sharded_program_key_splits_on_mesh_and_rules():
+    from distributed_machine_learning_tpu.compilecache import (
+        sharded_program_key,
+    )
+
+    cfg = {"model": "mlp", "learning_rate": 0.01, "batch_size": 16}
+    base = dict(mesh_shape={"dp": 2, "tp": 4},
+                rules_fingerprint=rules_fingerprint(MLP_RULES))
+    k = sharded_program_key(cfg, **base)
+    assert k == sharded_program_key(cfg, **base)  # stable
+    assert k != sharded_program_key(
+        cfg, mesh_shape={"dp": 4, "tp": 2},
+        rules_fingerprint=base["rules_fingerprint"],
+    )  # same 8 devices, different collectives -> different key
+    assert k != sharded_program_key(
+        cfg, mesh_shape=base["mesh_shape"],
+        rules_fingerprint=rules_fingerprint(TRANSFORMER_RULES),
+    )  # rule-table edit -> different key
+    # lr stays non-structural even under a mesh.
+    assert k == sharded_program_key(
+        dict(cfg, learning_rate=0.5), **base
+    )
+
+
+# -- the per-family registry -------------------------------------------------
+
+
+def test_rules_for_resolves_family_and_override():
+    assert rules_for({"model": "transformer"}) is TRANSFORMER_RULES
+    assert rules_for({"model": "mlp"}) is MLP_RULES
+    assert rules_for({"model": "nonesuch"}) == ((r".*", P()),)
+    override = [[r"w$", ["dp", None]], [r".*", []]]
+    resolved = rules_for({"model": "mlp", "partition_rules": override})
+    assert resolved[0] == (r"w$", P("dp", None))
+    assert resolved[1] == (r".*", P())
+
+
+def test_register_partition_rules():
+    register_partition_rules("_test_family", ((r".*", P("dp")),))
+    try:
+        assert rules_for({"model": "_test_family"}) == ((r".*", P("dp")),)
+        assert rules_fingerprint_for({"model": "_test_family"}).startswith(
+            "pr_"
+        )
+    finally:
+        PARTITION_RULE_TABLES.pop("_test_family", None)
+
+
+def test_mesh_axis_sizes():
+    mesh = make_mesh({"dp": 2, "tp": 4}, jax.devices())
+    assert mesh_axis_sizes(mesh) == {"dp": 2, "tp": 4}
+
+
+# -- the fused tier ----------------------------------------------------------
+
+
+def test_fused_epoch_matches_per_step_dispatch():
+    """One fused (scan, donated) epoch program computes the same params
+    and losses as N per-step dispatches — fusion is a dispatch-count
+    change, not a numerics change."""
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_machine_learning_tpu.models import build_model
+    from distributed_machine_learning_tpu.ops.losses import get_loss
+    from distributed_machine_learning_tpu.parallel.train_step import (
+        make_fused_epoch_step,
+        make_sharded_train_step,
+    )
+
+    mesh = make_mesh({"dp": 2, "sp": 1, "ep": 1, "tp": 4}, jax.devices())
+    cfg = {"model": "transformer", "d_model": 16, "num_heads": 4,
+           "num_layers": 1, "dim_feedforward": 32, "dropout": 0.0,
+           "max_seq_length": 8}
+    model = build_model(cfg)
+    loss_fn = get_loss("mse")
+    rng = jax.random.key(0)
+    rs = np.random.RandomState(0)
+    num_batches, batch = 3, 8
+    xb = rs.randn(num_batches, batch, 8, 4).astype(np.float32)
+    yb = rs.randn(num_batches, batch, 1).astype(np.float32)
+
+    def build(factory):
+        tx = optax.sgd(1e-2)  # stateless-ish: easy exact comparison
+        init_fn, prog = factory(model, tx, loss_fn, mesh)
+        params, opt_state = init_fn(rng, xb[0][:1])
+        return tx, prog, params, opt_state
+
+    # Per-step path: N dispatches with per-step folded keys.
+    _, step_fn, params_a, opt_a = build(make_sharded_train_step)
+    epoch_key = jax.random.key(7)
+    losses_a = []
+    for i in range(num_batches):
+        params_a, opt_a, loss = step_fn(
+            params_a, opt_a, jnp.asarray(xb[i]), jnp.asarray(yb[i]),
+            jax.random.fold_in(epoch_key, i),
+        )
+        losses_a.append(float(loss))
+
+    # Fused path: ONE dispatch over the same chunks.
+    _, epoch_fn, params_b, opt_b = build(make_fused_epoch_step)
+    params_b, opt_b, mean_loss = epoch_fn(
+        params_b, opt_b, jnp.asarray(xb), jnp.asarray(yb), epoch_key
+    )
+    assert float(mean_loss) == pytest.approx(
+        float(np.mean(losses_a)), rel=1e-5
+    )
+    for a, b in zip(jax.tree.leaves(params_a), jax.tree.leaves(params_b)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
