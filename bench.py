@@ -2004,6 +2004,171 @@ def child_probe() -> None:
 
 
 # ---------------------------------------------------------------------------
+# Child: serving soak (ISSUE 8 serve_soak section)
+
+
+def child_serve_soak() -> None:
+    """Sustained-RPS soak of the serving plane: continuous batching,
+    replica autoscaling, admission control, a chaos replica kill and a
+    zero-downtime hot swap both landing mid-soak.
+
+    The request stream is a load STEP (base -> burst -> base) so the
+    autoscaler has something real to answer; the kill lands in the first
+    base phase, the swap during the burst.  Emits ONE JSON line whose
+    claims are counter-verified from /metrics: achieved RPS, windowed
+    p50/p99 against the stated SLO, shed rate, dropped (non-shed)
+    requests (must be 0 — replica deaths redispatch server-side),
+    post-swap recompiles (must be 0 — the swap warms through the AOT
+    caches off-path), and the replica-count trajectory."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import jax
+    import numpy as np
+
+    from distributed_machine_learning_tpu import chaos, serve
+    from distributed_machine_learning_tpu.models import build_model
+
+    requests_n = int(os.environ.get("DML_SOAK_REQUESTS", "240"))
+    base_rps = float(os.environ.get("DML_SOAK_RPS", "40"))
+    burst_rps = float(os.environ.get("DML_SOAK_BURST_RPS", "120"))
+    slo_ms = float(os.environ.get("DML_SOAK_SLO_P99_MS", "500"))
+    rows, feat = 4, 8
+
+    config = {"model": "mlp", "hidden_sizes": [32, 16]}
+    model = build_model(config)
+    x0 = np.zeros((rows, feat), np.float32)
+    variables_a = model.init(jax.random.PRNGKey(0), x0, deterministic=True)
+    # The "new model" of the promotion: same architecture cohort (shared
+    # bucket programs through the AOT cache), different weights.
+    variables_b = jax.tree_util.tree_map(
+        lambda a: np.array(a) * 1.001, variables_a
+    )
+    bundle_a = serve.ServableBundle(
+        config=dict(config), variables=variables_a, path="soak://a"
+    )
+    bundle_b = serve.ServableBundle(
+        config=dict(config), variables=variables_b, path="soak://b"
+    )
+
+    kill_at = max(requests_n // 4, 2)
+    swap_at = max(requests_n // 2, 4)
+    plan = chaos.FaultPlan(
+        seed=7, replica_kills=((kill_at, -1),), hot_swaps=(swap_at,),
+    )
+    srv = serve.PredictionServer(
+        bundle_a, port=0, num_replicas=2,
+        max_batch_size=16, max_bucket=16, batcher="continuous",
+        max_queue=256, shed_watermark=192,
+        autoscale=serve.AutoscaleConfig(
+            min_replicas=1, max_replicas=3, up_queue_depth=6,
+            slo_p99_ms=slo_ms, down_idle_s=1.0, cooldown_s=0.5,
+            interval_s=0.1,
+        ),
+        request_timeout_s=30.0, fault_plan=plan,
+    )
+    srv.warmup(x0)
+    swap_done = threading.Event()
+
+    def do_swap():
+        serve.hot_swap(srv.replicas, bundle_b, sample=x0)
+        swap_done.set()
+
+    srv.replicas.on_swap_signal = do_swap
+    host, port = srv.start()
+    url = f"http://{host}:{port}/predict"
+    payload = json.dumps({"instances": x0.tolist()}).encode()
+
+    counts = {"ok": 0, "shed": 0, "dropped": 0}
+    counts_lock = threading.Lock()
+
+    def one_request():
+        req = urllib.request.Request(
+            url, data=payload,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                resp.read()
+            key = "ok"
+        except urllib.error.HTTPError as exc:
+            # Honest shed = an admission/breaker answer WITH backpressure
+            # (Retry-After); anything else the client never got is a drop.
+            shed = exc.code == 429 or (
+                exc.code == 503 and exc.headers.get("Retry-After")
+            )
+            key = "shed" if shed else "dropped"
+        except Exception:  # noqa: BLE001 - network-level failure = drop
+            key = "dropped"
+        with counts_lock:
+            counts[key] += 1
+
+    burst_lo, burst_hi = requests_n * 2 // 5, requests_n * 4 // 5
+    t0 = time.time()
+    threads = []
+    for i in range(requests_n):
+        th = threading.Thread(target=one_request, daemon=True)
+        th.start()
+        threads.append(th)
+        rps = burst_rps if burst_lo <= i < burst_hi else base_rps
+        time.sleep(1.0 / rps)
+    for th in threads:
+        th.join(timeout=60)
+    soak_wall = time.time() - t0
+    swap_landed = swap_done.wait(timeout=30)
+
+    # Post-step settle: the trajectory should come back DOWN after the
+    # load stops (down_idle_s + cooldown; bounded wait, not a sleep).
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if srv.replicas.scale_stats()["scale_downs"] >= 1:
+            break
+        time.sleep(0.2)
+
+    m = srv.handle_metrics()
+    scale = m["autoscale"]
+    faults = plan.snapshot()
+    result = {
+        "platform": "cpu",
+        "requests": requests_n,
+        "ok": counts["ok"],
+        "shed": counts["shed"],
+        "dropped": counts["dropped"],
+        "shed_rate": round(counts["shed"] / max(requests_n, 1), 4),
+        "achieved_rps": round(counts["ok"] / max(soak_wall, 1e-9), 2),
+        "offered_rps": round(requests_n / max(soak_wall, 1e-9), 2),
+        "p50_ms": m["latency_ms_p50"],
+        "p99_ms": m["latency_ms_p99"],
+        "slo_ms": slo_ms,
+        "slo_met": bool(m["latency_ms_p99"] <= slo_ms),
+        "latency_window": m["latency_window"],
+        "replica_kills": faults.get("replica_kills", 0),
+        "hot_swap_signals": faults.get("hot_swap_signals", 0),
+        "swap_landed": bool(swap_landed),
+        "swaps_total": m["swap"]["swaps_total"],
+        "post_swap_new_programs": m["compile"]["new_programs_since_warmup"],
+        "redispatches": m["admission"]["redispatches"],
+        "sheds_total": m["admission"]["sheds_total"],
+        # restarts may be 0 when the swap replaced the dead slot before
+        # the monitor's next tick — "healed" is the invariant, the healer
+        # is a race between two working recovery paths.
+        "restarts": m["restarts"],
+        "replicas_healthy": m["num_healthy"],
+        "breaker_opens": m["breakers"]["opens_total"],
+        "scale_ups": scale["scale_ups"],
+        "scale_downs": scale["scale_downs"],
+        "replicas_final": scale["replicas"],
+        "trajectory": [
+            (e["t_s"], e["replicas"]) for e in scale["events"]
+        ],
+        "wall_s": round(soak_wall, 2),
+    }
+    srv.close()
+    print(json.dumps(result))
+
+
+# ---------------------------------------------------------------------------
 # Parent orchestration
 
 
@@ -2133,11 +2298,25 @@ def emit(value: float, vs_baseline, backend: str, extra: dict) -> None:
         compact["probe_attempts"] = len(probe["attempts"])
     if probe.get("probe_cached"):
         compact["probe_cached"] = probe["probe_cached"]
+    if probe.get("probe_wedge_signature"):
+        compact["probe_wedge_signature"] = (
+            probe["probe_wedge_signature"]["signature"]
+        )
+    ss = extra.get("serve_soak")
+    if ss:
+        compact["serve_soak"] = (
+            {"error": str(ss["error"])[-120:]} if "error" in ss else
+            {k: ss.get(k) for k in (
+                "achieved_rps", "p99_ms", "slo_met", "shed_rate",
+                "dropped", "post_swap_new_programs", "scale_ups",
+                "scale_downs",
+            ) if ss.get(k) is not None}
+        )
     # Belt-and-braces: drop optional blocks until the line fits the
     # driver's tail capture (never the metric/value/backend core).
     out = json.dumps(compact)
     for k in ("compile_cache", "cold_second_run", "last_tpu_capture",
-              "flagship_prev", "asha", "flagship",
+              "flagship_prev", "asha", "flagship", "serve_soak",
               "quality_at_budget", "warm_skipped_after", "error"):
         if len(out) <= EMIT_MAX_CHARS:
             break
@@ -2177,6 +2356,27 @@ INTER_CHILD_GAP_S = 15.0
 _PROBE_MEMO: dict = {}
 
 
+def _wedge_signature(cause: str) -> str:
+    """Stable signature of a failed probe attempt's stderr.
+
+    BENCH_r05 burned 4 attempts x rc=124 on the SAME "Platform 'axon' is
+    experimental" wedge line — the schedule retried a failure mode whose
+    repetition already proved it was not transient.  Normalizing the
+    volatile parts (hex addresses, digits, paths, whitespace) lets two
+    attempts be compared: an identical signature twice running means a
+    deterministic wedge, and the schedule's remaining attempts are pure
+    wall-time loss."""
+    import hashlib
+    import re as _re
+
+    text = (cause or "").strip().lower()
+    text = _re.sub(r"0x[0-9a-f]+", "@", text)
+    text = _re.sub(r"/[\w\-./]+", "/P", text)
+    text = _re.sub(r"\d+", "#", text)
+    text = _re.sub(r"\s+", " ", text)
+    return hashlib.sha256(text.encode()).hexdigest()[:12]
+
+
 def _probe_tpu(log, probe_info, schedule,
                budget_s: float = PROBE_TOTAL_BUDGET_S) -> tuple:
     """Run probe attempts per ``schedule``; returns (probe_ok, tunnel_ok).
@@ -2204,6 +2404,7 @@ def _probe_tpu(log, probe_info, schedule,
         return probe_ok, tunnel_ok
     probe_ok, tunnel_ok = False, True
     t_start = time.time()
+    prev_sig = None
     for timeout_s, backoff_s in schedule:
         elapsed = time.time() - t_start
         if elapsed + backoff_s + timeout_s > budget_s:
@@ -2242,6 +2443,30 @@ def _probe_tpu(log, probe_info, schedule,
             probe_info["zombie_claimant"] = True
             tunnel_ok = False
             break
+        # Repeated-wedge fast path (BENCH_r05: 4 attempts x rc=124 on one
+        # identical stderr line): a TIMEOUT whose normalized signature
+        # matches the previous attempt's is deterministic, not transient —
+        # fall back to CPU after this one repeat instead of burning the
+        # rest of the schedule.  The signature lands in the artifact.
+        # rc=124 only: fast non-wedge failures keep their full retry
+        # schedule (each costs seconds, and transient causes repeat too).
+        if rc != 124:
+            prev_sig = None
+            continue
+        sig = _wedge_signature(cause)
+        probe_info["attempts"][-1]["signature"] = sig
+        if prev_sig is not None and sig == prev_sig:
+            log(
+                f"probe failed twice with identical wedge signature {sig}; "
+                f"abandoning the TPU path without further attempts"
+            )
+            probe_info["probe_wedge_signature"] = {
+                "signature": sig,
+                "snippet": (cause or "timeout (no output)")[-160:],
+                "attempts": len(probe_info["attempts"]),
+            }
+            break
+        prev_sig = sig
     probe_info["total_s"] = round(
         probe_info.get("total_s", 0.0) + (time.time() - t_start), 1
     )
@@ -2507,6 +2732,25 @@ def main() -> None:
     if torch_res is None:
         log(f"torch baseline failed rc={rc}; tail: {err[-500:]}")
 
+    # serve_soak section (ISSUE 8): the serving plane under sustained RPS
+    # with a chaos replica kill + zero-downtime hot swap mid-soak.  Always
+    # a CPU child (never claims the tunnel); latency numbers are host-
+    # relative, the zero-drop / zero-recompile / trajectory claims are
+    # platform-independent counters.
+    serve_soak = None
+    if os.environ.get("DML_BENCH_SERVE_SOAK", "1") != "0" \
+            and ours is not None:
+        log("running serve_soak (continuous batching + autoscale + chaos)")
+        t0 = time.time()
+        rc, out, err, _ = _run_child(
+            ["--child", "serve_soak"], _cpu_env(), 300
+        )
+        phases["serve_soak_s"] = round(time.time() - t0, 1)
+        serve_soak = _parse_result(out) if rc == 0 else None
+        if serve_soak is None:
+            log(f"serve_soak child failed rc={rc}; tail: {err[-300:]}")
+            serve_soak = {"error": (err or out)[-300:]}
+
     # Equal-budget quality comparison (BASELINE.md row 4): ours came from
     # the suite on the TPU path; on the CPU path run it here (CPU children
     # never claim the tunnel).  The torch side always runs on CPU — the
@@ -2639,6 +2883,8 @@ def main() -> None:
     }
     if quality:
         extra["quality_at_budget"] = quality
+    if serve_soak is not None:
+        extra["serve_soak"] = serve_soak
     if backend == "cpu":
         # On a dead-tunnel day the artifact still carries the most recent
         # real-chip suite, provenance-stamped with its capture time (the
@@ -2727,6 +2973,8 @@ if __name__ == "__main__":
         kind = argv[1]
         if kind == "probe":
             child_probe()
+        elif kind == "serve_soak":
+            child_serve_soak()
         elif kind == "flagship":
             child_flagship()
         elif kind == "sharded_flagship":

@@ -290,11 +290,35 @@ def _serve(rest) -> None:
                    help="a bundle directory (export-bundle's output)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000)
-    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--replicas", type=int, default=2,
+                   help="initial replica count")
     p.add_argument("--max-batch-size", type=int, default=64)
-    p.add_argument("--max-latency-ms", type=float, default=5.0)
+    p.add_argument("--max-latency-ms", type=float, default=5.0,
+                   help="micro-batcher flush deadline (--batcher micro)")
     p.add_argument("--max-bucket", type=int, default=256,
                    help="largest padded batch program (power-of-two grid)")
+    p.add_argument("--batcher", choices=("continuous", "micro"),
+                   default="continuous",
+                   help="continuous = inflight, depth-adaptive flushes "
+                        "(default); micro = size-or-latency")
+    p.add_argument("--max-queue", type=int, default=1024,
+                   help="bounded per-replica request queue; a full queue "
+                        "answers 429 + Retry-After")
+    p.add_argument("--target-step-ms", type=float, default=None,
+                   help="latency budget per flush: the continuous batcher "
+                        "steps its batch cap down the bucket grid while "
+                        "the measured step time exceeds this")
+    p.add_argument("--shed-watermark", type=int, default=None,
+                   help="total queued requests past which admission "
+                        "control sheds with 429 (default: off)")
+    p.add_argument("--min-replicas", type=int, default=None,
+                   help="autoscaler floor (default: --replicas)")
+    p.add_argument("--max-replicas", type=int, default=None,
+                   help="autoscaler ceiling; > --min-replicas enables the "
+                        "autoscaler (default: off)")
+    p.add_argument("--slo-p99-ms", type=float, default=None,
+                   help="autoscaler scale-up trigger on windowed p99")
+    p.add_argument("--autoscale-interval-s", type=float, default=0.5)
     p.add_argument("--tb-logdir", default=None,
                    help="stream /metrics scalars to a TensorBoard run dir")
     p.add_argument("--warmup-shape", default=None,
@@ -315,6 +339,17 @@ def _serve(rest) -> None:
     except (FileNotFoundError, ValueError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         raise SystemExit(1) from None
+    autoscale = None
+    lo = args.min_replicas if args.min_replicas is not None else args.replicas
+    hi = args.max_replicas if args.max_replicas is not None else args.replicas
+    if hi > lo:
+        from distributed_machine_learning_tpu.serve import AutoscaleConfig
+
+        autoscale = AutoscaleConfig(
+            min_replicas=lo, max_replicas=hi,
+            slo_p99_ms=args.slo_p99_ms,
+            interval_s=args.autoscale_interval_s,
+        )
     server = PredictionServer(
         bundle,
         host=args.host,
@@ -323,6 +358,11 @@ def _serve(rest) -> None:
         max_batch_size=args.max_batch_size,
         max_latency_ms=args.max_latency_ms,
         max_bucket=args.max_bucket,
+        batcher=args.batcher,
+        max_queue=args.max_queue,
+        target_step_ms=args.target_step_ms,
+        shed_watermark=args.shed_watermark,
+        autoscale=autoscale,
         tb_logdir=args.tb_logdir,
     )
     if args.warmup_shape:
@@ -336,7 +376,11 @@ def _serve(rest) -> None:
         "serving": f"http://{host}:{port}",
         "model_family": bundle.model_family,
         "replicas": args.replicas,
-        "endpoints": ["/predict", "/healthz", "/metrics"],
+        "batcher": args.batcher,
+        "autoscale": (
+            {"min": lo, "max": hi} if autoscale is not None else None
+        ),
+        "endpoints": ["/predict", "/healthz", "/metrics", "/admin/swap"],
     }), flush=True)
     try:
         while True:

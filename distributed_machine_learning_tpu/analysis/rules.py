@@ -962,6 +962,104 @@ class UndonatedHotJitRule(Rule):
                     )
 
 
+# --------------------------------------------------------------------------
+# DML009 unbounded-queue
+# --------------------------------------------------------------------------
+
+
+# Serving request-path modules: anything a /predict request's bytes flow
+# through.  export.py is deliberately absent (bundle IO, no request path).
+SERVE_REQUEST_PATH_PATTERNS = (
+    "serve/batcher.py",
+    "serve/engine.py",
+    "serve/replica.py",
+    "serve/server.py",
+    "serve/metrics.py",
+    "serve/autoscale.py",
+    "serve/swap.py",
+)
+
+_QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue"}
+
+
+class UnboundedQueueRule(Rule):
+    name = "unbounded-queue"
+    rule_id = "DML009"
+    severity = "error"
+    description = (
+        "queue.Queue()/collections.deque() without a maxsize/maxlen bound "
+        "in a serve/ request-path module: overload then accumulates "
+        "instead of shedding — admission control cannot refuse what an "
+        "unbounded queue already swallowed, latency grows without limit, "
+        "and the process OOMs instead of answering 429.  Every request-"
+        "path queue must carry an explicit bound (SimpleQueue has none "
+        "and is always flagged)."
+    )
+    _HINT = (
+        "bound it: Queue(maxsize=N) / deque(maxlen=N), and shed at "
+        "admission (QueueFull -> 429 + Retry-After) when it fills"
+    )
+
+    def applies(self, ctx) -> bool:
+        if "serve-request-path" in ctx.scopes:
+            return True
+        rel = ctx.display_path.replace("\\", "/")
+        return any(pat in rel for pat in SERVE_REQUEST_PATH_PATTERNS)
+
+    @staticmethod
+    def _is_unbounded_const(node: ast.AST) -> bool:
+        """maxsize=0 / maxsize=-1 / maxlen=None are spelled-out
+        unboundedness, not bounds."""
+        return isinstance(node, ast.Constant) and node.value in (0, None) \
+            or (
+                isinstance(node, ast.UnaryOp)
+                and isinstance(node.op, ast.USub)
+                and isinstance(node.operand, ast.Constant)
+            )
+
+    def check(self, ctx) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _call_name(node) or ""
+            base, _, attr = callee.rpartition(".")
+            if attr == "SimpleQueue" or (
+                not base and callee == "SimpleQueue"
+            ):
+                yield self.finding(
+                    ctx, node,
+                    "SimpleQueue has no capacity bound at all — a "
+                    "request-path queue must be boundable",
+                    self._HINT,
+                )
+                continue
+            name = attr or callee
+            if name in _QUEUE_CTORS:
+                bound = node.args[0] if node.args else next(
+                    (kw.value for kw in node.keywords
+                     if kw.arg == "maxsize"), None,
+                )
+                if bound is None or self._is_unbounded_const(bound):
+                    yield self.finding(
+                        ctx, node,
+                        f"`{callee}()` without a positive maxsize on the "
+                        f"serve request path",
+                        self._HINT,
+                    )
+            elif name == "deque":
+                bound = node.args[1] if len(node.args) >= 2 else next(
+                    (kw.value for kw in node.keywords
+                     if kw.arg == "maxlen"), None,
+                )
+                if bound is None or self._is_unbounded_const(bound):
+                    yield self.finding(
+                        ctx, node,
+                        f"`{callee}()` without a maxlen bound on the "
+                        f"serve request path",
+                        self._HINT,
+                    )
+
+
 ALL_RULES: List[Rule] = [
     DonationAliasRule(),
     UnlockedDispatchRule(),
@@ -971,6 +1069,7 @@ ALL_RULES: List[Rule] = [
     ImportTraceRule(),
     ThreadSwallowRule(),
     UndonatedHotJitRule(),
+    UnboundedQueueRule(),
 ]
 
 

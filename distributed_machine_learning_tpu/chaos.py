@@ -107,6 +107,12 @@ class FaultPlan:
       index (1-based: ``(10, 0)`` kills replica 0 at the 10th request).
       ``replica_idx=-1`` kills whichever replica is serving that request —
       the deterministic way to fail an in-flight request.
+    * ``hot_swaps`` — request indices; when the ReplicaSet's dispatch
+      counter reaches each one it fires ``on_swap_signal`` (the soak
+      harness registers a callback that performs the zero-downtime
+      bundle swap, ``serve/swap.py``) on a helper thread — the
+      deterministic way to land a model promotion MID-soak, keyed to the
+      same dispatch counter as the kills.
 
     Fail-SLOW faults (each fires exactly once; nothing raises — recovery
     depends on the liveness layer noticing the silence):
@@ -140,6 +146,7 @@ class FaultPlan:
         corrupt_path_substrings: Sequence[str] = (),
         trial_crashes: Iterable[Tuple[str, int]] = (),
         replica_kills: Iterable[Tuple[int, int]] = (),
+        hot_swaps: Iterable[int] = (),
         hang_dispatch_at: Iterable[Tuple[str, int]] = (),
         hang_s: float = 1.5,
         stall_storage_paths: Sequence[str] = (),
@@ -159,6 +166,7 @@ class FaultPlan:
         self._kills = sorted(
             ((int(n), int(r)) for n, r in replica_kills), reverse=True
         )
+        self._hot_swaps = sorted((int(n) for n in hot_swaps), reverse=True)
         # Fail-slow faults (PR 3): dispatch hangs, storage stalls, worker
         # partitions — silence, not errors, so only liveness machinery
         # (liveness.py watchdogs, cluster lease expiry) can recover them.
@@ -343,6 +351,20 @@ class FaultPlan:
                 )
                 return idx
         return None
+
+    def poll_hot_swap(self) -> bool:
+        """True when a scheduled mid-soak bundle swap comes due.  Reads the
+        dispatch counter :meth:`poll_replica_kill` advances (call order in
+        ``ReplicaSet.submit``: kill poll first, then this) so kills and
+        swaps share one deterministic request timeline."""
+        with self._lock:
+            if self._hot_swaps and self._submit_count >= self._hot_swaps[-1]:
+                self._hot_swaps.pop()
+                self._counters["hot_swap_signals"] = (
+                    self._counters.get("hot_swap_signals", 0) + 1
+                )
+                return True
+        return False
 
 
 def corrupt_bytes(data: bytes, flip_every: int = 97) -> bytes:

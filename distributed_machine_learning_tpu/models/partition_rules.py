@@ -42,15 +42,32 @@ MLP_RULES: Tuple = (
     (r".*", P()),
 )
 
-# Conv families: channel dims are small relative to tp on realistic
-# meshes; replicate (dp carries the parallelism).  Dense heads column-
-# shard where divisible.
+# Conv families (ROADMAP item 1 remainder): a Conv1d kernel is
+# (window, in_ch, out_ch) — out-channel is the reduction-free dim, so
+# column-shard it over tp (each shard computes its own channel slice; no
+# collective until a later row-sharded matmul reduces).  The Dense head
+# pair then alternates column-then-row like every other family, so one
+# reduce per head suffices.  Biases/norms replicate (cutting a bias
+# against its only dim buys nothing); clean_spec drops the tp axis
+# per-leaf where a channel count does not divide the mesh.
 CNN_RULES: Tuple = (
+    (r"Conv_\d+/kernel$", P(None, None, "tp")),
     (r"Dense_0/kernel$", P(None, "tp")),
+    (r"Dense_1/kernel$", P("tp", None)),
     (r".*", P()),
 )
 
+# Recurrent families: every LSTM/GRU gate is a Dense producing the hidden
+# dim — input kernels (i\w: ii/if/ig/io, ir/iz/in) are (features, hidden)
+# and recurrent kernels (h\w: hi/hf/hg/ho, hr/hz/hn) are (hidden, hidden);
+# column-shard both over tp so each shard owns a hidden-slice of every
+# gate and the scan's per-step matmuls stay local.  The MLP head then
+# alternates column (head_*) / row (out) to close with one reduce.
 RNN_RULES: Tuple = (
+    (r"(lstm|gru)_\d+/i[a-z]{1,2}/kernel$", P(None, "tp")),
+    (r"(lstm|gru)_\d+/h[a-z]{1,2}/kernel$", P(None, "tp")),
+    (r"head_\d+/kernel$", P(None, "tp")),
+    (r"out/kernel$", P("tp", None)),
     (r".*", P()),
 )
 

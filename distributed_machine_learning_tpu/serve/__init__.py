@@ -7,20 +7,36 @@ winner, not just find it)::
 
     serve.export_bundle(analysis, "/models/winner")     # or an exp dir
     bundle = serve.load_bundle("/models/winner")
-    srv = serve.PredictionServer(bundle, num_replicas=2)
+    srv = serve.PredictionServer(
+        bundle, num_replicas=2,
+        autoscale=serve.AutoscaleConfig(min_replicas=1, max_replicas=4),
+    )
     srv.warmup(sample_batch)
     host, port = srv.start()                            # POST /predict
+    srv.replicas.hot_swap(serve.load_bundle("/models/next"))  # live swap
 
 Layering: ``export`` freezes the best trial into a self-describing bundle;
 ``engine`` runs jit-compiled, shape-bucketed forward passes; ``batcher``
-micro-batches concurrent requests; ``replica`` scales engines across
-leased devices with failover; ``server`` is the stdlib HTTP front end;
-``metrics`` the latency/throughput accounting behind ``/metrics``.
+coalesces concurrent requests — continuous (inflight, depth-adaptive,
+bounded-queue) by default, micro (size-or-latency) on request;
+``replica`` scales engines across leased devices with failover and
+elastic add/remove; ``autoscale`` drives the replica count from windowed
+p99 + queue depth; ``swap`` hot-swaps a new bundle with zero dropped
+requests and zero serving-path compiles; ``server`` is the stdlib HTTP
+front end (429 load shedding, ``/admin/swap``); ``metrics`` the
+ring-buffer-windowed latency/throughput accounting behind ``/metrics``.
 """
 
+from distributed_machine_learning_tpu.serve.autoscale import (
+    AutoscaleConfig,
+    ReplicaAutoscaler,
+)
 from distributed_machine_learning_tpu.serve.batcher import (
     BatcherStats,
+    BatcherStopped,
+    ContinuousBatcher,
     MicroBatcher,
+    QueueFull,
 )
 from distributed_machine_learning_tpu.serve.engine import (
     InferenceEngine,
@@ -32,32 +48,45 @@ from distributed_machine_learning_tpu.serve.export import (
     export_bundle,
     load_bundle,
 )
-from distributed_machine_learning_tpu.serve.metrics import ServeMetrics
+from distributed_machine_learning_tpu.serve.metrics import (
+    LatencyWindow,
+    ServeMetrics,
+)
 from distributed_machine_learning_tpu.serve.replica import (
     AllReplicasOpen,
     CircuitBreaker,
+    Overloaded,
     Replica,
     ReplicaSet,
     ReplicaTimeout,
     replica_process_env,
 )
 from distributed_machine_learning_tpu.serve.server import PredictionServer
+from distributed_machine_learning_tpu.serve.swap import hot_swap
 
 __all__ = [
     "AllReplicasOpen",
+    "AutoscaleConfig",
     "BUNDLE_VERSION",
     "BatcherStats",
+    "BatcherStopped",
     "CircuitBreaker",
+    "ContinuousBatcher",
     "InferenceEngine",
+    "LatencyWindow",
     "MicroBatcher",
+    "Overloaded",
     "PredictionServer",
+    "QueueFull",
     "Replica",
+    "ReplicaAutoscaler",
     "ReplicaSet",
     "ReplicaTimeout",
     "ServableBundle",
     "ServeMetrics",
     "bucket_sizes",
     "export_bundle",
+    "hot_swap",
     "load_bundle",
     "replica_process_env",
 ]

@@ -1,0 +1,211 @@
+"""Replica autoscaling: p99 + queue depth drive the replica count.
+
+A static replica count serves a static load; real traffic steps (ROADMAP
+item 2: millions-of-users bursts).  The autoscaler closes the loop between
+the signals the serving plane already measures and the elastic ReplicaSet:
+
+* **scale up** when the windowed p99 (``serve/metrics.py`` ring buffer —
+  CURRENT traffic, never lifetime history) breaches the SLO, or queued
+  requests per *effective* replica pass a watermark;
+* **scale down** when both signals have stayed quiet for a sustained
+  period (a single idle tick must not flap the fleet);
+* **breaker- and monitor-aware**: a quarantined (open-breaker) or dead
+  replica is not capacity — effective replicas = healthy minus open, so
+  a chaos kill reads as LOST capacity and can trigger a compensating
+  scale-up rather than masking the gap.
+
+Every decision lands in a bounded ring (``decisions``) and every actual
+resize in ``ReplicaSet.scale_events`` — the replica-count trajectory that
+``/metrics`` exposes and the soak tests assert (acceptance: demonstrably
+up under a load step, back down after it).
+
+Deterministic testing: :meth:`ReplicaAutoscaler.tick` is the whole
+policy, callable without the thread; ``start()`` merely runs it on an
+interval.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from distributed_machine_learning_tpu.analysis.locks import named_lock
+
+
+@dataclass
+class AutoscaleConfig:
+    """Scaling policy knobs (docs/operations.md "Serving under load")."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # Scale up when queued requests per effective replica reach this.
+    up_queue_depth: int = 8
+    # Scale up when windowed p99 exceeds this (None = depth signal only).
+    slo_p99_ms: Optional[float] = None
+    # Both signals must stay quiet this long before a scale-down.
+    down_idle_s: float = 5.0
+    # Minimum gap between two resizes (either direction).
+    cooldown_s: float = 2.0
+    # Thread poll interval (tick cadence).
+    interval_s: float = 0.5
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1: {self.min_replicas}"
+            )
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas {self.max_replicas} < min_replicas "
+                f"{self.min_replicas}"
+            )
+
+
+class ReplicaAutoscaler:
+    """Grows/shrinks a :class:`~..serve.replica.ReplicaSet` between
+    configured bounds from p99-latency and queue-depth signals.
+
+    ``replica_set`` needs the elastic surface (``add_replica`` /
+    ``remove_replica`` / ``queue_depth_total`` / ``num_healthy`` /
+    ``breaker_stats`` / ``replicas``); ``metrics`` needs ``p99_ms()``
+    (the windowed quantile).  Both are duck-typed so tests can drive the
+    policy with stubs."""
+
+    def __init__(self, replica_set, metrics, config: AutoscaleConfig,
+                 name: str = "autoscaler"):
+        self.rs = replica_set
+        self.metrics = metrics
+        self.cfg = config
+        self._lock = named_lock("serve.autoscaler")
+        self._closing = False
+        self._last_resize = 0.0          # monotonic; 0 = never
+        self._quiet_since: Optional[float] = None
+        self.decisions: deque = deque(maxlen=256)
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self._thread: Optional[threading.Thread] = None
+        self._name = name
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, name=self._name, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._closing:
+            time.sleep(self.cfg.interval_s)
+            if self._closing:
+                return
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 - observer isolation, counted
+                with self._lock:
+                    self.decisions.append(
+                        {"action": "error", "t_mono": time.monotonic()}
+                    )
+
+    def close(self):
+        self._closing = True
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    # -- policy --------------------------------------------------------------
+
+    def _signals(self) -> Dict[str, Any]:
+        depth = self.rs.queue_depth_total()
+        healthy = self.rs.num_healthy()
+        open_breakers = self.rs.breaker_stats().get("open_replicas", 0)
+        effective = max(healthy - open_breakers, 0)
+        return {
+            "queue_depth": depth,
+            "replicas": len(self.rs.replicas),
+            "healthy": healthy,
+            "open_breakers": open_breakers,
+            "effective": effective,
+            "p99_ms": round(self.metrics.p99_ms(), 3),
+        }
+
+    def tick(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """One policy evaluation; returns the decision record."""
+        now = time.monotonic() if now is None else now
+        sig = self._signals()
+        cfg = self.cfg
+        action = "hold"
+        reason = ""
+
+        effective = max(sig["effective"], 1)
+        depth_per = sig["queue_depth"] / effective
+        slo_breach = (
+            cfg.slo_p99_ms is not None and sig["p99_ms"] > cfg.slo_p99_ms
+        )
+        depth_breach = depth_per >= cfg.up_queue_depth
+        lost_capacity = sig["effective"] < cfg.min_replicas
+        quiet = not slo_breach and not depth_breach and sig["queue_depth"] == 0
+
+        in_cooldown = (
+            self._last_resize > 0.0
+            and now - self._last_resize < cfg.cooldown_s
+        )
+        if quiet:
+            if self._quiet_since is None:
+                self._quiet_since = now
+        else:
+            self._quiet_since = None
+
+        if (depth_breach or slo_breach or lost_capacity) \
+                and sig["replicas"] < cfg.max_replicas and not in_cooldown:
+            reason = ("queue_depth" if depth_breach else
+                      "p99_slo" if slo_breach else "lost_capacity")
+            if self.rs.add_replica(reason=f"autoscale_up:{reason}"):
+                action = "scale_up"
+                self._last_resize = now
+                with self._lock:
+                    self.scale_ups += 1
+        elif (
+            quiet
+            and sig["replicas"] > cfg.min_replicas
+            and not in_cooldown
+            and self._quiet_since is not None
+            and now - self._quiet_since >= cfg.down_idle_s
+        ):
+            if self.rs.remove_replica(reason="autoscale_down:idle"):
+                action = "scale_down"
+                reason = "idle"
+                self._last_resize = now
+                # Re-arm: the next shrink needs a fresh quiet period.
+                self._quiet_since = now
+                with self._lock:
+                    self.scale_downs += 1
+
+        decision = {"action": action, "reason": reason, **sig}
+        with self._lock:
+            self.decisions.append(decision)
+        return decision
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Autoscaler state for ``/metrics``."""
+        with self._lock:
+            decisions = list(self.decisions)[-16:]
+            ups, downs = self.scale_ups, self.scale_downs
+        return {
+            "config": {
+                "min_replicas": self.cfg.min_replicas,
+                "max_replicas": self.cfg.max_replicas,
+                "up_queue_depth": self.cfg.up_queue_depth,
+                "slo_p99_ms": self.cfg.slo_p99_ms,
+                "down_idle_s": self.cfg.down_idle_s,
+                "cooldown_s": self.cfg.cooldown_s,
+            },
+            "scale_ups": ups,
+            "scale_downs": downs,
+            "last_decisions": decisions,
+        }

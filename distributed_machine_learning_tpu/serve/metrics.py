@@ -4,13 +4,17 @@ Plain-JSON first (the ``/metrics`` endpoint), with the same scalars
 optionally streamed through ``utils/tensorboard.py`` so a serving process
 shows up next to training runs in one TensorBoard — no tensorflow
 dependency either way.
+
+Latency quantiles are computed over a bounded sliding window
+(:class:`LatencyWindow`, a preallocated ring buffer): a long soak's
+``/metrics`` must describe CURRENT traffic, not lifetime history — and the
+autoscaler (``serve/autoscale.py``) keys its p99 signal off the same
+windowed value, so a stale quantile would also stall scale-up.
 """
 
 from __future__ import annotations
 
-import threading
 import time
-from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 from distributed_machine_learning_tpu.analysis.locks import named_lock
 
@@ -23,39 +27,79 @@ def percentile(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+class LatencyWindow:
+    """Fixed-capacity ring buffer of latency samples (milliseconds).
+
+    Preallocated storage, O(1) insert, newest ``capacity`` samples win:
+    a month-long soak reports the p99 of recent traffic, and a live
+    regression is never averaged away under lifetime history.  Not
+    thread-safe on its own — :class:`ServeMetrics` holds the lock.
+    """
+
+    def __init__(self, capacity: int = 1024):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1: {capacity}")
+        self.capacity = int(capacity)
+        self._buf = [0.0] * self.capacity
+        self._next = 0
+        self._count = 0
+
+    def add(self, value: float) -> None:
+        self._buf[self._next] = float(value)
+        self._next = (self._next + 1) % self.capacity
+        if self._count < self.capacity:
+            self._count += 1
+
+    def __len__(self) -> int:
+        return self._count
+
+    def values(self) -> List[float]:
+        """Window contents, oldest first."""
+        if self._count < self.capacity:
+            return self._buf[: self._count]
+        return self._buf[self._next:] + self._buf[: self._next]
+
+
 class ServeMetrics:
     """Thread-safe request accounting for one serving process.
 
-    Latencies keep a bounded window (the newest ``window`` samples) — p50
-    and p99 over recent traffic, not a lifetime average that hides a
-    regression behind a month of history.
+    Counters are lifetime totals; latency quantiles are windowed
+    (``window`` newest samples — see :class:`LatencyWindow`).
     """
 
-    def __init__(self, window: int = 4096):
+    def __init__(self, window: int = 1024):
         self._lock = named_lock("serve.metrics")
-        self._latencies_ms: deque = deque(maxlen=window)
+        self._latencies_ms = LatencyWindow(window)
         self._started_at = time.time()
         self.requests = 0
         self.rows = 0
         self.errors = 0
         self.rejected = 0
         self.timeouts = 0
+        self.sheds = 0
 
     def observe(self, latency_s: float, rows: int):
         with self._lock:
             self.requests += 1
             self.rows += rows
-            self._latencies_ms.append(latency_s * 1000.0)
+            self._latencies_ms.add(latency_s * 1000.0)
 
     def observe_error(self):
         with self._lock:
             self.errors += 1
 
     def observe_rejected(self):
-        """A load-shed 503 (all breakers open) — counted apart from errors
-        so shedding under chaos is distinguishable from failing."""
+        """A breaker 503 (all replicas quarantined) — counted apart from
+        errors so quarantine under chaos is distinguishable from failing."""
         with self._lock:
             self.rejected += 1
+
+    def observe_shed(self):
+        """An admission-control 429 (queue depth past the watermark) —
+        load deliberately turned away, the backpressure counter the
+        "Serving under load" runbook keys on."""
+        with self._lock:
+            self.sheds += 1
 
     def observe_timeout(self):
         """A request that missed its /predict deadline (hung replica, 504)
@@ -63,9 +107,19 @@ class ServeMetrics:
         with self._lock:
             self.timeouts += 1
 
+    def p50_ms(self) -> float:
+        """Windowed p50 — current traffic only."""
+        with self._lock:
+            return percentile(sorted(self._latencies_ms.values()), 50.0)
+
+    def p99_ms(self) -> float:
+        """Windowed p99 — the autoscaler's latency signal."""
+        with self._lock:
+            return percentile(sorted(self._latencies_ms.values()), 99.0)
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
-            lat = sorted(self._latencies_ms)
+            lat = sorted(self._latencies_ms.values())
             uptime = max(time.time() - self._started_at, 1e-9)
             return {
                 "uptime_s": round(uptime, 1),
@@ -73,12 +127,14 @@ class ServeMetrics:
                 "rows_total": self.rows,
                 "errors_total": self.errors,
                 "rejected_total": self.rejected,
+                "shed_total": self.sheds,
                 "timeouts_total": self.timeouts,
                 "requests_per_s": round(self.requests / uptime, 2),
                 "rows_per_s": round(self.rows / uptime, 2),
                 "latency_ms_p50": round(percentile(lat, 50.0), 3),
                 "latency_ms_p99": round(percentile(lat, 99.0), 3),
                 "latency_window": len(lat),
+                "latency_window_capacity": self._latencies_ms.capacity,
             }
 
     def scalar_pairs(self) -> List[Tuple[str, float]]:

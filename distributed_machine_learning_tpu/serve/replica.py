@@ -34,7 +34,12 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from distributed_machine_learning_tpu.analysis.locks import named_lock
-from distributed_machine_learning_tpu.serve.batcher import MicroBatcher
+from distributed_machine_learning_tpu.serve.batcher import (
+    BatcherStopped,
+    ContinuousBatcher,
+    MicroBatcher,
+    QueueFull,
+)
 from distributed_machine_learning_tpu.serve.engine import InferenceEngine
 from distributed_machine_learning_tpu.serve.export import ServableBundle
 from distributed_machine_learning_tpu.tune.executor import (
@@ -64,6 +69,22 @@ class AllReplicasOpen(RuntimeError):
             f"{retry_after_s:.2f}s"
         )
         self.retry_after_s = retry_after_s
+
+
+class Overloaded(RuntimeError):
+    """Admission control refused the request: total queue depth is past
+    the shed watermark (or every replica's bounded queue is full).  The
+    HTTP layer answers 429 + Retry-After — load is shed at the door, not
+    absorbed into an unbounded backlog (ISSUE 8 tentpole)."""
+
+    def __init__(self, retry_after_s: float, depth: int, watermark: int):
+        super().__init__(
+            f"shedding load: queue depth {depth} >= watermark {watermark}; "
+            f"retry in {retry_after_s:.2f}s"
+        )
+        self.retry_after_s = retry_after_s
+        self.depth = depth
+        self.watermark = watermark
 
 
 class ReplicaTimeout(RuntimeError):
@@ -231,7 +252,11 @@ class CircuitBreaker:
 
 
 class Replica:
-    """One engine + one micro-batcher pinned to one leased device."""
+    """One engine + one batcher pinned to one leased device.
+
+    ``batcher="continuous"`` (default) runs the inflight
+    :class:`ContinuousBatcher` — depth-adaptive flushes, bounded queue;
+    ``batcher="micro"`` keeps the original size-or-latency policy."""
 
     def __init__(
         self,
@@ -241,6 +266,9 @@ class Replica:
         max_batch_size: int = 64,
         max_latency_ms: float = 5.0,
         max_bucket: int = 256,
+        batcher: str = "continuous",
+        max_queue: int = 1024,
+        target_step_ms: Optional[float] = None,
     ):
         self.idx = idx
         self.device = device
@@ -250,12 +278,25 @@ class Replica:
         self.processed_batches = 0
         # Monotonic: last_beat is a liveness age (dmlint DML004).
         self.last_beat = time.monotonic()
-        self.batcher = MicroBatcher(
-            self._infer,
-            max_batch_size=max_batch_size,
-            max_latency_ms=max_latency_ms,
-            name=f"replica-{idx}",
-        )
+        if batcher == "continuous":
+            self.batcher = ContinuousBatcher(
+                self._infer,
+                max_batch_size=max_batch_size,
+                max_queue=max_queue,
+                target_step_ms=target_step_ms,
+                name=f"replica-{idx}",
+            )
+        elif batcher == "micro":
+            self.batcher = MicroBatcher(
+                self._infer,
+                max_batch_size=max_batch_size,
+                max_latency_ms=max_latency_ms,
+                name=f"replica-{idx}",
+            )
+        else:
+            raise ValueError(
+                f"batcher must be 'continuous' or 'micro': {batcher!r}"
+            )
 
     def _infer(self, x: np.ndarray) -> np.ndarray:
         out = self.engine.predict(x)
@@ -293,10 +334,20 @@ class ReplicaSet:
     through the AOT executable cache — ``compilecache.ExecutableCache``,
     same program keys as tune — deserializing finished executables, with
     the shared persistent XLA cache as the fallback tier; recovery
-    re-pays neither tracing nor backend compiles).  ``kill()`` hard-stops one replica's worker — dispatch
-    fails over to the survivors immediately, and the monitor treats the
-    gap like any other death; pass ``restart=False`` for an operator
-    drain that should stay down.
+    re-pays neither tracing nor backend compiles).  ``kill()`` hard-stops
+    one replica's worker — dispatch fails over to the survivors
+    immediately, and the monitor treats the gap like any other death;
+    pass ``restart=False`` for an operator drain that should stay down.
+
+    The set is **elastic** (ISSUE 8 tentpole): :meth:`add_replica` /
+    :meth:`remove_replica` grow and shrink it live — the autoscaler's
+    actuators — leasing devices through the same :class:`DeviceManager`
+    and recording every resize in :attr:`scale_events` (the replica-count
+    trajectory ``/metrics`` exposes).  Admission control: past
+    ``shed_watermark`` total queued requests ``submit`` raises
+    :class:`Overloaded` (HTTP 429 upstream), and a replica whose bounded
+    queue is full is skipped like a quarantined one.  Zero-downtime
+    bundle swap lives in ``serve/swap.py`` (:meth:`hot_swap` delegates).
     """
 
     def __init__(
@@ -307,6 +358,10 @@ class ReplicaSet:
         max_batch_size: int = 64,
         max_latency_ms: float = 5.0,
         max_bucket: int = 256,
+        batcher: str = "continuous",
+        max_queue: int = 1024,
+        target_step_ms: Optional[float] = None,
+        shed_watermark: Optional[int] = None,
         restart: bool = True,
         monitor_interval_s: float = 0.25,
         breaker_failure_threshold: int = 3,
@@ -320,42 +375,68 @@ class ReplicaSet:
             max_batch_size=max_batch_size,
             max_latency_ms=max_latency_ms,
             max_bucket=max_bucket,
+            batcher=batcher,
+            max_queue=max_queue,
+            target_step_ms=target_step_ms,
+        )
+        self._breaker_kwargs = dict(
+            failure_threshold=breaker_failure_threshold,
+            recovery_s=breaker_recovery_s,
         )
         # One breaker per SLOT, deliberately surviving monitor restarts: a
         # crash-looping replica must re-earn traffic through a half-open
         # probe, not get a clean slate on every respawn.
         self._breakers = [
-            CircuitBreaker(
-                failure_threshold=breaker_failure_threshold,
-                recovery_s=breaker_recovery_s,
-            )
+            CircuitBreaker(**self._breaker_kwargs)
             for _ in range(num_replicas)
         ]
+        self.shed_watermark = (
+            int(shed_watermark) if shed_watermark else None
+        )
         # chaos.FaultPlan (or None): polled once per dispatched request so
         # scheduled replica kills land deterministically mid-traffic.
         self._fault_plan = fault_plan
+        # Scheduled chaos hot-swap signals fire this (serve/swap.py or a
+        # soak harness registers it); invoked on a helper thread so the
+        # dispatching request never waits on a warmup.
+        self.on_swap_signal = None
         self._dm = DeviceManager(devices)
-        self._leases = []
+        # Per-SLOT lease (None when devices are shared round-robin):
+        # parallel to ``replicas``/``_breakers``/``_devices`` so elastic
+        # resize releases exactly the departing slot's lease.
+        self._slot_leases: List[Optional[List]] = []
         self._devices = []
         for r in range(num_replicas):
             lease = self._dm.acquire(1) if self._dm.num_free else None
             if lease:
-                self._leases.append(lease)
+                self._slot_leases.append(lease)
                 self._devices.append(lease[0][1])
             else:
                 # More replicas than devices: share round-robin (CPU dev
                 # boxes; on TPU, size the replica count to the slice).
+                self._slot_leases.append(None)
                 self._devices.append(self._dm.devices[r % self._dm.num_devices])
         self._lock = named_lock("serve.replicaset")
+        # Structural resizes (autoscale, swap) serialize here so a swap
+        # never interleaves with a shrink; dispatch only takes _lock.
+        self._scale_lock = named_lock("serve.replicaset.scale")
         self._rr = 0
         self.restarts = 0
         self.timeouts = 0  # requests that missed their deadline (predict)
+        self.sheds = 0        # requests refused by admission control
+        self.redispatches = 0  # requests re-routed off a dying replica
+        self.swaps = 0
+        self.swap_history: List[Dict[str, Any]] = []
+        self._born = time.monotonic()
+        self.scale_events: List[Dict[str, Any]] = []
         self._closing = False
         self._warmup_programs: Optional[int] = None
+        self._warmup_sample = None
         self.replicas: List[Replica] = [
             Replica(r, bundle, self._devices[r], **self._kwargs)
             for r in range(num_replicas)
         ]
+        self._record_scale_event(num_replicas, "init")
         self._monitor: Optional[threading.Thread] = None
         if restart:
             self._monitor = threading.Thread(
@@ -368,32 +449,67 @@ class ReplicaSet:
 
     # -- dispatch ------------------------------------------------------------
 
+    def queue_depth_total(self) -> int:
+        """Unanswered requests across every replica (queued + in-flight
+        where the batcher tracks it) — the admission-control and
+        autoscaler depth signal."""
+        with self._lock:
+            replicas = list(self.replicas)
+        return sum(
+            getattr(r.batcher, "pending", r.batcher.queue_depth)
+            for r in replicas
+        )
+
+    def _shed_retry_after_s(self, depth: int) -> float:
+        """Rough backlog-clearing estimate for a shed response."""
+        with self._lock:
+            replicas = list(self.replicas)
+        waits = [
+            r.batcher.retry_after_s() for r in replicas
+            if hasattr(r.batcher, "retry_after_s")
+        ]
+        return max(waits) if waits else min(0.05 * max(depth, 1), 5.0)
+
     def submit(self, x):
         """Round-robin to the next healthy replica whose breaker admits the
         request; dead replicas are skipped (failover) until the monitor
         restarts them, quarantined ones until their half-open probe
-        succeeds.  Raises :class:`AllReplicasOpen` when only breakers stand
-        in the way (503 + Retry-After upstream), plain RuntimeError when
-        every replica is dead.
+        succeeds, full-queue ones until their backlog drains.  Raises
+        :class:`Overloaded` when admission control sheds (429 upstream),
+        :class:`AllReplicasOpen` when only breakers stand in the way
+        (503 + Retry-After), plain RuntimeError when every replica is
+        dead.
 
         The returned future carries ``_dml_outcome`` (one-shot breaker
         recorder) and ``_dml_replica_idx`` so deadline enforcement in
         :meth:`predict` can charge a timeout to the serving slot."""
+        if self.shed_watermark is not None:
+            depth = self.queue_depth_total()
+            if depth >= self.shed_watermark:
+                self.sheds += 1
+                raise Overloaded(
+                    self._shed_retry_after_s(depth), depth,
+                    self.shed_watermark,
+                )
         with self._lock:
-            replicas = list(self.replicas)
+            pairs = list(zip(self.replicas, self._breakers))
             start = self._rr
-            self._rr = (self._rr + 1) % len(replicas)
+            self._rr = (self._rr + 1) % max(len(pairs), 1)
         any_alive = False
-        for off in range(len(replicas)):
-            i = (start + off) % len(replicas)
-            r = replicas[i]
+        any_full = False
+        for off in range(len(pairs)):
+            i = (start + off) % len(pairs)
+            r, breaker = pairs[i]
             if not r.alive():
                 continue
             any_alive = True
-            breaker = self._breakers[i]
             if not breaker.allow():
                 continue
-            fut = r.submit(x)
+            try:
+                fut = r.submit(x)
+            except QueueFull:
+                any_full = True
+                continue
 
             # Runs on the batcher worker (or inline if already done): the
             # request's fate is the breaker's signal — once, whether it
@@ -411,38 +527,69 @@ class ReplicaSet:
                 kill_idx = self._fault_plan.poll_replica_kill()
                 if kill_idx is not None:
                     self.kill(i if kill_idx < 0 else
-                              kill_idx % len(replicas))
+                              kill_idx % len(pairs))
+                if self._fault_plan.poll_hot_swap():
+                    cb = self.on_swap_signal
+                    if cb is not None:
+                        threading.Thread(
+                            target=cb, name="chaos-hot-swap", daemon=True
+                        ).start()
             return fut
+        if any_full:
+            depth = self.queue_depth_total()
+            self.sheds += 1
+            raise Overloaded(
+                self._shed_retry_after_s(depth), depth,
+                self.shed_watermark or depth,
+            )
         if any_alive:
             raise AllReplicasOpen(self.min_retry_after_s())
         raise RuntimeError("no healthy replicas")
 
     def min_retry_after_s(self) -> float:
         """Soonest moment any breaker would admit a probe (Retry-After)."""
-        waits = [b.retry_after_s() for b in self._breakers]
+        with self._lock:
+            breakers = list(self._breakers)
+        waits = [b.retry_after_s() for b in breakers]
         return min(waits) if waits else 0.0
 
-    def predict(self, x, timeout: Optional[float] = 30.0) -> np.ndarray:
-        """Submit + wait, with the timeout treated as a replica FAILURE.
+    def predict(self, x, timeout: Optional[float] = 30.0,
+                redispatch: int = 2) -> np.ndarray:
+        """Submit + wait, with the timeout treated as a replica FAILURE
+        and replica deaths redispatched.
 
         A hung replica's future never resolves, so without this the
         breaker never learns (it only counts outcomes that return) and
         every HTTP worker that round-robins onto the wedged slot blocks
         for the full timeout.  Charging the deadline miss to the slot's
         breaker quarantines it after ``failure_threshold`` misses — the
-        monitor/half-open probe path then owns recovery."""
-        fut = self.submit(x)
-        try:
-            return fut.result(timeout=timeout)
-        except FuturesTimeoutError:
-            self.timeouts += 1
-            outcome = getattr(fut, "_dml_outcome", None)
-            if outcome is not None:
-                outcome.record(failed=True)
-            raise ReplicaTimeout(
-                timeout if timeout is not None else float("inf"),
-                getattr(fut, "_dml_replica_idx", -1),
-            ) from None
+        monitor/half-open probe path then owns recovery.
+
+        A request whose replica died before flushing it
+        (:class:`BatcherStopped` — chaos kill, operator drain) is
+        redispatched to a survivor up to ``redispatch`` times: a replica
+        death is the server's problem, not the client's (the zero-
+        dropped-requests contract the soak bench verifies)."""
+        attempts = max(int(redispatch), 0) + 1
+        for attempt in range(attempts):
+            fut = self.submit(x)
+            try:
+                return fut.result(timeout=timeout)
+            except FuturesTimeoutError:
+                self.timeouts += 1
+                outcome = getattr(fut, "_dml_outcome", None)
+                if outcome is not None:
+                    outcome.record(failed=True)
+                raise ReplicaTimeout(
+                    timeout if timeout is not None else float("inf"),
+                    getattr(fut, "_dml_replica_idx", -1),
+                ) from None
+            except BatcherStopped:
+                # The slot's breaker already charged the failure via the
+                # done-callback; route the request to a survivor.
+                if attempt + 1 >= attempts:
+                    raise
+                self.redispatches += 1
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -452,37 +599,136 @@ class ReplicaSet:
             if self._closing:
                 return
             with self._lock:
-                dead = [
-                    (i, r)
-                    for i, r in enumerate(self.replicas)
-                    if not r.alive()
-                ]
-            for i, old in dead:
+                dead = [r for r in self.replicas if not r.alive()]
+            for old in dead:
                 if self._closing:
                     return
                 fresh = Replica(
                     old.idx, self.bundle, old.device, **self._kwargs
                 )
                 with self._lock:
-                    if self.replicas[i] is old:
+                    # Identity lookup, not a cached index: an autoscale
+                    # shrink or a hot swap may have moved/retired the slot
+                    # while we were building the replacement.
+                    try:
+                        i = self.replicas.index(old)
+                    except ValueError:
+                        i = -1
+                    if i >= 0:
                         self.replicas[i] = fresh
                         self.restarts += 1
-                    else:  # raced another restart; discard ours
-                        fresh.kill()
+                if i < 0:  # slot is gone (scaled away / swapped); discard
+                    fresh.kill()
 
     def kill(self, idx: int):
         with self._lock:
-            replica = self.replicas[idx]
+            replica = self.replicas[idx % len(self.replicas)]
         replica.kill()
 
     def warmup(self, sample) -> Dict[str, Any]:
         """Compile every replica's bucket grid; records the program count
-        the zero-recompile acceptance check diffs against."""
+        the zero-recompile acceptance check diffs against, and keeps the
+        sample so autoscale-added and hot-swapped replicas warm the same
+        grid BEFORE taking traffic."""
+        self._warmup_sample = np.asarray(sample)
         for r in list(self.replicas):
             r.engine.warmup(sample)
         stats = self.program_stats()
         self._warmup_programs = stats["programs"]
         return stats
+
+    # -- elastic scaling (the autoscaler's actuators) ------------------------
+
+    def _record_scale_event(self, count: int, reason: str) -> None:
+        self.scale_events.append({
+            "t_s": round(time.monotonic() - self._born, 3),
+            "replicas": int(count),
+            "reason": reason,
+        })
+
+    def add_replica(self, reason: str = "scale_up") -> bool:
+        """Grow the set by one replica (up to device availability is the
+        caller's policy — the set itself only refuses while closing).
+
+        The new replica leases its own device when one is free (same
+        DeviceManager discipline as trial placement), shares round-robin
+        otherwise, and is warmed through the AOT executable cache before
+        it enters dispatch — scale-up never compiles on the serving
+        path."""
+        with self._scale_lock:
+            if self._closing:
+                return False
+            with self._lock:
+                idx = len(self.replicas)
+            lease = self._dm.acquire(1) if self._dm.num_free else None
+            device = (lease[0][1] if lease
+                      else self._dm.devices[idx % self._dm.num_devices])
+            replica = Replica(idx, self.bundle, device, **self._kwargs)
+            if self._warmup_sample is not None:
+                replica.engine.warmup(self._warmup_sample)
+            breaker = CircuitBreaker(**self._breaker_kwargs)
+            with self._lock:
+                self.replicas.append(replica)
+                self._breakers.append(breaker)
+                self._devices.append(device)
+                self._slot_leases.append(lease)
+                count = len(self.replicas)
+            self._record_scale_event(count, reason)
+        # Keep the zero-recompile ledger honest: the warmed newcomer's
+        # programs are baseline, not traffic-induced compiles.
+        if self._warmup_programs is not None:
+            self._warmup_programs = self.program_stats()["programs"]
+        return True
+
+    def remove_replica(self, reason: str = "scale_down") -> bool:
+        """Shrink the set by one (never below one replica): the last slot
+        leaves dispatch first, then drains its queue — every request it
+        already accepted is answered — and its device lease is released."""
+        with self._scale_lock:
+            with self._lock:
+                if len(self.replicas) <= 1 or self._closing:
+                    return False
+                replica = self.replicas.pop()
+                self._breakers.pop()
+                self._devices.pop()
+                lease = self._slot_leases.pop()
+                count = len(self.replicas)
+            self._record_scale_event(count, reason)
+            replica.batcher.stop(drain=True, timeout=10.0)
+            if lease:
+                self._dm.release(lease)
+        if self._warmup_programs is not None:
+            self._warmup_programs = self.program_stats()["programs"]
+        return True
+
+    def scale_stats(self) -> Dict[str, Any]:
+        """Replica-count trajectory for ``/metrics`` (acceptance: the
+        autoscaler's up/down moves are observable and assertable)."""
+        with self._lock:
+            count = len(self.replicas)
+        events = list(self.scale_events)
+        # Derived from the trajectory itself, not from reason strings: an
+        # up is any event where the count rose vs the previous one.
+        deltas = list(zip(events, events[1:]))
+        return {
+            "replicas": count,
+            "scale_ups": sum(
+                1 for prev, cur in deltas
+                if cur["replicas"] > prev["replicas"]
+            ),
+            "scale_downs": sum(
+                1 for prev, cur in deltas
+                if cur["replicas"] < prev["replicas"]
+            ),
+            "events": events[-64:],
+        }
+
+    def hot_swap(self, new_bundle: ServableBundle, sample=None,
+                 warm: bool = True) -> Dict[str, Any]:
+        """Zero-downtime bundle swap — see ``serve/swap.py``."""
+        from distributed_machine_learning_tpu.serve.swap import hot_swap
+
+        return hot_swap(self, new_bundle, sample=sample, warm=warm)
 
     def program_stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -501,15 +747,17 @@ class ReplicaSet:
 
     def health(self) -> List[Dict[str, Any]]:
         with self._lock:
-            replicas = list(self.replicas)
+            pairs = list(zip(self.replicas, self._breakers))
         return [
-            {**r.health(), "breaker": self._breakers[i].state}
-            for i, r in enumerate(replicas)
+            {**r.health(), "breaker": b.state}
+            for r, b in pairs
         ]
 
     def breaker_stats(self) -> Dict[str, Any]:
         """Breaker state + fault counters for ``/metrics``."""
-        per = [b.stats() for b in self._breakers]
+        with self._lock:
+            breakers = list(self._breakers)
+        per = [b.stats() for b in breakers]
         return {
             "per_replica": per,
             "open_replicas": sum(
@@ -544,7 +792,9 @@ class ReplicaSet:
             self._monitor.join(timeout=2.0)
         with self._lock:
             replicas = list(self.replicas)
+            leases = [lease for lease in self._slot_leases if lease]
+            self._slot_leases = [None] * len(self._slot_leases)
         for r in replicas:
             r.batcher.stop(drain=False, timeout=2.0)
-        for lease in self._leases:
+        for lease in leases:
             self._dm.release(lease)

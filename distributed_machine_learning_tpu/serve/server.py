@@ -1,4 +1,4 @@
-"""Stdlib HTTP front end: /predict, /healthz, /metrics.
+"""Stdlib HTTP front end: /predict, /healthz, /metrics, /admin/swap.
 
 No web framework in the image, none needed: ``http.server`` with a
 threading server is enough for a JSON prediction API, and keeps the
@@ -7,12 +7,19 @@ TensorBoard writer in ``utils/tensorboard.py``).
 
 Endpoints::
 
-    POST /predict   {"instances": [[...], ...]}
-                    -> {"predictions": [...], "latency_ms": ...}
-    GET  /healthz   {"status": "ok"|"degraded", "replicas": [...]}
-    GET  /metrics   latency p50/p99, throughput, queue depth, batch fill
-                    ratio, compile counters (plain JSON; also streamed to
-                    TensorBoard when --tb-logdir is set)
+    POST /predict     {"instances": [[...], ...]}
+                      -> {"predictions": [...], "latency_ms": ...}
+                      429 + Retry-After when admission control sheds,
+                      503 + Retry-After when every breaker is open,
+                      504 on a per-request deadline miss
+    POST /admin/swap  {"bundle": "<dir>"} -> zero-downtime hot swap of a
+                      new bundle into the live ReplicaSet (serve/swap.py)
+    GET  /healthz     {"status": "ok"|"degraded", "replicas": [...]}
+    GET  /metrics     windowed latency p50/p99, throughput, queue depth,
+                      batch fill ratio, shed/backpressure counters,
+                      autoscale trajectory, swap history, compile
+                      counters (plain JSON; also streamed to TensorBoard
+                      when --tb-logdir is set)
 """
 
 from __future__ import annotations
@@ -26,6 +33,10 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from distributed_machine_learning_tpu.serve.autoscale import (
+    AutoscaleConfig,
+    ReplicaAutoscaler,
+)
 from distributed_machine_learning_tpu.serve.export import ServableBundle
 from distributed_machine_learning_tpu.serve.metrics import (
     ServeMetrics,
@@ -33,6 +44,7 @@ from distributed_machine_learning_tpu.serve.metrics import (
 )
 from distributed_machine_learning_tpu.serve.replica import (
     AllReplicasOpen,
+    Overloaded,
     ReplicaSet,
     ReplicaTimeout,
 )
@@ -55,6 +67,12 @@ class PredictionServer:
         max_batch_size: int = 64,
         max_latency_ms: float = 5.0,
         max_bucket: int = 256,
+        batcher: str = "continuous",
+        max_queue: int = 1024,
+        target_step_ms: Optional[float] = None,
+        shed_watermark: Optional[int] = None,
+        autoscale: Optional[AutoscaleConfig] = None,
+        metrics_window: int = 1024,
         tb_logdir: Optional[str] = None,
         request_timeout_s: float = 30.0,
         breaker_failure_threshold: int = 3,
@@ -68,12 +86,22 @@ class PredictionServer:
             max_batch_size=max_batch_size,
             max_latency_ms=max_latency_ms,
             max_bucket=max_bucket,
+            batcher=batcher,
+            max_queue=max_queue,
+            target_step_ms=target_step_ms,
+            shed_watermark=shed_watermark,
             breaker_failure_threshold=breaker_failure_threshold,
             breaker_recovery_s=breaker_recovery_s,
             fault_plan=fault_plan,
         )
         self._fault_plan = fault_plan
-        self.metrics = ServeMetrics()
+        self.metrics = ServeMetrics(window=metrics_window)
+        # The autoscaler reads the WINDOWED p99 (serve/metrics.py ring
+        # buffer) and the live queue depth; started with the HTTP server.
+        self.autoscaler: Optional[ReplicaAutoscaler] = (
+            ReplicaAutoscaler(self.replicas, self.metrics, autoscale)
+            if autoscale is not None else None
+        )
         self._tb = TensorBoardEmitter(tb_logdir)
         self._timeout_s = request_timeout_s
         self._host, self._port = host, port
@@ -109,6 +137,19 @@ class PredictionServer:
             "model_family": self.bundle.model_family,
         }
 
+    def handle_swap(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Zero-downtime promotion of a new bundle (serve/swap.py)."""
+        bundle_dir = body.get("bundle")
+        if not bundle_dir:
+            raise ValueError('request body needs a "bundle" directory')
+        from distributed_machine_learning_tpu.serve.swap import (
+            warm_swap_bundle,
+        )
+
+        event = warm_swap_bundle(self.replicas, str(bundle_dir))
+        self.bundle = self.replicas.bundle
+        return {"swapped": True, **event}
+
     def handle_metrics(self) -> Dict[str, Any]:
         programs = self.replicas.program_stats()
         batcher = self.replicas.batcher_stats()
@@ -120,6 +161,26 @@ class PredictionServer:
             "num_healthy": self.replicas.num_healthy(),
             "breakers": self.replicas.breaker_stats(),
             "restarts": self.replicas.restarts,
+            # Backpressure/admission counters + the replica-count
+            # trajectory: the "Serving under load" runbook's signals.
+            "admission": {
+                "max_queue": self.replicas._kwargs.get("max_queue"),
+                "shed_watermark": self.replicas.shed_watermark,
+                "sheds_total": self.replicas.sheds,
+                "queue_depth": batcher.get("queue_depth", 0),
+                "redispatches": self.replicas.redispatches,
+            },
+            "autoscale": {
+                **self.replicas.scale_stats(),
+                **(
+                    self.autoscaler.snapshot()
+                    if self.autoscaler is not None else {}
+                ),
+            },
+            "swap": {
+                "swaps_total": self.replicas.swaps,
+                "history": self.replicas.swap_history[-5:],
+            },
             # Checkpoint-to-ready cost (bundle params restore at load
             # time): the serving-side half of the ckpt/ wall-time story.
             "checkpoint_load_s": round(
@@ -173,16 +234,32 @@ class PredictionServer:
                     self._reply(500, {"error": repr(exc)})
 
             def do_POST(self):
-                if self.path != "/predict":
+                if self.path not in ("/predict", "/admin/swap"):
                     self._reply(404, {"error": f"no route {self.path}"})
                     return
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(length) or b"{}")
+                    if self.path == "/admin/swap":
+                        self._reply(200, server.handle_swap(body))
+                        return
                     self._reply(200, server.handle_predict(body))
-                except ValueError as exc:
+                except (ValueError, FileNotFoundError) as exc:
                     server.metrics.observe_error()
                     self._reply(400, {"error": str(exc)})
+                except Overloaded as exc:
+                    # Admission control: the queue is past its watermark —
+                    # shed NOW with honest backpressure instead of letting
+                    # the backlog grow past what the SLO can ever absorb.
+                    server.metrics.observe_shed()
+                    retry_after = max(int(math.ceil(exc.retry_after_s)), 1)
+                    self._reply(
+                        429,
+                        {"error": str(exc),
+                         "retry_after_s": round(exc.retry_after_s, 3),
+                         "queue_depth": exc.depth},
+                        headers={"Retry-After": str(retry_after)},
+                    )
                 except ReplicaTimeout as exc:
                     # Per-request deadline (request_timeout_s): a hung
                     # replica cannot pin this worker past it.  The miss
@@ -220,6 +297,8 @@ class PredictionServer:
             target=self._httpd.serve_forever, name="serve-http", daemon=True
         )
         self._thread.start()
+        if self.autoscaler is not None:
+            self.autoscaler.start()
         return self._host, self._port
 
     @property
@@ -227,6 +306,8 @@ class PredictionServer:
         return self._host, self._port
 
     def close(self):
+        if self.autoscaler is not None:
+            self.autoscaler.close()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

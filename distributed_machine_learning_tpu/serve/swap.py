@@ -1,0 +1,97 @@
+"""Zero-downtime bundle hot swap: warm off-path, then drain-and-switch.
+
+A model promotion must not drop a request and must not compile on the
+serving path (ISSUE 8 tentpole; ROADMAP item 2 pairs this with the AOT
+executable cache — "the swapped model compiles nothing").  The procedure:
+
+1. **Warm off-path.**  For each serving slot, a fresh :class:`Replica`
+   is built from the NEW bundle on the slot's own device and its whole
+   bucket grid is compiled through ``compilecache.ExecutableCache`` /
+   the persistent XLA cache *before* it sees a single request.  The old
+   replica keeps serving the slot the entire time.
+2. **Switch.**  The warmed replica replaces the old one under the
+   dispatch lock — an atomic list write; requests dispatched after this
+   instant run the new model.
+3. **Drain.**  The old replica leaves dispatch first, THEN its batcher
+   drains: every request it had already accepted is answered by the old
+   model.  No request is dropped, no request straddles two models.
+
+Slots swap one at a time, so N-1 replicas serve throughout — the same
+one-at-a-time discipline as a rolling deploy, inside one process.  After
+the last slot, the set's bundle pointer moves (monitor restarts now
+build the new model) and the zero-recompile ledger re-baselines, so
+``new_programs_since_warmup`` keeps meaning "compiles caused by traffic"
+across the swap — the counter the soak bench asserts is zero.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict
+
+
+def hot_swap(replica_set, new_bundle, sample=None,
+             warm: bool = True) -> Dict[str, Any]:
+    """Swap ``new_bundle`` into a live ReplicaSet with zero dropped
+    requests; returns the swap event (also appended to
+    ``replica_set.swap_history``).
+
+    ``sample`` drives the warmup grid; defaults to the sample the set was
+    originally warmed with.  ``warm=False`` skips pre-compilation (first
+    requests then compile through the caches — only for bundles whose
+    programs are known-cached)."""
+    from distributed_machine_learning_tpu.serve.replica import Replica
+
+    rs = replica_set
+    if sample is None:
+        sample = rs._warmup_sample
+    t0 = time.monotonic()
+    swapped = 0
+    with rs._scale_lock:
+        with rs._lock:
+            n = len(rs.replicas)
+        for i in range(n):
+            with rs._lock:
+                if i >= len(rs.replicas):
+                    break  # a concurrent shrink retired this slot
+                old = rs.replicas[i]
+            fresh = Replica(old.idx, new_bundle, old.device, **rs._kwargs)
+            if warm and sample is not None:
+                fresh.engine.warmup(sample)
+            with rs._lock:
+                # The slot may have been monitor-restarted while we
+                # warmed; whatever occupies it now is what we retire.
+                if i >= len(rs.replicas):
+                    fresh.kill()
+                    break
+                old = rs.replicas[i]
+                rs.replicas[i] = fresh
+            # Out of dispatch -> drain: accepted requests still answer
+            # on the OLD model, nothing is dropped mid-flight.
+            old.batcher.stop(drain=True, timeout=10.0)
+            swapped += 1
+        rs.bundle = new_bundle
+        stats = rs.program_stats()
+        if rs._warmup_programs is not None:
+            rs._warmup_programs = stats["programs"]
+        rs.swaps += 1
+        event = {
+            "bundle": getattr(new_bundle, "path", None),
+            "replicas_swapped": swapped,
+            "duration_s": round(time.monotonic() - t0, 3),
+            "programs_after": stats["programs"],
+            "at_unix": round(time.time(), 3),
+        }
+        rs.swap_history.append(event)
+        del rs.swap_history[:-16]
+    return event
+
+
+def warm_swap_bundle(replica_set, bundle_dir: str,
+                     sample=None) -> Dict[str, Any]:
+    """Load a bundle directory and hot-swap it in (the ``/admin/swap``
+    endpoint's whole job)."""
+    from distributed_machine_learning_tpu.serve.export import load_bundle
+
+    bundle = load_bundle(bundle_dir)
+    return hot_swap(replica_set, bundle, sample=sample)

@@ -7,11 +7,14 @@ must SERVE, not just exist).  End to end on CPU virtual devices:
 2. ``serve.export_bundle`` freezes it into a self-describing bundle
    (params + config + feature schema);
 3. a :class:`serve.PredictionServer` loads the bundle into N device-pinned
-   replicas, pre-compiles the padded-batch bucket grid, and serves
-   ``/predict`` ``/healthz`` ``/metrics``;
+   replicas behind the continuous (inflight) batcher, with the replica
+   autoscaler armed, pre-compiles the padded-batch bucket grid, and
+   serves ``/predict`` ``/healthz`` ``/metrics`` ``/admin/swap``;
 4. the driver fires ``--requests`` HTTP requests at mixed batch sizes and
    verifies the acceptance bar: ZERO new compiled programs after warmup
-   (every size lands in a warm bucket) and p50/p99 latency in /metrics.
+   (every size lands in a warm bucket) and p50/p99 latency in /metrics;
+5. a zero-downtime hot swap promotes a re-exported bundle into the live
+   ReplicaSet — zero dropped requests, zero serving-path compiles.
 
 Run:
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -80,6 +83,14 @@ def main(argv=None):
         bundle, port=0, num_replicas=args.replicas,
         max_batch_size=args.max_batch_size,
         max_latency_ms=args.max_latency_ms, max_bucket=64,
+        # Continuous batching is the default; bound the queue and arm the
+        # autoscaler so a burst scales out instead of queueing unbounded.
+        max_queue=512,
+        autoscale=serve.AutoscaleConfig(
+            min_replicas=args.replicas,
+            max_replicas=args.replicas + 2,
+            up_queue_depth=64,
+        ),
     )
     warm = server.warmup(np.asarray(val.x[:1], np.float32))
     host, port = server.start()
@@ -131,6 +142,22 @@ def main(argv=None):
     direct = np.asarray(model.apply(variables, x, deterministic=True))
     np.testing.assert_allclose(served, direct, rtol=1e-5, atol=1e-6)
     print("OK: zero recompiles after warmup; served == model.apply")
+
+    # -- 5. zero-downtime hot swap -------------------------------------------
+    # Promote "the next model" (here: the same winner re-exported) into
+    # the live set: warmed off-path through the AOT caches, then each
+    # slot drains-and-switches — no request dropped, nothing compiled.
+    next_dir = os.path.join(root, "bundle_next")
+    serve.export_bundle(analysis, next_dir)
+    event = server.replicas.hot_swap(serve.load_bundle(next_dir))
+    after = json.loads(urllib.request.urlopen(f"{base}/metrics").read())
+    assert after["swap"]["swaps_total"] == 1
+    assert after["compile"]["new_programs_since_warmup"] == 0
+    np.testing.assert_allclose(server.replicas.predict(x), direct,
+                               rtol=1e-5, atol=1e-6)
+    print(f"OK: hot swap in {event['duration_s']}s, zero post-swap "
+          f"compiles; autoscale trajectory: "
+          f"{[e['replicas'] for e in after['autoscale']['events']]}")
     server.close()
     return metrics
 

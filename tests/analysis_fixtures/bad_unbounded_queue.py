@@ -1,0 +1,21 @@
+# dmlint-scope: serve-request-path
+"""Historical risk pattern (ISSUE 8 satellite): a serving request queue
+with no capacity bound.  Overload then accumulates instead of shedding —
+admission control cannot 429 what the queue already swallowed, latency
+grows without limit, and the process OOMs under the very burst the
+serving plane exists to absorb."""
+
+import collections
+import queue
+from collections import deque
+
+
+def build_request_queues():
+    pending = queue.Queue()  # EXPECT: unbounded-queue
+    zero_is_unbounded = queue.Queue(maxsize=0)  # EXPECT: unbounded-queue
+    lifo = queue.LifoQueue()  # EXPECT: unbounded-queue
+    backlog = deque()  # EXPECT: unbounded-queue
+    explicit_none = collections.deque(maxlen=None)  # EXPECT: unbounded-queue
+    no_bound_at_all = queue.SimpleQueue()  # EXPECT: unbounded-queue
+    return (pending, zero_is_unbounded, lifo, backlog, explicit_none,
+            no_bound_at_all)

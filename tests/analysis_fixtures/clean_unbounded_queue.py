@@ -1,0 +1,27 @@
+# dmlint-scope: serve-request-path
+"""Idiomatic twin: every request-path queue carries an explicit bound,
+and a full queue is an ADMISSION decision (shed with Retry-After), never
+silent growth — the serve/batcher.py ContinuousBatcher shape."""
+
+import collections
+import queue
+from collections import deque
+
+MAX_QUEUE = 1024
+
+
+def build_request_queues(max_queue=MAX_QUEUE):
+    pending = queue.Queue(maxsize=max_queue)
+    positional_bound = queue.Queue(64)
+    lifo = queue.LifoQueue(maxsize=32)
+    backlog = deque(maxlen=max_queue)
+    seeded = collections.deque((), 128)
+    window = deque([0.0] * 16, maxlen=16)
+    return pending, positional_bound, lifo, backlog, seeded, window
+
+
+def admission(backlog, max_queue=MAX_QUEUE):
+    # Bound enforced at submit too: the deque's maxlen must never be the
+    # thing that (silently) drops a request.
+    if len(backlog) >= max_queue:
+        raise RuntimeError("shed with 429 + Retry-After upstream")

@@ -57,6 +57,18 @@ def _detail() -> dict:
         return json.load(f)
 
 
+# What the serve_soak child emits, for parent-flow stubs (the child itself
+# runs for real in test_child_serve_soak_end_to_end_tiny).
+_SOAK_STUB = {
+    "platform": "cpu", "requests": 240, "ok": 240, "shed": 0, "dropped": 0,
+    "shed_rate": 0.0, "achieved_rps": 50.0, "p50_ms": 1.0, "p99_ms": 4.0,
+    "slo_ms": 500.0, "slo_met": True, "replica_kills": 1,
+    "hot_swap_signals": 1, "swap_landed": True, "swaps_total": 1,
+    "post_swap_new_programs": 0, "scale_ups": 1, "scale_downs": 1,
+    "wall_s": 5.0,
+}
+
+
 def test_parse_result_takes_last_json_line():
     out = "noise\n{\"a\": 1}\nmore noise\n{\"b\": 2}\n"
     assert bench._parse_result(out) == {"b": 2}
@@ -74,10 +86,13 @@ def test_variant_scales_cover_baseline_configs():
 
 def test_probe_records_every_attempt_and_cause(monkeypatch):
     calls = []
+    causes = iter(["backend hung", "relay refused", "claim stalled"])
 
     def fake_run_child(args, env, timeout_s):
         calls.append((tuple(args), timeout_s))
-        return 124, "", "backend hung", True  # timeout, child exited
+        # Distinct failure modes: the repeated-wedge fast path must NOT
+        # cut the schedule short (that behavior has its own test below).
+        return 124, "", next(causes), True  # timeout, child exited
 
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
@@ -89,6 +104,51 @@ def test_probe_records_every_attempt_and_cause(monkeypatch):
     assert all(a["rc"] == 124 for a in info["attempts"])
     assert all(a["cause"] for a in info["attempts"])
     assert [a["timeout_s"] for a in info["attempts"]] == [5, 5, 10]
+    assert "probe_wedge_signature" not in info
+
+
+def test_probe_repeated_wedge_signature_stops_schedule(monkeypatch):
+    """BENCH_r05 satellite: 4 attempts x rc=124 burned on the SAME
+    "Platform 'axon' is experimental" stderr line.  An identical
+    normalized wedge signature on consecutive attempts is deterministic,
+    not transient — the probe falls back to CPU after ONE repeat and the
+    signature lands in the artifact."""
+    calls = []
+
+    def fake_run_child(args, env, timeout_s):
+        calls.append(tuple(args))
+        # Volatile parts (pid, address, path) differ per attempt; the
+        # normalized signature must still match.
+        n = len(calls)
+        return 124, "", (
+            f"RuntimeError: Platform 'axon' is experimental "
+            f"(pid {1000 + n}, buf 0xdead{n:04x}, /tmp/run{n}/log)"
+        ), True
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    info = {"attempts": []}
+    ok, tunnel_ok = bench._probe_tpu(
+        lambda m: None, info, ((5, 0), (5, 1), (10, 2), (10, 2)),
+    )
+    assert ok is False and tunnel_ok is True
+    assert len(info["attempts"]) == 2  # one repeat, then CPU fallback
+    sig = info["probe_wedge_signature"]
+    assert sig["signature"] == info["attempts"][0]["signature"] \
+        == info["attempts"][1]["signature"]
+    assert "axon" in sig["snippet"]
+    assert sig["attempts"] == 2
+
+
+def test_wedge_signature_normalizes_volatile_parts():
+    a = bench._wedge_signature(
+        "Platform 'axon' is experimental (pid 4242, 0xdeadbeef, /tmp/a/b)"
+    )
+    b = bench._wedge_signature(
+        "Platform 'axon' is experimental (pid 7, 0x1234, /var/x)"
+    )
+    c = bench._wedge_signature("relay connection refused")
+    assert a == b != c
 
 
 def test_probe_stops_on_zombie_claimant(monkeypatch):
@@ -169,9 +229,13 @@ def test_probe_budget_bounds_total_wall_time(monkeypatch):
         def sleep(cls, s):
             cls.now += s
 
+    causes = iter(["backend hung", "relay refused", "claim stalled"])
+
     def fake_run_child(args, env, timeout_s):
         FakeClock.sleep(timeout_s)  # attempt burns its whole timeout
-        return 124, "", "backend hung", True
+        # Distinct causes: this test exercises the BUDGET bound, not the
+        # repeated-wedge fast path.
+        return 124, "", next(causes), True
 
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
     monkeypatch.setattr(bench.time, "time", FakeClock.time)
@@ -246,6 +310,8 @@ def test_main_cpu_fallback_emit_fields(monkeypatch, capsys):
             return 0, json.dumps(ours), "", True
         if args[:2] == ["--child", "torch"]:
             return 0, json.dumps(torch_res), "", True
+        if args[:2] == ["--child", "serve_soak"]:
+            return 0, json.dumps(_SOAK_STUB), "", True
         raise AssertionError(f"unexpected child {args}")
 
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
@@ -265,6 +331,11 @@ def test_main_cpu_fallback_emit_fields(monkeypatch, capsys):
     assert "cpu_note" in detail
     assert detail["probe"]["skipped"]
     assert "cpu_sweep_s" in detail["phases"] and "torch_s" in detail["phases"]
+    # serve_soak section rides in both the sidecar and the compact line.
+    assert detail["serve_soak"]["slo_met"] is True
+    assert detail["serve_soak"]["dropped"] == 0
+    assert line["serve_soak"]["post_swap_new_programs"] == 0
+    assert "serve_soak_s" in detail["phases"]
 
 
 def _sweep_stub(dtype, tph):
@@ -299,6 +370,8 @@ def test_main_tpu_path_includes_flagship(monkeypatch, capsys):
             return 0, "probe OK: 1 x tpu", "", True
         if args[:2] == ["--child", "torch"]:
             return 0, json.dumps({"trials_per_hour": 70.0}), "", True
+        if args[:2] == ["--child", "serve_soak"]:
+            return 0, json.dumps(_SOAK_STUB), "", True
         raise AssertionError(f"unexpected child {args}")
 
     monkeypatch.setattr(bench, "_run_child_monitored", fake_monitored)
@@ -502,6 +575,8 @@ def test_main_late_stage_reuses_probe_verdict(monkeypatch, capsys):
             }), "", True
         if args[:2] == ["--child", "torch"]:
             return 0, json.dumps({"trials_per_hour": 70.0}), "", True
+        if args[:2] == ["--child", "serve_soak"]:
+            return 0, json.dumps(_SOAK_STUB), "", True
         raise AssertionError(f"unexpected child {args}")
 
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
@@ -510,11 +585,16 @@ def test_main_late_stage_reuses_probe_verdict(monkeypatch, capsys):
     bench.main()
     line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert line["backend"] == "cpu"
-    assert state["probes"] == 3  # the schedule's attempts, nothing more
+    # Identical rc=124 signature twice -> the repeated-wedge fast path
+    # stops the schedule at 2 attempts; the late stage then reuses the
+    # memoized verdict — no third or fourth probe child ever spawns.
+    assert state["probes"] == 2
     detail = _detail()
     assert detail["probe"]["probe_cached"] == 1  # late stage reused it
-    assert len(detail["probe"]["attempts"]) == 3
+    assert len(detail["probe"]["attempts"]) == 2
+    assert detail["probe"]["probe_wedge_signature"]["attempts"] == 2
     assert detail["probe"].get("late_retry") is False
+    assert line["probe_wedge_signature"]  # compact line carries it too
 
 
 def test_variant_partial_recovers_terminated_trials(tmp_path, monkeypatch):
@@ -586,6 +666,32 @@ def test_child_suite_end_to_end_tiny(monkeypatch, tmp_path, capsys):
         out["sweeps"]["float32"]["trials_per_hour"]
     )
     assert out2["flagship"]["step_s"] == out["flagship"]["step_s"]
+
+
+def test_child_serve_soak_end_to_end_tiny(monkeypatch, capsys):
+    """child_serve_soak for real (tiny request count): sustained RPS
+    against a 2-replica continuous-batching server, a chaos kill and a
+    hot swap mid-soak — zero dropped (non-shed) requests, zero post-swap
+    recompiles, both events counter-verified in the emitted section."""
+    monkeypatch.setenv("DML_SOAK_REQUESTS", "60")
+    monkeypatch.setenv("DML_SOAK_RPS", "60")
+    monkeypatch.setenv("DML_SOAK_BURST_RPS", "150")
+    bench.child_serve_soak()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["requests"] == 60
+    assert out["dropped"] == 0
+    assert out["ok"] + out["shed"] == 60
+    # The kill landed and the set HEALED — whether the monitor restart or
+    # the hot swap won the race for the dead slot is timing, not contract
+    # (the deterministic restart proof is test_replica_failover_and_restart).
+    assert out["replica_kills"] == 1
+    assert out["replicas_healthy"] == out["replicas_final"] >= 1
+    assert out["hot_swap_signals"] == 1 and out["swap_landed"] is True
+    assert out["swaps_total"] == 1
+    assert out["post_swap_new_programs"] == 0
+    assert out["p99_ms"] >= out["p50_ms"] > 0
+    assert out["achieved_rps"] > 0
+    assert out["trajectory"], "replica-count trajectory must be recorded"
 
 
 def test_child_flagship_tiny_shapes(monkeypatch, capsys):
@@ -683,6 +789,8 @@ def test_last_tpu_capture_recorded_and_attached(monkeypatch, tmp_path,
             }), "", True
         if args[:2] == ["--child", "torch"]:
             return 0, json.dumps({"trials_per_hour": 900.0}), "", True
+        if args[:2] == ["--child", "serve_soak"]:
+            return 0, json.dumps(_SOAK_STUB), "", True
         raise AssertionError(f"unexpected child {args}")
 
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
@@ -962,6 +1070,8 @@ def test_main_quality_at_budget_cpu_path(monkeypatch, capsys):
                 "best_validation_mape": 91.456, "trials": 8,
                 "brackets": 1,
             }), "", True
+        if args[:2] == ["--child", "serve_soak"]:
+            return 0, json.dumps(_SOAK_STUB), "", True
         raise AssertionError(f"unexpected child {args}")
 
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
@@ -1006,6 +1116,8 @@ def test_main_quality_from_tpu_suite(monkeypatch, capsys):
                 "budget_s": 30.0, "wall_s": 30.0,
                 "best_validation_mape": 92.0, "trials": 6, "brackets": 1,
             }), "", True
+        if args[:2] == ["--child", "serve_soak"]:
+            return 0, json.dumps(_SOAK_STUB), "", True
         raise AssertionError(f"unexpected child {args}")
 
     monkeypatch.setattr(bench, "_run_child_monitored", fake_monitored)

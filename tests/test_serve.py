@@ -454,3 +454,385 @@ def test_cli_serve_rejects_missing_bundle(tmp_path, capsys):
         main(["serve", "--bundle", str(tmp_path / "nope")])
     assert e.value.code == 1
     assert "not a bundle" in capsys.readouterr().err
+
+
+# --------------------------------------------------------------------------
+# continuous (inflight) batcher
+# --------------------------------------------------------------------------
+
+
+def test_continuous_batcher_dispatches_lone_request_immediately():
+    """No flush timer: a lone request's latency is one engine step, not a
+    max_latency_ms floor."""
+    seen = []
+
+    def infer(x):
+        seen.append(x.shape[0])
+        return x * 2
+
+    b = serve.ContinuousBatcher(infer, max_batch_size=1024)
+    t0 = time.time()
+    out = b.submit(np.ones((3, 2), np.float32)).result(timeout=5.0)
+    waited = time.time() - t0
+    b.stop()
+    assert np.array_equal(out, np.full((3, 2), 2.0, np.float32))
+    assert seen == [3]
+    assert waited < 1.0  # no timer-bound wait (MicroBatcher would sleep)
+
+
+def test_continuous_batcher_coalesces_arrivals_while_engine_busy():
+    """The continuous property: requests arriving DURING a flush ride the
+    next flush together — the device never idles while work is queued."""
+    import threading
+
+    gate = threading.Event()
+    sizes = []
+
+    def infer(x):
+        sizes.append(x.shape[0])
+        if len(sizes) == 1:
+            gate.wait(timeout=5.0)  # first flush holds the engine
+        return x
+
+    b = serve.ContinuousBatcher(infer, max_batch_size=64)
+    first = b.submit(np.ones((1, 1), np.float32))
+    deadline = time.time() + 5.0
+    while not sizes and time.time() < deadline:
+        time.sleep(0.005)  # wait until the worker picked up the first
+    futs = [b.submit(np.ones((2, 1), np.float32)) for _ in range(5)]
+    gate.set()
+    first.result(timeout=5.0)
+    for f in futs:
+        f.result(timeout=5.0)
+    b.stop()
+    assert sizes[0] == 1
+    assert sizes[1] == 10  # all five coalesced into ONE flush
+    stats = b.stats.to_dict(64)
+    assert stats["batches"] == 2
+    assert str(16) in stats["step_ms_ewma"]  # 10 rows -> bucket 16
+
+
+def test_continuous_batcher_bounded_queue_rejects_with_retry_after():
+    import threading
+
+    gate = threading.Event()
+
+    def infer(x):
+        gate.wait(timeout=5.0)
+        return x
+
+    b = serve.ContinuousBatcher(infer, max_batch_size=4, max_queue=3)
+    first = b.submit(np.ones((1, 1), np.float32))
+    deadline = time.time() + 5.0
+    while b.queue_depth and time.time() < deadline:
+        time.sleep(0.005)  # worker holds `first`; queue drains to 0
+    futs = [b.submit(np.ones((1, 1), np.float32)) for _ in range(3)]
+    with pytest.raises(serve.QueueFull) as exc:
+        b.submit(np.ones((1, 1), np.float32))
+    assert exc.value.retry_after_s > 0
+    assert exc.value.max_queue == 3
+    gate.set()
+    first.result(timeout=5.0)
+    for f in futs:
+        f.result(timeout=5.0)  # bounded, but nothing accepted was lost
+    b.stop()
+
+
+def test_continuous_batcher_adaptive_cap_steps_down_bucket_grid():
+    """The depth cap follows measured step time: a bucket whose EWMA
+    overruns target_step_ms is stepped past, down to one that fits."""
+    b = serve.ContinuousBatcher(lambda x: x, max_batch_size=16,
+                                target_step_ms=5.0)
+    try:
+        assert b._cap_rows() == 16  # unmeasured: optimistic
+        b.stats.record_step(16, 40.0)
+        b.stats.record_step(8, 20.0)
+        b.stats.record_step(4, 2.0)
+        assert b._cap_rows() == 4  # first bucket under the budget
+        # The EWMA recovers: fast measurements pull the cap back up.
+        for _ in range(20):
+            b.stats.record_step(16, 1.0)
+            b.stats.record_step(8, 1.0)
+        assert b._cap_rows() == 16
+    finally:
+        b.stop()
+
+
+def test_batcher_stopped_is_runtime_error_subclass():
+    # Back-compat: callers matching RuntimeError keep working.
+    assert issubclass(serve.BatcherStopped, RuntimeError)
+    assert issubclass(serve.QueueFull, RuntimeError)
+    assert issubclass(serve.Overloaded, RuntimeError)
+
+
+# --------------------------------------------------------------------------
+# windowed metrics (ring buffer)
+# --------------------------------------------------------------------------
+
+
+def test_metrics_window_reports_current_not_lifetime_latency():
+    m = serve.ServeMetrics(window=8)
+    for _ in range(100):
+        m.observe(1.0, rows=1)  # 1000 ms of bad history
+    for _ in range(8):
+        m.observe(0.001, rows=1)  # recent traffic is fast
+    assert m.p99_ms() <= 1.5  # the bad millisecond-era aged out
+    snap = m.snapshot()
+    assert snap["latency_window"] == 8
+    assert snap["latency_window_capacity"] == 8
+    assert snap["requests_total"] == 108  # counters stay lifetime
+    assert snap["latency_ms_p50"] <= 1.5
+
+
+def test_latency_window_ring_wraps_in_order():
+    w = serve.LatencyWindow(capacity=4)
+    for v in (1.0, 2.0, 3.0, 4.0, 5.0, 6.0):
+        w.add(v)
+    assert len(w) == 4
+    assert w.values() == [3.0, 4.0, 5.0, 6.0]  # oldest first, newest win
+
+
+# --------------------------------------------------------------------------
+# admission control / load shedding
+# --------------------------------------------------------------------------
+
+
+def test_replicaset_sheds_past_watermark(bundle_dir):
+    import threading
+
+    bundle = serve.load_bundle(bundle_dir)
+    rs = serve.ReplicaSet(bundle, num_replicas=1, restart=False,
+                          max_bucket=8, shed_watermark=3)
+    gate = threading.Event()
+    real_predict = rs.replicas[0].engine.predict
+    rs.replicas[0].engine.predict = (
+        lambda x: (gate.wait(5.0), real_predict(x))[1]
+    )
+    try:
+        x = np.zeros((1, 6, 4), np.float32)
+        # Depth counts queued AND in-flight: 3 unanswered = watermark.
+        accepted = [rs.submit(x) for _ in range(3)]
+        with pytest.raises(serve.Overloaded) as exc:
+            rs.submit(x)
+        assert exc.value.retry_after_s > 0
+        assert exc.value.depth >= 3
+        assert rs.sheds == 1
+        gate.set()
+        for f in accepted:
+            f.result(timeout=5.0)  # accepted requests all answer
+    finally:
+        rs.close()
+
+
+def test_server_returns_429_with_retry_after_when_shedding(server):
+    srv, base, val = server
+    srv.replicas.shed_watermark = 0  # shed everything: deterministic 429
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(f"{base}/predict",
+                  {"instances": np.asarray(val.x[:2], np.float32).tolist()})
+        assert e.value.code == 429
+        assert int(e.value.headers["Retry-After"]) >= 1
+        body = json.loads(e.value.read())
+        assert body["retry_after_s"] > 0
+    finally:
+        srv.replicas.shed_watermark = None
+    m = _get(f"{base}/metrics")
+    assert m["shed_total"] == 1
+    assert m["admission"]["sheds_total"] == 1
+
+
+# --------------------------------------------------------------------------
+# elastic replicas + autoscaler policy
+# --------------------------------------------------------------------------
+
+
+def test_replicaset_add_remove_replica_trajectory(bundle_dir, experiment):
+    _, val = experiment
+    bundle = serve.load_bundle(bundle_dir)
+    rs = serve.ReplicaSet(bundle, num_replicas=1, restart=False,
+                          max_bucket=8)
+    try:
+        x = np.asarray(val.x[:3], np.float32)
+        rs.warmup(x)
+        baseline = rs.predict(x)
+        assert rs.add_replica(reason="autoscale_up:test")
+        assert len(rs.replicas) == 2
+        # The newcomer was warmed before entering dispatch: traffic over
+        # both replicas compiles nothing new.
+        for _ in range(4):
+            assert np.array_equal(rs.predict(x), baseline)
+        assert rs.program_stats()["new_programs_since_warmup"] == 0
+        assert rs.remove_replica(reason="autoscale_down:test")
+        assert len(rs.replicas) == 1
+        assert np.array_equal(rs.predict(x), baseline)
+        assert not rs.remove_replica()  # never below one
+        stats = rs.scale_stats()
+        assert stats["replicas"] == 1
+        assert stats["scale_ups"] == 1 and stats["scale_downs"] == 1
+        reasons = [e["reason"] for e in stats["events"]]
+        assert reasons == ["init", "autoscale_up:test",
+                           "autoscale_down:test"]
+    finally:
+        rs.close()
+
+
+class _StubSet:
+    """Duck-typed ReplicaSet for deterministic autoscaler policy tests."""
+
+    def __init__(self):
+        self.replicas = [object()]
+        self.depth = 0
+        self.healthy = 1
+        self.open_breakers = 0
+        self.added, self.removed = [], []
+
+    def queue_depth_total(self):
+        return self.depth
+
+    def num_healthy(self):
+        return self.healthy
+
+    def breaker_stats(self):
+        return {"open_replicas": self.open_breakers}
+
+    def add_replica(self, reason=""):
+        self.replicas.append(object())
+        self.added.append(reason)
+        return True
+
+    def remove_replica(self, reason=""):
+        if len(self.replicas) <= 1:
+            return False
+        self.replicas.pop()
+        self.removed.append(reason)
+        return True
+
+
+class _StubMetrics:
+    p99 = 0.0
+
+    def p99_ms(self):
+        return self.p99
+
+
+def test_autoscaler_up_on_depth_cooldown_then_down_after_idle():
+    rs, m = _StubSet(), _StubMetrics()
+    a = serve.ReplicaAutoscaler(rs, m, serve.AutoscaleConfig(
+        min_replicas=1, max_replicas=3, up_queue_depth=4,
+        down_idle_s=1.0, cooldown_s=0.5,
+    ))
+    rs.depth, rs.healthy = 8, 1
+    assert a.tick(now=10.0)["action"] == "scale_up"
+    rs.healthy = 2
+    assert a.tick(now=10.1)["action"] == "hold"      # cooldown
+    assert a.tick(now=10.6)["action"] == "scale_up"  # still deep
+    rs.healthy = 3
+    assert len(rs.replicas) == 3
+    assert a.tick(now=11.2)["action"] == "hold"      # at max_replicas
+    rs.depth = 0                                      # load step ends
+    assert a.tick(now=11.3)["action"] == "hold"      # quiet period starts
+    assert a.tick(now=12.0)["action"] == "hold"      # 0.7s quiet < 1.0
+    assert a.tick(now=12.4)["action"] == "scale_down"
+    assert a.tick(now=12.6)["action"] == "hold"      # cooldown + re-armed
+    assert a.tick(now=13.5)["action"] == "scale_down"
+    assert len(rs.replicas) == 1
+    assert a.tick(now=15.0)["action"] == "hold"      # at min_replicas
+    assert a.snapshot()["scale_ups"] == 2
+    assert a.snapshot()["scale_downs"] == 2
+    assert all(r.startswith("autoscale_up") for r in rs.added)
+    assert all(r.startswith("autoscale_down") for r in rs.removed)
+
+
+def test_autoscaler_up_on_windowed_p99_slo_breach():
+    rs, m = _StubSet(), _StubMetrics()
+    a = serve.ReplicaAutoscaler(rs, m, serve.AutoscaleConfig(
+        min_replicas=1, max_replicas=2, slo_p99_ms=100.0,
+        up_queue_depth=1000,
+    ))
+    m.p99 = 50.0
+    assert a.tick(now=1.0)["action"] == "hold"
+    m.p99 = 250.0
+    d = a.tick(now=2.0)
+    assert d["action"] == "scale_up" and d["reason"] == "p99_slo"
+
+
+def test_autoscaler_is_breaker_aware():
+    """A quarantined replica is not capacity: depth-per-replica divides by
+    EFFECTIVE (healthy minus open) replicas, so a chaos kill reads as
+    lost capacity instead of being averaged away."""
+    rs, m = _StubSet(), _StubMetrics()
+    rs.replicas = [object(), object()]
+    rs.healthy, rs.open_breakers, rs.depth = 2, 1, 6
+    a = serve.ReplicaAutoscaler(rs, m, serve.AutoscaleConfig(
+        min_replicas=1, max_replicas=3, up_queue_depth=4,
+    ))
+    d = a.tick(now=5.0)
+    # 6 queued / 1 effective = 6 >= 4 (with 2 effective it would be 3).
+    assert d["action"] == "scale_up" and d["effective"] == 1
+
+
+# --------------------------------------------------------------------------
+# zero-downtime hot swap
+# --------------------------------------------------------------------------
+
+
+def _scaled_bundle(bundle_dir, factor):
+    """Same architecture cohort, different weights — a model promotion."""
+    import jax
+
+    b = serve.load_bundle(bundle_dir)
+    b.variables = jax.tree_util.tree_map(
+        lambda a: np.array(a) * factor, b.variables
+    )
+    b.path = f"{bundle_dir}#x{factor}"
+    return b
+
+
+def test_hot_swap_switches_model_with_zero_new_programs(
+    bundle_dir, experiment
+):
+    _, val = experiment
+    bundle_a = serve.load_bundle(bundle_dir)
+    bundle_b = _scaled_bundle(bundle_dir, 2.0)
+    x = np.asarray(val.x[:3], np.float32)
+    expected_b = serve.InferenceEngine(bundle_b, max_bucket=8).predict(x)
+
+    rs = serve.ReplicaSet(bundle_a, num_replicas=2, restart=False,
+                          max_bucket=8)
+    try:
+        rs.warmup(x)
+        before = rs.predict(x)
+        event = rs.hot_swap(bundle_b)
+        assert event["replicas_swapped"] == 2
+        after = rs.predict(x)
+        assert not np.array_equal(after, before)
+        assert np.array_equal(after, expected_b)
+        # Both fresh replicas answer the NEW model identically.
+        for _ in range(4):
+            assert np.array_equal(rs.predict(x), expected_b)
+        # The acceptance counter: the swap warmed off-path, traffic since
+        # compiled nothing.
+        assert rs.program_stats()["new_programs_since_warmup"] == 0
+        assert rs.swaps == 1
+        assert rs.bundle is bundle_b  # monitor restarts build the new one
+        assert rs.swap_history[-1]["bundle"] == bundle_b.path
+    finally:
+        rs.close()
+
+
+def test_server_admin_swap_endpoint(server, bundle_dir):
+    srv, base, val = server
+    x = np.asarray(val.x[:2], np.float32)
+    out = _post(f"{base}/admin/swap", {"bundle": bundle_dir})
+    assert out["swapped"] is True and out["replicas_swapped"] == 2
+    m = _get(f"{base}/metrics")
+    assert m["swap"]["swaps_total"] == 1
+    assert m["compile"]["new_programs_since_warmup"] == 0
+    # Same weights (same dir), so predictions are unchanged — the point
+    # is the machinery: serving continued across the swap.
+    preds = _post(f"{base}/predict", {"instances": x.tolist()})
+    assert len(preds["predictions"]) == 2
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(f"{base}/admin/swap", {})
+    assert e.value.code == 400

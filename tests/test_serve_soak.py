@@ -1,0 +1,214 @@
+"""Chaos-hardened serving soak (ISSUE 8): fixed-RPS traffic against a
+live ReplicaSet while a replica kill and a zero-downtime hot swap land
+mid-soak, plus the autoscaler's load-step trajectory.
+
+The contracts under test are the serve_soak bench section's acceptance
+claims, here made deterministic:
+
+* zero dropped (non-shed) requests — a replica death redispatches
+  server-side, a drain answers everything it accepted;
+* zero post-swap recompiles — counter-verified via program stats;
+* breaker / restart / autoscale transitions visible in ``/metrics``;
+* the autoscaler demonstrably scales up under a load step and back down
+  after it (replica-count trajectory asserted).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu import chaos, serve, tune
+from distributed_machine_learning_tpu.data import dummy_regression_data
+
+
+@pytest.fixture(scope="module")
+def bundles(tmp_path_factory):
+    """One tiny trained bundle + a scaled-weights twin (the promotion)."""
+    tmp = str(tmp_path_factory.mktemp("soak_exp"))
+    train, val = dummy_regression_data(
+        num_samples=64, seq_len=6, num_features=4, seed=3
+    )
+    analysis = tune.run(
+        tune.with_parameters(
+            tune.train_regressor, train_data=train, val_data=val
+        ),
+        {"model": "mlp", "hidden_sizes": [16], "learning_rate": 3e-3,
+         "num_epochs": 1, "batch_size": 32, "seed": 5},
+        metric="validation_loss", mode="min", num_samples=1,
+        storage_path=tmp, name="soak_src", verbose=0,
+    )
+    out = str(tmp_path_factory.mktemp("soak_bundles") / "winner")
+    serve.export_bundle(analysis, out)
+    import jax
+
+    bundle_a = serve.load_bundle(out)
+    bundle_b = serve.load_bundle(out)
+    bundle_b.variables = jax.tree_util.tree_map(
+        lambda a: np.array(a) * 1.5, bundle_b.variables
+    )
+    bundle_b.path = out + "#promoted"
+    return bundle_a, bundle_b, val
+
+
+def test_chaos_soak_kill_and_hot_swap_zero_drops(bundles):
+    """N requests at fixed RPS vs 2 replicas; a scheduled kill of the
+    serving replica at request 30, then — once the monitor's restart is
+    observed, still mid-soak — a hot swap to the promoted bundle.  Every
+    non-shed request answers, nothing recompiles post-swap, and the
+    failure story is readable from /metrics.
+
+    The kill is chaos-scheduled (deterministic in the request stream);
+    the swap is fired by the test AFTER the restart shows up in /metrics
+    so both transitions are individually assertable (a chaos-scheduled
+    swap can win the race for the dead slot and absorb the restart —
+    that composed path is exercised by bench child_serve_soak)."""
+    bundle_a, bundle_b, val = bundles
+    n_requests, rps = 150, 75.0
+    x = np.asarray(val.x[:2], np.float32)
+    expected_b = serve.InferenceEngine(bundle_b, max_bucket=8).predict(x)
+
+    plan = chaos.FaultPlan(seed=11, replica_kills=((30, -1),))
+    srv = serve.PredictionServer(
+        bundle_a, port=0, num_replicas=2, max_batch_size=8,
+        max_bucket=8, batcher="continuous", max_queue=256,
+        request_timeout_s=15.0, fault_plan=plan,
+    )
+    srv.warmup(x)
+    host, port = srv.start()
+    url = f"http://{host}:{port}"
+    payload = json.dumps({"instances": x.tolist()}).encode()
+
+    counts = {"ok": 0, "shed": 0, "dropped": 0}
+    lock = threading.Lock()
+
+    def one_request():
+        req = urllib.request.Request(
+            f"{url}/predict", data=payload,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=15) as resp:
+                resp.read()
+            key = "ok"
+        except urllib.error.HTTPError as exc:
+            shed = exc.code == 429 or (
+                exc.code == 503 and exc.headers.get("Retry-After")
+            )
+            key = "shed" if shed else "dropped"
+        except Exception:  # noqa: BLE001 - anything unanswered is a drop
+            key = "dropped"
+        with lock:
+            counts[key] += 1
+
+    def metrics():
+        with urllib.request.urlopen(f"{url}/metrics", timeout=10) as resp:
+            return json.loads(resp.read())
+
+    try:
+        threads = []
+        swapped = False
+        for i in range(n_requests):
+            th = threading.Thread(target=one_request, daemon=True)
+            th.start()
+            threads.append(th)
+            time.sleep(1.0 / rps)
+            # Mid-soak promotion: the moment the monitor's restart of the
+            # killed replica is visible, swap — traffic keeps flowing.
+            if not swapped and i >= 60 and metrics()["restarts"] >= 1:
+                serve.hot_swap(srv.replicas, bundle_b, sample=x)
+                swapped = True
+        for th in threads:
+            th.join(timeout=30)
+        assert swapped, "restart never observed -> swap never fired"
+
+        # Zero dropped (non-shed) requests across a kill AND a swap.
+        assert counts["dropped"] == 0, counts
+        assert counts["ok"] + counts["shed"] == n_requests
+
+        m = metrics()
+        # The chaos kill really fired, counter-verified end to end.
+        assert m["injected_faults"]["replica_kills"] == 1
+        # Monitor restarted the killed replica; the transition is visible.
+        assert m["restarts"] >= 1
+        assert m["num_healthy"] == m["num_replicas"] == 2
+        # Swap landed with ZERO post-swap recompiles.
+        assert m["swap"]["swaps_total"] == 1
+        assert m["compile"]["new_programs_since_warmup"] == 0
+        # Autoscale block present (trajectory recorded even when static).
+        assert m["autoscale"]["events"][0]["reason"] == "init"
+        # Post-swap traffic runs the NEW model.
+        out = json.loads(urllib.request.urlopen(
+            urllib.request.Request(
+                f"{url}/predict", data=payload,
+                headers={"Content-Type": "application/json"},
+            ), timeout=15,
+        ).read())
+        assert np.allclose(
+            np.asarray(out["predictions"], np.float32), expected_b,
+            rtol=1e-5, atol=1e-6,
+        )
+    finally:
+        srv.close()
+
+
+def test_autoscaler_scales_up_under_load_step_and_down_after(bundles):
+    """The acceptance trajectory, deterministically: gate the only
+    replica's engine so a burst piles up real queue depth -> the live
+    autoscaler adds (warmed) replicas; release the gate, traffic drains,
+    idle -> it scales back down.  The whole story is asserted from the
+    recorded replica-count trajectory."""
+    bundle_a, _, val = bundles
+    x = np.asarray(val.x[:1], np.float32)
+    rs = serve.ReplicaSet(bundle_a, num_replicas=1, restart=False,
+                          max_bucket=8, max_queue=256)
+    autoscaler = serve.ReplicaAutoscaler(
+        rs, serve.ServeMetrics(window=64), serve.AutoscaleConfig(
+            min_replicas=1, max_replicas=2, up_queue_depth=4,
+            down_idle_s=0.3, cooldown_s=0.1, interval_s=0.05,
+        ),
+    ).start()
+    gate = threading.Event()
+    try:
+        rs.warmup(x)
+        real_predict = rs.replicas[0].engine.predict
+        rs.replicas[0].engine.predict = (
+            lambda b: (gate.wait(15.0), real_predict(b))[1]
+        )
+        # Load step: a burst the gated replica cannot drain.
+        futs = [rs.submit(x) for _ in range(12)]
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if rs.scale_stats()["scale_ups"] >= 1:
+                break
+            time.sleep(0.05)
+        assert rs.scale_stats()["scale_ups"] >= 1, "no scale-up under load"
+        assert len(rs.replicas) == 2
+        # The added replica was warmed before dispatch: nothing compiled.
+        assert rs.program_stats()["new_programs_since_warmup"] == 0
+
+        gate.set()  # step ends; backlog drains, then idle
+        for f in futs:
+            f.result(timeout=15.0)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if rs.scale_stats()["scale_downs"] >= 1:
+                break
+            time.sleep(0.05)
+        stats = rs.scale_stats()
+        assert stats["scale_downs"] >= 1, "no scale-down after idle"
+        assert len(rs.replicas) == 1
+        # Trajectory tells the whole story in order: 1 -> 2 -> 1.
+        counts = [e["replicas"] for e in stats["events"]]
+        assert counts[0] == 1 and 2 in counts and counts[-1] == 1
+        reasons = [e["reason"] for e in stats["events"]]
+        assert any(r.startswith("autoscale_up") for r in reasons)
+        assert any(r.startswith("autoscale_down") for r in reasons)
+    finally:
+        gate.set()
+        autoscaler.close()
+        rs.close()
