@@ -909,6 +909,97 @@ def child_quality(scale: dict) -> None:
     print(json.dumps(result))
 
 
+def _pbt_quality_result(scale: dict, budget_s: float, note) -> dict:
+    """The in-device PBT arm of quality-at-budget (ISSUE 9).
+
+    Same space, data, budget, and program shapes as the ASHA arm — but the
+    whole population trains as ONE generation-scan program: exploit
+    ranking, the state gather, and the lr/wd explore are compiled in, so a
+    sweep of G generations costs ceil(num_epochs/chunk) host dispatches
+    instead of num_epochs/interval.  Repeated sweeps (fresh seeds) until
+    the next one would overrun the budget; the artifact carries best MAPE,
+    trials, the summed pbt counter block, and the measured host-dispatch
+    count — the directly comparable answer to the ASHA arm's
+    best-of-N-independent-sweeps number.
+    """
+    from distributed_machine_learning_tpu import tune
+    from distributed_machine_learning_tpu.data import glucose_like_data
+
+    train, val = glucose_like_data(
+        num_steps=scale["data_steps"], num_features=FEATURES
+    )
+    import jax
+
+    pop = scale["num_trials"]
+    epochs = scale["num_epochs"]
+    # One generation per ASHA grace period: the same decision cadence the
+    # ASHA arm prunes at, so the two arms spend comparable compute per
+    # decision.
+    interval = max(1, epochs // 4)
+    space = _bench_space(scale, "float32")
+    t0 = time.time()
+    best, total_trials, sweeps, last_wall = None, 0, 0, 0.0
+    counters = {"generations": 0, "exploits": 0, "explores": 0,
+                "host_dispatches": 0}
+    while True:
+        elapsed = time.time() - t0
+        if elapsed + max(last_wall, 5.0) > budget_s:
+            break
+        pbt = tune.PopulationBasedTraining(
+            perturbation_interval=interval,
+            hyperparam_mutations={
+                # The space's own lr/wd domains (search_space builders in
+                # _bench_space): explore stays inside what ASHA samples.
+                "learning_rate": tune.loguniform(1e-4, 1e-2),
+                "weight_decay": tune.loguniform(1e-6, 1e-3),
+            },
+            quantile_fraction=0.25,
+            seed=3000 + sweeps,
+        )
+        analysis = tune.run_vectorized(
+            space, train_data=train, val_data=val,
+            metric="validation_mape", mode="min",
+            num_samples=pop, max_batch_trials=pop,
+            scheduler=pbt,
+            storage_path=BENCH_RESULTS_DIR,
+            name=f"pbt_quality_{sweeps}_{int(t0)}",
+            seed=2000 + sweeps, verbose=0,
+        )
+        last_wall = (time.time() - t0) - elapsed
+        with open(os.path.join(analysis.root,
+                               "experiment_state.json")) as f:
+            state = json.load(f)
+        for k in ("generations", "exploits", "explores", "host_dispatches"):
+            counters[k] += int((state.get("pbt") or {}).get(k, 0))
+        counters["mode"] = (state.get("pbt") or {}).get("mode")
+        b = float(analysis.best_result.get("validation_mape", float("inf")))
+        best = b if best is None else min(best, b)
+        total_trials += analysis.num_terminated()
+        sweeps += 1
+        _touch_heartbeat()
+        note(f"pbt quality sweep {sweeps}: best {best:.2f} "
+             f"({total_trials} trials, "
+             f"{counters['host_dispatches']} host dispatches, "
+             f"{time.time() - t0:.0f}s)")
+    return {
+        "budget_s": budget_s,
+        "wall_s": round(time.time() - t0, 1),
+        "best_validation_mape": best,
+        "trials": total_trials,
+        "sweeps": sweeps,
+        "host_dispatches": counters["host_dispatches"],
+        "pbt": counters,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def child_pbt_quality(scale: dict) -> None:
+    t0 = time.time()
+    note = _make_note(t0)
+    result = _pbt_quality_result(scale, _quality_budget_s(), note)
+    print(json.dumps(result))
+
+
 def child_torch_quality(scale: dict) -> None:
     """The reference stack's best-val-at-budget: random search with
     synchronous successive halving (brackets of 8, bottom half culled each
@@ -2273,6 +2364,8 @@ def emit(value: float, vs_baseline, backend: str, extra: dict) -> None:
         )
     if extra.get("quality_at_budget"):
         compact["quality_at_budget"] = extra["quality_at_budget"]
+    if extra.get("pbt"):
+        compact["pbt"] = extra["pbt"]
     if extra.get("cold_second_run"):
         compact["cold_second_run"] = {
             k: extra["cold_second_run"].get(k)
@@ -2316,7 +2409,7 @@ def emit(value: float, vs_baseline, backend: str, extra: dict) -> None:
     # driver's tail capture (never the metric/value/backend core).
     out = json.dumps(compact)
     for k in ("compile_cache", "cold_second_run", "last_tpu_capture",
-              "flagship_prev", "asha", "flagship", "serve_soak",
+              "flagship_prev", "asha", "flagship", "serve_soak", "pbt",
               "quality_at_budget", "warm_skipped_after", "error"):
         if len(out) <= EMIT_MAX_CHARS:
             break
@@ -2766,6 +2859,7 @@ def main() -> None:
             f"elapsed > {QUALITY_SKIP_AFTER_S}s")
         phases["quality_skipped"] = "late"
         qb = 0
+    quality_pbt = None
     if qb > 0 and ours is not None:
         if quality_ours is None:
             log(f"running quality-at-budget (ours, CPU, {qb:.0f}s)")
@@ -2778,6 +2872,20 @@ def main() -> None:
             quality_ours = _parse_result(out) if rc == 0 else None
             if quality_ours is None:
                 log(f"quality child failed rc={rc}; tail: {err[-400:]}")
+        # The in-device PBT arm: same budget, same space/programs, whole
+        # sweep compiled as one generation scan (ISSUE 9) — reported
+        # beside ours/torch so the quality-at-budget table answers
+        # "which scheduler buys the best model per second".
+        log(f"running quality-at-budget (ours_pbt, CPU, {qb:.0f}s)")
+        t0 = time.time()
+        rc, out, err, _ = _run_child(
+            ["--child", "pbt_quality", scale_name], _cpu_env(),
+            qb + 300,
+        )
+        phases["quality_pbt_s"] = round(time.time() - t0, 1)
+        quality_pbt = _parse_result(out) if rc == 0 else None
+        if quality_pbt is None:
+            log(f"pbt quality child failed rc={rc}; tail: {err[-400:]}")
         # Equal WALL, not equal intent: our side's first sweep can overrun
         # the nominal budget on a cold compile — the torch side then gets
         # the seconds our side actually spent, never fewer.
@@ -2795,7 +2903,7 @@ def main() -> None:
         quality_torch = _parse_result(out) if rc == 0 else None
         if quality_torch is None:
             log(f"torch quality child failed rc={rc}; tail: {err[-400:]}")
-        if quality_ours or quality_torch:
+        if quality_ours or quality_torch or quality_pbt:
             quality = {"budget_s": qb}
             if quality_ours:
                 quality.update({
@@ -2804,6 +2912,16 @@ def main() -> None:
                     "ours_trials": quality_ours.get("trials"),
                     "ours_wall_s": _round_opt(quality_ours.get("wall_s"), 1),
                     "ours_backend": quality_ours.get("platform"),
+                })
+            if quality_pbt:
+                quality.update({
+                    "ours_pbt_best_mape": _round_opt(
+                        quality_pbt.get("best_validation_mape")),
+                    "ours_pbt_trials": quality_pbt.get("trials"),
+                    "ours_pbt_wall_s": _round_opt(
+                        quality_pbt.get("wall_s"), 1),
+                    "ours_pbt_host_dispatches":
+                        quality_pbt.get("host_dispatches"),
                 })
             if quality_torch:
                 quality.update({
@@ -2883,6 +3001,13 @@ def main() -> None:
     }
     if quality:
         extra["quality_at_budget"] = quality
+    if quality_pbt and quality_pbt.get("pbt"):
+        # The pbt counter block (generations/exploits/explores/
+        # host_dispatches summed over the arm's sweeps): host_dispatches
+        # far above generations/(chunk/interval) means the sweep fell back
+        # to boundary dispatching — the regression this block exists to
+        # expose in the artifact itself.
+        extra["pbt"] = quality_pbt["pbt"]
     if serve_soak is not None:
         extra["serve_soak"] = serve_soak
     if backend == "cpu":
@@ -2990,6 +3115,8 @@ if __name__ == "__main__":
             child_torch(FULL if argv[2] == "full" else SMALL)
         elif kind == "quality":
             child_quality(FULL if argv[2] == "full" else SMALL)
+        elif kind == "pbt_quality":
+            child_pbt_quality(FULL if argv[2] == "full" else SMALL)
         elif kind == "torch_quality":
             child_torch_quality(FULL if argv[2] == "full" else SMALL)
         elif kind == "variant":

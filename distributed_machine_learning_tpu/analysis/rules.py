@@ -1060,6 +1060,108 @@ class UnboundedQueueRule(Rule):
                     )
 
 
+# --------------------------------------------------------------------------
+# DML010 host-sync-in-scan
+# --------------------------------------------------------------------------
+
+
+# Vectorized hot-loop modules: anything whose scan bodies carry
+# population-stacked state (the fused epoch scans, the PBT generation
+# scan, the sharded fused epoch program).  Opt-in like DML002/DML008.
+VECTORIZED_HOT_LOOP_PATTERNS = (
+    "tune/vectorized.py",
+    "tune/_regression_program.py",
+    "tune/trainable",
+    "parallel/",
+)
+
+_HOST_SYNC_CALLS = {
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "jax.device_get",
+}
+_SCAN_NAMES = ("jax.lax.scan", "lax.scan")
+
+
+class HostSyncInScanRule(Rule):
+    name = "host-sync-in-scan"
+    rule_id = "DML010"
+    severity = "error"
+    description = (
+        "float() / .item() / np.asarray / jax.device_get inside a "
+        "lax.scan body: the body is TRACED, so a host conversion on a "
+        "population-stacked tracer either crashes at trace time "
+        "(ConcretizationTypeError) or silently constant-folds stale "
+        "values into the compiled program — and any survivor is a host "
+        "round-trip in the one loop the in-device design exists to keep "
+        "on device (the PBT generation scan dispatches ONCE per chunk "
+        "precisely because nothing inside it syncs).  Enforced in "
+        "opted-in vectorized hot-loop modules."
+    )
+    _HINT = (
+        "keep the scan body pure jnp (where/gather/cumsum replace host "
+        "logic); sync AFTER the dispatch returns — np.asarray on the "
+        "stacked outputs at the dispatch boundary is the supported place"
+    )
+
+    def applies(self, ctx) -> bool:
+        if "vectorized-hot-loop" in ctx.scopes:
+            return True
+        rel = ctx.display_path.replace("\\", "/")
+        return any(pat in rel for pat in VECTORIZED_HOT_LOOP_PATTERNS)
+
+    def _scan_bodies(self, scope: ast.AST) -> List[ast.AST]:
+        """Function defs / lambdas passed as a scan's body WITHIN one
+        enclosing scope (this codebase's idiom: the body is a nested def
+        right next to its lax.scan call)."""
+        local_defs: Dict[str, ast.AST] = {}
+        for node in ast.walk(scope):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_defs.setdefault(node.name, node)
+        bodies: List[ast.AST] = []
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            if (_call_name(node) or "") not in _SCAN_NAMES or not node.args:
+                continue
+            fn = node.args[0]
+            if isinstance(fn, ast.Lambda):
+                bodies.append(fn)
+            elif isinstance(fn, ast.Name) and fn.id in local_defs:
+                bodies.append(local_defs[fn.id])
+        return bodies
+
+    def check(self, ctx) -> Iterator[Finding]:
+        seen: Set[int] = set()
+        for body in self._scan_bodies(ctx.tree):
+            if id(body) in seen:
+                continue
+            seen.add(id(body))
+            for node in ast.walk(body):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _call_name(node) or ""
+                what = None
+                if callee == "float" and node.args:
+                    what = "float(...)"
+                elif callee in _HOST_SYNC_CALLS:
+                    what = f"{callee}(...)"
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item"
+                    and not node.args
+                ):
+                    what = ".item()"
+                if what:
+                    yield self.finding(
+                        ctx, node,
+                        f"host sync `{what}` inside a lax.scan body — "
+                        f"population-stacked values are tracers here; this "
+                        f"either fails to trace or bakes a stale constant "
+                        f"into the compiled hot loop",
+                        self._HINT,
+                    )
+
+
 ALL_RULES: List[Rule] = [
     DonationAliasRule(),
     UnlockedDispatchRule(),
@@ -1070,6 +1172,7 @@ ALL_RULES: List[Rule] = [
     ThreadSwallowRule(),
     UndonatedHotJitRule(),
     UnboundedQueueRule(),
+    HostSyncInScanRule(),
 ]
 
 

@@ -40,6 +40,7 @@ from distributed_machine_learning_tpu.compilecache.counters import (
 )
 from distributed_machine_learning_tpu.compilecache.keys import (
     NON_STRUCTURAL_KEYS,
+    pbt_program_key,
     program_key,
     sharded_program_key,
     shape_class_fingerprint,
@@ -72,6 +73,7 @@ __all__ = [
     "get_tracker",
     "install_artifacts",
     "pack_artifacts",
+    "pbt_program_key",
     "program_key",
     "sharded_program_key",
     "shape_class_fingerprint",

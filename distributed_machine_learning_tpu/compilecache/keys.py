@@ -104,6 +104,52 @@ def program_key(
     return "pk_" + hashlib.sha256(blob.encode()).hexdigest()[:32]
 
 
+def pbt_program_key(
+    config: Dict[str, Any],
+    *,
+    interval: int,
+    generations: int,
+    rows: int,
+    objective: Any = None,
+    mutation_spec: Any = None,
+    batch_shape: Optional[Sequence[Sequence[int]]] = None,
+    dtype: Optional[str] = None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> str:
+    """:func:`program_key` for the compiled PBT generation scan.
+
+    The generation scan is keyed by everything that shapes ITS trace on
+    top of the base shape class: the **perturbation interval** (inner
+    epoch-scan trip count), the **generation count** (outer scan trip
+    count), the **population row count**, the **objective** scalarization,
+    and the **mutation spec** constants (domain bounds, factors, resample
+    probability, quantile — all baked into the exploit/explore step).
+    The PBT ``seed`` must NOT split the key: it enters as per-row PRNG key
+    arguments, exactly like trial seeds in the base key — and
+    ``learning_rate``/``weight_decay`` stay non-structural (injected
+    optimizer state the scan mutates in-device).
+    """
+    spec = dict(mutation_spec or {})
+    merged = {
+        "pbt_scan": {
+            "interval": int(interval),
+            "generations": int(generations),
+            "rows": int(rows),
+            "objective": _canonical(objective or "quality"),
+            "mutations": _canonical(spec),
+        }
+    }
+    if extra:
+        merged.update(extra)
+    return program_key(
+        config,
+        batch_shape=batch_shape,
+        dtype=dtype,
+        donation=(0, 1, 2),
+        extra=merged,
+    )
+
+
 def sharded_program_key(
     config: Dict[str, Any],
     *,

@@ -100,6 +100,15 @@ def make_epoch_fn(
     return epoch
 
 
+# Metric names make_eval_fn produces (plus "train_loss" from the epoch fn):
+# the keys a compiled PBT generation scan can rank on.  Kept next to the
+# eval body so a metric rename cannot silently desynchronize the validator.
+EVAL_METRIC_KEYS = (
+    "validation_loss", "validation_mse", "validation_rmse",
+    "validation_mae", "validation_mape",
+)
+
+
 def make_eval_fn(
     forward: Callable, loss_name: str, n_blocks: int, eval_bs: int
 ) -> Callable:
@@ -202,6 +211,176 @@ def stage_data(
         n_val_blocks=n_val_pad // eval_bs,
         eval_bs=eval_bs,
     )
+
+
+def make_pbt_generation_fn(
+    epoch_fn: Callable,
+    eval_fn: Callable,
+    spec: Dict[str, Any],
+    *,
+    interval: int,
+    num_epochs_total: int,
+    metric: str,
+    n_rows: int,
+    n_valid: int,
+):
+    """The whole-PBT-sweep program body: a ``lax.scan`` over generations.
+
+    Each generation = ``interval`` epochs of the fused per-row epoch scan
+    (vmapped over the population) -> in-program quantile ranking over the
+    per-row metric -> exploit as gather (bottom-quantile rows adopt
+    top-quantile rows' params AND optimizer state) -> explore as
+    PRNG-driven per-row perturbation of the injected lr/wd (per-row keys
+    travel with their rows; a lagger keeps its own identity/seed).  This
+    is the Podracer "Anakin" shape applied to HPO: the host dispatches
+    once per generation CHUNK, not once per perturbation.
+
+    Every decision op is chosen for bit-parity with
+    ``schedulers.pbt.reference_generation_step``: threefry draws (jit ==
+    eager), stable lexsort ranking, IEEE f32 multiply/clip, and grid-gather
+    resampling (no transcendentals — XLA's fused exp is not bit-stable vs
+    eager).  Per-generation decisions come back as stacked scan outputs
+    (scores, src, new lr/wd, exploited) so the driver reconstructs trial
+    records, ``pbt_exploited_from`` notes, and TB streams exactly as rich
+    as the host-boundary path.
+
+    Returns ``run(params, opt_state, batch_stats, base_keys, pbt_keys,
+    lr, wd, x, y, xv, yv, mask, gen_ids, obj_scale)`` for the caller to
+    jit with ``donate_argnums=(0, 1, 2)``.  ``obj_scale`` is the host-
+    measured objective scalarization factor (latency/param terms — a
+    constant row multiplier, so in-population ranking is unchanged but
+    emitted scores are the deployability-scalarized objective).
+    """
+    if metric != "train_loss" and metric not in EVAL_METRIC_KEYS:
+        raise ValueError(
+            f"PBT metric {metric!r} is not produced by this trainable "
+            f"(have: train_loss, {', '.join(EVAL_METRIC_KEYS)})"
+        )
+    from distributed_machine_learning_tpu.ops.optimizers import (
+        set_injected_hyperparams,
+    )
+    from distributed_machine_learning_tpu.tune.schedulers.pbt import (
+        generation_draw_count,
+        resample_grid,
+    )
+
+    sign = np.float32(spec["sign"])
+    q = max(1, int(n_valid * spec["quantile"]))
+    lag_start = max(q, n_valid - q)
+    exploit_possible = n_valid >= 4 and lag_start < n_valid
+    n_draws = generation_draw_count(spec)
+    n_factors = len(spec["factors"])
+    factors_c = np.asarray(spec["factors"], np.float32)
+    grids = {e["key"]: resample_grid(e, spec["grid_points"])
+             for e in spec["specs"]}
+    invalid_c = (np.arange(n_rows) >= n_valid).astype(np.int8)
+    resample_p = np.float32(spec["resample_p"])
+
+    def exploit_explore(scores, lr, wd, draws, fire):
+        if not exploit_possible:
+            return (
+                jnp.arange(n_rows),
+                lr, wd,
+                jnp.zeros((n_rows,), bool),
+            )
+        rank = jnp.where(
+            jnp.isfinite(scores * sign), scores * sign, jnp.inf
+        ).astype(jnp.float32)
+        # Stable three-key sort: valid rows first, best score first, ties
+        # by row index — identical to the reference's sorted() tuple key.
+        order = jnp.lexsort((jnp.arange(n_rows), rank, invalid_c))
+        donors = order[:q]
+        donor_ok = jnp.isfinite(rank[donors])
+        n_ok = donor_ok.sum()
+        enabled = fire & jnp.isfinite(rank[order[0]]) & (n_ok > 0)
+        # Finite donors, original donor order first (stable partition).
+        fd = donors[jnp.lexsort((jnp.arange(q),
+                                 (~donor_ok).astype(jnp.int8)))]
+        laggers = order[lag_start:n_valid]
+        u0 = draws[laggers, 0]
+        d_idx = jnp.clip(
+            (u0 * n_ok.astype(jnp.float32)).astype(jnp.int32),
+            0, jnp.maximum(n_ok - 1, 0),
+        )
+        donor_rows = fd[d_idx]
+        src = jnp.arange(n_rows).at[laggers].set(
+            jnp.where(enabled, donor_rows, laggers)
+        )
+        exploited = jnp.zeros((n_rows,), bool).at[laggers].set(enabled)
+        vals = {"learning_rate": lr, "weight_decay": wd}
+        out = {}
+        for m, e in enumerate(spec["specs"]):
+            base = vals[e["key"]]
+            donor_v = base[src]
+            u_res = draws[:, 1 + 2 * m]
+            u_val = draws[:, 2 + 2 * m]
+            grid = jnp.asarray(grids[e["key"]])
+            gi = jnp.clip(
+                (u_val * np.float32(len(grids[e["key"]]))).astype(jnp.int32),
+                0, len(grids[e["key"]]) - 1,
+            )
+            resampled = grid[gi]
+            fi = jnp.clip(
+                (u_val * np.float32(n_factors)).astype(jnp.int32),
+                0, n_factors - 1,
+            )
+            stepped = jnp.clip(
+                donor_v * jnp.asarray(factors_c)[fi],
+                np.float32(e["lo"]), np.float32(e["hi"]),
+            )
+            cand = jnp.where(u_res < resample_p, resampled, stepped)
+            out[e["key"]] = jnp.where(exploited, cand, base)
+        for key in ("learning_rate", "weight_decay"):
+            if key not in spec["keys"]:
+                # Exploit copies the donor's whole config: an unmutated
+                # hyperparam still adopts the donor's value.
+                out[key] = jnp.where(exploited, vals[key][src], vals[key])
+        return src, out["learning_rate"], out["weight_decay"], exploited
+
+    def run(params, opt_state, batch_stats, base_keys, pbt_keys, lr, wd,
+            x, y, xv, yv, mask, gen_ids, obj_scale):
+        def one_row(p, o, b, key, epoch_ids):
+            def ebody(carry, e):
+                p, o, b = carry
+                k = jax.random.fold_in(key, e)
+                p, o, b, tl = epoch_fn(p, o, b, x, y, k)
+                m = eval_fn(p, b, xv, yv, mask)
+                return (p, o, b), (tl, m)
+
+            (p, o, b), (tls, ms) = jax.lax.scan(ebody, (p, o, b), epoch_ids)
+            return p, o, b, tls, ms
+
+        v_epochs = jax.vmap(one_row, in_axes=(0, 0, 0, 0, None))
+
+        def gen_body(carry, gen):
+            p, o, b, lr, wd = carry
+            epoch_ids = gen * interval + jnp.arange(interval)
+            p, o, b, tls, ms = v_epochs(p, o, b, base_keys, epoch_ids)
+            sel = tls if metric == "train_loss" else ms[metric]
+            scores = sel[:, -1] * obj_scale
+            draws = jax.vmap(
+                lambda k2: jax.random.uniform(
+                    jax.random.fold_in(k2, gen), (n_draws,)
+                )
+            )(pbt_keys)
+            # No perturbation after the sweep's final epoch (matching the
+            # boundary path's `epoch0 < num_epochs` guard).
+            fire = ((gen + 1) * interval) < num_epochs_total
+            src, new_lr, new_wd, exploited = exploit_explore(
+                scores, lr, wd, draws, fire
+            )
+            p, o, b = jax.tree.map(lambda a: a[src], (p, o, b))
+            o = set_injected_hyperparams(o, new_lr, new_wd)
+            return (p, o, b, new_lr, new_wd), (
+                tls, ms, scores, src, new_lr, new_wd, exploited
+            )
+
+        (p, o, b, lr, wd), ys = jax.lax.scan(
+            gen_body, (params, opt_state, batch_stats, lr, wd), gen_ids
+        )
+        return p, o, b, lr, wd, ys
+
+    return run
 
 
 def _call_lacks_deterministic(model) -> bool:

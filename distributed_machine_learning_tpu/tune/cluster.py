@@ -1566,6 +1566,13 @@ def run_distributed(
                if k != "distinct_keys"},
             "distinct_keys": reg["distinct_keys"],
         }
+        from distributed_machine_learning_tpu.tune.schedulers.pbt import (
+            pbt_state_block,
+        )
+
+        pbt_block = pbt_state_block(sched)
+        if pbt_block is not None:
+            extra["pbt"] = pbt_block
         try:
             store.write_state(trials, extra=extra)
             store.close()
@@ -1580,6 +1587,9 @@ def run_distributed(
                for k, v in (extra.get("checkpoint") or {}).items()},
             **{f"compile/{k}": v
                for k, v in (extra.get("compile") or {}).items()},
+            **{f"pbt/{k}": v
+               for k, v in (extra.get("pbt") or {}).items()
+               if isinstance(v, (int, float)) and not isinstance(v, bool)},
         }
         if counter_scalars:
             safe_cb("on_experiment_counters", counter_scalars)

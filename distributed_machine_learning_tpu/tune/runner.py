@@ -564,6 +564,16 @@ def run(
             # "it survived N faults" is a property of the artifact, not of
             # test logs.
             extra["injected_faults"] = plan.snapshot()
+        from distributed_machine_learning_tpu.tune.schedulers.pbt import (
+            pbt_state_block,
+        )
+
+        pbt_block = pbt_state_block(sched)
+        if pbt_block is not None:
+            # The pbt counter family (exploit/explore accounting) — the
+            # respawn driver's slice of what run_vectorized reports richer
+            # (generations/host_dispatches only exist in-device).
+            extra["pbt"] = pbt_block
         try:
             store.write_state(trials, extra=extra)
             store.close()
@@ -578,6 +588,9 @@ def run(
                for k, v in (extra.get("checkpoint") or {}).items()},
             **{f"compile/{k}": v
                for k, v in (extra.get("compile") or {}).items()},
+            **{f"pbt/{k}": v
+               for k, v in (extra.get("pbt") or {}).items()
+               if isinstance(v, (int, float)) and not isinstance(v, bool)},
         }
         if counter_scalars:
             safe_cb("on_experiment_counters", counter_scalars)

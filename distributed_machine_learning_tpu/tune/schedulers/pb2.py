@@ -103,6 +103,15 @@ class PB2(PopulationBasedTraining):
     def reset_improvement_chain(self, trial_id: str) -> None:
         self._last_score.pop(trial_id, None)
 
+    def device_mutation_spec(self):
+        """None: GP-UCB explore refits on host observations at EVERY
+        generation — it cannot be baked into a compiled generation scan.
+        run_vectorized therefore composes PB2 with the host-boundary path
+        (``pbt_mode="boundary"``): the GP keeps observing every report via
+        :meth:`observe_result` and its choices ride the same device-side
+        gather, one dispatch per perturbation interval."""
+        return None
+
     def on_trial_result(self, trial: Trial, result: Dict[str, Any]) -> str:
         self.observe_result(trial, result)
         decision = super().on_trial_result(trial, result)

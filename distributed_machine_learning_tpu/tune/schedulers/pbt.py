@@ -12,7 +12,7 @@ preemption-tolerant TPU trials must restart anyway.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -24,10 +24,57 @@ from distributed_machine_learning_tpu.tune.schedulers.base import (
 from distributed_machine_learning_tpu.tune.search_space import (
     Domain,
     LogRandInt,
+    LogUniform,
     RandInt,
+    Uniform,
 )
 from distributed_machine_learning_tpu.tune.trial import Trial
 from distributed_machine_learning_tpu.utils.seeding import rng_from
+
+# Resample values of the compiled exploit/explore step land on a fixed
+# host-precomputed grid (geometric for loguniform domains, linear for
+# uniform) instead of an exp/log inverse transform: transcendental ops are
+# NOT bit-stable between XLA's fused (jit) and eager kernels, and the
+# golden parity contract (compiled step == host reference, bit for bit) is
+# what makes the in-device path debuggable.  1024 points across an HPO
+# domain is far below the noise floor of any search.
+RESAMPLE_GRID_POINTS = 1024
+
+# Multiplicative scalarization weights: score = quality
+# * step_latency_s ** lat_w * param_millions ** param_w (mode="min" only —
+# every term is a cost).  Latency and params are constant across the rows
+# of one population (same architecture), so WITHIN a population the
+# ranking is pure quality; across populations / groups the scalarized
+# score (emitted per record as ``pbt_objective``) is what makes a
+# serve-bound sweep pick the best *deployable* model.
+_OBJECTIVE_WEIGHTS = {
+    "quality": (0.0, 0.0),
+    "quality_latency": (1.0, 0.0),
+    "quality_latency_params": (1.0, 1.0),
+}
+
+
+def _parse_objective(objective) -> Tuple[str, Tuple[float, float]]:
+    if objective is None:
+        objective = "quality"
+    if isinstance(objective, str):
+        if objective not in _OBJECTIVE_WEIGHTS:
+            raise ValueError(
+                f"objective must be one of {sorted(_OBJECTIVE_WEIGHTS)} or a "
+                f"weight dict {{'latency': w, 'params': w}}, got {objective!r}"
+            )
+        return objective, _OBJECTIVE_WEIGHTS[objective]
+    if isinstance(objective, dict):
+        unknown = set(objective) - {"latency", "params"}
+        if unknown:
+            raise ValueError(
+                f"objective weight dict supports 'latency'/'params', got "
+                f"{sorted(unknown)}"
+            )
+        lat = float(objective.get("latency", 0.0))
+        par = float(objective.get("params", 0.0))
+        return f"custom_lat{lat:g}_par{par:g}", (lat, par)
+    raise TypeError(f"objective must be a string or dict, got {objective!r}")
 
 
 class PopulationBasedTraining(TrialScheduler):
@@ -41,6 +88,7 @@ class PopulationBasedTraining(TrialScheduler):
         resample_probability: float = 0.25,
         perturbation_factors=(0.8, 1.2),
         seed: int = 0,
+        objective=None,
     ):
         if not hyperparam_mutations:
             raise ValueError("PBT requires hyperparam_mutations")
@@ -52,9 +100,15 @@ class PopulationBasedTraining(TrialScheduler):
         self.resample_p = resample_probability
         self.factors = perturbation_factors
         self.seed = seed
+        self.objective, self.objective_weights = _parse_objective(objective)
         # trial_id -> [(iteration, score), ...] in report order (lower=better)
         self._history: Dict[str, list] = {}
         self._num_perturbations = 0
+        # Decision trace of the deterministic generation step (compiled and
+        # boundary-reference paths append one entry per generation): the
+        # golden parity test replays these through
+        # :func:`reference_generation_step` and asserts bit equality.
+        self._generation_log: list = []
 
     def set_experiment(self, metric: str, mode: str):
         self.metric = self.metric if self.metric is not None else metric
@@ -183,6 +237,43 @@ class PopulationBasedTraining(TrialScheduler):
         """Record whatever the explore model learns from one report
         (no decision).  Base PBT learns nothing."""
 
+    def device_mutation_spec(self) -> Optional[Dict[str, Any]]:
+        """Static constants of the compiled exploit/explore step, or None.
+
+        None means these mutations cannot be compiled into the population
+        program — run_vectorized then keeps the host-boundary path.  The
+        compilable subset: every mutated key is ``learning_rate`` /
+        ``weight_decay`` (optimizer-state hyperparams) with a continuous
+        unquantized ``Uniform``/``LogUniform`` domain.  List specs,
+        quantized domains, callables, and model-based explores (PB2
+        overrides this to None) all need per-generation host decisions.
+        """
+        keys = tuple(sorted(self.mutations))
+        if not keys or not set(keys) <= {"learning_rate", "weight_decay"}:
+            return None
+        specs = []
+        for k in keys:
+            spec = self.mutations[k]
+            if not isinstance(spec, (Uniform, LogUniform)):
+                return None
+            if getattr(spec, "q", None):
+                return None  # quantized grids need _mutate's snap logic
+            specs.append({
+                "key": k,
+                "lo": float(spec.low),
+                "hi": float(spec.high),
+                "log": isinstance(spec, LogUniform),
+            })
+        return {
+            "sign": 1.0 if (self.mode or "min") == "min" else -1.0,
+            "quantile": float(self.quantile),
+            "resample_p": float(self.resample_p),
+            "factors": tuple(float(f) for f in self.factors),
+            "keys": keys,
+            "specs": tuple(specs),
+            "grid_points": RESAMPLE_GRID_POINTS,
+        }
+
     def reset_improvement_chain(self, trial_id: str) -> None:
         """The trial's weights were just replaced (exploit): any
         cross-boundary score delta is meaningless.  Base PBT keeps none."""
@@ -196,3 +287,164 @@ class PopulationBasedTraining(TrialScheduler):
 
     def debug_state(self):
         return {"num_perturbations": self._num_perturbations}
+
+
+# ---------------------------------------------------------------------------
+# Device-parity machinery: the compiled exploit/explore step and this
+# host-side reference must agree BIT FOR BIT on the same seed.  Everything
+# here is built from operations that are exactly reproducible between the
+# two: threefry draw bits (platform- and jit-invariant by design), IEEE
+# float32 multiply/min/max, integer truncation, and table lookups into a
+# host-precomputed resample grid (see RESAMPLE_GRID_POINTS).
+# ---------------------------------------------------------------------------
+
+
+def resample_grid(spec_entry: Dict[str, Any],
+                  n: int = RESAMPLE_GRID_POINTS) -> np.ndarray:
+    """The float32 resample table for one mutated hyperparameter.
+
+    Geometric spacing for log domains, linear otherwise — computed ONCE on
+    host and shared verbatim by the compiled program (baked constant) and
+    the reference, so 'resample' is a gather both sides do identically.
+    """
+    if spec_entry["log"]:
+        g = np.geomspace(spec_entry["lo"], spec_entry["hi"], n)
+    else:
+        g = np.linspace(spec_entry["lo"], spec_entry["hi"], n)
+    return np.asarray(g, np.float32)
+
+
+def generation_draw_count(spec: Dict[str, Any]) -> int:
+    """Uniform draws consumed per row per generation: one donor pick plus
+    (resample?, value) per mutated key."""
+    return 1 + 2 * len(spec["keys"])
+
+
+def generation_draws(seed: int, n_rows: int, gen: int,
+                     n_draws: int) -> np.ndarray:
+    """The ``(n_rows, n_draws)`` uniforms for generation ``gen``.
+
+    Derivation: per-row key ``fold_in(key(seed), row)`` folded with the
+    generation index — exactly the chain the compiled program evaluates
+    in-device (per-row keys travel with their rows; threefry bits are
+    identical eager vs jit), so the boundary path and this reference see
+    the same randomness as the scan.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    base = jax.random.key(int(seed))
+    keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+        jnp.arange(n_rows)
+    )
+    return np.asarray(
+        jax.vmap(
+            lambda k: jax.random.uniform(
+                jax.random.fold_in(k, gen), (n_draws,)
+            )
+        )(keys)
+    )
+
+
+def reference_generation_step(
+    spec: Dict[str, Any],
+    scores: np.ndarray,
+    row_lr: np.ndarray,
+    row_wd: np.ndarray,
+    valid: np.ndarray,
+    draws: np.ndarray,
+    fire: bool,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Host-side reference of ONE exploit/explore generation.
+
+    Pure numpy control flow over the shared draw bits; the compiled step in
+    ``tune/_regression_program.py`` is this function expressed as
+    gather/where — the golden parity test asserts they produce identical
+    ``(src, new_lr, new_wd, exploited)`` on the same inputs.
+
+    Semantics (mirroring the respawn scheduler above): rows ranked by
+    sign-adjusted score with non-finite rows strictly worst (never donate,
+    first rescued) and invalid rows (dummy pads / stopped trials) excluded;
+    the bottom quantile adopts a uniformly drawn FINITE top-quantile row's
+    state (``src``) and its hyperparams, each mutated resample-or-multiply
+    with clamping into the domain.  No exploit when fewer than 4 live rows,
+    when the best live score is non-finite, or after the final generation
+    (``fire=False``).
+    """
+    k = len(scores)
+    src = np.arange(k)
+    new_lr = np.asarray(row_lr, np.float32).copy()
+    new_wd = np.asarray(row_wd, np.float32).copy()
+    exploited = np.zeros(k, bool)
+    s = np.asarray(scores, np.float32) * np.float32(spec["sign"])
+    rank = np.where(np.isfinite(s), s, np.float32(np.inf)).astype(np.float32)
+    order = sorted(
+        range(k), key=lambda i: (0 if valid[i] else 1, rank[i], i)
+    )
+    n_valid = int(np.sum(np.asarray(valid, bool)))
+    if not fire or n_valid < 4 or not np.isfinite(rank[order[0]]):
+        return src, new_lr, new_wd, exploited
+    q = max(1, int(n_valid * spec["quantile"]))
+    donors = order[:q]
+    finite_donors = [i for i in donors if np.isfinite(rank[i])]
+    n_ok = len(finite_donors)
+    if n_ok == 0:
+        return src, new_lr, new_wd, exploited
+    lag_start = max(q, n_valid - q)
+    for i in order[lag_start:n_valid]:
+        u0 = np.float32(draws[i, 0])
+        d = finite_donors[
+            min(int(u0 * np.float32(n_ok)), n_ok - 1)
+        ]
+        src[i] = d
+        exploited[i] = True
+    # Explore operates on full columns (same vector shapes as the compiled
+    # step) and applies only to exploited rows; a key present in the
+    # population state but NOT mutated still adopts the donor's value —
+    # exploit copies the donor's whole config.
+    vals = {"learning_rate": new_lr, "weight_decay": new_wd}
+    out = {}
+    n_factors = len(spec["factors"])
+    factors = np.asarray(spec["factors"], np.float32)
+    for m, e in enumerate(spec["specs"]):
+        base = vals[e["key"]]
+        donor_v = base[src]
+        u_res = np.asarray(draws[:, 1 + 2 * m], np.float32)
+        u_val = np.asarray(draws[:, 2 + 2 * m], np.float32)
+        grid = resample_grid(e, spec.get("grid_points",
+                                         RESAMPLE_GRID_POINTS))
+        gi = np.clip(
+            (u_val * np.float32(len(grid))).astype(np.int32), 0,
+            len(grid) - 1,
+        )
+        resampled = grid[gi]
+        fi = np.clip(
+            (u_val * np.float32(n_factors)).astype(np.int32), 0,
+            n_factors - 1,
+        )
+        stepped = np.clip(
+            donor_v * factors[fi], np.float32(e["lo"]), np.float32(e["hi"])
+        ).astype(np.float32)
+        cand = np.where(u_res < np.float32(spec["resample_p"]),
+                        resampled, stepped)
+        out[e["key"]] = np.where(exploited, cand, base).astype(np.float32)
+    for key in ("learning_rate", "weight_decay"):
+        if key not in spec["keys"]:
+            out[key] = np.where(
+                exploited, vals[key][src], vals[key]
+            ).astype(np.float32)
+    return src, out["learning_rate"], out["weight_decay"], exploited
+
+
+def pbt_state_block(sched) -> Optional[Dict[str, Any]]:
+    """The ``pbt`` counter family for a driver's experiment_state extra —
+    what the respawn drivers (tune.run / run_distributed) can report; the
+    vectorized runner overlays its richer in-device counters on top."""
+    if not isinstance(sched, PopulationBasedTraining):
+        return None
+    return {
+        "mode": "respawn",
+        "exploits": sched._num_perturbations,
+        "explores": sched._num_perturbations,
+        "objective": sched.objective,
+    }

@@ -43,11 +43,19 @@ batch IS the PBT population — exploit is one device-side gather
 (bottom-quantile rows adopt top-quantile rows' params and optimizer state)
 and explore rewrites per-row learning_rate/weight_decay in the injected
 optimizer hyperparams.  No stop-and-respawn, no checkpoint round-trip, no
-recompile: a whole PBT generation costs one gather.  Only optimizer-state
-hyperparams can mutate (static keys change the program — use ``tune.run``'s
-respawn PBT for those).  PB2 composes: its GP observes every report via
-``observe_result`` and its UCB choice rides the same gather.  Other
-REQUEUE-style schedulers are unsupported.
+recompile.  Two execution modes (``pbt_mode=``): **compiled** (default
+where possible) scans WHOLE GENERATIONS inside one program — quantile
+ranking, the exploit gather, and the PRNG-driven explore are part of the
+traced computation, so a sweep of G generations costs
+``ceil(num_epochs/chunk)`` host dispatches instead of one per interval
+(the Podracer "Anakin" architecture applied to HPO); **boundary** keeps
+the host round-trip per interval but makes the SAME decisions through the
+shared deterministic reference step (``schedulers/pbt.py``), bit for bit.
+Only optimizer-state hyperparams can mutate (static keys change the
+program — use ``tune.run``'s respawn PBT for those).  PB2 composes on the
+boundary path: its GP observes every report via ``observe_result`` and
+its UCB choice rides the same gather.  Other REQUEUE-style schedulers are
+unsupported.
 
 The jittable program bodies are shared with the per-trial trainable via
 ``tune/_regression_program.py``.
@@ -217,6 +225,12 @@ class _GroupProgram:
         eval_one = make_eval_fn(
             forward, self.loss_name, data.n_val_blocks, data.eval_bs
         )
+        # Kept for the compiled-PBT generation scan, which composes the
+        # same epoch/eval bodies inside its own lax.scan.
+        self._epoch_one = epoch_one
+        self._eval_one = eval_one
+        self._pbt_programs: Dict[Tuple, Tuple] = {}
+        self._param_count: Optional[int] = None
 
         # With a population mesh, init materializes DIRECTLY in the sharded
         # layout — device 0 never has to hold (or scatter) the whole
@@ -260,6 +274,83 @@ class _GroupProgram:
             ),
             donate_argnums=(0, 1, 2),
         )
+
+    def param_count(self, base_keys, lrs, wds) -> int:
+        """Per-row parameter count via eval_shape pricing (nothing is
+        allocated) — the ``params`` term of the multi-objective
+        scalarization.  Constant across a population (same architecture),
+        so it scales the emitted objective without changing in-population
+        ranking."""
+        if self._param_count is None:
+            tpl = jax.eval_shape(self.init_population, base_keys, lrs, wds)
+            self._param_count = sum(
+                int(np.prod(leaf.shape[1:]))  # drop the population axis
+                for leaf in jax.tree.leaves(tpl[0])
+            )
+        return self._param_count
+
+    def pbt_generation_program(self, spec, *, interval: int, n_gens: int,
+                               n_rows: int, n_valid: int, metric: str,
+                               objective, log):
+        """The jitted generation-scan program for one (spec, geometry).
+
+        Cached per (scan lengths, population size, metric, mutation
+        constants): chunked dispatches of the same generation count reuse
+        ONE compiled program, and the canonical key rides the same
+        compilecache identity space as every other driver's programs
+        (interval/objective split the key; the PBT seed — per-row PRNG
+        key arguments — does not)."""
+        cache_key = (
+            interval, n_gens, n_rows, n_valid, metric, spec["sign"],
+            spec["quantile"], spec["resample_p"], spec["factors"],
+            tuple(tuple(sorted(e.items())) for e in spec["specs"]),
+        )
+        from distributed_machine_learning_tpu.compilecache import (
+            get_counters,
+            pbt_program_key,
+        )
+
+        hit = self._pbt_programs.get(cache_key)
+        if hit is not None:
+            get_counters().add("program_hits")
+            return hit
+        get_counters().add("program_misses")
+        from distributed_machine_learning_tpu.tune._regression_program import (
+            make_pbt_generation_fn,
+        )
+
+        key_spec = {
+            k: v for k, v in spec.items() if k != "keys"
+        }
+        key_spec["keys"] = list(spec["keys"])
+        key_spec["specs"] = [dict(e) for e in spec["specs"]]
+        prog_key = pbt_program_key(
+            self._static_cfg,
+            interval=interval,
+            generations=n_gens,
+            rows=n_rows,
+            objective=objective,
+            mutation_spec=key_spec,
+            batch_shape=[
+                tuple(self.data.x_train.shape), tuple(self.data.x_val.shape)
+            ],
+            extra={"vectorized": 1},
+        )
+        run = jax.jit(
+            make_pbt_generation_fn(
+                self._epoch_one, self._eval_one, spec,
+                interval=interval, num_epochs_total=self.num_epochs,
+                metric=metric, n_rows=n_rows, n_valid=n_valid,
+            ),
+            donate_argnums=(0, 1, 2),
+        )
+        log(
+            f"PBT generation scan: {n_gens} generation(s) x {interval} "
+            f"epoch(s) over {n_rows} rows compiled as one program "
+            f"[{prog_key}]"
+        )
+        self._pbt_programs[cache_key] = (run, prog_key)
+        return run, prog_key
 
     def rebind_data(self, train_data: Dataset, val_data: Dataset,
                     force: bool = False) -> None:
@@ -430,7 +521,8 @@ def _fit_dispatch_model(obs):
     return float(lat), float(ppe)
 
 
-def _resolve_auto_dispatch(program, sched, pbt, rows_now: int, log) -> int:
+def _resolve_auto_dispatch(program, sched, pbt, rows_now: int, log,
+                           pbt_compiled: bool = False) -> int:
     """Pick epochs_per_dispatch for this sweep from measured history.
 
     The trade (RESULTS.md round-5 session 2): rung-sized chunks let a
@@ -441,14 +533,25 @@ def _resolve_auto_dispatch(program, sched, pbt, rows_now: int, log) -> int:
     trial to max_t in the one cached program and applies rung stops
     post-hoc to the per-epoch record stream — identical reported
     results (stops land at the same rungs), more row-epochs, less wall
-    when dispatch latency dominates.  PBT can never speculate (exploit
-    mutates mid-flight state); FIFO always runs whole-budget.
+    when dispatch latency dominates.  Boundary-mode PBT can never
+    speculate (exploit mutates mid-flight state on host); COMPILED PBT
+    runs whole-budget outright — its generation scan mutates that state
+    in-program.  FIFO always runs whole-budget.
     """
     from distributed_machine_learning_tpu.tune.schedulers.base import (
         FIFOScheduler,
     )
 
     if pbt is not None:
+        if pbt_compiled:
+            # Exploit/explore is compiled INTO the program (generation
+            # scan), so nothing forces a host round-trip per interval:
+            # dispatch the whole budget at once — host dispatches for a
+            # PBT sweep drop from num_epochs/interval to
+            # ceil(num_epochs/chunk).
+            return program.num_epochs
+        # Boundary mode: one state gather per dispatch boundary, so the
+        # chunk must match the perturbation cadence.
         return max(int(pbt.interval), 1)
     if isinstance(sched, FIFOScheduler):
         return program.num_epochs
@@ -531,6 +634,7 @@ def run_vectorized(
     compile_cache_dir: Optional[str] = "auto",
     compaction: str = "auto",
     epochs_per_dispatch="auto",
+    pbt_mode: str = "auto",
     checkpoint_every_epochs: int = 0,
     checkpoint_format: str = "msgpack",
     resume: bool = False,
@@ -578,6 +682,21 @@ def run_vectorized(
     rule or ``checkpoint_every_epochs`` caps the auto pick so those
     keep their dispatch-boundary semantics; pass an int to force a
     chunk size.
+
+    ``pbt_mode``: how a ``PopulationBasedTraining`` sweep executes its
+    exploit/explore.  ``"auto"`` (default) compiles the whole sweep as a
+    generation scan — ranking, the state gather, and the lr/wd explore
+    in-device, one host dispatch per generation chunk — whenever the
+    scheduler allows it (continuous unquantized lr/wd domains, no ``stop``
+    rules, not PB2), else falls back to the host-boundary path.
+    ``"compiled"`` demands the in-device path (raises if impossible);
+    ``"boundary"`` forces the per-interval host round-trip — useful for
+    A/B debugging, and exact: both modes share one deterministic decision
+    step (same threefry draws, same f32 arithmetic, grid-based
+    resampling), so they produce identical exploit pairs and perturbed
+    values on the same seed.  The ``experiment_state.json["pbt"]`` block
+    (mode, generations, exploits, explores, host_dispatches) records
+    which path actually ran.
 
     ``checkpoint_every_epochs``: preemption tolerance for long sweeps — at
     matching dispatch boundaries the WHOLE in-flight population (params,
@@ -680,6 +799,50 @@ def run_vectorized(
             )
         pbt = sched
     sched.set_experiment(metric, mode)
+    # ---- PBT execution mode ------------------------------------------------
+    # "compiled": the WHOLE sweep is one generation-scan program — exploit
+    # ranking, the state gather, and the lr/wd explore all run in-device, and
+    # the host dispatches once per generation CHUNK instead of once per
+    # perturbation interval.  "boundary": the legacy host round-trip per
+    # interval — required by schedulers whose explore consults host state
+    # every generation (PB2's GP), by non-continuous mutation specs, and by
+    # per-epoch host decisions (stop= rules).  "auto" compiles when it can.
+    if pbt_mode not in ("auto", "compiled", "boundary"):
+        raise ValueError(
+            f"pbt_mode must be 'auto', 'compiled' or 'boundary', "
+            f"got {pbt_mode!r}"
+        )
+    pbt_compiled = False
+    pbt_spec = None
+    pbt_counters: Dict[str, Any] = {}
+    if pbt is not None:
+        if pbt.objective_weights != (0.0, 0.0) and mode != "min":
+            raise ValueError(
+                "PopulationBasedTraining(objective=...) scalarizes "
+                "quality x latency x params as a COST product — it is only "
+                "defined for mode='min' metrics"
+            )
+        pbt_spec = pbt.device_mutation_spec()
+        boundary_reasons = []
+        if pbt_spec is None:
+            boundary_reasons.append(
+                "the scheduler/mutation specs need per-generation host "
+                "decisions (PB2, list/quantized/callable specs)"
+            )
+        if stop is not None:
+            boundary_reasons.append(
+                "stop= rules decide per epoch on host"
+            )
+        if pbt_mode == "compiled" and boundary_reasons:
+            raise ValueError(
+                "pbt_mode='compiled' is impossible here: "
+                + "; ".join(boundary_reasons)
+            )
+        pbt_compiled = pbt_mode != "boundary" and not boundary_reasons
+        pbt_counters = {
+            "generations": 0, "exploits": 0, "explores": 0,
+            "host_dispatches": 0,
+        }
 
     if resume and not name:
         raise ValueError("resume=True requires name= of the prior run")
@@ -691,6 +854,14 @@ def run_vectorized(
     def log(msg: str):
         if verbose:
             print(f"[tune.vectorized] {msg}", flush=True)
+
+    if pbt is not None:
+        log(
+            "PBT mode: "
+            + ("compiled (exploit/explore in-program; host dispatches span "
+               "generations)" if pbt_compiled
+               else "boundary (host gather per perturbation interval)")
+        )
 
     from distributed_machine_learning_tpu.tune.callbacks import (
         with_default_reporter,
@@ -864,6 +1035,19 @@ def run_vectorized(
         ckpt_counters = _ckpt_m().delta_since(ckpt_metrics_base)
         if any(ckpt_counters.values()):
             extra["checkpoint"] = ckpt_counters
+        if pbt is not None:
+            # The pbt counter family: whether a sweep actually ran
+            # in-device (mode + host_dispatches) is a property of the
+            # artifact, not of logs — host_dispatches >> generations /
+            # (chunk/interval) is the "clamp is back" regression signal
+            # (docs/performance.md counter->action table).
+            extra["pbt"] = {
+                "mode": "compiled" if pbt_compiled else "boundary",
+                "objective": pbt.objective,
+                "interval": int(pbt.interval),
+                **pbt_counters,
+                **pbt.debug_state(),
+            }
         try:
             store.write_state(trials, extra=extra)
             store.close()
@@ -878,6 +1062,9 @@ def run_vectorized(
                for k, v in (extra.get("checkpoint") or {}).items()},
             **{f"compile/{k}": v
                for k, v in (extra.get("compile") or {}).items()},
+            **{f"pbt/{k}": v
+               for k, v in (extra.get("pbt") or {}).items()
+               if isinstance(v, (int, float)) and not isinstance(v, bool)},
         }
         if counter_scalars:
             safe_cb("on_experiment_counters", counter_scalars)
@@ -953,6 +1140,8 @@ def run_vectorized(
                         ckpt_manager=(
                             pop_manager if group_ckpt_path else None
                         ),
+                        pbt_compiled=pbt_compiled, pbt_spec=pbt_spec,
+                        pbt_counters=pbt_counters,
                     )
                     resume_state = None  # consumed by the first (only) group
                     row_epochs += pop_rows
@@ -1242,6 +1431,89 @@ def _emit_epoch_records(
             safe_cb("on_trial_complete", trial)
 
 
+def _pbt_objective_scale(pbt, program, base_keys, row_lr, row_wd) -> float:
+    """The constant scalarization factor of the multi-objective score:
+    ``step_latency_s ** lat_w * param_millions ** param_w``.
+
+    Latency comes from the program's measured dispatch history (riding the
+    cross-call program cache, so a warm sweep prices itself from the prior
+    sweep's measurement; neutral 1.0 before any measurement exists) and
+    params from eval_shape pricing — both constant across a population's
+    rows, so in-population exploit ranking is unchanged while the emitted
+    ``pbt_objective`` metric makes rows comparable ACROSS architecture
+    groups (the best *deployable* model wins a multi-group sweep).  Frozen
+    per population: re-reading the latency EWMA between generations would
+    break the compiled-vs-boundary decision parity.
+    """
+    lat_w, par_w = pbt.objective_weights
+    if lat_w == 0.0 and par_w == 0.0:
+        return 1.0
+    scale = 1.0
+    if lat_w:
+        obs = [o for o in program.dispatch_obs
+               if o.get("exec_s") and o.get("chunk")]
+        if obs:
+            o = obs[-1]
+            step_s = o["exec_s"] / max(o["chunk"] * program.steps_per_epoch,
+                                       1)
+            scale *= step_s ** lat_w
+    if par_w:
+        millions = program.param_count(base_keys, row_lr, row_wd) / 1e6
+        scale *= millions ** par_w
+    # float32: the device multiplies scores by this as an f32 scalar and
+    # the host reference must see the same bits.
+    return float(np.float32(scale))
+
+
+def _inject_objective(pbt, obj_scale, train_losses, metrics_np):
+    """Attach the scalarized objective as a per-epoch record metric
+    (``pbt_objective``) when multi-objective ranking is on — pass
+    ``run_vectorized(metric="pbt_objective")`` (with the quality metric
+    named on the scheduler) to make best-trial selection deployability-
+    aware across groups."""
+    if pbt is None or pbt.objective_weights == (0.0, 0.0):
+        return metrics_np
+    col = (train_losses if pbt.metric == "train_loss"
+           else metrics_np.get(pbt.metric))
+    if col is None:
+        return metrics_np
+    out = dict(metrics_np)
+    out["pbt_objective"] = np.asarray(col, np.float32) * np.float32(obj_scale)
+    return out
+
+
+def _apply_reference_exploits(batch, rows, lrs, wds, pbt, pbt_notes,
+                              src, new_lr, new_wd, exploited, mut_keys):
+    """Mirror one generation's (in-device or reference) exploit decisions
+    into the host bookkeeping: trial configs adopt the donor's config with
+    the perturbed hyperparams (the lagger keeps its own seed/identity),
+    improvement chains reset, and the donor note annotates the next
+    record.  Returns the (lagger, donor) trial-id pairs."""
+    pairs = []
+    for i in np.flatnonzero(np.asarray(exploited)):
+        r = rows[int(i)]
+        if r < 0:  # dummy pad rows are never laggers (ranked invalid)
+            continue
+        donor_r = rows[int(src[int(i)])]
+        lagger, donor = batch[r], batch[donor_r]
+        new_cfg = dict(donor.config)
+        new_cfg["learning_rate"] = float(new_lr[int(i)])
+        if "weight_decay" in new_cfg or "weight_decay" in mut_keys:
+            new_cfg["weight_decay"] = float(new_wd[int(i)])
+        new_cfg["seed"] = lagger.config.get("seed", 0)
+        lagger.config = new_cfg
+        # The laggard's weights are about to be (were) replaced by the
+        # donor's: a score delta across that boundary would credit the new
+        # config with the donor's head start.
+        pbt.reset_improvement_chain(lagger.trial_id)
+        lrs[r] = float(new_lr[int(i)])
+        wds[r] = float(new_wd[int(i)])
+        pbt_notes[r] = donor.trial_id
+        pairs.append((lagger.trial_id, donor.trial_id))
+        pbt._num_perturbations += 1
+    return pairs
+
+
 def _progress_note(msg: str) -> None:
     """Stderr heartbeat, on when ``DML_TUNE_PROGRESS`` is set (bench
     children set it). jit work is silent from the host side — on a remote
@@ -1285,6 +1557,9 @@ def _run_population(
     stop_rules=None,
     watchdog=None,
     ckpt_manager=None,
+    pbt_compiled: bool = False,
+    pbt_spec=None,
+    pbt_counters=None,
 ) -> Tuple[int, float]:
     """Train one population of K same-shape trials to completion.
 
@@ -1471,9 +1746,28 @@ def _run_population(
         int(getattr(sched, "max_t", program.num_epochs)
             or program.num_epochs),
     )
+    pbt_counters = pbt_counters if pbt_counters is not None else {}
+    if pbt_compiled and epoch_start % max(int(pbt.interval), 1):
+        # A resumed population whose checkpoint landed off a generation
+        # boundary cannot re-enter the generation scan mid-generation; the
+        # boundary path makes the SAME decisions (shared reference step),
+        # just one dispatch per interval.
+        log(
+            f"PBT falling back to boundary mode: resume epoch "
+            f"{epoch_start} is not a multiple of the perturbation "
+            f"interval {pbt.interval}"
+        )
+        pbt_compiled = False
+        # Overrides the driver-level mode in the teardown block (dict-merge
+        # order): the artifact must say what actually ran.
+        pbt_counters["mode"] = "boundary"
+        pbt_counters["mode_fallbacks"] = (
+            pbt_counters.get("mode_fallbacks", 0) + 1
+        )
     speculative = False
     if epochs_per_dispatch == "auto":
-        dispatch = _resolve_auto_dispatch(program, sched, pbt, len(rows), log)
+        dispatch = _resolve_auto_dispatch(program, sched, pbt, len(rows), log,
+                                          pbt_compiled=pbt_compiled)
         if stop_rules is not None:
             # User stop rules act at dispatch boundaries; a whole-budget
             # dispatch would turn a mid-sweep stop (plateau, timeout)
@@ -1495,17 +1789,50 @@ def _run_population(
         speculative = pbt is None and dispatch == e_spec
     else:
         dispatch = max(int(epochs_per_dispatch), 1)
-    if pbt is not None and dispatch > pbt.interval:
-        # One state gather can happen per dispatch boundary, so a chunk
-        # larger than the perturbation interval would silently DROP
-        # perturbations, not delay them.  Clamp so every interval fires.
+    chunk_gens = 0
+    if pbt is not None and pbt_compiled:
+        # In-device generations made the old interval clamp obsolete: the
+        # generation scan fires EVERY perturbation in-program, so the
+        # dispatch chunk may span many intervals.  It must still be a
+        # whole number of generations (round down; at least one) — the
+        # per-epoch leftover below the interval runs as a trailing plain
+        # chunk with no perturbation after it, same as the boundary
+        # path's final partial interval.
+        iv = max(int(pbt.interval), 1)
+        chunk_gens = dispatch // iv
+        if chunk_gens < 1:
+            # A checkpoint cadence (or explicit chunk) below the interval
+            # cannot fit one generation in-program: boundary fallback.
+            log(
+                f"PBT falling back to boundary mode: dispatch chunk "
+                f"{dispatch} < perturbation interval {iv}"
+            )
+            pbt_compiled = False
+            pbt_counters["mode"] = "boundary"
+            pbt_counters["mode_fallbacks"] = (
+                pbt_counters.get("mode_fallbacks", 0) + 1
+            )
+        elif chunk_gens * iv != dispatch:
+            log(
+                f"epochs_per_dispatch rounded {dispatch} -> "
+                f"{chunk_gens * iv} (whole generations of {iv} epochs; "
+                f"compiled PBT dispatches in generation units)"
+            )
+            dispatch = chunk_gens * iv
+    if pbt is not None and not pbt_compiled and dispatch > pbt.interval:
+        # Boundary fallback: one state gather can happen per dispatch
+        # boundary, so a chunk larger than the perturbation interval would
+        # silently DROP perturbations, not delay them.  Clamp so every
+        # interval fires.  (The compiled path above has no such limit —
+        # keeping this clamp active there is the regression the
+        # host_dispatches counter exists to catch.)
         log(
             f"epochs_per_dispatch clamped {dispatch} -> {pbt.interval} to "
-            f"match the PBT perturbation interval"
+            f"match the PBT perturbation interval (boundary mode)"
         )
         dispatch = pbt.interval
     epoch_budget = program.num_epochs
-    if dispatch > 1 and program.num_epochs % dispatch:
+    if dispatch > 1 and not pbt_compiled and program.num_epochs % dispatch:
         if speculative:
             # The auto resolver picked ONE whole-horizon speculative
             # dispatch (dispatch == max_t < num_epochs, not dividing it).
@@ -1537,12 +1864,201 @@ def _run_population(
             )
             dispatch = d
 
+    # PBT deterministic-step state (compiled AND boundary-reference paths):
+    # the per-ROW lr/wd the decision step last produced (float32 — the
+    # exact bits the device carries in the injected optimizer state), the
+    # frozen objective scalarization factor, and — compiled only — the
+    # per-row PBT PRNG keys that travel with their rows.
+    pbt_row_lr = pbt_row_wd = None
+    obj_scale = 1.0
+    pbt_keys = None
+    mut_keys: Tuple[str, ...] = ()
+    if pbt is not None and pbt_spec is not None:
+        mut_keys = tuple(pbt_spec["keys"])
+        pbt_row_lr = np.asarray(
+            [lrs[r] if r >= 0 else float(lrs[0]) for r in rows], np.float32
+        )
+        pbt_row_wd = np.asarray(
+            [wds[r] if r >= 0 else float(wds[0]) for r in rows], np.float32
+        )
+        obj_scale = _pbt_objective_scale(
+            pbt, program, base_keys,
+            jnp.asarray(pbt_row_lr), jnp.asarray(pbt_row_wd),
+        )
+        if obj_scale != 1.0:
+            log(
+                f"PBT multi-objective ranking: scores scaled by "
+                f"{obj_scale:.3g} ({pbt.objective})"
+            )
+    if pbt_compiled:
+        n_live = sum(1 for r in rows if r >= 0)
+        if any(r < 0 for r in rows[:n_live]):
+            # The compiled step ranks the valid PREFIX; pads are appended
+            # at creation so this never trips — defensive fallback only.
+            log("PBT falling back to boundary mode: non-suffix pad rows")
+            pbt_compiled = False
+            pbt_counters["mode"] = "boundary"
+            pbt_counters["mode_fallbacks"] = (
+                pbt_counters.get("mode_fallbacks", 0) + 1
+            )
+        else:
+            _pbt_base_key = jax.random.key(int(pbt.seed))
+            pbt_keys = jax.vmap(
+                lambda i: jax.random.fold_in(_pbt_base_key, i)
+            )(jnp.arange(len(rows)))
+            if pop_sharding is not None:
+                pbt_keys = jax.device_put(pbt_keys, pop_sharding)
     epoch0 = epoch_start
     # First dispatch of a population size traces + compiles; the watchdog
     # grants it the first-beat grace.  Compaction changes the compiled size,
     # so the dispatch after it is cold again.
     cold_dispatch = True
     while epoch0 < epoch_budget:
+        iv = max(int(pbt.interval), 1) if pbt is not None else 1
+        if (
+            pbt_compiled
+            and epoch0 % iv == 0
+            and (epoch_budget - epoch0) >= iv
+        ):
+            # ---- compiled PBT: the generation scan IS the dispatch ------
+            # One host round trip covers g generations: g x interval
+            # epochs, g in-program rankings, g exploit gathers, g explore
+            # perturbations.  Stacked per-generation outputs reconstruct
+            # the full record/note stream below.
+            g = min(chunk_gens, (epoch_budget - epoch0) // iv)
+            gen0 = epoch0 // iv
+            n_valid = sum(1 for r in rows if r >= 0)
+            run, _prog_key = program.pbt_generation_program(
+                pbt_spec, interval=iv, n_gens=g, n_rows=len(rows),
+                n_valid=n_valid, metric=pbt.metric, objective=pbt.objective,
+                log=log,
+            )
+            _progress_note(
+                f"dispatch PBT generations {gen0}..{gen0 + g} "
+                f"({g * iv} epochs) over {len(rows)} rows (first dispatch "
+                f"of a shape traces+compiles)"
+            )
+            c0 = tracker.thread_seconds()
+            t0 = time.time()
+            if watchdog is not None:
+                watchdog.track(
+                    "dispatch",
+                    info={"epoch0": epoch0, "epoch_end": epoch0 + g * iv,
+                          "rows": len(rows)},
+                    first_beat_grace_s=None if cold_dispatch else 0.0,
+                )
+            from distributed_machine_learning_tpu import chaos as _chaos
+
+            _plan = _chaos.active_plan()
+            if _plan is not None:
+                _plan.maybe_hang_dispatch("vectorized", epoch0 + 1)
+            data = program.data
+            params, opt_state, batch_stats, _lr_out, _wd_out, ys = run(
+                params, opt_state, batch_stats, base_keys, pbt_keys,
+                jnp.asarray(pbt_row_lr), jnp.asarray(pbt_row_wd),
+                data.x_train, data.y_train, data.x_val, data.y_val,
+                data.val_mask,
+                jnp.arange(gen0, gen0 + g), jnp.float32(obj_scale),
+            )
+            tls_all = np.asarray(ys[0])                       # (g, K, iv)
+            ms_all = {k: np.asarray(v) for k, v in ys[1].items()}
+            scores_all = np.asarray(ys[2], np.float32)        # (g, K)
+            src_all = np.asarray(ys[3])
+            newlr_all = np.asarray(ys[4], np.float32)
+            newwd_all = np.asarray(ys[5], np.float32)
+            expl_all = np.asarray(ys[6])
+            if watchdog is not None:
+                watchdog.untrack("dispatch")
+            cold_dispatch = False
+            from distributed_machine_learning_tpu.ckpt import get_metrics
+
+            get_metrics().add("steps", g * iv)
+            compile_delta = tracker.thread_seconds() - c0
+            exec_s = max(time.time() - t0 - compile_delta, 0.0)
+            _progress_note(
+                f"dispatch synced: {exec_s:.1f}s execute + "
+                f"{compile_delta:.1f}s compile"
+            )
+            if compile_delta > 0.05:
+                compile_cost_s = compile_delta
+            program.dispatch_obs.append({
+                "chunk": g * iv, "rows": len(rows),
+                "exec_s": exec_s, "compile_s": compile_delta,
+            })
+            del program.dispatch_obs[:-32]
+            per_epoch_exec = exec_s / (g * iv)
+            exec_ema = (
+                per_epoch_exec if exec_ema is None
+                else 0.5 * (exec_ema + per_epoch_exec)
+            )
+            exec_total_s += exec_s
+            row_epochs += len(rows) * g * iv
+            pbt_counters["host_dispatches"] += 1
+            pbt_counters["generations"] += g
+
+            t_end = time.time()
+            total_e = g * iv
+            for gi in range(g):
+                gen = gen0 + gi
+                for e_off in range(iv):
+                    epoch = gen * iv + e_off
+                    train_losses = tls_all[gi, :, e_off]
+                    metrics_np = {k: v[gi, :, e_off]
+                                  for k, v in ms_all.items()}
+                    metrics_np = _inject_objective(
+                        pbt, obj_scale, train_losses, metrics_np
+                    )
+                    step_count = (epoch + 1) * program.steps_per_epoch
+                    shape_val = float(program.shape_schedule(
+                        min(step_count, program.total_steps)
+                    ))
+                    now = (t0 + ((gi * iv + e_off) + 1)
+                           * (t_end - t0) / total_e)
+                    _emit_epoch_records(
+                        batch, rows, active, lrs, epoch, step_count,
+                        shape_val, now, train_losses, metrics_np,
+                        pbt_notes, pbt, sched, searcher, store, metric,
+                        mode, safe_cb, stop_rules,
+                    )
+                # Mirror this generation's in-device decisions into the
+                # host bookkeeping; notes annotate the NEXT generation's
+                # first record, exactly like the boundary path.
+                pbt._generation_log.append({
+                    "gen": gen,
+                    "fire": bool(((gen + 1) * iv) < program.num_epochs),
+                    "scores": scores_all[gi].copy(),
+                    "row_lr": pbt_row_lr.copy(),
+                    "row_wd": pbt_row_wd.copy(),
+                    "valid": np.asarray([r >= 0 for r in rows]),
+                    "src": src_all[gi].copy(),
+                    "new_lr": newlr_all[gi].copy(),
+                    "new_wd": newwd_all[gi].copy(),
+                    "exploited": expl_all[gi].copy(),
+                })
+                pairs = _apply_reference_exploits(
+                    batch, rows, lrs, wds, pbt, pbt_notes,
+                    src_all[gi], newlr_all[gi], newwd_all[gi],
+                    expl_all[gi], mut_keys,
+                )
+                pbt_counters["exploits"] += len(pairs)
+                pbt_counters["explores"] += len(pairs) * len(mut_keys)
+                if pairs:
+                    log(
+                        f"PBT epoch {(gen + 1) * iv - 1} (in-device): "
+                        + ", ".join(f"{a}<-{b}" for a, b in pairs)
+                    )
+                pbt_row_lr = newlr_all[gi].copy()
+                pbt_row_wd = newwd_all[gi].copy()
+            safe_cb("on_heartbeat")
+            epoch0 += g * iv
+            if (
+                ckpt_every
+                and ckpt_path
+                and epoch0 < program.num_epochs
+                and (epoch0 // ckpt_every) > ((epoch0 - g * iv) // ckpt_every)
+            ):
+                save_population(epoch0)
+            continue
         chunk = min(dispatch, epoch_budget - epoch0)
         _progress_note(
             f"dispatch epochs {epoch0}..{epoch0 + chunk} over "
@@ -1625,12 +2141,17 @@ def _run_population(
         )
         exec_total_s += exec_s
         row_epochs += len(rows) * chunk
+        if pbt is not None:
+            pbt_counters["host_dispatches"] += 1
 
         t_end = time.time()
         for e_off in range(chunk):
             epoch = epoch0 + e_off
             train_losses = tl_chunk[:, e_off]
             metrics_np = {key: v[:, e_off] for key, v in metrics_chunk.items()}
+            metrics_np = _inject_objective(
+                pbt, obj_scale, train_losses, metrics_np
+            )
             step_count = (epoch + 1) * program.steps_per_epoch
             # Trial-independent: evaluate once per epoch, not per trial.
             shape_val = float(
@@ -1653,11 +2174,15 @@ def _run_population(
         # window on this hook (callbacks.py), same as tune.run's event loop.
         safe_cb("on_heartbeat")
 
-        # ---- vectorized PBT: exploit = one gather over the population ------
+        # ---- vectorized PBT (boundary mode): exploit = one gather ----------
         # A chunk may cross interval boundaries; fire when it did (at worst
         # the perturbation lands chunk-1 epochs late — document, don't drop).
+        # Compiled mode never reaches here mid-sweep: its generation scan
+        # fires every interval in-program, and the only per-epoch chunks it
+        # dispatches are trailing leftovers past the final generation.
         if (
             pbt is not None
+            and not pbt_compiled
             and (epoch0 // pbt.interval) > ((epoch0 - chunk) // pbt.interval)
             and epoch0 < program.num_epochs
         ):
@@ -1671,6 +2196,78 @@ def _run_population(
                     f"trainable (have: train_loss, "
                     f"{', '.join(sorted(metrics_np))})"
                 )
+            pbt_counters["generations"] += 1
+        if (
+            pbt is not None
+            and not pbt_compiled
+            and pbt_spec is not None
+            and (epoch0 // pbt.interval) > ((epoch0 - chunk) // pbt.interval)
+            and epoch0 < program.num_epochs
+        ):
+            # Deterministic reference step — the exact host-side twin of
+            # the compiled generation step (shared draw bits, shared f32
+            # arithmetic), so pbt_mode="boundary" reproduces the compiled
+            # path's decisions bit for bit.  PB2 and non-continuous specs
+            # take the legacy branch below instead.
+            from distributed_machine_learning_tpu.tune.schedulers.pbt import (
+                generation_draw_count,
+                generation_draws,
+                reference_generation_step,
+            )
+
+            gen = (epoch0 - 1) // pbt.interval
+            valid = np.asarray([r >= 0 and active[r] for r in rows])
+            draws = generation_draws(
+                pbt.seed, len(rows), gen, generation_draw_count(pbt_spec)
+            )
+            scores_f = (np.asarray(scores, np.float32)
+                        * np.float32(obj_scale))
+            src, new_lr, new_wd, exploited = reference_generation_step(
+                pbt_spec, scores_f, pbt_row_lr, pbt_row_wd, valid, draws,
+                True,
+            )
+            pbt._generation_log.append({
+                "gen": gen, "fire": True,
+                "scores": scores_f.copy(),
+                "row_lr": pbt_row_lr.copy(),
+                "row_wd": pbt_row_wd.copy(),
+                "valid": valid,
+                "src": src.copy(), "new_lr": new_lr.copy(),
+                "new_wd": new_wd.copy(), "exploited": exploited.copy(),
+            })
+            pairs = _apply_reference_exploits(
+                batch, rows, lrs, wds, pbt, pbt_notes,
+                src, new_lr, new_wd, exploited, mut_keys,
+            )
+            pbt_counters["exploits"] += len(pairs)
+            pbt_counters["explores"] += len(pairs) * len(mut_keys)
+            if pairs:
+                sel = jnp.asarray(src)
+                # Exploit: bottom rows adopt donor rows' weights AND
+                # optimizer state in one device-side gather; explore lands
+                # in the injected optimizer hyperparams.
+                params, opt_state, batch_stats = jax.tree.map(
+                    lambda a: a[sel], (params, opt_state, batch_stats)
+                )
+                opt_state = _set_hyperparams(
+                    opt_state, jnp.asarray(new_lr), jnp.asarray(new_wd)
+                )
+                if pop_sharding is not None:
+                    params, opt_state, batch_stats = jax.device_put(
+                        (params, opt_state, batch_stats), pop_sharding
+                    )
+                log(
+                    f"PBT epoch {epoch}: "
+                    + ", ".join(f"{a}<-{b}" for a, b in pairs)
+                )
+            pbt_row_lr = new_lr.copy()
+            pbt_row_wd = new_wd.copy()
+        elif (
+            pbt is not None
+            and not pbt_compiled
+            and (epoch0 // pbt.interval) > ((epoch0 - chunk) // pbt.interval)
+            and epoch0 < program.num_epochs
+        ):
             sign = 1.0 if pbt.mode == "min" else -1.0
 
             def rank_key(value: float) -> float:
@@ -1718,6 +2315,10 @@ def _run_population(
                     pbt_notes[r] = donor.trial_id
                     exploited.append((lagger.trial_id, donor.trial_id))
                     pbt._num_perturbations += 1
+                pbt_counters["exploits"] += len(exploited)
+                pbt_counters["explores"] += (
+                    len(exploited) * len(pbt.mutations)
+                )
                 if exploited:
                     sel = jnp.asarray(src)
                     # Exploit: bottom rows adopt donor rows' weights AND

@@ -1,0 +1,31 @@
+# dmlint-scope: vectorized-hot-loop
+"""Historical bug pattern (ISSUE 9): a host conversion inside a scan body.
+
+The scan body is traced, so ``float()``/``.item()``/``np.asarray``/
+``jax.device_get`` on a population-stacked carry either crashes at trace
+time or constant-folds a stale value into the compiled hot loop — and any
+survivor is a per-step host round-trip in exactly the loop the in-device
+PBT design exists to keep on device."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_epoch(xs):
+    def body(carry, x):
+        best = float(carry.sum())  # EXPECT: host-sync-in-scan
+        snap = np.asarray(carry)  # EXPECT: host-sync-in-scan
+        host = jax.device_get(x)  # EXPECT: host-sync-in-scan
+        worst = carry.min().item()  # EXPECT: host-sync-in-scan
+        return carry + x, (best, snap, host, worst)
+
+    return jax.lax.scan(body, jnp.zeros(4), xs)
+
+
+def generation_loop(gen_ids, scores0):
+    return jax.lax.scan(
+        lambda s, g: (s, np.array(s)),  # EXPECT: host-sync-in-scan
+        scores0,
+        gen_ids,
+    )

@@ -1,0 +1,25 @@
+# dmlint-scope: vectorized-hot-loop
+"""Idiomatic twin: the scan body stays pure jnp (ranking via lexsort /
+where / gather — no host logic), and host conversions happen AFTER the
+dispatch returns, on the stacked outputs at the dispatch boundary."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_epoch(xs):
+    def body(carry, x):
+        order = jnp.lexsort((jnp.arange(carry.shape[0]), carry))
+        rescued = carry.at[order[-1]].set(carry[order[0]])
+        return rescued + x, rescued.sum()
+
+    return jax.lax.scan(body, jnp.zeros(4), xs)
+
+
+def dispatch(xs):
+    carry, sums = make_epoch(xs)
+    # Dispatch boundary: the program is done — syncing the stacked
+    # outputs here is the supported place.
+    totals = np.asarray(sums)
+    return float(totals[-1]), carry.sum().item()

@@ -1064,6 +1064,15 @@ def test_main_quality_at_budget_cpu_path(monkeypatch, capsys):
                 "best_validation_mape": 80.123, "trials": 32,
                 "sweeps": 2, "platform": "cpu",
             }), "", True
+        if args[:2] == ["--child", "pbt_quality"]:
+            return 0, json.dumps({
+                "budget_s": 30.0, "wall_s": 28.5,
+                "best_validation_mape": 79.456, "trials": 24,
+                "sweeps": 3, "host_dispatches": 3,
+                "pbt": {"generations": 12, "exploits": 9, "explores": 18,
+                        "host_dispatches": 3, "mode": "compiled"},
+                "platform": "cpu",
+            }), "", True
         if args[:2] == ["--child", "torch_quality"]:
             return 0, json.dumps({
                 "budget_s": 30.0, "wall_s": 30.2,
@@ -1085,7 +1094,17 @@ def test_main_quality_at_budget_cpu_path(monkeypatch, capsys):
     assert q["torch_best_mape"] == 91.46
     assert q["ours_trials"] == 32 and q["torch_trials"] == 8
     assert q["ours_backend"] == "cpu"
+    # The in-device PBT arm rides beside ours/torch (ISSUE 9)...
+    assert q["ours_pbt_best_mape"] == 79.46
+    assert q["ours_pbt_trials"] == 24
+    assert q["ours_pbt_host_dispatches"] == 3
+    # ...and the pbt counter block lands in the artifact AND the compact
+    # emit (generations >> host_dispatches = the in-device proof).
+    assert line["pbt"]["generations"] == 12
+    assert line["pbt"]["host_dispatches"] == 3
+    assert line["pbt"]["mode"] == "compiled"
     assert _detail()["quality_at_budget"] == q
+    assert _detail()["pbt"] == line["pbt"]
 
 
 def test_main_quality_from_tpu_suite(monkeypatch, capsys):
@@ -1111,6 +1130,15 @@ def test_main_quality_from_tpu_suite(monkeypatch, capsys):
             return 0, "probe OK: 1 x tpu", "", True
         if args[:2] == ["--child", "torch"]:
             return 0, json.dumps({"trials_per_hour": 70.0}), "", True
+        if args[:2] == ["--child", "pbt_quality"]:
+            return 0, json.dumps({
+                "budget_s": 30.0, "wall_s": 29.0,
+                "best_validation_mape": 81.0, "trials": 16,
+                "sweeps": 2, "host_dispatches": 2,
+                "pbt": {"generations": 8, "exploits": 6, "explores": 12,
+                        "host_dispatches": 2, "mode": "compiled"},
+                "platform": "cpu",
+            }), "", True
         if args[:2] == ["--child", "torch_quality"]:
             return 0, json.dumps({
                 "budget_s": 30.0, "wall_s": 30.0,
@@ -1130,7 +1158,9 @@ def test_main_quality_from_tpu_suite(monkeypatch, capsys):
     assert q["ours_backend"] == "tpu"
     assert q["ours_best_mape"] == 79.9
     assert q["torch_best_mape"] == 92.0
+    assert q["ours_pbt_best_mape"] == 81.0
     assert ["--child", "quality"] not in children  # suite already ran ours
+    assert ["--child", "pbt_quality"] in children  # the PBT arm still runs
 
 
 def test_monitored_runner_retains_full_child_logs(tmp_path, monkeypatch):

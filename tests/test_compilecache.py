@@ -114,6 +114,117 @@ def test_key_tuple_list_agnostic():
 
 
 # ---------------------------------------------------------------------------
+# compiled-PBT generation-scan keys (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+_PBT_SPEC = {
+    "quantile": 0.25, "resample_p": 0.25, "factors": (0.8, 1.2),
+    "keys": ["learning_rate"],
+    "specs": [{"key": "learning_rate", "lo": 1e-3, "hi": 1e-1, "log": True}],
+    "grid_points": 1024, "sign": 1.0,
+}
+PBT_GOLDEN_KEY = "pk_5f43c740785e3c9878f6b7ade4a87320"
+
+
+def _pbt_key(cfg=None, **over):
+    kwargs = dict(interval=2, generations=4, rows=8,
+                  mutation_spec=_PBT_SPEC, batch_shape=[(64, 8, 4)])
+    kwargs.update(over)
+    return cc.pbt_program_key(cfg or BASE_CFG, **kwargs)
+
+
+def test_pbt_key_golden_and_seed_invariant():
+    """The generation-scan key is a pure content hash (committed golden),
+    and the PBT/trial seeds must NOT split it — seeds ride in as per-row
+    PRNG key ARGUMENTS, exactly like trial seeds in the base key, so one
+    compiled scan serves every seeding of the same sweep shape."""
+    assert _pbt_key() == PBT_GOLDEN_KEY
+    assert _pbt_key(dict(BASE_CFG, seed=999, learning_rate=3.3,
+                         weight_decay=0.0)) == PBT_GOLDEN_KEY
+
+
+@pytest.mark.parametrize("change", [
+    {"interval": 4},                       # inner scan trip count
+    {"generations": 2},                    # outer scan trip count
+    {"rows": 16},                          # population size
+    {"objective": "quality_latency_params"},
+    {"mutation_spec": dict(_PBT_SPEC, resample_p=0.5)},
+    {"mutation_spec": dict(_PBT_SPEC, specs=[
+        {"key": "learning_rate", "lo": 1e-4, "hi": 1e-1, "log": True}])},
+])
+def test_pbt_key_splits_on_scan_identity(change):
+    assert _pbt_key(**change) != PBT_GOLDEN_KEY
+
+
+_PBT_SWEEP_CODE = """
+import json, os
+import numpy as np
+from distributed_machine_learning_tpu import tune
+from distributed_machine_learning_tpu.data import Dataset
+
+rng = np.random.default_rng(7)
+x = rng.normal(size=(128, 8, 4)).astype(np.float32)
+w = rng.normal(size=(4,)).astype(np.float32)
+y = (x.mean(axis=1) @ w)[:, None].astype(np.float32)
+train, val = Dataset(x[:96], y[:96]), Dataset(x[96:], y[96:])
+space = {
+    "model": "mlp", "hidden_sizes": (16, 8),
+    "learning_rate": tune.loguniform(1e-3, 1e-1),
+    "weight_decay": 1e-6, "seed": tune.randint(0, 10_000),
+    "num_epochs": 12, "batch_size": 16, "loss_function": "mse",
+    "lr_schedule": "constant",
+}
+pbt = tune.PopulationBasedTraining(
+    perturbation_interval=1,
+    hyperparam_mutations={"learning_rate": tune.loguniform(1e-3, 1e-1)},
+    quantile_fraction=0.25, seed=3,
+)
+analysis = tune.run_vectorized(
+    space, train_data=train, val_data=val,
+    metric="validation_mse", mode="min", num_samples=8,
+    scheduler=pbt, epochs_per_dispatch=3,  # 4 chunks x 3 generations
+    storage_path=os.environ["SWEEP_DIR"], seed=2, verbose=0,
+)
+with open(os.path.join(analysis.root, "experiment_state.json")) as f:
+    print(json.dumps(json.load(f)))
+"""
+
+
+def test_compiled_pbt_zero_recompile_across_generations(tmp_path):
+    """Acceptance (ISSUE 9 satellite): generations >> uncached backend
+    compiles.  A chunked compiled-PBT sweep re-dispatches ONE generation-
+    scan program — the second chunk compiles nothing new.
+
+    Runs in a FRESH process (honest compile census, and the big scan's
+    fusions must not pollute this process's XLA CPU symbol registry —
+    in-process, a later ``deserialize_executable`` can fail with
+    'Symbols not found' and silently cost other tests a recompile)."""
+    out = subprocess.run(
+        [sys.executable, "-c", _PBT_SWEEP_CODE],
+        env=dict(os.environ, PYTHONPATH=REPO_ROOT, JAX_PLATFORMS="cpu",
+                 SWEEP_DIR=str(tmp_path)),
+        capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    state = json.loads(out.stdout.strip().splitlines()[-1])
+    pbt_block = state["pbt"]
+    assert pbt_block["mode"] == "compiled"
+    assert pbt_block["generations"] == 12
+    assert pbt_block["host_dispatches"] == 4
+    compile_block = state["compile"]
+    # Program count: vmapped init + ONE generation scan (reused by all 4
+    # chunks) + the handful of tiny eager helpers (key creation).  The
+    # decisive property: uncached compiles stay far below the generation
+    # count — the scan recompiles for NO generation and NO chunk.
+    assert compile_block["backend_compiles_uncached"] <= 6
+    assert (compile_block["backend_compiles_uncached"]
+            < pbt_block["generations"])
+    # The cross-chunk program cache registered 1 miss (first build) and
+    # 3 hits for the generation scan.
+    assert compile_block.get("program_hits", 0) >= 3
+
+
+# ---------------------------------------------------------------------------
 # AOT executable cache
 # ---------------------------------------------------------------------------
 

@@ -3,16 +3,43 @@ vmapped population (no respawn, no checkpoint round-trip).
 
 BASELINE.json config 3 requires PBT; tune.run covers the stop-and-respawn
 variant (tests/test_cluster.py, test_schedulers.py) — this covers the
-TPU-shaped one: one gather per generation.
+TPU-shaped one.  Two execution modes (ISSUE 9): "compiled" (the default
+when possible) scans WHOLE GENERATIONS in one program — ranking, the
+exploit gather, and the lr/wd explore all in-device, one host dispatch per
+generation chunk; "boundary" keeps the host round-trip per interval (PB2,
+non-continuous specs, stop rules) but makes the SAME decisions through the
+shared deterministic reference step.
 """
+
+import json
+import os
 
 import numpy as np
 import pytest
 
 from distributed_machine_learning_tpu import tune
 from distributed_machine_learning_tpu.data import Dataset
+from distributed_machine_learning_tpu.tune.schedulers.pbt import (
+    generation_draw_count,
+    generation_draws,
+    reference_generation_step,
+)
 from distributed_machine_learning_tpu.tune.trial import TrialStatus
 from distributed_machine_learning_tpu.tune.vectorized import run_vectorized
+
+
+def _state_of(analysis):
+    with open(os.path.join(analysis.root, "experiment_state.json")) as f:
+        return json.load(f)
+
+
+def _exploit_notes(analysis):
+    return sorted(
+        (t.trial_id, r["training_iteration"], r["pbt_exploited_from"])
+        for t in analysis.trials
+        for r in t.results
+        if "pbt_exploited_from" in r
+    )
 
 
 @pytest.fixture(scope="module")
@@ -182,6 +209,283 @@ def test_vectorized_pbt_lifts_stuck_trials(tiny_data, tmp_path):
     )
     # The stuck half of the FIFO population never improves; PBT rescues it.
     assert np.median(pbt_finals) < np.median(fifo_finals)
+
+
+# --------------------------------------------------------------------------
+# ISSUE 9: in-device PBT (compiled generation scan)
+# --------------------------------------------------------------------------
+
+
+def test_compiled_pbt_single_dispatch_and_counters(tiny_data, tmp_path):
+    """Acceptance: a full PBT sweep (population 8, 4 perturbation
+    intervals) runs as ONE host dispatch — generations, exploits, and
+    explores counter-verified in-device, and every exploit decision
+    surfaced back into the record stream."""
+    train, val = tiny_data
+    pbt = _pbt()
+    analysis = run_vectorized(
+        SPACE, train_data=train, val_data=val,
+        metric="validation_mse", mode="min", num_samples=8,
+        scheduler=pbt, storage_path=str(tmp_path), seed=2, verbose=0,
+    )
+    assert all(t.training_iteration == 8 for t in analysis.trials)
+    block = _state_of(analysis)["pbt"]
+    assert block["mode"] == "compiled"
+    # num_epochs=8 / interval=2 = 4 generations; chunk spans them all, so
+    # host dispatches <= ceil(num_epochs/chunk) = 1 (vs 4 on the old
+    # clamped path).
+    assert block["host_dispatches"] == 1
+    assert block["generations"] == 4
+    assert block["exploits"] > 0
+    assert block["explores"] == block["exploits"]  # one mutated key (lr)
+    # Every in-device exploit decision landed in the record stream.
+    assert len(_exploit_notes(analysis)) == block["exploits"]
+    assert block["exploits"] == pbt.debug_state()["num_perturbations"]
+
+
+def test_compiled_exploit_explore_matches_host_reference(tiny_data, tmp_path):
+    """Golden parity: the compiled exploit/explore reproduces the
+    host-side reference in schedulers/pbt.py BIT FOR BIT on the same seed
+    — same exploit (lagger <- donor) pairs, same perturbed hyperparam
+    values.  (Exact equality is achievable because both sides are built
+    from threefry draw bits, IEEE f32 multiply/clip, and a shared resample
+    grid — no transcendentals in the decision path.)"""
+    train, val = tiny_data
+    pbt = _pbt()
+    run_vectorized(
+        SPACE, train_data=train, val_data=val,
+        metric="validation_mse", mode="min", num_samples=8,
+        scheduler=pbt, storage_path=str(tmp_path), seed=2, verbose=0,
+    )
+    log = pbt._generation_log
+    assert len(log) == 4  # one entry per generation, all from the device
+    spec = pbt.device_mutation_spec()
+    n_draws = generation_draw_count(spec)
+    exploited_total = 0
+    for e in log:
+        draws = generation_draws(pbt.seed, len(e["scores"]), e["gen"],
+                                 n_draws)
+        src, new_lr, new_wd, exploited = reference_generation_step(
+            spec, e["scores"], e["row_lr"], e["row_wd"], e["valid"],
+            draws, e["fire"],
+        )
+        np.testing.assert_array_equal(e["src"], src)
+        np.testing.assert_array_equal(e["exploited"], exploited)
+        # Bit-for-bit: float32 arrays compared for exact equality.
+        np.testing.assert_array_equal(e["new_lr"], new_lr)
+        np.testing.assert_array_equal(e["new_wd"], new_wd)
+        exploited_total += int(exploited.sum())
+    assert exploited_total > 0
+    assert not log[-1]["fire"]  # no perturbation after the final epoch
+
+
+def test_compiled_and_boundary_paths_agree(tiny_data, tmp_path):
+    """The boundary fallback shares the compiled step's decision function,
+    so on the same seed both modes produce the same exploit pairs, the
+    same perturbed lr values, and the same final best trial."""
+    train, val = tiny_data
+    runs = {}
+    for mode in ("compiled", "boundary"):
+        a = run_vectorized(
+            SPACE, train_data=train, val_data=val,
+            metric="validation_mse", mode="min", num_samples=8,
+            scheduler=_pbt(), pbt_mode=mode,
+            storage_path=str(tmp_path / mode), seed=2, verbose=0,
+        )
+        runs[mode] = a
+        assert _state_of(a)["pbt"]["mode"] == mode
+    c, b = runs["compiled"], runs["boundary"]
+    assert _exploit_notes(c) == _exploit_notes(b)
+    assert c.best_trial.trial_id == b.best_trial.trial_id
+    for tc, tb in zip(c.trials, b.trials):
+        assert tc.config["learning_rate"] == tb.config["learning_rate"]
+    # Boundary paid one dispatch per interval; compiled paid one total.
+    assert _state_of(b)["pbt"]["host_dispatches"] == 4
+    assert _state_of(c)["pbt"]["host_dispatches"] == 1
+
+
+def test_chaos_seeded_compiled_matches_boundary_best_trial(tiny_data,
+                                                          tmp_path,
+                                                          monkeypatch):
+    """Chaos-seeded acceptance: with deterministic storage faults active,
+    the in-device path still finds the SAME best trial as the boundary
+    path (fault injection perturbs IO timing/retries, never the compiled
+    decisions).  chdir + relative storage paths keep the fault schedule a
+    pure function of the seed (FaultPlan decisions hash the path — the
+    PR 3 tmp_path-flake postmortem, docs/static-analysis.md DML003)."""
+    from distributed_machine_learning_tpu import chaos
+
+    monkeypatch.chdir(tmp_path)
+    train, val = tiny_data
+    best = {}
+    for mode in ("compiled", "boundary"):
+        plan = chaos.FaultPlan(seed=13, write_error_rate=0.3)
+        with chaos.active(plan):
+            a = run_vectorized(
+                SPACE, train_data=train, val_data=val,
+                metric="validation_mse", mode="min", num_samples=8,
+                scheduler=_pbt(), pbt_mode=mode,
+                # Population checkpoints route through the faultable
+                # storage layer (plain record appends do not).
+                checkpoint_every_epochs=2,
+                storage_path=f"chaos_{mode}",
+                name=f"chaos_{mode}", seed=2, verbose=0,
+            )
+        assert plan.snapshot().get("storage_write_errors", 0) > 0
+        best[mode] = (a.best_trial.trial_id, _exploit_notes(a))
+    assert best["compiled"] == best["boundary"]
+
+
+def test_compiled_pbt_chunked_dispatch_reuses_program(tiny_data, tmp_path):
+    """An explicit chunk below the whole budget dispatches generation
+    chunks — and a chunk that is not a multiple of the interval rounds
+    DOWN to whole generations (the old interval clamp is gone)."""
+    train, val = tiny_data
+    pbt = _pbt()
+    analysis = run_vectorized(
+        SPACE, train_data=train, val_data=val,
+        metric="validation_mse", mode="min", num_samples=8,
+        scheduler=pbt, epochs_per_dispatch=5,  # -> 4 epochs = 2 generations
+        storage_path=str(tmp_path), seed=2, verbose=0,
+    )
+    block = _state_of(analysis)["pbt"]
+    assert block["mode"] == "compiled"
+    assert block["generations"] == 4
+    assert block["host_dispatches"] == 2  # two 2-generation chunks
+    assert all(t.training_iteration == 8 for t in analysis.trials)
+    assert pbt.debug_state()["num_perturbations"] > 0
+
+
+def test_pbt_mode_compiled_rejects_host_only_features(tiny_data, tmp_path):
+    """pbt_mode='compiled' refuses what cannot compile (stop rules need
+    per-epoch host decisions); auto silently falls back to boundary."""
+    train, val = tiny_data
+
+    class StopNever(tune.Stopper):
+        def __call__(self, trial_id, result):
+            return False
+
+    with pytest.raises(ValueError, match="stop"):
+        run_vectorized(
+            SPACE, train_data=train, val_data=val,
+            metric="validation_mse", mode="min", num_samples=8,
+            scheduler=_pbt(), pbt_mode="compiled", stop=StopNever(),
+            storage_path=str(tmp_path), seed=2, verbose=0,
+        )
+    analysis = run_vectorized(
+        SPACE, train_data=train, val_data=val,
+        metric="validation_mse", mode="min", num_samples=8,
+        scheduler=_pbt(), stop=StopNever(),
+        storage_path=str(tmp_path / "auto"), seed=2, verbose=0,
+    )
+    assert _state_of(analysis)["pbt"]["mode"] == "boundary"
+
+
+def test_pb2_composes_on_boundary_path(tiny_data, tmp_path):
+    """PB2's GP explore consults host observations every generation, so
+    auto mode keeps it on the boundary path — still perturbs, still
+    completes."""
+    train, val = tiny_data
+    pb2 = tune.PB2(
+        perturbation_interval=2,
+        hyperparam_mutations={
+            "learning_rate": tune.loguniform(1e-3, 1e-1),
+        },
+        quantile_fraction=0.25, seed=3,
+    )
+    analysis = run_vectorized(
+        SPACE, train_data=train, val_data=val,
+        metric="validation_mse", mode="min", num_samples=8,
+        scheduler=pb2, storage_path=str(tmp_path), seed=2, verbose=0,
+    )
+    block = _state_of(analysis)["pbt"]
+    assert block["mode"] == "boundary"
+    assert pb2.debug_state()["num_perturbations"] > 0
+    assert block["host_dispatches"] == 4
+
+
+# --------------------------------------------------------------------------
+# multi-objective ranking (quality x latency x params)
+# --------------------------------------------------------------------------
+
+
+def test_multi_objective_emits_scalarized_records(tiny_data, tmp_path):
+    """objective='quality_latency_params' scales the ranking score by the
+    measured step latency and eval_shape param pricing, and every record
+    carries the scalarized ``pbt_objective`` metric."""
+    train, val = tiny_data
+    pbt = tune.PopulationBasedTraining(
+        metric="validation_mse", mode="min",
+        perturbation_interval=2,
+        hyperparam_mutations={
+            "learning_rate": tune.loguniform(1e-3, 1e-1),
+        },
+        quantile_fraction=0.25, seed=3,
+        objective="quality_latency_params",
+    )
+    analysis = run_vectorized(
+        SPACE, train_data=train, val_data=val,
+        metric="validation_mse", mode="min", num_samples=8,
+        scheduler=pbt, storage_path=str(tmp_path), seed=2, verbose=0,
+    )
+    block = _state_of(analysis)["pbt"]
+    assert block["mode"] == "compiled"
+    assert block["objective"] == "quality_latency_params"
+    for t in analysis.trials:
+        for r in t.results:
+            assert "pbt_objective" in r
+            assert np.isfinite(r["pbt_objective"]) or not np.isfinite(
+                r["validation_mse"]
+            )
+    # The scalarization preserves in-population ranking (constant row
+    # multiplier): the best trial matches a pure-quality run's best.
+    pure = run_vectorized(
+        SPACE, train_data=train, val_data=val,
+        metric="validation_mse", mode="min", num_samples=8,
+        scheduler=_pbt(), storage_path=str(tmp_path / "pure"),
+        seed=2, verbose=0,
+    )
+    assert analysis.best_trial.trial_id == pure.best_trial.trial_id
+    # The parity contract holds under objective scaling too.
+    spec = pbt.device_mutation_spec()
+    n_draws = generation_draw_count(spec)
+    for e in pbt._generation_log:
+        draws = generation_draws(pbt.seed, len(e["scores"]), e["gen"],
+                                 n_draws)
+        src, new_lr, _, exploited = reference_generation_step(
+            spec, e["scores"], e["row_lr"], e["row_wd"], e["valid"],
+            draws, e["fire"],
+        )
+        np.testing.assert_array_equal(e["src"], src)
+        np.testing.assert_array_equal(e["new_lr"], new_lr)
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError, match="objective"):
+        tune.PopulationBasedTraining(
+            hyperparam_mutations={"learning_rate": tune.loguniform(1e-3, 1e-1)},
+            objective="no_such_objective",
+        )
+    sched = tune.PopulationBasedTraining(
+        hyperparam_mutations={"learning_rate": tune.loguniform(1e-3, 1e-1)},
+        objective={"latency": 1.0},
+    )
+    assert sched.objective_weights == (1.0, 0.0)
+
+
+def test_objective_requires_min_mode(tiny_data, tmp_path):
+    train, val = tiny_data
+    sched = tune.PopulationBasedTraining(
+        metric="validation_mse", mode="max",
+        hyperparam_mutations={"learning_rate": tune.loguniform(1e-3, 1e-1)},
+        objective="quality_latency",
+    )
+    with pytest.raises(ValueError, match="min"):
+        run_vectorized(
+            SPACE, train_data=train, val_data=val,
+            metric="validation_mse", mode="max", num_samples=4,
+            scheduler=sched, storage_path=str(tmp_path), seed=2, verbose=0,
+        )
 
 
 def test_stopper_terminated_rows_excluded_from_pbt(tiny_data, tmp_path):
