@@ -1162,6 +1162,115 @@ class HostSyncInScanRule(Rule):
                     )
 
 
+# --------------------------------------------------------------------------
+# DML011 blocking-transfer-in-loop
+# --------------------------------------------------------------------------
+
+
+# Hot input-path modules: anywhere an epoch/step loop moves training bytes
+# host->device.  Opt-in like DML002/DML008/DML010.  tune/vectorized.py is
+# deliberately absent: its in-loop transfers are dispatch-BOUNDARY control
+# ops (row selectors, per-row lr/wd vectors, population re-pins after a
+# compaction), a few KB between whole-population programs — not per-batch
+# training data the device waits on.
+HOT_INPUT_LOOP_PATTERNS = (
+    "tune/trainable",
+    "data/pipeline.py",
+    "bench.py",
+    "benchmarks/",
+)
+
+_TRANSFER_CALLS = {
+    "jax.device_put",
+    "jnp.asarray", "jax.numpy.asarray",
+    "jnp.array", "jax.numpy.array",
+}
+
+
+class BlockingTransferInLoopRule(Rule):
+    name = "blocking-transfer-in-loop"
+    rule_id = "DML011"
+    severity = "error"
+    description = (
+        "jax.device_put / jnp.asarray of host data inside a for/while "
+        "epoch loop in a hot input-path module: every iteration pays a "
+        "BLOCKING host->device transfer the device must wait on — zero "
+        "host/device overlap, exactly the duty-cycle leak the streaming "
+        "prefetch ring (data/pipeline.py) exists to close.  Enforced in "
+        "opted-in hot input-path modules."
+    )
+    _HINT = (
+        "stage through the prefetch ring (data/pipeline.ChunkPrefetcher "
+        "device_puts chunk k+1 on a producer thread while the device "
+        "consumes chunk k) or hoist the transfer above the loop"
+    )
+
+    def applies(self, ctx) -> bool:
+        if "hot-input-loop" in ctx.scopes:
+            return True
+        rel = ctx.display_path.replace("\\", "/")
+        return any(pat in rel for pat in HOT_INPUT_LOOP_PATTERNS)
+
+    @staticmethod
+    def _loop_body_nodes(loop: ast.AST) -> Iterator[ast.AST]:
+        """Nodes lexically inside the loop body, NOT descending into
+        nested function defs or lambdas — those are traced program bodies
+        or producer sources, where the transfer runs off the consumer's
+        critical path (the prefetch-ring idiom itself)."""
+        stack: List[ast.AST] = list(loop.body) + list(loop.orelse)
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    @staticmethod
+    def _generator_loops(tree: ast.AST) -> Set[int]:
+        """Loops inside GENERATOR functions are exempt: a ``yield``-ing
+        source that device_puts per chunk IS the prefetch-ring idiom —
+        the producer thread pulls it while the consumer computes, so the
+        transfer is off the critical path by construction."""
+        exempt: Set[int] = set()
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            has_yield = any(
+                isinstance(n, (ast.Yield, ast.YieldFrom))
+                for n in ast.walk(fn)
+            )
+            if has_yield:
+                exempt.update(
+                    id(n) for n in ast.walk(fn)
+                    if isinstance(n, (ast.For, ast.While))
+                )
+        return exempt
+
+    def check(self, ctx) -> Iterator[Finding]:
+        seen: Set[int] = set()
+        exempt_loops = self._generator_loops(ctx.tree)
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            if id(loop) in exempt_loops:
+                continue
+            for node in self._loop_body_nodes(loop):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                callee = _call_name(node) or ""
+                if callee in _TRANSFER_CALLS:
+                    seen.add(id(node))
+                    yield self.finding(
+                        ctx, node,
+                        f"blocking `{callee}(...)` inside a for/while loop "
+                        f"— a per-iteration host->device transfer the "
+                        f"device waits on (no overlap)",
+                        self._HINT,
+                    )
+
+
 ALL_RULES: List[Rule] = [
     DonationAliasRule(),
     UnlockedDispatchRule(),
@@ -1173,6 +1282,7 @@ ALL_RULES: List[Rule] = [
     UndonatedHotJitRule(),
     UnboundedQueueRule(),
     HostSyncInScanRule(),
+    BlockingTransferInLoopRule(),
 ]
 
 

@@ -55,6 +55,14 @@ class InjectedTrialCrash(InjectedFault, RuntimeError):
     """A trial killed at a scheduled epoch (preemption stand-in)."""
 
 
+class InjectedProducerCrash(InjectedFault, RuntimeError):
+    """The host-input producer thread killed at a scheduled chunk index
+    (``data/pipeline.py``).  Raised INSIDE the producer; the prefetch ring
+    re-raises it on the consumer (trial) thread, so it follows the
+    ordinary trial error path — retry budget, checkpoint restore, device
+    release — like every other injected crash."""
+
+
 class InjectedCommitKill(InjectedFault, RuntimeError):
     """A process killed between a sharded checkpoint's chunk writes and its
     COMMIT marker.  Deliberately NOT an OSError: the storage retry policy
@@ -130,6 +138,18 @@ class FaultPlan:
       ``duration_s``: its frames (both directions) are delayed until the
       partition heals — TCP semantics, delivery delayed not dropped — so
       the head's lease expiry, requeue, and self-fencing all exercise.
+
+    Streaming-input faults (``data/pipeline.py``'s prefetch ring):
+
+    * ``slow_producer_ms`` — the producer thread sleeps this long before
+      staging EVERY chunk (degraded host input: slow storage, a
+      CPU-starved gather).  Training must stay correct with overlap
+      efficiency degraded — the counters, not the params, absorb the
+      slowdown.
+    * ``producer_crash_at`` — chunk index (0-based, across the trial's
+      whole chunk stream); the producer raises
+      :class:`InjectedProducerCrash` before staging that chunk.  Fires
+      once — the retried incarnation's producer passes the same index.
     """
 
     def __init__(
@@ -152,6 +172,8 @@ class FaultPlan:
         stall_storage_paths: Sequence[str] = (),
         stall_storage_ms: float = 0.0,
         partition_worker: Iterable[Tuple[int, int, float]] = (),
+        slow_producer_ms: float = 0.0,
+        producer_crash_at: Optional[int] = None,
     ):
         self.seed = seed
         self.write_error_rate = float(write_error_rate)
@@ -177,6 +199,10 @@ class FaultPlan:
         self._partitions = sorted(
             ((int(n), int(w), float(d)) for n, w, d in partition_worker),
             reverse=True,
+        )
+        self.slow_producer_ms = float(slow_producer_ms)
+        self._producer_crash_at = (
+            int(producer_crash_at) if producer_crash_at is not None else None
         )
         self._lock = named_lock("chaos.plan")
         self._op_counts: Dict[Tuple[str, str], int] = {}
@@ -316,6 +342,29 @@ class FaultPlan:
                 self._counters.get("dispatch_hangs", 0) + 1
             )
         time.sleep(self.hang_s)
+
+    # -- streaming-input faults ----------------------------------------------
+
+    def maybe_producer_fault(self, chunk_index: int) -> None:
+        """Called by the prefetch ring's producer thread before staging
+        each chunk: sleeps ``slow_producer_ms`` (every chunk), raises
+        :class:`InjectedProducerCrash` at the scheduled index (once)."""
+        if self.slow_producer_ms > 0:
+            self._count("producer_slowdowns")
+            time.sleep(self.slow_producer_ms / 1000.0)
+        crash = False
+        with self._lock:
+            if self._producer_crash_at is not None \
+                    and int(chunk_index) >= self._producer_crash_at:
+                self._producer_crash_at = None
+                self._counters["producer_crashes"] = (
+                    self._counters.get("producer_crashes", 0) + 1
+                )
+                crash = True
+        if crash:
+            raise InjectedProducerCrash(
+                f"injected producer crash at chunk {chunk_index}"
+            )
 
     # -- cluster faults ------------------------------------------------------
 

@@ -40,6 +40,7 @@ from distributed_machine_learning_tpu.compilecache.counters import (
 )
 from distributed_machine_learning_tpu.compilecache.keys import (
     NON_STRUCTURAL_KEYS,
+    chunked_program_key,
     pbt_program_key,
     program_key,
     sharded_program_key,
@@ -68,6 +69,7 @@ __all__ = [
     "NON_STRUCTURAL_KEYS",
     "cache_dir",
     "cache_entry_count",
+    "chunked_program_key",
     "enable_persistent_cache",
     "get_counters",
     "get_tracker",

@@ -150,6 +150,40 @@ def pbt_program_key(
     )
 
 
+def chunked_program_key(
+    config: Dict[str, Any],
+    *,
+    chunk_rows: int,
+    batch_shape: Optional[Sequence[Sequence[int]]] = None,
+    dtype: Optional[str] = None,
+    donation: Sequence[int] = (),
+    extra: Optional[Dict[str, Any]] = None,
+) -> str:
+    """:func:`program_key` for one streaming CHUNK program
+    (``data/pipeline.py``: the out-of-core prefetch ring).
+
+    The chunk's **row count** (batches per staged slab — the chunk scan's
+    trip count, baked into the trace) folds into the key on top of the
+    base shape class; the **number of chunks per epoch does NOT** — the
+    host loops over chunks, so a 10-chunk and a 1000-chunk epoch of the
+    same slab shape run the identical executable.  An epoch whose batch
+    count does not divide the chunk size gets exactly one extra key (the
+    tail chunk's smaller row count).  Dataset length and epoch batch
+    count therefore never split streaming keys — only the slab geometry
+    does.
+    """
+    merged = {"stream_chunk_rows": int(chunk_rows)}
+    if extra:
+        merged.update(extra)
+    return program_key(
+        config,
+        batch_shape=batch_shape,
+        dtype=dtype,
+        donation=donation,
+        extra=merged,
+    )
+
+
 def sharded_program_key(
     config: Dict[str, Any],
     *,

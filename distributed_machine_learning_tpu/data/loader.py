@@ -83,8 +83,20 @@ class Dataset:
         shuffle: bool = True,
         seed_parts: Sequence = (0,),
         drop_remainder: bool = True,
-    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        """Yield (x, y) batches. Static batch shape by default (jit-friendly)."""
+        with_mask: bool = False,
+    ) -> Iterator[Tuple[np.ndarray, ...]]:
+        """Yield (x, y) batches. Static batch shape by default (jit-friendly).
+
+        A dataset smaller than ``batch_size`` yields ONE batch zero-padded
+        to exactly ``batch_size`` (it used to emit a ragged batch, which
+        silently broke the static-shape jit contract — every odd dataset
+        size forced its own recompile).  ``with_mask=True`` yields
+        ``(x, y, mask)`` triples (``mask`` is float32, 1.0 for real rows)
+        so consumers can weight the padding out of their loss; it also
+        pads the final ragged batch under ``drop_remainder=False`` (whose
+        legacy ragged yield is kept when no mask is requested — padding
+        without a mask would silently dilute a loss).
+        """
         from distributed_machine_learning_tpu.data import native as _native
 
         n = len(self)
@@ -96,29 +108,169 @@ class Dataset:
             idx = np.arange(n)
         end = (n // batch_size) * batch_size if drop_remainder else n
         if end == 0:
-            end = n  # tiny dataset: emit one ragged batch rather than nothing
+            end = n  # tiny dataset: one batch, PADDED to batch_size below
         if self.x.dtype == np.float32 and self.y.dtype == np.float32:
             take = _native.gather
         else:
             take = lambda a, sel: a[sel]  # noqa: E731
         for start in range(0, end, batch_size):
             sel = idx[start : start + batch_size]
-            yield take(self.x, sel), take(self.y, sel)
+            bx, by = take(self.x, sel), take(self.y, sel)
+            short = batch_size - len(sel)
+            # Tiny datasets always pad (the static-shape contract);
+            # a drop_remainder=False ragged TAIL pads only when the mask
+            # can carry the truth.
+            if short > 0 and (start == 0 or with_mask):
+                bx = np.concatenate(
+                    [bx, np.zeros((short, *bx.shape[1:]), bx.dtype)]
+                )
+                by = np.concatenate(
+                    [by, np.zeros((short, *by.shape[1:]), by.dtype)]
+                )
+            if with_mask:
+                mask = np.ones(len(bx), np.float32)
+                if short > 0:
+                    mask[len(sel):] = 0.0
+                yield bx, by, mask
+            else:
+                yield bx, by
 
     def num_batches(self, batch_size: int, drop_remainder: bool = True) -> int:
         n = len(self)
         return max(n // batch_size if drop_remainder else -(-n // batch_size), 1)
 
-    def as_jax(self, device=None):
-        """Stage the full arrays onto a device once (HBM-resident epochs)."""
+    def as_jax(self, device=None, enforce_budget: bool = False):
+        """Stage the full arrays onto a device once (HBM-resident epochs).
+
+        ``enforce_budget=True`` first checks the staged bytes against the
+        device's accelerator-memory budget
+        (``models/flagship.single_chip_hbm_bytes`` — the virtual
+        ``DML_CPU_DEVICE_BUDGET_BYTES`` budget on CPU) and raises
+        ``data.pipeline.ResidentOverBudgetError`` for a dataset that
+        provably cannot stage — the out-of-core alternative is the
+        streaming prefetch ring (``input_mode="streaming"``).
+        """
         import jax
 
+        if enforce_budget:
+            from distributed_machine_learning_tpu.data.pipeline import (
+                check_resident_budget,
+            )
+
+            check_resident_budget(
+                int(self.x.nbytes) + int(self.y.nbytes), device,
+                what="Dataset.as_jax",
+            )
         if device is not None:
             return (
                 jax.device_put(self.x, device),
                 jax.device_put(self.y, device),
             )
         return jax.numpy.asarray(self.x), jax.numpy.asarray(self.y)
+
+
+# ---------------------------------------------------------------------------
+# Dataset-rebuild disk cache: windowed/standardized arrays shared across
+# trial processes
+# ---------------------------------------------------------------------------
+
+CACHE_DIR_ENV_VAR = "DML_DATASET_CACHE_DIR"
+
+
+def dataset_cache_dir(explicit: Optional[str] = None) -> Optional[str]:
+    """Resolve the windowed-array cache directory: explicit argument, else
+    ``$DML_DATASET_CACHE_DIR``, else disabled (None)."""
+    raw = explicit or os.environ.get(CACHE_DIR_ENV_VAR)
+    return os.path.expanduser(raw) if raw else None
+
+
+def _window_cache_key(
+    x: np.ndarray, y: np.ndarray, interval: int, stride: int,
+    standardize: bool, nan_policy: str,
+) -> str:
+    """Content key for one windowed build: sha256 over the SOURCE bytes
+    (post feature-selection, pre window) plus every parameter that shapes
+    the product — two trials re-windowing the same source hit the same
+    file; any content or parameter change misses honestly."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for arr in (np.ascontiguousarray(x), np.ascontiguousarray(y)):
+        h.update(str((arr.shape, arr.dtype.str)).encode())
+        h.update(arr.tobytes())
+    h.update(
+        f"interval={interval}/stride={stride}/standardize={standardize}"
+        f"/nan={nan_policy}/v1".encode()
+    )
+    return h.hexdigest()[:32]
+
+
+def _atomic_np_save(path: str, arr: np.ndarray) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        np.save(f, arr)
+    os.replace(tmp, path)  # atomic: readers see whole files or nothing
+
+
+def _windowed_arrays(
+    x: np.ndarray,
+    y: np.ndarray,
+    interval: int,
+    stride: int,
+    standardize: bool,
+    nan_policy: str,
+    cache_dir: Optional[str],
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Standardize + window (the expensive per-trial rebuild), optionally
+    through the on-disk cache.
+
+    With a cache directory, the windowed arrays are stored once per
+    (source sha256, interval, stride, standardize, nan_policy) and
+    reopened via ``np.load(mmap_mode="r")`` — process-pool children and
+    cluster trials on one host then share the kernel PAGE CACHE for the
+    windowed bytes instead of each re-running the windowing/standardize
+    kernels (``dataset_cache_{hits,misses,bytes}`` counters, published in
+    the ``host_input`` block)."""
+
+    def build() -> Tuple[np.ndarray, np.ndarray]:
+        xs = x
+        if standardize:
+            from distributed_machine_learning_tpu.data import native as _native
+
+            xs, _, _ = _native.standardize(xs)
+        xw = split_into_intervals(xs, interval, stride)
+        yw = split_into_intervals(y, interval, stride)[:, -1, 0:1]
+        return xw, yw
+
+    if not cache_dir:
+        return build()
+    from distributed_machine_learning_tpu.data.pipeline import (
+        get_host_input_counters,
+    )
+
+    counters = get_host_input_counters()
+    key = _window_cache_key(x, y, interval, stride, standardize, nan_policy)
+    os.makedirs(cache_dir, exist_ok=True)
+    fx = os.path.join(cache_dir, f"win_{key}_x.npy")
+    fy = os.path.join(cache_dir, f"win_{key}_y.npy")
+    try:
+        xw = np.load(fx, mmap_mode="r")
+        yw = np.load(fy, mmap_mode="r")
+        counters.add("dataset_cache_hits")
+        counters.add("dataset_cache_bytes", int(xw.nbytes) + int(yw.nbytes))
+        return xw, yw
+    except (OSError, ValueError):
+        pass  # miss (or a torn legacy file): rebuild and publish
+    counters.add("dataset_cache_misses")
+    xw, yw = build()
+    try:
+        _atomic_np_save(fx, xw)
+        _atomic_np_save(fy, yw)
+        # Serve THIS process from the mmap too: the windowed copy is
+        # dropped and every consumer shares one page-cached file.
+        return np.load(fx, mmap_mode="r"), np.load(fy, mmap_mode="r")
+    except OSError:
+        return xw, yw  # cache write failure must never fail a build
 
 
 def train_val_split(
@@ -149,6 +301,7 @@ def make_regression_dataset(
     seed: int = 42,
     standardize: bool = False,
     nan_policy: str = "zero",
+    cache_dir: Optional[str] = None,
 ) -> Tuple[Dataset, Dataset]:
     """The reference's `get_data_loaders` pipeline (`:423-459`), DataFrame -> Datasets.
 
@@ -164,6 +317,14 @@ def make_regression_dataset(
     non-finite feature values with 0; "keep" passes them through.  Windows
     whose LABEL is non-finite are dropped under either policy — zeroing a
     target would silently train toward garbage.
+
+    ``cache_dir`` (or ``$DML_DATASET_CACHE_DIR``) enables the windowed-
+    array disk cache: the standardized/windowed product is stored once per
+    (source sha256, interval, stride, standardize, nan_policy) and
+    reopened via ``np.load(mmap_mode="r")``, so process-pool and cluster
+    trials rebuilding the same dataset share page cache instead of
+    re-windowing per trial (counters: ``dataset_cache_{hits,misses,bytes}``
+    in the ``host_input`` block).
     """
     if nan_policy not in ("zero", "keep"):
         raise ValueError(f"unknown nan_policy {nan_policy!r}")
@@ -176,16 +337,16 @@ def make_regression_dataset(
     y = labels_df[label_column].to_numpy(dtype=np.float32)
     if nan_policy == "zero":
         x = np.nan_to_num(x, nan=0.0, posinf=0.0, neginf=0.0)
-    if standardize:
-        from distributed_machine_learning_tpu.data import native as _native
-
-        x, _, _ = _native.standardize(x)
-
-    xw = split_into_intervals(x, interval, stride)
-    yw = split_into_intervals(y, interval, stride)[:, -1, 0:1]  # last-step label
+    xw, yw = _windowed_arrays(
+        x, y, interval, stride, standardize, nan_policy,
+        dataset_cache_dir(cache_dir),
+    )
     finite = np.isfinite(yw[:, 0])
     if not finite.all():
         xw, yw = xw[finite], yw[finite]
+    # xw/yw may be mmap-backed (cache hit): the split's fancy indexing
+    # materializes real in-memory splits from the page-cached file, so
+    # the Datasets themselves never hold mmap views.
     return train_val_split(xw, yw, val_fraction=val_fraction, seed=seed)
 
 

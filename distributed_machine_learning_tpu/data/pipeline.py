@@ -1,0 +1,598 @@
+"""Out-of-core training: the double-buffered host→device prefetch pipeline.
+
+Every trainable used to stage the full dataset to device memory once
+(``Dataset.as_jax`` / ``stage_data`` — "HBM-resident epochs"): a dataset
+bigger than one chip's budget could not train at all, and there was zero
+host↔device overlap anywhere in the stack.  This module is the loader
+underneath ``input_mode="streaming"``:
+
+* a **bounded ring** of device-resident staging slabs (``ChunkPrefetcher``)
+  fed by a background **producer thread** — the producer shuffles/gathers
+  the next chunk on host (native kernels, ``data/native.py``) and
+  ``device_put``\\ s chunk *k+1* while the fused epoch program consumes
+  donated chunk *k* (donation frees each consumed slab, so at most
+  ``depth + 1`` slabs ever exist on device);
+* **engagement policy** (:func:`resolve_input_mode`): explicit
+  ``input_mode="resident"|"streaming"`` wins; ``"auto"`` engages streaming
+  when the staged dataset would exceed ``streaming_engage_fraction``
+  (default 0.5) of :func:`models.flagship.single_chip_hbm_bytes` — on the
+  CPU test platform that budget is the ``DML_CPU_DEVICE_BUDGET_BYTES``
+  virtual one, which is what makes the out-of-core claim provable in
+  tier-1;
+* the **determinism contract**: a streaming run sees exactly the batches a
+  resident run of the same seed sees, in the same order, and finishes with
+  bit-identical params — the producer replays the resident path's own
+  permutation (threefry draws are identical eager vs jit) and the chunk
+  programs continue the resident epoch scan's PRNG key chain across chunk
+  boundaries (``tune/_regression_program.make_chunk_epoch_fn``);
+* the **host_input counter family**: prefetch hits, producer/consumer
+  waits (count + seconds), chunks/bytes staged, producer stalls/crashes,
+  and the derived ``overlap_efficiency = 1 − consumer_wait_s / step time``
+  — published to ``experiment_state.json["host_input"]`` and TensorBoard
+  ``host_input/*`` by the drivers, asserted by ``bench.py``'s
+  ``streaming`` section;
+* **failure surfaces**: the producer is watched by the existing liveness
+  ``DispatchWatchdog`` (silence past the deadline is counted as
+  ``producer_stalls`` while the consumer keeps waiting, and a hard timeout
+  turns a wedged producer into an ordinary trial error the retry budget
+  handles); ``chaos.FaultPlan(slow_producer_ms=..., producer_crash_at=...)``
+  injects degradation and death deterministically.
+
+The dataset-rebuild disk cache (``data/loader.py``) shares this module's
+counter registry (``dataset_cache_hits/misses/bytes``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from distributed_machine_learning_tpu.analysis.locks import named_lock
+
+# A scan's xs slab can never ALIAS an output (the shapes differ), so XLA
+# warns that the donated chunk buffers are "not usable" — but donation
+# still invalidates and frees each consumed slab at the chunk boundary,
+# which is exactly the ring's memory bound.  Expected for every streaming
+# chunk program, so it is silenced here (real donation regressions are
+# caught by the sharded trainable's is_deleted audit counter, not by this
+# warning).
+import warnings as _warnings
+
+_warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable",
+    category=UserWarning,
+)
+
+INPUT_MODES = ("auto", "resident", "streaming")
+
+# "auto" engages streaming when staged bytes exceed this fraction of the
+# device budget (params/optimizer/activations need the rest); override
+# per-trial via config["streaming_engage_fraction"].
+DEFAULT_ENGAGE_FRACTION = 0.5
+# Fraction of the device budget the staging ring may occupy across all
+# in-flight slabs (depth staged + 1 being consumed).
+RING_BUDGET_FRACTION = 0.25
+# Ring depth: 2 = classic double buffering (producer stages k+1 while the
+# device consumes k).  config["streaming_prefetch_depth"] overrides.
+DEFAULT_PREFETCH_DEPTH = 2
+# Producer silence past this is a counted stall (liveness watchdog);
+# config["streaming_producer_deadline_s"] overrides.
+DEFAULT_PRODUCER_DEADLINE_S = 60.0
+
+
+class ResidentOverBudgetError(RuntimeError):
+    """``input_mode="resident"`` asked to stage more bytes than the device
+    budget holds.  ``"auto"`` would have engaged streaming; raising (rather
+    than OOMing later, or silently streaming against an explicit knob) is
+    the budget check the out-of-core acceptance test asserts."""
+
+
+class ProducerStalled(RuntimeError):
+    """The producer thread went silent past the hard timeout.  Surfaced on
+    the CONSUMER (trial) thread so the ordinary error path — retry budget,
+    checkpoint restore, device release — handles a wedged producer exactly
+    like a wedged dispatch."""
+
+
+# ---------------------------------------------------------------------------
+# host_input counter family
+# ---------------------------------------------------------------------------
+
+
+class HostInputCounters:
+    """Process-wide counters for the streaming input path (same registry
+    discipline as ``compilecache/counters.py``: drivers snapshot at start
+    and publish ``delta_since`` at teardown)."""
+
+    _FIELDS = (
+        "streams_engaged",       # trainables that ran input_mode=streaming
+        "mode_fallbacks",        # streaming requested but driver fell back
+        "chunks_staged",
+        "bytes_staged",
+        "prefetch_hits",         # consumer asked, chunk was already staged
+        "consumer_waits",        # consumer had to wait on the producer
+        "consumer_wait_s",
+        "producer_waits",        # producer blocked on a full ring
+        "producer_wait_s",
+        "consume_s",             # consumer seconds spent in chunk programs
+        "producer_stalls",       # liveness watchdog expiries on the producer
+        "producer_crashes",
+        # Dataset-rebuild disk cache (data/loader.py): windowed/standardized
+        # arrays reopened via np.load(mmap_mode="r") instead of re-windowed.
+        "dataset_cache_hits",
+        "dataset_cache_misses",
+        "dataset_cache_bytes",
+    )
+
+    def __init__(self):
+        self._lock = named_lock("data.host_input_counters")
+        self._c: Dict[str, float] = {k: 0 for k in self._FIELDS}
+
+    def add(self, name: str, value: float = 1) -> None:
+        with self._lock:
+            self._c[name] = self._c.get(name, 0) + value
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._c.get(name, 0)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in self._c.items()
+            }
+
+    def delta_since(self, baseline: Dict[str, float]) -> Dict[str, float]:
+        snap = self.snapshot()
+        return {k: round(v - baseline.get(k, 0), 4) for k, v in snap.items()}
+
+    def reset(self) -> None:
+        """Test hook: zero every counter."""
+        with self._lock:
+            self._c = {k: 0 for k in self._FIELDS}
+
+
+_counters = HostInputCounters()
+
+
+def get_host_input_counters() -> HostInputCounters:
+    """The process-wide registry (one per process)."""
+    return _counters
+
+
+def overlap_efficiency(counters: Dict[str, float]) -> Optional[float]:
+    """``1 − consumer_wait_s / step time``: the fraction of consumer step
+    time NOT spent waiting on host input.  1.0 = the device never waited
+    (perfect overlap); None when nothing streamed."""
+    step_s = float(counters.get("consume_s", 0) or 0)
+    wait_s = float(counters.get("consumer_wait_s", 0) or 0)
+    if step_s <= 0 and wait_s <= 0:
+        return None
+    return round(max(0.0, 1.0 - wait_s / max(step_s + wait_s, 1e-9)), 4)
+
+
+def host_input_block(baseline: Dict[str, float]) -> Optional[Dict[str, Any]]:
+    """The ``experiment_state.json["host_input"]`` block for one run: the
+    counter deltas plus the derived overlap efficiency; None when the run
+    neither streamed nor touched the dataset cache."""
+    delta = _counters.delta_since(baseline)
+    if not any(delta.values()):
+        return None
+    eff = overlap_efficiency(delta)
+    if eff is not None:
+        delta["overlap_efficiency"] = eff
+    return delta
+
+
+# ---------------------------------------------------------------------------
+# engagement policy / budget check
+# ---------------------------------------------------------------------------
+
+
+def staged_nbytes(train_data, val_data, compute_dtype) -> int:
+    """Bytes resident staging would pin on ONE device: x splits in the
+    compute dtype, y splits in float32 (``stage_data``'s layout)."""
+    x_item = int(np.dtype(compute_dtype).itemsize) if compute_dtype else 4
+    total = 0
+    for ds in (train_data, val_data):
+        if ds is None:
+            continue
+        total += int(ds.x.size) * x_item + int(ds.y.size) * 4
+    return total
+
+
+def device_budget_bytes(device=None) -> int:
+    """One device's accelerator-memory budget (virtual on CPU — see
+    ``models/flagship.single_chip_hbm_bytes``)."""
+    from distributed_machine_learning_tpu.models.flagship import (
+        single_chip_hbm_bytes,
+    )
+
+    return single_chip_hbm_bytes(device)
+
+
+def check_resident_budget(nbytes: int, device=None, what: str = "dataset"):
+    """Raise :class:`ResidentOverBudgetError` when ``nbytes`` exceeds the
+    device budget — the check resident staging (``Dataset.as_jax`` /
+    ``stage_data``) provably fails for an over-budget dataset."""
+    budget = device_budget_bytes(device)
+    if nbytes > budget:
+        raise ResidentOverBudgetError(
+            f"resident staging of {what} needs {nbytes} bytes but the "
+            f"device budget is {budget} bytes "
+            f"({getattr(device, 'platform', 'cpu')}; on CPU the virtual "
+            f"DML_CPU_DEVICE_BUDGET_BYTES budget applies) — use "
+            f'input_mode="streaming" (or "auto") to train out-of-core'
+        )
+    return budget
+
+
+def resolve_input_mode(
+    config: Dict[str, Any],
+    nbytes: int,
+    device=None,
+    *,
+    shards: int = 1,
+) -> str:
+    """Resolve ``config["input_mode"]`` to ``"resident"`` or ``"streaming"``.
+
+    ``shards``: how many devices the staged arrays' batch axis spreads over
+    (the sharded trainable's dp degree) — resident bytes PER DEVICE are
+    ``nbytes / shards``.  Explicit ``"resident"`` over budget raises;
+    ``"auto"`` engages streaming past ``streaming_engage_fraction`` of the
+    budget; explicit ``"streaming"`` always streams (the parity tests force
+    it on small datasets).
+    """
+    mode = str(config.get("input_mode", "auto") or "auto").lower()
+    if mode not in INPUT_MODES:
+        raise ValueError(
+            f"input_mode must be one of {INPUT_MODES}, got {mode!r}"
+        )
+    per_device = int(nbytes) // max(int(shards), 1)
+    if mode == "streaming":
+        return "streaming"
+    if mode == "resident":
+        check_resident_budget(per_device, device, what="the dataset")
+        return "resident"
+    fraction = float(
+        config.get("streaming_engage_fraction", DEFAULT_ENGAGE_FRACTION)
+    )
+    if per_device > fraction * device_budget_bytes(device):
+        return "streaming"
+    return "resident"
+
+
+# ---------------------------------------------------------------------------
+# chunk planning
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ChunkPlan:
+    """How one epoch's batch sequence splits into staged chunks.
+
+    ``num_chunks`` full chunks of ``chunk_batches`` batches, plus an
+    optional tail of ``tail_batches`` — the tail compiles its own (second)
+    chunk program; the chunk COUNT never shapes a trace (the host loops),
+    which is why the compile-cache key folds in rows only
+    (``compilecache.chunked_program_key``)."""
+
+    batch_size: int
+    num_batches: int       # batches per epoch (= optimizer steps per epoch)
+    chunk_batches: int     # batches per full chunk
+    num_chunks: int        # full chunks per epoch
+    tail_batches: int      # 0, or the last chunk's (smaller) batch count
+
+    @property
+    def chunks_per_epoch(self) -> int:
+        return self.num_chunks + (1 if self.tail_batches else 0)
+
+    def chunk_sizes(self) -> Iterator[Tuple[int, int]]:
+        """Yield ``(start_batch, rows)`` per chunk, in epoch order."""
+        for c in range(self.num_chunks):
+            yield c * self.chunk_batches, self.chunk_batches
+        if self.tail_batches:
+            yield self.num_chunks * self.chunk_batches, self.tail_batches
+
+
+def plan_chunks(
+    num_batches: int,
+    batch_size: int,
+    row_nbytes: int,
+    *,
+    device=None,
+    config: Optional[Dict[str, Any]] = None,
+) -> ChunkPlan:
+    """Size chunks so the whole ring fits ``RING_BUDGET_FRACTION`` of the
+    device budget: per-slab bytes = ring budget / (depth + 1) — depth
+    staged slabs plus the one being consumed (donation frees it at the
+    chunk boundary).  ``config["streaming_chunk_batches"]`` overrides."""
+    config = config or {}
+    depth = int(config.get("streaming_prefetch_depth", DEFAULT_PREFETCH_DEPTH))
+    override = config.get("streaming_chunk_batches")
+    if override:
+        chunk_batches = max(1, min(int(override), num_batches))
+    else:
+        bytes_per_batch = max(int(batch_size) * int(row_nbytes), 1)
+        ring_budget = RING_BUDGET_FRACTION * device_budget_bytes(device)
+        per_slab = ring_budget / (depth + 1)
+        chunk_batches = int(
+            max(1, min(per_slab // bytes_per_batch, num_batches))
+        )
+    return ChunkPlan(
+        batch_size=int(batch_size),
+        num_batches=int(num_batches),
+        chunk_batches=chunk_batches,
+        num_chunks=int(num_batches) // chunk_batches,
+        tail_batches=int(num_batches) % chunk_batches,
+    )
+
+
+def prefetch_depth(config: Optional[Dict[str, Any]] = None) -> int:
+    return int(
+        (config or {}).get(
+            "streaming_prefetch_depth", DEFAULT_PREFETCH_DEPTH
+        )
+    )
+
+
+def gather_batches(
+    x: np.ndarray, y: np.ndarray, idx: np.ndarray, rows: int, batch_size: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-gather ``idx`` (flat, ``rows * batch_size`` long) out of the
+    source arrays into ``[rows, batch_size, ...]`` slabs — the native
+    OpenMP gather when both splits are float32 (the same kernel
+    ``Dataset.batches`` uses), fancy indexing otherwise."""
+    from distributed_machine_learning_tpu.data import native as _native
+
+    if x.dtype == np.float32 and y.dtype == np.float32:
+        xg, yg = _native.gather(x, idx), _native.gather(y, idx)
+    else:
+        xg, yg = x[idx], y[idx]
+    return (
+        xg.reshape(rows, batch_size, *x.shape[1:]),
+        yg.reshape(rows, batch_size, *y.shape[1:]),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the prefetch ring
+# ---------------------------------------------------------------------------
+
+_DONE = object()
+
+
+class _Crash:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class ChunkPrefetcher:
+    """A bounded ring of staged device slabs fed by a producer thread.
+
+    ``source`` is a generator whose ``next()`` performs the host work AND
+    the ``device_put`` for one chunk, returning the staged item (any
+    pytree-ish value; items with ``nbytes`` attributes are accounted as
+    staged bytes).  The producer thread pulls from it and feeds the
+    bounded ring (``maxsize=depth``); the consumer (trial thread) calls
+    :meth:`get` per chunk.  A chunk already in the ring is a
+    ``prefetch_hit``; an empty ring is a counted consumer wait — overlap
+    efficiency falls out of exactly these counters.
+
+    The producer is watched by a liveness ``DispatchWatchdog``: one beat
+    per staged chunk, expiry counted as ``producer_stalls`` while the
+    consumer keeps waiting, and :class:`ProducerStalled` raised on the
+    consumer thread past ``hard_timeout_s`` so a wedged producer follows
+    the ordinary trial error path.  A producer exception (including the
+    chaos-injected crash) is re-raised on the consumer thread.
+    """
+
+    def __init__(
+        self,
+        source: Iterator[Any],
+        *,
+        depth: int = DEFAULT_PREFETCH_DEPTH,
+        deadline_s: float = DEFAULT_PRODUCER_DEADLINE_S,
+        hard_timeout_s: Optional[float] = None,
+        name: str = "host-input",
+        counters: Optional[HostInputCounters] = None,
+    ):
+        from distributed_machine_learning_tpu.liveness import DispatchWatchdog
+
+        self._source = source
+        self._depth = max(int(depth), 1)
+        self._ring: "queue.Queue" = queue.Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+        self._counters = counters or get_host_input_counters()
+        self._deadline_s = float(deadline_s)
+        self._hard_timeout_s = (
+            float(hard_timeout_s)
+            if hard_timeout_s is not None
+            else max(8.0 * self._deadline_s, 30.0)
+        )
+        # Polled from the consumer's wait loop — no monitor thread needed.
+        self._watchdog = DispatchWatchdog(self._deadline_s)
+        self._watchdog.track("producer", info=name)
+        self._producer = threading.Thread(
+            target=self._produce, name=f"{name}-producer", daemon=True
+        )
+        self._chunk_index = 0
+        # Per-instance consumer wait seconds (the registry is process-wide
+        # and concurrent trials share it; per-epoch overlap accounting
+        # needs THIS ring's waits).
+        self.wait_s = 0.0
+        self._producer.start()
+
+    # -- producer side -------------------------------------------------------
+
+    def _put(self, item) -> bool:
+        """Ring put with wait accounting; False when closing."""
+        waited = False
+        t0 = time.monotonic()
+        while not self._stop.is_set():
+            try:
+                self._ring.put(item, timeout=0.05)
+                if waited:
+                    self._counters.add(
+                        "producer_wait_s", time.monotonic() - t0
+                    )
+                return True
+            except queue.Full:
+                if not waited:
+                    waited = True
+                    self._counters.add("producer_waits")
+        return False
+
+    def _produce(self) -> None:
+        from distributed_machine_learning_tpu import chaos
+
+        try:
+            while not self._stop.is_set():
+                plan = chaos.active_plan()
+                if plan is not None:
+                    # Deterministic degradation/death: sleep per chunk
+                    # and/or crash at a scheduled chunk index.
+                    plan.maybe_producer_fault(self._chunk_index)
+                try:
+                    item = next(self._source)
+                except StopIteration:
+                    self._put(_DONE)
+                    return
+                self._chunk_index += 1
+                self._counters.add("chunks_staged")
+                self._counters.add("bytes_staged", _item_nbytes(item))
+                if not self._put(item):
+                    return
+                self._watchdog.beat("producer")
+        except BaseException as exc:  # noqa: BLE001 - re-raised on consumer
+            self._counters.add("producer_crashes")
+            self._put(_Crash(exc))
+
+    # -- consumer side -------------------------------------------------------
+
+    def get(self):
+        """Next staged chunk; raises the producer's exception on crash,
+        :class:`ProducerStalled` past the hard timeout, ``StopIteration``
+        when the source is exhausted."""
+        try:
+            item = self._ring.get_nowait()
+            self._counters.add("prefetch_hits")
+        except queue.Empty:
+            self._counters.add("consumer_waits")
+            t0 = time.monotonic()
+            item = None
+            while item is None:
+                waited = time.monotonic() - t0
+                if waited > self._hard_timeout_s:
+                    self._counters.add("consumer_wait_s", waited)
+                    self.wait_s += waited
+                    raise ProducerStalled(
+                        f"host-input producer silent for {waited:.1f}s "
+                        f"(hard timeout {self._hard_timeout_s:.1f}s, "
+                        f"stall deadline {self._deadline_s:.1f}s)"
+                    )
+                # Silence past the deadline is a counted liveness event
+                # (edge-triggered: once per stall episode) — the operator
+                # signal that the producer, not the device, is the
+                # bottleneck or the casualty.
+                for _ in self._watchdog.expired():
+                    self._counters.add("producer_stalls")
+                try:
+                    item = self._ring.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+            waited = time.monotonic() - t0
+            self._counters.add("consumer_wait_s", waited)
+            self.wait_s += waited
+        if isinstance(item, _Crash):
+            raise item.exc
+        if item is _DONE:
+            raise StopIteration
+        return item
+
+    def note_consume(self, seconds: float) -> None:
+        """Record consumer seconds spent executing chunk programs (the
+        denominator of overlap efficiency)."""
+        self._counters.add("consume_s", float(seconds))
+
+    def close(self) -> None:
+        """Stop the producer and drain the ring (idempotent)."""
+        self._stop.set()
+        try:
+            while True:
+                self._ring.get_nowait()
+        except queue.Empty:
+            pass
+        if self._producer.is_alive():
+            self._producer.join(timeout=2.0)
+        self._watchdog.untrack("producer")
+
+    def __enter__(self) -> "ChunkPrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+def _item_nbytes(item) -> int:
+    """Total nbytes across array leaves of a staged item (tuples/lists/
+    dicts of arrays; anything without ``nbytes`` counts 0)."""
+    if isinstance(item, (tuple, list)):
+        return sum(_item_nbytes(v) for v in item)
+    if isinstance(item, dict):
+        return sum(_item_nbytes(v) for v in item.values())
+    return int(getattr(item, "nbytes", 0) or 0)
+
+
+# ---------------------------------------------------------------------------
+# streaming program cache (unsharded trainable)
+# ---------------------------------------------------------------------------
+
+# One built+jitted streaming program set per chunked program key: under
+# injected hyperparameters the chunk programs are trial-independent, so a
+# cohort of streaming trials traces each chunk program once (the same
+# rationale as tune/trainable.py's cohort bundle cache — but nothing here
+# pins staged data, so the cap is entry-count only).
+_STREAM_CACHE: Dict[str, Any] = {}
+_STREAM_LOCKS: Dict[str, Any] = {}
+_STREAM_CACHE_MAX = 8
+_STREAM_GUARD = named_lock("data.stream_program_guard")
+
+
+def clear_stream_program_cache() -> None:
+    with _STREAM_GUARD:
+        _STREAM_CACHE.clear()
+        _STREAM_LOCKS.clear()
+
+
+def stream_bundle_for(key: str, build: Callable[[], Any]):
+    """Exactly-once build of a streaming program bundle per key (the
+    cohort's other trials wait on the per-key lock and reuse)."""
+    with _STREAM_GUARD:
+        bundle = _STREAM_CACHE.pop(key, None)
+        if bundle is not None:
+            _STREAM_CACHE[key] = bundle  # LRU touch
+            return bundle
+        lock = _STREAM_LOCKS.setdefault(key, named_lock("data.stream_build"))
+    with lock:
+        with _STREAM_GUARD:
+            bundle = _STREAM_CACHE.get(key)
+            if bundle is not None:
+                return bundle
+        bundle = build()
+        with _STREAM_GUARD:
+            _STREAM_CACHE[key] = bundle
+            while len(_STREAM_CACHE) > _STREAM_CACHE_MAX:
+                evicted = next(iter(_STREAM_CACHE))
+                _STREAM_CACHE.pop(evicted)
+                _STREAM_LOCKS.pop(evicted, None)
+        return bundle

@@ -100,6 +100,105 @@ def make_epoch_fn(
     return epoch
 
 
+def make_chunk_epoch_fn(
+    forward: Callable,
+    tx: optax.GradientTransformation,
+    loss_fn: Callable,
+) -> Callable:
+    """One streaming CHUNK of an epoch as a pure function (out-of-core
+    path, ``data/pipeline.py``): a scan over a staged slab of pre-gathered
+    batches.
+
+    ``chunk(params, opt_state, batch_stats, key, xb, yb) -> (params,
+    opt_state, batch_stats, key, losses)`` where ``xb``/``yb`` are
+    ``[rows, batch_size, ...]`` slabs.  The step body is kept IDENTICAL to
+    :func:`make_epoch_fn`'s (same split order, same loss closure, same
+    update sequence) and the PRNG key rides the carry ACROSS chunk calls,
+    so a streaming epoch executes bit-for-bit the computation the resident
+    epoch program executes — the host gathers the batches the resident
+    program's in-program gather would have produced (same permutation:
+    threefry draws are identical eager vs jit), and the chunk boundary is
+    invisible to the numerics.  Jit at the call site with
+    ``donate_argnums`` covering state AND the slab (the consumed chunk's
+    buffers free at the boundary — the ring's memory bound depends on it).
+    """
+
+    def chunk(params, opt_state, batch_stats, key, xb, yb):
+        def step(carry, batch):
+            params, opt_state, batch_stats, key = carry
+            key, dkey = jax.random.split(key)
+            xb_, yb_ = batch
+
+            def loss_of(p):
+                preds, new_bs, aux = forward(p, batch_stats, xb_, dkey,
+                                             train=True)
+                return loss_fn(preds.astype(jnp.float32), yb_) + aux, new_bs
+
+            (loss, new_bs), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                params
+            )
+            updates, new_opt = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, new_opt, new_bs, key), loss
+
+        (params, opt_state, batch_stats, key), losses = jax.lax.scan(
+            step, (params, opt_state, batch_stats, key), (xb, yb)
+        )
+        return params, opt_state, batch_stats, key, losses
+
+    return chunk
+
+
+def make_chunk_eval_fn(forward: Callable) -> Callable:
+    """Masked eval over ONE streamed chunk of validation blocks: ``(params,
+    batch_stats, xb, yb, mb) -> (se_sum, ae_sum, ape_sum, hub_sum, count)``
+    partial sums the host accumulates across chunks before forming the
+    :func:`make_eval_fn` metric set (same per-example terms; only the
+    cross-block summation moves to the host)."""
+
+    def evaluate_chunk(params, batch_stats, xb, yb, mb):
+        def step(_, batch):
+            x, y, m = batch
+            preds, _, _ = forward(
+                params, batch_stats, x, jax.random.key(0), train=False
+            )
+            preds = preds.astype(jnp.float32)
+            se, ae, ape = per_example_losses(preds, y)
+            hub = jnp.mean(optax.huber_loss(preds, y, delta=1.0), axis=-1)
+            return None, (
+                (se * m).sum(), (ae * m).sum(), (ape * m).sum(),
+                (hub * m).sum(),
+            )
+
+        _, (se, ae, ape, hub) = jax.lax.scan(step, None, (xb, yb, mb))
+        return se.sum(), ae.sum(), ape.sum(), hub.sum(), mb.sum()
+
+    return evaluate_chunk
+
+
+def eval_metrics_from_sums(
+    loss_name: str, se: float, ae: float, ape: float, hub: float, count: float
+) -> Dict[str, float]:
+    """:func:`make_eval_fn`'s metric dict from host-accumulated partial
+    sums (the streamed-validation path)."""
+    count = max(float(count), 1e-9)
+    mse = se / count
+    mae = ae / count
+    mape = 100.0 * ape / count
+    huber = hub / count
+    rmse = float(np.sqrt(mse))
+    by_name = {
+        "mse": mse, "mae": mae, "mape": mape, "huber": huber, "rmse": rmse,
+    }
+    return {
+        "validation_loss": float(by_name.get(loss_name, mse)),
+        "validation_mse": float(mse),
+        "validation_rmse": float(rmse),
+        "validation_mae": float(mae),
+        "validation_mape": float(mape),
+    }
+
+
 # Metric names make_eval_fn produces (plus "train_loss" from the epoch fn):
 # the keys a compiled PBT generation scan can rank on.  Kept next to the
 # eval body so a metric rename cannot silently desynchronize the validator.

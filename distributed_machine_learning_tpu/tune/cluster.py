@@ -811,6 +811,7 @@ def run_distributed(
     checkpoint_storage: Optional[str] = None,
     checkpoint_format: str = "msgpack",
     mesh_shape: Optional[Dict[str, int]] = None,
+    input_mode: Optional[str] = None,
     elastic_listen: Union[str, socket.socket, None] = None,
     artifact_origin: Union[bool, "ArtifactRegistry"] = True,
     resume: bool = False,
@@ -869,6 +870,12 @@ def run_distributed(
     ``slots = len(devices) // prod(mesh_shape)`` so slot groups never
     overlap).  The sharded trainable then builds the named mesh from the
     model family's partition rules (``models/partition_rules.py``).
+    ``input_mode``: sweep-wide data staging mode (same knob as
+    ``tune.run``), stamped into every sampled config: ``"resident"``,
+    ``"streaming"`` (the out-of-core prefetch ring, ``data/pipeline.py``),
+    or ``"auto"``.  The trainable resolves it against the budget of the
+    devices its WORKER leased; host_input counters stay worker-side (they
+    describe each worker host's own input path).
     ``stop`` / ``points_to_evaluate``: same surface as ``tune.run`` (dict /
     callable / Stopper; warm-start configs run first).
     ``callbacks`` / ``verbose=2``: the same observer surface as ``tune.run``
@@ -905,6 +912,13 @@ def run_distributed(
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+    if input_mode is not None and input_mode not in (
+        "auto", "resident", "streaming"
+    ):
+        raise ValueError(
+            f"input_mode must be 'auto', 'resident' or 'streaming', "
+            f"got {input_mode!r}"
+        )
     if resume:
         from distributed_machine_learning_tpu.tune.runner import _validate_resume
 
@@ -1108,9 +1122,10 @@ def run_distributed(
         # the local process executor, runner.py).
         time_limit_per_trial_s=time_limit_per_trial_s,
         log=log,
-        config_overlay=(
-            {"mesh_shape": dict(mesh_shape)} if mesh_shape else None
-        ),
+        config_overlay={
+            **({"mesh_shape": dict(mesh_shape)} if mesh_shape else {}),
+            **({"input_mode": input_mode} if input_mode else {}),
+        } or None,
     )
     trials = lifecycle.trials
     by_id = lifecycle.by_id

@@ -75,6 +75,7 @@ def run(
     search_alg: Optional[Searcher] = None,
     resources_per_trial: Optional[Dict[str, int]] = None,
     mesh_shape: Optional[Dict[str, int]] = None,
+    input_mode: Optional[str] = None,
     max_concurrent: Optional[int] = None,
     storage_path: str = DEFAULT_STORAGE,
     name: Optional[str] = None,
@@ -112,6 +113,15 @@ def run(
     ``tune.run(trainable, space, mesh_shape={"dp": 2, "tp": 4})`` leases
     8 devices per trial and the sharded trainable builds the mesh from
     its model family's partition rules (``models/partition_rules.py``).
+    ``input_mode``: sweep-wide data staging mode stamped into every sampled
+    config (a config carrying its own ``input_mode`` wins) — ``"resident"``
+    (HBM-resident epochs; raises when the staged dataset exceeds the
+    device budget), ``"streaming"`` (the out-of-core prefetch ring,
+    ``data/pipeline.py``), or ``"auto"`` (the default: streaming engages
+    when the dataset exceeds ``streaming_engage_fraction`` of the budget).
+    Streaming runs publish the ``host_input`` counter block
+    (prefetch hits, producer/consumer waits, overlap efficiency) into
+    ``experiment_state.json`` and TensorBoard ``host_input/*``.
     ``stop``: dict of result-key -> threshold (a trial stops once any key's
     reported value reaches it, e.g. ``{"training_iteration": 20}``), a
     callable ``(trial_id, result) -> bool``, or a ``tune.Stopper``
@@ -226,6 +236,14 @@ def run(
     # discipline as the checkpoint counters).
     compile_tracker_base = compilecache.get_tracker().snapshot()
     compile_counters_base = compilecache.get_counters().snapshot()
+    from distributed_machine_learning_tpu.data import pipeline as hostpipe
+
+    if input_mode is not None and input_mode not in hostpipe.INPUT_MODES:
+        raise ValueError(
+            f"input_mode must be one of {hostpipe.INPUT_MODES}, "
+            f"got {input_mode!r}"
+        )
+    host_input_base = hostpipe.get_host_input_counters().snapshot()
     device_mgr = DeviceManager(devices)
     events: "queue.Queue" = queue.Queue()
     watchdog = None
@@ -273,9 +291,10 @@ def run(
         keep_checkpoints_num=keep_checkpoints_num,
         time_limit_per_trial_s=time_limit_per_trial_s,
         log=log,
-        config_overlay=(
-            {"mesh_shape": dict(mesh_shape)} if mesh_shape else None
-        ),
+        config_overlay={
+            **({"mesh_shape": dict(mesh_shape)} if mesh_shape else {}),
+            **({"input_mode": input_mode} if input_mode else {}),
+        } or None,
     )
     trials = lifecycle.trials
     pending = lifecycle.pending
@@ -558,6 +577,13 @@ def run(
         ckpt_counters = get_metrics().delta_since(ckpt_metrics_base)
         if any(ckpt_counters.values()):
             extra["checkpoint"] = ckpt_counters
+        # Host-input accounting for THIS run (out-of-core streaming +
+        # dataset cache): prefetch hits, producer/consumer waits, and the
+        # derived overlap efficiency — present only when something
+        # streamed or the dataset cache was touched.
+        hi_block = hostpipe.host_input_block(host_input_base)
+        if hi_block is not None:
+            extra["host_input"] = hi_block
         plan = chaos.active_plan()
         if plan is not None:
             # A chaos run's state snapshot records what was injected, so
@@ -588,6 +614,8 @@ def run(
                for k, v in (extra.get("checkpoint") or {}).items()},
             **{f"compile/{k}": v
                for k, v in (extra.get("compile") or {}).items()},
+            **{f"host_input/{k}": v
+               for k, v in (extra.get("host_input") or {}).items()},
             **{f"pbt/{k}": v
                for k, v in (extra.get("pbt") or {}).items()
                if isinstance(v, (int, float)) and not isinstance(v, bool)},

@@ -43,6 +43,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from distributed_machine_learning_tpu.analysis.locks import named_lock
 from distributed_machine_learning_tpu.data.loader import Dataset
@@ -59,6 +60,9 @@ from distributed_machine_learning_tpu.ops.schedules import get_schedule
 from distributed_machine_learning_tpu.tune import session
 from distributed_machine_learning_tpu.tune._regression_program import (
     detect_call_convention,
+    eval_metrics_from_sums,
+    make_chunk_epoch_fn,
+    make_chunk_eval_fn,
     make_epoch_fn,
     make_eval_fn,
     make_forward,
@@ -123,10 +127,17 @@ def _bundle_nbytes(bundle) -> int:
 
 
 def clear_cohort_program_cache() -> None:
-    """Drop every cached cohort bundle (frees their staged device data)."""
+    """Drop every cached cohort bundle (frees their staged device data) and
+    the streaming program bundles (programs only — streaming never pins
+    staged splits)."""
+    from distributed_machine_learning_tpu.data.pipeline import (
+        clear_stream_program_cache,
+    )
+
     with _COHORT_GUARD:
         _COHORT_CACHE.clear()
         _COHORT_LOCKS.clear()
+    clear_stream_program_cache()
 
 
 def _cohort_key(config, train_data, val_data, device):
@@ -203,6 +214,25 @@ def train_regressor(
     from distributed_machine_learning_tpu.models import compute_dtype_of
 
     compute_dtype = compute_dtype_of(config) or jnp.float32
+
+    lease = session.get_devices()
+    device = lease[0] if lease else jax.devices()[0]
+
+    # Input-mode resolution (data/pipeline.py): HBM-resident epochs when
+    # the staged dataset fits, the double-buffered prefetch ring when it
+    # does not (or when config["input_mode"]="streaming" forces it) —
+    # explicit "resident" over the device budget raises rather than OOM.
+    from distributed_machine_learning_tpu.data import pipeline as hostpipe
+
+    input_mode = hostpipe.resolve_input_mode(
+        config,
+        hostpipe.staged_nbytes(train_data, val_data, compute_dtype),
+        device,
+    )
+    if input_mode == "streaming":
+        return _train_regressor_streaming(
+            config, train_data, val_data, device, compute_dtype
+        )
 
     accum = max(int(config.get("accumulate_grad_batches", 1)), 1)
     lr = float(config["learning_rate"])
@@ -298,8 +328,6 @@ def train_regressor(
             steps_per_epoch=steps_per_epoch, total_steps=total_steps,
         )
 
-    lease = session.get_devices()
-    device = lease[0] if lease else jax.devices()[0]
     if injected and bool(config.get("share_programs", True)):
         # Everything in the bundle is trial-independent under injection:
         # one build serves the whole cohort (and the per-key lock makes
@@ -523,5 +551,506 @@ def train_regressor(
                 with dispatch_lock():
                     checkpoint = jax.device_get(checkpoint)
         session.report(record, checkpoint=checkpoint)
+
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Streaming (out-of-core) path: the double-buffered prefetch ring
+# ---------------------------------------------------------------------------
+
+_StreamBundle = namedtuple("_StreamBundle", [
+    "model", "flag_name", "has_bn", "forward", "tx", "init_model",
+    "init_opt", "chunk_train", "evaluate", "eval_chunk", "shape_schedule",
+    "total_steps",
+])
+
+
+def _train_regressor_streaming(
+    config: Dict[str, Any],
+    train_data: Dataset,
+    val_data: Dataset,
+    device,
+    compute_dtype,
+):
+    """``train_regressor``'s out-of-core twin (``input_mode="streaming"``).
+
+    Instead of staging both splits to the device once, the epoch's shuffled
+    batch sequence is cut into chunks; a producer thread gathers chunk
+    *k+1* on host (the SAME permutation the resident epoch program would
+    draw — threefry bits are identical eager vs jit) and ``device_put``\\ s
+    it into the bounded ring while the jitted chunk program consumes
+    donated chunk *k*.  The chunk program's step body and PRNG key chain
+    are the resident program's own (``make_chunk_epoch_fn``), so both
+    modes see identical batches in identical order and finish with
+    bit-identical params — the determinism contract
+    ``tests/test_streaming.py`` asserts end to end.  Validation streams
+    too when it exceeds the engage fraction of the budget, else it stays
+    resident (bit-identical metrics with the resident path's eval
+    program).
+    """
+    from distributed_machine_learning_tpu.compilecache import (
+        chunked_program_key,
+    )
+    from distributed_machine_learning_tpu.data import pipeline as hostpipe
+
+    counters = hostpipe.get_host_input_counters()
+    counters.add("streams_engaged")
+
+    num_epochs = int(config.get("num_epochs", 20))
+    seed = int(config.get("seed", 0))
+    loss_name = str(config.get("loss_function", "mse"))
+    accum = max(int(config.get("accumulate_grad_batches", 1)), 1)
+    lr = float(config["learning_rate"])
+    wd = float(config.get("weight_decay", 0.0))
+    opt_name = str(config.get("optimizer", "adam")).lower()
+    injected = (
+        opt_name in INJECTABLE_OPTIMIZERS
+        and accum == 1
+        and bool(config.get("inject_hyperparams", True))
+    )
+
+    x_np, y_np = train_data.x, train_data.y
+    n_train = len(train_data)
+    batch_size = int(min(int(config.get("batch_size", 32)), n_train))
+    num_batches = max(n_train // batch_size, 1)
+    steps_per_epoch = num_batches
+    total_steps = max(int(config.get(
+        "total_steps", num_epochs * max(steps_per_epoch // accum, 1)
+    )), 1)
+
+    # Chunk geometry: ring slabs sized to the device budget.
+    row_nbytes = (
+        int(np.prod(x_np.shape[1:], dtype=np.int64))
+        * np.dtype(compute_dtype).itemsize
+        + int(np.prod(y_np.shape[1:], dtype=np.int64)) * 4
+    )
+    plan = hostpipe.plan_chunks(
+        num_batches, batch_size, row_nbytes, device=device, config=config
+    )
+
+    # Validation layout: identical padding math to stage_data (bit-equal
+    # metrics when validation stays resident).
+    n_val = len(val_data)
+    eval_bs = int(min(max(batch_size, 1), n_val))
+    n_val_pad = -(-n_val // eval_bs) * eval_bs
+    n_val_blocks = n_val_pad // eval_bs
+    val_nbytes = (
+        n_val_pad * int(np.prod(val_data.x.shape[1:], dtype=np.int64))
+        * np.dtype(compute_dtype).itemsize
+        + n_val_pad * int(np.prod(val_data.y.shape[1:], dtype=np.int64)) * 4
+    )
+    engage_fraction = float(config.get(
+        "streaming_engage_fraction", hostpipe.DEFAULT_ENGAGE_FRACTION
+    ))
+    val_streaming = (
+        val_nbytes > engage_fraction * hostpipe.device_budget_bytes(device)
+    )
+    eval_plan = (
+        hostpipe.plan_chunks(
+            n_val_blocks, eval_bs, row_nbytes, device=device, config=config
+        )
+        if val_streaming
+        else None
+    )
+
+    def _build_stream_bundle(use_injected) -> _StreamBundle:
+        shape_schedule = get_schedule(
+            str(config.get("lr_schedule", "warmup_linear_decay")),
+            learning_rate=1.0,
+            warmup_steps=int(config.get("warmup_steps", 0)),
+            total_steps=total_steps,
+        )
+        if use_injected:
+            tx = make_injected_optimizer(
+                opt_name,
+                shape_schedule,
+                momentum=float(config.get("momentum", 0.0)),
+                gradient_clipping=float(config.get("gradient_clipping", 0.0)),
+            )
+        else:
+            tx = make_optimizer(
+                opt_name,
+                learning_rate=get_schedule(
+                    str(config.get("lr_schedule", "warmup_linear_decay")),
+                    learning_rate=lr,
+                    warmup_steps=int(config.get("warmup_steps", 0)),
+                    total_steps=total_steps,
+                ),
+                weight_decay=wd,
+                momentum=float(config.get("momentum", 0.0)),
+                gradient_clipping=float(config.get("gradient_clipping", 0.0)),
+                accumulate_grad_batches=accum,
+            )
+        model = build_model(config)
+        # Abstract probe: flag kwarg + BN detection with NOTHING allocated
+        # (an over-budget dataset often rides with a big model too).
+        abstract_vars, flag_name = detect_call_convention(
+            model,
+            jax.ShapeDtypeStruct(
+                (1, *x_np.shape[1:]), np.dtype(compute_dtype)
+            ),
+            abstract=True,
+        )
+        has_bn = "batch_stats" in abstract_vars
+        init_kwargs = {
+            flag_name: True if flag_name == "deterministic" else False
+        }
+        init_model = jax.jit(
+            lambda rngs, x: model.init(rngs, x, **init_kwargs)
+        )
+        forward = make_forward(model, flag_name, has_bn)
+        # ONE jitted chunk program serves the full chunk AND the tail
+        # (jit retraces per slab shape: at most two traces per epoch
+        # geometry — the chunk COUNT never shapes a trace).  Donation
+        # covers the state and the consumed slab, so each chunk's staging
+        # buffers free at the boundary (the ring's memory bound).
+        chunk_train = jax.jit(
+            make_chunk_epoch_fn(forward, tx, get_loss(loss_name)),
+            donate_argnums=(0, 1, 2, 4, 5),
+        )
+        evaluate = (
+            None
+            if val_streaming
+            else jax.jit(
+                make_eval_fn(forward, loss_name, n_val_blocks, eval_bs)
+            )
+        )
+        eval_chunk = (
+            jax.jit(make_chunk_eval_fn(forward), donate_argnums=(2, 3, 4))
+            if val_streaming
+            else None
+        )
+        return _StreamBundle(
+            model=model, flag_name=flag_name, has_bn=has_bn,
+            forward=forward, tx=tx, init_model=init_model,
+            init_opt=jax.jit(tx.init), chunk_train=chunk_train,
+            evaluate=evaluate, eval_chunk=eval_chunk,
+            shape_schedule=shape_schedule, total_steps=total_steps,
+        )
+
+    # The chunked program's OWN cache identity: slab rows fold in, chunk
+    # count does not (compilecache.chunked_program_key) — one build per
+    # cohort under injection, same discipline as the resident bundle.
+    program_key = chunked_program_key(
+        config,
+        chunk_rows=plan.chunk_batches,
+        batch_shape=[
+            [plan.chunk_batches, batch_size, *x_np.shape[1:]],
+            [plan.chunk_batches, batch_size, *y_np.shape[1:]],
+        ],
+        dtype=str(config.get("compute_dtype") or "float32"),
+        donation=(0, 1, 2, 4, 5),
+        extra={
+            "tail_rows": plan.tail_batches,
+            "val": ["streamed", eval_plan.chunk_batches]
+            if val_streaming else ["resident", n_val_blocks, eval_bs],
+            "device": [getattr(device, "platform", "cpu"),
+                       int(getattr(device, "id", 0))],
+        },
+    )
+    if injected and bool(config.get("share_programs", True)):
+        with dispatch_lock():
+            bundle = hostpipe.stream_bundle_for(
+                program_key, lambda: _build_stream_bundle(True)
+            )
+    else:
+        with dispatch_lock():
+            bundle = _build_stream_bundle(injected)
+    tx = bundle.tx
+    chunk_train = bundle.chunk_train
+    shape_schedule = bundle.shape_schedule
+
+    with dispatch_lock():
+        variables = bundle.init_model(
+            init_rngs_for(seed),
+            jnp.asarray(x_np[:1], dtype=compute_dtype),
+        )
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        opt_state = bundle.init_opt(params)
+        if injected:
+            opt_state = set_injected_hyperparams(opt_state, lr, wd)
+
+    # Resident validation staging (the common case: train dominates).
+    xv = yv = vmask = None
+    if not val_streaming:
+        pad = n_val_pad - n_val
+        xv_np = (
+            np.concatenate([val_data.x,
+                            np.zeros((pad, *val_data.x.shape[1:]),
+                                     val_data.x.dtype)])
+            if pad else val_data.x
+        )
+        yv_np = (
+            np.concatenate([val_data.y,
+                            np.zeros((pad, *val_data.y.shape[1:]),
+                                     val_data.y.dtype)])
+            if pad else val_data.y
+        )
+        with dispatch_lock():
+            xv = jnp.asarray(xv_np, dtype=compute_dtype)
+            yv = jnp.asarray(yv_np, dtype=jnp.float32)
+            vmask = jnp.asarray(np.concatenate(
+                [np.ones(n_val, np.float32), np.zeros(pad, np.float32)]
+            ))
+
+    # ---- restore (PBT exploit / fault retry) -------------------------------
+    rng_impl = resolve_rng_impl(config)
+    start_epoch = 0
+    ckpt = session.get_checkpoint()
+    if ckpt is not None:
+        saved_impl = ckpt.get("rng_impl") if isinstance(ckpt, dict) else None
+        if saved_impl is not None:
+            rng_impl = saved_impl or None
+        else:
+            rng_impl = config.get("rng_impl") or None
+        template = {
+            "params": params,
+            "opt_state": opt_state,
+            "batch_stats": batch_stats,
+            "epoch": 0,
+        }
+        with dispatch_lock():
+          try:
+            restored = restore_into(template, ckpt)
+          except (ValueError, KeyError, TypeError, AttributeError):
+            if not injected:
+                raise
+            # Legacy (baked-optimizer) checkpoint: rebuild the baked chain
+            # for this incarnation — same fallback as the resident path.
+            injected = False
+            tx = make_optimizer(
+                opt_name,
+                learning_rate=get_schedule(
+                    str(config.get("lr_schedule", "warmup_linear_decay")),
+                    learning_rate=lr,
+                    warmup_steps=int(config.get("warmup_steps", 0)),
+                    total_steps=total_steps,
+                ),
+                weight_decay=wd,
+                momentum=float(config.get("momentum", 0.0)),
+                gradient_clipping=float(
+                    config.get("gradient_clipping", 0.0)
+                ),
+                accumulate_grad_batches=accum,
+            )
+            chunk_train = jax.jit(
+                make_chunk_epoch_fn(
+                    bundle.forward, tx, get_loss(loss_name)
+                ),
+                donate_argnums=(0, 1, 2, 4, 5),
+            )
+            opt_state = jax.jit(tx.init)(params)
+            template["opt_state"] = opt_state
+            restored = restore_into(template, ckpt)
+        params = restored["params"]
+        opt_state = restored["opt_state"]
+        batch_stats = restored["batch_stats"]
+        start_epoch = int(restored["epoch"]) + 1
+        if injected:
+            with dispatch_lock():
+                opt_state = set_injected_hyperparams(opt_state, lr, wd)
+
+    checkpoint_freq = int(config.get("checkpoint_freq", 1))
+
+    # ---- per-epoch MFU accounting (same derivation as the resident path) ---
+    seq_len = int(x_np.shape[1]) if x_np.ndim == 3 else 1
+    feats = int(x_np.shape[-1])
+    step_flops = train_step_flops(config, batch_size, seq_len, feats)
+    eval_flops = forward_flops(config, n_val, seq_len, feats)
+    epoch_flops = (
+        step_flops * steps_per_epoch + (eval_flops or 0.0)
+        if step_flops is not None
+        else None
+    )
+    peak = device_peak_flops(
+        device, str(config.get("compute_dtype", "float32"))
+    )
+    tracker = get_tracker()
+
+    # ---- the producer: host gather + device_put of chunk k+1 ---------------
+    depth = hostpipe.prefetch_depth(config)
+    deadline_s = float(config.get(
+        "streaming_producer_deadline_s", hostpipe.DEFAULT_PRODUCER_DEADLINE_S
+    ))
+
+    def _stage(arr, dtype):
+        staged = np.asarray(arr, dtype=dtype)
+        if serialization_on():
+            with dispatch_lock():
+                return jax.device_put(staged, device)
+        return jax.device_put(staged, device)
+
+    def _epoch_perm(epoch: int) -> np.ndarray:
+        # EXACTLY the resident epoch program's permutation: same key
+        # derivation, same split, same truncation — threefry bits are
+        # identical eager vs jit, so the host replays the in-program draw.
+        if serialization_on():
+            with dispatch_lock():
+                epoch_key = jax.random.key(
+                    fold_seed(seed, "epoch", epoch), impl=rng_impl
+                )
+                perm_key, _ = jax.random.split(epoch_key)
+                perm = np.asarray(jax.random.permutation(perm_key, n_train))
+        else:
+            epoch_key = jax.random.key(
+                fold_seed(seed, "epoch", epoch), impl=rng_impl
+            )
+            perm_key, _ = jax.random.split(epoch_key)
+            perm = np.asarray(jax.random.permutation(perm_key, n_train))
+        return perm[: num_batches * batch_size]
+
+    def _source():
+        for epoch in range(start_epoch, num_epochs):
+            perm = _epoch_perm(epoch)
+            for start, rows in plan.chunk_sizes():
+                idx = perm[start * batch_size:(start + rows) * batch_size]
+                xg, yg = hostpipe.gather_batches(
+                    x_np, y_np, idx, rows, batch_size
+                )
+                yield (
+                    _stage(xg, compute_dtype), _stage(yg, np.float32)
+                )
+            if val_streaming:
+                vmask_np = (
+                    np.arange(n_val_pad) < n_val
+                ).astype(np.float32)
+                for vstart, vrows in eval_plan.chunk_sizes():
+                    lo, hi = vstart * eval_bs, (vstart + vrows) * eval_bs
+                    xvc = np.zeros(
+                        (hi - lo, *val_data.x.shape[1:]), val_data.x.dtype
+                    )
+                    yvc = np.zeros(
+                        (hi - lo, *val_data.y.shape[1:]), val_data.y.dtype
+                    )
+                    real = max(min(hi, n_val) - lo, 0)
+                    if real:
+                        xvc[:real] = val_data.x[lo:lo + real]
+                        yvc[:real] = val_data.y[lo:lo + real]
+                    yield (
+                        _stage(
+                            xvc.reshape(vrows, eval_bs,
+                                        *val_data.x.shape[1:]),
+                            compute_dtype,
+                        ),
+                        _stage(
+                            yvc.reshape(vrows, eval_bs,
+                                        *val_data.y.shape[1:]),
+                            np.float32,
+                        ),
+                        _stage(
+                            vmask_np[lo:hi].reshape(vrows, eval_bs),
+                            np.float32,
+                        ),
+                    )
+
+    prefetcher = hostpipe.ChunkPrefetcher(
+        _source(), depth=depth, deadline_s=deadline_s,
+        name=f"stream-{session.get_trial_id()}",
+    )
+
+    import time as _time
+
+    # ---- epoch loop: consume donated chunk k while k+1 stages --------------
+    try:
+        for epoch in range(start_epoch, num_epochs):
+            step_count = (epoch + 1) * steps_per_epoch
+            opt_steps = (epoch + 1) * max(steps_per_epoch // accum, 1)
+            with dispatch_lock():
+                epoch_key = jax.random.key(
+                    fold_seed(seed, "epoch", epoch), impl=rng_impl
+                )
+                # The resident program's in-program split: perm_key (the
+                # producer replays it) and the step chain's root.
+                _, key = jax.random.split(epoch_key)
+                lr_now = lr * float(
+                    shape_schedule(min(opt_steps, total_steps))
+                )
+            wait0 = prefetcher.wait_s
+            c0 = tracker.thread_seconds()
+            t0 = _time.time()
+            loss_parts = []
+            for _start, _rows in plan.chunk_sizes():
+                # The ring get stays OUTSIDE the dispatch hold: the
+                # producer's device_put takes the same lock under
+                # serialization, and waiting while holding it would
+                # deadlock the very overlap being measured.
+                xb, yb = prefetcher.get()
+                with dispatch_lock():
+                    params, opt_state, batch_stats, key, losses = (
+                        chunk_train(
+                            params, opt_state, batch_stats, key, xb, yb
+                        )
+                    )
+                loss_parts.append(losses)
+                # A consumed chunk IS progress: a slow producer must read
+                # as slow, never as a silent (stalled) trial.
+                session.heartbeat()
+            if val_streaming:
+                sums = np.zeros(5, np.float64)
+                for _vstart, _vrows in eval_plan.chunk_sizes():
+                    xbv, ybv, mbv = prefetcher.get()
+                    with dispatch_lock():
+                        part = bundle.eval_chunk(
+                            params, batch_stats, xbv, ybv, mbv
+                        )
+                        sums += np.array([float(v) for v in part])
+                    session.heartbeat()
+                metrics = eval_metrics_from_sums(loss_name, *sums)
+                with dispatch_lock():
+                    train_loss = float(jnp.concatenate(loss_parts).mean())
+            else:
+                with dispatch_lock():
+                    metrics = bundle.evaluate(
+                        params, batch_stats, xv, yv, vmask
+                    )
+                    # Scalar readbacks sync every queued chunk program
+                    # before the epoch clock stops (jit returns futures).
+                    train_loss = float(jnp.concatenate(loss_parts).mean())
+                    metrics = {k: float(v) for k, v in metrics.items()}
+            wait_s = prefetcher.wait_s - wait0
+            wall = _time.time() - t0
+            compile_s = tracker.thread_seconds() - c0
+            exec_s = max(wall - compile_s - wait_s, 1e-9)
+            prefetcher.note_consume(max(wall - wait_s, 0.0))
+            record = {
+                "epoch": epoch,
+                "train_loss": train_loss,
+                "lr": lr_now,
+                "steps": step_count,
+                "input_mode": "streaming",
+                **metrics,
+            }
+            record["epoch_time_s"] = round(exec_s, 4)
+            try:
+                stats = device.memory_stats()
+                if stats and "bytes_in_use" in stats:
+                    record["device_bytes_in_use"] = int(
+                        stats["bytes_in_use"]
+                    )
+            except Exception:  # noqa: BLE001 - telemetry must never fail
+                pass
+            if epoch_flops is not None:
+                record["epoch_flops"] = epoch_flops
+                if peak:
+                    record["mfu"] = round(epoch_flops / exec_s / peak, 5)
+            checkpoint = None
+            if checkpoint_freq and (epoch + 1) % checkpoint_freq == 0:
+                checkpoint = {
+                    "params": params,
+                    "opt_state": opt_state,
+                    "batch_stats": batch_stats,
+                    "epoch": epoch,
+                    "rng_impl": rng_impl or "",
+                }
+                if serialization_on():
+                    with dispatch_lock():
+                        checkpoint = jax.device_get(checkpoint)
+            session.report(record, checkpoint=checkpoint)
+    finally:
+        # Early stop, crash, or clean finish: the producer thread and the
+        # ring's staged slabs must never outlive the trial.
+        prefetcher.close()
 
     return None

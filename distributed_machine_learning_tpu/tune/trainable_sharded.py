@@ -76,7 +76,10 @@ from distributed_machine_learning_tpu.tune._regression_program import (
     per_example_losses,
 )
 from distributed_machine_learning_tpu.tune.checkpoint import restore_into
-from distributed_machine_learning_tpu.utils.dispatch import dispatch_lock
+from distributed_machine_learning_tpu.utils.dispatch import (
+    dispatch_lock,
+    serialization_on,
+)
 from distributed_machine_learning_tpu.utils.seeding import (
     fold_seed,
     init_rngs_for,
@@ -166,6 +169,35 @@ def _train_sharded(
         )
     num_batches = n_train // global_batch
     steps_per_epoch = num_batches
+
+    # Input-mode resolution (data/pipeline.py): the staged epoch arrays'
+    # batch axis spreads over dp, so the resident footprint PER DEVICE is
+    # the dataset over dp — streaming engages when even that slice
+    # exceeds the engage fraction of one device's budget; explicit
+    # "resident" over budget raises.
+    from distributed_machine_learning_tpu.data import pipeline as hostpipe
+
+    dataset_bytes = (
+        x_np.nbytes + y_np.nbytes
+        + int(val_data.x.size + val_data.y.size) * 4
+    )
+    input_mode = hostpipe.resolve_input_mode(
+        config, dataset_bytes, devices[0], shards=dp
+    )
+    streaming = input_mode == "streaming"
+    if streaming:
+        hostpipe.get_host_input_counters().add("streams_engaged")
+        per_dev_row_nbytes = max(
+            (int(np.prod(x_np.shape[1:], dtype=np.int64)) * 4
+             + int(np.prod(y_np.shape[1:], dtype=np.int64)) * 4) // dp,
+            1,
+        )
+        chunk_plan = hostpipe.plan_chunks(
+            num_batches, global_batch, per_dev_row_nbytes,
+            device=devices[0], config=config,
+        )
+    else:
+        chunk_plan = None
 
     accum = max(int(config.get("accumulate_grad_batches", 1)), 1)
     total_steps = int(
@@ -300,11 +332,41 @@ def _train_sharded(
         )
         return params, opt_state, batch_stats, losses.mean()
 
+    # Streaming chunk program: the SAME step body scanned over a staged
+    # slab of the epoch's batches, with the global batch counter riding
+    # the carry from ``i0`` so the per-step ``fold_in(epoch_key, i)``
+    # matches the resident program bit for bit across chunk boundaries.
+    def chunk_fn(params, opt_state, batch_stats, i0, xb, yb, epoch_key):
+        def step(carry, batch):
+            params, opt_state, batch_stats, i = carry
+            x, y = batch
+            key = jax.random.fold_in(epoch_key, i)
+
+            def loss_of(p):
+                preds, new_bs, aux = forward(p, batch_stats, x, key, True)
+                return loss_fn(preds.astype(jnp.float32), y) + aux, new_bs
+
+            (loss, new_bs), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state, new_bs, i + 1), loss
+
+        (params, opt_state, batch_stats, _), losses = jax.lax.scan(
+            step, (params, opt_state, batch_stats, i0), (xb, yb)
+        )
+        return params, opt_state, batch_stats, losses
+
     # The fused epoch program: donation covers EVERY large input — params
     # (0), opt_state (1), batch_stats (2), and the staged epoch batches
     # (3, 4): the batch chunks are consumed exactly once per epoch, so
     # donating them saves a full epoch-sized HBM copy per epoch.
     _EPOCH_DONATE = (0, 1, 2, 3, 4)
+    # Chunk donation: state plus the consumed slab (4, 5) — each staged
+    # chunk's buffers free at the chunk boundary (the ring's memory
+    # bound); i0 and epoch_key are scalars.
+    _CHUNK_DONATE = (0, 1, 2, 4, 5)
     # out_shardings pinned to the SAME rule layout as the inputs: without
     # the pin GSPMD may propagate a different layout onto the returned
     # params (observed: head params pulled onto 'tp' by the head-kernel
@@ -345,7 +407,61 @@ def _train_sharded(
             int(getattr(d, "id", i)) for i, d in enumerate(devices)
         ]},
     )
-    with dispatch_lock():
+    chunk_jit_kwargs = {
+        "in_shardings": (
+            p_shardings, o_shardings, bs_shardings, repl,
+            xb_sharding, yb_sharding, repl,
+        ),
+        "out_shardings": (p_shardings, o_shardings, bs_shardings, repl),
+    }
+
+    def jit_chunk():
+        return jax.jit(
+            chunk_fn, donate_argnums=_CHUNK_DONATE, **chunk_jit_kwargs
+        )
+
+    train_epoch = train_chunk = None
+    if streaming:
+        # Chunked programs carry their OWN cache identity: slab rows fold
+        # in (the scan trip count baked into the trace), the chunk COUNT
+        # does not (the host loops) — so dataset length never splits the
+        # key.  One jitted callable serves full and tail slabs (jit
+        # retraces per shape: at most two traces per geometry); the
+        # full-slab trace resolves through the AOT tier.
+        chunk_shape = (
+            (chunk_plan.chunk_batches, global_batch) + x_np.shape[1:],
+            (chunk_plan.chunk_batches, global_batch) + y_np.shape[1:],
+        )
+        chunk_key = sharded_program_key(
+            config,
+            mesh_shape=mesh_axis_sizes(mesh),
+            rules_fingerprint=rules_fp,
+            batch_shape=[list(chunk_shape[0]), list(chunk_shape[1])],
+            dtype=str(config.get("compute_dtype") or "float32"),
+            donation=_CHUNK_DONATE,
+            extra={
+                "stream_chunk_rows": chunk_plan.chunk_batches,
+                "device_ids": [
+                    int(getattr(d, "id", i)) for i, d in enumerate(devices)
+                ],
+            },
+        )
+        with dispatch_lock():
+            try:
+                train_chunk = _epoch_aot_cache().get_or_compile(
+                    chunk_key, chunk_fn,
+                    params, opt_state, batch_stats, jnp.int32(0),
+                    jax.ShapeDtypeStruct(chunk_shape[0], jnp.float32),
+                    jax.ShapeDtypeStruct(chunk_shape[1], jnp.float32),
+                    jax.random.key(0),
+                    donate_argnums=_CHUNK_DONATE,
+                    jit_kwargs=chunk_jit_kwargs,
+                )
+            except Exception:  # noqa: BLE001 - AOT must never fail a trial
+                train_chunk = jit_chunk()
+        train_chunk_tail = jit_chunk() if chunk_plan.tail_batches else None
+    else:
+      with dispatch_lock():
         try:
             train_epoch = _epoch_aot_cache().get_or_compile(
                 program_key, epoch_fn,
@@ -444,7 +560,20 @@ def _train_sharded(
             epoch_jit_kwargs["out_shardings"] = (
                 p_shardings, o_shardings, bs_shardings, repl,
             )
-            train_epoch = jit_epoch()
+            chunk_jit_kwargs["in_shardings"] = (
+                p_shardings, o_shardings, bs_shardings, repl,
+                xb_sharding, yb_sharding, repl,
+            )
+            chunk_jit_kwargs["out_shardings"] = (
+                p_shardings, o_shardings, bs_shardings, repl,
+            )
+            if streaming:
+                train_chunk = jit_chunk()
+                train_chunk_tail = (
+                    jit_chunk() if chunk_plan.tail_batches else None
+                )
+            else:
+                train_epoch = jit_epoch()
             template["opt_state"] = _host(opt_state)
             restored = restore_into(template, ckpt)
         # Re-shard restored host arrays into the live mesh layout.
@@ -463,6 +592,129 @@ def _train_sharded(
     checkpoint_freq = int(config.get("checkpoint_freq", 1))
     rng = np.random.default_rng(fold_seed(seed, "shuffle"))
     audit_donation = True
+
+    if streaming:
+        # ---- streaming epoch loop: consume chunk k while k+1 stages --------
+        import time as _time
+
+        depth = hostpipe.prefetch_depth(config)
+        deadline_s = float(config.get(
+            "streaming_producer_deadline_s",
+            hostpipe.DEFAULT_PRODUCER_DEADLINE_S,
+        ))
+
+        def _stage(arr, sharding):
+            if serialization_on():
+                with dispatch_lock():
+                    return jax.device_put(arr, sharding)
+            return jax.device_put(arr, sharding)
+
+        def _source():
+            # The resident loop's OWN shuffle stream, consumed in the same
+            # epoch order from the same start epoch — identical batches in
+            # identical order is the determinism contract.
+            prod_rng = np.random.default_rng(fold_seed(seed, "shuffle"))
+            for _epoch in range(start_epoch, num_epochs):
+                perm = prod_rng.permutation(n_train)[
+                    : num_batches * global_batch
+                ]
+                for start, rows in chunk_plan.chunk_sizes():
+                    idx = perm[
+                        start * global_batch:(start + rows) * global_batch
+                    ]
+                    xg, yg = hostpipe.gather_batches(
+                        x_np, y_np, idx, rows, global_batch
+                    )
+                    yield _stage(xg, xb_sharding), _stage(yg, yb_sharding)
+
+        prefetcher = hostpipe.ChunkPrefetcher(
+            _source(), depth=depth, deadline_s=deadline_s,
+            name=f"stream-{session.get_trial_id()}",
+        )
+        try:
+            for epoch in range(start_epoch, num_epochs):
+                step_count = (epoch + 1) * steps_per_epoch
+                opt_steps = (epoch + 1) * max(steps_per_epoch // accum, 1)
+                with dispatch_lock():
+                    epoch_key = jax.random.key(
+                        fold_seed(seed, "epoch", epoch)
+                    )
+                    lr_now = (
+                        lr * float(
+                            shape_schedule(min(opt_steps, total_steps))
+                        )
+                        if injected
+                        else float(schedule(min(opt_steps, total_steps)))
+                    )
+                wait0 = prefetcher.wait_s
+                t0 = _time.monotonic()
+                loss_parts = []
+                probes = None
+                for start, rows in chunk_plan.chunk_sizes():
+                    # The ring get stays OUTSIDE the dispatch hold — the
+                    # producer's device_put takes the same lock under
+                    # serialization.
+                    xb, yb = prefetcher.get()
+                    with dispatch_lock():
+                        if audit_donation and probes is None:
+                            probes = [xb, yb] \
+                                + jax.tree.leaves(params)[:1] \
+                                + jax.tree.leaves(opt_state)[:1]
+                        prog = (
+                            train_chunk
+                            if rows == chunk_plan.chunk_batches
+                            else train_chunk_tail
+                        )
+                        params, opt_state, batch_stats, losses = prog(
+                            params, opt_state, batch_stats,
+                            jnp.int32(start), xb, yb, epoch_key,
+                        )
+                    loss_parts.append(losses)
+                    # A consumed chunk IS progress for the trial watchdog.
+                    session.heartbeat()
+                with dispatch_lock():
+                    metrics = evaluate(params, batch_stats, xv, yv, mask)
+                    train_loss = float(jnp.concatenate(loss_parts).mean())
+                    metrics = {k: float(v) for k, v in metrics.items()}
+                    if audit_donation and probes is not None:
+                        audit_donation = False
+                        consumed = sum(
+                            1 for a in probes
+                            if isinstance(a, jax.Array) and a.is_deleted()
+                        )
+                        if consumed:
+                            get_compile_counters().add(
+                                "donation_aliased_buffers", consumed
+                            )
+                wait_s = prefetcher.wait_s - wait0
+                prefetcher.note_consume(
+                    max(_time.monotonic() - t0 - wait_s, 0.0)
+                )
+                record = {
+                    "epoch": epoch,
+                    "train_loss": train_loss,
+                    "lr": lr_now,
+                    "steps": step_count,
+                    "num_devices": len(devices),
+                    "mesh_shape": dict(mesh_shape),
+                    "input_mode": "streaming",
+                    **metrics,
+                }
+                checkpoint = None
+                if checkpoint_freq and (epoch + 1) % checkpoint_freq == 0:
+                    with dispatch_lock():
+                        checkpoint = {
+                            "params": _host(params),
+                            "opt_state": _host(opt_state),
+                            "batch_stats": _host(batch_stats),
+                            "epoch": epoch,
+                        }
+                session.report(record, checkpoint=checkpoint)
+        finally:
+            # Early stop, crash, or clean finish: the producer thread and
+            # its staged slabs must never outlive the trial.
+            prefetcher.close()
+        return None
 
     # ---- epoch loop: host-driven so the scheduler can interrupt ------------
     for epoch in range(start_epoch, num_epochs):
@@ -488,9 +740,11 @@ def _train_sharded(
                 if injected
                 else float(schedule(min(opt_steps, total_steps)))
             )
+            # dmlint: disable=blocking-transfer-in-loop one whole-epoch slab per epoch by design; streaming (input_mode) is the over-budget path
             xb = jax.device_put(
                 x_np[perm].reshape(xb_shape), xb_sharding,
             )
+            # dmlint: disable=blocking-transfer-in-loop one whole-epoch slab per epoch by design; streaming (input_mode) is the over-budget path
             yb = jax.device_put(
                 y_np[perm].reshape(yb_shape), yb_sharding,
             )

@@ -635,6 +635,7 @@ def run_vectorized(
     compaction: str = "auto",
     epochs_per_dispatch="auto",
     pbt_mode: str = "auto",
+    input_mode: str = "auto",
     checkpoint_every_epochs: int = 0,
     checkpoint_format: str = "msgpack",
     resume: bool = False,
@@ -682,6 +683,15 @@ def run_vectorized(
     rule or ``checkpoint_every_epochs`` caps the auto pick so those
     keep their dispatch-boundary semantics; pass an int to force a
     chunk size.
+
+    ``input_mode``: accepted for surface parity with ``tune.run`` /
+    ``run_distributed``.  ``"streaming"`` FALLS BACK to resident staging
+    in this driver (logged + counted as ``host_input.mode_fallbacks`` in
+    ``experiment_state.json``): population programs gather every row's
+    shuffled batches in-program from the shared staged splits, and
+    per-row permutations would multiply a host-side chunk gather (and
+    its slab bytes) by the population size.  Out-of-core datasets belong
+    on ``tune.run``'s per-trial executors (``data/pipeline.py``).
 
     ``pbt_mode``: how a ``PopulationBasedTraining`` sweep executes its
     exploit/explore.  ``"auto"`` (default) compiles the whole sweep as a
@@ -741,6 +751,24 @@ def run_vectorized(
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+    from distributed_machine_learning_tpu.data import pipeline as hostpipe
+
+    if input_mode not in hostpipe.INPUT_MODES:
+        raise ValueError(
+            f"input_mode must be one of {hostpipe.INPUT_MODES}, "
+            f"got {input_mode!r}"
+        )
+    host_input_base = hostpipe.get_host_input_counters().snapshot()
+    input_mode_requested = input_mode
+    if input_mode == "streaming":
+        # The population program gathers every row's shuffled batches
+        # IN-program from the shared staged splits — per-row permutations
+        # mean a host-side chunk gather would multiply host work (and slab
+        # bytes) by the population size.  Streaming therefore falls back
+        # to resident staging here, counted and logged; use tune.run's
+        # per-trial executors for out-of-core datasets.
+        hostpipe.get_host_input_counters().add("mode_fallbacks")
+        input_mode = "resident"
     from distributed_machine_learning_tpu import compilecache as cc
 
     if compile_cache_dir is not None:
@@ -854,6 +882,15 @@ def run_vectorized(
     def log(msg: str):
         if verbose:
             print(f"[tune.vectorized] {msg}", flush=True)
+
+    if input_mode_requested == "streaming":
+        log(
+            "input_mode='streaming' falls back to resident staging here: "
+            "population programs gather per-row permutations in-program "
+            "from the shared staged splits (counted as "
+            "host_input.mode_fallbacks; use tune.run for out-of-core "
+            "datasets)"
+        )
 
     if pbt is not None:
         log(
@@ -1035,6 +1072,13 @@ def run_vectorized(
         ckpt_counters = _ckpt_m().delta_since(ckpt_metrics_base)
         if any(ckpt_counters.values()):
             extra["checkpoint"] = ckpt_counters
+        # Host-input accounting (dataset cache activity; streaming itself
+        # falls back to resident in the vectorized driver — the requested
+        # mode and the fallback count are part of the record).
+        hi_block = hostpipe.host_input_block(host_input_base)
+        if hi_block is not None:
+            hi_block["input_mode_requested"] = input_mode_requested
+            extra["host_input"] = hi_block
         if pbt is not None:
             # The pbt counter family: whether a sweep actually ran
             # in-device (mode + host_dispatches) is a property of the
@@ -1062,6 +1106,9 @@ def run_vectorized(
                for k, v in (extra.get("checkpoint") or {}).items()},
             **{f"compile/{k}": v
                for k, v in (extra.get("compile") or {}).items()},
+            **{f"host_input/{k}": v
+               for k, v in (extra.get("host_input") or {}).items()
+               if isinstance(v, (int, float)) and not isinstance(v, bool)},
             **{f"pbt/{k}": v
                for k, v in (extra.get("pbt") or {}).items()
                if isinstance(v, (int, float)) and not isinstance(v, bool)},

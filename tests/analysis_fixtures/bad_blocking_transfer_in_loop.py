@@ -1,0 +1,38 @@
+# dmlint-scope: hot-input-loop
+"""Historical bug pattern (ISSUE 10): per-batch host->device transfers
+inside an epoch loop.
+
+Every iteration pays a BLOCKING ``device_put``/``jnp.asarray`` the device
+must wait on — zero host/device overlap, the exact duty-cycle leak the
+streaming prefetch ring (``data/pipeline.py``) exists to close (the
+reference stack copied every batch to the device at ``:327``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def per_batch_epoch(step, params, batches):
+    for bx, by in batches:
+        xb = jax.device_put(bx)  # EXPECT: blocking-transfer-in-loop
+        yb = jnp.asarray(by)  # EXPECT: blocking-transfer-in-loop
+        params = step(params, xb, yb)
+    return params
+
+
+def polling_loop(step, params, source):
+    while True:
+        batch = source.next()
+        if batch is None:
+            break
+        xb = jax.numpy.asarray(batch)  # EXPECT: blocking-transfer-in-loop
+        params = step(params, xb)
+    return params
+
+
+def staged_per_epoch(step, params, x_np, epochs):
+    for _epoch in range(epochs):
+        perm = np.argsort(x_np[:, 0])
+        xb = jnp.array(x_np[perm])  # EXPECT: blocking-transfer-in-loop
+        params = step(params, xb)
+    return params

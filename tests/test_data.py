@@ -99,3 +99,99 @@ def test_synthetic_generators_shapes():
     assert gtrain.x.shape[1:] == (96, 6)
     assert gtrain.y.shape[1:] == (1,)
     assert np.isfinite(gtrain.x).all() and np.isfinite(gtrain.y).all()
+
+
+def test_tiny_dataset_batches_pad_to_static_shape():
+    """The tiny-dataset escape hatch pads to batch_size instead of
+    emitting a ragged batch (ISSUE 10 satellite): the static-shape jit
+    contract holds for ANY dataset size — one trace serves them all."""
+    import jax
+
+    traces = []
+
+    def step(x, y):
+        traces.append(1)  # python body runs once per TRACE, not per call
+        return (x[:, :1] * y).sum()
+
+    jit_step = jax.jit(step)
+    for n in (5, 7, 31):  # three different tiny sizes, one compiled shape
+        ds = Dataset(
+            np.arange(n * 4, dtype=np.float32).reshape(n, 4),
+            np.arange(n, dtype=np.float32)[:, None],
+        )
+        for epoch in range(2):
+            got = list(ds.batches(32, seed_parts=("e", epoch)))
+            assert len(got) == 1
+            (bx, by) = got[0]
+            assert bx.shape == (32, 4) and by.shape == (32, 1)
+            jit_step(bx, by)
+    assert len(traces) == 1  # the old ragged yield compiled once PER SIZE
+
+
+def test_batches_with_mask_weights_out_padding():
+    ds = Dataset(
+        np.ones((5, 4), np.float32), np.ones((5, 1), np.float32)
+    )
+    ((bx, by, mask),) = ds.batches(32, with_mask=True, seed_parts=("m", 0))
+    assert bx.shape == (32, 4) and mask.shape == (32,)
+    assert mask.sum() == 5 and set(np.unique(mask)) <= {0.0, 1.0}
+    # padded rows are zeros, real rows survive
+    assert np.all(bx[mask == 0.0] == 0.0)
+    # drop_remainder=False + mask: the ragged TAIL pads too
+    ds2 = Dataset(
+        np.ones((40, 4), np.float32), np.ones((40, 1), np.float32)
+    )
+    batches = list(ds2.batches(32, drop_remainder=False, with_mask=True,
+                               seed_parts=("m", 1)))
+    assert [b[0].shape for b in batches] == [(32, 4), (32, 4)]
+    assert batches[-1][2].sum() == 8
+    # legacy contract without a mask: ragged tail kept (padding without a
+    # mask would silently dilute a loss)
+    legacy = list(ds2.batches(32, drop_remainder=False, seed_parts=("m", 1)))
+    assert legacy[-1][0].shape == (8, 4)
+
+
+def test_windowed_dataset_disk_cache(tmp_path, monkeypatch):
+    """Per-trial dataset rebuild dedup (ISSUE 10 satellite): the second
+    build of the same source hits the on-disk windowed arrays via
+    np.load(mmap_mode='r'); any parameter change misses honestly."""
+    import pandas as pd
+
+    from distributed_machine_learning_tpu.data import pipeline as hostpipe
+
+    n = 400
+    fdf = pd.DataFrame({
+        "f1": np.arange(n, dtype=np.float32),
+        "f2": np.sin(np.arange(n, dtype=np.float32)),
+    })
+    ldf = pd.DataFrame(
+        {"Historic Glucose mg/dL": np.arange(n, dtype=np.float32)}
+    )
+    cache = str(tmp_path / "dsc")
+    counters = hostpipe.get_host_input_counters()
+    base = counters.snapshot()
+    t1, v1 = make_regression_dataset(
+        fdf, ldf, interval=50, stride=25, standardize=True, cache_dir=cache
+    )
+    d1 = counters.delta_since(base)
+    assert d1["dataset_cache_misses"] == 1 and d1["dataset_cache_hits"] == 0
+    t2, v2 = make_regression_dataset(
+        fdf, ldf, interval=50, stride=25, standardize=True, cache_dir=cache
+    )
+    d2 = counters.delta_since(base)
+    assert d2["dataset_cache_hits"] == 1 and d2["dataset_cache_misses"] == 1
+    assert d2["dataset_cache_bytes"] > 0
+    np.testing.assert_array_equal(t1.x, t2.x)
+    np.testing.assert_array_equal(v1.y, v2.y)
+    # a changed parameter is a different product -> miss
+    make_regression_dataset(
+        fdf, ldf, interval=50, stride=50, standardize=True, cache_dir=cache
+    )
+    d3 = counters.delta_since(base)
+    assert d3["dataset_cache_misses"] == 2
+    # the env var is the process-wide switch (with_parameters-free paths)
+    monkeypatch.setenv("DML_DATASET_CACHE_DIR", cache)
+    make_regression_dataset(fdf, ldf, interval=50, stride=25,
+                            standardize=True)
+    d4 = counters.delta_since(base)
+    assert d4["dataset_cache_hits"] == 2
