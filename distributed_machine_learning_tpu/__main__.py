@@ -212,10 +212,22 @@ def _lint(rest) -> None:
                         "unsuppressed finding (burn-down workflow)")
     p.add_argument("--json", action="store_true",
                    help="machine-readable findings (includes suppressed/"
-                        "baselined, marked)")
+                        "baselined, marked); alias for --format=json")
+    p.add_argument("--format", default=None,
+                   choices=("text", "json", "sarif"),
+                   help="report format (default: text; sarif = SARIF "
+                        "2.1.0 for CI annotators)")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="REF",
+                   help="lint only files touched vs a git ref (default "
+                        "HEAD) — the fast pre-commit path; the whole "
+                        "tree is still parsed so cross-file rules see "
+                        "the full call graph, and exit codes match the "
+                        "full run")
     p.add_argument("-v", "--verbose", action="store_true",
                    help="also show suppressed and baselined findings")
     args = p.parse_args(rest)
+    fmt = args.format or ("json" if args.json else "text")
 
     # The linter is stdlib-only on purpose: importing the analysis package
     # pulls in no jax (engine.py docstring) — `dml-tpu lint` stays usable
@@ -231,7 +243,17 @@ def _lint(rest) -> None:
     baseline = args.baseline or analysis.DEFAULT_BASELINE
     if baseline == "none":
         baseline = None
-    result = analysis.lint_paths(paths, rules=rules, baseline_path=baseline)
+    only_files = None
+    if args.changed is not None:
+        only_files = _changed_python_files(args.changed, paths)
+        if only_files is None:
+            raise SystemExit(2)  # not a git checkout / bad ref
+        if not only_files:
+            print(f"dmlint: no .py files changed vs {args.changed}")
+            raise SystemExit(0)
+    result = analysis.lint_paths(
+        paths, rules=rules, baseline_path=baseline, only_files=only_files
+    )
     if args.update_baseline:
         if baseline is None:
             print("error: --update-baseline needs a baseline path",
@@ -241,16 +263,60 @@ def _lint(rest) -> None:
         print(f"baseline rewritten: {baseline} "
               f"({len(result.unsuppressed())} entries)")
         return
-    if args.json:
+    if fmt == "json":
         print(json.dumps({
             "files_checked": result.files_checked,
             "findings": [f.to_json() for f in result.findings],
             "errors": result.errors,
             "ok": result.ok,
         }, indent=2))
+    elif fmt == "sarif":
+        print(json.dumps(analysis.render_sarif(result, rules), indent=2))
     else:
         print(analysis.render(result, verbose=args.verbose))
     raise SystemExit(0 if result.ok else 1)
+
+
+def _changed_python_files(ref, paths):
+    """Absolute paths of ``.py`` files changed vs ``ref`` (committed diff
+    + working tree + untracked), or None when git/ref is unusable.  The
+    repo is found from the first lint path, so ``dml-tpu lint pkg/
+    --changed`` works from anywhere inside the checkout."""
+    import os
+    import subprocess
+
+    anchor = os.path.abspath(paths[0])
+    cwd = anchor if os.path.isdir(anchor) else os.path.dirname(anchor)
+    try:
+        root = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            cwd=cwd, capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        print(f"error: --changed needs git: {exc}", file=sys.stderr)
+        return None
+    if root.returncode != 0:
+        print(f"error: --changed outside a git checkout: "
+              f"{root.stderr.strip()}", file=sys.stderr)
+        return None
+    top = root.stdout.strip()
+    out = []
+    for cmd in (
+        ["git", "diff", "--name-only", ref, "--"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        proc = subprocess.run(
+            cmd, cwd=top, capture_output=True, text=True, timeout=60,
+        )
+        if proc.returncode != 0:
+            print(f"error: {' '.join(cmd)}: {proc.stderr.strip()}",
+                  file=sys.stderr)
+            return None
+        out.extend(
+            line.strip() for line in proc.stdout.splitlines()
+            if line.strip().endswith(".py")
+        )
+    return sorted({os.path.join(top, rel) for rel in out})
 
 
 def _export_bundle(rest) -> None:
@@ -400,6 +466,7 @@ def main(argv=None) -> None:
         "  worker         host trial supervisor (see 'worker --help')\n"
         "  lint           dmlint static analysis over the package (or given\n"
         "                 paths); exit 1 on any unsuppressed finding\n"
+        "                 (--changed for pre-commit, --format=sarif for CI)\n"
         "  info           jax backend/device summary for this process\n"
         "  probe          bounded accelerator health check (child process)\n"
         "  analyze        <experiment_dir>: best config + trial table of a\n"

@@ -7,8 +7,15 @@ invariant violation that could have been caught mechanically (ISSUE 6):
   engine encoding the repo's JAX/concurrency invariants — donation
   aliasing, unlocked dispatch, chaos determinism, wall-clock deadlines,
   pickle-free checkpoints, import-time tracing, swallowed thread
-  exceptions.  Run it with ``dml-tpu lint`` (exits non-zero on any
-  unsuppressed finding) or via :func:`lint_paths`.
+  exceptions.  Since v2 (ISSUE 11) the engine is whole-project: every
+  file parses once into a shared context, and cross-file rules reason
+  over a symbol table + call graph (:mod:`callgraph`) and an
+  intraprocedural CFG/reaching-definitions pass (:mod:`dataflow`) —
+  use-after-donation, the transitive closure of chaos determinism, and
+  a static Eraser-style lockset check seeded from the ``named_lock``
+  roles.  Run it with ``dml-tpu lint`` (exits non-zero on any
+  unsuppressed finding; ``--changed`` for pre-commit, ``--format=sarif``
+  for CI annotators) or via :func:`lint_paths`.
 * lock-order recording (:mod:`locks`): ``named_lock()``-created locks
   record per-thread acquisition edges; a cycle in the role graph is a
   deadlock precondition detectable from single-threaded tests.
@@ -24,9 +31,12 @@ docs/static-analysis.md.
 from distributed_machine_learning_tpu.analysis.engine import (  # noqa: F401
     DEFAULT_BASELINE,
     LintResult,
+    clear_context_cache,
     iter_python_files,
     lint_paths,
+    parse_count,
     render,
+    render_sarif,
 )
 from distributed_machine_learning_tpu.analysis.findings import (  # noqa: F401
     Finding,

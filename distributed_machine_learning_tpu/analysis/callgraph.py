@@ -1,0 +1,423 @@
+"""Project symbol table + call graph: the whole-package view dmlint v2
+rules reason over.
+
+The per-file rules are structurally blind across a function call — PR 4's
+donation-alias corruption and PR 7's fencing race both crossed file
+boundaries before they bit.  This module gives the cross-file rules the
+three things they need, built ONCE per lint run from the engine's shared
+parse cache (every file is parsed exactly once, then every rule reads the
+same trees):
+
+* a **symbol table**: every module / class / function / method in the
+  linted tree, keyed by dotted qualname (``pkg.mod.Class.method``);
+* **import resolution** within the linted tree: ``import a.b as c``,
+  ``from .mod import f as g``, relative imports — resolved by
+  longest-prefix match against known module names (``from x import *``
+  marks the module unresolvable rather than guessing);
+* **call edges** with decorator/wrapper awareness: direct calls,
+  ``self.method()`` (through same-file base classes), calls through
+  import aliases, plus *indirect* edges through the wrappers this
+  codebase actually uses — ``jax.jit(f)``, ``functools.partial(f, ...)``,
+  and ``threading.Thread(target=f)`` / ``Timer(..., f)`` all put ``f``
+  on the caller's call path.
+
+Resolution is deliberately CONSERVATIVE: an attribute call on an object
+of unknown type, a ``getattr``-computed callee, or anything behind
+``exec``/``eval`` resolves to nothing (and the containing function is
+marked ``has_dynamic_calls``).  Under-approximating the graph means a
+cross-file rule can miss a path, never that it invents one — zero false
+positives is the property the gate lives on (docs/static-analysis.md,
+"How the call graph resolves names").
+
+Stdlib-only, imports no jax (analysis/__init__.py contract).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_WRAPPER_CALLS = {
+    # wrapper callee (last dotted segment kept flexible by full match)
+    "jax.jit", "jit", "pjit", "jax.pjit", "jax.pmap", "jax.vmap", "vmap",
+    "functools.partial", "partial", "nn.remat", "jax.checkpoint",
+}
+_THREAD_CTORS = {"Thread", "Timer"}
+_DYNAMIC_CALLEES = {"getattr", "exec", "eval", "__import__"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# --------------------------------------------------------------------------
+# info records
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class CallSite:
+    """One call expression inside a function body."""
+
+    raw: str                      # dotted callee text as written
+    node: ast.Call
+    target: Optional[str] = None  # resolved project qualname (or None)
+    via: str = "direct"           # "direct" | "wrapper" | "thread"
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str
+    module: str
+    name: str
+    node: ast.AST                 # FunctionDef / AsyncFunctionDef
+    ctx: object                   # engine.FileContext (duck-typed)
+    cls: Optional[str] = None     # owning class name, for methods
+    decorators: List[str] = field(default_factory=list)  # dotted names
+    decorator_nodes: List[ast.AST] = field(default_factory=list)
+    params: List[str] = field(default_factory=list)
+    calls: List[CallSite] = field(default_factory=list)
+    has_dynamic_calls: bool = False
+
+    @property
+    def is_method(self) -> bool:
+        return self.cls is not None
+
+
+@dataclass
+class ClassInfo:
+    qualname: str
+    module: str
+    name: str
+    node: ast.ClassDef
+    ctx: object
+    bases: List[str] = field(default_factory=list)  # dotted, as written
+    methods: Dict[str, FunctionInfo] = field(default_factory=dict)
+
+
+@dataclass
+class ModuleInfo:
+    name: str
+    ctx: object
+    imports: Dict[str, str] = field(default_factory=dict)  # alias -> fq
+    star_imports: bool = False
+    functions: Dict[str, FunctionInfo] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+
+
+# --------------------------------------------------------------------------
+# module naming
+# --------------------------------------------------------------------------
+
+
+def module_name_for(path: str) -> str:
+    """Dotted module name for a file: walk up while ``__init__.py``
+    marks the parent as a package; files outside any package are their
+    bare stem (fixtures, tmp files, scripts)."""
+    path = os.path.abspath(path)
+    d, base = os.path.split(path)
+    stem = base[:-3] if base.endswith(".py") else base
+    parts = [] if stem == "__init__" else [stem]
+    while os.path.exists(os.path.join(d, "__init__.py")):
+        d, pkg = os.path.split(d)
+        parts.insert(0, pkg)
+        if not pkg:
+            break
+    return ".".join(parts) or stem
+
+
+# --------------------------------------------------------------------------
+# the project
+# --------------------------------------------------------------------------
+
+
+class Project:
+    """Symbol table + call graph over a set of parsed FileContexts."""
+
+    def __init__(self, contexts: Sequence[object]):
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.contexts = list(contexts)
+        for ctx in contexts:
+            self._index_module(ctx)
+        for mod in self.modules.values():
+            self._resolve_calls(mod)
+
+    # -- indexing ------------------------------------------------------------
+
+    def _index_module(self, ctx) -> None:
+        name = module_name_for(ctx.path)
+        mod = ModuleInfo(name=name, ctx=ctx)
+        if name in self.modules:
+            # duplicate stem outside packages (two tmp files named x.py):
+            # keep both resolvable by suffixing — lookups by qualname stay
+            # unambiguous, cross-module resolution simply won't match the
+            # duplicate, which is the conservative outcome.
+            name = f"{name}@{len(self.modules)}"
+            mod.name = name
+        self.modules[name] = mod
+        self._collect_imports(mod, ctx.tree)
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = self._function_info(mod, node, cls=None)
+                mod.functions[node.name] = info
+                self.functions[info.qualname] = info
+            elif isinstance(node, ast.ClassDef):
+                cinfo = ClassInfo(
+                    qualname=f"{mod.name}.{node.name}",
+                    module=mod.name, name=node.name, node=node, ctx=ctx,
+                    bases=[_dotted(b) or "" for b in node.bases],
+                )
+                for sub in node.body:
+                    if isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        minfo = self._function_info(
+                            mod, sub, cls=node.name
+                        )
+                        cinfo.methods[sub.name] = minfo
+                        self.functions[minfo.qualname] = minfo
+                mod.classes[node.name] = cinfo
+                self.classes[cinfo.qualname] = cinfo
+
+    def _collect_imports(self, mod: ModuleInfo, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        mod.imports[alias.asname] = alias.name
+                    else:
+                        # ``import a.b.c`` binds ``a``; dotted uses are
+                        # resolved by prefix match against module names.
+                        root = alias.name.split(".", 1)[0]
+                        mod.imports.setdefault(root, root)
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:  # relative: resolve against this module
+                    pkg_parts = mod.name.split(".")
+                    # level 1 = current package (drop the module segment)
+                    keep = len(pkg_parts) - node.level
+                    if keep < 0:
+                        continue  # beyond the tree root: unresolvable
+                    prefix = ".".join(pkg_parts[:keep])
+                    base = f"{prefix}.{base}".strip(".") if base else prefix
+                for alias in node.names:
+                    if alias.name == "*":
+                        mod.star_imports = True
+                        continue
+                    local = alias.asname or alias.name
+                    mod.imports[local] = (
+                        f"{base}.{alias.name}" if base else alias.name
+                    )
+
+    def _function_info(self, mod: ModuleInfo, node, cls: Optional[str]):
+        qual = (
+            f"{mod.name}.{cls}.{node.name}" if cls
+            else f"{mod.name}.{node.name}"
+        )
+        decorators: List[str] = []
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            decorators.append(_dotted(target) or "<computed>")
+        params = [
+            a.arg for a in node.args.posonlyargs + node.args.args
+        ]
+        return FunctionInfo(
+            qualname=qual, module=mod.name, name=node.name, node=node,
+            ctx=mod.ctx, cls=cls, decorators=decorators,
+            decorator_nodes=list(node.decorator_list), params=params,
+        )
+
+    # -- resolution ----------------------------------------------------------
+
+    def resolve_name(
+        self, mod: ModuleInfo, dotted: str, cls: Optional[str] = None
+    ) -> Optional[str]:
+        """Resolve a dotted name used in ``mod`` (optionally inside class
+        ``cls``) to a project function/class qualname, or None."""
+        if not dotted:
+            return None
+        head, _, rest = dotted.partition(".")
+        # self./cls. -> the enclosing class's method (incl. same-file bases)
+        if head in ("self", "cls") and cls is not None and rest:
+            return self._resolve_method(mod, cls, rest)
+        # local symbol in this module
+        if not rest:
+            if dotted in mod.functions:
+                return mod.functions[dotted].qualname
+            if dotted in mod.classes:
+                return self.classes[
+                    mod.classes[dotted].qualname
+                ].qualname
+        # imported alias
+        if head in mod.imports:
+            dotted = mod.imports[head] + (("." + rest) if rest else "")
+        return self._lookup_qualname(dotted)
+
+    def _resolve_method(
+        self, mod: ModuleInfo, cls: str, rest: str
+    ) -> Optional[str]:
+        """``self.a`` / ``self.a.b`` — only single-attribute method calls
+        resolve; walk same-project base classes in declaration order."""
+        if "." in rest:
+            return None  # self.obj.method(): obj's type is unknown
+        seen: Set[str] = set()
+        stack = [f"{mod.name}.{cls}"]
+        while stack:
+            cq = stack.pop(0)
+            if cq in seen:
+                continue
+            seen.add(cq)
+            cinfo = self.classes.get(cq)
+            if cinfo is None:
+                continue
+            if rest in cinfo.methods:
+                return cinfo.methods[rest].qualname
+            for base in cinfo.bases:
+                base_q = self.resolve_name(
+                    self.modules[cinfo.module], base
+                )
+                if base_q:
+                    stack.append(base_q)
+        return None
+
+    def _lookup_qualname(self, dotted: str) -> Optional[str]:
+        """Match ``a.b.c.f`` against known modules by LONGEST prefix; the
+        remainder must be a function, class, or Class.method."""
+        if dotted in self.functions:
+            return dotted
+        parts = dotted.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod_name = ".".join(parts[:cut])
+            mod = self.modules.get(mod_name)
+            if mod is None:
+                continue
+            rest = parts[cut:]
+            if len(rest) == 1:
+                if rest[0] in mod.functions:
+                    return mod.functions[rest[0]].qualname
+                if rest[0] in mod.classes:
+                    return mod.classes[rest[0]].qualname
+            elif len(rest) == 2 and rest[0] in mod.classes:
+                cinfo = mod.classes[rest[0]]
+                if rest[1] in cinfo.methods:
+                    return cinfo.methods[rest[1]].qualname
+            # an __init__ re-export (from .mod import f) would need the
+            # alias table of THAT module:
+            if rest and rest[0] in mod.imports:
+                chained = mod.imports[rest[0]] + "".join(
+                    "." + r for r in rest[1:]
+                )
+                if chained != dotted:
+                    return self._lookup_qualname(chained)
+            return None
+        return None
+
+    def _resolve_calls(self, mod: ModuleInfo) -> None:
+        for owner in list(mod.functions.values()) + [
+            m for c in mod.classes.values() for m in c.methods.values()
+        ]:
+            self._collect_calls(mod, owner)
+
+    def _collect_calls(self, mod: ModuleInfo, fn: FunctionInfo) -> None:
+        cls = fn.cls
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            raw = _dotted(node.func) or ""
+            last = raw.rsplit(".", 1)[-1]
+            if last in _DYNAMIC_CALLEES:
+                fn.has_dynamic_calls = True
+            site = CallSite(raw=raw, node=node)
+            site.target = (
+                self.resolve_name(mod, raw, cls) if raw else None
+            )
+            # a call target that is a CLASS is its __init__/constructor:
+            # keep the class qualname (rules can look it up), but only
+            # function qualnames participate in reachability.
+            fn.calls.append(site)
+            # wrapper awareness: jit(f) / partial(f, ...) / vmap(f)
+            if raw in _WRAPPER_CALLS or last in ("jit", "pjit", "pmap",
+                                                 "vmap", "partial"):
+                for arg in node.args[:1]:
+                    inner = _dotted(arg)
+                    if inner:
+                        t = self.resolve_name(mod, inner, cls)
+                        if t:
+                            fn.calls.append(CallSite(
+                                raw=inner, node=node, target=t,
+                                via="wrapper",
+                            ))
+            # thread targets: Thread(target=f), Timer(interval, f)
+            if last in _THREAD_CTORS:
+                cands: List[ast.AST] = [
+                    kw.value for kw in node.keywords
+                    if kw.arg in ("target", "function")
+                ]
+                if last == "Timer" and len(node.args) >= 2:
+                    cands.append(node.args[1])
+                for cand in cands:
+                    inner = _dotted(cand)
+                    if inner:
+                        t = self.resolve_name(mod, inner, cls)
+                        if t:
+                            fn.calls.append(CallSite(
+                                raw=inner, node=node, target=t,
+                                via="thread",
+                            ))
+
+    # -- graph queries -------------------------------------------------------
+
+    def callees(self, qualname: str) -> List[str]:
+        fn = self.functions.get(qualname)
+        if fn is None:
+            return []
+        out: List[str] = []
+        for site in fn.calls:
+            if site.target is None:
+                continue
+            if site.target in self.functions:
+                out.append(site.target)
+            elif site.target in self.classes:
+                init = self.classes[site.target].methods.get("__init__")
+                if init is not None:
+                    out.append(init.qualname)
+        return out
+
+    def reachable(
+        self, roots: Iterable[str]
+    ) -> Dict[str, Tuple[str, ...]]:
+        """Transitive closure over call edges.  Returns
+        ``{qualname: path}`` where path is the call chain from a root
+        (roots map to a 1-tuple of themselves).  BFS — the recorded path
+        is a shortest chain, which is what a finding message wants."""
+        out: Dict[str, Tuple[str, ...]] = {}
+        queue: List[str] = []
+        for r in roots:
+            if r in self.functions and r not in out:
+                out[r] = (r,)
+                queue.append(r)
+        while queue:
+            cur = queue.pop(0)
+            for nxt in self.callees(cur):
+                if nxt in out:
+                    continue
+                out[nxt] = out[cur] + (nxt,)
+                queue.append(nxt)
+        return out
+
+    def module_of(self, ctx) -> Optional[ModuleInfo]:
+        for mod in self.modules.values():
+            if mod.ctx is ctx:
+                return mod
+        return None

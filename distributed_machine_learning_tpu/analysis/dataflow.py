@@ -1,0 +1,471 @@
+"""Intraprocedural def-use dataflow: a function's CFG + reaching defs.
+
+The per-file rules (analysis/rules.py) pattern-match single statements;
+the cross-file rules (DML012+) need ORDER — "is this name read after that
+call, on any path, before being reassigned?" is a property of the control
+flow graph, not of any one line.  This module builds that graph at
+statement granularity and answers the two queries the project rules need:
+
+* :func:`reaching_definitions` — the classic forward may-analysis: which
+  assignments of each name can reach each statement's entry.  Used by the
+  unit tests as the ground truth the CFG is judged against, and by
+  :func:`uses_of_definition` (def-use chains).
+* :func:`reads_after` — from a given statement, every ``ast.Name`` load
+  of a name reachable WITHOUT passing a kill (reassignment).  This is the
+  use-after-donation query: the "definition" being tracked is the moment
+  a buffer was donated, and any surviving read is a bug.  Loop back edges
+  count — a donation inside a ``for`` body whose argument is not rebound
+  is read again by the call itself on the next iteration.
+
+Everything here is stdlib-only and CONSERVATIVE on dynamic features
+(engine.py docstring): a function using ``exec``/``eval``, ``global``/
+``nonlocal`` on the tracked name, or star imports makes the analysis
+refuse (:func:`bailout_reason`) rather than guess — a lint that guesses
+manufactures false positives, and zero-FP is the property that keeps the
+gate credible (docs/static-analysis.md).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+# --------------------------------------------------------------------------
+# name extraction
+# --------------------------------------------------------------------------
+
+
+def _target_names(target: ast.AST) -> Set[str]:
+    """Plain names bound by one assignment target (tuple/list unpacked;
+    starred included; attribute/subscript targets bind no NAME)."""
+    out: Set[str] = set()
+    if isinstance(target, ast.Name):
+        out.add(target.id)
+    elif isinstance(target, ast.Starred):
+        out |= _target_names(target.value)
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            out |= _target_names(elt)
+    return out
+
+
+def assigned_names(stmt: ast.stmt) -> Set[str]:
+    """Names this statement (re)binds in the enclosing function scope —
+    the KILL set.  Compound statements report only their own binding
+    (e.g. a ``for`` target, a ``with ... as``), never their bodies'."""
+    out: Set[str] = set()
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            out |= _target_names(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        out |= _target_names(stmt.target)
+    elif isinstance(stmt, ast.For):
+        out |= _target_names(stmt.target)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                out |= _target_names(item.optional_vars)
+    elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+        for alias in stmt.names:
+            if alias.name == "*":
+                continue
+            out.add(alias.asname or alias.name.split(".", 1)[0])
+    elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+        out.add(stmt.name)
+    elif isinstance(stmt, ast.NamedExpr):  # pragma: no cover - not a stmt
+        out |= _target_names(stmt.target)
+    # walrus targets anywhere in the statement's expressions also bind
+    for node in _own_expressions(stmt):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.NamedExpr):
+                out |= _target_names(sub.target)
+    return out
+
+
+def _own_expressions(stmt: ast.stmt) -> List[ast.AST]:
+    """The expression parts evaluated AT this statement — headers only for
+    compound statements (their bodies are separate CFG nodes)."""
+    if isinstance(stmt, ast.Assign):
+        return [stmt.value] + list(stmt.targets)
+    if isinstance(stmt, ast.AugAssign):
+        return [stmt.value, stmt.target]
+    if isinstance(stmt, ast.AnnAssign):
+        return [v for v in (stmt.value, stmt.target) if v is not None]
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, ast.For):
+        return [stmt.iter, stmt.target]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        out: List[ast.AST] = []
+        for item in stmt.items:
+            out.append(item.context_expr)
+            if item.optional_vars is not None:
+                out.append(item.optional_vars)
+        return out
+    if isinstance(stmt, ast.Return):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, ast.Raise):
+        return [v for v in (stmt.exc, stmt.cause) if v is not None]
+    if isinstance(stmt, ast.Expr):
+        return [stmt.value]
+    if isinstance(stmt, ast.Assert):
+        return [stmt.test] + ([stmt.msg] if stmt.msg is not None else [])
+    if isinstance(stmt, ast.Delete):
+        return list(stmt.targets)
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        # decorators + defaults run at def time; the body does not
+        return list(stmt.decorator_list) + list(stmt.args.defaults) + [
+            d for d in (stmt.args.kw_defaults or []) if d is not None
+        ]
+    if isinstance(stmt, ast.ClassDef):
+        return list(stmt.decorator_list) + list(stmt.bases) + [
+            kw.value for kw in stmt.keywords
+        ]
+    return []
+
+
+def used_names(stmt: ast.stmt) -> List[ast.Name]:
+    """``ast.Name`` LOADS evaluated at this statement (headers only for
+    compound statements; nested function/lambda bodies excluded — their
+    reads happen at some later call, which the intraprocedural pass
+    cannot place, so charging them here would be a guess)."""
+    out: List[ast.Name] = []
+    for expr in _own_expressions(stmt):
+        out.extend(_loads_in(expr))
+    return out
+
+
+def _loads_in(node: ast.AST) -> Iterator[ast.Name]:
+    stack: List[ast.AST] = [node]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            continue  # deferred bodies
+        if isinstance(cur, ast.Name) and isinstance(cur.ctx, ast.Load):
+            yield cur
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+# --------------------------------------------------------------------------
+# CFG
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class CFGNode:
+    index: int
+    stmt: ast.stmt
+    succs: Set[int] = field(default_factory=set)
+    preds: Set[int] = field(default_factory=set)
+
+
+@dataclass
+class CFG:
+    """Statement-granularity control flow graph of ONE function body.
+
+    ``nodes[i].stmt`` is a simple statement or a compound statement's
+    HEADER (its body statements are their own nodes).  ``entry`` fans
+    into the first statement(s); ``EXIT`` (-1) collects returns/falloff.
+    """
+
+    nodes: List[CFGNode]
+    entry: Set[int]
+    fn: ast.AST
+
+    EXIT = -1
+
+    def node_for(self, stmt: ast.stmt) -> Optional[CFGNode]:
+        for n in self.nodes:
+            if n.stmt is stmt:
+                return n
+        return None
+
+
+class _Builder:
+    def __init__(self):
+        self.nodes: List[CFGNode] = []
+
+    def add(self, stmt: ast.stmt) -> int:
+        n = CFGNode(index=len(self.nodes), stmt=stmt)
+        self.nodes.append(n)
+        return n.index
+
+    def edge(self, a: int, b: int) -> None:
+        if a == CFG.EXIT:
+            return
+        self.nodes[a].succs.add(b)
+        if b != CFG.EXIT:
+            self.nodes[b].preds.add(a)
+
+    def block(
+        self,
+        stmts: Sequence[ast.stmt],
+        loop_ctx: Optional[Tuple[Set[int], Set[int]]],
+    ) -> Tuple[Set[int], Set[int]]:
+        """Wire a statement list; returns (entry set, exit set) — the exit
+        set is every node whose successor is "whatever follows the block".
+        ``loop_ctx`` is (break-collector, continue-collector) of the
+        innermost enclosing loop."""
+        entries: Set[int] = set()
+        prev_exits: Set[int] = set()
+        first = True
+        for stmt in stmts:
+            s_entry, s_exit = self.stmt(stmt, loop_ctx)
+            if first:
+                entries = s_entry
+                first = False
+            else:
+                for p in prev_exits:
+                    for e in s_entry:
+                        self.edge(p, e)
+            prev_exits = s_exit
+            if not s_exit:
+                # terminal statement (return/raise/break/continue):
+                # statements below it in THIS block are unreachable, and
+                # unreachable code cannot read anything — stop wiring.
+                break
+        return entries, prev_exits
+
+    def stmt(
+        self,
+        stmt: ast.stmt,
+        loop_ctx: Optional[Tuple[Set[int], Set[int]]],
+    ) -> Tuple[Set[int], Set[int]]:
+        idx = self.add(stmt)
+        if isinstance(stmt, ast.If):
+            body_in, body_out = self.block(stmt.body, loop_ctx)
+            for e in body_in:
+                self.edge(idx, e)
+            exits = set(body_out)
+            if stmt.orelse:
+                else_in, else_out = self.block(stmt.orelse, loop_ctx)
+                for e in else_in:
+                    self.edge(idx, e)
+                exits |= else_out
+            else:
+                exits.add(idx)  # test-false falls through
+            return {idx}, exits
+        if isinstance(stmt, (ast.While, ast.For)):
+            breaks: Set[int] = set()
+            continues: Set[int] = set()
+            body_in, body_out = self.block(stmt.body, (breaks, continues))
+            for e in body_in:
+                self.edge(idx, e)
+            for b in body_out | continues:  # back edge
+                self.edge(b, idx)
+            exits: Set[int] = {idx} | breaks  # loop-done falls through
+            if stmt.orelse:
+                else_in, else_out = self.block(stmt.orelse, loop_ctx)
+                for e in else_in:
+                    self.edge(idx, e)
+                exits = breaks | else_out
+            return {idx}, exits
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            body_in, body_out = self.block(stmt.body, loop_ctx)
+            for e in body_in:
+                self.edge(idx, e)
+            return {idx}, body_out or {idx}
+        if isinstance(stmt, ast.Try):
+            body_in, body_out = self.block(stmt.body, loop_ctx)
+            for e in body_in:
+                self.edge(idx, e)
+            body_nodes = self._nodes_of(stmt.body)
+            exits: Set[int] = set(body_out)
+            for handler in stmt.handlers:
+                h_in, h_out = self.block(handler.body, loop_ctx)
+                # conservatively: any statement in the try body may raise
+                # into any handler (may-analysis: more edges, never fewer)
+                for src in body_nodes | {idx}:
+                    for e in h_in:
+                        self.edge(src, e)
+                exits |= h_out
+            if stmt.orelse:
+                else_in, else_out = self.block(stmt.orelse, loop_ctx)
+                for p in body_out:
+                    for e in else_in:
+                        self.edge(p, e)
+                exits = (exits - body_out) | else_out
+            if stmt.finalbody:
+                fin_in, fin_out = self.block(stmt.finalbody, loop_ctx)
+                for p in exits:
+                    for e in fin_in:
+                        self.edge(p, e)
+                exits = fin_out
+            return {idx}, exits
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            self.edge(idx, CFG.EXIT)
+            return {idx}, set()
+        if isinstance(stmt, ast.Break):
+            if loop_ctx is not None:
+                loop_ctx[0].add(idx)
+            return {idx}, set()
+        if isinstance(stmt, ast.Continue):
+            if loop_ctx is not None:
+                loop_ctx[1].add(idx)
+            return {idx}, set()
+        # simple statement (incl. nested def/class headers)
+        return {idx}, {idx}
+
+
+    def _nodes_of(self, stmts: Sequence[ast.stmt]) -> Set[int]:
+        """Indices of every node built from ``stmts`` (recursively)."""
+        wanted = set()
+        stack = list(stmts)
+        while stack:
+            s = stack.pop()
+            wanted.add(id(s))
+            for _, value in ast.iter_fields(s):
+                if isinstance(value, list):
+                    stack.extend(
+                        v for v in value if isinstance(v, ast.stmt)
+                    )
+                    stack.extend(
+                        h for v in value if isinstance(v, ast.excepthandler)
+                        for h in v.body
+                    )
+        return {n.index for n in self.nodes if id(n.stmt) in wanted}
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """CFG of a FunctionDef/AsyncFunctionDef body (or any stmt list owner:
+    a Module works too — used by tests)."""
+    builder = _Builder()
+    body = fn.body if hasattr(fn, "body") else []
+    entry, exits = builder.block(body, None)
+    for p in exits:
+        builder.edge(p, CFG.EXIT)
+    return CFG(nodes=builder.nodes, entry=entry, fn=fn)
+
+
+# --------------------------------------------------------------------------
+# reaching definitions
+# --------------------------------------------------------------------------
+
+
+def reaching_definitions(
+    cfg: CFG,
+) -> Dict[int, Set[Tuple[str, int]]]:
+    """Forward may-analysis: for each node index, the set of
+    ``(name, defining-node-index)`` pairs that can reach its ENTRY.
+    Function parameters reach everything as ``(name, -2)``."""
+    PARAM = -2
+    params: Set[Tuple[str, int]] = set()
+    fn = cfg.fn
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        a = fn.args
+        for arg in (
+            list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+            + ([a.vararg] if a.vararg else [])
+            + ([a.kwarg] if a.kwarg else [])
+        ):
+            params.add((arg.arg, PARAM))
+    gen: Dict[int, Set[Tuple[str, int]]] = {}
+    kill: Dict[int, Set[str]] = {}
+    for n in cfg.nodes:
+        names = assigned_names(n.stmt)
+        kill[n.index] = names
+        gen[n.index] = {(name, n.index) for name in names}
+    in_sets: Dict[int, Set[Tuple[str, int]]] = {
+        n.index: set() for n in cfg.nodes
+    }
+    for e in cfg.entry:
+        in_sets[e] |= params
+    changed = True
+    while changed:
+        changed = False
+        for n in cfg.nodes:
+            out = {
+                d for d in in_sets[n.index] if d[0] not in kill[n.index]
+            } | gen[n.index]
+            for s in n.succs:
+                if s == CFG.EXIT:
+                    continue
+                before = len(in_sets[s])
+                in_sets[s] |= out
+                if len(in_sets[s]) != before:
+                    changed = True
+    return in_sets
+
+
+def uses_of_definition(
+    cfg: CFG, def_index: int, name: str
+) -> List[Tuple[int, ast.Name]]:
+    """Def-use chain: statements whose evaluation can observe the binding
+    of ``name`` made at node ``def_index`` (paired with the Name loads)."""
+    reach = reaching_definitions(cfg)
+    out: List[Tuple[int, ast.Name]] = []
+    for n in cfg.nodes:
+        if (name, def_index) not in reach[n.index]:
+            continue
+        for load in used_names(n.stmt):
+            if load.id == name:
+                out.append((n.index, load))
+    return out
+
+
+def reads_after(
+    cfg: CFG, start_index: int, name: str
+) -> List[ast.Name]:
+    """Every Name LOAD of ``name`` reachable from ``start_index``'s
+    successors before any statement rebinds it.  The start statement's own
+    uses are excluded on the first visit (they happen before the event
+    being tracked) but COUNT if re-reached through a loop back edge."""
+    start = cfg.nodes[start_index]
+    if name in assigned_names(start.stmt):
+        return []  # the event statement itself rebinds: nothing survives
+    seen: Set[int] = set()
+    work: List[int] = [s for s in start.succs if s != CFG.EXIT]
+    out: List[ast.Name] = []
+    while work:
+        idx = work.pop()
+        if idx in seen:
+            continue
+        seen.add(idx)
+        node = cfg.nodes[idx]
+        hits = [u for u in used_names(node.stmt) if u.id == name]
+        out.extend(hits)
+        if name in assigned_names(node.stmt):
+            continue  # killed: stop propagating on this path
+        work.extend(s for s in node.succs if s != CFG.EXIT)
+    # de-dup by position, order by source location
+    uniq: Dict[Tuple[int, int], ast.Name] = {}
+    for u in out:
+        uniq.setdefault((u.lineno, u.col_offset), u)
+    return [uniq[k] for k in sorted(uniq)]
+
+
+# --------------------------------------------------------------------------
+# conservative bail-outs
+# --------------------------------------------------------------------------
+
+
+_DYNAMIC_CALLS = {"exec", "eval", "vars", "locals", "globals"}
+
+
+def bailout_reason(fn: ast.AST, name: Optional[str] = None) -> Optional[str]:
+    """Why this function is beyond honest static analysis, or None.
+
+    ``exec``/``eval``/``locals()`` can rebind anything invisibly;
+    ``global``/``nonlocal`` on the tracked name means writes happen in
+    scopes this CFG does not see.  The project rules treat a bail-out as
+    "report nothing here" — conservative for a LINT (no false positives),
+    the opposite of conservative for a compiler, and the difference is
+    deliberate (module docstring)."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            callee = node.func
+            if (
+                isinstance(callee, ast.Name)
+                and callee.id in _DYNAMIC_CALLS
+            ):
+                return f"uses {callee.id}()"
+        elif isinstance(node, ast.Global):
+            if name is None or name in node.names:
+                return "declares global " + ", ".join(node.names)
+        elif isinstance(node, ast.Nonlocal):
+            if name is None or name in node.names:
+                return "declares nonlocal " + ", ".join(node.names)
+    return None

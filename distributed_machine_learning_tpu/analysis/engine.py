@@ -82,11 +82,40 @@ def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
                     yield os.path.join(root, name)
 
 
+# Parse cache: (abs path) -> ((size, mtime_ns), FileContext).  Parsing is
+# the dominant cost of a whole-package lint; every rule — per-file AND
+# project — reads the same tree, and repeated runs in one process (the
+# tier-1 gate, the CLI tests, the perf guard) re-parse nothing that has
+# not changed on disk.  parse_count() is the test hook proving both.
+_CONTEXT_CACHE: Dict[str, tuple] = {}
+_PARSE_COUNT = 0
+
+
+def parse_count() -> int:
+    return _PARSE_COUNT
+
+
+def clear_context_cache() -> None:
+    _CONTEXT_CACHE.clear()
+
+
 def load_context(path: str) -> FileContext:
-    with open(path, encoding="utf-8") as f:
+    global _PARSE_COUNT
+    abspath = os.path.abspath(path)
+    st = os.stat(abspath)
+    sig = (st.st_size, st.st_mtime_ns)
+    hit = _CONTEXT_CACHE.get(abspath)
+    if hit is not None and hit[0] == sig:
+        ctx = hit[1]
+        # display_path is cwd-relative; the cwd may have moved between
+        # runs (tests chdir) — recompute, everything else is content.
+        ctx.display_path = _display_path(path)
+        return ctx
+    with open(abspath, encoding="utf-8") as f:
         source = f.read()
     lines = source.splitlines()
     tree = ast.parse(source, filename=path)
+    _PARSE_COUNT += 1
     scopes: set = set()
     for raw in lines[:_SCOPE_SCAN_LINES]:
         m = _SCOPE_RE.search(raw)
@@ -94,7 +123,7 @@ def load_context(path: str) -> FileContext:
             scopes.update(
                 s.strip() for s in m.group(1).split(",") if s.strip()
             )
-    return FileContext(
+    ctx = FileContext(
         path=path,
         display_path=_display_path(path),
         source=source,
@@ -103,40 +132,87 @@ def load_context(path: str) -> FileContext:
         scopes=frozenset(scopes),
         suppressions=findings_lib.parse_suppressions(lines),
     )
+    _CONTEXT_CACHE[abspath] = (sig, ctx)
+    return ctx
 
 
 def lint_paths(
     paths: Sequence[str],
     rules: Optional[Sequence[rules_lib.Rule]] = None,
     baseline_path: Optional[str] = DEFAULT_BASELINE,
+    only_files: Optional[Sequence[str]] = None,
 ) -> LintResult:
     """Run ``rules`` (default: all) over every ``.py`` under ``paths``.
 
     Findings matching an inline suppression or a baseline entry are kept in
     the result (marked), so callers can audit what is being silenced; the
     gate is :meth:`LintResult.unsuppressed`.
+
+    ``only_files`` restricts which files findings are REPORTED from (the
+    ``--changed`` pre-commit path): every file under ``paths`` is still
+    parsed into the shared project context — a cross-file rule needs the
+    whole call graph to judge one file — but per-file rules run only on,
+    and project findings are filtered to, the restricted set.  Exit-code
+    semantics are unchanged: unsuppressed findings in the set fail.
     """
     active = list(rules) if rules is not None else list(rules_lib.ALL_RULES)
+    file_rules = [
+        r for r in active if not isinstance(r, rules_lib.ProjectRule)
+    ]
+    project_rules = [
+        r for r in active if isinstance(r, rules_lib.ProjectRule)
+    ]
+    only: Optional[set] = None
+    if only_files is not None:
+        only = {os.path.abspath(f) for f in only_files}
     result = LintResult()
+    contexts: List[FileContext] = []
     for path in iter_python_files(paths):
+        in_scope = only is None or os.path.abspath(path) in only
         try:
             ctx = load_context(path)
         except SyntaxError as exc:
-            result.errors.append(
-                f"{_display_path(path)}:{exc.lineno or 0}: syntax error: "
-                f"{exc.msg}"
-            )
+            if in_scope:
+                result.errors.append(
+                    f"{_display_path(path)}:{exc.lineno or 0}: syntax "
+                    f"error: {exc.msg}"
+                )
             continue
         except OSError as exc:
-            result.errors.append(f"{_display_path(path)}: unreadable: {exc}")
+            if in_scope:
+                result.errors.append(
+                    f"{_display_path(path)}: unreadable: {exc}"
+                )
+            continue
+        contexts.append(ctx)
+        if not in_scope:
             continue
         result.files_checked += 1
-        for rule in active:
+        for rule in file_rules:
             if not rule.applies(ctx):
                 continue
             for finding in rule.check(ctx):
                 finding.suppressed = findings_lib.is_suppressed(
                     finding, ctx.suppressions
+                )
+                result.findings.append(finding)
+    if project_rules and contexts:
+        from distributed_machine_learning_tpu.analysis import (
+            callgraph as callgraph_lib,
+        )
+
+        project = callgraph_lib.Project(contexts)
+        supp_by_file = {c.display_path: c.suppressions for c in contexts}
+        in_scope_files = {
+            c.display_path for c in contexts
+            if only is None or os.path.abspath(c.path) in only
+        }
+        for rule in project_rules:
+            for finding in rule.check_project(project):
+                if finding.file not in in_scope_files:
+                    continue
+                finding.suppressed = findings_lib.is_suppressed(
+                    finding, supp_by_file.get(finding.file, {})
                 )
                 result.findings.append(finding)
     if baseline_path:
@@ -145,6 +221,72 @@ def lint_paths(
         )
     result.findings.sort(key=lambda f: (f.file, f.line, f.rule_id))
     return result
+
+
+def render_sarif(
+    result: LintResult,
+    rules: Optional[Sequence[rules_lib.Rule]] = None,
+) -> Dict[str, object]:
+    """The result as a SARIF 2.1.0 ``dict`` (``--format=sarif``), so CI
+    annotators consume rule id / level / file / region without parsing
+    the text report.  Suppressed and baselined findings are included with
+    a SARIF ``suppressions`` entry — CI should annotate only the live
+    ones, but auditing what is silenced is part of the report."""
+    catalog = list(rules) if rules is not None else list(
+        rules_lib.ALL_RULES
+    )
+    sarif_rules = [
+        {
+            "id": r.rule_id,
+            "name": r.name,
+            "shortDescription": {"text": r.name},
+            "fullDescription": {"text": r.description},
+            "defaultConfiguration": {"level": r.severity},
+        }
+        for r in catalog
+    ]
+    results = []
+    for f in result.findings:
+        entry: Dict[str, object] = {
+            "ruleId": f.rule_id,
+            "level": f.severity,
+            "message": {
+                "text": f.message + (f"\nfix: {f.hint}" if f.hint else "")
+            },
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.file.replace(os.sep, "/"),
+                    },
+                    "region": {"startLine": f.line},
+                },
+            }],
+        }
+        if f.suppressed or f.baselined:
+            entry["suppressions"] = [{
+                "kind": "inSource" if f.suppressed else "external",
+            }]
+        results.append(entry)
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "dmlint",
+                    "informationUri": "docs/static-analysis.md",
+                    "rules": sarif_rules,
+                },
+            },
+            "results": results,
+            "invocations": [{
+                "executionSuccessful": result.ok,
+            }],
+        }],
+    }
 
 
 def render(result: LintResult, verbose: bool = False) -> str:

@@ -186,10 +186,12 @@ class LockOrderRecorder:
 
     def snapshot(self) -> Dict[str, object]:
         edges = self.edges()
+        with self._mu:  # self_edges is written under _mu (dmlint DML014)
+            self_edges = dict(self.self_edges)
         return {
             "roles": sorted(self.roles_seen | self.nodes()),
             "edges": sorted(f"{a} -> {b}" for a, b in edges),
-            "self_edges": dict(self.self_edges),
+            "self_edges": self_edges,
             "cycles": [" -> ".join(c) for c in self.cycles()],
         }
 

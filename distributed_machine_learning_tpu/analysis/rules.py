@@ -435,6 +435,17 @@ _NONDET_BUILTINS = {
 }
 
 
+def _nondet_reason(callee: str) -> Optional[str]:
+    """Why a call is nondeterministic, or None.  Shared by DML003 (this
+    file's sites) and DML013 (sites reached through the call graph)."""
+    why = _NONDET_CALLS.get(callee)
+    if why is None and callee.startswith(_NONDET_PREFIXES):
+        why = f"{callee.split('.', 1)[0]} state varies per run"
+    if why is None and callee in _NONDET_BUILTINS:
+        why = _NONDET_BUILTINS[callee]
+    return why
+
+
 class ChaosDeterminismRule(Rule):
     name = "chaos-determinism"
     rule_id = "DML003"
@@ -463,11 +474,7 @@ class ChaosDeterminismRule(Rule):
             callee = _call_name(node)
             if callee is None:
                 continue
-            why = _NONDET_CALLS.get(callee)
-            if why is None and callee.startswith(_NONDET_PREFIXES):
-                why = f"{callee.split('.', 1)[0]} state varies per run"
-            if why is None and callee in _NONDET_BUILTINS:
-                why = _NONDET_BUILTINS[callee]
+            why = _nondet_reason(callee)
             if why is None:
                 continue
             yield self.finding(
@@ -1271,6 +1278,704 @@ class BlockingTransferInLoopRule(Rule):
                     )
 
 
+# ==========================================================================
+# Cross-file rules (dmlint v2): symbol table + call graph + dataflow
+# ==========================================================================
+#
+# Everything below reasons over the WHOLE linted tree at once
+# (analysis/callgraph.py builds the project view from the engine's shared
+# parse cache; analysis/dataflow.py answers order questions inside one
+# function).  The per-file visitors above are structurally blind across a
+# function call — PR 4's donation-alias corruption and PR 7's fencing race
+# both crossed file boundaries before they bit.
+
+from distributed_machine_learning_tpu.analysis import (  # noqa: E402
+    callgraph as callgraph_lib,
+    dataflow as dataflow_lib,
+)
+
+
+class ProjectRule(Rule):
+    """A rule that runs ONCE over the whole project, not per file.
+
+    The engine builds a single :class:`callgraph.Project` from every
+    parsed file and hands it to :meth:`check_project`; findings land in
+    whatever file each site lives in and go through the same suppression
+    / baseline machinery as per-file findings."""
+
+    def check(self, ctx) -> Iterator[Finding]:
+        return iter(())  # per-file entry point intentionally empty
+
+    def check_project(self, project) -> Iterator[Finding]:
+        raise NotImplementedError  # pragma: no cover - abstract
+
+
+def _positions_from(node: ast.AST, module_consts: Dict[str, ast.AST]
+                    ) -> Optional[tuple]:
+    """A donate_argnums value as a tuple of ints, when statically known:
+    a constant int, a tuple/list of constant ints, or a Name bound to one
+    at module level (the ``_EPOCH_DONATE = (0, 1, 2)`` idiom)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+            ):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    if isinstance(node, ast.Name) and node.id in module_consts:
+        return _positions_from(module_consts[node.id], {})
+    return None
+
+
+def _module_consts(tree: ast.AST) -> Dict[str, ast.AST]:
+    out: Dict[str, ast.AST] = {}
+    for node in tree.body if hasattr(tree, "body") else []:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name):
+                out[t.id] = node.value
+    return out
+
+
+def _donate_kw(call: ast.Call) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return kw.value
+    return None
+
+
+# --------------------------------------------------------------------------
+# DML012 use-after-donation
+# --------------------------------------------------------------------------
+
+
+class UseAfterDonationRule(ProjectRule):
+    name = "use-after-donation"
+    rule_id = "DML012"
+    severity = "error"
+    description = (
+        "A name passed at a donate_argnums position of a jitted callable "
+        "is READ after the call: donation hands the buffer to XLA for "
+        "in-place reuse, so the old value is deleted (RuntimeError on a "
+        "real backend) or — with zero-copy aliasing on CPU — silently "
+        "overwritten by the next step.  The static twin of the runtime "
+        "donation audit (ISSUE 7): the audit proves donation HAPPENED, "
+        "this rule proves nobody still depends on the donated value.  "
+        "Donation summaries propagate through the call graph, so a "
+        "helper that forwards its parameter into a donated position "
+        "donates its caller's buffer too (the PR 4 corruption crossed "
+        "exactly such a boundary)."
+    )
+    _HINT = (
+        "rebind the result over the donated name "
+        "(`params, opt = step(params, opt)`) or snapshot with "
+        "np.array(x, copy=True) BEFORE the donating call"
+    )
+
+    def check_project(self, project) -> Iterator[Finding]:
+        self._mod_bind_cache: Dict[int, Dict[str, tuple]] = {}
+        donating_attrs = self._attr_map(project)
+        summaries = self._summaries(project, donating_attrs)
+        for fn in project.functions.values():
+            yield from self._check_fn(
+                project, fn, donating_attrs, summaries
+            )
+
+    # -- donating-callable discovery ----------------------------------------
+
+    def _jit_donation(self, call: ast.Call, consts) -> Optional[tuple]:
+        """Donated positions of a ``jax.jit(..., donate_argnums=...)``
+        call expression, else None."""
+        callee = _call_name(call) or ""
+        if callee not in _JIT_NAMES:
+            return None
+        kw = _donate_kw(call)
+        if kw is None:
+            return None
+        return _positions_from(kw, consts)
+
+    def _attr_map(self, project) -> Dict[str, tuple]:
+        """attr name -> donated positions, for donating programs stored
+        as instance attributes (``self.train_epoch = jax.jit(...)``) or
+        passed as constructor fields (``Bundle(train_epoch=prog)``).
+        Ambiguous attrs (two bindings that disagree) are dropped —
+        resolution must never guess."""
+        out: Dict[str, tuple] = {}
+        dead: Set[str] = set()
+
+        def record(attr: str, pos: tuple) -> None:
+            if attr in dead:
+                return
+            if attr in out and out[attr] != pos:
+                del out[attr]
+                dead.add(attr)
+                return
+            out[attr] = pos
+
+        for mod in project.modules.values():
+            consts = _module_consts(mod.ctx.tree)
+            named: Dict[str, tuple] = {}
+            for node in ast.walk(mod.ctx.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not isinstance(node.value, ast.Call):
+                    continue
+                pos = self._jit_donation(node.value, consts)
+                if pos is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute):
+                        record(t.attr, pos)
+                    elif isinstance(t, ast.Name):
+                        named[t.id] = pos
+            # constructor fields: Bundle(train_epoch=<donating name>)
+            for node in ast.walk(mod.ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                for kw in node.keywords:
+                    if (
+                        kw.arg
+                        and isinstance(kw.value, ast.Name)
+                        and kw.value.id in named
+                    ):
+                        record(kw.arg, named[kw.value.id])
+        return out
+
+    def _summaries(self, project, donating_attrs) -> Dict[str, Set[int]]:
+        """qualname -> parameter indices donated THROUGH the function:
+        a param forwarded (as a bare name) into a donated position of a
+        donating callable inside the body.  Fixpoint over the call graph
+        so chains of helpers propagate."""
+        summaries: Dict[str, Set[int]] = {}
+        for _ in range(10):  # tiny graphs: converges in 2-3 rounds
+            changed = False
+            for fn in project.functions.values():
+                mine = summaries.setdefault(fn.qualname, set())
+                for call, positions, _desc in self._donating_calls(
+                    project, fn, donating_attrs, summaries
+                ):
+                    for pos in positions:
+                        if pos >= len(call.args):
+                            continue
+                        arg = call.args[pos]
+                        if (
+                            isinstance(arg, ast.Name)
+                            and arg.id in fn.params
+                        ):
+                            idx = fn.params.index(arg.id)
+                            if idx not in mine:
+                                mine.add(idx)
+                                changed = True
+            if not changed:
+                break
+        return summaries
+
+    def _donating_calls(self, project, fn, donating_attrs, summaries):
+        """(call node, donated positions, callee description) for every
+        donating call inside ``fn``'s body."""
+        mod = project.modules.get(fn.module)
+        consts = _module_consts(mod.ctx.tree) if mod else {}
+        # names bound to donating jits or donating attrs, in this
+        # function or at module level
+        local: Dict[str, tuple] = {}
+
+        def scan_bindings(stmts) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue  # a nested def's bindings are its own scope
+                if isinstance(stmt, ast.Assign):
+                    targets = [
+                        t.id for t in stmt.targets
+                        if isinstance(t, ast.Name)
+                    ]
+                    if targets:
+                        pos: Optional[tuple] = None
+                        if isinstance(stmt.value, ast.Call):
+                            pos = self._jit_donation(stmt.value, consts)
+                        elif isinstance(stmt.value, ast.Attribute):
+                            # f = bundle.train_epoch — donating-attr alias
+                            pos = donating_attrs.get(stmt.value.attr)
+                        if pos is not None:
+                            for t in targets:
+                                local[t] = pos
+                for _, value in ast.iter_fields(stmt):
+                    if isinstance(value, list) and value:
+                        if isinstance(value[0], ast.stmt):
+                            scan_bindings(value)
+                        elif isinstance(value[0], ast.excepthandler):
+                            for h in value:
+                                scan_bindings(h.body)
+
+        if mod:
+            cache = getattr(self, "_mod_bind_cache", None)
+            if cache is None:
+                cache = self._mod_bind_cache = {}
+            cached = cache.get(id(mod))
+            if cached is None:
+                scan_bindings(mod.ctx.tree.body)
+                cache[id(mod)] = dict(local)
+            else:
+                local.update(cached)
+        scan_bindings(fn.node.body)
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Name) and func.id in local:
+                yield node, local[func.id], func.id
+            elif isinstance(func, ast.Attribute):
+                if func.attr in donating_attrs:
+                    yield node, donating_attrs[func.attr], (
+                        _call_name(node) or func.attr
+                    )
+                    continue
+                raw = _dotted(func)
+                if raw:
+                    target = project.resolve_name(mod, raw, fn.cls) \
+                        if mod else None
+                    donated = summaries.get(target or "", set())
+                    if donated:
+                        # self.helper(a) / obj.helper(a): arg i is
+                        # param i+1 (the bound receiver fills param 0)
+                        offset = 1 if target and project.functions[
+                            target
+                        ].is_method else 0
+                        positions = tuple(
+                            p - offset for p in sorted(donated)
+                            if p - offset >= 0
+                        )
+                        if positions:
+                            yield node, positions, raw
+            elif isinstance(func, ast.Name):
+                raw = func.id
+                target = project.resolve_name(mod, raw, fn.cls) \
+                    if mod else None
+                donated = summaries.get(target or "", set())
+                if donated:
+                    yield node, tuple(sorted(donated)), raw
+
+    # -- the check -----------------------------------------------------------
+
+    def _check_fn(self, project, fn, donating_attrs, summaries
+                  ) -> Iterator[Finding]:
+        events = list(
+            self._donating_calls(project, fn, donating_attrs, summaries)
+        )
+        if not events:
+            return
+        cfg = dataflow_lib.build_cfg(fn.node)
+        # innermost enclosing CFG statement of each call node
+        owner: Dict[int, int] = {}
+        for n in cfg.nodes:
+            for expr in dataflow_lib._own_expressions(n.stmt):
+                for sub in ast.walk(expr):
+                    owner.setdefault(id(sub), n.index)
+        reported: Set[tuple] = set()
+        for call, positions, desc in events:
+            stmt_idx = owner.get(id(call))
+            if stmt_idx is None:
+                continue  # call sits in a nested def: out of this CFG
+            for pos in positions:
+                if pos >= len(call.args):
+                    continue
+                arg = call.args[pos]
+                if not isinstance(arg, ast.Name):
+                    continue
+                name = arg.id
+                if dataflow_lib.bailout_reason(fn.node, name):
+                    continue  # dynamic scope games: refuse to guess
+                for read in dataflow_lib.reads_after(
+                    cfg, stmt_idx, name
+                ):
+                    key = (name, read.lineno, read.col_offset)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    yield self.finding(
+                        fn.ctx, read,
+                        f"`{name}` is read here but its buffer was "
+                        f"donated to `{desc}` at line {call.lineno} "
+                        f"(donate_argnums position {pos}) — the donated "
+                        f"buffer is deleted or reused in place by the "
+                        f"next dispatch",
+                        self._HINT,
+                    )
+
+
+# --------------------------------------------------------------------------
+# DML013 transitive-chaos-nondeterminism
+# --------------------------------------------------------------------------
+
+
+class TransitiveChaosRule(ProjectRule):
+    name = "transitive-chaos-nondeterminism"
+    rule_id = "DML013"
+    severity = "error"
+    description = (
+        "The interprocedural closure of DML003: a fault-injection "
+        "decision must be a pure function of (seed, op, key, call-count) "
+        "ALL the way down — a FaultPlan decision method that calls a "
+        "helper in another module which consults wall time, PIDs, or "
+        "`random` is exactly as flaky as doing it inline, and the "
+        "per-file rule cannot see across the call.  Sites inside files "
+        "DML003 already covers are skipped (one owner per site); this "
+        "rule reports the sites the call graph reaches OUTSIDE them, "
+        "with the chain that reaches each one."
+    )
+    _HINT = (
+        "derive the decision from the seeded hash of stable keys "
+        "(_hash_fraction), or hoist the nondeterministic read out of the "
+        "decision path and pass its value in as an argument"
+    )
+
+    def check_project(self, project) -> Iterator[Finding]:
+        chaos_rule = ChaosDeterminismRule()
+        scoped = {
+            id(ctx) for ctx in project.contexts if chaos_rule.applies(ctx)
+        }
+        roots: List[str] = []
+        for fn in project.functions.values():
+            if id(fn.ctx) in scoped:
+                roots.append(fn.qualname)
+        for cinfo in project.classes.values():
+            bases = {b.rsplit(".", 1)[-1] for b in cinfo.bases}
+            if cinfo.name == "FaultPlan" or "FaultPlan" in bases:
+                roots.extend(m.qualname for m in cinfo.methods.values())
+        reach = project.reachable(roots)
+        for qual, path in sorted(reach.items()):
+            fn = project.functions[qual]
+            if id(fn.ctx) in scoped:
+                continue  # DML003 owns sites in chaos-scoped files
+            yield from self._check_fn(fn, path)
+
+    def _check_fn(self, fn, path) -> Iterator[Finding]:
+        chain = " -> ".join(path)
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _call_name(node)
+            if callee is None:
+                continue
+            why = _nondet_reason(callee)
+            if why is None:
+                continue
+            yield self.finding(
+                fn.ctx, node,
+                f"nondeterministic `{callee}()` ({why}) is reachable "
+                f"from a fault-decision path: {chain}",
+                self._HINT,
+            )
+
+
+# --------------------------------------------------------------------------
+# DML014 unguarded-shared-state
+# --------------------------------------------------------------------------
+
+
+_LOCK_CTORS = {"named_lock", "NamedLock"}
+_RAW_LOCK_CTORS = {"Lock", "RLock", "Semaphore", "BoundedSemaphore"}
+_EXEMPT_METHODS = {"__init__", "__post_init__", "__del__", "__new__"}
+
+
+class _Access:
+    __slots__ = ("attr", "method", "node", "held", "write", "nested")
+
+    def __init__(self, attr, method, node, held, write, nested):
+        self.attr = attr
+        self.method = method
+        self.node = node
+        self.held = held
+        self.write = write
+        self.nested = nested
+
+
+class UnguardedSharedStateRule(ProjectRule):
+    name = "unguarded-shared-state"
+    rule_id = "DML014"
+    severity = "error"
+    description = (
+        "A static Eraser-style lockset check seeded from the named_lock "
+        "role instrumentation: an instance attribute WRITTEN inside a "
+        "`with self._lock:` block in one method is shared mutable state "
+        "by declaration — reading or writing it in another method while "
+        "holding none of its writer locks is the data race the lock was "
+        "bought to prevent.  Private helpers whose every intra-class "
+        "call site holds the lock inherit it (the `_drain_locked` "
+        "idiom, resolved through the call graph); `__init__` — and any "
+        "method that CREATES the guarding lock itself (a second-phase "
+        "constructor like a connection handshake) — is exempt: "
+        "construction happens-before publication."
+    )
+    _HINT = (
+        "take the guarding lock around the access (or, if the access is "
+        "deliberately lock-free — an atomic flag read, a snapshot of an "
+        "immutable value — say so: "
+        "# dmlint: disable=unguarded-shared-state <reason>)"
+    )
+
+    def check_project(self, project) -> Iterator[Finding]:
+        for cinfo in sorted(
+            project.classes.values(), key=lambda c: c.qualname
+        ):
+            yield from self._check_class(cinfo)
+
+    # -- lock attr discovery -------------------------------------------------
+
+    def _lock_attrs(self, cinfo) -> Dict[str, str]:
+        """attr -> role ('' when unnamed).  Conditions wrapping a lock
+        attr alias to it; bare Conditions are locks of their own."""
+        locks: Dict[str, str] = {}
+        alias: Dict[str, str] = {}
+        created_in: Dict[str, Set[str]] = {}
+        for m in cinfo.methods.values():
+            for node in ast.walk(m.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not isinstance(node.value, ast.Call):
+                    continue
+                callee = (_call_name(node.value) or "").rsplit(".", 1)[-1]
+                attr_targets = [
+                    t.attr for t in node.targets
+                    if isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ]
+                if not attr_targets:
+                    continue
+                if callee in _LOCK_CTORS:
+                    role = ""
+                    if node.value.args and isinstance(
+                        node.value.args[0], ast.Constant
+                    ):
+                        role = str(node.value.args[0].value)
+                    for a in attr_targets:
+                        locks[a] = role
+                        created_in.setdefault(m.name, set()).add(a)
+                elif callee in _RAW_LOCK_CTORS:
+                    for a in attr_targets:
+                        locks[a] = ""
+                        created_in.setdefault(m.name, set()).add(a)
+                elif callee == "Condition":
+                    arg = node.value.args[0] if node.value.args else None
+                    if (
+                        isinstance(arg, ast.Attribute)
+                        and isinstance(arg.value, ast.Name)
+                        and arg.value.id == "self"
+                    ):
+                        for a in attr_targets:
+                            alias[a] = arg.attr
+                    else:
+                        role = ""
+                        if isinstance(arg, ast.Call):
+                            inner = (
+                                _call_name(arg) or ""
+                            ).rsplit(".", 1)[-1]
+                            if inner in _LOCK_CTORS and arg.args and \
+                                    isinstance(arg.args[0], ast.Constant):
+                                role = str(arg.args[0].value)
+                        for a in attr_targets:
+                            locks[a] = role
+        for cond, lock in alias.items():
+            locks[cond] = locks.get(lock, "")
+            alias[cond] = lock if lock in locks else cond
+        self._alias = alias
+        self._created_in = created_in
+        return locks
+
+    # -- per-method walk -----------------------------------------------------
+
+    def _check_class(self, cinfo) -> Iterator[Finding]:
+        locks = self._lock_attrs(cinfo)
+        if not locks:
+            return
+        alias = self._alias
+        method_names = set(cinfo.methods)
+        accesses: List[_Access] = []
+        # (callee method, effective held, caller method, nested) sites
+        self_calls: List[tuple] = []
+
+        def canon(attr: str) -> str:
+            return alias.get(attr, attr)
+
+        def lock_of_with(item: ast.withitem) -> Optional[str]:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):  # self._cond.acquire() etc: no
+                return None
+            if (
+                isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and expr.attr in locks
+            ):
+                return canon(expr.attr)
+            return None
+
+        def scan_expr(expr, method, held, nested):
+            # container mutation counts as a write to the attr: the
+            # object behind self.X is what the lock protects, and
+            # `self.X[k] = v` under the lock is the guard declaration
+            # just as much as `self.X = ...`
+            sub_writes: Set[int] = set()
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.Subscript) and isinstance(
+                    sub.ctx, (ast.Store, ast.Del)
+                ):
+                    tgt = sub.value
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        sub_writes.add(id(tgt))
+            for sub in ast.walk(expr):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                    continue  # handled by walk_stmts for defs
+                if not isinstance(sub, ast.Attribute):
+                    continue
+                if not (
+                    isinstance(sub.value, ast.Name)
+                    and sub.value.id == "self"
+                ):
+                    continue
+                if sub.attr in locks or sub.attr in alias:
+                    continue
+                write = isinstance(sub.ctx, (ast.Store, ast.Del)) \
+                    or id(sub) in sub_writes
+                accesses.append(_Access(
+                    sub.attr, method, sub, held, write, nested
+                ))
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.Call) and isinstance(
+                    sub.func, ast.Attribute
+                ):
+                    f = sub.func
+                    if (
+                        isinstance(f.value, ast.Name)
+                        and f.value.id == "self"
+                        and f.attr in method_names
+                    ):
+                        self_calls.append((f.attr, held, method, nested))
+
+        def walk_stmts(stmts, method, held, nested):
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    # a nested def runs LATER (callback/thread target):
+                    # whatever lock is held now is not held then
+                    walk_stmts(stmt.body, method, frozenset(), True)
+                    continue
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    inner = set(held)
+                    for item in stmt.items:
+                        scan_expr(item.context_expr, method, held, nested)
+                        if item.optional_vars is not None:
+                            scan_expr(item.optional_vars, method, held,
+                                      nested)
+                        got = lock_of_with(item)
+                        if got:
+                            inner.add(got)
+                    walk_stmts(stmt.body, method, frozenset(inner),
+                               nested)
+                    continue
+                # headers of other compounds evaluate at current held
+                for expr in dataflow_lib._own_expressions(stmt):
+                    scan_expr(expr, method, held, nested)
+                for field_name, value in ast.iter_fields(stmt):
+                    if isinstance(value, list) and value:
+                        if isinstance(value[0], ast.stmt):
+                            walk_stmts(value, method, held, nested)
+                        elif isinstance(value[0], ast.excepthandler):
+                            for h in value:
+                                walk_stmts(h.body, method, held, nested)
+
+        for name, m in cinfo.methods.items():
+            walk_stmts(m.node.body, name, frozenset(), False)
+
+        # a method can't access state it doesn't touch; accessing a
+        # missing method via self_calls is fine (sites list only).
+        sites_of: Dict[str, List[tuple]] = {}
+        for callee, held, caller, nested in self_calls:
+            sites_of.setdefault(callee, []).append(
+                (held, caller, nested)
+            )
+
+        # lock inheritance fixpoint: a PRIVATE method whose every
+        # intra-class call site holds lock set S inherits S.
+        inherited: Dict[str, frozenset] = {
+            name: frozenset() for name in method_names
+        }
+        for _ in range(len(method_names) + 1):
+            changed = False
+            for name in method_names:
+                if not name.startswith("_") or name.startswith("__"):
+                    continue
+                sites = sites_of.get(name)
+                if not sites:
+                    continue
+                common: Optional[Set[str]] = None
+                for held, caller, nested in sites:
+                    eff = set(held)
+                    if not nested:
+                        eff |= inherited.get(caller, frozenset())
+                    common = eff if common is None else (common & eff)
+                new = frozenset(common or ())
+                if new != inherited[name]:
+                    inherited[name] = new
+                    changed = True
+            if not changed:
+                break
+
+        # guard sets: locks held at locked WRITES, per attr
+        guards: Dict[str, Set[str]] = {}
+        for acc in accesses:
+            eff = set(acc.held)
+            if not acc.nested:
+                eff |= inherited.get(acc.method, frozenset())
+            if acc.write and eff:
+                guards.setdefault(acc.attr, set()).update(eff)
+
+        reported: Set[tuple] = set()
+        for acc in accesses:
+            guard = guards.get(acc.attr)
+            if not guard:
+                continue
+            if acc.method in _EXEMPT_METHODS:
+                continue
+            if guard & self._created_in.get(acc.method, set()):
+                # this method CREATES the guarding lock: it is that
+                # lock's construction phase (handshake/open idiom) —
+                # nothing else can hold a lock that does not exist yet
+                continue
+            eff = set(acc.held)
+            if not acc.nested:
+                eff |= inherited.get(acc.method, frozenset())
+            if eff & guard:
+                continue
+            key = (acc.attr, acc.node.lineno)
+            if key in reported:
+                continue
+            reported.add(key)
+            roles = sorted(
+                r for r in (locks.get(g, "") for g in guard) if r
+            ) or sorted(guard)
+            verb = "written" if acc.write else "read"
+            yield self.finding(
+                cinfo.ctx, acc.node,
+                f"`self.{acc.attr}` is guarded by "
+                f"{', '.join(repr(r) for r in roles)} elsewhere in "
+                f"`{cinfo.name}` but {verb} here in `{acc.method}` "
+                f"without holding it — a concurrent locked writer can "
+                f"interleave with this access",
+                self._HINT,
+            )
+
+
 ALL_RULES: List[Rule] = [
     DonationAliasRule(),
     UnlockedDispatchRule(),
@@ -1283,6 +1988,9 @@ ALL_RULES: List[Rule] = [
     UnboundedQueueRule(),
     HostSyncInScanRule(),
     BlockingTransferInLoopRule(),
+    UseAfterDonationRule(),
+    TransitiveChaosRule(),
+    UnguardedSharedStateRule(),
 ]
 
 

@@ -156,6 +156,7 @@ class MicroBatcher:
             return len(self._queue)
 
     def is_alive(self) -> bool:
+        # dmlint: disable=unguarded-shared-state deliberate lock-free read: alive() sits on the per-request dispatch path and a single bool load is atomic under the GIL — staleness only delays failover by one round-robin pass
         return self._thread.is_alive() and not self._stop
 
     # -- worker side ---------------------------------------------------------
@@ -424,6 +425,7 @@ class ContinuousBatcher:
             return len(self._queue) + self._inflight
 
     def is_alive(self) -> bool:
+        # dmlint: disable=unguarded-shared-state deliberate lock-free read: alive() sits on the per-request dispatch path and a single bool load is atomic under the GIL — staleness only delays failover by one round-robin pass
         return self._thread.is_alive() and not self._stop
 
     # -- adaptive cap --------------------------------------------------------

@@ -242,13 +242,19 @@ class CircuitBreaker:
             )
 
     def stats(self) -> Dict[str, Any]:
-        return {
-            "state": self.state,
-            "failures_total": self.failures_total,
-            "successes_total": self.successes_total,
-            "opens_total": self.opens_total,
-            "probes_total": self.probes_total,
-        }
+        # state first (the property takes the lock itself), then the
+        # counters as one consistent snapshot under the lock — unlocked
+        # reads here could tear across a concurrent record_failure
+        # (dmlint DML014 unguarded-shared-state).
+        state = self.state
+        with self._lock:
+            return {
+                "state": state,
+                "failures_total": self.failures_total,
+                "successes_total": self.successes_total,
+                "opens_total": self.opens_total,
+                "probes_total": self.probes_total,
+            }
 
 
 class Replica:
@@ -631,7 +637,11 @@ class ReplicaSet:
         sample so autoscale-added and hot-swapped replicas warm the same
         grid BEFORE taking traffic."""
         self._warmup_sample = np.asarray(sample)
-        for r in list(self.replicas):
+        # snapshot under the lock (predict does the same): a concurrent
+        # scale-up must not tear the iteration (dmlint DML014)
+        with self._lock:
+            replicas = list(self.replicas)
+        for r in replicas:
             r.engine.warmup(sample)
         stats = self.program_stats()
         self._warmup_programs = stats["programs"]

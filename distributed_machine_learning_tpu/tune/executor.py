@@ -547,7 +547,11 @@ class ProcessTrialExecutor:
                     break
             else:
                 proc = None
-        if self._prewarm and not self._closing:
+            # read under the pool lock: close() flips it under the same
+            # lock, and an unlocked read here could replenish the pool
+            # mid-shutdown (dmlint DML014 unguarded-shared-state)
+            closing = self._closing
+        if self._prewarm and not closing:
             threading.Thread(
                 target=self._add_warm_child, args=(dict(env),),
                 name="runner-prewarm", daemon=True,

@@ -1,0 +1,22 @@
+"""Historical bug (PR 3, interprocedural): a FaultPlan decision that is
+pure where DML003 can see it — but calls a helper whose helper consults
+wall time.  The flake is exactly as real two hops away; only the call
+graph reaches it."""
+
+import time
+
+
+def _entropy(op):
+    return time.time() % 1.0  # EXPECT: transitive-chaos-nondeterminism
+
+
+def _decide(seed, op, path):
+    return _entropy(op) < 0.5
+
+
+class FaultPlan:
+    def __init__(self, seed):
+        self.seed = seed
+
+    def on_storage_op(self, op, path):
+        return _decide(self.seed, op, path)

@@ -1,0 +1,25 @@
+"""Historical bug shape (the PR 7 fencing race family): an attribute the
+class itself declares shared — by writing it under a ``named_lock`` role
+— read and written from OTHER methods with no lock held.  The classic
+Eraser lockset violation: the locked writer and the unlocked reader can
+interleave."""
+
+from distributed_machine_learning_tpu.analysis.locks import named_lock
+
+
+class FaultCounters:
+    def __init__(self):
+        self._lock = named_lock("fixture.fault_counters")
+        self.total = 0
+        self.by_op = {}
+
+    def record(self, op):
+        with self._lock:
+            self.total += 1
+            self.by_op[op] = self.by_op.get(op, 0) + 1
+
+    def snapshot(self):
+        return {"total": self.total}  # EXPECT: unguarded-shared-state
+
+    def reset(self):
+        self.total = 0  # EXPECT: unguarded-shared-state

@@ -1,0 +1,38 @@
+"""Historical bug (PR 4, the static twin of ISSUE 7's runtime donation
+audit): a buffer handed to XLA via ``donate_argnums`` is deleted (real
+backend) or reused in place (CPU aliasing) by the next dispatch — reading
+the donated name afterwards is use-after-free at best.  The hard case is
+the one the per-file rules could never see: the CALLER passes, a helper
+donates, and the caller keeps reading."""
+
+import jax
+import numpy as np
+
+
+def donate_state(params, opt_state, key):
+    """The helper boundary: its params/opt_state flow into donated
+    positions, so calling it donates the caller's buffers."""
+    step = jax.jit(lambda p, o, k: (p, o), donate_argnums=(0, 1))
+    return step(params, opt_state, key)
+
+
+def run_after_helper(params, opt_state, key):
+    new_p, new_o = donate_state(params, opt_state, key)
+    loss = float(params.mean())  # EXPECT: use-after-donation
+    return new_p, new_o, loss
+
+
+def run_direct(params, opt_state, key):
+    epoch = jax.jit(lambda p, o, k: (p, o), donate_argnums=(0, 1))
+    new_p, new_o = epoch(params, opt_state, key)
+    host = np.array(new_p, copy=True)
+    stale = opt_state  # EXPECT: use-after-donation
+    return host, stale
+
+
+def run_loop(params, opt_state, keys):
+    epoch = jax.jit(lambda p, o, k: (p, o), donate_argnums=(0, 1))
+    out = None
+    for k in keys:
+        out = epoch(params, opt_state, k)  # EXPECT: use-after-donation, use-after-donation
+    return out

@@ -1,0 +1,26 @@
+"""Idiomatic twin: every function reachable from a FaultPlan decision
+derives its answer from the seeded hash of stable keys — nothing on the
+decision path reads wall time, PIDs, or entropy."""
+
+import hashlib
+
+
+def _hash_fraction(*parts):
+    blob = "|".join(str(p) for p in parts).encode()
+    digest = hashlib.sha256(blob).digest()
+    return int.from_bytes(digest[:8], "big") / 2 ** 64
+
+
+def _decide(seed, op, path, count):
+    return _hash_fraction(seed, op, path, count) < 0.5
+
+
+class FaultPlan:
+    def __init__(self, seed):
+        self.seed = seed
+        self.counts = {}
+
+    def on_storage_op(self, op, path):
+        n = self.counts.get((op, path), 0)
+        self.counts[(op, path)] = n + 1
+        return _decide(self.seed, op, path, n)

@@ -1,0 +1,35 @@
+"""Idiomatic twin: every access to the locked attribute holds the lock —
+directly, through the Condition wrapping it, or by being a private
+helper whose every call site holds it (the ``_locked`` suffix idiom the
+call graph resolves)."""
+
+import threading
+
+from distributed_machine_learning_tpu.analysis.locks import named_lock
+
+
+class FaultCounters:
+    def __init__(self):
+        self._lock = named_lock("fixture.fault_counters")
+        self._cond = threading.Condition(self._lock)
+        self.total = 0
+
+    def record(self, op):
+        with self._lock:
+            self.total += 1
+            self._note_locked()
+            self._cond.notify_all()
+
+    def _note_locked(self):
+        # called only with self._lock held (the call graph proves it)
+        self.total = max(self.total, 0)
+
+    def wait_nonzero(self, timeout):
+        with self._cond:  # the Condition IS the lock
+            while self.total == 0:
+                self._cond.wait(timeout)
+            return self.total
+
+    def snapshot(self):
+        with self._lock:
+            return {"total": self.total}

@@ -1,0 +1,29 @@
+"""Idiomatic twin of use-after-donation: rebind the result OVER the
+donated names (the self-feed every train loop in this repo uses), or
+snapshot with a real copy BEFORE the donating call."""
+
+import jax
+import numpy as np
+
+
+def donate_state(params, opt_state, key):
+    step = jax.jit(lambda p, o, k: (p, o), donate_argnums=(0, 1))
+    return step(params, opt_state, key)
+
+
+def run_self_feed(params, opt_state, key):
+    params, opt_state = donate_state(params, opt_state, key)
+    return float(params.mean())
+
+
+def run_snapshot_first(params, opt_state, key):
+    host = np.array(params, copy=True)  # real copy, taken BEFORE donation
+    params, opt_state = donate_state(params, opt_state, key)
+    return host, params, opt_state
+
+
+def run_loop(params, opt_state, keys):
+    epoch = jax.jit(lambda p, o, k: (p, o), donate_argnums=(0, 1))
+    for k in keys:
+        params, opt_state = epoch(params, opt_state, k)
+    return params, opt_state

@@ -353,6 +353,277 @@ def test_recorder_snapshot_shape():
 
 
 # --------------------------------------------------------------------------
+# cross-file rules (dmlint v2): the project context end to end
+# --------------------------------------------------------------------------
+
+
+def test_dml012_caught_across_a_file_boundary(tmp_path):
+    """The acceptance case: the CALLER (one file) passes, the CALLEE
+    (another file) donates — only the project call graph connects them."""
+    (tmp_path / "callee.py").write_text(
+        "import jax\n\n\n"
+        "def donate_state(params, opt_state, key):\n"
+        "    step = jax.jit(lambda p, o, k: (p, o), "
+        "donate_argnums=(0, 1))\n"
+        "    return step(params, opt_state, key)\n"
+    )
+    (tmp_path / "caller.py").write_text(
+        "from callee import donate_state\n\n\n"
+        "def run(params, opt_state, key):\n"
+        "    new_p, new_o = donate_state(params, opt_state, key)\n"
+        "    return float(params.mean())\n"
+    )
+    result = analysis.lint_paths([str(tmp_path)], baseline_path=None)
+    hits = [f for f in result.findings if f.rule_id == "DML012"]
+    assert len(hits) == 1
+    assert hits[0].file.endswith("caller.py") and hits[0].line == 6
+    assert "donate_state" in hits[0].message
+    # the clean twin of the same shape: rebinding over the donated names
+    (tmp_path / "caller.py").write_text(
+        "from callee import donate_state\n\n\n"
+        "def run(params, opt_state, key):\n"
+        "    params, opt_state = donate_state(params, opt_state, key)\n"
+        "    return float(params.mean())\n"
+    )
+    result = analysis.lint_paths([str(tmp_path)], baseline_path=None)
+    assert not [f for f in result.findings if f.rule_id == "DML012"]
+
+
+def test_dml013_skips_sites_dml003_already_owns(tmp_path):
+    """One owner per site: a nondeterministic call INSIDE a chaos-scoped
+    file is DML003's; DML013 reports only what the call graph reaches
+    outside."""
+    (tmp_path / "chaos.py").write_text(
+        "import helpers\n\n\n"
+        "class FaultPlan:\n"
+        "    def on_storage_op(self, op, path):\n"
+        "        return helpers.decide(op)\n"
+    )
+    (tmp_path / "helpers.py").write_text(
+        "import time\n\n\n"
+        "def decide(op):\n"
+        "    return time.time() % 1.0 < 0.5\n"
+    )
+    result = analysis.lint_paths([str(tmp_path)], baseline_path=None)
+    by_rule = collections.Counter(f.rule_id for f in result.findings)
+    assert by_rule["DML013"] == 1
+    hit = next(f for f in result.findings if f.rule_id == "DML013")
+    assert hit.file.endswith("helpers.py")
+    assert "FaultPlan.on_storage_op" in hit.message
+    # the same call INSIDE chaos.py: DML003 fires there, DML013 must not
+    (tmp_path / "chaos.py").write_text(
+        "import time\n\n\n"
+        "class FaultPlan:\n"
+        "    def on_storage_op(self, op, path):\n"
+        "        return time.time() % 1.0 < 0.5\n"
+    )
+    result = analysis.lint_paths([str(tmp_path)], baseline_path=None)
+    chaos_hits = [
+        f for f in result.findings if f.file.endswith("chaos.py")
+    ]
+    assert {f.rule_id for f in chaos_hits} == {"DML003"}
+
+
+def test_dml014_lock_creator_method_is_construction_phase(tmp_path):
+    """A second-phase constructor (handshake/open) that CREATES the
+    guard lock may initialize the attributes it guards — nothing else
+    can hold a lock that does not exist yet."""
+    src = (
+        "from distributed_machine_learning_tpu.analysis.locks import "
+        "named_lock\n\n\n"
+        "class Conn:\n"
+        "    def open(self):\n"
+        "        self._lock = named_lock('fix.conn')\n"
+        "        self.buffer = []\n\n"
+        "    def push(self, item):\n"
+        "        with self._lock:\n"
+        "            self.buffer.append(item)\n"
+    )
+    result = _lint_source(tmp_path, src)
+    assert not [f for f in result.findings if f.rule_id == "DML014"]
+
+
+def test_project_rule_findings_respect_inline_suppressions(tmp_path):
+    src = (
+        "from distributed_machine_learning_tpu.analysis.locks import "
+        "named_lock\n\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('fix.c')\n"
+        "        self.n = 0\n\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self.n += 1\n\n"
+        "    def peek(self):\n"
+        "        return self.n  "
+        "# dmlint: disable=unguarded-shared-state test: atomic read\n"
+    )
+    result = _lint_source(tmp_path, src)
+    hits = [f for f in result.findings if f.rule_id == "DML014"]
+    assert len(hits) == 1 and hits[0].suppressed
+    assert not result.unsuppressed()
+
+
+# --------------------------------------------------------------------------
+# CLI satellites: --changed and --format=sarif
+# --------------------------------------------------------------------------
+
+
+def _git(tmp_path, *args):
+    import subprocess
+
+    return subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=tmp_path, capture_output=True, text=True, check=True,
+    )
+
+
+def test_lint_changed_matches_full_run_exit_codes(tmp_path, capsys):
+    from distributed_machine_learning_tpu.__main__ import main
+
+    _git(tmp_path, "init", "-q")
+    clean = tmp_path / "clean.py"
+    clean.write_text(
+        "import time\n\n\ndef age(start):\n"
+        "    return time.monotonic() - start\n"
+    )
+    _git(tmp_path, "add", "clean.py")
+    _git(tmp_path, "commit", "-qm", "clean")
+    # a violation lands in the working tree: --changed and the full run
+    # must agree (exit 1)
+    hot = tmp_path / "hot.py"
+    hot.write_text(
+        "import time\n\n\ndef lease():\n"
+        "    deadline = time.time() + 5\n    return deadline\n"
+    )
+    for argv in (
+        ["lint", str(tmp_path), "--baseline", "none"],
+        ["lint", str(tmp_path), "--changed", "--baseline", "none"],
+    ):
+        with pytest.raises(SystemExit) as exc:
+            main(argv)
+        assert exc.value.code == 1, argv
+    out = capsys.readouterr().out
+    assert "hot.py" in out and "clean.py" not in out
+    # committed: nothing changed vs HEAD -> exit 0 without linting
+    _git(tmp_path, "add", "hot.py")
+    _git(tmp_path, "commit", "-qm", "hot")
+    _git(tmp_path, "rm", "-q", "hot.py")
+    _git(tmp_path, "commit", "-qm", "rm")
+    with pytest.raises(SystemExit) as exc:
+        main(["lint", str(tmp_path), "--changed", "--baseline", "none"])
+    assert exc.value.code == 0
+    assert "no .py files changed" in capsys.readouterr().out
+
+
+def test_lint_changed_sees_cross_file_findings_in_changed_file(tmp_path,
+                                                               capsys):
+    """--changed parses the WHOLE tree (a cross-file rule needs the full
+    call graph) but reports only from changed files: a caller edited to
+    read a donated buffer is caught even though the donating helper is
+    untouched."""
+    from distributed_machine_learning_tpu.__main__ import main
+
+    _git(tmp_path, "init", "-q")
+    (tmp_path / "callee.py").write_text(
+        "import jax\n\n\n"
+        "def donate_state(params, opt_state, key):\n"
+        "    step = jax.jit(lambda p, o, k: (p, o), "
+        "donate_argnums=(0, 1))\n"
+        "    return step(params, opt_state, key)\n"
+    )
+    caller = tmp_path / "caller.py"
+    caller.write_text(
+        "from callee import donate_state\n\n\n"
+        "def run(params, opt_state, key):\n"
+        "    params, opt_state = donate_state(params, opt_state, key)\n"
+        "    return float(params.mean())\n"
+    )
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-qm", "seed")
+    caller.write_text(
+        "from callee import donate_state\n\n\n"
+        "def run(params, opt_state, key):\n"
+        "    new_p, new_o = donate_state(params, opt_state, key)\n"
+        "    return float(params.mean())\n"
+    )
+    with pytest.raises(SystemExit) as exc:
+        main(["lint", str(tmp_path), "--changed", "--baseline", "none"])
+    assert exc.value.code == 1
+    out = capsys.readouterr().out
+    assert "DML012" in out and "caller.py" in out
+
+
+def test_lint_format_sarif(tmp_path, capsys):
+    from distributed_machine_learning_tpu.__main__ import main
+
+    bad = os.path.join(FIXTURES, "bad_wallclock_deadline.py")
+    with pytest.raises(SystemExit) as exc:
+        main(["lint", bad, "--baseline", "none", "--format", "sarif"])
+    assert exc.value.code == 1  # exit-code parity with the text run
+    import json
+
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "dmlint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"DML004", "DML012", "DML013", "DML014"} <= rule_ids
+    results = run["results"]
+    assert results and all(r["ruleId"] == "DML004" for r in results)
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith(
+        "bad_wallclock_deadline.py"
+    )
+    assert loc["region"]["startLine"] > 0
+    assert not run["invocations"][0]["executionSuccessful"]
+    # clean file: empty results, exit 0
+    clean = os.path.join(FIXTURES, "clean_wallclock_deadline.py")
+    with pytest.raises(SystemExit) as exc:
+        main(["lint", clean, "--baseline", "none", "--format", "sarif"])
+    assert exc.value.code == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["runs"][0]["results"] == []
+
+
+# --------------------------------------------------------------------------
+# engine perf guard: one parse per file, shared across rules and runs
+# --------------------------------------------------------------------------
+
+
+def test_whole_package_lint_parses_each_file_once_and_caches():
+    from distributed_machine_learning_tpu.analysis import engine
+
+    engine.clear_context_cache()
+    before = engine.parse_count()
+    first = analysis.lint_paths([PKG_ROOT])
+    parsed = engine.parse_count() - before
+    # 14 rules (3 of them whole-project) over N files: N parses exactly
+    assert parsed == first.files_checked, (parsed, first.files_checked)
+    second = analysis.lint_paths([PKG_ROOT])
+    assert engine.parse_count() - before == parsed  # cache: zero re-parses
+    assert second.files_checked == first.files_checked
+
+
+def test_whole_package_lint_stays_under_wall_clock_budget():
+    """The tested perf budget (ISSUE 11): parsing every file once into
+    the shared project context, then running every rule — cross-file
+    ones included — must stay interactive.  Measured ~2.4s on the CI
+    container; the budget leaves ~8x headroom for a loaded host before
+    someone notices their pre-commit hook."""
+    import time
+
+    from distributed_machine_learning_tpu.analysis import engine
+
+    engine.clear_context_cache()  # honest cold run
+    t0 = time.monotonic()
+    result = analysis.lint_paths([PKG_ROOT])
+    dt = time.monotonic() - t0
+    assert result.files_checked > 40
+    assert dt < 20.0, f"whole-package lint took {dt:.1f}s (budget 20s)"
+
+
+# --------------------------------------------------------------------------
 # engine hygiene
 # --------------------------------------------------------------------------
 

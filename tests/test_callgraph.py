@@ -1,0 +1,264 @@
+"""Unit tests for analysis/callgraph.py (ISSUE 11): the project symbol
+table + call graph the cross-file rules reason over.
+
+The contract under test is CONSERVATIVE resolution: every edge the graph
+records must be real (imports resolved within the linted tree, methods
+through same-project bases, wrapper/thread indirection), and everything
+dynamic — ``getattr`` callees, unknown receiver types, star imports —
+resolves to nothing rather than to a guess."""
+
+import os
+import textwrap
+
+from distributed_machine_learning_tpu.analysis import callgraph
+from distributed_machine_learning_tpu.analysis.engine import load_context
+
+
+def _project(tmp_path, files, pkg=None):
+    """Write ``files`` (name -> source), return a Project over them.
+    With ``pkg``, files land inside a package directory of that name."""
+    root = tmp_path
+    if pkg:
+        root = tmp_path / pkg
+        root.mkdir(exist_ok=True)
+        (root / "__init__.py").write_text("")
+        files = dict(files)
+        files.setdefault("__init__.py", "")
+    ctxs = []
+    for name, src in files.items():
+        p = root / name
+        p.write_text(textwrap.dedent(src))
+        ctxs.append(load_context(str(p)))
+    return callgraph.Project(ctxs)
+
+
+# --------------------------------------------------------------------------
+# module naming + symbol table
+# --------------------------------------------------------------------------
+
+
+def test_module_names_inside_and_outside_packages(tmp_path):
+    proj = _project(tmp_path, {"mod.py": "def f():\n    pass\n"},
+                    pkg="pkgx")
+    assert "pkgx.mod" in proj.modules
+    assert "pkgx.mod.f" in proj.functions
+    loose = _project(tmp_path, {"loose.py": "def g():\n    pass\n"})
+    assert "loose.g" in loose.functions
+
+
+def test_symbol_table_classes_and_methods(tmp_path):
+    proj = _project(tmp_path, {
+        "m.py": """
+        class A:
+            def hit(self):
+                pass
+
+        class B(A):
+            def other(self):
+                self.hit()
+        """,
+    })
+    assert "m.A" in proj.classes and "m.B" in proj.classes
+    assert "m.A.hit" in proj.functions
+    # self.hit() resolves through the same-project base class
+    assert "m.A.hit" in proj.callees("m.B.other")
+
+
+# --------------------------------------------------------------------------
+# import resolution
+# --------------------------------------------------------------------------
+
+
+def test_from_import_and_alias_resolution(tmp_path):
+    proj = _project(tmp_path, {
+        "util.py": "def helper():\n    pass\n",
+        "a.py": """
+        from util import helper as h
+        import util
+
+        def f():
+            h()
+
+        def g():
+            util.helper()
+        """,
+    })
+    assert proj.callees("a.f") == ["util.helper"]
+    assert proj.callees("a.g") == ["util.helper"]
+
+
+def test_import_cycle_resolves_both_directions(tmp_path):
+    """Two modules importing each other: the table is built from parsed
+    trees, not executed imports, so a cycle is just two edges."""
+    proj = _project(tmp_path, {
+        "x.py": """
+        import y
+
+        def fx():
+            y.fy()
+        """,
+        "y.py": """
+        import x
+
+        def fy():
+            x.fx()
+        """,
+    })
+    assert proj.callees("x.fx") == ["y.fy"]
+    assert proj.callees("y.fy") == ["x.fx"]
+    reach = proj.reachable(["x.fx"])
+    assert set(reach) == {"x.fx", "y.fy"}  # and it terminates
+
+
+def test_star_import_is_a_bailout_not_a_guess(tmp_path):
+    proj = _project(tmp_path, {
+        "util.py": "def helper():\n    pass\n",
+        "a.py": """
+        from util import *
+
+        def f():
+            helper()
+        """,
+    })
+    assert proj.modules["a"].star_imports
+    assert proj.callees("a.f") == []  # unresolved, never guessed
+
+
+def test_relative_import_resolution(tmp_path):
+    proj = _project(tmp_path, {
+        "util.py": "def helper():\n    pass\n",
+        "a.py": """
+        from .util import helper
+
+        def f():
+            helper()
+        """,
+    }, pkg="pkgr")
+    assert proj.callees("pkgr.a.f") == ["pkgr.util.helper"]
+
+
+# --------------------------------------------------------------------------
+# decorator chains + wrapper/thread awareness
+# --------------------------------------------------------------------------
+
+
+def test_decorator_chain_is_recorded(tmp_path):
+    proj = _project(tmp_path, {
+        "m.py": """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        @jax.named_call
+        def step(params):
+            return params
+        """,
+    })
+    fn = proj.functions["m.step"]
+    assert fn.decorators == ["functools.partial", "jax.named_call"]
+    assert len(fn.decorator_nodes) == 2
+
+
+def test_wrapper_and_thread_target_edges(tmp_path):
+    proj = _project(tmp_path, {
+        "m.py": """
+        import threading
+        import jax
+
+        def payload(x):
+            return x
+
+        def loop():
+            pass
+
+        def build():
+            prog = jax.jit(payload)
+            t = threading.Thread(target=loop, daemon=True)
+            return prog, t
+        """,
+    })
+    build = proj.functions["m.build"]
+    vias = {(s.target, s.via) for s in build.calls if s.target}
+    assert ("m.payload", "wrapper") in vias
+    assert ("m.loop", "thread") in vias
+    assert {"m.payload", "m.loop"} <= set(proj.reachable(["m.build"]))
+
+
+# --------------------------------------------------------------------------
+# conservative bail-outs
+# --------------------------------------------------------------------------
+
+
+def test_getattr_and_exec_mark_dynamic_and_resolve_nothing(tmp_path):
+    proj = _project(tmp_path, {
+        "m.py": """
+        def f(obj, name):
+            fn = getattr(obj, name)
+            return fn()
+
+        def g(src):
+            exec(src)
+        """,
+    })
+    assert proj.functions["m.f"].has_dynamic_calls
+    assert proj.functions["m.g"].has_dynamic_calls
+    assert proj.callees("m.f") == []
+
+
+def test_unknown_receiver_attribute_call_is_unresolved(tmp_path):
+    proj = _project(tmp_path, {
+        "m.py": """
+        class C:
+            def m(self):
+                pass
+
+        def f(obj):
+            obj.m()
+        """,
+    })
+    assert proj.callees("m.f") == []  # obj's type is unknown: no edge
+
+
+def test_reachable_records_shortest_path(tmp_path):
+    proj = _project(tmp_path, {
+        "m.py": """
+        def a():
+            b()
+
+        def b():
+            c()
+
+        def c():
+            pass
+        """,
+    })
+    reach = proj.reachable(["m.a"])
+    assert reach["m.c"] == ("m.a", "m.b", "m.c")
+
+
+def test_duplicate_loose_stems_do_not_collide(tmp_path):
+    d1 = tmp_path / "one"
+    d2 = tmp_path / "two"
+    d1.mkdir()
+    d2.mkdir()
+    (d1 / "mod.py").write_text("def f():\n    pass\n")
+    (d2 / "mod.py").write_text("def g():\n    pass\n")
+    proj = callgraph.Project([
+        load_context(str(d1 / "mod.py")),
+        load_context(str(d2 / "mod.py")),
+    ])
+    assert len(proj.modules) == 2  # second got a disambiguated name
+
+
+def test_module_name_for_walks_packages(tmp_path):
+    pkg = tmp_path / "outer" / "inner"
+    os.makedirs(pkg)
+    (tmp_path / "outer" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("")
+    (pkg / "leaf.py").write_text("")
+    assert callgraph.module_name_for(
+        str(pkg / "leaf.py")
+    ) == "outer.inner.leaf"
+    assert callgraph.module_name_for(
+        str(pkg / "__init__.py")
+    ) == "outer.inner"
