@@ -226,20 +226,44 @@ def _lint(rest) -> None:
                         "full run")
     p.add_argument("-v", "--verbose", action="store_true",
                    help="also show suppressed and baselined findings")
+    p.add_argument("--jax", action="store_true",
+                   help="ALSO run the program-level tier (jaxlint, "
+                        "docs/static-analysis.md): partition-rule "
+                        "coverage, donation verification, jaxpr hygiene, "
+                        "mesh-axis soundness — imports jax but compiles "
+                        "and allocates nothing")
     args = p.parse_args(rest)
     fmt = args.format or ("json" if args.json else "text")
 
     # The linter is stdlib-only on purpose: importing the analysis package
     # pulls in no jax (engine.py docstring) — `dml-tpu lint` stays usable
     # on hosts where backend init is broken (which is WHEN you lint).
+    # --jax opts into the program-level tier and is the one path that
+    # imports jax (still: eval_shape/make_jaxpr/lower only, nothing run).
     from distributed_machine_learning_tpu import analysis
 
     paths = args.paths or [
         os.path.dirname(os.path.abspath(analysis.__file__)) + "/.."
     ]
-    rules = None
+    # --rule restricts BOTH tiers: each name resolves to an AST rule or a
+    # jax check; naming a jax check implies --jax.  A tier with no
+    # selected rules is skipped entirely.
+    rules = jax_checks = None
     if args.rule:
-        rules = [analysis.get_rule(r) for r in args.rule]
+        rules, jax_checks = [], []
+        for r in args.rule:
+            try:
+                rules.append(analysis.get_rule(r))
+                continue
+            except KeyError:
+                pass
+            try:
+                jax_checks.append(analysis.get_jax_check(r))
+                args.jax = True
+            except KeyError:
+                print(f"error: no dmlint rule or jaxlint check named "
+                      f"{r!r}", file=sys.stderr)
+                raise SystemExit(2) from None
     baseline = args.baseline or analysis.DEFAULT_BASELINE
     if baseline == "none":
         baseline = None
@@ -251,9 +275,22 @@ def _lint(rest) -> None:
         if not only_files:
             print(f"dmlint: no .py files changed vs {args.changed}")
             raise SystemExit(0)
-    result = analysis.lint_paths(
-        paths, rules=rules, baseline_path=baseline, only_files=only_files
-    )
+    if rules is not None and not rules:
+        result = analysis.LintResult()  # only jax checks were selected
+    else:
+        result = analysis.lint_paths(
+            paths, rules=rules, baseline_path=baseline,
+            only_files=only_files,
+        )
+    if args.jax and (jax_checks is None or jax_checks):
+        jres = analysis.run_jax_checks(
+            checks=jax_checks, baseline_path=baseline,
+            only_files=only_files,
+        )
+        result.findings.extend(jres.findings)
+        result.errors.extend(jres.errors)
+        result.files_checked += jres.files_checked
+        result.findings.sort(key=lambda f: (f.file, f.line, f.rule_id))
     if args.update_baseline:
         if baseline is None:
             print("error: --update-baseline needs a baseline path",
@@ -271,9 +308,105 @@ def _lint(rest) -> None:
             "ok": result.ok,
         }, indent=2))
     elif fmt == "sarif":
-        print(json.dumps(analysis.render_sarif(result, rules), indent=2))
+        catalog = list(rules) if rules is not None else list(
+            analysis.ALL_RULES
+        )
+        if args.jax:
+            catalog += (
+                list(jax_checks) if jax_checks
+                else analysis.jax_check_catalog()
+            )
+        print(json.dumps(analysis.render_sarif(result, catalog), indent=2))
     else:
         print(analysis.render(result, verbose=args.verbose))
+    raise SystemExit(0 if result.ok else 1)
+
+
+def _audit_sharding(rest) -> None:
+    """``dml-tpu audit-sharding``: the jax tier plus per-family coverage
+    reports — the operator view of ``lint --jax`` (same gate, same exit
+    semantics, with the sharding arithmetic printed instead of implied)."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="audit-sharding",
+        description="program-level sharding/donation audit (jaxlint; "
+                    "alias for the jax tier of `lint --jax` plus "
+                    "per-family partition coverage reports)",
+    )
+    p.add_argument("families", nargs="*", default=None,
+                   help="model families to report on (default: every "
+                        "registered family with canonical configs)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable reports + findings")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: analysis/baseline.json; "
+                        "'none' disables)")
+    args = p.parse_args(rest)
+
+    from distributed_machine_learning_tpu import analysis
+    from distributed_machine_learning_tpu.analysis.jaxlint import (
+        coverage as coverage_lib,
+    )
+    from distributed_machine_learning_tpu.models.partition_rules import (
+        PARTITION_RULE_TABLES,
+    )
+
+    families = args.families or sorted(
+        f for f in coverage_lib.KNOWN_FAMILY_CONFIGS
+        if f in PARTITION_RULE_TABLES
+    )
+    reports = []
+    for family in families:
+        if family not in PARTITION_RULE_TABLES:
+            print(f"error: no partition-rule table for family "
+                  f"{family!r}", file=sys.stderr)
+            raise SystemExit(2)
+        reports.append(coverage_lib.coverage_report(family))
+    # A shared table's rule is dead only if NO audited family fires it
+    # (the same union the lint gate applies) — the report must not claim
+    # debt the gate would not.
+    fired_union = {}
+    for rep in reports:
+        key = (rep["anchor_path"], rep["anchor_symbol"])
+        fired_union.setdefault(key, set()).update(rep["fired"])
+    for rep in reports:
+        key = (rep["anchor_path"], rep["anchor_symbol"])
+        rep["dead_rules"] = [
+            d for d in rep["dead_rules"]
+            if d["index"] not in fired_union[key]
+        ]
+    baseline = args.baseline or analysis.DEFAULT_BASELINE
+    if baseline == "none":
+        baseline = None
+    result = analysis.run_jax_checks(baseline_path=baseline)
+    if args.json:
+        print(json.dumps({
+            "reports": reports,
+            "findings": [f.to_json() for f in result.findings],
+            "errors": result.errors,
+            "inert": result.inert,
+            "ok": result.ok,
+        }, indent=2))
+        raise SystemExit(0 if result.ok else 1)
+    for rep in reports:
+        covered = rep["num_leaves"] - len(rep["unmatched"])
+        print(f"[{rep['family']}] {rep['num_rules']} rule(s), "
+              f"{rep['num_leaves']} non-scalar leaves over configs "
+              f"({', '.join(rep['configs'])}): {covered} covered, "
+              f"{len(rep['unmatched'])} unmatched, "
+              f"{len(rep['dead_rules'])} dead rule(s), "
+              f"{len(rep['non_dividing'])} non-dividing")
+        for u in rep["unmatched"]:
+            print(f"    unmatched: {u['path']} {u['shape']} "
+                  f"({100 * u['fraction']:.1f}%, {u['config']})")
+        for d in rep["dead_rules"]:
+            print(f"    dead: {d['pattern']}")
+        for n in rep["non_dividing"]:
+            print(f"    non-dividing: {n['path']} dim {n['dim']} vs "
+                  f"{n['axis']} of {n['mesh']}")
+    print(analysis.render(result))
+    print(f"jaxlint inert: {result.inert}")
     raise SystemExit(0 if result.ok else 1)
 
 
@@ -461,12 +594,15 @@ def main(argv=None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
     usage = (
         "usage: python -m distributed_machine_learning_tpu "
-        "{worker|info|probe|analyze|lint|serve|export-bundle|export-orbax} "
-        "[args]\n"
+        "{worker|info|probe|analyze|lint|audit-sharding|serve|"
+        "export-bundle|export-orbax} [args]\n"
         "  worker         host trial supervisor (see 'worker --help')\n"
         "  lint           dmlint static analysis over the package (or given\n"
         "                 paths); exit 1 on any unsuppressed finding\n"
-        "                 (--changed for pre-commit, --format=sarif for CI)\n"
+        "                 (--changed for pre-commit, --format=sarif for CI,\n"
+        "                 --jax for the program-level jaxlint tier)\n"
+        "  audit-sharding program-level sharding/donation audit (the jax\n"
+        "                 tier + per-family partition coverage reports)\n"
         "  info           jax backend/device summary for this process\n"
         "  probe          bounded accelerator health check (child process)\n"
         "  analyze        <experiment_dir>: best config + trial table of a\n"
@@ -494,6 +630,8 @@ def main(argv=None) -> None:
         _analyze(rest)
     elif cmd == "lint":
         _lint(rest)
+    elif cmd == "audit-sharding":
+        _audit_sharding(rest)
     elif cmd == "serve":
         _serve(rest)
     elif cmd == "export-bundle":

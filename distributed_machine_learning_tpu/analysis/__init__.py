@@ -22,7 +22,14 @@ invariant violation that could have been caught mechanically (ISSUE 6):
 
 This package imports NO jax (and must stay that way): the linter runs in
 environments where initializing a backend is wrong or impossible, and
-``locks`` is imported by low-level modules everywhere.
+``locks`` is imported by low-level modules everywhere.  The ONE scoped
+exception is the program-level tier (:mod:`jaxlint`, dmlint v3 /
+ISSUE 12): it audits jaxprs and lowered modules, so *running* it needs
+jax — but every jax import in it is function-local, it is loaded lazily
+(:func:`run_jax_checks` below), and even then it only ever calls
+``eval_shape`` / ``make_jaxpr`` / ``lower()`` — nothing allocated,
+nothing compiled (enforced by a tier-1 inertness test).  Run it with
+``dml-tpu lint --jax`` or ``dml-tpu audit-sharding``.
 
 Catalog, severities, and the suppression/baseline workflow:
 docs/static-analysis.md.
@@ -54,3 +61,28 @@ from distributed_machine_learning_tpu.analysis.rules import (  # noqa: F401
     CHECKPOINT_PATH_PATTERNS,
     get_rule,
 )
+
+
+def run_jax_checks(*args, **kwargs):
+    """Lazy surface over :func:`jaxlint.run_jax_checks` — importing this
+    package must never pull jax; only running the jax tier does."""
+    from distributed_machine_learning_tpu.analysis.jaxlint import (
+        run_jax_checks as _run,
+    )
+
+    return _run(*args, **kwargs)
+
+
+def jax_check_catalog():
+    """The jax-tier check list (JaxCheck instances), lazily imported."""
+    from distributed_machine_learning_tpu.analysis.jaxlint import JAX_CHECKS
+
+    return list(JAX_CHECKS)
+
+
+def get_jax_check(name: str):
+    from distributed_machine_learning_tpu.analysis.jaxlint import (
+        get_jax_check as _get,
+    )
+
+    return _get(name)

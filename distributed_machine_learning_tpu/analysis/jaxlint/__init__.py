@@ -1,0 +1,44 @@
+"""jaxlint — the program-level analysis tier (dmlint v3, ISSUE 12).
+
+dmlint's AST tier (analysis/rules.py) audits the *Python*; every expensive
+failure class left lives in the *JAX program* and is invisible to source
+text: a partition-rule table whose unmatched leaves silently replicate a
+flagship over HBM, donation defeated by a layout/dtype mismatch the
+compiled aliasing table quietly drops, a host callback smuggled into a
+``lax.scan`` body, a non-bit-stable transcendental inside the PBT
+determinism contract, a collective or sharding constraint naming a mesh
+axis that doesn't exist.  This subpackage inspects jaxprs and lowered
+modules instead of source text.
+
+The contract that makes it trustworthy: **every check uses only
+``eval_shape`` / ``make_jaxpr`` / ``lower()`` — nothing is allocated and
+nothing is compiled or executed**.  ``run_jax_checks`` measures its own
+inertness (compile-tracker event deltas + live-array deltas) and a tier-1
+test enforces it, so the auditor can run on a host whose accelerator you
+do not want to touch.
+
+Unlike the AST tier (stdlib-only by design), this tier imports jax — but
+only inside functions, so ``import analysis.jaxlint`` (and the plain
+``dml-tpu lint``) still works on hosts where backend init is broken.
+
+Surface: ``dml-tpu lint --jax`` (both tiers, one gate) and
+``dml-tpu audit-sharding`` (the jax tier plus per-family coverage
+reports).  Findings reuse the dmlint Finding model, inline suppressions,
+the baseline, ``--changed`` filtering, and SARIF output.
+"""
+
+from __future__ import annotations
+
+from distributed_machine_learning_tpu.analysis.jaxlint.runner import (
+    JAX_CHECKS,
+    JaxLintResult,
+    get_jax_check,
+    run_jax_checks,
+)
+
+__all__ = [
+    "JAX_CHECKS",
+    "JaxLintResult",
+    "get_jax_check",
+    "run_jax_checks",
+]

@@ -1,0 +1,489 @@
+"""DML101 jax-partition-coverage: audit rule tables against REAL param trees.
+
+``models/partition_rules.py`` is a promise ("this family shards like
+this"); nothing checked that the promise covers the parameters the models
+actually have.  The failure modes, each priced by ``eval_shape`` (nothing
+allocated):
+
+* **unmatched leaf** — a big matrix leaf that falls through to the
+  catch-all replicates on EVERY device; at flagship scale that is the
+  silent HBM blow-up the born-sharded init exists to prevent;
+* **dead rule** — a table entry no leaf of any representative config ever
+  matches: a typo'd path regex, or debt from a renamed flax module (the
+  rule LOOKS like coverage but isn't);
+* **non-dividing axis** — ``clean_spec`` silently drops a sharding axis
+  whose mesh size does not divide the dim, so the leaf replicates while
+  the table claims otherwise;
+* **over-budget flagship** — the per-device bytes of the flagship config
+  priced UNDER its rule table exceed ``single_chip_hbm_bytes()``: the
+  "fits sharded" claim is arithmetic, so check the arithmetic.
+
+Representative configs live in :data:`KNOWN_FAMILY_CONFIGS` — families a
+test registers at runtime are deliberately NOT audited (the registry is
+process state; auditing it would make findings depend on test order).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from distributed_machine_learning_tpu.analysis.findings import Finding
+from distributed_machine_learning_tpu.analysis.jaxlint.base import (
+    AuditContext,
+    JaxCheck,
+    assignment_line,
+    rule_entry_lines,
+)
+
+# One entry per registered family: the configs whose eval_shape'd param
+# trees the table must cover.  Variants matter — the transformer table
+# serves dense, MoE ("ep"-sharded expert stacks), and depthwise-separable
+# feed-forwards, and a rule is only dead if NO variant fires it.
+KNOWN_FAMILY_CONFIGS: Dict[str, List[Dict[str, Any]]] = {
+    "transformer": [
+        {"model": "transformer", "d_model": 256, "num_heads": 4,
+         "num_layers": 2, "dim_feedforward": 512, "max_seq_length": 8},
+        {"model": "transformer", "d_model": 64, "num_heads": 4,
+         "num_layers": 1, "feedforward_type": "moe", "num_experts": 4,
+         "max_seq_length": 8},
+        {"model": "transformer", "d_model": 64, "num_heads": 4,
+         "num_layers": 1, "feedforward_type": "depthwise_separable",
+         "max_seq_length": 8},
+    ],
+    "simple_transformer": [
+        {"model": "simple_transformer", "d_model": 128, "num_heads": 4,
+         "num_layers": 2, "dim_feedforward": 256, "max_seq_length": 8},
+    ],
+    "mlp": [{"model": "mlp", "hidden_sizes": (64, 32)}],
+    "cnn1d": [{"model": "cnn1d", "channels": (32, 64)}],
+    "rnn": [
+        {"model": "rnn", "hidden_size": 64, "cell_type": "lstm"},
+        {"model": "rnn", "hidden_size": 64, "cell_type": "gru"},
+    ],
+    "resnet18": [{"model": "resnet18"}],
+}
+
+# The mesh shapes rule intent is priced against: the tier-1 8-device
+# (dp, tp) mesh and an ep-carrying variant for expert stacks.
+DEFAULT_MESH_SHAPES: Tuple[Dict[str, int], ...] = (
+    {"dp": 2, "tp": 4},
+    {"dp": 2, "ep": 2, "tp": 2},
+)
+
+# A replicated-by-catch-all leaf below this fraction of the family's total
+# parameters is noise (funnel-head tails, output kernels), not an HBM
+# risk; above it, silence is exactly the failure mode being audited.
+DEFAULT_LEAF_FRACTION = 0.02
+
+
+def _sample_shape(config: Dict[str, Any]) -> Tuple[int, ...]:
+    return (1, int(config.get("max_seq_length", 8)), 4)
+
+
+def abstract_param_tree(config: Dict[str, Any]):
+    """The family's REAL param tree as ShapeDtypeStructs (the sharded
+    trainable's abstract convention probe, nothing allocated)."""
+    import jax
+
+    from distributed_machine_learning_tpu.models import build_model
+    from distributed_machine_learning_tpu.tune._regression_program import (
+        detect_call_convention,
+    )
+
+    model = build_model(dict(config, mesh=None))
+    rngs = jax.eval_shape(
+        lambda: {"params": jax.random.key(0), "dropout": jax.random.key(1)}
+    )
+    variables, _ = detect_call_convention(
+        model, jax.ShapeDtypeStruct(_sample_shape(config), "float32"),
+        init_rngs=rngs, abstract=True,
+    )
+    return variables["params"]
+
+
+def _flat_leaves(tree) -> List[Tuple[str, Tuple[int, ...], int]]:
+    """[(path, shape, size)] over non-scalar leaves."""
+    import jax
+    import numpy as np
+
+    from distributed_machine_learning_tpu.parallel.partition import (
+        _is_scalar_leaf,
+        path_str,
+    )
+
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if _is_scalar_leaf(leaf):
+            continue
+        shape = tuple(int(s) for s in leaf.shape)
+        out.append((path_str(path), shape,
+                    int(np.prod(shape, dtype=np.int64))))
+    return out
+
+
+def _is_catchall(pattern, spec) -> bool:
+    from jax.sharding import PartitionSpec as P
+
+    return isinstance(pattern, str) and pattern in (".*", "^.*$") \
+        and tuple(spec) == tuple(P())
+
+
+def _match_index(rules, path: str) -> Optional[int]:
+    from distributed_machine_learning_tpu.parallel.partition import (
+        _pattern_matches,
+    )
+
+    for i, (pattern, _spec) in enumerate(rules):
+        if _pattern_matches(pattern, path):
+            return i
+    return None
+
+
+def table_anchor(family: str, rules) -> Tuple[str, Optional[str]]:
+    """(abs path, symbol) where this family's table is WRITTEN — prefer
+    the module whose assignment literally lists the entries (per-entry
+    line numbers for dead-rule findings) over a re-export."""
+    from distributed_machine_learning_tpu.models import partition_rules as mpr
+    from distributed_machine_learning_tpu.parallel import sharding as psh
+
+    best: Tuple[str, Optional[str]] = (os.path.abspath(mpr.__file__), None)
+    for mod in (mpr, psh):
+        path = os.path.abspath(mod.__file__)
+        for name, val in vars(mod).items():
+            if val is rules and name.isupper():
+                if len(rule_entry_lines(path, name)) == len(rules):
+                    return path, name
+                if best[1] is None:
+                    best = (path, name)
+    return best
+
+
+class PartitionCoverageCheck(JaxCheck):
+    name = "jax-partition-coverage"
+    rule_id = "DML101"
+    severity = "error"
+    description = (
+        "Partition-rule coverage audited against the family's REAL "
+        "eval_shape'd param tree: big leaves silently falling through to "
+        "the replicate catch-all (the HBM blow-up born-sharded init "
+        "exists to prevent), dead rules no leaf ever matches, sharding "
+        "axes clean_spec silently drops because the mesh size does not "
+        "divide the dim, and a flagship whose per-device bytes priced "
+        "UNDER its own rule table exceed single_chip_hbm_bytes()."
+    )
+    _HINT = (
+        "add a rule for the leaf (or an explicit `(pattern, P())` "
+        "documenting the replicate), delete/fix the dead pattern, or "
+        "resize the dim to divide the mesh axis"
+    )
+
+    def check(self, audit: AuditContext) -> Iterator[Finding]:
+        from distributed_machine_learning_tpu.models.partition_rules import (
+            PARTITION_RULE_TABLES,
+        )
+
+        reports = []
+        for family in sorted(KNOWN_FAMILY_CONFIGS):
+            rules = PARTITION_RULE_TABLES.get(family)
+            if rules is None:
+                continue
+            reports.append((family, rules, coverage_report(family)))
+        # A table may be SHARED across families (the transformer entry
+        # serves simple_transformer too): a rule is dead only if NO
+        # family sharing the table fires it, and the finding is emitted
+        # once per table, not once per family.
+        fired_union: Dict[int, set] = {}
+        families_of: Dict[int, List[str]] = {}
+        for family, rules, rep in reports:
+            fired_union.setdefault(id(rules), set()).update(rep["fired"])
+            families_of.setdefault(id(rules), []).append(family)
+        seen_tables: set = set()
+        for family, rules, rep in reports:
+            if id(rules) in seen_tables:
+                rep["dead_rules"] = []
+            else:
+                seen_tables.add(id(rules))
+                rep["dead_rules"] = [
+                    d for d in rep["dead_rules"]
+                    if d["index"] not in fired_union[id(rules)]
+                ]
+                rep["dead_families"] = families_of[id(rules)]
+            yield from findings_from_report(rep, check=self)
+        yield from self._flagship_budget_findings()
+
+    # -- the flagship fit claim ---------------------------------------------
+
+    def _flagship_budget_findings(self) -> Iterator[Finding]:
+        from distributed_machine_learning_tpu.models import (
+            partition_rules as mpr,
+        )
+        from distributed_machine_learning_tpu.models.flagship import (
+            flagship_sharded_config,
+            single_chip_hbm_bytes,
+        )
+
+        budget = single_chip_hbm_bytes()
+        try:
+            config = flagship_sharded_config(budget)
+        except ValueError:
+            return
+        per_device = sharded_bytes_per_device(
+            config, dict(config["mesh_shape"])
+        )
+        if per_device > budget:
+            path = os.path.abspath(mpr.__file__)
+            yield self.finding(
+                path,
+                assignment_line(path, "PARTITION_RULE_TABLES"),
+                f"the flagship config (d_model={config['d_model']}) does "
+                f"NOT fit sharded: {per_device} bytes/device under mesh "
+                f"{config['mesh_shape']} and the transformer rule table "
+                f"vs a {budget}-byte single-chip budget",
+                "shard the dominating leaves (see audit-sharding's "
+                "coverage report) or grow the mesh",
+            )
+
+
+def audit_table(
+    rules,
+    trees: Sequence[Tuple[str, Any]],
+    *,
+    anchor_path: str,
+    anchor_symbol: Optional[str] = None,
+    mesh_shapes: Sequence[Dict[str, int]] = DEFAULT_MESH_SHAPES,
+    leaf_fraction: float = DEFAULT_LEAF_FRACTION,
+    check: Optional[PartitionCoverageCheck] = None,
+) -> List[Finding]:
+    """Audit one rule table against ``[(config_name, param_tree)]`` —
+    the fixture-facing core the repo-wide check builds on."""
+    report = _table_report(
+        rules, trees,
+        anchor_path=anchor_path, anchor_symbol=anchor_symbol,
+        mesh_shapes=mesh_shapes, leaf_fraction=leaf_fraction,
+    )
+    return list(findings_from_report(report, check=check))
+
+
+def _table_report(
+    rules,
+    trees: Sequence[Tuple[str, Any]],
+    *,
+    anchor_path: str,
+    anchor_symbol: Optional[str],
+    mesh_shapes: Sequence[Dict[str, int]],
+    leaf_fraction: float,
+    family: str = "",
+) -> Dict[str, Any]:
+    from distributed_machine_learning_tpu.parallel.partition import (
+        clean_spec_report,
+    )
+
+    rules = tuple(rules)
+    entry_lines = (
+        rule_entry_lines(anchor_path, anchor_symbol) if anchor_symbol else []
+    )
+    table_line = (
+        assignment_line(anchor_path, anchor_symbol) if anchor_symbol else 1
+    )
+    fired: set = set()
+    unmatched: List[Dict[str, Any]] = []
+    non_dividing: List[Dict[str, Any]] = []
+    num_leaves = 0
+    for config_name, tree in trees:
+        leaves = _flat_leaves(tree)
+        num_leaves += len(leaves)
+        total = sum(size for _, _, size in leaves) or 1
+        for path, shape, size in leaves:
+            idx = _match_index(rules, path)
+            if idx is not None:
+                fired.add(idx)
+            frac = size / total
+            covered = idx is not None and not _is_catchall(*rules[idx])
+            if not covered:
+                if len(shape) >= 2 and frac >= leaf_fraction:
+                    unmatched.append({
+                        "path": path, "shape": shape,
+                        "fraction": round(frac, 4), "config": config_name,
+                    })
+                continue
+            spec = rules[idx][1]
+            for sizes in mesh_shapes:
+                _cleaned, drops = clean_spec_report(spec, shape, sizes)
+                for dim, axis, reason in drops:
+                    if reason == "non-dividing" and frac >= leaf_fraction:
+                        non_dividing.append({
+                            "path": path, "dim": dim, "axis": axis,
+                            "mesh": dict(sizes), "shape": shape,
+                            "config": config_name,
+                        })
+    dead = [
+        {"index": i, "pattern": _pattern_repr(rules[i][0]),
+         "line": entry_lines[i] if i < len(entry_lines) else table_line}
+        for i in range(len(rules))
+        if i not in fired and not _is_catchall(*rules[i])
+    ]
+    return {
+        "family": family,
+        "anchor_path": anchor_path,
+        "anchor_symbol": anchor_symbol,
+        "table_line": table_line,
+        "configs": [name for name, _ in trees],
+        "num_rules": len(rules),
+        "num_leaves": num_leaves,
+        "fired": sorted(fired),
+        "unmatched": unmatched,
+        "dead_rules": dead,
+        "non_dividing": _dedup(non_dividing),
+    }
+
+
+def _pattern_repr(pattern) -> str:
+    if isinstance(pattern, (tuple, list)):
+        return "(" + ", ".join(str(c) for c in pattern) + ")"
+    return str(pattern)
+
+
+def _dedup(entries: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    seen = set()
+    out = []
+    for e in entries:
+        key = (e["path"], e["dim"], e["axis"], tuple(sorted(e["mesh"].items())))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(e)
+    return out
+
+
+def findings_from_report(
+    report: Dict[str, Any], check: Optional[PartitionCoverageCheck] = None
+) -> Iterator[Finding]:
+    check = check or PartitionCoverageCheck()
+    path = report["anchor_path"]
+    fam = f" [{report['family']}]" if report.get("family") else ""
+    for u in report["unmatched"]:
+        yield check.finding(
+            path, report["table_line"],
+            f"param leaf `{u['path']}` {u['shape']}{fam} matches no "
+            f"sharding rule and silently replicates on every device "
+            f"({100 * u['fraction']:.1f}% of the family's parameters, "
+            f"config: {u['config']})",
+            check._HINT,
+        )
+    scope = ", ".join(
+        report.get("dead_families") or [report.get("family") or "?"]
+    )
+    for d in report["dead_rules"]:
+        yield check.finding(
+            path, d["line"],
+            f"dead rule `{d['pattern']}`: no param leaf of any "
+            f"representative config of {scope} matches it",
+            check._HINT,
+        )
+    for n in report["non_dividing"]:
+        yield check.finding(
+            path, report["table_line"],
+            f"leaf `{n['path']}` dim {n['dim']} (size "
+            f"{n['shape'][n['dim']]}) does not divide mesh axis "
+            f"`{n['axis']}` of {n['mesh']}{fam}: clean_spec silently "
+            f"replicates it while the table claims a sharding",
+            check._HINT,
+        )
+
+
+def coverage_report(
+    family: str,
+    rules=None,
+    *,
+    mesh_shapes: Sequence[Dict[str, int]] = DEFAULT_MESH_SHAPES,
+    leaf_fraction: float = DEFAULT_LEAF_FRACTION,
+) -> Dict[str, Any]:
+    """The per-family structured report (golden-tested; printed by
+    ``dml-tpu audit-sharding``)."""
+    from distributed_machine_learning_tpu.models.partition_rules import (
+        PARTITION_RULE_TABLES,
+    )
+
+    if rules is None:
+        rules = PARTITION_RULE_TABLES[family]
+    configs = KNOWN_FAMILY_CONFIGS.get(family, [])
+    trees = []
+    for cfg in configs:
+        name = (
+            cfg.get("feedforward_type") or cfg.get("cell_type")
+            or (f"d{cfg['d_model']}" if "d_model" in cfg else "default")
+        )
+        trees.append((str(name), abstract_param_tree(cfg)))
+    anchor_path, anchor_symbol = table_anchor(family, rules)
+    return _table_report(
+        rules, trees,
+        anchor_path=anchor_path, anchor_symbol=anchor_symbol,
+        mesh_shapes=mesh_shapes, leaf_fraction=leaf_fraction,
+        family=family,
+    )
+
+
+def sharded_bytes_per_device(
+    config: Dict[str, Any], mesh_sizes: Dict[str, int]
+) -> int:
+    """Parameter + optimizer bytes PER DEVICE under the family's rule
+    table on ``mesh_sizes`` — pure shape math (:func:`jax.eval_shape` +
+    spec cleaning), the "does the flagship actually fit sharded" number."""
+    import jax
+    import numpy as np
+
+    from distributed_machine_learning_tpu.models.partition_rules import (
+        rules_for,
+    )
+    from distributed_machine_learning_tpu.ops.optimizers import (
+        make_optimizer,
+    )
+    from distributed_machine_learning_tpu.parallel.partition import (
+        clean_spec_report,
+        match_partition_rules,
+    )
+    from jax.sharding import PartitionSpec as P
+
+    params = abstract_param_tree(config)
+    rules = rules_for(config)
+    specs = match_partition_rules(rules, params)
+    tx = make_optimizer(str(config.get("optimizer", "adam")),
+                        learning_rate=1e-3)
+    opt_state = jax.eval_shape(tx.init, params)
+
+    spec_by_path = {}
+    for path, spec in jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=lambda x: isinstance(x, P)
+    )[0]:
+        spec_by_path[tuple(repr(k) for k in path)] = spec
+
+    def leaf_bytes(path, leaf) -> int:
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if not shape:
+            return int(getattr(leaf.dtype, "itemsize", 4)) if hasattr(
+                leaf, "dtype") else 4
+        nbytes = int(np.prod(shape, dtype=np.int64)) * leaf.dtype.itemsize
+        # optimizer moments inherit the param's spec by path suffix
+        # (parallel/sharding.opt_state_shardings' matching rule)
+        spath = tuple(repr(k) for k in path)
+        spec = None
+        for i in range(len(spath)):
+            spec = spec_by_path.get(spath[i:])
+            if spec is not None:
+                break
+        if spec is None:
+            return nbytes
+        cleaned, _ = clean_spec_report(spec, shape, mesh_sizes)
+        div = 1
+        for axis in cleaned:
+            if axis is not None:
+                div *= int(mesh_sizes[axis])
+        return nbytes // max(div, 1)
+
+    total = 0
+    for tree in (params, opt_state):
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                total += leaf_bytes(path, leaf)
+    return total

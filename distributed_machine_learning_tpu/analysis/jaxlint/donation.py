@@ -1,0 +1,119 @@
+"""DML102 jax-donation-defeated: confirm donation from the lowered module.
+
+``donate_argnums`` is a request, not a guarantee: jax decides at LOWERING
+time which donated inputs actually alias an output (aval + layout + memory
+kind must match), and a defeated donation costs a silent extra copy of the
+largest buffers in the program — the bug class PR 7 found by hand in
+bench.py's flagship measure step, and the one the runtime
+``donation_aliased_buffers`` counter can only see after paying for a real
+dispatch.  This check reads the decision where it is made — the
+``tf.aliasing_output`` / ``jax.buffer_donor`` attributes of
+``jit(...).lower(...)`` (``compilecache.aot.lowered_alias_info``) — so the
+audit needs no device, no compile, no allocation.
+
+Per-program contract (``programs.FusedProgram``): every leaf of a
+``must_alias`` argnum must carry ``tf.aliasing_output``; ``consume_only``
+slabs are exempt (no output shares their aval — donation there buys
+buffer scavenging, not aliasing); an argnum donated in the program but
+declared in NEITHER class is a registry drift and is reported too.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+from distributed_machine_learning_tpu.analysis.findings import Finding
+from distributed_machine_learning_tpu.analysis.jaxlint.base import (
+    AuditContext,
+    JaxCheck,
+)
+
+
+class DonationCheck(JaxCheck):
+    name = "jax-donation-defeated"
+    rule_id = "DML102"
+    severity = "error"
+    description = (
+        "A donate_argnums entry of a fused epoch/PBT program does not "
+        "actually alias any output in the lowered module: the donation "
+        "is silently dropped and the program pays an extra copy of its "
+        "largest buffers (params + optimizer state) on every dispatch.  "
+        "Verified from jit(...).lower()'s input/output aliasing table — "
+        "the decision point itself — for every registered fused program "
+        "(resident, sharded, streaming-chunk x2, PBT generation)."
+    )
+    _HINT = (
+        "pin out_shardings to the input layout (a donated buffer can "
+        "only alias an identically-laid-out output), keep the output "
+        "aval identical to the donated input's, or reclassify the arg "
+        "as consume_only if no output legitimately matches"
+    )
+
+    def check(self, audit: AuditContext) -> Iterator[Finding]:
+        for prog in audit.programs():
+            if prog.role == "pbt-decision":
+                continue  # the whitelist's stub program, not a real jit
+            yield from audit_program(
+                prog, lowered=audit.lowered_of(prog), check=self
+            )
+
+
+def audit_program(
+    prog, lowered=None, check: Optional[DonationCheck] = None
+) -> List[Finding]:
+    """Verify one :class:`programs.FusedProgram`'s donation contract from
+    its lowered module (lowering it here if not supplied)."""
+    import jax
+
+    from distributed_machine_learning_tpu.compilecache.aot import (
+        lowered_alias_info,
+    )
+
+    check = check or DonationCheck()
+    if lowered is None:
+        lowered = prog.lower()
+    info = lowered_alias_info(lowered)
+    ranges = prog.flat_arg_ranges()
+    findings: List[Finding] = []
+    declared = set(prog.must_alias) | set(prog.consume_only)
+    for argnum in sorted(prog.donate_argnums):
+        start, stop = ranges.get(argnum, (0, 0))
+        leaves = jax.tree_util.tree_leaves(prog.example_args[argnum])
+        missing = [
+            i for i in range(start, stop)
+            if i not in info["aliased"]
+        ]
+        if argnum in prog.must_alias:
+            if missing:
+                shapes = ", ".join(
+                    str(tuple(leaves[i - start].shape)) for i in missing[:4]
+                )
+                findings.append(check.finding(
+                    prog.anchor_path, prog.anchor_line,
+                    f"program `{prog.name}`: donated argnum {argnum} has "
+                    f"{len(missing)}/{stop - start} buffer(s) that alias "
+                    f"NO output in the lowered module (e.g. shapes "
+                    f"{shapes}) — donation defeated, the update pays a "
+                    f"full extra copy",
+                    check._HINT,
+                ))
+        elif argnum not in declared:
+            findings.append(check.finding(
+                prog.anchor_path, prog.anchor_line,
+                f"program `{prog.name}`: donated argnum {argnum} is "
+                f"declared neither must_alias nor consume_only in the "
+                f"fused-program registry — the verifier cannot vouch "
+                f"for it",
+                "classify the argnum in analysis/jaxlint/programs.py",
+            ))
+    # must_alias args that are NOT donated at all: the registry says the
+    # in-place update exists, the program disagrees.
+    for argnum in prog.must_alias:
+        if argnum not in prog.donate_argnums:
+            findings.append(check.finding(
+                prog.anchor_path, prog.anchor_line,
+                f"program `{prog.name}`: argnum {argnum} is declared "
+                f"must_alias but the program does not donate it",
+                "add it to donate_argnums (or fix the registry entry)",
+            ))
+    return findings

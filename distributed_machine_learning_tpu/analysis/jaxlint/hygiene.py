@@ -1,0 +1,161 @@
+"""DML103 jax-hygiene: jaxpr scans over the fused programs.
+
+Four scans, each a class of bug the AST tier cannot see because the
+offending op only exists after tracing:
+
+* **host callback inside lax.scan** — a ``debug_callback`` /
+  ``pure_callback`` / ``io_callback`` in a scan body synchronizes
+  device->host once PER STEP; inside the fused epoch scan that turns one
+  dispatch per epoch back into hundreds (the regression DML010 guards at
+  source level, re-checked here where wrappers/closures can't hide it);
+* **implicit f64 promotion** — an f64/c128 aval anywhere in an f32
+  program (a python float touching a weak-typed array under x64) doubles
+  bytes and halves TPU throughput silently;
+* **device transfer in traced code** — a ``device_put`` primitive inside
+  a jaxpr is a host round-trip baked into the program body;
+* **transcendental whitelist (PBT decision program)** — PR 9's
+  bit-parity contract: exploit/explore decisions are built ONLY from
+  threefry draw bits, IEEE multiply/clip, integer truncation, and grid
+  gathers, because XLA's fused transcendentals are NOT bit-stable vs
+  eager.  The whitelist runs on the generation program built with
+  transcendental-free stub epoch/eval bodies, so every flagged primitive
+  belongs to the decision machinery itself.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+from distributed_machine_learning_tpu.analysis.findings import Finding
+from distributed_machine_learning_tpu.analysis.jaxlint.base import (
+    PKG_DIR,
+    AuditContext,
+    JaxCheck,
+    eqn_line,
+    iter_eqns,
+)
+
+CALLBACK_PRIMITIVES = frozenset({
+    "debug_callback", "pure_callback", "io_callback", "python_callback",
+    "callback", "outside_call", "host_callback",
+})
+
+SCAN_PRIMITIVES = frozenset({"scan", "while"})
+
+TRANSFER_PRIMITIVES = frozenset({"device_put", "copy_to_host", "transfer"})
+
+# Primitives whose lowering may fuse into non-bit-stable approximations
+# (XLA is free to substitute rational/polynomial kernels per backend and
+# per fusion decision) — banned from the PBT decision path.
+TRANSCENDENTAL_PRIMITIVES = frozenset({
+    "exp", "exp2", "expm1", "log", "log2", "log1p", "logistic", "tanh",
+    "sin", "cos", "tan", "asin", "acos", "atan", "atan2", "sinh", "cosh",
+    "asinh", "acosh", "atanh", "erf", "erfc", "erf_inv", "pow", "sqrt",
+    "rsqrt", "cbrt", "digamma", "lgamma", "igamma", "igammac",
+})
+
+_WIDE_DTYPES = ("float64", "complex128")
+
+
+def _explicit_transfer(eqn) -> bool:
+    """True when a ``device_put`` names a concrete device/sharding — a
+    placement decision BAKED into the program.  ``jnp.asarray`` on a host
+    constant traces to ``device_put`` with ``devices=[None]`` (jax's own
+    constant staging, harmless); only an explicit target is a finding."""
+    devices = eqn.params.get("devices")
+    if devices is None:
+        return True  # older lowering: no param means explicit call form
+    return any(d is not None for d in devices)
+
+
+class HygieneCheck(JaxCheck):
+    name = "jax-hygiene"
+    rule_id = "DML103"
+    severity = "error"
+    description = (
+        "Jaxpr hygiene over the fused programs: host callbacks inside "
+        "lax.scan bodies (a device->host sync per step), implicit "
+        "f64/weak-type promotions in f32 programs, device transfers "
+        "baked into traced code, and — on the PBT decision program — "
+        "the transcendental-primitive whitelist enforcing PR 9's "
+        "compiled-vs-host bit-parity contract statically."
+    )
+    _HINT = (
+        "hoist the host interaction out of the traced body; keep "
+        "decision math to threefry bits / IEEE multiply / integer "
+        "truncation / grid gathers; cast explicitly instead of letting "
+        "weak types promote"
+    )
+
+    def check(self, audit: AuditContext) -> Iterator[Finding]:
+        for prog in audit.programs():
+            jaxpr = audit.jaxpr_of(prog)
+            yield from audit_jaxpr(
+                prog.name, jaxpr.jaxpr,
+                anchor_path=prog.anchor_path,
+                anchor_line=prog.anchor_line,
+                within=PKG_DIR,
+                transcendental=(prog.role == "pbt-decision"),
+                check=self,
+            )
+
+
+def _anchor(check, eqn, within, anchor_path, anchor_line
+            ) -> Tuple[str, int]:
+    site = eqn_line(eqn, within) if within else None
+    return site if site is not None else (anchor_path, anchor_line)
+
+
+def audit_jaxpr(
+    prog_name: str,
+    jaxpr,
+    *,
+    anchor_path: str,
+    anchor_line: int = 1,
+    within: Optional[str] = None,
+    transcendental: bool = False,
+    check: Optional[HygieneCheck] = None,
+) -> List[Finding]:
+    """Scan one jaxpr (recursively, sub-jaxprs included).  Findings anchor
+    at the offending op's own traceback frame inside ``within`` when the
+    trace preserved one, else at the program's registry anchor."""
+    check = check or HygieneCheck()
+    findings: List[Finding] = []
+    seen = set()
+
+    def emit(eqn, message: str) -> None:
+        path, line = _anchor(check, eqn, within, anchor_path, anchor_line)
+        key = (path, line, message.split(":", 1)[0])
+        if key in seen:
+            return
+        seen.add(key)
+        findings.append(check.finding(path, line, message, check._HINT))
+
+    for eqn, stack in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name in CALLBACK_PRIMITIVES and any(
+            s in SCAN_PRIMITIVES for s in stack
+        ):
+            emit(eqn,
+                 f"host callback `{name}` inside a lax.scan body of "
+                 f"program `{prog_name}`: a device->host synchronization "
+                 f"per scan step")
+        if name in TRANSFER_PRIMITIVES and _explicit_transfer(eqn):
+            emit(eqn,
+                 f"device transfer `{name}` baked into traced code of "
+                 f"program `{prog_name}`")
+        for v in eqn.outvars:
+            dtype = str(getattr(getattr(v, "aval", None), "dtype", ""))
+            if dtype in _WIDE_DTYPES:
+                emit(eqn,
+                     f"implicit {dtype} promotion in program "
+                     f"`{prog_name}` (`{name}` output): f32 programs "
+                     f"must not silently widen")
+                break
+        if transcendental and name in TRANSCENDENTAL_PRIMITIVES:
+            emit(eqn,
+                 f"transcendental primitive `{name}` in the PBT "
+                 f"DECISION program `{prog_name}`: XLA's fused "
+                 f"transcendentals are not bit-stable vs eager, which "
+                 f"breaks the compiled-vs-host parity contract (PR 9)")
+    return findings

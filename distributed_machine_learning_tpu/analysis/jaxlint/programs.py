@@ -1,0 +1,416 @@
+"""The fused-program registry: the donated epoch programs, built abstractly.
+
+The donation verifier and the hygiene scans audit the EXACT program bodies
+the trainables run — ``make_epoch_fn`` / ``make_indexed_epoch_fn`` /
+``make_chunk_epoch_fn`` / ``make_indexed_chunk_fn`` /
+``make_pbt_generation_fn`` from ``tune/_regression_program.py`` — not
+reimplementations that could drift.  Every input is a
+``jax.ShapeDtypeStruct`` (param/opt trees via ``eval_shape`` over the real
+``model.init`` / ``tx.init``; PRNG keys via ``eval_shape`` over
+``jax.random.key``), so building, tracing, and lowering a program
+allocates nothing and compiles nothing.
+
+``must_alias`` vs ``consume_only``: a donated STATE buffer (params /
+opt_state / batch_stats) must genuinely alias an output — that is the
+in-place update the donation buys, and a layout/dtype drift that defeats
+it is the bug class PR 7 found by hand in bench.py.  A donated SLAB
+(the epoch/chunk batch arrays) can never alias — no output shares its
+aval — but donation still lets XLA scavenge the buffer for intermediates;
+the verifier requires nothing of those beyond being declared here, so an
+arg accidentally moved from one class to the other is itself a finding.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from distributed_machine_learning_tpu.analysis.jaxlint.base import (
+    PKG_DIR,
+    pattern_line,
+)
+
+_F32 = "float32"
+
+
+@dataclass
+class FusedProgram:
+    """One fused program plus everything the checks need to audit it."""
+
+    name: str
+    fn: Callable
+    example_args: Tuple[Any, ...]
+    donate_argnums: Tuple[int, ...]
+    must_alias: Tuple[int, ...]
+    consume_only: Tuple[int, ...] = ()
+    jit_kwargs: Dict[str, Any] = field(default_factory=dict)
+    anchor_path: str = ""
+    anchor_line: int = 1
+    mesh_axes: Optional[Dict[str, int]] = None
+    role: str = "epoch"  # "epoch" | "pbt" | "pbt-decision"
+
+    def make_jaxpr(self):
+        import jax
+
+        return jax.make_jaxpr(self.fn)(*self.example_args)
+
+    def lower(self):
+        import warnings
+
+        import jax
+
+        jitted = jax.jit(
+            self.fn, donate_argnums=self.donate_argnums, **self.jit_kwargs
+        )
+        with warnings.catch_warnings():
+            # The consume-only slabs legitimately trip jax's "donated
+            # buffers were not usable" warning at lowering — the verifier
+            # reads the aliasing table itself and judges per class.
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable"
+            )
+            return jitted.lower(*self.example_args)
+
+    def flat_arg_ranges(self) -> Dict[int, Tuple[int, int]]:
+        """argnum -> [start, stop) over the FLATTENED argument list (the
+        order the lowered module's %argN parameters follow)."""
+        import jax
+
+        out: Dict[int, Tuple[int, int]] = {}
+        offset = 0
+        for i, arg in enumerate(self.example_args):
+            n = len(jax.tree_util.tree_leaves(arg))
+            out[i] = (offset, offset + n)
+            offset += n
+        return out
+
+
+def _sds(shape, dtype=_F32):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(tuple(shape), getattr(jnp, dtype))
+
+
+def _abstract_rngs():
+    import jax
+
+    return jax.eval_shape(
+        lambda: {"params": jax.random.key(0), "dropout": jax.random.key(1)}
+    )
+
+
+def _key_aval():
+    import jax
+
+    return jax.eval_shape(lambda: jax.random.key(0))
+
+
+def _abstract_model(config, x_shape):
+    """(forward, variables, has_bn) with variables as ShapeDtypeStructs —
+    the sharded trainable's abstract convention probe, reused verbatim."""
+    from distributed_machine_learning_tpu.models import build_model
+    from distributed_machine_learning_tpu.tune._regression_program import (
+        detect_call_convention,
+        make_forward,
+    )
+
+    model = build_model(dict(config))
+    variables, flag_name = detect_call_convention(
+        model, _sds(x_shape), init_rngs=_abstract_rngs(), abstract=True
+    )
+    has_bn = "batch_stats" in variables
+    return make_forward(model, flag_name, has_bn), variables, has_bn
+
+
+def _injected_adam(total_steps: int = 16):
+    from distributed_machine_learning_tpu.ops.optimizers import (
+        make_injected_optimizer,
+    )
+    from distributed_machine_learning_tpu.ops.schedules import get_schedule
+
+    schedule = get_schedule(
+        "warmup_linear_decay", learning_rate=1.0, warmup_steps=0,
+        total_steps=total_steps,
+    )
+    return make_injected_optimizer("adam", schedule)
+
+
+def _resident_epoch() -> FusedProgram:
+    """tune/trainable.py's fused epoch program (donate_argnums=(0, 1, 2))."""
+    import jax
+
+    from distributed_machine_learning_tpu.ops.losses import get_loss
+    from distributed_machine_learning_tpu.tune._regression_program import (
+        make_epoch_fn,
+    )
+
+    forward, variables, has_bn = _abstract_model(
+        {"model": "mlp", "hidden_sizes": (16, 8), "mesh": None}, (1, 8, 4)
+    )
+    tx = _injected_adam()
+    params = variables["params"]
+    opt_state = jax.eval_shape(tx.init, params)
+    batch_stats = variables.get("batch_stats", {})
+    epoch = make_epoch_fn(forward, tx, get_loss("mse"),
+                          n_train=64, num_batches=4, batch_size=16)
+    return FusedProgram(
+        name="resident_epoch",
+        fn=epoch,
+        example_args=(params, opt_state, batch_stats,
+                      _sds((64, 8, 4)), _sds((64, 1)), _key_aval()),
+        donate_argnums=(0, 1, 2),
+        must_alias=(0, 1, 2),
+        anchor_path=os.path.join(PKG_DIR, "tune", "trainable.py"),
+        anchor_line=pattern_line(
+            os.path.join(PKG_DIR, "tune", "trainable.py"),
+            "donate_argnums=(0, 1, 2)",
+        ),
+    )
+
+
+def _streaming_chunk() -> FusedProgram:
+    """tune/trainable.py's streaming chunk program
+    (donate_argnums=(0, 1, 2, 4, 5): state + the consumed slab)."""
+    import jax
+
+    from distributed_machine_learning_tpu.ops.losses import get_loss
+    from distributed_machine_learning_tpu.tune._regression_program import (
+        make_chunk_epoch_fn,
+    )
+
+    forward, variables, _ = _abstract_model(
+        {"model": "mlp", "hidden_sizes": (16, 8), "mesh": None}, (1, 8, 4)
+    )
+    tx = _injected_adam()
+    params = variables["params"]
+    opt_state = jax.eval_shape(tx.init, params)
+    chunk = make_chunk_epoch_fn(forward, tx, get_loss("mse"))
+    return FusedProgram(
+        name="streaming_chunk",
+        fn=chunk,
+        example_args=(params, opt_state, {}, _key_aval(),
+                      _sds((2, 16, 8, 4)), _sds((2, 16, 1))),
+        donate_argnums=(0, 1, 2, 4, 5),
+        must_alias=(0, 1, 2),
+        consume_only=(4, 5),
+        anchor_path=os.path.join(PKG_DIR, "tune", "trainable.py"),
+        anchor_line=pattern_line(
+            os.path.join(PKG_DIR, "tune", "trainable.py"),
+            "donate_argnums=(0, 1, 2, 4, 5)",
+        ),
+    )
+
+
+def _sharded_mesh():
+    """A 1x1 (dp, tp) mesh over the first local device: enough to carry
+    NamedShardings, activation pins, and the rule layout through lowering
+    without requiring a multi-device host (sizes 1 change nothing about
+    the aliasing/primitive structure being audited)."""
+    import jax
+
+    from distributed_machine_learning_tpu.parallel.mesh import make_mesh
+
+    return make_mesh({"dp": 1, "tp": 1}, list(jax.devices())[:1])
+
+
+def _sharded_programs() -> Tuple[FusedProgram, FusedProgram]:
+    """tune/trainable_sharded.py's fused epoch + streaming chunk programs,
+    with the real rule-table shardings and activation pins in play."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_machine_learning_tpu.models import build_model
+    from distributed_machine_learning_tpu.models.partition_rules import (
+        rules_for,
+    )
+    from distributed_machine_learning_tpu.ops.losses import get_loss
+    from distributed_machine_learning_tpu.parallel.sharding import (
+        opt_state_shardings,
+        param_shardings,
+    )
+    from distributed_machine_learning_tpu.tune._regression_program import (
+        detect_call_convention,
+        make_forward,
+        make_indexed_chunk_fn,
+        make_indexed_epoch_fn,
+    )
+
+    mesh = _sharded_mesh()
+    config = {
+        "model": "transformer", "d_model": 64, "num_heads": 4,
+        "num_layers": 1, "dim_feedforward": 128, "max_seq_length": 8,
+    }
+    model = build_model(dict(config, mesh=mesh))
+    variables, flag_name = detect_call_convention(
+        model, _sds((1, 8, 4)), init_rngs=_abstract_rngs(), abstract=True
+    )
+    has_bn = "batch_stats" in variables
+    forward = make_forward(model, flag_name, has_bn)
+    tx = _injected_adam()
+    params = variables["params"]
+    opt_state = jax.eval_shape(tx.init, params)
+    batch_stats = variables.get("batch_stats", {})
+    rules = rules_for(config)
+    p_sh = param_shardings(params, mesh, rules)
+    o_sh = opt_state_shardings(opt_state, p_sh, mesh)
+    repl = NamedSharding(mesh, P())
+    bs_sh = jax.tree.map(lambda _: repl, batch_stats)
+    xb_sh = NamedSharding(mesh, P(None, "dp", None, None))
+    yb_sh = NamedSharding(mesh, P(None, "dp", None))
+    loss_fn = get_loss("mse")
+    epoch = make_indexed_epoch_fn(forward, tx, loss_fn)
+    chunk = make_indexed_chunk_fn(forward, tx, loss_fn)
+    sharded_path = os.path.join(PKG_DIR, "tune", "trainable_sharded.py")
+    mesh_axes = {"dp": 1, "tp": 1}
+    epoch_prog = FusedProgram(
+        name="sharded_epoch",
+        fn=epoch,
+        example_args=(params, opt_state, batch_stats,
+                      _sds((4, 8, 8, 4)), _sds((4, 8, 1)), _key_aval()),
+        donate_argnums=(0, 1, 2, 3, 4),
+        must_alias=(0, 1, 2),
+        consume_only=(3, 4),
+        jit_kwargs={
+            "in_shardings": (p_sh, o_sh, bs_sh, xb_sh, yb_sh, repl),
+            "out_shardings": (p_sh, o_sh, bs_sh, repl),
+        },
+        anchor_path=sharded_path,
+        anchor_line=pattern_line(sharded_path, "_EPOCH_DONATE = "),
+        mesh_axes=mesh_axes,
+    )
+    import jax.numpy as jnp
+
+    chunk_prog = FusedProgram(
+        name="sharded_stream_chunk",
+        fn=chunk,
+        example_args=(params, opt_state, batch_stats,
+                      jax.ShapeDtypeStruct((), jnp.int32),
+                      _sds((2, 8, 8, 4)), _sds((2, 8, 1)), _key_aval()),
+        donate_argnums=(0, 1, 2, 4, 5),
+        must_alias=(0, 1, 2),
+        consume_only=(4, 5),
+        jit_kwargs={
+            "in_shardings": (p_sh, o_sh, bs_sh, repl, xb_sh, yb_sh, repl),
+            "out_shardings": (p_sh, o_sh, bs_sh, repl),
+        },
+        anchor_path=sharded_path,
+        anchor_line=pattern_line(sharded_path, "_CHUNK_DONATE = "),
+        mesh_axes=mesh_axes,
+    )
+    return epoch_prog, chunk_prog
+
+
+def _pbt_mutation_spec() -> Dict[str, Any]:
+    from distributed_machine_learning_tpu.tune.schedulers.pbt import (
+        RESAMPLE_GRID_POINTS,
+    )
+
+    return {
+        "sign": 1.0,
+        "quantile": 0.25,
+        "resample_p": 0.25,
+        "factors": (0.8, 1.2),
+        "keys": ("learning_rate", "weight_decay"),
+        "specs": (
+            {"key": "learning_rate", "lo": 1e-5, "hi": 1e-1, "log": True},
+            {"key": "weight_decay", "lo": 1e-6, "hi": 1e-2, "log": True},
+        ),
+        "grid_points": RESAMPLE_GRID_POINTS,
+    }
+
+
+def _pbt_args(params, opt_state, batch_stats, n_rows: int, n_gens: int):
+    import jax
+    import jax.numpy as jnp
+
+    def pop(tree):
+        return jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct((n_rows,) + tuple(l.shape),
+                                           l.dtype),
+            tree,
+        )
+
+    keys = jax.eval_shape(
+        lambda: jax.random.split(jax.random.key(0), n_rows)
+    )
+    return (
+        pop(params), pop(opt_state), pop(batch_stats),
+        keys, keys,
+        _sds((n_rows,)), _sds((n_rows,)),
+        _sds((64, 8, 4)), _sds((64, 1)),
+        _sds((32, 8, 4)), _sds((32, 1)), _sds((32,)),
+        jax.ShapeDtypeStruct((n_gens,), jnp.int32),
+        _sds(()),
+    )
+
+
+def _pbt_generation(decision_only: bool = False) -> FusedProgram:
+    """tune/vectorized.py's compiled PBT generation scan — either with the
+    real epoch/eval bodies (donation + hygiene audits) or with
+    transcendental-free stubs (``decision_only``), which strips the
+    program down to exactly the exploit/explore decision machinery whose
+    bit-parity contract (PR 9) bans transcendentals."""
+    import jax
+
+    from distributed_machine_learning_tpu.ops.losses import get_loss
+    from distributed_machine_learning_tpu.tune._regression_program import (
+        EVAL_METRIC_KEYS,
+        make_epoch_fn,
+        make_eval_fn,
+        make_pbt_generation_fn,
+    )
+
+    forward, variables, _ = _abstract_model(
+        {"model": "mlp", "hidden_sizes": (16, 8), "mesh": None}, (1, 8, 4)
+    )
+    tx = _injected_adam()
+    params = variables["params"]
+    opt_state = jax.eval_shape(tx.init, params)
+    n_rows, n_gens, interval = 8, 2, 2
+
+    if decision_only:
+        def epoch_one(p, o, b, x, y, key):
+            return p, o, b, x.sum() * 0.0
+
+        def eval_one(p, b, xv, yv, mask):
+            s = xv.sum() * 0.0
+            return {k: s for k in EVAL_METRIC_KEYS}
+    else:
+        epoch_one = make_epoch_fn(forward, tx, get_loss("mse"),
+                                  n_train=64, num_batches=4, batch_size=16)
+        eval_one = make_eval_fn(forward, "mse", n_blocks=2, eval_bs=16)
+
+    run = make_pbt_generation_fn(
+        epoch_one, eval_one, _pbt_mutation_spec(),
+        interval=interval, num_epochs_total=n_gens * interval,
+        metric="validation_mape", n_rows=n_rows, n_valid=n_rows,
+    )
+    vectorized_path = os.path.join(PKG_DIR, "tune", "vectorized.py")
+    return FusedProgram(
+        name="pbt_decision" if decision_only else "pbt_generation",
+        fn=run,
+        example_args=_pbt_args(params, opt_state, {}, n_rows, n_gens),
+        donate_argnums=(0, 1, 2),
+        must_alias=(0, 1, 2) if not decision_only else (),
+        anchor_path=vectorized_path,
+        anchor_line=pattern_line(vectorized_path,
+                                 "make_pbt_generation_fn("),
+        role="pbt-decision" if decision_only else "pbt",
+    )
+
+
+def fused_programs() -> list:
+    """Every fused program the donation verifier must confirm (ISSUE 12:
+    resident, sharded, streaming-chunk x2, PBT generation) plus the
+    decision-only PBT program the transcendental whitelist runs on."""
+    sharded_epoch, sharded_chunk = _sharded_programs()
+    return [
+        _resident_epoch(),
+        sharded_epoch,
+        _streaming_chunk(),
+        sharded_chunk,
+        _pbt_generation(),
+        _pbt_generation(decision_only=True),
+    ]

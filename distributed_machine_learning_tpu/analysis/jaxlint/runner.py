@@ -1,0 +1,140 @@
+"""jaxlint runner: run the jax-tier checks through the dmlint machinery.
+
+Findings flow through the SAME pipeline as the AST tier — inline
+``# dmlint: disable=<check> <reason>`` suppressions read from the anchored
+source file, the shared baseline, ``--changed`` filtering via
+``only_files``, sorted/rendered/SARIF'd by the same code — so one
+workflow gates both tiers.
+
+The runner also measures its own inertness: compile-tracker event deltas
+(zero backend compiles) and the net live-array delta after releasing the
+traced artifacts (zero device buffers survive the run).  A tier-1 test
+asserts both stay zero; the numbers ride the result so the CLI can print
+them (``audit-sharding``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from distributed_machine_learning_tpu.analysis import engine
+from distributed_machine_learning_tpu.analysis import findings as findings_lib
+from distributed_machine_learning_tpu.analysis.engine import (
+    DEFAULT_BASELINE,
+    LintResult,
+)
+from distributed_machine_learning_tpu.analysis.jaxlint.base import (
+    AuditContext,
+    JaxCheck,
+)
+from distributed_machine_learning_tpu.analysis.jaxlint.coverage import (
+    PartitionCoverageCheck,
+)
+from distributed_machine_learning_tpu.analysis.jaxlint.donation import (
+    DonationCheck,
+)
+from distributed_machine_learning_tpu.analysis.jaxlint.hygiene import (
+    HygieneCheck,
+)
+from distributed_machine_learning_tpu.analysis.jaxlint.meshcheck import (
+    MeshAxisCheck,
+)
+
+JAX_CHECKS: List[JaxCheck] = [
+    PartitionCoverageCheck(),
+    DonationCheck(),
+    HygieneCheck(),
+    MeshAxisCheck(),
+]
+
+
+def get_jax_check(name: str) -> JaxCheck:
+    for check in JAX_CHECKS:
+        if check.name == name or check.rule_id == name:
+            return check
+    raise KeyError(f"no jaxlint check named {name!r}")
+
+
+@dataclass
+class JaxLintResult(LintResult):
+    """LintResult plus the run's measured inertness."""
+
+    inert: Dict[str, int] = field(default_factory=dict)
+
+
+def run_jax_checks(
+    checks: Optional[Sequence[JaxCheck]] = None,
+    baseline_path: Optional[str] = DEFAULT_BASELINE,
+    only_files: Optional[Sequence[str]] = None,
+) -> JaxLintResult:
+    """Run the jax-tier checks over the installed package's registered
+    artifacts (rule tables, fused programs).
+
+    ``only_files`` filters which ANCHOR files findings are reported from
+    (the ``--changed`` path) — the audit itself is whole-program either
+    way, exactly like the AST tier's cross-file rules.
+    """
+    import gc
+    import os
+
+    from distributed_machine_learning_tpu.compilecache.tracker import (
+        get_tracker,
+    )
+
+    active = list(checks) if checks is not None else list(JAX_CHECKS)
+    result = JaxLintResult()
+    tracker = get_tracker()
+    before = tracker.snapshot()
+    import jax
+
+    gc.collect()
+    live_before = len(jax.live_arrays())
+
+    audit = AuditContext()
+    raw = []
+    for check in active:
+        try:
+            raw.extend(check.check(audit))
+        except Exception as exc:  # noqa: BLE001 - one broken check must
+            # not silence the others; a crash IS a reportable condition.
+            result.errors.append(
+                f"jaxlint check {check.name} crashed: {exc!r}"
+            )
+    audit.release()
+    gc.collect()
+    after = tracker.snapshot()
+    result.inert = {
+        "backend_compiles": int(
+            after["backend_compiles"] - before["backend_compiles"]
+        ),
+        "backend_compiles_uncached": int(
+            after["backend_compiles_uncached"]
+            - before["backend_compiles_uncached"]
+        ),
+        "live_arrays": len(jax.live_arrays()) - live_before,
+        "traces": int(after["traces"] - before["traces"]),
+    }
+
+    only = None
+    if only_files is not None:
+        only = {os.path.abspath(f) for f in only_files}
+    files = set()
+    for f in raw:
+        abspath = os.path.abspath(f.file)
+        if only is not None and abspath not in only:
+            continue
+        try:
+            ctx = engine.load_context(abspath)
+            f.suppressed = findings_lib.is_suppressed(f, ctx.suppressions)
+        except (OSError, SyntaxError):
+            pass
+        files.add(f.file)
+        result.findings.append(f)
+    result.files_checked = len(files)
+    if baseline_path:
+        findings_lib.apply_baseline(
+            result.findings, findings_lib.load_baseline(baseline_path)
+        )
+    result.findings.sort(key=lambda f: (f.file, f.line, f.rule_id))
+    return result

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import re
 import tempfile
 import threading
 from typing import Any, Callable, Dict, Optional, Sequence
@@ -42,6 +43,55 @@ from distributed_machine_learning_tpu.compilecache.counters import get_counters
 from distributed_machine_learning_tpu.compilecache import tracker as _tracker
 
 _MAGIC = b"DMLAOT1\n"
+
+# ``func.func public @main(%arg3: tensor<8x4xf32> {..., tf.aliasing_output
+# = 1 : i32, ...})`` — the MLIR attribute jax's lowering stamps on every
+# input buffer that will ALIAS an output (donation that actually took).
+# ``jax.buffer_donor`` marks a donated input XLA may scavenge for
+# intermediates even though no output matches its aval (the consumed-slab
+# case — see data/pipeline.py's warning filter).
+_ARG_RE = re.compile(r"%arg(\d+):")
+_ALIAS_RE = re.compile(r"tf\.aliasing_output\s*=\s*(\d+)")
+_DONOR_RE = re.compile(r"jax\.buffer_donor\s*=\s*true")
+
+
+def lowered_alias_info(lowered) -> Dict[str, Any]:
+    """Input/output aliasing of a ``jax.jit(...).lower(...)`` result,
+    WITHOUT compiling it (the donation decision is made at lowering time;
+    reading it must stay allocation- and compile-free — the jaxlint
+    donation verifier's whole contract, analysis/jaxlint/donation.py).
+
+    Returns ``{"num_args": N, "aliased": {arg_index: output_index},
+    "buffer_donors": {arg_index, ...}}`` over the FLATTENED argument list
+    (the order ``jax.tree_util.tree_leaves`` yields the example args in).
+    """
+    text = lowered.as_text()
+    # Only the entry function's signature matters; stop at its body so a
+    # nested func's %arg0 cannot shadow main's.
+    main = text.split("func.func public @main", 1)
+    sig = main[1].split("{\n", 1)[0] if len(main) == 2 else text
+    # Per-arg attribute dicts may embed strings containing braces
+    # (``mhlo.sharding = "{replicated}"``), so bracket matching is not an
+    # option: scan each arg's span up to the next ``%argN:`` token (or
+    # the result arrow) instead.
+    aliased: Dict[int, int] = {}
+    donors = set()
+    num_args = 0
+    matches = list(_ARG_RE.finditer(sig))
+    for i, m in enumerate(matches):
+        idx = int(m.group(1))
+        num_args = max(num_args, idx + 1)
+        end = matches[i + 1].start() if i + 1 < len(matches) else len(sig)
+        span = sig[m.end():end]
+        if i + 1 >= len(matches):
+            span = span.split("->", 1)[0]
+        am = _ALIAS_RE.search(span)
+        if am:
+            aliased[idx] = int(am.group(1))
+        if _DONOR_RE.search(span):
+            donors.add(idx)
+    return {"num_args": num_args, "aliased": aliased,
+            "buffer_donors": donors}
 
 
 def default_aot_dir() -> str:

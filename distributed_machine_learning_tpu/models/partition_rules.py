@@ -71,7 +71,17 @@ RNN_RULES: Tuple = (
     (r".*", P()),
 )
 
+# ResNet was replicate-only until the jaxlint coverage audit (DML101)
+# priced it: the stage-2/3 conv stacks are ~80% of the family's params and
+# every kernel was silently falling through to the catch-all.  Same
+# recipe as CNN_RULES, one rank up: a 2-D conv kernel is
+# (kh, kw, in_ch, out_ch) — column-shard the reduction-free out-channel
+# dim over tp (64..512 all divide the tier-1 tp sizes); the (1, 1, in,
+# out) projection shortcuts follow.  The Dense head (512, 1) replicates
+# by explicit rule: its out dim is 1, there is nothing to shard.
 RESNET_RULES: Tuple = (
+    (r"(stem|Conv_\d+|proj)/kernel$", P(None, None, None, "tp")),
+    (r"head/kernel$", P()),
     (r".*", P()),
 )
 

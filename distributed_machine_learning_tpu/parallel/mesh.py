@@ -23,6 +23,14 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+# The framework-wide mesh-axis vocabulary (module docstring above).  A
+# PartitionSpec / collective naming an axis outside this set is a typo or
+# an import from another stack's convention — no mesh this framework
+# builds will ever carry it, so the spec silently cleans to replication
+# (jaxlint DML104 mesh-axis-soundness flags exactly this).
+CANONICAL_AXES = ("dp", "sp", "tp", "ep", "pp")
+
+
 def make_mesh(
     axis_sizes: Dict[str, int],
     devices: Optional[Sequence] = None,

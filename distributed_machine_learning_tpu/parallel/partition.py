@@ -111,23 +111,47 @@ def match_partition_rules(
     return jax.tree_util.tree_map_with_path(assign, params)
 
 
+def clean_spec_report(
+    spec: P, shape: Tuple[int, ...], axis_sizes: dict
+) -> Tuple[P, list]:
+    """:func:`clean_spec` over plain ``{axis: size}`` sizes, reporting WHY
+    each axis fell away: ``(cleaned_spec, [(dim, axis, reason), ...])``
+    with reason in ``"missing-axis"`` / ``"excess-rank"`` /
+    ``"non-dividing"``.  Mesh-free on purpose — the jaxlint coverage audit
+    (analysis/jaxlint/coverage.py) prices rule tables against mesh shapes
+    no local device set can build, and "silently cleaned to None" is
+    exactly the information :func:`clean_spec` discards."""
+    shape = tuple(int(s) for s in shape or ())
+    ndim = len(shape)
+    out = []
+    drops = []
+    for i, axis in enumerate(spec):
+        if i >= ndim:
+            if axis is not None:
+                drops.append((i, axis, "excess-rank"))
+            continue
+        if axis is None:
+            out.append(None)
+        elif axis not in axis_sizes:
+            out.append(None)
+            drops.append((i, axis, "missing-axis"))
+        elif shape[i] % int(axis_sizes[axis]) != 0:
+            out.append(None)
+            drops.append((i, axis, "non-dividing"))
+        else:
+            out.append(axis)
+    return P(*out), drops
+
+
 def clean_spec(spec: P, leaf, mesh: Mesh) -> P:
     """Reconcile a rule spec with a concrete leaf on a concrete mesh:
     drop axes the mesh lacks, axes beyond the leaf's rank, and axes whose
     mesh size does not divide the dim."""
-    ndim = getattr(leaf, "ndim", len(getattr(leaf, "shape", ()) or ()))
     shape = tuple(getattr(leaf, "shape", ()) or ())
-    out = []
-    for i, axis in enumerate(spec):
-        if i >= ndim:
-            break
-        if axis is None or axis not in mesh.axis_names:
-            out.append(None)
-        elif shape and shape[i] % mesh.shape[axis] != 0:
-            out.append(None)
-        else:
-            out.append(axis)
-    return P(*out)
+    cleaned, _ = clean_spec_report(
+        spec, shape, {str(k): int(v) for k, v in mesh.shape.items()}
+    )
+    return cleaned
 
 
 def shardings_from_rules(

@@ -60,6 +60,12 @@ TRANSFORMER_TP_RULES = (
     # matmuls) shard their d_model dim when divisible; clean_spec
     # replicates them on meshes where they don't.
     (r"head/Dense_0/kernel$", P("tp", None)),
+    # The head FUNNEL below Dense_0 (128->64->32->16->1) is fixed-size at
+    # any d_model — O(10 KB) replicated at flagship scale.  The explicit
+    # rule records the decision so the jaxlint coverage audit (DML101)
+    # can tell "deliberately replicated" from "fell through the
+    # catch-all" (its first run flagged exactly these leaves).
+    (r"head/Dense_[1-9]\d*/(kernel|bias)$", P()),
     (r"input_projection/kernel$", P(None, "tp")),
     (r".*", P()),  # everything else replicated
 )

@@ -149,6 +149,88 @@ def make_chunk_epoch_fn(
     return chunk
 
 
+def make_indexed_epoch_fn(
+    forward: Callable,
+    tx: optax.GradientTransformation,
+    loss_fn: Callable,
+) -> Callable:
+    """The SHARDED trainable's fused epoch body (tune/trainable_sharded.py):
+    a scan over pre-gathered ``[num_batches, global_batch, ...]`` slabs
+    whose per-step dropout key is ``fold_in(epoch_key, i)`` on an integer
+    step counter riding the carry — the indexed twin of
+    :func:`make_epoch_fn` (which draws keys by splitting along the carry).
+
+    ``epoch(params, opt_state, batch_stats, xb, yb, epoch_key) ->
+    (params, opt_state, batch_stats, mean_loss)``.  Jit at the call site
+    with donation + in/out shardings; extracted here so the jaxlint
+    donation/hygiene audits (analysis/jaxlint/) lower the EXACT program
+    the trainable runs, not a reimplementation that could drift.
+    """
+
+    def epoch(params, opt_state, batch_stats, xb, yb, epoch_key):
+        def step(carry, batch):
+            params, opt_state, batch_stats, i = carry
+            x, y = batch
+            key = jax.random.fold_in(epoch_key, i)
+
+            def loss_of(p):
+                preds, new_bs, aux = forward(p, batch_stats, x, key, True)
+                return loss_fn(preds.astype(jnp.float32), y) + aux, new_bs
+
+            (loss, new_bs), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state, new_bs, i + 1), loss
+
+        (params, opt_state, batch_stats, _), losses = jax.lax.scan(
+            step, (params, opt_state, batch_stats, jnp.int32(0)), (xb, yb)
+        )
+        return params, opt_state, batch_stats, losses.mean()
+
+    return epoch
+
+
+def make_indexed_chunk_fn(
+    forward: Callable,
+    tx: optax.GradientTransformation,
+    loss_fn: Callable,
+) -> Callable:
+    """The sharded trainable's streaming CHUNK body: the same step body as
+    :func:`make_indexed_epoch_fn` scanned over a staged slab, with the
+    global batch counter entering as ``i0`` so ``fold_in(epoch_key, i)``
+    matches the resident program bit for bit across chunk boundaries.
+
+    ``chunk(params, opt_state, batch_stats, i0, xb, yb, epoch_key) ->
+    (params, opt_state, batch_stats, losses)``.  Jit at the call site.
+    """
+
+    def chunk(params, opt_state, batch_stats, i0, xb, yb, epoch_key):
+        def step(carry, batch):
+            params, opt_state, batch_stats, i = carry
+            x, y = batch
+            key = jax.random.fold_in(epoch_key, i)
+
+            def loss_of(p):
+                preds, new_bs, aux = forward(p, batch_stats, x, key, True)
+                return loss_fn(preds.astype(jnp.float32), y) + aux, new_bs
+
+            (loss, new_bs), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state, new_bs, i + 1), loss
+
+        (params, opt_state, batch_stats, _), losses = jax.lax.scan(
+            step, (params, opt_state, batch_stats, i0), (xb, yb)
+        )
+        return params, opt_state, batch_stats, losses
+
+    return chunk
+
+
 def make_chunk_eval_fn(forward: Callable) -> Callable:
     """Masked eval over ONE streamed chunk of validation blocks: ``(params,
     batch_stats, xb, yb, mb) -> (se_sum, ae_sum, ape_sum, hub_sum, count)``

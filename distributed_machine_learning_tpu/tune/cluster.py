@@ -434,6 +434,7 @@ def serve_worker(
     # Bind and announce readiness BEFORE importing jax: jax cold-import takes
     # tens of seconds, and the driver's connect queues in the backlog while
     # device enumeration finishes (it blocks on the hello frame, not connect).
+    startup_t0 = time.monotonic()
     secret = secret if secret is not None else _cluster_secret()
     if not _is_loopback(host) and not secret:
         print(
@@ -453,6 +454,15 @@ def serve_worker(
         with open(ready_file, "w") as f:
             f.write(f"{host}:{actual_port}\n")
 
+    # Test/chaos knob: stretch this worker's startup the way a loaded host
+    # does (the jax import below is the real cost; the sleep stands in for
+    # it deterministically in the loaded-host regression test).
+    _startup_sleep = float(
+        os.environ.get("DML_CLUSTER_STARTUP_SLEEP_S", "0") or 0.0
+    )
+    if _startup_sleep > 0:
+        time.sleep(_startup_sleep)
+
     import jax
 
     from distributed_machine_learning_tpu import chaos
@@ -471,6 +481,11 @@ def serve_worker(
 
     devices = list(jax.devices())
     slots = slots or len(devices)
+    # MEASURED spawn time (bind + jax import + device enum + cache attach):
+    # the driver scales per-trial first-beat grace from it, because the
+    # same host load that stretched THIS stretches every trial's cold
+    # start (startup_scaled_grace; the PR 9/11 full-run flake).
+    startup_s = time.monotonic() - startup_t0
 
     debug = bool(os.environ.get("DML_CLUSTER_DEBUG"))
 
@@ -481,7 +496,9 @@ def serve_worker(
     while True:
         sock, peer = server.accept()
         dbg(f"accepted driver {peer}")
-        shutdown = _serve_driver_connection(sock, secret, devices, slots, dbg)
+        shutdown = _serve_driver_connection(
+            sock, secret, devices, slots, dbg, startup_s=startup_s
+        )
         if shutdown:
             break
     server.close()
@@ -493,6 +510,7 @@ def _serve_driver_connection(
     devices: List,
     slots: int,
     dbg: Callable[[str], None],
+    startup_s: float = 0.0,
 ) -> bool:
     """Serve one driver over an established socket (either direction: a
     connection the supervisor accepted, or one ``join_driver`` dialed).
@@ -508,6 +526,9 @@ def _serve_driver_connection(
             "slots": slots,
             "host": socket.gethostname(),
             "num_devices": len(devices),
+            # Measured spawn->ready seconds: the driver's load signal for
+            # scaling first-beat grace (startup_scaled_grace).
+            "startup_s": round(float(startup_s), 3),
         },
         secret,
     )
@@ -623,6 +644,7 @@ def join_driver(
     Blocks until the driver disconnects or shuts the worker down; returns
     True on an explicit shutdown (callers looping for driver restarts can
     stop then)."""
+    startup_t0 = time.monotonic()
     secret = secret if secret is not None else _cluster_secret()
     host, port = driver_address.rsplit(":", 1)
     if not _is_loopback(host) and not secret:
@@ -650,6 +672,7 @@ def join_driver(
 
     devices = list(jax.devices())
     slots = slots or len(devices)
+    startup_s = time.monotonic() - startup_t0
 
     debug = bool(os.environ.get("DML_CLUSTER_DEBUG"))
 
@@ -657,12 +680,45 @@ def join_driver(
         if debug:
             print(f"[worker->{driver_address}] {msg}", flush=True)
 
-    return _serve_driver_connection(sock, secret, devices, slots, dbg)
+    return _serve_driver_connection(
+        sock, secret, devices, slots, dbg, startup_s=startup_s
+    )
 
 
 # --------------------------------------------------------------------------
 # driver side
 # --------------------------------------------------------------------------
+
+
+# How many multiples of a worker's measured spawn time the first-beat
+# grace must cover.  Spawn = process start + jax import + device enum; a
+# trial's cold start (trainable import + storage setup + first epoch) is
+# empirically lighter than that, so 5x is comfortable headroom while
+# still being LOAD-PROPORTIONAL: an idle host (~5-10s spawn) keeps tight
+# deadlines, a thrashing CI host (60s+ spawn) gets minutes of grace
+# instead of a spurious stall->requeue (the PR 9/11 full-run flake).
+STARTUP_GRACE_SCALE = 5.0
+
+
+def startup_scaled_grace(
+    deadline_s: float,
+    grace_s: Optional[float],
+    worker_startup_s: float,
+) -> float:
+    """Per-trial first-beat grace scaled from the worker's MEASURED spawn
+    time, never below the configured (or default) fixed grace.
+
+    The fixed grace answers "how long may a healthy cold start take on an
+    idle host"; the scaled term answers the question the flake actually
+    asked — "on THIS host, under ITS current load".  Both are floors, so
+    scaling can only make expiry more conservative; steady-state stall
+    detection (after the first beat) is untouched.
+    """
+    base = (
+        float(grace_s) if grace_s is not None
+        else max(3.0 * float(deadline_s), 30.0)
+    )
+    return max(base, STARTUP_GRACE_SCALE * max(float(worker_startup_s), 0.0))
 
 
 class RemoteWorker:
@@ -704,6 +760,12 @@ class RemoteWorker:
             )
         self.slots: int = int(hello["slots"])
         self.hostname: str = hello.get("host", self.address)
+        # The worker's MEASURED spawn->ready time: under host load (CI
+        # neighbors, bench children) jax import stretches from seconds to
+        # minutes, and the same load stretches every trial's cold start —
+        # so per-trial first-beat grace scales from this instead of
+        # trusting a fixed constant (startup_scaled_grace).
+        self.startup_s: float = float(hello.get("startup_s", 0.0) or 0.0)
         self.running: Dict[str, int] = {}  # trial_id -> slot
         self.alive = True
         # Liveness bookkeeping (driver clock): last frame seen, and the
@@ -1147,7 +1209,17 @@ def run_distributed(
         assignment[trial.trial_id] = worker
         lifecycle.mark_running(trial)
         if watchdog is not None:
-            watchdog.track(trial.trial_id)
+            # First-beat grace scales from THIS worker's measured spawn
+            # time: a loaded host that took a minute to import jax will
+            # also start trials slowly, and a fixed grace there reads
+            # "slow" as "stalled" (the worker-startup deadline flake).
+            watchdog.track(
+                trial.trial_id,
+                first_beat_grace_s=startup_scaled_grace(
+                    progress_deadline_s, progress_grace_s,
+                    worker.startup_s,
+                ),
+            )
         safe_cb("on_trial_start", trial)
         try:
             trial_mesh = trial.config.get("mesh_shape") or {}
@@ -1643,6 +1715,7 @@ def start_local_workers(
 
     procs: List[subprocess.Popen] = []
     addrs: List[str] = []
+    measured_spawns: List[float] = []
     for i in range(n):
         fd, ready = tempfile.mkstemp(prefix=f"dml_worker_{i}_")
         os.close(fd)
@@ -1675,13 +1748,23 @@ def start_local_workers(
         log_f.close()
         proc.log_path = log_path  # type: ignore[attr-defined]
         procs.append(proc)
-        deadline = time.monotonic() + timeout
+        # The ready deadline scales from the measured spawn of earlier
+        # workers: host load stretches every spawn alike, so worker 0's
+        # actual latency is a better budget predictor for worker 1 than
+        # any fixed constant (the worker-startup deadline flake).
+        spawn_t0 = time.monotonic()
+        budget = max(
+            float(timeout),
+            STARTUP_GRACE_SCALE * max(measured_spawns, default=0.0),
+        )
+        deadline = spawn_t0 + budget
         while not os.path.exists(ready):
             if proc.poll() is not None:
                 raise RuntimeError(f"worker {i} exited rc={proc.returncode}")
             if time.monotonic() > deadline:
                 raise TimeoutError(f"worker {i} did not become ready")
             time.sleep(0.05)
+        measured_spawns.append(time.monotonic() - spawn_t0)
         with open(ready) as f:
             addrs.append(f.read().strip())
         os.unlink(ready)

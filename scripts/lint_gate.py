@@ -1,0 +1,94 @@
+#!/usr/bin/env python3
+"""CI diff gate: ``dml-tpu lint --changed --format=sarif`` for BOTH tiers.
+
+The checked-in entry point CI (and pre-push hooks) call so the gate's
+flags live in ONE place:
+
+    python scripts/lint_gate.py [--ref REF] [--out lint.sarif] [--full]
+
+* ``--ref`` (default: ``origin/main`` if it resolves, else ``HEAD``) —
+  findings are filtered to files changed vs the ref; the whole tree is
+  still parsed/audited so cross-file and program-level checks judge the
+  change against the full project.
+* ``--out`` — where the SARIF 2.1.0 report lands (CI annotators upload
+  it); the human-readable text report goes to stdout either way.
+* ``--full`` — gate the whole tree instead of the diff (the nightly /
+  release mode).
+* ``--no-jax`` — AST tier only, for hosts without a working jax install.
+
+Exit code is the lint's: 0 clean, 1 unsuppressed findings, 2 usage/git
+trouble — the same contract as ``dml-tpu lint`` itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _default_ref() -> str:
+    probe = subprocess.run(
+        ["git", "rev-parse", "--verify", "--quiet", "origin/main"],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    return "origin/main" if probe.returncode == 0 else "HEAD"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--ref", default=None,
+                   help="diff base (default: origin/main, else HEAD)")
+    p.add_argument("--out", default="lint.sarif",
+                   help="SARIF output path (default: ./lint.sarif)")
+    p.add_argument("--full", action="store_true",
+                   help="lint the whole tree, not just the diff")
+    p.add_argument("--no-jax", action="store_true",
+                   help="skip the program-level (jaxlint) tier")
+    args = p.parse_args(argv)
+
+    cmd = [sys.executable, "-m", "distributed_machine_learning_tpu",
+           "lint", "--format=sarif"]
+    if not args.no_jax:
+        cmd.append("--jax")
+    if not args.full:
+        cmd.append(f"--changed={args.ref or _default_ref()}")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")  # the gate must not touch TPUs
+    proc = subprocess.run(
+        cmd, cwd=REPO, capture_output=True, text=True, env=env,
+    )
+    if proc.stderr:
+        sys.stderr.write(proc.stderr)
+    out = proc.stdout.strip()
+    if not out:
+        return proc.returncode
+    try:
+        sarif = json.loads(out)
+    except json.JSONDecodeError:
+        # --changed with no .py files changed prints a plain line, not
+        # SARIF; surface it and pass the exit code through.
+        print(out)
+        return proc.returncode
+    with open(args.out, "w") as f:
+        json.dump(sarif, f, indent=2)
+        f.write("\n")
+    results = sarif["runs"][0]["results"]
+    live = [r for r in results if not r.get("suppressions")]
+    for r in live:
+        loc = r["locations"][0]["physicalLocation"]
+        print(f"{loc['artifactLocation']['uri']}:"
+              f"{loc['region']['startLine']}: {r['ruleId']} "
+              f"{r['message']['text'].splitlines()[0]}")
+    print(f"lint gate: {len(live)} live finding(s), "
+          f"{len(results) - len(live)} suppressed/baselined "
+          f"-> {args.out}")
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,22 @@
+"""DML102 bad fixture: a donated jit whose donation is defeated.
+
+Argnum 0's output changes dtype (f32 -> bf16), so no output shares its
+aval and the lowered module drops the aliasing silently — the exact
+shape of the bench.py flagship-measure bug PR 7 found by hand.  Argnum 1
+aliases fine, proving the check reads the real aliasing table rather
+than flagging every donation.
+"""
+
+import jax.numpy as jnp
+
+
+def program(a, b):
+    return a.astype(jnp.bfloat16), b + 1.0
+
+
+PROGRAM = dict(  # EXPECT: jax-donation-defeated
+    fn=program,
+    arg_shapes=((4, 4), (4, 4)),
+    donate_argnums=(0, 1),
+    must_alias=(0, 1),
+)

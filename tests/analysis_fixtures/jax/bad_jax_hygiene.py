@@ -1,0 +1,25 @@
+"""DML103 bad fixture: a host callback inside a ``lax.scan`` body.
+
+The callback synchronizes device->host once PER SCAN STEP — inside a
+fused epoch program that turns one dispatch per epoch back into one per
+batch.  The finding anchors at the callback call site itself (jaxpr
+equation source info), not at the program's registry entry.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _leak(x):
+    del x
+
+
+def program(xs):
+    def body(carry, x):
+        jax.debug.callback(_leak, x)  # EXPECT: jax-hygiene
+        return carry + x, x * 2.0
+
+    return jax.lax.scan(body, jnp.float32(0.0), xs)
+
+
+ARG_SHAPES = ((8,),)

@@ -1,0 +1,14 @@
+"""DML104 bad fixture: a rule table naming a phantom mesh axis.
+
+``megatron_mp`` is another stack's axis convention — no mesh this
+framework builds will ever carry it, so the spec silently cleans to
+replication on every mesh while the table reads as if it shards.
+"""
+
+from jax.sharding import PartitionSpec as P
+
+RULES = (
+    (r"ff/kernel$", P(None, "megatron_mp")),  # EXPECT: jax-mesh-axis
+    (r"ff/bias$", P("tp")),
+    (r".*", P()),
+)

@@ -1,0 +1,31 @@
+"""DML101 bad fixture: a rule table with every coverage failure mode.
+
+``embed/table`` (the biggest leaf) falls through to the catch-all and
+silently replicates; the ``gone/never`` rule matches nothing (dead); and
+``head/out``'s sharded dim (50) does not divide tp=4, so clean_spec
+silently replicates it while the table claims a sharding.  Unmatched and
+non-dividing findings anchor at the table assignment line; the dead rule
+anchors at its own entry.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+MESH_SHAPES = ({"dp": 2, "tp": 4},)
+LEAF_FRACTION = 0.02
+
+RULES = (  # EXPECT: jax-partition-coverage, jax-partition-coverage
+    (r"ff/w_big$", P(None, "tp")),
+    (r"head/out$", P(None, "tp")),
+    (r"gone/never$", P("tp")),  # EXPECT: jax-partition-coverage
+    (r".*", P()),
+)
+
+
+def param_tree():
+    return {
+        "ff": {"w_big": jax.ShapeDtypeStruct((64, 64), jnp.float32)},
+        "embed": {"table": jax.ShapeDtypeStruct((512, 64), jnp.float32)},
+        "head": {"out": jax.ShapeDtypeStruct((64, 50), jnp.float32)},
+    }

@@ -1,0 +1,14 @@
+"""DML102 clean twin: both donated args alias same-aval outputs (the
+in-place update donation exists for), so the verifier stays silent."""
+
+
+def program(a, b):
+    return a * 2.0, b + 1.0
+
+
+PROGRAM = dict(
+    fn=program,
+    arg_shapes=((4, 4), (4, 4)),
+    donate_argnums=(0, 1),
+    must_alias=(0, 1),
+)

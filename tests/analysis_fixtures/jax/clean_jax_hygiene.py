@@ -1,0 +1,22 @@
+"""DML103 clean twin: the same scan with a pure body, and a callback
+OUTSIDE any scan (a once-per-call callback is a design choice, not a
+per-step sync — the check is scan-scoped on purpose)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _note(x):
+    del x
+
+
+def program(xs):
+    def body(carry, x):
+        return carry + x, x * 2.0
+
+    total, ys = jax.lax.scan(body, jnp.float32(0.0), xs)
+    jax.debug.callback(_note, total)
+    return total, ys
+
+
+ARG_SHAPES = ((8,),)

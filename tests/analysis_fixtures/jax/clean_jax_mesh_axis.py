@@ -1,0 +1,10 @@
+"""DML104 clean twin: every named axis comes from the framework's mesh
+vocabulary (parallel.mesh.CANONICAL_AXES)."""
+
+from jax.sharding import PartitionSpec as P
+
+RULES = (
+    (r"ff/kernel$", P(None, "tp")),
+    (r"ff/experts$", P("ep", None, "tp")),
+    (r".*", P()),
+)

@@ -236,6 +236,75 @@ def test_vectorized_dispatch_watchdog_flags_hang(tmp_path, capfd):
 # --------------------------------------------------------------------------
 
 
+def test_startup_scaled_grace_math():
+    """First-beat grace = max(configured/default fixed grace, SCALE x the
+    worker's measured spawn time): load-proportional, never below the
+    old behavior, and steady-state deadlines untouched."""
+    from distributed_machine_learning_tpu.tune.cluster import (
+        STARTUP_GRACE_SCALE,
+        startup_scaled_grace,
+    )
+
+    # idle host: the fixed grace (explicit or default) is the floor
+    assert startup_scaled_grace(1.2, 30.0, 0.0) == 30.0
+    assert startup_scaled_grace(1.2, None, 0.0) == 30.0  # max(3*d, 30)
+    assert startup_scaled_grace(20.0, None, 0.0) == 60.0
+    # loaded host: measured spawn dominates
+    assert startup_scaled_grace(1.2, 30.0, 60.0) == (
+        STARTUP_GRACE_SCALE * 60.0
+    )
+    # the scaled term can only RAISE the grace, never lower it
+    assert startup_scaled_grace(1.2, 45.0, 1.0) == 45.0
+    assert startup_scaled_grace(1.2, 0.5, -3.0) == 0.5  # junk clamps
+
+
+def test_slow_worker_startup_does_not_stall_trials(tmp_path):
+    """Loaded-host regression for the worker-startup deadline flake (PR 9
+    and PR 11 full runs): a host whose worker spawn is stretched (here:
+    deterministically, via DML_CLUSTER_STARTUP_SLEEP_S standing in for a
+    loaded host's jax import) runs trials whose first report takes longer
+    than the FIXED first-beat threshold (deadline 0.4s + grace 0.5s <
+    ~1s first epoch) — with the grace scaled from the worker's measured
+    spawn time, none of them is spuriously stalled or requeued."""
+    from distributed_machine_learning_tpu.liveness import DispatchWatchdog
+
+    # The fixed threshold really is too small for this workload: a
+    # watchdog with the UNscaled grace flags the key before the first
+    # beat lands (the old behavior this test regresses against).
+    dog = DispatchWatchdog(0.4, first_beat_grace_s=0.5)
+    dog.track("would-stall")
+    time.sleep(1.0)
+    assert [e.key for e in dog.expired()] == ["would-stall"]
+
+    procs, addrs = start_local_workers(
+        1, slots=2,
+        env=_worker_env({"DML_CLUSTER_STARTUP_SLEEP_S": "2.5"}),
+    )
+    try:
+        analysis = run_distributed(
+            "cluster_trainables:slow_resumable_trial",
+            # ONE ~1s epoch per trial: everything between dispatch and the
+            # first report is cold start (the window the scaled grace
+            # covers); no steady-state gap ever exceeds the 0.4s deadline
+            # because the first report is also the last.
+            {"x": tune.uniform(0.0, 6.0), "epochs": 1, "sleep_s": 1.0},
+            metric="loss", mode="min", num_samples=2,
+            workers=addrs, storage_path=str(tmp_path),
+            name="lv_slow_spawn", seed=3, verbose=0,
+            progress_deadline_s=0.4, progress_grace_s=0.5,
+        )
+        assert analysis.num_terminated() == 2
+        state = json.load(open(f"{analysis.root}/experiment_state.json"))
+        lv = state.get("liveness", {})
+        assert lv.get("stalls_detected", 0) == 0, (
+            f"slow startup read as a stall despite scaled grace: {lv}"
+        )
+        assert lv.get("stall_requeues", 0) == 0
+        assert all(t.num_failures == 0 for t in analysis.trials)
+    finally:
+        _terminate(procs)
+
+
 def _worker_env(extra=None):
     keep = [
         p
