@@ -2082,15 +2082,47 @@ def child_suite(scale_name: str) -> None:
 
 
 def child_probe() -> None:
-    import jax
+    # Probe forensics (ISSUE 13 satellite): the parent sets
+    # DML_OBS_FLIGHT_MIRROR (every flight event ALSO lands on disk as it
+    # happens — survives any kill, even native-code wedges where no
+    # handler runs) and DML_OBS_DUMP_DIR; a SIGTERM from the parent's
+    # timeout dumps the ring + the open-span stack, so a wedged probe
+    # finally says WHICH phase it wedged in instead of just rc=124.
+    import signal
 
-    devs = jax.devices()
+    from distributed_machine_learning_tpu import obs
+
+    dump_dir = os.environ.get("DML_OBS_DUMP_DIR")
+    if dump_dir:
+        obs.configure(trace_dir=dump_dir, label="probe", dump_dir=dump_dir)
+
+    def _on_term(_signum, _frame):
+        obs.dump_flight_recorder("probe_sigterm")
+        obs.flush()
+        os._exit(128 + signal.SIGTERM)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except (ValueError, OSError):
+        pass
+
+    obs.event("probe_phase", {"phase": "jax_import"})
+    with obs.span("probe.jax_import"):
+        import jax
+
+    obs.event("probe_phase", {"phase": "backend_claim"})
+    with obs.span("probe.backend_claim"):
+        devs = jax.devices()
     assert devs and devs[0].platform != "cpu", f"no accelerator: {devs}"
     # One tiny computation proves the backend actually executes, not just inits.
     import jax.numpy as jnp
 
-    out = float(jnp.ones((8, 8)).sum())
+    obs.event("probe_phase", {"phase": "execute"})
+    with obs.span("probe.execute"):
+        out = float(jnp.ones((8, 8)).sum())
     assert out == 64.0, out
+    obs.event("probe_phase", {"phase": "done"})
+    obs.flush()
     print(f"probe OK: {len(devs)} x {devs[0].platform}")
 
 
@@ -2614,6 +2646,47 @@ def _wedge_signature(cause: str) -> str:
     return hashlib.sha256(text.encode()).hexdigest()[:12]
 
 
+def _probe_wedge_forensics(forensics_dir: str, mirror_path: str) -> dict:
+    """Collect the wedged probe child's flight-recorder evidence for the
+    BENCH artifact: ``trace_dump`` (the SIGTERM ring+span-stack dump if
+    the handler got to run, else the crash-safe event mirror) plus the
+    last few mirrored events inline — the r03-r05 wedge class finally
+    names the phase it died in instead of one normalized stderr line."""
+    import glob as _glob
+    import json as _json
+
+    out: dict = {}
+    dumps = sorted(
+        _glob.glob(os.path.join(forensics_dir, "flightrec_*.json")),
+        key=os.path.getmtime,
+    )
+    if dumps:
+        out["trace_dump"] = dumps[-1]
+        try:
+            with open(dumps[-1]) as f:
+                payload = _json.load(f)
+            out["trace_dump_tail"] = payload.get("events", [])[-8:]
+            stacks = payload.get("span_stacks") or {}
+            out["last_span_stack"] = next(
+                (s for s in stacks.values() if s), []
+            )
+        except (OSError, ValueError):
+            pass
+    elif os.path.exists(mirror_path):
+        # No dump = the handler never ran (a native-code wedge); the
+        # per-event mirror still says which phase was reached.
+        out["trace_dump"] = mirror_path
+        try:
+            with open(mirror_path) as f:
+                lines = f.read().strip().splitlines()
+            out["trace_dump_tail"] = [
+                _json.loads(ln) for ln in lines[-8:] if ln.strip()
+            ]
+        except (OSError, ValueError):
+            pass
+    return out
+
+
 def _probe_tpu(log, probe_info, schedule,
                budget_s: float = PROBE_TOTAL_BUDGET_S) -> tuple:
     """Run probe attempts per ``schedule``; returns (probe_ok, tunnel_ok).
@@ -2642,6 +2715,15 @@ def _probe_tpu(log, probe_info, schedule,
     probe_ok, tunnel_ok = False, True
     t_start = time.time()
     prev_sig = None
+    # Probe forensics: every probe child mirrors its flight-recorder
+    # events to this file as they happen (crash-safe — a SIGKILLed or
+    # native-wedged child still leaves the phases it reached), and dumps
+    # ring + open-span stack on SIGTERM.  A diagnosed wedge ships the
+    # evidence in the artifact (probe_wedge_signature.trace_dump).
+    import tempfile as _tempfile
+
+    forensics_dir = _tempfile.mkdtemp(prefix="dml_probe_obs_")
+    mirror_path = os.path.join(forensics_dir, "probe_flight.jsonl")
     for timeout_s, backoff_s in schedule:
         elapsed = time.time() - t_start
         if elapsed + backoff_s + timeout_s > budget_s:
@@ -2658,8 +2740,14 @@ def _probe_tpu(log, probe_info, schedule,
         attempt_no = len(probe_info["attempts"]) + 1
         log(f"probing TPU backend (attempt {attempt_no}, timeout {timeout_s}s)")
         t0 = time.time()
+        _unlink_quiet(mirror_path)  # the mirror describes THIS attempt
+        probe_env = dict(
+            _tpu_env(),
+            DML_OBS_FLIGHT_MIRROR=mirror_path,
+            DML_OBS_DUMP_DIR=forensics_dir,
+        )
         rc, out, err, exited = _run_child(
-            ["--child", "probe"], _tpu_env(), timeout_s
+            ["--child", "probe"], probe_env, timeout_s
         )
         cause = (out.strip() or err.strip())[-240:]
         log(f"probe rc={rc}: {cause[-200:]}")
@@ -2701,6 +2789,7 @@ def _probe_tpu(log, probe_info, schedule,
                 "signature": sig,
                 "snippet": (cause or "timeout (no output)")[-160:],
                 "attempts": len(probe_info["attempts"]),
+                **_probe_wedge_forensics(forensics_dir, mirror_path),
             }
             break
         prev_sig = sig

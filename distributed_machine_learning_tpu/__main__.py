@@ -452,6 +452,98 @@ def _changed_python_files(ref, paths):
     return sorted({os.path.join(top, rel) for rel in out})
 
 
+def _trace(rest) -> None:
+    """``dml-tpu trace {export|merge|summarize}``: the operator surface of
+    the observability plane (obs/, docs/observability.md)."""
+    import argparse
+    import os
+
+    p = argparse.ArgumentParser(
+        prog="trace",
+        description="export / merge / summarize structured traces "
+                    "(tune.run(trace=True) or DML_OBS_TRACE=1)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    p_exp = sub.add_parser(
+        "export",
+        help="merge an experiment's per-process span files into one "
+             "Chrome-trace/Perfetto trace.json",
+    )
+    p_exp.add_argument("experiment_dir",
+                       help="an experiment directory (or its trace/ dir)")
+    p_exp.add_argument("-o", "--out", default=None,
+                       help="output path (default: <trace_dir>/trace.json)")
+
+    p_merge = sub.add_parser(
+        "merge",
+        help="merge trace dirs/experiment dirs from several hosts into "
+             "one trace.json",
+    )
+    p_merge.add_argument("sources", nargs="+",
+                         help="trace directories (or experiment dirs)")
+    p_merge.add_argument("-o", "--out", required=True)
+
+    p_sum = sub.add_parser(
+        "summarize",
+        help="per-phase wall-clock breakdown table (one trial with "
+             "--trial; the MFU 'where did the time go' view)",
+    )
+    p_sum.add_argument("source",
+                       help="experiment dir, trace dir, or trace.json")
+    p_sum.add_argument("--trial", default=None,
+                       help="restrict to spans of one trial id")
+    p_sum.add_argument("--json", action="store_true")
+    args = p.parse_args(rest)
+
+    from distributed_machine_learning_tpu import obs
+
+    def resolve_trace_dir(path):
+        sub_dir = os.path.join(path, "trace")
+        return sub_dir if os.path.isdir(sub_dir) else path
+
+    if args.cmd == "export":
+        trace_dir = resolve_trace_dir(args.experiment_dir)
+        if not os.path.isdir(trace_dir):
+            print(f"error: no directory at {trace_dir}", file=sys.stderr)
+            raise SystemExit(1)
+        out = obs.merge_trace_dir(trace_dir, args.out)
+        if out is None:
+            print(f"error: no trace_*.jsonl span files under {trace_dir} "
+                  f"(was the run traced? tune.run(trace=True) or "
+                  f"DML_OBS_TRACE=1)", file=sys.stderr)
+            raise SystemExit(1)
+        print(out)
+    elif args.cmd == "merge":
+        records = []
+        for src in args.sources:
+            trace_dir = resolve_trace_dir(src)
+            if not os.path.isdir(trace_dir):
+                print(f"error: no directory at {trace_dir}",
+                      file=sys.stderr)
+                raise SystemExit(1)
+            records.extend(obs.read_trace_files(trace_dir))
+        if not records:
+            print("error: no span records in any source", file=sys.stderr)
+            raise SystemExit(1)
+        with open(args.out, "w") as f:
+            json.dump(obs.chrome_trace(records), f)
+        print(args.out)
+    else:
+        try:
+            rows, table = obs.summarize_trace(args.source, trial=args.trial)
+        except (OSError, ValueError, KeyError) as exc:
+            print(f"error: cannot summarize {args.source}: {exc}",
+                  file=sys.stderr)
+            raise SystemExit(1) from None
+        if args.json:
+            print(json.dumps({"trial": args.trial, "phases": rows}))
+        else:
+            if args.trial:
+                print(f"trial {args.trial}:")
+            print(table)
+
+
 def _export_bundle(rest) -> None:
     import argparse
 
@@ -594,7 +686,7 @@ def main(argv=None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
     usage = (
         "usage: python -m distributed_machine_learning_tpu "
-        "{worker|info|probe|analyze|lint|audit-sharding|serve|"
+        "{worker|info|probe|analyze|lint|audit-sharding|trace|serve|"
         "export-bundle|export-orbax} [args]\n"
         "  worker         host trial supervisor (see 'worker --help')\n"
         "  lint           dmlint static analysis over the package (or given\n"
@@ -607,6 +699,9 @@ def main(argv=None) -> None:
         "  probe          bounded accelerator health check (child process)\n"
         "  analyze        <experiment_dir>: best config + trial table of a\n"
         "                 finished/interrupted experiment (--json for tools)\n"
+        "  trace          export/merge/summarize structured traces from a\n"
+        "                 traced run (tune.run(trace=True)): Chrome-trace/\n"
+        "                 Perfetto JSON + per-phase wall-clock breakdowns\n"
         "  export-bundle  <experiment_dir> <out_dir>: freeze the best\n"
         "                 trial into a servable bundle (serve/export.py)\n"
         "  serve          --bundle <dir>: HTTP prediction service over\n"
@@ -632,6 +727,8 @@ def main(argv=None) -> None:
         _lint(rest)
     elif cmd == "audit-sharding":
         _audit_sharding(rest)
+    elif cmd == "trace":
+        _trace(rest)
     elif cmd == "serve":
         _serve(rest)
     elif cmd == "export-bundle":

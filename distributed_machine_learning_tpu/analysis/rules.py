@@ -1278,6 +1278,108 @@ class BlockingTransferInLoopRule(Rule):
                     )
 
 
+# --------------------------------------------------------------------------
+# DML015 bare-counter-increment
+# --------------------------------------------------------------------------
+
+
+# Modules already wired into the unified metrics registry (obs/registry.py):
+# new telemetry there must register, not grow a seventh private family.
+OBS_INSTRUMENTED_PATTERNS = (
+    "serve/",
+    "liveness.py",
+    "data/pipeline.py",
+    "obs/",
+    "ckpt/metrics.py",
+    "compilecache/counters.py",
+    "chaos.py",
+)
+
+# Names that read as telemetry counters (not loop indices, not data rows).
+_COUNTER_NAME_RE = re.compile(
+    r"(?:_total|_totals|_count|_counts|_errors|_failures|_hits|_misses|"
+    r"_flushes|_dumps|_skips|_stalls|_crashes|_kills|_requeues|_retries|"
+    r"_drops|_dropped|_expiries)$"
+    r"|^(?:errors|failures|hits|misses|sheds|timeouts|redispatches|"
+    r"restarts|requeues|recoveries|stalls|kills|crashes|rejected|rejects|"
+    r"drops|dropped|swaps|exports|dumps)$"
+)
+
+_PROVIDER_METHOD_RE = re.compile(r"^(?:snapshot|stats|to_dict)$|_stats$")
+
+
+class BareCounterIncrementRule(Rule):
+    name = "bare-counter-increment"
+    rule_id = "DML015"
+    severity = "error"
+    description = (
+        "ad-hoc `self.<counter> += 1`-style telemetry in an obs-"
+        "instrumented module, outside any metrics-provider class: before "
+        "obs/registry.py, six subsystems each grew a private counter "
+        "family exactly this way — invisible to flight dumps, /metrics, "
+        "and the cluster head until someone hand-plumbed it.  A counter "
+        "that bypasses the registry cannot be aggregated, dumped, or "
+        "asserted on.  Enforced in opted-in modules "
+        "(`# dmlint-scope: obs-metrics` or OBS_INSTRUMENTED_PATTERNS)."
+    )
+    _HINT = (
+        "count through the plane: obs.get_registry().add(name) for "
+        "one-off counters, or put it in a family class (one exposing "
+        "snapshot()/stats()/to_dict()) registered via register_family()"
+    )
+
+    def applies(self, ctx) -> bool:
+        if "obs-metrics" in ctx.scopes:
+            return True
+        rel = ctx.display_path.replace("\\", "/")
+        return any(pat in rel for pat in OBS_INSTRUMENTED_PATTERNS)
+
+    @staticmethod
+    def _provider_classes(tree: ast.AST) -> Set[int]:
+        """Statement ids inside classes that ARE metrics providers — they
+        expose an aggregate view (snapshot/stats/to_dict), which is the
+        registry's family contract; their internal increments are the
+        implementation OF the plane, not a bypass of it."""
+        exempt: Set[int] = set()
+        for cls in ast.walk(tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            if any(
+                isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and _PROVIDER_METHOD_RE.search(m.name)
+                for m in cls.body
+            ):
+                exempt.update(id(n) for n in ast.walk(cls))
+        return exempt
+
+    def check(self, ctx) -> Iterator[Finding]:
+        exempt = self._provider_classes(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.AugAssign) or id(node) in exempt:
+                continue
+            if not isinstance(node.op, ast.Add):
+                continue
+            target = node.target
+            if not (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                continue
+            attr = target.attr
+            if attr.startswith("_"):  # private state, not exported telemetry
+                continue
+            if not _COUNTER_NAME_RE.search(attr):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"`self.{attr} += ...` grows a private telemetry counter "
+                f"outside any registered family — invisible to the "
+                f"metrics registry, flight dumps, and head aggregation",
+                self._HINT,
+            )
+
+
 # ==========================================================================
 # Cross-file rules (dmlint v2): symbol table + call graph + dataflow
 # ==========================================================================
@@ -1988,6 +2090,7 @@ ALL_RULES: List[Rule] = [
     UnboundedQueueRule(),
     HostSyncInScanRule(),
     BlockingTransferInLoopRule(),
+    BareCounterIncrementRule(),
     UseAfterDonationRule(),
     TransitiveChaosRule(),
     UnguardedSharedStateRule(),

@@ -93,6 +93,11 @@ class FaultPlan:
       before the request leaves the worker; the worker MUST fall back to
       compiling locally (counted as ``fetch_fallbacks`` in the ``compile``
       family) and the sweep must still find the same best trial.
+    * ``trace_export_error_rate`` — obs-plane exports (trace merges,
+      flight-recorder dumps) fail with :class:`InjectedIOError` before
+      the write.  The telemetry plane MUST absorb these — counted as
+      ``obs.export_failures``, never a failed trial or request — and a
+      faulted sweep must find the same best trial as control.
 
     Scheduled faults (each fires exactly once):
 
@@ -161,6 +166,7 @@ class FaultPlan:
         slow_rate: float = 0.0,
         slow_s: float = 0.02,
         artifact_fetch_error_rate: float = 0.0,
+        trace_export_error_rate: float = 0.0,
         chunk_write_error_rate: float = 0.0,
         kill_before_commit: Sequence[str] = (),
         corrupt_path_substrings: Sequence[str] = (),
@@ -181,6 +187,7 @@ class FaultPlan:
         self.slow_rate = float(slow_rate)
         self.slow_s = float(slow_s)
         self.artifact_fetch_error_rate = float(artifact_fetch_error_rate)
+        self.trace_export_error_rate = float(trace_export_error_rate)
         self.chunk_write_error_rate = float(chunk_write_error_rate)
         self._commit_kill_pending: List[str] = list(kill_before_commit)
         self._corrupt_pending: List[str] = list(corrupt_path_substrings)
@@ -310,6 +317,20 @@ class FaultPlan:
             self._count("artifact_fetch_errors")
             raise InjectedIOError(
                 f"injected artifact fetch fault for {key}"
+            )
+
+    def on_trace_export(self, path: str) -> None:
+        """Called by the obs plane before a trace export / flight dump
+        write; may raise :class:`InjectedIOError`.  The decision key is
+        the path with volatile per-run digits stripped, so a sweep's Nth
+        export faults identically regardless of pids/sequence numbers."""
+        import re as _re
+
+        key = _re.sub(r"\d+", "#", path.rsplit("/", 1)[-1])
+        if self._roll("trace_export", key, self.trace_export_error_rate):
+            self._count("trace_export_errors")
+            raise InjectedIOError(
+                f"injected trace export fault for {path}"
             )
 
     # -- trial faults --------------------------------------------------------
@@ -463,16 +484,26 @@ _active_plan: Optional[FaultPlan] = None
 
 def activate(plan: FaultPlan) -> None:
     """Install ``plan`` process-wide: storage faults via the get_storage
-    fault wrapper, trial/serve faults via :func:`active_plan` polling."""
+    fault wrapper, trial/serve faults via :func:`active_plan` polling.
+    The plan's injected-fault counters also register as the
+    ``injected_faults`` family in the unified metrics registry, so a
+    chaos run's ``/metrics`` and flight dumps carry what fired."""
     global _active_plan
     _active_plan = plan
     storage_lib.set_fault_wrapper(lambda backend: FaultyStorage(backend, plan))
+    from distributed_machine_learning_tpu.obs import get_registry
+
+    get_registry().register_family("injected_faults", plan)
 
 
 def deactivate() -> None:
     global _active_plan
-    _active_plan = None
+    plan, _active_plan = _active_plan, None
     storage_lib.set_fault_wrapper(None)
+    if plan is not None:
+        from distributed_machine_learning_tpu.obs import get_registry
+
+        get_registry().unregister_family("injected_faults", plan)
 
 
 def active_plan() -> Optional[FaultPlan]:

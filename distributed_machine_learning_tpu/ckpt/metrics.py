@@ -114,6 +114,15 @@ class CheckpointMetrics:
 
 _metrics = CheckpointMetrics()
 
+# The unified observability plane sees the same counters (obs/registry.py):
+# the blocks drivers publish stay byte-identical, this just makes them
+# visible in one place (flight dumps, /metrics "obs", head aggregation).
+from distributed_machine_learning_tpu.obs.registry import (  # noqa: E402
+    get_registry as _obs_registry,
+)
+
+_obs_registry().register_family("checkpoint", _metrics)
+
 
 def get_metrics() -> CheckpointMetrics:
     """The process-wide registry (one per process, like the compile

@@ -54,8 +54,13 @@ class AsyncCheckpointer:
             try:
                 import time as _time
 
+                from distributed_machine_learning_tpu import obs
+
                 t0 = _time.time()
-                nbytes, nchunks = fmt.write_snapshot(path, skeleton, leaves)
+                with obs.span("ckpt.save_async", {"path": path}):
+                    nbytes, nchunks = fmt.write_snapshot(
+                        path, skeleton, leaves
+                    )
                 metrics.record_save(
                     _time.time() - t0, nbytes, max(nchunks, 1)
                 )

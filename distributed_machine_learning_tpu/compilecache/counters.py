@@ -87,6 +87,16 @@ class CompileCounters:
 
 _counters = CompileCounters()
 
+# Registered as the ``compile`` family in the unified metrics registry
+# (obs/registry.py) — the experiment_state.json block keeps its exact
+# shape (drivers still build it from state_block); this is the
+# process-wide live view.
+from distributed_machine_learning_tpu.obs.registry import (  # noqa: E402
+    get_registry as _obs_registry,
+)
+
+_obs_registry().register_family("compile", _counters)
+
 
 def get_counters() -> CompileCounters:
     """The process-wide registry (one per process, like the compile-time

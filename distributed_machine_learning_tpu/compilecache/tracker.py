@@ -136,6 +136,15 @@ class CompileTimeTracker:
                 self._max_backend_s = max(self._max_backend_s, duration)
             elif event == _DURATION_EVENTS[1]:
                 self._trace_count[ident] = self._trace_count.get(ident, 0) + 1
+        if event == _DURATION_EVENTS[0]:
+            # Into the observability plane: each backend compile becomes a
+            # trace span (the listener hands us the measured duration, so
+            # the span is recorded retroactively) and a flight-ring event —
+            # a wedged process's dump shows what was compiling when.
+            from distributed_machine_learning_tpu import obs
+
+            obs.add_complete("compile.backend", duration)
+            obs.event("backend_compile", {"dur_s": round(duration, 4)})
 
     def _on_event(self, event: str, **_kw):
         if event != _CACHE_HIT_EVENT:

@@ -53,6 +53,7 @@ from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 import numpy as np
 
 from distributed_machine_learning_tpu.analysis.locks import named_lock
+from distributed_machine_learning_tpu import obs
 
 # A scan's xs slab can never ALIAS an output (the shapes differ), so XLA
 # warns that the donated chunk buffers are "not usable" — but donation
@@ -159,6 +160,14 @@ class HostInputCounters:
 
 
 _counters = HostInputCounters()
+
+# Same counters, one more consumer: the unified metrics registry
+# (obs/registry.py) — the driver-published host_input block is unchanged.
+from distributed_machine_learning_tpu.obs.registry import (  # noqa: E402
+    get_registry as _obs_registry,
+)
+
+_obs_registry().register_family("host_input", _counters)
 
 
 def get_host_input_counters() -> HostInputCounters:
@@ -462,7 +471,10 @@ class ChunkPrefetcher:
                     # and/or crash at a scheduled chunk index.
                     plan.maybe_producer_fault(self._chunk_index)
                 try:
-                    item = next(self._source)
+                    with obs.span(
+                        "prefetch.stage", {"chunk": self._chunk_index}
+                    ):
+                        item = next(self._source)
                 except StopIteration:
                     self._put(_DONE)
                     return
@@ -489,26 +501,31 @@ class ChunkPrefetcher:
             self._counters.add("consumer_waits")
             t0 = time.monotonic()
             item = None
-            while item is None:
-                waited = time.monotonic() - t0
-                if waited > self._hard_timeout_s:
-                    self._counters.add("consumer_wait_s", waited)
-                    self.wait_s += waited
-                    raise ProducerStalled(
-                        f"host-input producer silent for {waited:.1f}s "
-                        f"(hard timeout {self._hard_timeout_s:.1f}s, "
-                        f"stall deadline {self._deadline_s:.1f}s)"
-                    )
-                # Silence past the deadline is a counted liveness event
-                # (edge-triggered: once per stall episode) — the operator
-                # signal that the producer, not the device, is the
-                # bottleneck or the casualty.
-                for _ in self._watchdog.expired():
-                    self._counters.add("producer_stalls")
-                try:
-                    item = self._ring.get(timeout=0.05)
-                except queue.Empty:
-                    continue
+            with obs.span("prefetch.wait", {"chunk": self._chunk_index}):
+                while item is None:
+                    waited = time.monotonic() - t0
+                    if waited > self._hard_timeout_s:
+                        self._counters.add("consumer_wait_s", waited)
+                        self.wait_s += waited
+                        obs.event(
+                            "producer_stalled",
+                            {"waited_s": round(waited, 2)},
+                        )
+                        raise ProducerStalled(
+                            f"host-input producer silent for {waited:.1f}s "
+                            f"(hard timeout {self._hard_timeout_s:.1f}s, "
+                            f"stall deadline {self._deadline_s:.1f}s)"
+                        )
+                    # Silence past the deadline is a counted liveness event
+                    # (edge-triggered: once per stall episode) — the
+                    # operator signal that the producer, not the device, is
+                    # the bottleneck or the casualty.
+                    for _ in self._watchdog.expired():
+                        self._counters.add("producer_stalls")
+                    try:
+                        item = self._ring.get(timeout=0.05)
+                    except queue.Empty:
+                        continue
             waited = time.monotonic() - t0
             self._counters.add("consumer_wait_s", waited)
             self.wait_s += waited

@@ -39,6 +39,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from distributed_machine_learning_tpu.analysis.locks import named_lock
+from distributed_machine_learning_tpu.obs import record_event as _obs_event
 
 
 class Heartbeat:
@@ -192,6 +193,15 @@ class DispatchWatchdog:
                     self.stalls_total += 1
                     out.append(StallEvent(key, age, entry.deadline_s,
                                           entry.info))
+        for event in out:
+            # Into the always-on flight ring: a later dump of this process
+            # carries WHEN each silence was detected, next to whatever the
+            # process was doing around it.
+            _obs_event("watchdog_stall", {
+                "key": event.key,
+                "age_s": round(event.age_s, 2),
+                "deadline_s": event.deadline_s,
+            })
         return out
 
     # -- blocking-call guard (monitor-thread mode) ---------------------------

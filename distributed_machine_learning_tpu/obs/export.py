@@ -1,0 +1,207 @@
+"""Trace export: per-process JSONL span files -> one Chrome-trace JSON.
+
+Every traced process (driver, process-executor children, cluster workers)
+appends completed spans to its own ``trace_<label>_<pid>.jsonl`` under the
+experiment's ``trace/`` directory (``obs/trace.py``).  This module merges
+them into a single ``trace.json`` in Chrome trace-event format — loadable
+in Perfetto (https://ui.perfetto.dev) or ``chrome://tracing`` — and
+answers the question the MFU work keeps hitting: *where did the wall
+clock go inside one trial* (``summarize_trace`` prints the per-phase
+breakdown without leaving the terminal).
+
+Wall-clock ``ts`` + monotonic ``dur`` (see ``obs/trace.py``) make the
+per-process files mergeable on one timeline; the merge normalizes ``ts``
+to the earliest event so viewers start at t=0.
+
+Export failures never propagate (``obs.export_failures`` counts them) —
+telemetry trouble must not fail a trial, a request, or a teardown; the
+chaos plan's ``trace_export_error_rate`` exists to prove exactly that.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from distributed_machine_learning_tpu.obs.registry import get_registry
+
+_EVENT_KEYS = ("name", "ph", "ts", "dur", "pid", "tid")
+
+
+def _maybe_inject_export_fault(path: str) -> None:
+    from distributed_machine_learning_tpu import chaos
+
+    plan = chaos.active_plan()
+    if plan is not None:
+        plan.on_trace_export(path)
+
+
+def read_trace_files(trace_dir: str) -> List[Dict[str, Any]]:
+    """All span records under ``trace_dir`` (bad lines skipped, counted)."""
+    records: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(os.path.join(trace_dir, "trace_*.jsonl"))):
+        label = os.path.basename(path)[len("trace_"):-len(".jsonl")]
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        # A torn tail line from a killed process: the
+                        # records before it are still good.
+                        get_registry().add("torn_trace_lines")
+                        continue
+                    rec.setdefault("args", {})["proc"] = label
+                    records.append(rec)
+        except OSError:
+            get_registry().add("export_failures")
+    return records
+
+
+def chrome_trace(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Records -> Chrome trace-event JSON object (ts normalized to 0)."""
+    events = [r for r in records if all(k in r for k in _EVENT_KEYS)]
+    t0 = min((r["ts"] for r in events), default=0.0)
+    out_events: List[Dict[str, Any]] = []
+    seen_procs: Dict[int, str] = {}
+    for r in sorted(events, key=lambda r: r["ts"]):
+        ev = dict(r)
+        ev["ts"] = round(ev["ts"] - t0, 1)
+        out_events.append(ev)
+        label = (r.get("args") or {}).get("proc")
+        if label and r["pid"] not in seen_procs:
+            seen_procs[r["pid"]] = label
+    # Metadata events name each process lane in the viewer.
+    for pid, label in seen_procs.items():
+        out_events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": label},
+        })
+    return {
+        "traceEvents": out_events,
+        "displayTimeUnit": "ms",
+        "otherData": {"origin_ts_us": t0},
+    }
+
+
+def merge_trace_dir(trace_dir: str,
+                    out_path: Optional[str] = None) -> Optional[str]:
+    """Merge every per-process trace file under ``trace_dir`` into
+    ``trace.json`` (or ``out_path``).  Returns the written path, or None
+    on failure / nothing to merge (counted, never raised)."""
+    try:
+        records = read_trace_files(trace_dir)
+        if not records:
+            return None
+        out = out_path or os.path.join(trace_dir, "trace.json")
+        _maybe_inject_export_fault(out)
+        tmp = out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(chrome_trace(records), f)
+        os.replace(tmp, out)
+    except Exception:  # noqa: BLE001 - teardown telemetry must not raise
+        get_registry().add("export_failures")
+        return None
+    return out
+
+
+def _load_events(source: str) -> List[Dict[str, Any]]:
+    """Events from a merged trace.json, a trace dir, or an experiment dir
+    (which holds ``trace/``)."""
+    if os.path.isdir(source):
+        sub = os.path.join(source, "trace")
+        trace_dir = sub if os.path.isdir(sub) else source
+        merged = os.path.join(trace_dir, "trace.json")
+        if not os.path.exists(merged):
+            return read_trace_files(trace_dir)
+        source = merged
+    with open(source) as f:
+        data = json.load(f)
+    return data["traceEvents"] if isinstance(data, dict) else data
+
+
+def summarize_trace(
+    source: str, trial: Optional[str] = None,
+) -> Tuple[List[Dict[str, Any]], str]:
+    """Per-phase wall-clock breakdown: group complete spans by name, sum
+    durations, and render a table.  ``trial`` filters to spans whose
+    ``args.trial_id`` matches — the "where did one trial's time go" view
+    the MFU climb needs.
+
+    Returns ``(rows, rendered_table)``; rows are sorted by total time.
+    """
+    events = [
+        e for e in _load_events(source)
+        if e.get("ph") == "X" and "dur" in e
+    ]
+    if trial is not None:
+        # The trial's own spans plus every DESCENDANT (epochs, compiles,
+        # checkpoint saves — across processes: parent ids ride the
+        # frames), walked over the span-id -> parent-id edges.
+        roots = {
+            (e.get("args") or {}).get("span_id")
+            for e in events
+            if str((e.get("args") or {}).get("trial_id")) == str(trial)
+        } - {None}
+        parent_of = {
+            (e.get("args") or {}).get("span_id"):
+                (e.get("args") or {}).get("parent_id")
+            for e in events
+        }
+
+        def in_trial(span_id) -> bool:
+            seen = set()
+            while span_id is not None and span_id not in seen:
+                if span_id in roots:
+                    return True
+                seen.add(span_id)
+                span_id = parent_of.get(span_id)
+            return False
+
+        events = [
+            e for e in events
+            if in_trial((e.get("args") or {}).get("span_id"))
+        ]
+    by_name: Dict[str, Dict[str, float]] = {}
+    for e in events:
+        row = by_name.setdefault(
+            e["name"], {"count": 0, "total_ms": 0.0, "max_ms": 0.0}
+        )
+        dur_ms = float(e["dur"]) / 1000.0
+        row["count"] += 1
+        row["total_ms"] += dur_ms
+        row["max_ms"] = max(row["max_ms"], dur_ms)
+    # Share is computed against the longest phase total: nested spans
+    # double-count wall time by construction, so a percent-of-run would
+    # overflow 100 and mislead — percent-of-longest ranks instead.
+    top = max((r["total_ms"] for r in by_name.values()), default=0.0)
+    rows = [
+        {
+            "phase": name,
+            "count": int(r["count"]),
+            "total_ms": round(r["total_ms"], 3),
+            "mean_ms": round(r["total_ms"] / r["count"], 3),
+            "max_ms": round(r["max_ms"], 3),
+            "rel": round(r["total_ms"] / top, 4) if top else 0.0,
+        }
+        for name, r in by_name.items()
+    ]
+    rows.sort(key=lambda r: -r["total_ms"])
+    header = (
+        f"{'phase':<28} {'count':>6} {'total_ms':>12} "
+        f"{'mean_ms':>10} {'max_ms':>10} {'rel':>6}"
+    )
+    lines = [header, "-" * len(header)]
+    for r in rows:
+        lines.append(
+            f"{r['phase']:<28.28} {r['count']:>6} {r['total_ms']:>12.3f} "
+            f"{r['mean_ms']:>10.3f} {r['max_ms']:>10.3f} {r['rel']:>6.2f}"
+        )
+    if not rows:
+        lines.append("(no complete spans matched)")
+    return rows, "\n".join(lines)

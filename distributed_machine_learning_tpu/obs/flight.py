@@ -1,0 +1,227 @@
+"""Always-on flight recorder: the last N events of THIS process, cheap
+enough to leave running everywhere.
+
+Every fail-slow path in the stack — watchdog expiry, STALLED transitions,
+lease expiry on a silent worker, a replica breaker opening, SIGTERM of a
+wedged child, a wedged TPU bench probe — used to leave behind exactly one
+counter increment.  The flight recorder turns each of those into "here are
+the last ~2048 timestamped events this process saw", dumped automatically
+at the moment the fail-slow path fires.
+
+Design constraints (and how they're met):
+
+* **Bounded + preallocated** — a fixed ring of ``capacity`` slots
+  allocated once; recording can never grow memory.
+* **Lock-free, single-writer per slot** — slot claims go through
+  ``itertools.count()`` (its ``__next__`` is C-atomic under the GIL), so
+  concurrent recorders from many threads interleave without a lock and a
+  recorder can never block a hot path.
+* **Crash-safe (opt-in mirror)** — a process that may die holding the
+  ring in memory (the TPU bench probe child, which can wedge in native
+  code where no signal handler runs) sets ``mirror_path``: every event is
+  ALSO appended as a JSON line immediately, so the forensics survive even
+  a SIGKILL.  Mirroring is off by default — hot paths pay only the ring
+  write.
+
+Recording must never raise: a telemetry failure inside a failure handler
+would mask the original incident.  Dump failures are counted
+(``obs.export_failures`` in the registry), never raised.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from distributed_machine_learning_tpu.obs.registry import get_registry
+
+CAPACITY_ENV = "DML_OBS_FLIGHT_CAPACITY"
+MIRROR_ENV = "DML_OBS_FLIGHT_MIRROR"
+DUMP_DIR_ENV = "DML_OBS_DUMP_DIR"
+
+DEFAULT_CAPACITY = 2048
+
+
+class FlightRecorder:
+    """Fixed-capacity ring of recent process events."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 mirror_path: Optional[str] = None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1: {capacity}")
+        self.capacity = int(capacity)
+        self._ring: List[Optional[tuple]] = [None] * self.capacity
+        self._slots = itertools.count()
+        self._mirror_path = None
+        self._mirror_file = None
+        if mirror_path:
+            self.set_mirror(mirror_path)
+
+    # -- recording (hot path) ------------------------------------------------
+
+    def record(self, kind: str, detail: Optional[Dict[str, Any]] = None):
+        """Record one event.  Never raises; the ring write itself is two
+        C-atomic operations (slot claim + item store)."""
+        try:
+            entry = (
+                time.monotonic(), time.time(), threading.get_ident(),
+                kind, detail,
+            )
+            self._ring[next(self._slots) % self.capacity] = entry
+            if self._mirror_file is not None:
+                self._mirror_line(entry)
+        except Exception:  # noqa: BLE001 - telemetry must not break callers
+            get_registry().add("record_failures")
+
+    # -- crash-safe mirror ---------------------------------------------------
+
+    def set_mirror(self, path: Optional[str]) -> None:
+        """Mirror every future event to ``path`` as JSON lines (flushed per
+        event).  ``None`` turns mirroring off."""
+        if self._mirror_file is not None:
+            try:
+                self._mirror_file.close()
+            except OSError:
+                get_registry().add("export_failures")
+        self._mirror_path = path
+        self._mirror_file = None
+        if path:
+            try:
+                self._mirror_file = open(path, "a", buffering=1)
+            except OSError:
+                get_registry().add("export_failures")
+
+    def _mirror_line(self, entry: tuple) -> None:
+        try:
+            self._mirror_file.write(json.dumps(_entry_json(entry)) + "\n")
+        except (OSError, ValueError, TypeError):
+            get_registry().add("export_failures")
+
+    # -- reading -------------------------------------------------------------
+
+    def events(self) -> List[Dict[str, Any]]:
+        """Ring contents oldest-first (concurrent writers may still be
+        landing; this is a best-effort snapshot, which is all forensics
+        need)."""
+        entries = [e for e in list(self._ring) if e is not None]
+        entries.sort(key=lambda e: e[0])
+        return [_entry_json(e) for e in entries]
+
+    def __len__(self) -> int:
+        return sum(1 for e in self._ring if e is not None)
+
+
+def _entry_json(entry: tuple) -> Dict[str, Any]:
+    mono, wall, tid, kind, detail = entry
+    out = {
+        "t_mono": round(mono, 6),
+        "t_wall": round(wall, 6),
+        "tid": tid,
+        "kind": kind,
+    }
+    if detail:
+        out["detail"] = detail
+    return out
+
+
+_recorder: Optional[FlightRecorder] = None
+_recorder_lock = threading.Lock()  # creation only; recording is lock-free
+
+
+def get_flight_recorder() -> FlightRecorder:
+    """The process-wide recorder, created on first use (capacity from
+    ``DML_OBS_FLIGHT_CAPACITY``, mirror from ``DML_OBS_FLIGHT_MIRROR`` —
+    the env path is how probe/bench children inherit crash-safe
+    forensics without any protocol)."""
+    global _recorder
+    if _recorder is None:
+        with _recorder_lock:
+            if _recorder is None:
+                try:
+                    cap = int(os.environ.get(CAPACITY_ENV, DEFAULT_CAPACITY))
+                except ValueError:
+                    cap = DEFAULT_CAPACITY
+                _recorder = FlightRecorder(
+                    max(cap, 1), os.environ.get(MIRROR_ENV) or None
+                )
+    return _recorder
+
+
+def record_event(kind: str, detail: Optional[Dict[str, Any]] = None) -> None:
+    """Module-level convenience: record into the process recorder."""
+    get_flight_recorder().record(kind, detail)
+
+
+_dump_dir: Optional[str] = None
+_dump_seq = itertools.count()
+
+
+def set_dump_dir(path: Optional[str]) -> None:
+    """Default destination for automatic dumps (drivers point this at the
+    experiment root at startup)."""
+    global _dump_dir
+    _dump_dir = path
+
+
+def dump_dir() -> Optional[str]:
+    return _dump_dir or os.environ.get(DUMP_DIR_ENV) or None
+
+
+def dump_flight_recorder(
+    reason: str,
+    directory: Optional[str] = None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> Optional[str]:
+    """Write the ring + per-thread open-span stacks + registry snapshot to
+    a JSON file; returns the path, or None when no destination is
+    configured or the write failed (counted, never raised).
+
+    This is THE fail-slow forensics hook: watchdog expiries, STALLED
+    transitions, lease expiry, breaker-open, SIGTERM handlers, and the
+    bench probe all route here.
+    """
+    dest = directory or dump_dir()
+    if not dest:
+        return None
+    reg = get_registry()
+    safe_reason = "".join(
+        c if c.isalnum() or c in "-_" else "_" for c in reason
+    )[:80]
+    path = os.path.join(
+        dest,
+        f"flightrec_{os.getpid()}_{next(_dump_seq)}_{safe_reason}.json",
+    )
+    try:
+        # Chaos coverage for the telemetry plane itself: an injected
+        # export fault must be absorbed exactly like a real disk error.
+        from distributed_machine_learning_tpu import chaos
+
+        plan = chaos.active_plan()
+        if plan is not None:
+            plan.on_trace_export(path)
+        from distributed_machine_learning_tpu.obs import trace as trace_lib
+
+        payload = {
+            "reason": reason,
+            "pid": os.getpid(),
+            "dumped_at": time.time(),
+            "events": get_flight_recorder().events(),
+            "span_stacks": trace_lib.active_span_stacks(),
+            "registry": reg.snapshot(),
+        }
+        if extra:
+            payload["extra"] = extra
+        os.makedirs(dest, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1, default=str)
+        os.replace(tmp, path)
+    except Exception:  # noqa: BLE001 - forensics must never fail the caller
+        reg.add("export_failures")
+        return None
+    reg.add("flight_dumps")
+    return path

@@ -1,0 +1,169 @@
+"""Unified metrics registry: ONE place every counter family reports into.
+
+Before this module, six subsystems each invented their own telemetry —
+``experiment_state.json`` counter blocks (``liveness``, ``compile``,
+``checkpoint``, ``host_input``, ``pbt``, ``injected_faults``), the serve
+``/metrics`` JSON, and per-driver TensorBoard writers — with no way to ask
+"what does this PROCESS know right now" in one call.  The registry closes
+that gap without breaking anything: the existing counter classes keep
+their shapes (drivers still snapshot/delta them directly, so every
+``experiment_state.json`` block and the serve ``/metrics`` JSON stay
+byte-compatible) and additionally *register* here as a **family** — any
+object (or zero-arg callable) whose ``snapshot()`` returns a flat
+``{name: number}`` dict.
+
+Two surfaces:
+
+* :meth:`MetricsRegistry.snapshot` — ``{"counters": {...}, "families":
+  {fam: {...}}}``, the whole process's telemetry in one dict (flight-
+  recorder dumps embed it, ``/metrics`` serves it under ``"obs"``).
+* :meth:`MetricsRegistry.scalar_snapshot` — the same flattened to
+  ``{"fam/name": value}``, which is what rides the cluster head-node
+  aggregation frame: workers attach it to their terminal frames and the
+  head sums across workers, so cluster-wide counters appear in ONE place
+  (``experiment_state.json["obs"]["cluster"]``).
+
+Registry-native counters (``add``/``get``) hold the obs plane's own
+accounting — ``export_failures``, ``flight_dumps``, ``spans_recorded`` —
+the counters dmlint DML015 (``bare-counter-increment``) steers new code
+toward instead of ad-hoc ``self.x += 1`` attributes.
+
+Stdlib-only (no jax): usable from the linter, the serve plane, and probe
+children alike.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple, Union
+
+from distributed_machine_learning_tpu.analysis.locks import named_lock
+
+FamilyProvider = Union[Callable[[], Dict[str, Any]], Any]
+
+
+class MetricsRegistry:
+    """Process-wide registry of counter families + native counters.
+
+    Thread-safe.  ``snapshot`` copies the provider table under the lock
+    and calls each family's ``snapshot()`` OUTSIDE it, so the registry
+    lock never nests inside (or around) a family's own lock — no
+    lock-order edges with the families it aggregates.
+    """
+
+    def __init__(self):
+        self._lock = named_lock("obs.registry")
+        self._families: Dict[str, FamilyProvider] = {}
+        self._counters: Dict[str, float] = {}
+
+    # -- families ------------------------------------------------------------
+
+    def register_family(self, name: str, provider: FamilyProvider) -> None:
+        """(Re)register ``provider`` under ``name``.
+
+        ``provider`` is either a zero-arg callable returning a flat dict
+        or an object with a ``snapshot()`` method (the existing counter
+        classes all qualify).  Last registration wins — per-run objects
+        (watchdogs, fault plans) re-register freely.
+        """
+        with self._lock:
+            self._families[name] = provider
+
+    def unregister_family(self, name: str, provider: FamilyProvider = None):
+        """Remove ``name``; with ``provider`` given, only if it is still
+        the registered one (a newer run's family is never evicted by an
+        older run's teardown)."""
+        with self._lock:
+            if provider is None or self._families.get(name) is provider:
+                self._families.pop(name, None)
+
+    def families(self) -> List[str]:
+        with self._lock:
+            return sorted(self._families)
+
+    # -- native counters -----------------------------------------------------
+
+    def add(self, name: str, value: float = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    # -- views ---------------------------------------------------------------
+
+    def _family_snapshots(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            providers = dict(self._families)
+        out: Dict[str, Dict[str, Any]] = {}
+        for name, provider in providers.items():
+            try:
+                snap = provider() if callable(provider) else provider.snapshot()
+                if isinstance(snap, dict):
+                    out[name] = snap
+            except Exception:  # noqa: BLE001 - a broken family must not
+                # take the whole plane down; the failure is itself counted.
+                self.add("family_errors")
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Everything this process's registry knows, structured."""
+        families = self._family_snapshots()
+        with self._lock:
+            counters = dict(self._counters)
+        return {"counters": counters, "families": families}
+
+    def scalar_snapshot(self) -> Dict[str, float]:
+        """Flat ``{"family/name": value}`` view (numbers only) — the shape
+        the cluster aggregation frame and TensorBoard scalars consume."""
+        snap = self.snapshot()
+        out: Dict[str, float] = {
+            f"obs/{k}": v for k, v in snap["counters"].items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+        for fam, block in snap["families"].items():
+            for k, v in block.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    out[f"{fam}/{k}"] = v
+        return out
+
+    def delta_since(self, baseline: Dict[str, float]) -> Dict[str, float]:
+        """Native-counter delta vs a prior ``counters_snapshot()`` — how a
+        driver scopes process-wide obs counters to one run."""
+        with self._lock:
+            snap = dict(self._counters)
+        keys = set(snap) | set(baseline)
+        return {
+            k: round(snap.get(k, 0) - baseline.get(k, 0), 4) for k in keys
+        }
+
+    def counters_snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._counters)
+
+    def reset(self) -> None:
+        """Test hook: zero native counters (families stay registered)."""
+        with self._lock:
+            self._counters = {}
+
+
+def aggregate_scalars(
+    per_source: Dict[str, Dict[str, float]],
+) -> Dict[str, float]:
+    """Sum flat scalar snapshots across sources (the head-node view:
+    one dict per worker in, one cluster-wide dict out)."""
+    out: Dict[str, float] = {}
+    for snap in per_source.values():
+        for k, v in (snap or {}).items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[k] = round(out.get(k, 0) + v, 4)
+    return out
+
+
+_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry (one per process, same discipline as
+    ``ckpt.metrics.get_metrics`` / ``compilecache.get_counters``)."""
+    return _registry

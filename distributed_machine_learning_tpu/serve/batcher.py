@@ -36,6 +36,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 from distributed_machine_learning_tpu.analysis.locks import named_lock
+from distributed_machine_learning_tpu import obs
 
 
 class BatcherStopped(RuntimeError):
@@ -67,6 +68,10 @@ class _Pending:
     future: Future
     # Monotonic: feeds the max_latency flush deadline (dmlint DML004).
     enqueued_at: float = field(default_factory=time.monotonic)
+    # Submitter's span context (serve.request/serve.predict): the flush
+    # span on the batcher thread parents under it, so one request's trace
+    # crosses the queue boundary (None when tracing is off — free).
+    obs_ctx: object = field(default_factory=obs.current_context)
 
 
 class BatcherStats:
@@ -209,7 +214,12 @@ class MicroBatcher:
                 return
             try:
                 xs = np.concatenate([p.x for p in batch], axis=0)
-                preds = np.asarray(self.infer_fn(xs))
+                with obs.span(
+                    "batch.flush",
+                    {"rows": int(xs.shape[0]), "requests": len(batch)},
+                    parent=batch[0].obs_ctx,
+                ):
+                    preds = np.asarray(self.infer_fn(xs))
                 off = 0
                 for p in batch:
                     n = p.x.shape[0]
@@ -494,7 +504,12 @@ class ContinuousBatcher:
             try:
                 xs = np.concatenate([p.x for p in batch], axis=0)
                 t0 = time.monotonic()
-                preds = np.asarray(self.infer_fn(xs))
+                with obs.span(
+                    "batch.flush",
+                    {"rows": rows, "requests": len(batch)},
+                    parent=batch[0].obs_ctx,
+                ):
+                    preds = np.asarray(self.infer_fn(xs))
                 self.stats.record_step(
                     self.bucket_for(rows),
                     (time.monotonic() - t0) * 1000.0,

@@ -22,6 +22,7 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from distributed_machine_learning_tpu import obs
 from distributed_machine_learning_tpu.analysis.locks import named_lock
 from distributed_machine_learning_tpu.compilecache import (
     ExecutableCache,
@@ -201,7 +202,7 @@ class InferenceEngine:
             pad = np.zeros((bucket - n, *x.shape[1:]), dtype=x.dtype)
             x = np.concatenate([x, pad], axis=0)
         key = (bucket, x.shape[1:], str(x.dtype))
-        with dispatch_lock():
+        with obs.span("engine.step", {"bucket": bucket}), dispatch_lock():
             ctx = (
                 jax.default_device(self._device)
                 if self._device is not None

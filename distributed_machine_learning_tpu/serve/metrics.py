@@ -77,6 +77,13 @@ class ServeMetrics:
         self.rejected = 0
         self.timeouts = 0
         self.sheds = 0
+        # The serving process's slice of the unified metrics registry
+        # (obs/registry.py): /metrics keeps its exact JSON shape — this
+        # adds the same counters to the one-plane view (flight dumps,
+        # the "obs" block /metrics also serves).
+        from distributed_machine_learning_tpu.obs import get_registry
+
+        get_registry().register_family("serve", self)
 
     def observe(self, latency_s: float, rows: int):
         with self._lock:

@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from distributed_machine_learning_tpu import obs
 from distributed_machine_learning_tpu.analysis.locks import named_lock
 from distributed_machine_learning_tpu.serve.batcher import (
     BatcherStopped,
@@ -179,6 +180,19 @@ class CircuitBreaker:
         self._opened_at = now
         self._probes_in_flight = 0
         self.opens_total += 1
+        # Breaker-open is a fail-slow incident: record it in the flight
+        # ring and dump the ring (no-op unless a dump dir is configured)
+        # so "why did this slot quarantine" has forensics, not a counter.
+        obs.event("breaker_open", {
+            "failures_total": self.failures_total,
+            "opens_total": self.opens_total,
+        })
+        threading.Thread(
+            target=obs.dump_flight_recorder,
+            args=(f"breaker_open_{self.opens_total}",),
+            name="obs-breaker-dump",
+            daemon=True,
+        ).start()
 
     def allow(self) -> bool:
         """May a request be dispatched now?  In half-open, a True answer
@@ -578,24 +592,29 @@ class ReplicaSet:
         dropped-requests contract the soak bench verifies)."""
         attempts = max(int(redispatch), 0) + 1
         for attempt in range(attempts):
-            fut = self.submit(x)
-            try:
-                return fut.result(timeout=timeout)
-            except FuturesTimeoutError:
-                self.timeouts += 1
-                outcome = getattr(fut, "_dml_outcome", None)
-                if outcome is not None:
-                    outcome.record(failed=True)
-                raise ReplicaTimeout(
-                    timeout if timeout is not None else float("inf"),
-                    getattr(fut, "_dml_replica_idx", -1),
-                ) from None
-            except BatcherStopped:
-                # The slot's breaker already charged the failure via the
-                # done-callback; route the request to a survivor.
-                if attempt + 1 >= attempts:
-                    raise
-                self.redispatches += 1
+            with obs.span("serve.predict", {"attempt": attempt}) as sp:
+                fut = self.submit(x)
+                sp.set("replica", getattr(fut, "_dml_replica_idx", -1))
+                try:
+                    return fut.result(timeout=timeout)
+                except FuturesTimeoutError:
+                    self.timeouts += 1
+                    outcome = getattr(fut, "_dml_outcome", None)
+                    if outcome is not None:
+                        outcome.record(failed=True)
+                    obs.event("replica_timeout", {
+                        "replica": getattr(fut, "_dml_replica_idx", -1),
+                    })
+                    raise ReplicaTimeout(
+                        timeout if timeout is not None else float("inf"),
+                        getattr(fut, "_dml_replica_idx", -1),
+                    ) from None
+                except BatcherStopped:
+                    # The slot's breaker already charged the failure via
+                    # the done-callback; route the request to a survivor.
+                    if attempt + 1 >= attempts:
+                        raise
+                    self.redispatches += 1
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -33,6 +33,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from distributed_machine_learning_tpu import obs
 from distributed_machine_learning_tpu.serve.autoscale import (
     AutoscaleConfig,
     ReplicaAutoscaler,
@@ -118,7 +119,8 @@ class PredictionServer:
         if x.ndim < 1 or x.shape[0] == 0:
             raise ValueError("instances must be a non-empty array")
         t0 = time.time()
-        preds = self.replicas.predict(x, timeout=self._timeout_s)
+        with obs.span("serve.request", {"rows": int(x.shape[0])}):
+            preds = self.replicas.predict(x, timeout=self._timeout_s)
         latency = time.time() - t0
         self.metrics.observe(latency, rows=x.shape[0])
         return {
@@ -191,6 +193,10 @@ class PredictionServer:
             # A chaos soak's injections are observable where the breaker
             # state is — one endpoint tells the whole failure story.
             out["injected_faults"] = self._fault_plan.snapshot()
+        # The unified registry's view of this process (obs/registry.py):
+        # every family the process carries, one block.  The keys above
+        # keep their exact shapes — this is additive.
+        out["obs"] = obs.get_registry().snapshot()
         self._tb.emit(self.metrics, extra={
             "queue_depth": batcher.get("queue_depth", 0),
             "batch_fill_ratio": batcher.get("batch_fill_ratio", 0.0),

@@ -40,6 +40,7 @@ def hot_swap(replica_set, new_bundle, sample=None,
     originally warmed with.  ``warm=False`` skips pre-compilation (first
     requests then compile through the caches — only for bundles whose
     programs are known-cached)."""
+    from distributed_machine_learning_tpu import obs
     from distributed_machine_learning_tpu.serve.replica import Replica
 
     rs = replica_set
@@ -47,7 +48,12 @@ def hot_swap(replica_set, new_bundle, sample=None,
         sample = rs._warmup_sample
     t0 = time.monotonic()
     swapped = 0
-    with rs._scale_lock:
+    obs.event("hot_swap_begin", {
+        "bundle": getattr(new_bundle, "path", None),
+    })
+    with obs.span(
+        "serve.hot_swap", {"bundle": getattr(new_bundle, "path", None)}
+    ), rs._scale_lock:
         with rs._lock:
             n = len(rs.replicas)
         for i in range(n):

@@ -186,6 +186,7 @@ def main() -> None:
 
         import jax
 
+        from distributed_machine_learning_tpu import obs
         from distributed_machine_learning_tpu.tune.session import (
             PauseTrial,
             Session,
@@ -197,6 +198,30 @@ def main() -> None:
         )
         tracker = get_tracker()
         devices = jax.devices()
+        # Join the driver's trace (same trace id; spans parent under the
+        # driver's trial.dispatch span) and point flight dumps at the
+        # experiment dir.  A SIGTERM — the runner's stall/time-limit kill
+        # path — dumps this process's flight ring + open-span stacks
+        # BEFORE dying, so a killed wedge leaves its hang site behind.
+        obs.configure_from_frame(
+            init.get("obs"), label=f"child{os.getpid()}"
+        )
+
+        import signal as _signal
+
+        def _on_sigterm(_signum, _frame):
+            obs.dump_flight_recorder(
+                f"sigterm_{init.get('trial_id', 'trial')}"
+            )
+            obs.flush()
+            os._exit(128 + _signal.SIGTERM)
+
+        try:
+            _signal.signal(_signal.SIGTERM, _on_sigterm)
+        except (ValueError, OSError):
+            # Not the main thread / unsupported platform: forensics are
+            # then the parent's job, the trial itself is unaffected.
+            pass
     except BaseException:  # noqa: BLE001
         write_frame(stdout, ("error", traceback.format_exc()))
         return
@@ -238,12 +263,21 @@ def main() -> None:
                 heartbeat_fn=heartbeat_fn,
             )
         )
-        trainable(dict(init["config"]))
+        with obs.maybe_profile_trial(
+            init.get("obs_profile_dir"), init["trial_id"]
+        ), obs.span(
+            "trial",
+            {"trial_id": init["trial_id"],
+             "incarnation": int(init.get("incarnation", 0))},
+        ):
+            trainable(dict(init["config"]))
         write_frame(stdout, ("complete",))
     except (StopTrial, PauseTrial):
         write_frame(stdout, ("complete",))
     except BaseException:  # noqa: BLE001 - everything goes back to the parent
         write_frame(stdout, ("error", traceback.format_exc()))
+    finally:
+        obs.flush()
 
 
 if __name__ == "__main__":

@@ -83,21 +83,25 @@ def save_checkpoint(path: str, tree: Dict[str, Any]) -> str:
     payload — a crash between the two leaves a checkpoint that is merely
     unverifiable, never a manifest pointing at absent data.
     """
+    from distributed_machine_learning_tpu import obs
+
     if _is_sharded(path):
-        return _sharded_fmt.save_sharded(path, tree)
-    t0 = time.time()
-    payload = serialization.to_bytes(_to_host(tree))
-    backend, p = get_storage(path)
-    backend.write_bytes(p, payload)
-    manifest = {
-        "sha256": hashlib.sha256(payload).hexdigest(),
-        "bytes": len(payload),
-        "format": "flax-msgpack",
-    }
-    backend.write_bytes(
-        manifest_path_for(p), json.dumps(manifest).encode()
-    )
-    get_metrics().record_save(time.time() - t0, len(payload), 1)
+        with obs.span("ckpt.save", {"format": "sharded"}):
+            return _sharded_fmt.save_sharded(path, tree)
+    with obs.span("ckpt.save", {"format": "msgpack"}):
+        t0 = time.time()
+        payload = serialization.to_bytes(_to_host(tree))
+        backend, p = get_storage(path)
+        backend.write_bytes(p, payload)
+        manifest = {
+            "sha256": hashlib.sha256(payload).hexdigest(),
+            "bytes": len(payload),
+            "format": "flax-msgpack",
+        }
+        backend.write_bytes(
+            manifest_path_for(p), json.dumps(manifest).encode()
+        )
+        get_metrics().record_save(time.time() - t0, len(payload), 1)
     return path
 
 
@@ -119,10 +123,18 @@ def load_checkpoint(
     """
     if not path:
         return None
+    from distributed_machine_learning_tpu import obs
+
     if _is_sharded(path):
-        return _sharded_fmt.load_sharded(
-            path, verify=verify, shardings=shardings
-        )
+        with obs.span("ckpt.restore", {"format": "sharded"}):
+            return _sharded_fmt.load_sharded(
+                path, verify=verify, shardings=shardings
+            )
+    with obs.span("ckpt.restore", {"format": "msgpack"}):
+        return _load_msgpack(path, verify)
+
+
+def _load_msgpack(path: str, verify: bool) -> Optional[Dict[str, Any]]:
     t0 = time.time()
     backend, p = get_storage(path)
     data = backend.read_bytes(p)

@@ -271,7 +271,14 @@ def _publish_artifacts(state: _WorkerState, key: str,
 
 
 def _worker_run_trial(state: _WorkerState, msg: Dict[str, Any], devices: List):
+    from distributed_machine_learning_tpu import obs
+
     trial_id = msg["trial_id"]
+    # Join the head's trace: the dispatch frame carries the trace id, the
+    # head-side dispatch span to parent under, the shared trace dir, and
+    # the dump destination.  Idempotent re-configuration per trial — a
+    # supervisor serves many trials (and many experiments) in one process.
+    obs.configure_from_frame(msg.get("obs"), label=f"worker{os.getpid()}")
     # Decision routing is keyed by (trial_id, incarnation): after a fence +
     # requeue the driver may redispatch the SAME trial to this same worker
     # while the fenced incarnation still drains — their decisions must
@@ -390,7 +397,9 @@ def _worker_run_trial(state: _WorkerState, msg: Dict[str, Any], devices: List):
                             heartbeat_fn=heartbeat_fn))
         import jax
 
-        with jax.default_device(devices[0]):
+        with jax.default_device(devices[0]), obs.span(
+            "trial", {"trial_id": trial_id, "incarnation": incarnation}
+        ):
             trainable(dict(trial.config))
         terminal = {"type": "complete", "trial_id": trial_id,
                     "incarnation": incarnation}
@@ -406,6 +415,11 @@ def _worker_run_trial(state: _WorkerState, msg: Dict[str, Any], devices: List):
         }
     finally:
         set_session(None)
+        obs.flush()
+        # Head-node aggregation frame: this worker process's whole
+        # registry snapshot rides the terminal frame; the head keeps the
+        # latest per worker and sums across workers at experiment end.
+        terminal["obs_counters"] = obs.get_registry().scalar_snapshot()
         with state.dec_lock:
             # The same-incarnation guard stays even though the terminal frame
             # now follows cleanup: a worker-death requeue on the driver can
@@ -883,6 +897,7 @@ def run_distributed(
     progress_grace_s: Optional[float] = None,
     worker_heartbeat_timeout_s: Optional[float] = 60.0,
     worker_reconnect_grace_s: float = 30.0,
+    trace: bool = False,
 ) -> ExperimentAnalysis:
     """``tune.run`` across multiple host supervisors (see module docstring).
 
@@ -1044,6 +1059,23 @@ def run_distributed(
     artifacts_base = artifacts.snapshot()
     store.set_context(metric, mode)
 
+    # Observability plane (obs/, same surface as tune.run): flight dumps
+    # land in the experiment root; with ``trace`` (or DML_OBS_TRACE=1) the
+    # driver AND every worker stream spans into <root>/trace/ — workers
+    # reach it through the dispatch frame's trace context, so one trial's
+    # spans share one trace id across the head/worker boundary.  Shared
+    # storage is assumed exactly as it is for checkpoints.
+    from distributed_machine_learning_tpu import obs as obs_lib
+
+    trace = trace or os.environ.get("DML_OBS_TRACE") == "1"
+    trace_dir = os.path.join(store.root, "trace") if trace else None
+    prev_dump_dir = obs_lib.dump_dir()
+    obs_lib.configure(trace_dir=trace_dir, label="head",
+                      dump_dir=store.root)
+    obs_counters_base = obs_lib.get_registry().counters_snapshot()
+    worker_obs: Dict[str, Dict[str, float]] = {}  # addr -> last snapshot
+    trial_spans: Dict[str, Any] = {}
+
     events: "queue.Queue[Tuple]" = queue.Queue()
     pool: List[RemoteWorker] = []
 
@@ -1167,6 +1199,15 @@ def run_distributed(
         "worker_reconnects": 0,
         "quarantined_checkpoints": 0,
     }
+    # Live view of the head's liveness counters in the unified registry
+    # (the published experiment_state.json block keeps its shape below).
+    obs_lib.get_registry().register_family(
+        "liveness",
+        lambda: {
+            **liveness,
+            **(watchdog.snapshot() if watchdog is not None else {}),
+        },
+    )
 
     lifecycle = TrialLifecycle(
         searcher=searcher,
@@ -1220,6 +1261,18 @@ def run_distributed(
                     worker.startup_s,
                 ),
             )
+        # Head-side dispatch span; its context rides the dispatch frame so
+        # the worker's trial span lands in the SAME trace (id included).
+        span = obs_lib.detached_span(
+            "trial.dispatch",
+            {"trial_id": trial.trial_id, "incarnation": trial.incarnation,
+             "worker": worker.address},
+            parent=obs_lib.current_context(),
+        )
+        trial_spans[trial.trial_id] = span
+        obs_lib.event("trial_dispatch", {
+            "trial_id": trial.trial_id, "worker": worker.address,
+        })
         safe_cb("on_trial_start", trial)
         try:
             trial_mesh = trial.config.get("mesh_shape") or {}
@@ -1240,6 +1293,7 @@ def run_distributed(
                     "restore_path": trial.restore_path,
                     "start_iteration": trial.training_iteration,
                     "artifact_origin": artifact_origin,
+                    "obs": obs_lib.trace_context_frame(parent=span.context),
                 }
             )
         except OSError:
@@ -1262,6 +1316,9 @@ def run_distributed(
             worker.running.pop(trial.trial_id, None)
         if watchdog is not None:
             watchdog.untrack(trial.trial_id)
+        span = trial_spans.pop(trial.trial_id, None)
+        if span is not None:
+            span.end()
 
     def requeue_lost(trial: Trial, why: str,
                      counter: str = "silent_worker_requeues"):
@@ -1348,6 +1405,14 @@ def run_distributed(
                     worker.suspect = True
                     worker.expired_at = now
                     liveness["lease_expiries"] += 1
+                    # Head-side forensics for a silent worker: the last
+                    # ~2048 driver events (dispatches, results, beats)
+                    # around the moment the lease expired.
+                    obs_lib.dump_flight_recorder(
+                        f"lease_expiry_{worker.address}",
+                        extra={"worker": worker.address,
+                               "silent_s": round(silent, 2)},
+                    )
                     lost = [by_id[tid] for tid in list(worker.running)]
                     log(
                         f"worker {worker.address} silent for {silent:.1f}s "
@@ -1378,6 +1443,12 @@ def run_distributed(
                     continue
                 trial.stall_count += 1
                 liveness["stalls_detected"] += 1
+                obs_lib.dump_flight_recorder(
+                    f"stall_{trial.trial_id}",
+                    extra={"trial_id": trial.trial_id,
+                           "worker": worker.address,
+                           "age_s": round(event.age_s, 2)},
+                )
                 why = (
                     f"stalled: no progress signal in {event.age_s:.1f}s "
                     f"on {worker.address} (deadline "
@@ -1397,6 +1468,8 @@ def run_distributed(
                 launch_ready()
 
     # ---- main loop ----
+    exp_span = obs_lib.span("experiment", {"name": name})
+    exp_span.__enter__()
     try:
         # Inside the try so every setup is paired with on_experiment_end in
         # the finally (a ProfilerCallback's process-global trace must stop
@@ -1586,6 +1659,11 @@ def run_distributed(
                 safe_cb("on_trial_result", trial, trial.last_result)
 
             elif mtype == "complete":
+                if msg.get("obs_counters"):
+                    # Head-node aggregation frame: the worker's whole
+                    # registry snapshot (latest wins per worker; totals
+                    # are summed across workers at teardown).
+                    worker_obs[worker.address] = msg["obs_counters"]
                 release(trial)
                 # complete_trial returns True when the scheduler REQUEUEs
                 # (PBT exploit): the trial keeps living, so no completion
@@ -1595,12 +1673,15 @@ def run_distributed(
                 store.write_state(trials)
 
             elif mtype == "error":
+                if msg.get("obs_counters"):
+                    worker_obs[worker.address] = msg["obs_counters"]
                 trial.error = msg.get("traceback", "unknown error")
                 release(trial)
                 safe_cb("on_trial_error", trial, trial.error)
                 lifecycle.fail_trial(trial, trial.error)
                 store.write_state(trials)
     finally:
+        exp_span.__exit__(None, None, None)
         wall = time.time() - start_time
         if elastic_server is not None:
             try:
@@ -1660,6 +1741,32 @@ def run_distributed(
         pbt_block = pbt_state_block(sched)
         if pbt_block is not None:
             extra["pbt"] = pbt_block
+        # Observability teardown: close straggler dispatch spans, merge
+        # the per-process trace files (driver + every worker that shares
+        # the storage), publish the obs counter delta AND the cluster-wide
+        # aggregation of the workers' registry snapshots — the head-node
+        # view the six scattered counter families never had.
+        for span in trial_spans.values():
+            span.end()
+        trial_spans.clear()
+        merged_trace = None
+        if trace_dir is not None:
+            obs_lib.flush()
+            merged_trace = obs_lib.merge_trace_dir(trace_dir)
+            obs_lib.shutdown()
+        obs_delta = obs_lib.get_registry().delta_since(obs_counters_base)
+        obs_block: Dict[str, Any] = {
+            k: v for k, v in obs_delta.items() if v
+        }
+        if merged_trace is not None:
+            obs_block["trace"] = merged_trace
+        if worker_obs:
+            obs_block["cluster"] = obs_lib.aggregate_scalars(worker_obs)
+            obs_block["cluster_workers"] = len(worker_obs)
+        if obs_block:
+            extra["obs"] = obs_block
+        obs_lib.get_registry().unregister_family("liveness")
+        obs_lib.set_dump_dir(prev_dump_dir)
         try:
             store.write_state(trials, extra=extra)
             store.close()
@@ -1676,6 +1783,9 @@ def run_distributed(
                for k, v in (extra.get("compile") or {}).items()},
             **{f"pbt/{k}": v
                for k, v in (extra.get("pbt") or {}).items()
+               if isinstance(v, (int, float)) and not isinstance(v, bool)},
+            **{f"obs/{k}": v
+               for k, v in (extra.get("obs") or {}).items()
                if isinstance(v, (int, float)) and not isinstance(v, bool)},
         }
         if counter_scalars:

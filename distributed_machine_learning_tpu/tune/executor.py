@@ -31,6 +31,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 
+from distributed_machine_learning_tpu import obs
 from distributed_machine_learning_tpu.analysis.locks import named_lock
 from distributed_machine_learning_tpu.ckpt import metrics as ckpt_metrics
 from distributed_machine_learning_tpu.tune import checkpoint as ckpt_lib
@@ -292,6 +293,10 @@ class ThreadTrialExecutor:
             # overlap counters: an async write still in flight when the
             # next step reports is a demonstrably overlapped save.
             ckpt_metrics.note_step()
+            obs.event("report", {
+                "trial_id": trial.trial_id,
+                "iteration": trial.training_iteration + 1,
+            })
             if checkpoint is not None and writer_hung[0]:
                 checkpoint = None
             if checkpoint is not None:
@@ -366,8 +371,16 @@ class ThreadTrialExecutor:
         try:
             # TraceAnnotation tags this trial's host activity in profiler
             # captures (ProfilerCallback), so per-trial spans are visible.
+            # The obs span parents under the driver's trial.dispatch span
+            # (same thread stack from here on: epoch/ckpt spans nest).
             with jax.default_device(devices[0]), jax.profiler.TraceAnnotation(
                 f"trial:{trial.trial_id}"
+            ), obs.maybe_profile_trial(
+                getattr(trial, "_obs_profile_dir", None), trial.trial_id
+            ), obs.span(
+                "trial",
+                {"trial_id": trial.trial_id, "incarnation": incarnation},
+                parent=getattr(trial, "_obs_parent", None),
             ):
                 trainable(dict(trial.config))
             self.events.put(("complete", trial, None, incarnation))
@@ -635,6 +648,9 @@ class ProcessTrialExecutor:
         proc = self._procs.get(trial.trial_id)
         if proc is None or proc.poll() is not None:
             return
+        obs.event("trial_kill", {
+            "trial_id": trial.trial_id, "reason": reason,
+        })
         proc.terminate()
 
         def _escalate():
@@ -709,6 +725,16 @@ class ProcessTrialExecutor:
                     "trainable": cloudpickle.dumps(trainable),
                     "restore": restore,
                     "sys_path": list(sys.path),
+                    # Trace context + dump destination: the child's spans
+                    # join THIS trial's trace, its SIGTERM handler dumps
+                    # its flight ring into the experiment dir.
+                    "obs": obs.trace_context_frame(
+                        parent=getattr(trial, "_obs_parent", None)
+                    ),
+                    "obs_profile_dir": getattr(
+                        trial, "_obs_profile_dir", None
+                    ),
+                    "incarnation": incarnation,
                 },
             )
             while True:

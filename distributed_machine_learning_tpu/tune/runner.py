@@ -97,6 +97,8 @@ def run(
     points_to_evaluate: Optional[List[Dict[str, Any]]] = None,
     progress_deadline_s: Optional[float] = None,
     progress_grace_s: Optional[float] = None,
+    trace: bool = False,
+    trace_profile_trials: int = 0,
 ) -> ExperimentAnalysis:
     """Run an HPO experiment; see module docstring.
 
@@ -187,6 +189,22 @@ def run(
     re-run from their newest checkpoint, and sampling continues to
     ``num_samples`` — driver-crash / preemption recovery for the whole
     experiment, not just single trials.
+    ``trace``: structured tracing (``obs/``, docs/observability.md; also
+    enabled by ``DML_OBS_TRACE=1``): every process in the run — driver,
+    process-executor children — streams spans (trial lifecycle, epochs,
+    compiles, checkpoint save/restore, prefetch waits) to per-process
+    files under ``<experiment>/trace/``, merged into a Chrome-trace/
+    Perfetto ``trace.json`` at experiment end (``dml-tpu trace`` to
+    export/summarize).  Trace ids are consistent across the process
+    boundary.  Off (the default), the instrumentation costs one
+    None-check per span.  Either way the run points the always-on flight
+    recorder at the experiment root: a stall, kill, or SIGTERM dumps the
+    last ~2048 events (``flightrec_*.json``) with per-thread open-span
+    stacks — the hang site, not just a counter.
+    ``trace_profile_trials``: programmatically ``jax.profiler``-capture
+    the first N trials into ``<experiment>/profile/<trial_id>/`` (one at
+    a time; concurrent candidates skip, counted).  Independent of
+    ``trace``.
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
@@ -244,6 +262,24 @@ def run(
             f"got {input_mode!r}"
         )
     host_input_base = hostpipe.get_host_input_counters().snapshot()
+    # Observability plane (obs/): flight-recorder dumps land in the
+    # experiment root for THIS run; tracing (opt-in) streams spans to
+    # <root>/trace/ per process, merged at teardown.
+    import os as _os
+
+    from distributed_machine_learning_tpu import obs as obs_lib
+
+    trace = trace or _os.environ.get("DML_OBS_TRACE") == "1"
+    trace_dir = _os.path.join(store.root, "trace") if trace else None
+    prev_dump_dir = obs_lib.dump_dir()
+    obs_lib.configure(trace_dir=trace_dir, label="driver",
+                      dump_dir=store.root)
+    profile_dir = (
+        _os.path.join(store.root, "profile")
+        if trace_profile_trials > 0 else None
+    )
+    profile_budget = [max(int(trace_profile_trials), 0)]
+    obs_counters_base = obs_lib.get_registry().counters_snapshot()
     device_mgr = DeviceManager(devices)
     events: "queue.Queue" = queue.Queue()
     watchdog = None
@@ -300,6 +336,16 @@ def run(
     pending = lifecycle.pending
     start_time = lifecycle.start_time
 
+    liveness_counters = {"stall_kills": 0, "stall_requeues": 0}
+    if watchdog is not None:
+        # The liveness family in the unified registry: watchdog counters +
+        # the runner's kill/requeue responses, live (the published
+        # experiment_state.json block keeps its existing shape below).
+        obs_lib.get_registry().register_family(
+            "liveness",
+            lambda: {**watchdog.snapshot(), **liveness_counters},
+        )
+
     if resume:
         counts = lifecycle.restore_experiment(resources=resources)
         log(
@@ -314,6 +360,8 @@ def run(
 
         dispatch_safely(callbacks, hook, *args, log=log)
 
+    trial_spans: Dict[str, Any] = {}  # trial_id -> open dispatch span
+
     def launch_ready():
         while pending and len(running) < max_concurrent:
             leased = device_mgr.acquire(pending[0].resources.devices)
@@ -324,6 +372,23 @@ def run(
             running[trial.trial_id] = leased
             if watchdog is not None:
                 watchdog.track(trial.trial_id)
+            # Driver-side dispatch span (detached: it closes on a later
+            # event-loop iteration); the executor parents the in-trial
+            # span under it — across the process boundary too.
+            span = obs_lib.detached_span(
+                "trial.dispatch",
+                {"trial_id": trial.trial_id,
+                 "incarnation": trial.incarnation},
+                parent=obs_lib.current_context(),
+            )
+            trial_spans[trial.trial_id] = span
+            trial._obs_parent = span.context
+            if profile_dir is not None and profile_budget[0] > 0:
+                profile_budget[0] -= 1
+                trial._obs_profile_dir = profile_dir
+            else:
+                trial._obs_profile_dir = None
+            obs_lib.event("trial_dispatch", {"trial_id": trial.trial_id})
             safe_cb("on_trial_start", trial)
             executor.start_trial(trial, trainable, leased)
 
@@ -333,10 +398,12 @@ def run(
             device_mgr.release(leased)
         if watchdog is not None:
             watchdog.untrack(trial.trial_id)
+        span = trial_spans.pop(trial.trial_id, None)
+        if span is not None:
+            span.end()
 
     # -------- main event loop ------------------------------------------------
     last_enforce = [0.0]
-    liveness_counters = {"stall_kills": 0, "stall_requeues": 0}
     _STALL_PREFIX = "stalled: no progress signal"
 
     def enforce_liveness():
@@ -364,6 +431,16 @@ def run(
                 watchdog.untrack(event.key)
                 continue
             trial.stall_count += 1
+            # Forensics BEFORE the response: the dump carries the last
+            # ~2048 process events plus every thread's open-span stack —
+            # under the thread executor that includes the stalled trial
+            # thread's innermost span, i.e. the hang site.
+            obs_lib.dump_flight_recorder(
+                f"stall_{trial.trial_id}",
+                extra={"trial_id": trial.trial_id,
+                       "age_s": round(event.age_s, 2),
+                       "deadline_s": event.deadline_s},
+            )
             if getattr(executor, "supports_kill", False):
                 why = (
                     f"{_STALL_PREFIX} in {event.age_s:.1f}s "
@@ -532,9 +609,13 @@ def run(
     # callbacks must see experiment end so e.g. ProfilerCallback stops the
     # process-global trace and JsonlCallback closes its file.
     try:
-        for cb in callbacks:
-            cb.setup(store.root, metric, mode)
-        event_loop()
+        # The experiment root span: every driver-side span (trial
+        # dispatches) and, via frame context, every child/worker span
+        # shares its trace id.
+        with obs_lib.span("experiment", {"name": name}):
+            for cb in callbacks:
+                cb.setup(store.root, metric, mode)
+            event_loop()
     finally:
         # Clock first (teardown time is not experiment time), then tear the
         # executor down: an interrupted sweep must not leave orphan trial
@@ -600,6 +681,26 @@ def run(
             # respawn driver's slice of what run_vectorized reports richer
             # (generations/host_dispatches only exist in-device).
             extra["pbt"] = pbt_block
+        # Observability-plane accounting + trace merge: close any spans
+        # still open (teardown), merge the per-process span files into
+        # one Chrome-trace JSON, and publish the obs counter delta.
+        for span in trial_spans.values():
+            span.end()
+        trial_spans.clear()
+        merged_trace = None
+        if trace_dir is not None:
+            obs_lib.flush()
+            merged_trace = obs_lib.merge_trace_dir(trace_dir)
+            obs_lib.shutdown()
+        obs_delta = obs_lib.get_registry().delta_since(obs_counters_base)
+        obs_block = {k: v for k, v in obs_delta.items() if v}
+        if merged_trace is not None:
+            obs_block["trace"] = merged_trace
+        if obs_block:
+            extra["obs"] = obs_block
+        if watchdog is not None:
+            obs_lib.get_registry().unregister_family("liveness")
+        obs_lib.set_dump_dir(prev_dump_dir)
         try:
             store.write_state(trials, extra=extra)
             store.close()
@@ -618,6 +719,9 @@ def run(
                for k, v in (extra.get("host_input") or {}).items()},
             **{f"pbt/{k}": v
                for k, v in (extra.get("pbt") or {}).items()
+               if isinstance(v, (int, float)) and not isinstance(v, bool)},
+            **{f"obs/{k}": v
+               for k, v in (extra.get("obs") or {}).items()
                if isinstance(v, (int, float)) and not isinstance(v, bool)},
         }
         if counter_scalars:

@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_machine_learning_tpu import obs
 from distributed_machine_learning_tpu.analysis.locks import named_lock
 from distributed_machine_learning_tpu.data.loader import Dataset
 from distributed_machine_learning_tpu.models import build_model
@@ -475,7 +476,7 @@ def train_regressor(
         # the hold: stamping outside would count lock-wait — other
         # trials' whole epochs — as this trial's execute time and
         # deflate mfu by ~Nx under serialization.
-        with dispatch_lock():
+        with obs.span("epoch", {"epoch": epoch}), dispatch_lock():
             epoch_key = jax.random.key(
                 fold_seed(seed, "epoch", epoch), impl=rng_impl
             )
@@ -957,6 +958,10 @@ def _train_regressor_streaming(
         for epoch in range(start_epoch, num_epochs):
             step_count = (epoch + 1) * steps_per_epoch
             opt_steps = (epoch + 1) * max(steps_per_epoch // accum, 1)
+            epoch_span = obs.span(
+                "epoch", {"epoch": epoch, "mode": "streaming"}
+            )
+            epoch_span.__enter__()
             with dispatch_lock():
                 epoch_key = jax.random.key(
                     fold_seed(seed, "epoch", epoch), impl=rng_impl
@@ -1047,6 +1052,11 @@ def _train_regressor_streaming(
                 if serialization_on():
                     with dispatch_lock():
                         checkpoint = jax.device_get(checkpoint)
+            # Close the epoch span before report (report blocks on the
+            # scheduler; that wait is dispatch time, not epoch time).  An
+            # exception above leaves it OPEN on purpose: a stall dump then
+            # shows the in-flight epoch as the hang site.
+            epoch_span.__exit__(None, None, None)
             session.report(record, checkpoint=checkpoint)
     finally:
         # Early stop, crash, or clean finish: the producer thread and the

@@ -45,6 +45,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from distributed_machine_learning_tpu import obs
 from distributed_machine_learning_tpu.compilecache import (
     get_counters as get_compile_counters,
     sharded_program_key,
@@ -598,6 +599,10 @@ def _train_sharded(
             for epoch in range(start_epoch, num_epochs):
                 step_count = (epoch + 1) * steps_per_epoch
                 opt_steps = (epoch + 1) * max(steps_per_epoch // accum, 1)
+                epoch_span = obs.span(
+                    "epoch", {"epoch": epoch, "mode": "streaming"}
+                )
+                epoch_span.__enter__()
                 with dispatch_lock():
                     epoch_key = jax.random.key(
                         fold_seed(seed, "epoch", epoch)
@@ -672,6 +677,10 @@ def _train_sharded(
                             "batch_stats": _host(batch_stats),
                             "epoch": epoch,
                         }
+                # Close before report (scheduler wait is not epoch time);
+                # an exception above leaves it open — the stall dump then
+                # names the in-flight epoch as the hang site.
+                epoch_span.__exit__(None, None, None)
                 session.report(record, checkpoint=checkpoint)
         finally:
             # Early stop, crash, or clean finish: the producer thread and
@@ -692,7 +701,7 @@ def _train_sharded(
         step_count = (epoch + 1) * steps_per_epoch
         # Schedule is indexed by optimizer steps (micro-steps // accum).
         opt_steps = (epoch + 1) * max(steps_per_epoch // accum, 1)
-        with dispatch_lock():
+        with obs.span("epoch", {"epoch": epoch}), dispatch_lock():
             epoch_key = jax.random.key(fold_seed(seed, "epoch", epoch))
             # Optax schedules are jnp-based — evaluating one is a small
             # device dispatch, so it stays inside the hold (advisor r5:

@@ -74,6 +74,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_machine_learning_tpu import obs as _obs
 from distributed_machine_learning_tpu.data.loader import Dataset
 from distributed_machine_learning_tpu.models import build_model
 from distributed_machine_learning_tpu.ops.losses import get_loss
@@ -878,6 +879,11 @@ def run_vectorized(
     store = ExperimentStore(storage_path, name)
     store.set_context(metric, mode)
     start_time = time.time()
+    # Observability plane: flight dumps (dispatch stalls) land in the
+    # experiment root; obs counter deltas publish at teardown.
+    _prev_dump_dir = _obs.dump_dir()
+    _obs.configure(dump_dir=store.root)
+    _obs_counters_base = _obs.get_registry().counters_snapshot()
 
     def log(msg: str):
         if verbose:
@@ -929,6 +935,13 @@ def run_vectorized(
                 f"{info.get('epoch0', '?')}..{info.get('epoch_end', '?')} "
                 f"over {info.get('rows', '?')} rows",
                 file=sys.stderr, flush=True,
+            )
+            # And the flight ring: the dump shows what the driver was
+            # doing in the run-up to the wedge (last dispatches, ckpt
+            # submits, compile events).
+            _obs.dump_flight_recorder(
+                "vectorized_dispatch_stall",
+                extra={"age_s": round(event.age_s, 2), **info},
             )
 
         # The dispatch blocks THIS thread, so detection needs the monitor
@@ -1092,6 +1105,16 @@ def run_vectorized(
                 **pbt_counters,
                 **pbt.debug_state(),
             }
+            # The pbt family in the unified registry: the same block,
+            # queryable process-wide (flight dumps embed it).
+            _obs.get_registry().register_family(
+                "pbt", lambda: dict(pbt_counters)
+            )
+        obs_delta = _obs.get_registry().delta_since(_obs_counters_base)
+        obs_block = {k: v for k, v in obs_delta.items() if v}
+        if obs_block:
+            extra["obs"] = obs_block
+        _obs.set_dump_dir(_prev_dump_dir)
         try:
             store.write_state(trials, extra=extra)
             store.close()
@@ -1111,6 +1134,9 @@ def run_vectorized(
                if isinstance(v, (int, float)) and not isinstance(v, bool)},
             **{f"pbt/{k}": v
                for k, v in (extra.get("pbt") or {}).items()
+               if isinstance(v, (int, float)) and not isinstance(v, bool)},
+            **{f"obs/{k}": v
+               for k, v in (extra.get("obs") or {}).items()
                if isinstance(v, (int, float)) and not isinstance(v, bool)},
         }
         if counter_scalars:
@@ -2000,20 +2026,24 @@ def _run_population(
             if _plan is not None:
                 _plan.maybe_hang_dispatch("vectorized", epoch0 + 1)
             data = program.data
-            params, opt_state, batch_stats, _lr_out, _wd_out, ys = run(
-                params, opt_state, batch_stats, base_keys, pbt_keys,
-                jnp.asarray(pbt_row_lr), jnp.asarray(pbt_row_wd),
-                data.x_train, data.y_train, data.x_val, data.y_val,
-                data.val_mask,
-                jnp.arange(gen0, gen0 + g), jnp.float32(obj_scale),
-            )
-            tls_all = np.asarray(ys[0])                       # (g, K, iv)
-            ms_all = {k: np.asarray(v) for k, v in ys[1].items()}
-            scores_all = np.asarray(ys[2], np.float32)        # (g, K)
-            src_all = np.asarray(ys[3])
-            newlr_all = np.asarray(ys[4], np.float32)
-            newwd_all = np.asarray(ys[5], np.float32)
-            expl_all = np.asarray(ys[6])
+            with _obs.span(
+                "pbt.generation",
+                {"gen0": gen0, "generations": g, "rows": len(rows)},
+            ):
+                params, opt_state, batch_stats, _lr_out, _wd_out, ys = run(
+                    params, opt_state, batch_stats, base_keys, pbt_keys,
+                    jnp.asarray(pbt_row_lr), jnp.asarray(pbt_row_wd),
+                    data.x_train, data.y_train, data.x_val, data.y_val,
+                    data.val_mask,
+                    jnp.arange(gen0, gen0 + g), jnp.float32(obj_scale),
+                )
+                tls_all = np.asarray(ys[0])                   # (g, K, iv)
+                ms_all = {k: np.asarray(v) for k, v in ys[1].items()}
+                scores_all = np.asarray(ys[2], np.float32)    # (g, K)
+                src_all = np.asarray(ys[3])
+                newlr_all = np.asarray(ys[4], np.float32)
+                newwd_all = np.asarray(ys[5], np.float32)
+                expl_all = np.asarray(ys[6])
             if watchdog is not None:
                 watchdog.untrack("dispatch")
             cold_dispatch = False
@@ -2131,31 +2161,42 @@ def _run_population(
         _plan = _chaos.active_plan()
         if _plan is not None:
             _plan.maybe_hang_dispatch("vectorized", epoch0 + 1)
-        if chunk == 1:
-            epoch_keys = jax.vmap(
-                lambda key: jax.random.fold_in(key, epoch0)
-            )(base_keys)
-            params, opt_state, batch_stats, tl = program.train_epoch(
-                params, opt_state, batch_stats, data.x_train, data.y_train,
-                epoch_keys,
-            )
-            metrics_k = program.eval_population(
-                params, batch_stats, data.x_val, data.y_val, data.val_mask
-            )
-            tl_chunk = np.asarray(tl)[:, None]  # (K, 1)
-            metrics_chunk = {
-                key: np.asarray(v)[:, None] for key, v in metrics_k.items()
-            }
-        else:
-            params, opt_state, batch_stats, tls, ms = program.train_epochs(
-                params, opt_state, batch_stats, base_keys,
-                data.x_train, data.y_train,
-                data.x_val, data.y_val, data.val_mask,
-                jnp.arange(epoch0, epoch0 + chunk),
-            )
-            # vmap(scan) stacks as (K, E)
-            tl_chunk = np.asarray(tls)
-            metrics_chunk = {key: np.asarray(v) for key, v in ms.items()}
+        with _obs.span(
+            "vec.dispatch",
+            {"epoch0": epoch0, "epochs": chunk, "rows": len(rows)},
+        ):
+            if chunk == 1:
+                epoch_keys = jax.vmap(
+                    lambda key: jax.random.fold_in(key, epoch0)
+                )(base_keys)
+                params, opt_state, batch_stats, tl = program.train_epoch(
+                    params, opt_state, batch_stats,
+                    data.x_train, data.y_train,
+                    epoch_keys,
+                )
+                metrics_k = program.eval_population(
+                    params, batch_stats, data.x_val, data.y_val,
+                    data.val_mask
+                )
+                tl_chunk = np.asarray(tl)[:, None]  # (K, 1)
+                metrics_chunk = {
+                    key: np.asarray(v)[:, None]
+                    for key, v in metrics_k.items()
+                }
+            else:
+                params, opt_state, batch_stats, tls, ms = (
+                    program.train_epochs(
+                        params, opt_state, batch_stats, base_keys,
+                        data.x_train, data.y_train,
+                        data.x_val, data.y_val, data.val_mask,
+                        jnp.arange(epoch0, epoch0 + chunk),
+                    )
+                )
+                # vmap(scan) stacks as (K, E)
+                tl_chunk = np.asarray(tls)
+                metrics_chunk = {
+                    key: np.asarray(v) for key, v in ms.items()
+                }
         # Materialize BEFORE reading the clocks: eval execution is part of
         # the per-epoch cost the compaction model weighs (np.asarray above
         # synced everything).

@@ -15,6 +15,13 @@ flags live in ONE place:
 * ``--full`` — gate the whole tree instead of the diff (the nightly /
   release mode).
 * ``--no-jax`` — AST tier only, for hosts without a working jax install.
+* ``--no-perf-guard`` — skip the obs-plane disabled-path overhead check.
+
+The gate also runs the observability-plane overhead guard
+(``DML_OBS_PERF_GUARD=1`` in its own environment): the tracing-DISABLED
+``obs.span()`` path must stay at a few hundred ns per call with zero net
+allocation, or always-on instrumentation in epoch/request hot paths stops
+being free — a regression there gates the diff like a lint finding.
 
 Exit code is the lint's: 0 clean, 1 unsuppressed findings, 2 usage/git
 trouble — the same contract as ``dml-tpu lint`` itself.
@@ -49,6 +56,8 @@ def main(argv=None) -> int:
                    help="lint the whole tree, not just the diff")
     p.add_argument("--no-jax", action="store_true",
                    help="skip the program-level (jaxlint) tier")
+    p.add_argument("--no-perf-guard", action="store_true",
+                   help="skip the obs disabled-path overhead guard")
     args = p.parse_args(argv)
 
     cmd = [sys.executable, "-m", "distributed_machine_learning_tpu",
@@ -87,7 +96,50 @@ def main(argv=None) -> int:
     print(f"lint gate: {len(live)} live finding(s), "
           f"{len(results) - len(live)} suppressed/baselined "
           f"-> {args.out}")
+    if proc.returncode == 0 and not args.no_perf_guard:
+        rc = _obs_perf_guard(env)
+        if rc:
+            return rc
     return proc.returncode
+
+
+# Generous CI bounds (shared-runner jitter); the tier-1 guard in
+# tests/test_obs_plane.py measures the same function.
+PERF_GUARD_NS_BUDGET = 1500.0
+PERF_GUARD_BLOCK_BUDGET = 16
+
+
+def _obs_perf_guard(env) -> int:
+    """Run obs.disabled_path_overhead in a child (DML_OBS_PERF_GUARD=1)
+    and fail the gate if the disabled span path regressed."""
+    env = dict(env, DML_OBS_PERF_GUARD="1")
+    code = (
+        "import json\n"
+        "from distributed_machine_learning_tpu import obs\n"
+        "print(json.dumps(min((obs.disabled_path_overhead()\n"
+        "      for _ in range(3)), key=lambda r: r['ns_per_span'])))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO, capture_output=True, text=True, env=env, timeout=300,
+    )
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr)
+        print("obs perf guard: FAILED to run")
+        return 1
+    measured = json.loads(proc.stdout.strip().splitlines()[-1])
+    ok = (
+        measured["ns_per_span"] <= PERF_GUARD_NS_BUDGET
+        and measured["net_blocks"] <= PERF_GUARD_BLOCK_BUDGET
+    )
+    print(
+        f"obs perf guard: {measured['ns_per_span']:.0f} ns/span, "
+        f"{measured['net_blocks']} net blocks over {measured['iters']} "
+        f"disabled spans (budget {PERF_GUARD_NS_BUDGET:.0f} ns / "
+        f"{PERF_GUARD_BLOCK_BUDGET} blocks) -> "
+        f"{'ok' if ok else 'REGRESSED'}"
+    )
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

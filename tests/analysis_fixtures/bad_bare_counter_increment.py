@@ -1,0 +1,29 @@
+# dmlint-scope: obs-metrics
+"""Historical risk pattern (ISSUE 13 satellite): ad-hoc telemetry
+counters grown as bare ``self.x += 1`` attributes.  Before obs/registry.py
+six subsystems each accreted a private counter family exactly this way —
+every one needed hand-plumbing into experiment_state.json, /metrics, and
+TensorBoard separately, and none were visible to flight-recorder dumps or
+cluster head aggregation."""
+
+
+class RequestPath:
+    """Not a metrics provider: no snapshot()/stats()/to_dict()."""
+
+    def __init__(self):
+        self.requests_total = 0
+        self.timeouts = 0
+        self.cache_misses = 0
+        self.retry_after = 1.0
+
+    def handle(self, ok: bool):
+        self.requests_total += 1  # EXPECT: bare-counter-increment
+        if not ok:
+            self.timeouts += 1  # EXPECT: bare-counter-increment
+
+    def lookup(self, found: bool):
+        if not found:
+            self.cache_misses += 1  # EXPECT: bare-counter-increment
+        # Non-counter numeric state is fine (name doesn't read as
+        # telemetry):
+        self.retry_after += 0.5

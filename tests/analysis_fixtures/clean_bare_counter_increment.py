@@ -1,0 +1,42 @@
+# dmlint-scope: obs-metrics
+"""Idiomatic twins of bad_bare_counter_increment.py: telemetry routed
+through the observability plane — either the registry's native counters
+or a family class that exposes ``snapshot()`` (the ``register_family``
+contract), whose internal increments ARE the plane."""
+
+from distributed_machine_learning_tpu.obs import get_registry
+
+
+class RequestMetrics:
+    """A metrics provider: exposes snapshot(), registers as a family."""
+
+    def __init__(self):
+        self.requests_total = 0
+        self.timeouts = 0
+        get_registry().register_family("request_fixture", self)
+
+    def handle(self, ok: bool):
+        self.requests_total += 1
+        if not ok:
+            self.timeouts += 1
+
+    def snapshot(self):
+        return {
+            "requests_total": self.requests_total,
+            "timeouts": self.timeouts,
+        }
+
+
+class RequestPath:
+    def __init__(self, metrics: RequestMetrics):
+        self.metrics = metrics
+        self._seen = 0  # private internal state, not exported telemetry
+
+    def handle(self, ok: bool):
+        self._seen += 1
+        self.metrics.handle(ok)
+
+    def lookup(self, found: bool):
+        if not found:
+            # One-off counters go straight to the registry.
+            get_registry().add("fixture_cache_misses")
