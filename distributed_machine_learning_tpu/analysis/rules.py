@@ -1380,6 +1380,179 @@ class BareCounterIncrementRule(Rule):
             )
 
 
+# --------------------------------------------------------------------------
+# DML016 local-global-device-confusion
+# --------------------------------------------------------------------------
+
+# Modules that run (or may run) under a multi-process jax.distributed
+# runtime, where jax.devices() is the GLOBAL view and jax.local_devices()
+# the per-host one — conflating them works on one process and breaks the
+# moment a mesh spans two.
+MULTIHOST_SCOPED_PATTERNS = (
+    "multihost/",
+)
+
+_LOCAL_NAME_RE = re.compile(r"(?:^|_)(?:local|per_host|host)(?:_|$)")
+
+
+class LocalGlobalDeviceConfusionRule(Rule):
+    name = "local-global-device-confusion"
+    rule_id = "DML016"
+    severity = "error"
+    description = (
+        "multihost-scoped code conflating the GLOBAL device/process view "
+        "with the per-host one: len(jax.devices()) bound to a per-host "
+        "name, jax.devices() sliced by jax.local_device_count() (the "
+        "global list is not ordered local-first), or a host-data slice "
+        "sized from jax.process_count() in a function that never consults "
+        "jax.process_index() — every host would load shard 0.  All three "
+        "are single-process-invisible: they pass every test until a mesh "
+        "actually spans two processes (ISSUE 14's failure class)."
+    )
+    _HINT = (
+        "per-host sizing: jax.local_device_count()/jax.local_devices(); "
+        "per-host data slices: offset by jax.process_index() (or derive "
+        "the slice from the sharding — multihost.stage_global does)"
+    )
+
+    def applies(self, ctx) -> bool:
+        if "multihost" in ctx.scopes:
+            return True
+        rel = ctx.display_path.replace("\\", "/")
+        return any(pat in rel for pat in MULTIHOST_SCOPED_PATTERNS)
+
+    @staticmethod
+    def _is_call_to(node: ast.AST, *names: str) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and (_call_name(node) or "").rsplit(".", 1)[-1] in names
+        )
+
+    def _global_count_expr(self, node: ast.AST) -> bool:
+        """len(jax.devices()) or jax.device_count()."""
+        if self._is_call_to(node, "device_count"):
+            return True
+        return (
+            self._is_call_to(node, "len")
+            and node.args
+            and self._is_call_to(node.args[0], "devices")
+        )
+
+    def check(self, ctx) -> Iterator[Finding]:
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Module)):
+                continue
+            body = fn.body if isinstance(fn, ast.Module) else [fn]
+            yield from self._check_scope(ctx, fn, body)
+
+    def _check_scope(self, ctx, fn, body) -> Iterator[Finding]:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Module level: only the assignment checks apply (a module-
+            # level slice has no process_index discipline to inherit).
+            for node in body:
+                if isinstance(node, ast.Assign):
+                    yield from self._check_assign(ctx, node)
+            return
+        local_nodes = list(self._walk_local(fn))
+        calls = {
+            (_call_name(n) or "").rsplit(".", 1)[-1]
+            for n in local_nodes if isinstance(n, ast.Call)
+        }
+        uses_process_count = "process_count" in calls
+        uses_process_index = "process_index" in calls
+        # Names sized from the process count — a slice bounded by one of
+        # these is a per-host data load.
+        per_host_names: Set[str] = set()
+        for node in local_nodes:
+            if isinstance(node, ast.Assign) and any(
+                self._is_call_to(c, "process_count")
+                for c in ast.walk(node.value)
+            ):
+                per_host_names.update(
+                    t.id for t in node.targets if isinstance(t, ast.Name)
+                )
+        for node in local_nodes:
+            if isinstance(node, ast.Assign):
+                yield from self._check_assign(ctx, node)
+            elif isinstance(node, ast.Subscript):
+                yield from self._check_subscript(
+                    ctx, node, per_host_names,
+                    uses_process_count, uses_process_index,
+                )
+
+    @staticmethod
+    def _walk_local(fn):
+        """Walk one function's OWN statements: a nested def is its own
+        scope (it gets its own process_index discipline) and is visited
+        as its own top-level function by check()."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _check_assign(self, ctx, node: ast.Assign) -> Iterator[Finding]:
+        if not self._global_count_expr(node.value):
+            return
+        for t in node.targets:
+            if isinstance(t, ast.Name) and _LOCAL_NAME_RE.search(t.id):
+                yield self.finding(
+                    ctx, node,
+                    f"`{t.id}` is sized from the GLOBAL device count "
+                    f"(len(jax.devices())/jax.device_count()) — on a "
+                    f"multi-process runtime that is every host's devices, "
+                    f"not this host's",
+                    self._HINT,
+                )
+
+    def _check_subscript(self, ctx, node: ast.Subscript, per_host_names,
+                         uses_process_count, uses_process_index
+                         ) -> Iterator[Finding]:
+        if not isinstance(node.slice, ast.Slice):
+            return
+        # B: jax.devices()[...local_device_count()...] — slicing the
+        # global list by the local count assumes local devices come first.
+        if self._is_call_to(node.value, "devices"):
+            bound_calls = [
+                c for b in (node.slice.lower, node.slice.upper) if b
+                for c in ast.walk(b)
+            ]
+            if any(self._is_call_to(c, "local_device_count")
+                   for c in bound_calls):
+                yield self.finding(
+                    ctx, node,
+                    "jax.devices() sliced by jax.local_device_count(): "
+                    "the global device list is ordered by process index, "
+                    "not local-first — this is only this host's devices "
+                    "on process 0",
+                    "use jax.local_devices()",
+                )
+                return
+        # C: a per-host-sized data slice in a function that divides by
+        # process_count but never consults process_index — every host
+        # loads the SAME shard.
+        if not uses_process_count or uses_process_index:
+            return
+        for bound in (node.slice.lower, node.slice.upper):
+            if bound is None:
+                continue
+            if any(
+                isinstance(n, ast.Name) and n.id in per_host_names
+                for n in ast.walk(bound)
+            ):
+                yield self.finding(
+                    ctx, node,
+                    "host-data slice sized from jax.process_count() with "
+                    "no jax.process_index() offset in scope: every "
+                    "process would load the same (first) shard",
+                    self._HINT,
+                )
+                return
+
+
 # ==========================================================================
 # Cross-file rules (dmlint v2): symbol table + call graph + dataflow
 # ==========================================================================
@@ -2091,6 +2264,7 @@ ALL_RULES: List[Rule] = [
     HostSyncInScanRule(),
     BlockingTransferInLoopRule(),
     BareCounterIncrementRule(),
+    LocalGlobalDeviceConfusionRule(),
     UseAfterDonationRule(),
     TransitiveChaosRule(),
     UnguardedSharedStateRule(),

@@ -115,6 +115,16 @@ class FaultPlan:
       kill-between-chunks-and-COMMIT preemption (fires once per entry).
     * ``trial_crashes`` — ``(trial_id, training_iteration)`` pairs; the
       executor raises :class:`InjectedTrialCrash` at that report boundary.
+    * ``kill_process_at`` — ``(trial_id, training_iteration, process_index)``
+      triples; a GANG MEMBER child (``multihost/_gang_child.py``) whose
+      gang process index matches hard-exits (``os._exit``) at that report
+      boundary — the member-dies-mid-collective fault the gang teardown
+      path exists for: its peers are left blocked in their next
+      collective, the head reaps the gang and requeues the trial from its
+      newest valid checkpoint.  Fires on the trial's FIRST incarnation
+      only (gang children are fresh processes, so the requeued gang must
+      pass the same boundary unharmed); the plan reaches the child
+      through ``DML_CHAOS_PLAN`` in its spawn environment.
     * ``replica_kills`` — ``(request_index, replica_idx)`` pairs; the
       ReplicaSet kills that replica when its dispatch counter reaches the
       index (1-based: ``(10, 0)`` kills replica 0 at the 10th request).
@@ -171,6 +181,7 @@ class FaultPlan:
         kill_before_commit: Sequence[str] = (),
         corrupt_path_substrings: Sequence[str] = (),
         trial_crashes: Iterable[Tuple[str, int]] = (),
+        kill_process_at: Iterable[Tuple[str, int, int]] = (),
         replica_kills: Iterable[Tuple[int, int]] = (),
         hot_swaps: Iterable[int] = (),
         hang_dispatch_at: Iterable[Tuple[str, int]] = (),
@@ -192,6 +203,9 @@ class FaultPlan:
         self._commit_kill_pending: List[str] = list(kill_before_commit)
         self._corrupt_pending: List[str] = list(corrupt_path_substrings)
         self._trial_crashes = {(str(t), int(i)) for t, i in trial_crashes}
+        self._process_kills = {
+            (str(t), int(i), int(p)) for t, i, p in kill_process_at
+        }
         self._kills = sorted(
             ((int(n), int(r)) for n, r in replica_kills), reverse=True
         )
@@ -349,6 +363,35 @@ class FaultPlan:
         raise InjectedTrialCrash(
             f"injected crash: {trial_id} at iteration {iteration}"
         )
+
+    def maybe_kill_process(
+        self, trial_id: str, iteration: int, process_index: int,
+        incarnation: int = 1,
+    ) -> None:
+        """Hard-exit THIS process if (trial_id, iteration, process_index)
+        is scheduled — a gang member dying mid-collective.  ``os._exit``
+        (no unwinding, no frames flushed): a preempted host doesn't run
+        finally-blocks either.  Fires only on the trial's FIRST
+        incarnation: gang children are fresh processes re-activating the
+        plan from the spawn env, so the usual in-process fires-once
+        bookkeeping cannot span a retry — the incarnation guard is what
+        lets the requeued gang pass the same boundary and finish.  The
+        counter increment is best-effort forensics for same-process
+        observers; cross-process assertions read the head's
+        gang_teardown/requeue counters instead."""
+        if int(incarnation) > 1:
+            return
+        key = (str(trial_id), int(iteration), int(process_index))
+        with self._lock:
+            if key not in self._process_kills:
+                return
+            self._process_kills.discard(key)
+            self._counters["process_kills"] = (
+                self._counters.get("process_kills", 0) + 1
+            )
+        import os
+
+        os._exit(86)
 
     def maybe_hang_dispatch(self, trial_id: str, iteration: int) -> None:
         """Sleep ``hang_s`` if (trial_id, iteration) is scheduled — a

@@ -271,14 +271,38 @@ def _chunk_file_name(leaf_idx: int, start: Sequence[int]) -> str:
     return f"L{leaf_idx}.{offs}{CHUNK_SUFFIX}"
 
 
+def _mh_barrier(name: str) -> None:
+    """Order a multi-process save's phases (no-op single-process).
+
+    The commit protocol over many WRITERS needs two fences the
+    single-process path gets for free from program order: every process's
+    stale-COMMIT delete must land before ANY chunk is written (a late
+    starter's delete must never remove the marker process 0 just wrote —
+    observed in the 2-process probe), and every process's chunks must land
+    before process 0 writes the index/COMMIT that names them."""
+    import jax
+
+    try:
+        nproc = jax.process_count()
+    except Exception:  # pragma: no cover - pre-init
+        return
+    if nproc <= 1:
+        return
+    from distributed_machine_learning_tpu.multihost.runtime import barrier
+
+    barrier(name)
+
+
 def write_snapshot(path: str, skeleton, leaves: List[Any]) -> Tuple[int, int]:
     """Write a snapshotted tree as one generation under ``path``; returns
     ``(bytes_written, chunks_written)``.  Order is the commit protocol:
-    chunks -> index.json -> COMMIT."""
+    chunks -> index.json -> COMMIT (multi-process: barriers between the
+    phases, see :func:`_mh_barrier`)."""
     backend, p = get_storage(path)
     # Re-saving over a previous attempt at the same step: drop its COMMIT
     # FIRST so no reader ever pairs the old marker with new bytes.
     backend.delete(backend.join(p, COMMIT_NAME))
+    _mh_barrier(f"ckpt_clear:{p}")
     total_bytes = 0
     total_chunks = 0
     index_leaves: List[Dict[str, Any]] = []
@@ -316,6 +340,9 @@ def write_snapshot(path: str, skeleton, leaves: List[Any]) -> Tuple[int, int]:
         if leaf.partition is not None:
             rec["partition"] = leaf.partition
         index_leaves.append(rec)
+    # All processes' chunks must be on storage before the index/COMMIT
+    # that names them (no-op single-process).
+    _mh_barrier(f"ckpt_chunks:{p}")
     try:
         import jax
 

@@ -41,6 +41,7 @@ from distributed_machine_learning_tpu.compilecache.counters import (
 from distributed_machine_learning_tpu.compilecache.keys import (
     NON_STRUCTURAL_KEYS,
     chunked_program_key,
+    gang_program_key,
     pbt_program_key,
     program_key,
     sharded_program_key,
@@ -71,6 +72,7 @@ __all__ = [
     "cache_entry_count",
     "chunked_program_key",
     "enable_persistent_cache",
+    "gang_program_key",
     "get_counters",
     "get_tracker",
     "install_artifacts",

@@ -184,6 +184,46 @@ def chunked_program_key(
     )
 
 
+def gang_program_key(
+    config: Dict[str, Any],
+    *,
+    process_count: int,
+    local_device_counts: Sequence[int],
+    batch_shape: Optional[Sequence[Sequence[int]]] = None,
+    dtype: Optional[str] = None,
+    donation: Sequence[int] = (),
+    extra: Optional[Dict[str, Any]] = None,
+) -> str:
+    """:func:`program_key` for a program lowered over a PROCESS-SPANNING
+    mesh (``multihost/`` gang trials).
+
+    The **process topology** — process count × per-process local device
+    layout — folds into the key because the compiler splits on it: the
+    same mesh shape decomposed differently across processes lowers
+    different cross-process collectives (2 processes × 2 devices and
+    4 × 1 are different programs).  Reshaping the gang therefore splits
+    the key; a SECOND gang of the same topology computes the identical
+    key, which is what lets it fetch the first gang's artifacts from the
+    cluster origin and compile nothing.  Canonical (counts only — no
+    device ids, hostnames, or ports), so the key is stable across hosts.
+    """
+    merged = {
+        "process_topology": {
+            "process_count": int(process_count),
+            "local_device_counts": [int(c) for c in local_device_counts],
+        }
+    }
+    if extra:
+        merged.update(extra)
+    return program_key(
+        config,
+        batch_shape=batch_shape,
+        dtype=dtype,
+        donation=donation,
+        extra=merged,
+    )
+
+
 def sharded_program_key(
     config: Dict[str, Any],
     *,

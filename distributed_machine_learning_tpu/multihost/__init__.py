@@ -1,0 +1,70 @@
+"""multihost/ — multi-controller SPMD: meshes that span processes.
+
+Grown from the seed's thin ``parallel/multihost.py`` (ISSUE 14 tentpole).
+Everything the stack built so far — the sharded flagship, streaming
+prefetch, in-device PBT, the AOT cache — assumed every mesh device lives in
+ONE process; the cluster layer only leased contiguous *local* device
+groups.  This package is the missing multi-controller layer, split the way
+the Podracer/Gemma pod setups split it (PAPERS.md):
+
+* :mod:`runtime` — the process-local SPMD runtime: ``initialize`` (join
+  ``jax.distributed``), deadline-gated :func:`barrier` with
+  absent-process forensics, :func:`multihost_mesh` (DCN/ICI-aware),
+  :func:`global_batch_array` / :func:`stage_global` (per-host shard
+  loading — no host ever materializes a peer's slice),
+  :func:`broadcast_from_coordinator`, :func:`host_snapshot`
+  (checkpoint-safe device→host readback that leaves process-spanning
+  arrays sharded), and :func:`process_topology` (the identity that folds
+  into compile-cache keys).
+* :mod:`bootstrap` — head-brokered gang bootstrap: the cluster head
+  assigns coordinator address + process ids (:class:`GangSpec`, shipped
+  to gang children over the spawn env), and every member gates on an
+  all-processes-joined barrier with a deadline; expiry dumps the flight
+  recorder naming the absent process ids.
+* :mod:`gang` — driver-side gang bookkeeping for ``run_distributed(
+  processes_per_trial=N)``: one trial owns a DP×TP mesh spanning N
+  worker processes; any member death tears the gang down and requeues
+  the trial from its newest valid checkpoint.
+* :mod:`spawn` — worker-supervisor side: run one gang member as a fresh
+  subprocess (``jax.distributed`` must join BEFORE backend init, which a
+  long-lived supervisor already did) and relay its report/decision/
+  heartbeat frames to the cluster control plane.
+
+Single-process, every entry point degrades to a sensible no-op/local
+equivalent — the same training script runs unchanged from a laptop CPU
+mesh to a pod.
+"""
+
+from distributed_machine_learning_tpu.multihost.runtime import (
+    BarrierTimeout,
+    barrier,
+    broadcast_from_coordinator,
+    describe,
+    global_batch_array,
+    host_snapshot,
+    initialize,
+    is_coordinator,
+    multihost_mesh,
+    process_topology,
+    stage_global,
+)
+from distributed_machine_learning_tpu.multihost.bootstrap import (
+    GangSpec,
+    join_gang,
+)
+
+__all__ = [
+    "BarrierTimeout",
+    "GangSpec",
+    "barrier",
+    "broadcast_from_coordinator",
+    "describe",
+    "global_batch_array",
+    "host_snapshot",
+    "initialize",
+    "is_coordinator",
+    "join_gang",
+    "multihost_mesh",
+    "process_topology",
+    "stage_global",
+]

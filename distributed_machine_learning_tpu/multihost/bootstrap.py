@@ -1,0 +1,112 @@
+"""Head-brokered jax.distributed bootstrap for gang trials.
+
+A gang is N fresh worker processes that together run ONE trial over a
+process-spanning mesh.  The cluster head (``tune/cluster.py``) brokers the
+bootstrap — it assigns the coordinator address and dense process ids and
+ships each member a :class:`GangSpec` through the spawn environment — and
+every member gates on an all-processes-joined :func:`join_gang` barrier
+with a deadline, so a member that never comes up turns into a named
+forensic event (flight dump listing the absent process ids) plus a
+:class:`~distributed_machine_learning_tpu.multihost.runtime.BarrierTimeout`
+instead of an indefinite hang in the first collective.
+
+Why fresh processes: ``jax.distributed.initialize`` must run BEFORE the
+backend initializes, and a long-lived worker supervisor enumerated its
+devices long ago — so gang members are spawned per trial
+(``multihost/spawn.py``), exactly like the process-per-trial executor's
+children.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+from dataclasses import asdict, dataclass
+from typing import Dict, Optional
+
+GANG_SPEC_ENV = "DML_GANG_SPEC"
+
+# Default all-members-joined deadline.  Generous: a gang member's cold
+# start is a fresh interpreter + jax import + distributed join, and the
+# whole point of the deadline is naming stragglers, not racing them.
+DEFAULT_JOIN_DEADLINE_S = 120.0
+
+
+@dataclass
+class GangSpec:
+    """Everything one gang member needs to join its runtime.
+
+    Assigned by the HEAD (never self-elected): ``coordinator_address`` is
+    member 0's host plus a port that member 0's supervisor reserved
+    (``gang_prepare`` frame), and ``process_id`` is dense in dispatch
+    order so the dp axis's process decomposition is deterministic.
+    """
+
+    gang_id: str
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+    local_device_count: int
+    join_deadline_s: float = DEFAULT_JOIN_DEADLINE_S
+
+    def to_env(self) -> str:
+        return json.dumps(asdict(self), sort_keys=True)
+
+    @classmethod
+    def from_env(cls, raw: Optional[str] = None) -> Optional["GangSpec"]:
+        raw = raw if raw is not None else os.environ.get(GANG_SPEC_ENV)
+        if not raw:
+            return None
+        try:
+            return cls(**json.loads(raw))
+        except (ValueError, TypeError):
+            return None
+
+
+def allocate_coordinator_port(host: str = "127.0.0.1") -> int:
+    """Reserve a free TCP port on ``host`` for a gang's jax.distributed
+    coordinator (member 0 binds it when it initializes).  Runs on the
+    MEMBER-0 supervisor — only that host knows its own free ports."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def join_gang(spec: GangSpec) -> Dict[str, int]:
+    """Join the gang's distributed runtime and gate on the all-joined
+    barrier.  Returns :func:`runtime.describe` on success; on a barrier
+    deadline expiry the flight recorder has already been dumped naming the
+    absent process ids and ``BarrierTimeout`` propagates (the member exits
+    with an error frame; the head tears the gang down and requeues).
+    """
+    from distributed_machine_learning_tpu import obs
+    from distributed_machine_learning_tpu.multihost import runtime
+
+    with obs.span("multihost.bootstrap", {
+        "gang_id": spec.gang_id,
+        "process_id": spec.process_id,
+        "num_processes": spec.num_processes,
+    }):
+        runtime.initialize(
+            coordinator_address=spec.coordinator_address,
+            num_processes=spec.num_processes,
+            process_id=spec.process_id,
+        )
+        import jax
+
+        if jax.process_count() != spec.num_processes:
+            raise RuntimeError(
+                f"gang {spec.gang_id}: joined a runtime of "
+                f"{jax.process_count()} processes, expected "
+                f"{spec.num_processes}"
+            )
+        # All-members-joined gate: no member proceeds to data loading or
+        # compilation until the whole gang exists — a straggler here is a
+        # named flight-dump + BarrierTimeout, not a hang in collective #1.
+        runtime.barrier(
+            f"gang_join:{spec.gang_id}", deadline_s=spec.join_deadline_s
+        )
+        d = runtime.describe()
+        obs.event("gang_joined", {"gang_id": spec.gang_id, **d})
+        return d

@@ -1,0 +1,118 @@
+"""Driver-side gang bookkeeping for ``run_distributed(processes_per_trial=N)``.
+
+A :class:`Gang` is the head's record of one trial's N-process mesh: which
+worker supervisor hosts each member, which control-plane slot each member
+occupies, how far the bootstrap has progressed, and the join deadline the
+head enforces (ISSUE 14: dispatch is GATED on all-processes-joined with a
+deadline — a member that never comes up becomes a flight dump naming the
+absent process ids plus a teardown/requeue, never a silent hang).
+
+The cluster event loop (``tune/cluster.py``) drives all state transitions;
+this module is deliberately passive data + predicates so the protocol
+stays readable in one place there.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set
+
+
+@dataclass
+class GangMember:
+    worker: Any          # cluster.RemoteWorker
+    slot: int
+    process_id: int
+    done: bool = False   # terminal frame (or slot release) seen
+
+
+@dataclass
+class Gang:
+    """One trial's process-spanning execution record on the head."""
+
+    gang_id: str
+    trial_id: str
+    incarnation: int
+    members: List[GangMember]
+    # Lifecycle: "preparing" (waiting for member 0's supervisor to reserve
+    # a coordinator port) -> "bootstrapping" (members spawned, waiting for
+    # all gang_joined frames) -> "running".
+    state: str = "preparing"
+    coordinator_address: Optional[str] = None
+    joined: Set[int] = field(default_factory=set)
+    join_deadline: float = 0.0     # monotonic; 0 = not yet armed
+    prepare_deadline: float = 0.0  # monotonic; bounds the port reservation
+
+    @property
+    def num_processes(self) -> int:
+        return len(self.members)
+
+    @property
+    def coordinator(self) -> GangMember:
+        return self.members[0]
+
+    def member(self, process_id: int) -> Optional[GangMember]:
+        for m in self.members:
+            if m.process_id == int(process_id):
+                return m
+        return None
+
+    def arm_join_deadline(self, deadline_s: float) -> None:
+        self.state = "bootstrapping"
+        self.join_deadline = time.monotonic() + float(deadline_s)
+
+    def mark_joined(self, process_id: int) -> bool:
+        """Record one member's bootstrap completion; True when the gang
+        just became fully joined."""
+        self.joined.add(int(process_id))
+        if self.state == "bootstrapping" and self.all_joined():
+            self.state = "running"
+            return True
+        return False
+
+    def all_joined(self) -> bool:
+        return len(self.joined) >= self.num_processes
+
+    def absent_ids(self) -> List[int]:
+        """Process ids that have not joined — the bootstrap-timeout dump's
+        payload."""
+        return [
+            m.process_id for m in self.members
+            if m.process_id not in self.joined
+        ]
+
+    def join_expired(self, now: Optional[float] = None) -> bool:
+        now = time.monotonic() if now is None else now
+        return (
+            self.state == "bootstrapping"
+            and self.join_deadline > 0.0
+            and now > self.join_deadline
+            and not self.all_joined()
+        )
+
+    def prepare_expired(self, now: Optional[float] = None) -> bool:
+        now = time.monotonic() if now is None else now
+        return (
+            self.state == "preparing"
+            and self.prepare_deadline > 0.0
+            and now > self.prepare_deadline
+        )
+
+    def workers(self) -> List[Any]:
+        return [m.worker for m in self.members]
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "gang_id": self.gang_id,
+            "trial_id": self.trial_id,
+            "incarnation": self.incarnation,
+            "state": self.state,
+            "coordinator_address": self.coordinator_address,
+            "members": [
+                {"worker": m.worker.address, "slot": m.slot,
+                 "process_id": m.process_id, "joined":
+                     m.process_id in self.joined}
+                for m in self.members
+            ],
+        }

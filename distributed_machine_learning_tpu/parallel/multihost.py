@@ -1,197 +1,26 @@
-"""Multi-host SPMD: jax.distributed runtime + DCN/ICI-aware meshes.
+"""Compatibility shim: the multi-host SPMD runtime grew into its own
+subsystem (``distributed_machine_learning_tpu/multihost/`` — ISSUE 14).
 
-The reference's multi-node story is Ray's gRPC control plane with zero
-collectives (SURVEY.md §1 L3, §5 "distributed communication backend" — no
-NCCL/MPI anywhere). The TPU-native framework splits that capability in two:
-
-* **HPO control plane** — driver↔worker TCP supervisors
-  (`tune/cluster.py`): many independent trials, metrics/decisions over DCN.
-* **One model over many hosts** — THIS module: every host runs the same
-  jitted program, `jax.distributed` wires the XLA runtime together, and
-  collectives ride ICI inside a slice / DCN across slices. This is the
-  NCCL/MPI-equivalent layer, done the XLA way: you never call a collective
-  yourself — you annotate shardings on a mesh from `multihost_mesh()` and
-  XLA inserts/schedules them.
-
-Mesh layout rule (the "How to Scale Your Model" recipe): put ``dp``
-(gradient all-reduce once per step — latency-tolerant) across hosts on DCN,
-and the chatty axes (``tp``/``sp``/``ep`` — per-layer collectives) inside a
-host/slice on ICI. ``multihost_mesh`` encodes exactly that via
-``mesh_utils.create_hybrid_device_mesh``.
-
-Single-process (tests, one chip, CPU meshes) every function degrades to a
-sensible no-op/local equivalent, so the same training script runs unchanged
-from a laptop CPU mesh to a multi-host pod — launch it once per host with
-the coordinator env set (or under a cluster manager jax auto-detects).
+Every helper that lived here (``initialize``, ``multihost_mesh``,
+``global_batch_array``, ``barrier``, ``broadcast_from_coordinator``,
+``is_coordinator``, ``describe``) now lives in
+:mod:`distributed_machine_learning_tpu.multihost.runtime`, alongside the
+new deadline-gated barrier, per-host staging, checkpoint-safe snapshots,
+and process-topology identity.  Import from
+``distributed_machine_learning_tpu.multihost`` in new code.
 """
 
-from __future__ import annotations
-
-import os
-from typing import Dict, Optional, Sequence
-
-import jax
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-_initialized = False
-
-
-def initialize(
-    coordinator_address: Optional[str] = None,
-    num_processes: Optional[int] = None,
-    process_id: Optional[int] = None,
-    local_device_ids: Optional[Sequence[int]] = None,
-) -> bool:
-    """Join (or skip joining) the jax.distributed runtime. Idempotent.
-
-    Args default from the standard env (``JAX_COORDINATOR_ADDRESS``,
-    ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID`` — also set by TPU pod
-    metadata, which ``jax.distributed.initialize()`` auto-detects with no
-    args). Returns True when a multi-process runtime is active after the
-    call, False for the single-process fallback (no coordinator configured
-    and none auto-detectable). Call BEFORE any other jax API touches the
-    backend — device enumeration pins the runtime.
-    """
-    global _initialized
-    if _initialized:
-        return jax.process_count() > 1
-    coordinator_address = coordinator_address or os.environ.get(
-        "JAX_COORDINATOR_ADDRESS"
-    )
-    env_np = os.environ.get("JAX_NUM_PROCESSES")
-    env_pid = os.environ.get("JAX_PROCESS_ID")
-    num_processes = (
-        num_processes if num_processes is not None
-        else int(env_np) if env_np else None
-    )
-    process_id = (
-        process_id if process_id is not None
-        else int(env_pid) if env_pid else None
-    )
-    in_managed_cluster = any(
-        os.environ.get(k)
-        for k in ("TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS",
-                  "CLOUD_TPU_TASK_ID")
-    )
-    if coordinator_address is None and not in_managed_cluster:
-        return False  # single-process: nothing to join
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        local_device_ids=local_device_ids,
-    )
-    _initialized = True
-    return jax.process_count() > 1
-
-
-def is_coordinator() -> bool:
-    """Process 0 — the one that should write checkpoints/logs/results."""
-    return jax.process_index() == 0
-
-
-def multihost_mesh(
-    *, tp: int = 1, sp: int = 1, ep: int = 1,
-    devices: Optional[Sequence] = None,
-) -> Mesh:
-    """Global mesh over every process's devices, DCN/ICI-aware.
-
-    ``dp`` fills whatever tp/sp/ep leave over. Multi-process: ``dp`` spans
-    hosts (its once-per-step gradient reduction tolerates DCN latency) and
-    tp/sp/ep must fit INSIDE one process's devices so their per-layer
-    collectives stay on ICI — sizes that straddle hosts raise.
-    Single-process: plain mesh over the local devices (axis order dp, sp,
-    ep, tp — tp last = ICI-adjacent, same convention as mesh.auto_mesh).
-    """
-    devices = list(devices) if devices is not None else list(jax.devices())
-    n_procs = jax.process_count()
-    used = tp * sp * ep
-    if len(devices) % used != 0:
-        raise ValueError(
-            f"{len(devices)} devices not divisible by tp*sp*ep={used}"
-        )
-    dp = len(devices) // used
-    axis_names = ("dp", "sp", "ep", "tp")
-    if n_procs == 1:
-        arr = np.array(devices).reshape(dp, sp, ep, tp)
-        return Mesh(arr, axis_names)
-
-    per_host = len(devices) // n_procs
-    if used > per_host or per_host % used != 0:
-        raise ValueError(
-            f"tp*sp*ep={used} must divide one host's {per_host} devices: "
-            f"tensor/sequence/expert collectives are per-layer traffic and "
-            f"must stay on ICI, not DCN (put dp across hosts instead)"
-        )
-    from jax.experimental import mesh_utils
-
-    ici_dp = per_host // used
-    n_slices = len({getattr(d, "slice_index", None) for d in devices})
-    # Granule choice: by default create_hybrid_device_mesh groups devices
-    # by slice_index; when slices don't map 1:1 to processes (single-slice
-    # multi-host pods, and multi-process CPU test clusters where every
-    # device reports slice 0 — caught by the 2-process CPU test), group by
-    # process instead. Either way the helper keeps the ICI-topology-aware
-    # device ordering within each granule.
-    arr = mesh_utils.create_hybrid_device_mesh(
-        mesh_shape=(ici_dp, sp, ep, tp),          # within a granule (ICI)
-        dcn_mesh_shape=(n_procs, 1, 1, 1),        # across granules (DCN)
-        devices=devices,
-        process_is_granule=(n_slices != n_procs),
-    )
-    return Mesh(arr.reshape(dp, sp, ep, tp), axis_names)
-
-
-def global_batch_array(
-    host_local: np.ndarray, mesh: Mesh, spec: P = P("dp")
-) -> jax.Array:
-    """Assemble a global sharded array from each host's LOCAL shard.
-
-    The multi-host data-loading contract: every host loads only its slice
-    of the batch (no host ever materializes the global array — the analogue
-    of the reference's Ray object-store broadcast, without the broadcast),
-    and this stitches the shards into one global ``jax.Array`` addressable
-    under jit. Single-process it is just ``device_put`` with the sharding.
-    """
-    sharding = NamedSharding(mesh, spec)
-    if jax.process_count() == 1:
-        return jax.device_put(host_local, sharding)
-    from jax.experimental import multihost_utils
-
-    return multihost_utils.host_local_array_to_global_array(
-        host_local, mesh, spec
-    )
-
-
-def barrier(name: str = "barrier") -> None:
-    """Block until every process reaches this point (no-op single-process).
-
-    Use at phase boundaries (before reading a peer's checkpoint, after
-    coordinator-only writes) — NOT inside the step loop, where jit+XLA
-    already orders collectives.
-    """
-    if jax.process_count() == 1:
-        return
-    from jax.experimental import multihost_utils
-
-    multihost_utils.sync_global_devices(name)
-
-
-def broadcast_from_coordinator(pytree):
-    """Every process returns the coordinator's value (process-consistent
-    config/HPO decisions without a side channel). Identity single-process."""
-    if jax.process_count() == 1:
-        return pytree
-    from jax.experimental import multihost_utils
-
-    return multihost_utils.broadcast_one_to_all(pytree)
-
-
-def describe() -> Dict[str, int]:
-    return {
-        "process_index": jax.process_index(),
-        "process_count": jax.process_count(),
-        "local_device_count": jax.local_device_count(),
-        "global_device_count": jax.device_count(),
-    }
+from distributed_machine_learning_tpu.multihost.runtime import (  # noqa: F401
+    BarrierTimeout,
+    barrier,
+    broadcast_from_coordinator,
+    describe,
+    global_batch_array,
+    host_snapshot,
+    initialize,
+    is_coordinator,
+    multihost_mesh,
+    process_topology,
+    spanning_mesh,
+    stage_global,
+)

@@ -196,6 +196,10 @@ class _WorkerState:
         # (the trial thread blocks on it; the recv loop answers).
         self.artifact_replies: Dict[str, "queue.Queue"] = {}
         self.art_lock = named_lock("cluster.worker.artifacts")
+        # (trial_id, incarnation) -> live gang-member child handle
+        # (multihost/spawn.py) — the gang_abort/teardown kill target.
+        self.gang_children: Dict[Tuple[str, int], Any] = {}
+        self.gang_lock = named_lock("cluster.worker.gangs")
 
 
 # Program keys this worker PROCESS has already fetched-or-compiled: the
@@ -432,6 +436,202 @@ def _worker_run_trial(state: _WorkerState, msg: Dict[str, Any], devices: List):
             pass  # driver went away; its reader already flagged the death
 
 
+def _worker_run_gang_member(state: _WorkerState, msg: Dict[str, Any],
+                            devices: List):
+    """Run ONE member of a process-spanning gang trial (multihost/):
+    spawn a fresh gang-child subprocess (jax.distributed must initialize
+    before the backend — this supervisor's is long gone) and relay its
+    frames up the control plane.  Only the coordinator member (gang
+    process 0) produces result/beat/complete frames; every other member
+    reports only its bootstrap join and its terminal state."""
+    import cloudpickle
+
+    from distributed_machine_learning_tpu import obs
+    from distributed_machine_learning_tpu.multihost.bootstrap import GangSpec
+    from distributed_machine_learning_tpu.multihost.spawn import (
+        GangChildHandle,
+        member_child_env,
+    )
+
+    trial_id = msg["trial_id"]
+    incarnation = int(msg.get("incarnation", 0))
+    process_id = int(msg["process_id"])
+    gang_id = msg["gang_id"]
+    obs.configure_from_frame(msg.get("obs"), label=f"worker{os.getpid()}")
+    dec_key = (trial_id, incarnation)
+    dq: Optional["queue.Queue[str]"] = None
+    if process_id == 0:
+        dq = queue.Queue()
+        with state.dec_lock:
+            state.decisions[dec_key] = dq
+
+    # Compile-artifact origin, gang edition: the key folds the PROCESS
+    # TOPOLOGY (compilecache.gang_program_key) — reshaping the gang splits
+    # it; the second same-topology gang fetches instead of compiling.
+    # Fetch installs into this host's persistent cache dir, which the
+    # child inherits below; publish happens at the first result boundary.
+    publish_key = [None]
+    pre_files: set = set()
+    gang_key = None
+    if msg.get("artifact_origin"):
+        from distributed_machine_learning_tpu import compilecache as cc
+
+        n = int(msg["num_processes"])
+        gang_key = cc.gang_program_key(
+            dict(msg["config"]),
+            process_count=n,
+            local_device_counts=[int(msg["local_device_count"])] * n,
+        )
+        with _SEEN_KEYS_LOCK:
+            first_here = gang_key not in _SEEN_PROGRAM_KEYS
+            _SEEN_PROGRAM_KEYS.add(gang_key)
+        if first_here:
+            pre_files = cc.snapshot_cache_dir(cc.cache_dir())
+            if not _fetch_artifacts(state, gang_key):
+                publish_key[0] = gang_key
+
+    # Test/chaos knob: stretch THIS member's spawn the way a straggler
+    # host does (same pattern as DML_CLUSTER_STARTUP_SLEEP_S) — how the
+    # head's gang-bootstrap deadline + absent-process flight dump are
+    # exercised deterministically.
+    _spawn_hold = float(os.environ.get("DML_GANG_SPAWN_HOLD_S", "0") or 0.0)
+    if _spawn_hold > 0:
+        time.sleep(_spawn_hold)
+
+    spec = GangSpec(
+        gang_id=gang_id,
+        coordinator_address=msg["coordinator_address"],
+        num_processes=int(msg["num_processes"]),
+        process_id=process_id,
+        local_device_count=int(msg["local_device_count"]),
+        join_deadline_s=float(msg.get("join_deadline_s", 120.0)),
+    )
+    child_env = member_child_env(
+        spec, devices=devices,
+        platform=getattr(devices[0], "platform", None) if devices else None,
+    )
+    from distributed_machine_learning_tpu import compilecache as _cc
+
+    if _cc.cache_dir():
+        # The child's compiles must land in THIS host's persistent cache
+        # so the origin fetch/publish diff sees them.
+        child_env["DML_TPU_COMPILE_CACHE"] = _cc.cache_dir()
+
+    terminal: Dict[str, Any]
+    handle = None
+    try:
+        trainable = resolve_trainable(msg["trainable"])
+        init_msg = {
+            "trial_id": trial_id,
+            "incarnation": incarnation,
+            "config": dict(msg["config"]),
+            "trainable": cloudpickle.dumps(trainable),
+            "restore_path": msg.get("restore_path"),
+            "checkpoint_dir": msg.get("checkpoint_dir"),
+            "checkpoint_format": msg.get("checkpoint_format", "sharded"),
+            "start_iteration": int(msg.get("start_iteration", 0)),
+            "obs": msg.get("obs"),
+        }
+        handle = GangChildHandle(spec, init_msg, devices=devices,
+                                 env=child_env)
+        with state.gang_lock:
+            state.gang_children[dec_key] = handle
+        saw_terminal = None
+        while True:
+            try:
+                frame = handle.read()
+            except EOFError:
+                break
+            kind = frame[0]
+            if kind == "joined":
+                _send(state.sock, state.send_lock, {
+                    "type": "gang_joined",
+                    "trial_id": trial_id,
+                    "incarnation": incarnation,
+                    "gang_id": gang_id,
+                    "process_id": process_id,
+                }, state.secret)
+            elif kind == "result":
+                if publish_key[0] is not None:
+                    # First report boundary: the child's compiles are in
+                    # the shared cache dir; ship the fresh entries.
+                    _publish_artifacts(state, publish_key[0], pre_files)
+                    publish_key[0] = None
+                _send(state.sock, state.send_lock, {
+                    "type": "result",
+                    "trial_id": trial_id,
+                    "incarnation": incarnation,
+                    "metrics": frame[1],
+                    "checkpoint_path": frame[2],
+                }, state.secret)
+                handle.send_decision(dq.get())
+            elif kind == "beat":
+                _send(state.sock, state.send_lock, {
+                    "type": "trial_beat", "trial_id": trial_id,
+                    "incarnation": incarnation,
+                }, state.secret)
+            elif kind in ("complete", "error"):
+                saw_terminal = frame
+                break
+        if saw_terminal is None:
+            # Child died without a terminal frame: SIGKILL from a gang
+            # abort, a chaos kill_process_at, or a real preemption.
+            rc = handle.wait(timeout=5.0)
+            saw_terminal = (
+                "error",
+                f"gang member {process_id} of {gang_id} died without a "
+                f"terminal frame (rc={rc})",
+            )
+        if process_id == 0:
+            if saw_terminal[0] == "complete":
+                terminal = {"type": "complete", "trial_id": trial_id,
+                            "incarnation": incarnation}
+            else:
+                terminal = {
+                    "type": "error",
+                    "trial_id": trial_id,
+                    "incarnation": incarnation,
+                    "traceback": saw_terminal[1],
+                }
+        else:
+            terminal = {
+                "type": "gang_member_done",
+                "trial_id": trial_id,
+                "incarnation": incarnation,
+                "gang_id": gang_id,
+                "process_id": process_id,
+                "ok": saw_terminal[0] == "complete",
+            }
+            if saw_terminal[0] != "complete":
+                terminal["traceback"] = saw_terminal[1]
+    except BaseException:  # noqa: BLE001 - ship the traceback to the driver
+        tb = traceback.format_exc()
+        if process_id == 0:
+            terminal = {"type": "error", "trial_id": trial_id,
+                        "incarnation": incarnation, "traceback": tb}
+        else:
+            terminal = {
+                "type": "gang_member_done", "trial_id": trial_id,
+                "incarnation": incarnation, "gang_id": gang_id,
+                "process_id": process_id, "ok": False, "traceback": tb,
+            }
+    finally:
+        if handle is not None and handle.wait(timeout=2.0) is None:
+            handle.kill()  # wedged child (abort path): reap hard
+        obs.flush()
+        terminal["obs_counters"] = obs.get_registry().scalar_snapshot()
+        with state.gang_lock:
+            if state.gang_children.get(dec_key) is not None:
+                del state.gang_children[dec_key]
+        with state.dec_lock:
+            if dq is not None and state.decisions.get(dec_key) is dq:
+                del state.decisions[dec_key]
+        try:
+            _send(state.sock, state.send_lock, terminal, state.secret)
+        except OSError:
+            pass  # driver went away; its reader already flagged the death
+
+
 def serve_worker(
     host: str = "127.0.0.1",
     port: int = 0,
@@ -601,6 +801,52 @@ def _serve_driver_connection(
                 name=f"trial-{msg['trial_id']}",
                 daemon=True,
             ).start()
+        elif mtype == "gang_prepare":
+            # Reserve a coordinator port for a gang this host will anchor
+            # (member 0 binds it inside jax.distributed.initialize).
+            from distributed_machine_learning_tpu.multihost.bootstrap import (
+                allocate_coordinator_port,
+            )
+
+            try:
+                port = allocate_coordinator_port()
+            except OSError as exc:  # pragma: no cover - no free ports
+                dbg(f"gang_prepare failed: {exc!r}")
+                continue
+            _send(sock, state.send_lock, {
+                "type": "gang_port",
+                "gang_id": msg.get("gang_id", ""),
+                "port": port,
+            }, secret)
+        elif mtype == "run_gang_member":
+            # A gang member leases a contiguous local device group by slot,
+            # exactly like a local mesh trial.
+            slot = int(msg.get("slot", 0))
+            n = max(int(msg.get("local_device_count", 1)), 1)
+            if n <= 1:
+                dev = [devices[slot % len(devices)]]
+            else:
+                groups = max(len(devices) // n, 1)
+                g = slot % groups
+                dev = devices[g * n:(g + 1) * n] or devices[:n]
+            threading.Thread(
+                target=_worker_run_gang_member,
+                args=(state, msg, dev),
+                name=f"gang-{msg['gang_id']}-p{msg['process_id']}",
+                daemon=True,
+            ).start()
+        elif mtype == "gang_abort":
+            # Head-side gang teardown: SIGKILL the member child (it may be
+            # wedged in a collective against a dead peer — no report
+            # boundary will ever come).  The relay thread sees EOF and
+            # ships the terminal frame.
+            with state.gang_lock:
+                handle = state.gang_children.get(
+                    (msg["trial_id"], int(msg.get("incarnation", 0)))
+                )
+            if handle is not None:
+                dbg(f"gang_abort {msg['trial_id']}")
+                handle.kill()
         elif mtype == "decision":
             with state.dec_lock:
                 dq = state.decisions.get(
@@ -639,6 +885,13 @@ def _serve_driver_connection(
     with state.dec_lock:
         for dq in state.decisions.values():
             dq.put("stop")
+    # Gang children must never outlive the driver connection that spawned
+    # them (a stop decision only reaches a child sitting at a report
+    # boundary; one wedged in a collective needs the kill).
+    with state.gang_lock:
+        handles = list(state.gang_children.values())
+    for handle in handles:
+        handle.kill()
     sock.close()
     return shutdown
 
@@ -887,6 +1140,8 @@ def run_distributed(
     checkpoint_storage: Optional[str] = None,
     checkpoint_format: str = "msgpack",
     mesh_shape: Optional[Dict[str, int]] = None,
+    processes_per_trial: int = 1,
+    gang_join_deadline_s: float = 120.0,
     input_mode: Optional[str] = None,
     elastic_listen: Union[str, socket.socket, None] = None,
     artifact_origin: Union[bool, "ArtifactRegistry"] = True,
@@ -947,6 +1202,32 @@ def run_distributed(
     ``slots = len(devices) // prod(mesh_shape)`` so slot groups never
     overlap).  The sharded trainable then builds the named mesh from the
     model family's partition rules (``models/partition_rules.py``).
+    ``processes_per_trial``: >1 makes every trial a **gang** — one trial
+    owning a DP×TP mesh that SPANS that many worker processes
+    (``multihost/``).  The head brokers the ``jax.distributed`` bootstrap:
+    it picks N workers, asks member 0's supervisor to reserve a
+    coordinator port (``gang_prepare``/``gang_port``), assigns dense
+    process ids, and ships each member a GangSpec; each supervisor spawns
+    a FRESH gang-member subprocess (``jax.distributed`` must initialize
+    before the backend, which a long-lived supervisor already did).
+    Dispatch gates on an all-members-joined barrier with
+    ``gang_join_deadline_s`` — expiry dumps the flight recorder naming
+    the absent process ids and requeues the trial.  Only the gang
+    coordinator (process 0) reports/saves; decisions broadcast in-band to
+    the other members.  Any member death (preemption, chaos
+    ``kill_process_at``) tears the whole gang down — surviving members
+    are killed mid-collective — and the trial requeues from its newest
+    valid checkpoint within ``max_failures`` (counters
+    ``gang_teardowns`` / ``gang_requeues`` / ``gang_bootstrap_timeouts``
+    in the liveness block).  Requires ``checkpoint_format="sharded"``
+    (a process-spanning pytree saves per-process chunks; the resharding
+    restore reads them back on ANY topology).  ``mesh_shape``'s total
+    device count must divide evenly across the gang; without
+    ``mesh_shape`` each member contributes one device (pure dp).
+    Compile-cache keys fold the gang's process topology
+    (``compilecache.gang_program_key``): reshaping the gang splits the
+    key; a second same-topology gang fetches the first gang's artifacts
+    from the head's origin and compiles nothing.
     ``input_mode``: sweep-wide data staging mode (same knob as
     ``tune.run``), stamped into every sampled config: ``"resident"``,
     ``"streaming"`` (the out-of-core prefetch ring, ``data/pipeline.py``),
@@ -989,6 +1270,33 @@ def run_distributed(
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+    processes_per_trial = int(processes_per_trial)
+    if processes_per_trial < 1:
+        raise ValueError(
+            f"processes_per_trial must be >= 1, got {processes_per_trial}"
+        )
+    gang_devices_per_member = 1
+    if processes_per_trial > 1:
+        if checkpoint_format != "sharded":
+            raise ValueError(
+                "processes_per_trial > 1 checkpoints from a process-"
+                "spanning mesh, which only the sharded format can write "
+                "(per-process chunks + COMMIT): pass "
+                "checkpoint_format='sharded'"
+            )
+        if mesh_shape:
+            total_mesh_devices = 1
+            for v in mesh_shape.values():
+                total_mesh_devices *= max(int(v), 1)
+            if total_mesh_devices % processes_per_trial != 0:
+                raise ValueError(
+                    f"mesh_shape {dict(mesh_shape)} has "
+                    f"{total_mesh_devices} devices, not divisible across "
+                    f"{processes_per_trial} gang members"
+                )
+            gang_devices_per_member = (
+                total_mesh_devices // processes_per_trial
+            )
     if input_mode is not None and input_mode not in (
         "auto", "resident", "streaming"
     ):
@@ -1016,6 +1324,17 @@ def run_distributed(
         raise ValueError(
             "run_distributed needs at least one worker address "
             "(or elastic_listen for join-based capacity)"
+        )
+    if (
+        processes_per_trial > 1
+        and elastic_listen is None
+        and len(workers) < processes_per_trial
+    ):
+        raise ValueError(
+            f"processes_per_trial={processes_per_trial} needs at least "
+            f"that many worker supervisors (got {len(workers)}; gang "
+            f"members must live in distinct processes), or elastic_listen "
+            f"for join-based capacity"
         )
     if checkpoint_storage and checkpoint_storage.startswith("mem://"):
         raise ValueError(
@@ -1179,6 +1498,15 @@ def run_distributed(
 
     trainable_spec: Any = trainable
     assignment: Dict[str, RemoteWorker] = {}
+    # Gang trials (processes_per_trial > 1): head-side records of each
+    # trial's process-spanning mesh (multihost/gang.py).
+    from distributed_machine_learning_tpu.multihost.gang import (
+        Gang,
+        GangMember,
+    )
+
+    gangs: Dict[str, Gang] = {}
+    gang_by_trial: Dict[str, Gang] = {}
 
     from distributed_machine_learning_tpu import chaos as chaos_lib
 
@@ -1198,6 +1526,9 @@ def run_distributed(
         "fenced_frames": 0,
         "worker_reconnects": 0,
         "quarantined_checkpoints": 0,
+        "gang_teardowns": 0,
+        "gang_requeues": 0,
+        "gang_bootstrap_timeouts": 0,
     }
     # Live view of the head's liveness counters in the unified registry
     # (the published experiment_state.json block keeps its shape below).
@@ -1303,14 +1634,95 @@ def run_distributed(
             release(trial)
             lifecycle.requeue(trial)
 
+    def dispatch_gang(trial: Trial) -> bool:
+        """Reserve one slot on ``processes_per_trial`` DISTINCT workers and
+        start the gang bootstrap (coordinator-port reservation on member
+        0's supervisor).  False — with no side effects — when too few
+        workers currently have capacity; the trial stays pending."""
+        avail = [w for w in pool if w.free_slots > 0]
+        if len(avail) < processes_per_trial:
+            return False
+        members = []
+        for i, worker in enumerate(avail[:processes_per_trial]):
+            slot = next(
+                s for s in range(worker.slots)
+                if s not in worker.running.values()
+            )
+            worker.running[trial.trial_id] = slot
+            members.append(GangMember(worker=worker, slot=slot,
+                                      process_id=i))
+        # mark_running bumps the incarnation; the gang id carries the
+        # bumped value so member frames and the stale-frame guard agree.
+        lifecycle.mark_running(trial)
+        gang = Gang(
+            gang_id=f"{trial.trial_id}.i{trial.incarnation}",
+            trial_id=trial.trial_id,
+            incarnation=trial.incarnation,
+            members=members,
+        )
+        gang.prepare_deadline = time.monotonic() + float(
+            gang_join_deadline_s
+        )
+        gangs[gang.gang_id] = gang
+        gang_by_trial[trial.trial_id] = gang
+        # Result/decision traffic flows through the COORDINATOR member's
+        # supervisor: that worker is the trial's assignment.
+        assignment[trial.trial_id] = members[0].worker
+        if watchdog is not None:
+            # First-beat grace must additionally cover the gang bootstrap
+            # (fresh interpreter + jax import + distributed join per
+            # member) — floor it at the join deadline.
+            watchdog.track(
+                trial.trial_id,
+                first_beat_grace_s=max(
+                    startup_scaled_grace(
+                        progress_deadline_s, progress_grace_s,
+                        max(m.worker.startup_s for m in members),
+                    ),
+                    float(gang_join_deadline_s),
+                ),
+            )
+        span = obs_lib.detached_span(
+            "trial.dispatch",
+            {"trial_id": trial.trial_id, "incarnation": trial.incarnation,
+             "gang_id": gang.gang_id,
+             "workers": [m.worker.address for m in members]},
+            parent=obs_lib.current_context(),
+        )
+        trial_spans[trial.trial_id] = span
+        obs_lib.event("gang_dispatch", {
+            "gang_id": gang.gang_id,
+            "trial_id": trial.trial_id,
+            "workers": [m.worker.address for m in members],
+        })
+        safe_cb("on_trial_start", trial)
+        try:
+            members[0].worker.send(
+                {"type": "gang_prepare", "gang_id": gang.gang_id}
+            )
+        except OSError:
+            members[0].worker.alive = False
+            teardown_gang(gang, "coordinator worker died at gang prepare")
+        return True
+
     def launch_ready():
         while pending:
+            if processes_per_trial > 1:
+                if not dispatch_gang(pending[0]):
+                    return
+                pending.pop(0)
+                continue
             worker = max(pool, key=lambda w: w.free_slots, default=None)
             if worker is None or worker.free_slots <= 0:
                 return
             dispatch(pending.pop(0), worker)
 
     def release(trial: Trial):
+        gang = gang_by_trial.pop(trial.trial_id, None)
+        if gang is not None:
+            gangs.pop(gang.gang_id, None)
+            for m in gang.members:
+                m.worker.running.pop(trial.trial_id, None)
         worker = assignment.pop(trial.trial_id, None)
         if worker is not None:
             worker.running.pop(trial.trial_id, None)
@@ -1319,6 +1731,38 @@ def run_distributed(
         span = trial_spans.pop(trial.trial_id, None)
         if span is not None:
             span.end()
+
+    def teardown_gang(gang: Gang, why: str, requeue: bool = True):
+        """Abort every member (supervisors SIGKILL their gang children —
+        peers of a dead member sit wedged in a collective), release all
+        reserved slots, and requeue the trial from its newest valid
+        checkpoint through the ordinary retry budget."""
+        if gang_by_trial.get(gang.trial_id) is not gang:
+            return  # stale: the trial already moved on
+        liveness["gang_teardowns"] += 1
+        log(f"gang {gang.gang_id} teardown: {why.splitlines()[-1]}")
+        obs_lib.event("gang_teardown", {
+            "gang_id": gang.gang_id, "why": why.splitlines()[-1],
+        })
+        for m in gang.members:
+            try:
+                m.worker.send({
+                    "type": "gang_abort",
+                    "trial_id": gang.trial_id,
+                    "incarnation": gang.incarnation,
+                })
+            except OSError:
+                m.worker.alive = False
+        trial = by_id.get(gang.trial_id)
+        if trial is None:
+            gang_by_trial.pop(gang.trial_id, None)
+            gangs.pop(gang.gang_id, None)
+            return
+        if requeue:
+            requeue_lost(trial, why, counter="gang_requeues")
+            launch_ready()
+        else:
+            release(trial)
 
     def requeue_lost(trial: Trial, why: str,
                      counter: str = "silent_worker_requeues"):
@@ -1420,11 +1864,15 @@ def run_distributed(
                         f"expired, requeueing {len(lost)} in-flight trials"
                     )
                     for trial in lost:
-                        requeue_lost(
-                            trial,
+                        why = (
                             f"worker {worker.address} lease expired "
-                            f"(silent {silent:.1f}s — hung or partitioned)",
+                            f"(silent {silent:.1f}s — hung or partitioned)"
                         )
+                        gang = gang_by_trial.get(trial.trial_id)
+                        if gang is not None:
+                            teardown_gang(gang, why)
+                        else:
+                            requeue_lost(trial, why)
                     launch_ready()
                 elif worker.suspect and (
                     now - worker.expired_at > worker_reconnect_grace_s
@@ -1455,6 +1903,13 @@ def run_distributed(
                     f"{event.deadline_s:.1f}s)"
                 )
                 log(f"{trial.trial_id} {why}; fencing and requeueing")
+                gang = gang_by_trial.get(trial.trial_id)
+                if gang is not None:
+                    # A stalled gang cannot self-fence at a report
+                    # boundary — members may be wedged in a collective;
+                    # the abort path SIGKILLs them.
+                    teardown_gang(gang, why)
+                    continue
                 try:
                     # Pre-load the stop decision so the wedged incarnation
                     # self-fences at its next report boundary.
@@ -1466,6 +1921,27 @@ def run_distributed(
                     worker.alive = False
                 requeue_lost(trial, why, counter="stall_requeues")
                 launch_ready()
+        # Gang bootstrap deadlines: a gang stuck preparing (coordinator
+        # port never reserved) or bootstrapping (members never all joined)
+        # past its deadline becomes a flight dump NAMING the absent
+        # process ids, then a teardown + requeue.
+        for gang in list(gangs.values()):
+            if gang.prepare_expired() or gang.join_expired():
+                absent = gang.absent_ids()
+                liveness["gang_bootstrap_timeouts"] += 1
+                obs_lib.dump_flight_recorder(
+                    f"gang_bootstrap_timeout_{gang.trial_id}",
+                    extra={
+                        "gang": gang.describe(),
+                        "absent_process_ids": absent,
+                        "state": gang.state,
+                    },
+                )
+                teardown_gang(
+                    gang,
+                    f"gang bootstrap deadline expired in state "
+                    f"{gang.state!r}; absent process ids {absent}",
+                )
 
     # ---- main loop ----
     exp_span = obs_lib.span("experiment", {"name": name})
@@ -1496,13 +1972,24 @@ def run_distributed(
                 if not any(w.alive for w in pool) and elastic_server is None:
                     break
                 continue
-            if pending and not any(w.alive for w in pool) and (
-                elastic_server is None
+            alive_workers = sum(1 for w in pool if w.alive)
+            if pending and elastic_server is None and (
+                alive_workers == 0
+                or (processes_per_trial > 1
+                    and alive_workers < processes_per_trial
+                    and not any(w.running for w in pool))
             ):
-                # Cluster died with work outstanding and no way to regrow.
+                # Cluster died (or shrank below one gang's width with
+                # nothing left in flight) with work outstanding and no way
+                # to regrow.
+                why = (
+                    "no live workers" if alive_workers == 0 else
+                    f"only {alive_workers} live workers for "
+                    f"processes_per_trial={processes_per_trial}"
+                )
                 for trial in list(pending):
                     pending.remove(trial)
-                    trial.error = "no live workers"
+                    trial.error = why
                     safe_cb("on_trial_error", trial, trial.error)
                     lifecycle.finish(trial, TrialStatus.ERROR)
                 break
@@ -1533,6 +2020,13 @@ def run_distributed(
                     f"{len(lost)} running trials"
                 )
                 for trial in lost:
+                    gang = gang_by_trial.get(trial.trial_id)
+                    if gang is not None:
+                        teardown_gang(
+                            gang,
+                            f"worker {worker.address} died (gang member)",
+                        )
+                        continue
                     release(trial)
                     err = f"worker {worker.address} died"
                     safe_cb("on_trial_error", trial, err)
@@ -1571,6 +2065,104 @@ def run_distributed(
                 if artifact_origin:
                     artifacts.publish(
                         msg.get("key", ""), msg.get("files") or {}
+                    )
+                continue
+
+            if mtype == "gang_port":
+                # Member 0's supervisor reserved the coordinator port:
+                # assign process ids and spawn every member.
+                gang = gangs.get(msg.get("gang_id", ""))
+                if gang is None or gang.state != "preparing":
+                    continue  # torn down while the reply was in flight
+                trial = by_id.get(gang.trial_id)
+                if trial is None:
+                    continue
+                chost = gang.coordinator.worker.address.rsplit(":", 1)[0]
+                gang.coordinator_address = f"{chost}:{int(msg['port'])}"
+                span = trial_spans.get(gang.trial_id)
+                spawn_failed = False
+                for m in gang.members:
+                    try:
+                        m.worker.send({
+                            "type": "run_gang_member",
+                            "trial_id": gang.trial_id,
+                            "incarnation": gang.incarnation,
+                            "gang_id": gang.gang_id,
+                            "process_id": m.process_id,
+                            "num_processes": gang.num_processes,
+                            "coordinator_address":
+                                gang.coordinator_address,
+                            "local_device_count": gang_devices_per_member,
+                            "slot": m.slot,
+                            "config": dict(trial.config),
+                            "trainable": trainable_spec,
+                            "checkpoint_dir": store.checkpoint_dir(trial),
+                            "checkpoint_format": store.checkpoint_format,
+                            "restore_path": trial.restore_path,
+                            "start_iteration": trial.training_iteration,
+                            "artifact_origin": artifact_origin,
+                            "join_deadline_s": float(gang_join_deadline_s),
+                            "obs": obs_lib.trace_context_frame(
+                                parent=span.context
+                                if span is not None else None
+                            ),
+                        })
+                    except OSError:
+                        m.worker.alive = False
+                        spawn_failed = True
+                        teardown_gang(
+                            gang,
+                            f"worker {m.worker.address} died at gang spawn",
+                        )
+                        break
+                if not spawn_failed:
+                    gang.arm_join_deadline(gang_join_deadline_s)
+                continue
+
+            if mtype == "gang_joined":
+                gang = gangs.get(msg.get("gang_id", ""))
+                if gang is not None and int(
+                    msg.get("incarnation", -1)
+                ) == gang.incarnation:
+                    if gang.mark_joined(int(msg.get("process_id", -1))):
+                        log(
+                            f"gang {gang.gang_id} fully joined "
+                            f"({gang.num_processes} processes)"
+                        )
+                        obs_lib.event("gang_running", {
+                            "gang_id": gang.gang_id,
+                        })
+                continue
+
+            if mtype == "gang_member_done":
+                gang = gangs.get(msg.get("gang_id", ""))
+                if gang is None or int(
+                    msg.get("incarnation", -1)
+                ) != gang.incarnation:
+                    liveness["fenced_frames"] += 1
+                    continue
+                member = gang.member(int(msg.get("process_id", -1)))
+                if msg.get("ok"):
+                    # A non-coordinator member finished its SPMD program;
+                    # its slot frees now, the trial completes when the
+                    # coordinator's terminal lands.
+                    if member is not None:
+                        member.done = True
+                        member.worker.running.pop(gang.trial_id, None)
+                else:
+                    tb = msg.get("traceback") or "gang member failed"
+                    obs_lib.dump_flight_recorder(
+                        f"gang_member_failure_{gang.trial_id}",
+                        extra={
+                            "gang": gang.describe(),
+                            "process_id": msg.get("process_id"),
+                            "traceback_tail": tb[-1500:],
+                        },
+                    )
+                    teardown_gang(
+                        gang,
+                        f"gang member {msg.get('process_id')} on "
+                        f"{worker.address} failed: {tb.splitlines()[-1]}",
                     )
                 continue
 
@@ -1664,6 +2256,22 @@ def run_distributed(
                     # registry snapshot (latest wins per worker; totals
                     # are summed across workers at teardown).
                     worker_obs[worker.address] = msg["obs_counters"]
+                gang = gang_by_trial.get(trial.trial_id)
+                if gang is not None:
+                    # Coordinator finished: reap any member whose own
+                    # terminal has not landed yet (the SPMD program ended
+                    # everywhere — a straggler here is teardown, not
+                    # progress) so slots free deterministically.
+                    for m in gang.members[1:]:
+                        if not m.done:
+                            try:
+                                m.worker.send({
+                                    "type": "gang_abort",
+                                    "trial_id": gang.trial_id,
+                                    "incarnation": gang.incarnation,
+                                })
+                            except OSError:
+                                m.worker.alive = False
                 release(trial)
                 # complete_trial returns True when the scheduler REQUEUEs
                 # (PBT exploit): the trial keeps living, so no completion
@@ -1676,6 +2284,20 @@ def run_distributed(
                 if msg.get("obs_counters"):
                     worker_obs[worker.address] = msg["obs_counters"]
                 trial.error = msg.get("traceback", "unknown error")
+                gang = gang_by_trial.get(trial.trial_id)
+                if gang is not None:
+                    # Coordinator errored: the whole gang goes — peers may
+                    # already be wedged in a collective against the dead
+                    # program.  teardown_gang routes through requeue_lost
+                    # (quarantine + newest valid generation + retry
+                    # budget).
+                    teardown_gang(
+                        gang,
+                        f"gang coordinator failed: "
+                        f"{trial.error.splitlines()[-1]}",
+                    )
+                    store.write_state(trials)
+                    continue
                 release(trial)
                 safe_cb("on_trial_error", trial, trial.error)
                 lifecycle.fail_trial(trial, trial.error)
@@ -1868,15 +2490,23 @@ def start_local_workers(
             STARTUP_GRACE_SCALE * max(measured_spawns, default=0.0),
         )
         deadline = spawn_t0 + budget
-        while not os.path.exists(ready):
+        # Poll for a COMPLETE address, not mere file existence: the worker
+        # creates the ready file and then writes "host:port\n" — reading
+        # in between hands the driver an empty address (observed flake).
+        addr = ""
+        while ":" not in addr:
             if proc.poll() is not None:
                 raise RuntimeError(f"worker {i} exited rc={proc.returncode}")
             if time.monotonic() > deadline:
                 raise TimeoutError(f"worker {i} did not become ready")
+            if os.path.exists(ready):
+                with open(ready) as f:
+                    addr = f.read().strip()
+                if ":" in addr:
+                    break
             time.sleep(0.05)
         measured_spawns.append(time.monotonic() - spawn_t0)
-        with open(ready) as f:
-            addrs.append(f.read().strip())
+        addrs.append(addr)
         os.unlink(ready)
     return procs, addrs
 

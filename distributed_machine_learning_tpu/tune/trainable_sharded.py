@@ -93,6 +93,17 @@ def _host(tree):
     return jax.tree.map(lambda a: np.asarray(a), tree)
 
 
+def _host_template(tree):
+    """A restore TEMPLATE matching ``tree``'s structure/shapes/dtypes with
+    no device readback: ``restore_into`` takes every value from the
+    checkpoint, so zeros serve — and a process-SPANNING array (multihost
+    gang trials) cannot be ``np.asarray``'d at all."""
+    return jax.tree.map(
+        lambda a: np.zeros(a.shape, a.dtype) if hasattr(a, "shape") else a,
+        tree,
+    )
+
+
 @functools.lru_cache(maxsize=1)
 def _epoch_aot_cache():
     """One process-wide AOT store for fused epoch programs: a second trial
@@ -145,9 +156,25 @@ def _train_sharded(
     val_data: Dataset,
 ):
 
-    devices = session.get_devices() or list(jax.devices())
-    mesh_shape = dict(config.get("mesh_shape") or {"dp": len(devices)})
-    mesh = make_mesh(mesh_shape, devices)
+    from distributed_machine_learning_tpu.multihost import runtime as mh
+
+    n_procs = jax.process_count()
+    if n_procs > 1:
+        # Gang trial (multihost/): ONE mesh over every process's devices.
+        # This process traces the same global program as its peers, loads
+        # only the batch slices its devices address (stage_global), and
+        # checkpoints only the shards it holds (host_snapshot + the
+        # sharded format).  The budget probe must read a LOCAL device —
+        # a peer's device has no memory stats here (dmlint DML016).
+        devices = list(jax.devices())
+        mesh_shape = dict(config.get("mesh_shape") or {"dp": len(devices)})
+        mesh = mh.spanning_mesh(mesh_shape)
+        budget_device = jax.local_devices()[0]
+    else:
+        devices = session.get_devices() or list(jax.devices())
+        mesh_shape = dict(config.get("mesh_shape") or {"dp": len(devices)})
+        mesh = make_mesh(mesh_shape, devices)
+        budget_device = devices[0]
     dp = int(mesh.shape.get("dp", 1))
     rules = rules_for(config)
     rules_fp = rules_fingerprint(rules)
@@ -184,10 +211,17 @@ def _train_sharded(
         x_np.nbytes + y_np.nbytes
         + int(val_data.x.size + val_data.y.size) * 4
     )
+    if n_procs > 1 and str(config.get("input_mode") or "") == "streaming":
+        raise ValueError(
+            "input_mode='streaming' is not supported on a process-spanning "
+            "mesh yet: the prefetch ring stages whole slabs per process "
+            "and would double-buffer every host's full epoch (use "
+            "'resident', or run the trial single-process)"
+        )
     input_mode = hostpipe.resolve_input_mode(
-        config, dataset_bytes, devices[0], shards=dp
+        config, dataset_bytes, budget_device, shards=dp
     )
-    streaming = input_mode == "streaming"
+    streaming = input_mode == "streaming" and n_procs == 1
     if streaming:
         hostpipe.get_host_input_counters().add("streams_engaged")
         per_dev_row_nbytes = max(
@@ -365,10 +399,18 @@ def _train_sharded(
         # not share an AOT entry (the collision hands trial B outputs
         # placed on trial A's devices).  Cross-worker dedup is unaffected
         # — it rides the persistent-cache/artifact-origin key, not this
-        # executable-level one.
-        extra={"device_ids": [
-            int(getattr(d, "id", i)) for i, d in enumerate(devices)
-        ]},
+        # executable-level one.  On a process-spanning mesh the PROCESS
+        # TOPOLOGY folds in too: the same mesh shape decomposed over a
+        # different process layout lowers different cross-process
+        # collectives (reshaping the gang must split the key; the same
+        # topology elsewhere must not).
+        extra={
+            "device_ids": [
+                int(getattr(d, "id", i)) for i, d in enumerate(devices)
+            ],
+            **({"process_topology": mh.process_topology()}
+               if n_procs > 1 else {}),
+        },
     )
     chunk_jit_kwargs = {
         "in_shardings": (
@@ -423,6 +465,12 @@ def _train_sharded(
             except Exception:  # noqa: BLE001 - AOT must never fail a trial
                 train_chunk = jit_chunk()
         train_chunk_tail = jit_chunk() if chunk_plan.tail_batches else None
+    elif n_procs > 1:
+        # Process-spanning programs skip the AOT executable tier (a
+        # serialized executable pins concrete devices of ONE process
+        # view); compile-once still holds through the persistent XLA
+        # cache + artifact origin, whose keys fold the process topology.
+        train_epoch = jit_epoch()
     else:
       with dispatch_lock():
         try:
@@ -462,11 +510,12 @@ def _train_sharded(
         eval_fn, in_shardings=(None, None, xv_sharding, xv_sharding, xv_sharding)
     )
     # Validation staging is device traffic too — same hold discipline
-    # (utils/dispatch.py).
+    # (utils/dispatch.py).  stage_global = device_put single-process; on a
+    # spanning mesh each process stages only its addressable slices.
     with dispatch_lock():
-        xv = jax.device_put(xv_np, xv_sharding)
-        yv = jax.device_put(yv_np, xv_sharding)
-        mask = jax.device_put(mask_np, xv_sharding)
+        xv = mh.stage_global(xv_np, xv_sharding)
+        yv = mh.stage_global(yv_np, xv_sharding)
+        mask = mh.stage_global(mask_np, xv_sharding)
 
     # ---- restore (PBT exploit / fault retry) -------------------------------
     start_epoch = 0
@@ -476,9 +525,9 @@ def _train_sharded(
       # like every other device-call section (utils/dispatch.py).
       with dispatch_lock():
         template = {
-            "params": _host(params),
-            "opt_state": _host(opt_state),
-            "batch_stats": _host(batch_stats),
+            "params": _host_template(params),
+            "opt_state": _host_template(opt_state),
+            "batch_stats": _host_template(batch_stats),
             "epoch": 0,
         }
         try:
@@ -538,7 +587,7 @@ def _train_sharded(
                 )
             else:
                 train_epoch = jit_epoch()
-            template["opt_state"] = _host(opt_state)
+            template["opt_state"] = _host_template(opt_state)
             restored = restore_into(template, ckpt)
         # Re-shard restored host arrays into the live mesh layout.
         params = jax.device_put(restored["params"], p_shardings)
@@ -554,7 +603,19 @@ def _train_sharded(
         start_epoch = int(restored["epoch"]) + 1
 
     checkpoint_freq = int(config.get("checkpoint_freq", 1))
-    rng = np.random.default_rng(fold_seed(seed, "shuffle"))
+
+    def epoch_perm(epoch: int) -> np.ndarray:
+        """Per-EPOCH-keyed shuffle (not one sequential stream from trial
+        start): a restored incarnation resuming at epoch k must draw
+        epoch k's permutation, not replay epoch 0's — the property that
+        makes an interrupted+requeued trial (gang teardown, preemption)
+        finish bit-identical to an uninterrupted control.  Same keying
+        convention as the in-program threefry chain
+        (``fold_seed(seed, "epoch", epoch)``)."""
+        return np.random.default_rng(
+            fold_seed(seed, "shuffle", epoch)
+        ).permutation(n_train)[: num_batches * global_batch]
+
     audit_donation = True
 
     if streaming:
@@ -574,14 +635,11 @@ def _train_sharded(
             return jax.device_put(arr, sharding)
 
         def _source():
-            # The resident loop's OWN shuffle stream, consumed in the same
-            # epoch order from the same start epoch — identical batches in
-            # identical order is the determinism contract.
-            prod_rng = np.random.default_rng(fold_seed(seed, "shuffle"))
+            # The resident loop's OWN per-epoch shuffle keys, consumed in
+            # the same epoch order — identical batches in identical order
+            # is the determinism contract.
             for _epoch in range(start_epoch, num_epochs):
-                perm = prod_rng.permutation(n_train)[
-                    : num_batches * global_batch
-                ]
+                perm = epoch_perm(_epoch)
                 for start, rows in chunk_plan.chunk_sizes():
                     idx = perm[
                         start * global_batch:(start + rows) * global_batch
@@ -690,7 +748,7 @@ def _train_sharded(
 
     # ---- epoch loop: host-driven so the scheduler can interrupt ------------
     for epoch in range(start_epoch, num_epochs):
-        perm = rng.permutation(n_train)[: num_batches * global_batch]
+        perm = epoch_perm(epoch)
         # Serialized across concurrent trial threads on fragile backends
         # (utils/dispatch.py — the tunnel-wedge mitigation). The epoch
         # batches' host->device transfer — the loop's largest single
@@ -712,12 +770,16 @@ def _train_sharded(
                 if injected
                 else float(schedule(min(opt_steps, total_steps)))
             )
-            # dmlint: disable=blocking-transfer-in-loop one whole-epoch slab per epoch by design; streaming (input_mode) is the over-budget path
-            xb = jax.device_put(
+            # One whole-epoch slab per epoch by design (streaming is the
+            # over-budget path); stage_global = device_put on one process,
+            # addressable-slices-only on a spanning mesh — every host
+            # gathers the same permutation, so the global batches are
+            # IDENTICAL to the single-process run's (the bit-identity
+            # contract).
+            xb = mh.stage_global(
                 x_np[perm].reshape(xb_shape), xb_sharding,
             )
-            # dmlint: disable=blocking-transfer-in-loop one whole-epoch slab per epoch by design; streaming (input_mode) is the over-budget path
-            yb = jax.device_put(
+            yb = mh.stage_global(
                 y_np[perm].reshape(yb_shape), yb_sharding,
             )
             if audit_donation:
@@ -755,11 +817,14 @@ def _train_sharded(
         if checkpoint_freq and (epoch + 1) % checkpoint_freq == 0:
             # Checkpoint readback is device traffic too — same hold
             # discipline as the epoch dispatch (utils/dispatch.py).
+            # host_snapshot copies fully-addressable leaves and leaves
+            # process-SPANNING leaves sharded: each gang member then
+            # serializes exactly the shards it holds (ckpt/format.py).
             with dispatch_lock():
                 checkpoint = {
-                    "params": _host(params),
-                    "opt_state": _host(opt_state),
-                    "batch_stats": _host(batch_stats),
+                    "params": mh.host_snapshot(params),
+                    "opt_state": mh.host_snapshot(opt_state),
+                    "batch_stats": mh.host_snapshot(batch_stats),
                     "epoch": epoch,
                 }
         session.report(record, checkpoint=checkpoint)

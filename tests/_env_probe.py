@@ -165,6 +165,113 @@ print(json.dumps({"ok": True}))
     return True, "sharded vmap+compaction probe passed twice"
 
 
+_MULTIPROC_CHILD = r"""
+import json
+import os
+import sys
+
+idx, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=1"
+).strip()
+import jax
+
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception as exc:
+    print(json.dumps({"note": repr(exc)}), flush=True)
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=nproc,
+    process_id=idx,
+)
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+devices = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+mesh = Mesh(np.array(devices).reshape(nproc), ("dp",))
+arr = jax.make_array_from_callback(
+    (nproc,), NamedSharding(mesh, P("dp")),
+    lambda i: np.arange(nproc, dtype=np.float32)[i] + 1.0,
+)
+total = float(jax.jit(jnp.sum)(arr))  # one cross-process psum
+print(json.dumps({
+    "idx": idx, "total": total,
+    "process_count": jax.process_count(),
+    "ok": bool(total == float(nproc * (nproc + 1) / 2)),
+}), flush=True)
+"""
+
+
+@functools.lru_cache(maxsize=None)
+def multiprocess_cpu_collectives() -> Tuple[bool, str]:
+    """Can TWO OS processes join one jax.distributed runtime over a
+    localhost coordinator and run a cross-process reduction on this CPU
+    backend?  The capability every multihost/ e2e (gang trials,
+    process-spanning checkpoints, the two-process bit-identity runs)
+    stands on: both processes must initialize, build a dp mesh spanning
+    them, and agree on one psum.  Probe failure (no gloo collectives in
+    this jaxlib, sandboxed localhost sockets, version drift) skips those
+    tests WITH the evidence below."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [_REPO_ROOT]
+        + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+           if p and ".axon_site" not in p]
+    )
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID", "DML_GANG_SPEC"):
+        env.pop(var, None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _MULTIPROC_CHILD, str(i), "2", str(port)],
+            env=env, cwd=_REPO_ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for i, proc in enumerate(procs):
+        try:
+            out, err = proc.communicate(timeout=_PROBE_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            return False, (
+                f"2-process collectives probe timed out after "
+                f"{_PROBE_TIMEOUT_S}s (process {i} never finished the "
+                f"distributed join or the psum)"
+            )
+        outs.append((proc.returncode, out, err))
+    for i, (rc, out, err) in enumerate(outs):
+        line = next(
+            (ln for ln in reversed(out.strip().splitlines())
+             if ln.startswith("{") and '"ok"' in ln), None,
+        )
+        if rc != 0 or line is None:
+            return False, (
+                f"2-process collectives probe: process {i} failed rc={rc}; "
+                f"stderr tail: {err[-400:]!r}"
+            )
+        verdict = json.loads(line)
+        if not verdict.get("ok") or verdict.get("process_count") != 2:
+            return False, (
+                f"2-process collectives probe: process {i} saw "
+                f"process_count={verdict.get('process_count')}, "
+                f"psum total={verdict.get('total')} (expected 3.0)"
+            )
+    return True, "2-process jax.distributed psum probe passed"
+
+
 @functools.lru_cache(maxsize=None)
 def sharded_2d_mesh() -> Tuple[bool, str]:
     """Can this backend run GSPMD-sharded (dp x tp mesh) trainables

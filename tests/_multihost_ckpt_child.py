@@ -1,0 +1,192 @@
+"""Child body for the 2-process checkpoint matrix rows
+(tests/test_ckpt_restore_matrix.py) and the barrier-timeout flight-dump
+test (tests/test_multihost.py).
+
+Modes (argv[1]):
+
+* ``save`` — both processes join one jax.distributed runtime, place a
+  known pytree on a dp=2 mesh SPANNING them, and save generations 1 and 2
+  through the sharded format (each process writes only its own chunks;
+  process 0 writes index/COMMIT after the all-chunks barrier).  With
+  ``DML_CHAOS_PLAN`` carrying ``kill_before_commit`` for gen 2, process
+  0's COMMIT write raises — the preempted-save variant.
+* ``restore`` — both processes restore a generation the PARENT saved
+  single-process: full host gather (bit-checked against the expectation)
+  AND a resharded restore onto the process-spanning mesh, each process
+  checking the bytes of exactly its addressable shards.
+* ``barrier_timeout`` — process 0 waits on a deadline barrier that
+  process 1 never reaches; the BarrierTimeout + flight dump (naming the
+  absent id) are the assertion payload.
+
+argv: mode, process_id, num_processes, port, workdir, outfile
+"""
+
+import json
+import os
+import sys
+
+
+def launch(mode: str, workdir: str, outdir: str, env_extra=None,
+           timeout_s: float = 240.0):
+    """Parent-side runner: spawn BOTH processes of one child mode with a
+    sanitized CPU env and return their parsed result dicts (asserting
+    both produced one)."""
+    import socket
+    import subprocess
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = repo
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID", "DML_GANG_SPEC"):
+        env.pop(var, None)
+    if env_extra:
+        env.update(env_extra)
+    outs = [os.path.join(outdir, f"{mode}_p{i}.json") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), mode, str(i), "2",
+             str(port), workdir, outs[i]],
+            env=env, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    errs = []
+    for p in procs:
+        try:
+            _, err = p.communicate(timeout=timeout_s)
+            errs.append(err)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+    results = []
+    for i, path in enumerate(outs):
+        assert os.path.exists(path), (
+            f"child {i} wrote no result; rc={procs[i].returncode}, "
+            f"stderr tail: {errs[i][-800:]}"
+        )
+        with open(path) as f:
+            results.append(json.load(f))
+    return results
+
+
+def main() -> None:
+    mode, idx, nproc, port, workdir, outfile = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+        sys.argv[5], sys.argv[6],
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=1"
+    ).strip()
+    result = {"mode": mode, "idx": idx}
+    try:
+        from distributed_machine_learning_tpu import chaos
+
+        chaos.activate_from_env()
+
+        import jax
+
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception as exc:  # pragma: no cover - version drift
+            result["collectives_note"] = repr(exc)
+
+        from distributed_machine_learning_tpu.multihost import runtime
+
+        runtime.initialize(
+            coordinator_address=f"127.0.0.1:{port}",
+            num_processes=nproc, process_id=idx,
+        )
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from distributed_machine_learning_tpu.ckpt import format as fmt
+
+        mesh = runtime.spanning_mesh({"dp": nproc})
+        sh = NamedSharding(mesh, P("dp"))
+
+        def tree(offset: float):
+            return {
+                "w": (np.arange(64, dtype=np.float32) + offset
+                      ).reshape(8, 8),
+                "step": int(offset),
+            }
+
+        def place(t):
+            return {
+                "w": runtime.stage_global(t["w"], sh),
+                "step": t["step"],
+            }
+
+        if mode == "save":
+            fmt.save_sharded(os.path.join(workdir, "gen_000001"),
+                             place(tree(1.0)))
+            try:
+                fmt.save_sharded(os.path.join(workdir, "gen_000002"),
+                                 place(tree(2.0)))
+                result["gen2"] = "committed"
+            except chaos.InjectedCommitKill:
+                result["gen2"] = "commit_killed"
+            runtime.barrier("saved")
+        elif mode == "restore":
+            gen = os.path.join(workdir, "gen_000001")
+            # Full host gather: bit-identical on every process.
+            full = fmt.load_sharded(gen)
+            result["full_ok"] = bool(
+                np.asarray(full["w"]).tobytes()
+                == tree(3.0)["w"].tobytes()
+                and int(full["step"]) == 3
+            )
+            # Resharded restore ONTO the spanning mesh: each process
+            # checks the bytes of its own addressable shards only.
+            resharded = fmt.load_sharded(gen, shardings={"w": sh})
+            shard_ok = True
+            for s in resharded["w"].addressable_shards:
+                want = tree(3.0)["w"][s.index]
+                shard_ok &= bool(
+                    np.asarray(s.data).tobytes() == want.tobytes()
+                )
+            result["reshard_ok"] = shard_ok
+            result["n_local_shards"] = len(
+                resharded["w"].addressable_shards
+            )
+        elif mode == "barrier_timeout":
+            from distributed_machine_learning_tpu import obs
+            from distributed_machine_learning_tpu.multihost.runtime import (
+                BarrierTimeout,
+            )
+
+            obs.configure(dump_dir=workdir)
+            if idx == 0:
+                try:
+                    runtime.barrier("straggler_test", deadline_s=4.0)
+                    result["timed_out"] = False
+                except BarrierTimeout as exc:
+                    result["timed_out"] = True
+                    result["absent"] = exc.absent
+            else:
+                # Never reach the barrier; exit after the peer's deadline.
+                import time
+
+                time.sleep(8.0)
+        result["ok"] = True
+    except Exception:  # noqa: BLE001 - parent decides skip vs fail
+        import traceback
+
+        result["ok"] = False
+        result["error"] = traceback.format_exc()[-2000:]
+    with open(outfile, "w") as f:
+        json.dump(result, f)
+
+
+if __name__ == "__main__":
+    main()

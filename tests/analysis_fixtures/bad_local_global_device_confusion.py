@@ -1,0 +1,25 @@
+# dmlint-scope: multihost
+"""Fixture: the three single-process-invisible device-view conflations a
+process-spanning mesh exposes (ISSUE 14).  Each passes every test on one
+process and breaks the moment jax.process_count() > 1."""
+
+import jax
+
+
+def local_buffer_pool():
+    # The GLOBAL device count sized as if it were this host's.
+    n_local = len(jax.devices())  # EXPECT: local-global-device-confusion
+    return [bytearray(1024) for _ in range(n_local)]
+
+
+def my_devices():
+    # The global list is ordered by process index, not local-first: this
+    # is only this host's devices on process 0.
+    return jax.devices()[: jax.local_device_count()]  # EXPECT: local-global-device-confusion
+
+
+def load_host_shard(data):
+    # Divides the data across processes but never offsets by
+    # process_index: every host loads shard 0.
+    per_host = len(data) // jax.process_count()
+    return data[:per_host]  # EXPECT: local-global-device-confusion

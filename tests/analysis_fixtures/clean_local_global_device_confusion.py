@@ -1,0 +1,33 @@
+# dmlint-scope: multihost
+"""Clean twin: the per-host idioms the rule must stay silent on."""
+
+import jax
+
+
+def local_buffer_pool():
+    # Per-host sizing from the per-host API.
+    n_local = jax.local_device_count()
+    return [bytearray(1024) for _ in range(n_local)]
+
+
+def my_devices():
+    # The per-host device list, straight from the per-host API.
+    return jax.local_devices()
+
+
+def load_host_shard(data):
+    # Process-count division WITH the process_index offset.
+    per_host = len(data) // jax.process_count()
+    start = jax.process_index() * per_host
+    return data[start:start + per_host]
+
+
+def whole_dataset_rows(data, n_rows):
+    # A plain slice with no process arithmetic anywhere in scope.
+    return data[:n_rows]
+
+
+def global_mesh_size():
+    # The global count used AS the global count is fine.
+    total_devices = len(jax.devices())
+    return total_devices

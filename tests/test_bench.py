@@ -1231,3 +1231,27 @@ def test_child_streaming_end_to_end_tiny(monkeypatch, capsys):
     # pass_0p9 is the bench ACCEPTANCE on real runs; at this toy size the
     # ratio is noisy, so assert it is derived consistently, not its value.
     assert out["pass_0p9"] == (out["step_rate_vs_resident"] >= 0.9)
+
+
+def test_multihost_section_cpu_and_tunnel_skip_with_reason(monkeypatch):
+    """ISSUE 14 satellite: the MULTICHIP multihost section NEVER emits a
+    non-comparable number — CPU fallback and the single-claimant tunnel
+    both record skipped-with-reason stubs."""
+    cpu = bench._multihost_section("cpu", None, lambda m: None)
+    assert cpu["skipped"].startswith("cpu fallback")
+    assert "step_s" not in cpu
+    monkeypatch.delenv("DML_BENCH_MULTIHOST", raising=False)
+    tpu = bench._multihost_section("tpu", None, lambda m: None)
+    assert "single-claimant" in tpu["skipped"]
+    assert "step_s" not in tpu
+
+
+def test_multihost_section_compact_line():
+    """The compact emit line carries the skip reason (or the numbers),
+    same shape discipline as sharded_flagship."""
+    compact = {}
+    mhx = {"skipped": "cpu fallback: " + "x" * 200}
+    compact["multihost"] = (
+        {"skipped": mhx["skipped"][:80]} if mhx.get("skipped") else None
+    )
+    assert len(compact["multihost"]["skipped"]) == 80
