@@ -2680,8 +2680,10 @@ def emit(value: float, vs_baseline, backend: str, extra: dict) -> None:
         "detail": detail_ref,
     }
     for k in ("mfu", "compute_dtype", "best_validation_mape", "wall_s",
-              "device_utilization", "vs_baseline_cold", "partial",
-              "warm_skipped_after", "epochs_per_dispatch", "total_s"):
+              "device_utilization", "vs_baseline_cold", "comparability",
+              "vs_baseline_same_backend", "vs_baseline_cold_same_backend",
+              "partial", "warm_skipped_after", "epochs_per_dispatch",
+              "total_s"):
         if extra.get(k) is not None:
             compact[k] = extra[k]
     if extra.get("error"):
@@ -3394,6 +3396,35 @@ def main() -> None:
           if torch_res else None)
     vs_cold = (ours.get("trials_per_hour_cold", 0)
                / torch_res["trials_per_hour"] if torch_res else None)
+    # Comparability honesty (perf sentinel, perf/sentinel.py): the repo's
+    # reference backend is the banked chip capture's.  When THIS run fell
+    # back to a different backend, a headline `vs_baseline` would be read
+    # against chip-era rounds (the r03–r05 "0.8x" trap) — so the
+    # cross-backend headline is null + a comparability tag, and the
+    # honest same-backend ratio (our cpu run vs the torch-cpu baseline)
+    # moves to `vs_baseline_same_backend`.
+    ref_backend = "tpu" if _load_last_tpu_capture() else backend
+    cross_backend = backend != ref_backend
+    if cross_backend:
+        if vs is not None:
+            extra_comparability = {
+                "comparability": f"{backend}-fallback vs {ref_backend}",
+                "vs_baseline_same_backend": round(vs, 2),
+            }
+        else:
+            extra_comparability = {
+                "comparability": f"{backend}-fallback vs {ref_backend}",
+            }
+        if vs_cold is not None:
+            extra_comparability["vs_baseline_cold_same_backend"] = round(
+                vs_cold, 2
+            )
+        vs_headline = None
+        vs_cold_headline = None
+    else:
+        extra_comparability = {}
+        vs_headline = vs
+        vs_cold_headline = vs_cold
     extra = {
         "mfu": round(mfu, 4) if mfu is not None else None,
         "peak_flops_assumed": peak,
@@ -3413,8 +3444,9 @@ def main() -> None:
         # fallback "0.39x" was exactly that).
         "wall_s": round(ours["wall_s"], 1),
         "cold_wall_s": round(ours.get("cold_wall_s") or 0.0, 1),
-        "vs_baseline_cold": (round(vs_cold, 2)
-                             if vs_cold is not None else None),
+        "vs_baseline_cold": (round(vs_cold_headline, 2)
+                             if vs_cold_headline is not None else None),
+        **extra_comparability,
         "warm_walls_s": ours.get("warm_walls_s"),
         "wall_spread_s": ours.get("wall_spread_s"),
         "compile_s": round(ours.get("compile_s") or 0.0, 1),
@@ -3433,7 +3465,8 @@ def main() -> None:
         # in the same artifact was a VERDICT r5 deduction.
         **({} if backend != "cpu" else {"cpu_note": (
             "fallback headline is a WARM wall (compile in cold_wall_s); "
-            + (f"this run measured warm {round(vs, 2)}x torch"
+            + (f"this run measured warm {round(vs, 2)}x torch "
+               f"(same-backend: cpu vs torch-cpu)"
                + (f" (cold {round(vs_cold, 2)}x)"
                   if vs_cold is not None else "")
                if vs is not None else "no torch baseline this run")
@@ -3541,7 +3574,7 @@ def main() -> None:
             "fifo_row_epochs": ours.get("fifo_row_epochs"),
             "best_validation_mape": ours.get("asha_best_mape"),
         }
-    emit(ours["trials_per_hour"], vs, backend, extra)
+    emit(ours["trials_per_hour"], vs_headline, backend, extra)
 
 
 if __name__ == "__main__":

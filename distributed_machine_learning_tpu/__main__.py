@@ -544,6 +544,145 @@ def _trace(rest) -> None:
             print(table)
 
 
+def _perf(rest) -> None:
+    """``dml-tpu perf {compare|audit}``: the operator surface of the
+    performance observatory (perf/, docs/performance.md)."""
+    import argparse
+    import glob as glob_lib
+
+    p = argparse.ArgumentParser(
+        prog="perf",
+        description="cost-model audit + bench regression sentinel "
+                    "(perf/costmodel.py, perf/sentinel.py)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    p_cmp = sub.add_parser(
+        "compare",
+        help="bucket BENCH_r*/MULTICHIP_r* rounds into comparability "
+             "classes and verdict only within a class (exit 1 on an "
+             "in-class regression beyond the noise band)",
+    )
+    p_cmp.add_argument("--artifacts", nargs="+", required=True,
+                       help="round artifact paths or globs "
+                            "(BENCH_r*.json MULTICHIP_r*.json)")
+    p_cmp.add_argument("--noise", type=float, default=None,
+                       help="noise band as a fraction (default 0.15: "
+                            "+/-15%% is flat, not a verdict)")
+    p_cmp.add_argument("--json", action="store_true")
+
+    p_aud = sub.add_parser(
+        "audit",
+        help="compile tiny canonical programs per model family on THIS "
+             "backend and cross-check XLA's cost_analysis() FLOPs "
+             "against the analytic model in ops/flops.py (exit 1 on "
+             "divergence beyond tolerance)",
+    )
+    p_aud.add_argument("families", nargs="*",
+                       default=None,
+                       help="model families (default: mlp "
+                            "simple_transformer transformer)")
+    p_aud.add_argument("--tolerance", type=float, default=None,
+                       help="ratio tolerance (default "
+                            "perf.DEFAULT_CROSSCHECK_TOL)")
+    p_aud.add_argument("--json", action="store_true")
+    args = p.parse_args(rest)
+
+    from distributed_machine_learning_tpu import perf
+
+    if args.cmd == "compare":
+        paths = []
+        for pat in args.artifacts:
+            hits = sorted(glob_lib.glob(pat))
+            paths.extend(hits if hits else [pat])
+        rounds = perf.load_rounds(paths)
+        if not rounds:
+            print(f"error: no BENCH_r*/MULTICHIP_r* artifacts among "
+                  f"{args.artifacts}", file=sys.stderr)
+            raise SystemExit(2)
+        report = perf.evaluate_rounds(
+            rounds,
+            noise_band=(args.noise if args.noise is not None
+                        else perf.DEFAULT_NOISE_BAND),
+        )
+        if args.json:
+            print(json.dumps(report, indent=1))
+        else:
+            print(perf.render_report(report))
+        raise SystemExit(0 if report["ok"] else 1)
+
+    # audit: zero-extra-compile discipline does not apply here — this IS
+    # the command that compiles (tiny) programs, on purpose, to judge
+    # the analytic model on the current backend.
+    import jax
+    import numpy as np
+
+    from distributed_machine_learning_tpu.models import build_model
+    from distributed_machine_learning_tpu.ops.flops import (
+        device_peak_flops,
+        forward_flops,
+    )
+
+    families = args.families or ["mlp", "simple_transformer",
+                                 "transformer"]
+    tol = (args.tolerance if args.tolerance is not None
+           else perf.DEFAULT_CROSSCHECK_TOL)
+    batch, seq, feats = 8, 16, 4
+    rows = []
+    ok = True
+    for family in families:
+        config = {"model": family, "dropout": 0.0}
+        x = np.zeros((batch, seq, feats), np.float32)
+        if family == "mlp":
+            x = x.reshape(batch, seq * feats)
+        model = build_model(config)
+        variables = model.init(jax.random.key(0), x)
+
+        def apply(v, xin):
+            return model.apply(v, xin, deterministic=True)
+
+        compiled = jax.jit(apply).lower(variables, x).compile()
+        cost = perf.extract_cost(compiled)
+        analytic = forward_flops(config, batch, seq, feats)
+        finding = perf.crosscheck(
+            analytic, (cost or {}).get("flops"), tolerance=tol,
+            label=family,
+        )
+        dev = jax.devices()[0]
+        row = {
+            "family": family,
+            "analytic_flops": analytic,
+            "measured_flops": (cost or {}).get("flops"),
+            "ratio": (
+                round(cost["flops"] / analytic, 4)
+                if cost and cost.get("flops") and analytic else None
+            ),
+            "roofline": perf.roofline(
+                cost,
+                device_peak_flops(dev),
+                perf.device_hbm_bandwidth(dev),
+            ),
+            "divergence": finding,
+        }
+        rows.append(row)
+        if finding is not None:
+            ok = False
+    if args.json:
+        print(json.dumps({"tolerance": tol, "programs": rows, "ok": ok},
+                         indent=1))
+    else:
+        for r in rows:
+            ratio = f"{r['ratio']:.2f}x" if r["ratio"] else "n/a"
+            verdict = (
+                f"DIVERGENT ({r['divergence']['kind']})"
+                if r["divergence"] else "ok"
+            )
+            bound = (r["roofline"] or {}).get("bound") or "?"
+            print(f"[{r['family']}] measured/analytic {ratio} "
+                  f"({verdict}); roofline: {bound}-bound")
+    raise SystemExit(0 if ok else 1)
+
+
 def _export_bundle(rest) -> None:
     import argparse
 
@@ -686,7 +825,7 @@ def main(argv=None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
     usage = (
         "usage: python -m distributed_machine_learning_tpu "
-        "{worker|info|probe|analyze|lint|audit-sharding|trace|serve|"
+        "{worker|info|probe|analyze|lint|audit-sharding|perf|trace|serve|"
         "export-bundle|export-orbax} [args]\n"
         "  worker         host trial supervisor (see 'worker --help')\n"
         "  lint           dmlint static analysis over the package (or given\n"
@@ -695,6 +834,10 @@ def main(argv=None) -> None:
         "                 --jax for the program-level jaxlint tier)\n"
         "  audit-sharding program-level sharding/donation audit (the jax\n"
         "                 tier + per-family partition coverage reports)\n"
+        "  perf           compare: bench-round regression sentinel over\n"
+        "                 BENCH_r*/MULTICHIP_r* artifacts (comparability\n"
+        "                 classes; exit 1 on an in-class regression);\n"
+        "                 audit: XLA cost-model vs analytic FLOPs\n"
         "  info           jax backend/device summary for this process\n"
         "  probe          bounded accelerator health check (child process)\n"
         "  analyze        <experiment_dir>: best config + trial table of a\n"
@@ -727,6 +870,8 @@ def main(argv=None) -> None:
         _lint(rest)
     elif cmd == "audit-sharding":
         _audit_sharding(rest)
+    elif cmd == "perf":
+        _perf(rest)
     elif cmd == "trace":
         _trace(rest)
     elif cmd == "serve":

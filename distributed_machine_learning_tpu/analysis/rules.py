@@ -1290,6 +1290,7 @@ OBS_INSTRUMENTED_PATTERNS = (
     "liveness.py",
     "data/pipeline.py",
     "obs/",
+    "perf/",
     "ckpt/metrics.py",
     "compilecache/counters.py",
     "chaos.py",
@@ -2251,6 +2252,208 @@ class UnguardedSharedStateRule(ProjectRule):
             )
 
 
+# --------------------------------------------------------------------------
+# DML017 lifetime-quantile
+# --------------------------------------------------------------------------
+
+# Calls that compute a percentile/quantile over their first data argument.
+_QUANTILE_CALLS = {
+    "percentile", "quantile", "quantiles",
+    "nanpercentile", "nanquantile",
+}
+
+# Methods that BOUND a list in place (ring/window semantics).
+_BOUNDING_METHODS = {"popleft", "clear"}
+
+
+class LifetimeQuantileRule(Rule):
+    name = "lifetime-quantile"
+    rule_id = "DML017"
+    severity = "error"
+    description = (
+        "a percentile/quantile computed over an UNBOUNDED accumulated "
+        "list in a telemetry module: the PR 8 postmortem as a rule — "
+        "serve latency quantiles originally accumulated every request's "
+        "latency for the process lifetime, so a long soak both leaked "
+        "memory without limit and reported a p99 frozen by hours-old "
+        "traffic (the autoscaler keys scale-up off that value).  A "
+        "lifetime quantile is wrong twice: unbounded growth AND a stale "
+        "signal.  Only LIFETIME accumulators are flagged (self "
+        "attributes and module-level lists); a function-local list dies "
+        "with its call and is fine.  Enforced in obs-instrumented "
+        "modules (OBS_INSTRUMENTED_PATTERNS / `# dmlint-scope: "
+        "obs-metrics`)."
+    )
+    _HINT = (
+        "window it: collections.deque(maxlen=N) (or an explicit ring) "
+        "and compute the quantile over the window — serve/metrics.py's "
+        "bounded latency ring is the house idiom"
+    )
+
+    def applies(self, ctx) -> bool:
+        if "obs-metrics" in ctx.scopes:
+            return True
+        rel = ctx.display_path.replace("\\", "/")
+        return any(pat in rel for pat in OBS_INSTRUMENTED_PATTERNS)
+
+    # -- accumulator discovery -----------------------------------------------
+
+    @staticmethod
+    def _is_list_literal(node: ast.AST) -> bool:
+        return isinstance(node, ast.List) or (
+            isinstance(node, ast.Call)
+            and (_call_name(node) or "") == "list"
+            and not node.args
+        )
+
+    @staticmethod
+    def _self_attr(node: ast.AST) -> Optional[str]:
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return node.attr
+        return None
+
+    def _scan_scope(self, nodes) -> Dict[str, Dict[str, bool]]:
+        """Per-accumulator evidence over one scope's nodes: ``{name:
+        {"list_init", "grows", "bounded"}}``.  ``name`` is ``.attr`` for
+        self attributes, the bare identifier for module globals."""
+        acc: Dict[str, Dict[str, bool]] = {}
+
+        def rec(name: str) -> Dict[str, bool]:
+            return acc.setdefault(
+                name, {"list_init": False, "grows": False,
+                       "bounded": False}
+            )
+
+        for node in nodes:
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    name = self._target_name(tgt)
+                    if name is None:
+                        continue
+                    if self._is_list_literal(node.value):
+                        rec(name)["list_init"] = True
+                    elif isinstance(tgt, (ast.Attribute, ast.Name)):
+                        # Any other reassignment (a slice-trim
+                        # ``x = x[-n:]``, a deque, a fresh snapshot)
+                        # bounds or replaces the accumulator.
+                        rec(name)["bounded"] = True
+            elif isinstance(node, ast.AugAssign):
+                name = self._target_name(node.target)
+                if name is not None:
+                    rec(name)["grows"] = True
+            elif isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    base = (
+                        tgt.value if isinstance(tgt, ast.Subscript) else tgt
+                    )
+                    name = self._target_name(base)
+                    if name is not None:
+                        rec(name)["bounded"] = True
+            elif isinstance(node, ast.Call):
+                if not isinstance(node.func, ast.Attribute):
+                    continue
+                name = self._target_name(node.func.value)
+                if name is None:
+                    continue
+                meth = node.func.attr
+                if meth in ("append", "extend", "insert"):
+                    rec(name)["grows"] = True
+                elif meth in _BOUNDING_METHODS or (
+                    meth == "pop" and node.args
+                ):
+                    # ``pop(0)`` / ``popleft`` / ``clear`` = ring or
+                    # reset semantics; bare ``pop()`` consumes the end
+                    # of a stack, which also bounds it.
+                    rec(name)["bounded"] = True
+                elif meth == "pop":
+                    rec(name)["bounded"] = True
+        return acc
+
+    def _target_name(self, node: ast.AST) -> Optional[str]:
+        attr = self._self_attr(node)
+        if attr is not None:
+            return f".{attr}"
+        if isinstance(node, ast.Name):
+            return node.id
+        return None
+
+    # -- quantile-site discovery ---------------------------------------------
+
+    def _quantile_data_name(self, call: ast.Call) -> Optional[str]:
+        callee = (_call_name(call) or "").rsplit(".", 1)[-1]
+        if callee not in _QUANTILE_CALLS or not call.args:
+            return None
+        data = call.args[0]
+        # Unwrap ``sorted(x)`` / ``list(x)`` — the copy is taken at call
+        # time, so the quantile is still over the accumulator's lifetime
+        # contents.
+        while (
+            isinstance(data, ast.Call)
+            and (_call_name(data) or "") in ("sorted", "list")
+            and data.args
+        ):
+            data = data.args[0]
+        return self._target_name(data)
+
+    def check(self, ctx) -> Iterator[Finding]:
+        # Only LIFETIME accumulators: self attributes (class scope) and
+        # names LIST-INITIALIZED at module top level (module scope).  A
+        # function-local list dies with its call and is never flagged.
+        for scope_nodes, label, allowed in self._scopes(ctx.tree):
+            acc = self._scan_scope(scope_nodes)
+            for node in scope_nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                name = self._quantile_data_name(node)
+                if name is None or not allowed(name):
+                    continue
+                info = acc.get(name)
+                if not info or not info["list_init"] or not info["grows"]:
+                    continue
+                if info["bounded"]:
+                    continue
+                display = (
+                    f"self{name}" if name.startswith(".") else name
+                )
+                yield self.finding(
+                    ctx, node,
+                    f"quantile over `{display}`, a lifetime-accumulated "
+                    f"list that only ever grows — unbounded memory AND a "
+                    f"quantile dominated by stale traffic"
+                    + (f" (in {label})" if label else ""),
+                    self._HINT,
+                )
+
+    def _scopes(self, tree: ast.AST):
+        """(nodes, label, allowed-name predicate) per judgment scope:
+        every class (``self.X`` attrs are instance-lifetime) and the
+        module body outside classes (module-top-level lists are
+        process-lifetime)."""
+        class_nodes: Set[int] = set()
+        for cls in ast.walk(tree):
+            if isinstance(cls, ast.ClassDef):
+                nodes = list(ast.walk(cls))
+                class_nodes.update(id(n) for n in nodes)
+                yield nodes, cls.name, lambda n: n.startswith(".")
+        module_lists = {
+            tgt.id
+            for node in getattr(tree, "body", [])
+            if isinstance(node, ast.Assign)
+            and self._is_list_literal(node.value)
+            for tgt in node.targets
+            if isinstance(tgt, ast.Name)
+        }
+        yield (
+            [n for n in ast.walk(tree) if id(n) not in class_nodes],
+            "",
+            lambda n: n in module_lists,
+        )
+
+
 ALL_RULES: List[Rule] = [
     DonationAliasRule(),
     UnlockedDispatchRule(),
@@ -2265,6 +2468,7 @@ ALL_RULES: List[Rule] = [
     BlockingTransferInLoopRule(),
     BareCounterIncrementRule(),
     LocalGlobalDeviceConfusionRule(),
+    LifetimeQuantileRule(),
     UseAfterDonationRule(),
     TransitiveChaosRule(),
     UnguardedSharedStateRule(),

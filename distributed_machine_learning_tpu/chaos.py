@@ -160,7 +160,11 @@ class FaultPlan:
       staging EVERY chunk (degraded host input: slow storage, a
       CPU-starved gather).  Training must stay correct with overlap
       efficiency degraded — the counters, not the params, absorb the
-      slowdown.
+      slowdown.  ``slow_producer_match`` — optional ring-name substrings
+      (rings are named ``stream-<trial_id>``): only matching producers
+      sleep, so ONE trial of a sweep becomes a straggler its peers are
+      measured against (the perf anomaly plane must then NAME it —
+      ``perf_straggler[<trial_id>]``, perf/anomaly.py).
     * ``producer_crash_at`` — chunk index (0-based, across the trial's
       whole chunk stream); the producer raises
       :class:`InjectedProducerCrash` before staging that chunk.  Fires
@@ -190,6 +194,7 @@ class FaultPlan:
         stall_storage_ms: float = 0.0,
         partition_worker: Iterable[Tuple[int, int, float]] = (),
         slow_producer_ms: float = 0.0,
+        slow_producer_match: Sequence[str] = (),
         producer_crash_at: Optional[int] = None,
     ):
         self.seed = seed
@@ -222,6 +227,9 @@ class FaultPlan:
             reverse=True,
         )
         self.slow_producer_ms = float(slow_producer_ms)
+        self.slow_producer_match = tuple(
+            str(s) for s in slow_producer_match
+        )
         self._producer_crash_at = (
             int(producer_crash_at) if producer_crash_at is not None else None
         )
@@ -409,11 +417,26 @@ class FaultPlan:
 
     # -- streaming-input faults ----------------------------------------------
 
-    def maybe_producer_fault(self, chunk_index: int) -> None:
+    def maybe_producer_fault(
+        self, chunk_index: int, name: Optional[str] = None
+    ) -> None:
         """Called by the prefetch ring's producer thread before staging
         each chunk: sleeps ``slow_producer_ms`` (every chunk), raises
-        :class:`InjectedProducerCrash` at the scheduled index (once)."""
-        if self.slow_producer_ms > 0:
+        :class:`InjectedProducerCrash` at the scheduled index (once).
+
+        With ``slow_producer_match`` set, only rings whose ``name``
+        contains one of the substrings sleep (the ring is named
+        ``stream-<trial_id>``) — the straggler fault: ONE trial of a
+        sweep degrades while its peers run clean, and the perf anomaly
+        plane must name it.  Substring matching against the caller-owned
+        ring name is deterministic (dmlint DML003: no entropy, no
+        wall-time in the decision)."""
+        if self.slow_producer_ms > 0 and (
+            not self.slow_producer_match
+            or (name is not None and any(
+                s in name for s in self.slow_producer_match
+            ))
+        ):
             self._count("producer_slowdowns")
             time.sleep(self.slow_producer_ms / 1000.0)
         crash = False

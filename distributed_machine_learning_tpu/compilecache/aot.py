@@ -228,6 +228,7 @@ class ExecutableCache:
         if compiled is not None:
             counters.add("program_hits")
             counters.add("aot_imports")
+            self._capture_cost(key, compiled, from_disk=True)
             entry = self._remember(key, compiled, fn, static_argnums,
                                    donate_argnums, jit_kwargs)
             return self._wrap(key, entry)
@@ -239,9 +240,31 @@ class ExecutableCache:
             counters.add("aot_exports")
         else:
             counters.add("aot_unsupported")
+        self._capture_cost(key, compiled, from_disk=False)
         entry = self._remember(key, compiled, fn, static_argnums,
                                donate_argnums, jit_kwargs)
         return self._wrap(key, entry)
+
+    def _capture_cost(self, key: str, compiled, from_disk: bool) -> None:
+        """Cost-model audit capture (perf/costmodel.py) — riding ONLY on
+        executables this cache was compiling or deserializing anyway, so
+        the audit adds zero compiles by construction.  A disk hit prefers
+        the sidecar written at export time (it carries the ORIGIN
+        process's numbers across workers); the fallback reads the
+        deserialized executable's own analysis.  Never raises: cost
+        capture is telemetry, not a cache dependency."""
+        try:
+            from distributed_machine_learning_tpu.perf import costmodel
+
+            if from_disk and self._persist and costmodel.load_program_cost(
+                key, self._dir
+            ) is not None:
+                return
+            costmodel.record_program_cost(
+                key, compiled, self._dir if self._persist else None
+            )
+        except Exception:  # noqa: BLE001 - audit must never cost a trial
+            pass
 
     @staticmethod
     def _jit(fn, static_argnums, donate_argnums, jit_kwargs=None):

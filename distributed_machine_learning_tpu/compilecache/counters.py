@@ -54,6 +54,13 @@ class CompileCounters:
         # proof the buffer alias took effect, not just that donate_argnums
         # was requested (docs/performance.md donation audit table).
         "donation_aliased_buffers",
+        # Cost-model audit (perf/costmodel.py): programs whose
+        # cost_analysis() was captured at compile time vs reloaded from a
+        # <key>.cost.json sidecar — captures + sidecar_loads together
+        # must track aot activity with ZERO extra program_misses (the
+        # audit rides executables the cache was building anyway).
+        "cost_captures",
+        "cost_sidecar_loads",
     )
 
     def __init__(self):

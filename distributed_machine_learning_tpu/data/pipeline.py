@@ -430,6 +430,7 @@ class ChunkPrefetcher:
         # Polled from the consumer's wait loop — no monitor thread needed.
         self._watchdog = DispatchWatchdog(self._deadline_s)
         self._watchdog.track("producer", info=name)
+        self._name = name
         self._producer = threading.Thread(
             target=self._produce, name=f"{name}-producer", daemon=True
         )
@@ -468,8 +469,12 @@ class ChunkPrefetcher:
                 plan = chaos.active_plan()
                 if plan is not None:
                     # Deterministic degradation/death: sleep per chunk
-                    # and/or crash at a scheduled chunk index.
-                    plan.maybe_producer_fault(self._chunk_index)
+                    # and/or crash at a scheduled chunk index.  The ring
+                    # name ("stream-<trial_id>") lets a plan slow ONE
+                    # trial's producer — the named-straggler fault.
+                    plan.maybe_producer_fault(
+                        self._chunk_index, name=self._name
+                    )
                 try:
                     with obs.span(
                         "prefetch.stage", {"chunk": self._chunk_index}

@@ -39,6 +39,7 @@ from distributed_machine_learning_tpu.multihost.runtime import (
     BarrierTimeout,
     barrier,
     broadcast_from_coordinator,
+    check_gang_skew,
     describe,
     global_batch_array,
     host_snapshot,
@@ -58,6 +59,7 @@ __all__ = [
     "GangSpec",
     "barrier",
     "broadcast_from_coordinator",
+    "check_gang_skew",
     "describe",
     "global_batch_array",
     "host_snapshot",

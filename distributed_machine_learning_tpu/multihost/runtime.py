@@ -359,6 +359,52 @@ def host_snapshot(tree):
     return jax.tree.map(snap, tree)
 
 
+_skew_monitor = None
+
+
+def check_gang_skew(
+    seconds: float,
+    label: str = "epoch",
+    ratio_threshold: float = 1.75,
+    sustain: int = 2,
+):
+    """Per-gang-member straggler detection for one round (epoch).
+
+    Every member calls this with its own round duration; the values are
+    allgathered (one tiny DCN collective) and judged by
+    ``perf.anomaly.GangSkewMonitor`` — a member sustained past
+    ``ratio_threshold`` x its peers' median becomes a named incident:
+    ``perf_straggler[process_<id>]`` in the registry plus a flight dump
+    carrying the full round timings.  Counters/dumps fire on the
+    COORDINATOR only (the head aggregates each incident once); every
+    member still gets the straggler list back so a trainable can stamp
+    it into its records.  No-op (empty list) single-process.
+
+    MUST be called by every process of the gang (it is a collective) —
+    the trainables gate it on ``config["perf_gang_skew"]`` which rides
+    the broadcast config, so all members agree."""
+    if jax.process_count() == 1:
+        return []
+    from jax.experimental import multihost_utils
+
+    from distributed_machine_learning_tpu.perf.anomaly import (
+        GangSkewMonitor,
+    )
+
+    vals = np.asarray(
+        multihost_utils.process_allgather(np.float64(float(seconds)))
+    ).ravel()
+    values = {i: float(v) for i, v in enumerate(vals)}
+    global _skew_monitor
+    if _skew_monitor is None:
+        _skew_monitor = GangSkewMonitor(
+            ratio_threshold=ratio_threshold, sustain=sustain
+        )
+    return _skew_monitor.observe_round(
+        values, label=label, report=is_coordinator()
+    )
+
+
 def process_topology() -> Dict[str, object]:
     """The process-layout identity of this runtime: process count plus the
     per-process local device counts (sorted by process index).

@@ -112,3 +112,26 @@ def train_step_flops(
     if fwd is None:
         return None
     return (4.0 if config.get("remat") else 3.0) * fwd
+
+
+def epoch_flops(
+    config: Dict[str, Any],
+    batch: int,
+    seq: int,
+    features: int,
+    steps_per_epoch: int,
+    eval_rows: int = 0,
+) -> Optional[float]:
+    """One epoch's analytic FLOPs: train steps + the full-set eval pass —
+    the derivation both trainables used to inline (now owned here so the
+    MFU numerator cannot drift between the resident, streaming, and
+    sharded paths; consumed via ``perf.EpochPerfAccounting``)."""
+    step = train_step_flops(config, batch, seq, features)
+    if step is None:
+        return None
+    ev = (
+        forward_flops(config, int(eval_rows), seq, features)
+        if eval_rows
+        else None
+    )
+    return step * int(steps_per_epoch) + (ev or 0.0)

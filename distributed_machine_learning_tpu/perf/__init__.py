@@ -1,0 +1,71 @@
+"""perf/ — the performance observatory (ISSUE 15 tentpole).
+
+Three layers, one import, all wired through the obs plane:
+
+* **Cost-model audit** (``perf/costmodel.py``): ``compiled.
+  cost_analysis()`` captured by the AOT executable cache at compile time
+  (zero extra compiles; ``<key>.cost.json`` sidecars ride the cached
+  artifacts across workers), cross-checked against the analytic FLOP
+  model in ``ops/flops.py``, plus roofline compute/memory-bound
+  classification and the one :class:`EpochPerfAccounting` MFU helper
+  both trainables share.
+* **Step-stream anomaly detection** (``perf/anomaly.py``): median/MAD
+  robust z-scores over per-step timings — per-trial outliers in a
+  sweep, per-gang-member skew in multihost, serve ``engine.step``
+  flushes via the batcher's EWMA loop.  Sustained anomalies increment
+  registry counters (``perf_straggler[<who>]`` names the culprit) and
+  trigger a flight-recorder dump.
+* **Regression sentinel** (``perf/sentinel.py`` + ``dml-tpu perf
+  compare``): the checked-in ``BENCH_r*``/``MULTICHIP_r*`` rounds
+  bucketed into comparability classes so a CPU-fallback capture can
+  never read as a chip-era regression.
+
+Stdlib-only at import time (no jax) — same discipline as ``obs/``.
+See docs/performance.md ("Roofline & regression sentinel") and
+docs/observability.md for counter -> action tables.
+"""
+
+from __future__ import annotations
+
+from distributed_machine_learning_tpu.perf.anomaly import (
+    GangSkewMonitor,
+    RobustWindow,
+    StepAnomalyDetector,
+    get_step_anomalies,
+    skew_by_member,
+)
+from distributed_machine_learning_tpu.perf.costmodel import (
+    DEFAULT_CROSSCHECK_TOL,
+    EpochPerfAccounting,
+    cost_sidecar_path,
+    crosscheck,
+    crosscheck_program,
+    device_hbm_bandwidth,
+    extract_cost,
+    load_program_cost,
+    program_class,
+    program_cost,
+    record_program_cost,
+    reset_cost_store,
+    roofline,
+)
+from distributed_machine_learning_tpu.perf.sentinel import (
+    DEFAULT_NOISE_BAND,
+    comparability_class,
+    evaluate_rounds,
+    load_round,
+    load_rounds,
+    reference_backend,
+    render_report,
+)
+
+__all__ = [
+    "DEFAULT_CROSSCHECK_TOL", "DEFAULT_NOISE_BAND",
+    "EpochPerfAccounting", "GangSkewMonitor", "RobustWindow",
+    "StepAnomalyDetector", "comparability_class", "cost_sidecar_path",
+    "crosscheck", "crosscheck_program", "device_hbm_bandwidth",
+    "evaluate_rounds", "extract_cost", "get_step_anomalies",
+    "load_program_cost", "load_round", "load_rounds", "program_class",
+    "program_cost", "record_program_cost", "reference_backend",
+    "render_report", "reset_cost_store", "roofline", "skew_by_member",
+]

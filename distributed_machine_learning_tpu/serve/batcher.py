@@ -37,6 +37,9 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 from distributed_machine_learning_tpu.analysis.locks import named_lock
 from distributed_machine_learning_tpu import obs
+from distributed_machine_learning_tpu.perf.anomaly import (
+    get_step_anomalies,
+)
 
 
 class BatcherStopped(RuntimeError):
@@ -510,9 +513,18 @@ class ContinuousBatcher:
                     parent=batch[0].obs_ctx,
                 ):
                     preds = np.asarray(self.infer_fn(xs))
-                self.stats.record_step(
-                    self.bucket_for(rows),
-                    (time.monotonic() - t0) * 1000.0,
+                bucket = self.bucket_for(rows)
+                step_ms = (time.monotonic() - t0) * 1000.0
+                self.stats.record_step(bucket, step_ms)
+                # The same per-bucket step measurement the adaptive cap
+                # EWMA runs on also feeds the step-stream anomaly
+                # detector (perf/anomaly.py): a sustained engine.step
+                # outlier — wedged relay, degraded replica — becomes a
+                # counter + flight dump naming this batcher instead of a
+                # silently drifting p99.
+                get_step_anomalies().observe(
+                    f"serve.step.b{bucket}", step_ms / 1000.0,
+                    who=self._thread.name,
                 )
                 off = 0
                 for p in batch:

@@ -108,6 +108,16 @@ def get_trial_id() -> str:
     return _get_session().trial.trial_id
 
 
+def current_trial_id(default=None):
+    """``get_trial_id()`` that degrades to ``default`` when no session is
+    installed (or the session carries no trial object) — for telemetry
+    attribution (perf/anomaly.py) from a trainable invoked bare, where
+    raising would fail the trial over a label."""
+    sess = getattr(_session_store, "session", None)
+    trial = getattr(sess, "trial", None)
+    return getattr(trial, "trial_id", default)
+
+
 def get_devices():
     """The jax devices assigned to this trial by the executor."""
     return list(_get_session().devices)

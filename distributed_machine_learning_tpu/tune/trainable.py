@@ -70,10 +70,8 @@ from distributed_machine_learning_tpu.tune._regression_program import (
     per_example_losses,
     stage_data,
 )
-from distributed_machine_learning_tpu.ops.flops import (
-    device_peak_flops,
-    forward_flops,
-    train_step_flops,
+from distributed_machine_learning_tpu.perf.costmodel import (
+    EpochPerfAccounting,
 )
 from distributed_machine_learning_tpu.tune.checkpoint import restore_into
 from distributed_machine_learning_tpu.utils.compile_cache import get_tracker
@@ -445,18 +443,22 @@ def train_regressor(
     checkpoint_freq = int(config.get("checkpoint_freq", 1))
 
     # ---- per-epoch MFU accounting (BASELINE.md utilization target) ---------
+    # One perf-owned derivation for every trainable (perf/costmodel.py):
+    # flops/peak/MFU keys stay byte-compatible with the block this
+    # replaced, and each epoch's timing feeds the step-stream anomaly
+    # detector attributed to THIS trial (straggler naming in sweeps).
     x_shape = data.x_train.shape
     seq_len = int(x_shape[1]) if len(x_shape) == 3 else 1
     feats = int(x_shape[-1])
-    step_flops = train_step_flops(config, data.batch_size, seq_len, feats)
-    eval_flops = forward_flops(config, int(data.x_val.shape[0]), seq_len, feats)
-    epoch_flops = (
-        step_flops * steps_per_epoch + (eval_flops or 0.0)
-        if step_flops is not None
-        else None
-    )
-    peak = device_peak_flops(
-        device, str(config.get("compute_dtype", "float32"))
+    perf_acct = EpochPerfAccounting(
+        config,
+        batch_size=data.batch_size,
+        seq_len=seq_len,
+        features=feats,
+        steps_per_epoch=steps_per_epoch,
+        eval_rows=int(data.x_val.shape[0]),
+        device=device,
+        trial_id=session.current_trial_id(),
     )
     tracker = get_tracker()
 
@@ -515,20 +517,7 @@ def train_regressor(
         exec_s = max(
             _time.time() - t0 - (tracker.thread_seconds() - c0), 1e-9
         )
-        record["epoch_time_s"] = round(exec_s, 4)
-        # Device-memory watermark (TPU HBM; None on CPU): catches per-epoch
-        # memory creep — leaked buffers, donation regressions — in the
-        # ordinary metric stream where TB/analyze can plot it.
-        try:
-            stats = device.memory_stats()
-            if stats and "bytes_in_use" in stats:
-                record["device_bytes_in_use"] = int(stats["bytes_in_use"])
-        except Exception:  # noqa: BLE001 - never fail an epoch on telemetry
-            pass
-        if epoch_flops is not None:
-            record["epoch_flops"] = epoch_flops
-            if peak:
-                record["mfu"] = round(epoch_flops / exec_s / peak, 5)
+        perf_acct.annotate(record, exec_s, device=device)
         checkpoint = None
         if checkpoint_freq and (epoch + 1) % checkpoint_freq == 0:
             checkpoint = {
@@ -855,18 +844,18 @@ def _train_regressor_streaming(
 
     checkpoint_freq = int(config.get("checkpoint_freq", 1))
 
-    # ---- per-epoch MFU accounting (same derivation as the resident path) ---
+    # ---- per-epoch MFU accounting (same helper as the resident path) -------
     seq_len = int(x_np.shape[1]) if x_np.ndim == 3 else 1
     feats = int(x_np.shape[-1])
-    step_flops = train_step_flops(config, batch_size, seq_len, feats)
-    eval_flops = forward_flops(config, n_val, seq_len, feats)
-    epoch_flops = (
-        step_flops * steps_per_epoch + (eval_flops or 0.0)
-        if step_flops is not None
-        else None
-    )
-    peak = device_peak_flops(
-        device, str(config.get("compute_dtype", "float32"))
+    perf_acct = EpochPerfAccounting(
+        config,
+        batch_size=batch_size,
+        seq_len=seq_len,
+        features=feats,
+        steps_per_epoch=steps_per_epoch,
+        eval_rows=n_val,
+        device=device,
+        trial_id=session.current_trial_id(),
     )
     tracker = get_tracker()
 
@@ -1027,19 +1016,15 @@ def _train_regressor_streaming(
                 "input_mode": "streaming",
                 **metrics,
             }
-            record["epoch_time_s"] = round(exec_s, 4)
-            try:
-                stats = device.memory_stats()
-                if stats and "bytes_in_use" in stats:
-                    record["device_bytes_in_use"] = int(
-                        stats["bytes_in_use"]
-                    )
-            except Exception:  # noqa: BLE001 - telemetry must never fail
-                pass
-            if epoch_flops is not None:
-                record["epoch_flops"] = epoch_flops
-                if peak:
-                    record["mfu"] = round(epoch_flops / exec_s / peak, 5)
+            # ``observe_s`` is wall minus compile but INCLUDING prefetch
+            # wait: a starved consumer must read as slow to the anomaly
+            # detector (that is the straggler signal a chaos
+            # slow-producer run exists to surface), while the MFU
+            # numerator keeps the wait-free exec_s.
+            perf_acct.annotate(
+                record, exec_s, device=device,
+                observe_s=max(wall - compile_s, 1e-9),
+            )
             checkpoint = None
             if checkpoint_freq and (epoch + 1) % checkpoint_freq == 0:
                 checkpoint = {

@@ -70,6 +70,9 @@ from distributed_machine_learning_tpu.parallel.sharding import (
     opt_state_shardings,
     param_shardings,
 )
+from distributed_machine_learning_tpu.perf.costmodel import (
+    EpochPerfAccounting,
+)
 from distributed_machine_learning_tpu.tune import session
 from distributed_machine_learning_tpu.tune._regression_program import (
     detect_call_convention,
@@ -79,6 +82,7 @@ from distributed_machine_learning_tpu.tune._regression_program import (
     per_example_losses,
 )
 from distributed_machine_learning_tpu.tune.checkpoint import restore_into
+from distributed_machine_learning_tpu.utils.compile_cache import get_tracker
 from distributed_machine_learning_tpu.utils.dispatch import (
     dispatch_lock,
     serialization_on,
@@ -604,6 +608,35 @@ def _train_sharded(
 
     checkpoint_freq = int(config.get("checkpoint_freq", 1))
 
+    # ---- per-epoch MFU/roofline accounting (perf/costmodel.py) -------------
+    # Same helper as tune/trainable.py; the sharded paths additionally
+    # carry their AOT program key so the captured XLA cost is
+    # cross-checked against the analytic model and the records report
+    # ``roofline_bound`` (process-spanning programs skip the AOT tier —
+    # and the audit — by construction).
+    seq_len = int(x_np.shape[1]) if x_np.ndim == 3 else 1
+    feats = int(x_np.shape[-1])
+    perf_acct = EpochPerfAccounting(
+        config,
+        batch_size=global_batch,
+        seq_len=seq_len,
+        features=feats,
+        steps_per_epoch=steps_per_epoch,
+        eval_rows=n_val,
+        device=budget_device,
+        num_devices=len(devices),
+        program_key=(
+            chunk_key if streaming
+            else program_key if n_procs == 1
+            else None
+        ),
+        program_steps=(
+            chunk_plan.chunk_batches if streaming else steps_per_epoch
+        ),
+        trial_id=session.current_trial_id(),
+    )
+    tracker = get_tracker()
+
     def epoch_perm(epoch: int) -> np.ndarray:
         """Per-EPOCH-keyed shuffle (not one sequential stream from trial
         start): a restored incarnation resuming at epoch k must draw
@@ -673,6 +706,7 @@ def _train_sharded(
                         else float(schedule(min(opt_steps, total_steps)))
                     )
                 wait0 = prefetcher.wait_s
+                c0 = tracker.thread_seconds()
                 t0 = _time.monotonic()
                 loss_parts = []
                 probes = None
@@ -713,9 +747,10 @@ def _train_sharded(
                                 "donation_aliased_buffers", consumed
                             )
                 wait_s = prefetcher.wait_s - wait0
-                prefetcher.note_consume(
-                    max(_time.monotonic() - t0 - wait_s, 0.0)
-                )
+                wall = _time.monotonic() - t0
+                compile_s = tracker.thread_seconds() - c0
+                exec_s = max(wall - compile_s - wait_s, 1e-9)
+                prefetcher.note_consume(max(wall - wait_s, 0.0))
                 record = {
                     "epoch": epoch,
                     "train_loss": train_loss,
@@ -726,6 +761,13 @@ def _train_sharded(
                     "input_mode": "streaming",
                     **metrics,
                 }
+                # Wait rides in observe_s (a starved consumer must read
+                # as slow to the anomaly detector), never in the MFU
+                # numerator — same convention as tune/trainable.py.
+                perf_acct.annotate(
+                    record, exec_s, device=budget_device,
+                    observe_s=max(wall - compile_s, 1e-9),
+                )
                 checkpoint = None
                 if checkpoint_freq and (epoch + 1) % checkpoint_freq == 0:
                     with dispatch_lock():
@@ -747,6 +789,8 @@ def _train_sharded(
         return None
 
     # ---- epoch loop: host-driven so the scheduler can interrupt ------------
+    import time as _time
+
     for epoch in range(start_epoch, num_epochs):
         perm = epoch_perm(epoch)
         # Serialized across concurrent trial threads on fragile backends
@@ -788,12 +832,22 @@ def _train_sharded(
                 # runtime proof the buffer aliases took effect.
                 probes = [xb, yb] + jax.tree.leaves(params)[:1] \
                     + jax.tree.leaves(opt_state)[:1]
+            # Stamps AFTER staging (the slab transfer is input time, not
+            # epoch execute time) and INSIDE the hold — same MFU-clock
+            # discipline as tune/trainable.py's resident loop.
+            c0 = tracker.thread_seconds()
+            t0 = _time.monotonic()
             params, opt_state, batch_stats, train_loss = train_epoch(
                 params, opt_state, batch_stats, xb, yb, epoch_key
             )
             metrics = evaluate(params, batch_stats, xv, yv, mask)
             train_loss = float(train_loss)
             metrics = {k: float(v) for k, v in metrics.items()}
+            exec_s = max(
+                _time.monotonic() - t0
+                - (tracker.thread_seconds() - c0),
+                1e-9,
+            )
             if audit_donation:
                 audit_donation = False
                 consumed = sum(
@@ -813,6 +867,18 @@ def _train_sharded(
             "mesh_shape": dict(mesh_shape),
             **metrics,
         }
+        perf_acct.annotate(record, exec_s, device=budget_device)
+        if n_procs > 1 and bool(config.get("perf_gang_skew", True)):
+            # Per-gang-member skew: allgather each member's epoch wall
+            # and name a sustained straggler by PROCESS ID (counter +
+            # flight dump — perf/anomaly.py).  One small collective per
+            # epoch, device traffic, so it rides the dispatch hold.
+            with dispatch_lock():
+                stragglers = mh.check_gang_skew(exec_s, label="epoch")
+            if stragglers:
+                record["gang_stragglers"] = [
+                    int(p) for p, _ in stragglers
+                ]
         checkpoint = None
         if checkpoint_freq and (epoch + 1) % checkpoint_freq == 0:
             # Checkpoint readback is device traffic too — same hold

@@ -1,0 +1,43 @@
+# dmlint-scope: obs-metrics
+"""Historical risk pattern (ISSUE 15 satellite; the PR 8 ring-buffer
+postmortem as a rule): latency quantiles computed over a list that
+accumulates for the PROCESS LIFETIME.  A month-long soak both grows the
+list without bound and reports a p99 dominated by hours-old traffic —
+and the autoscaler keys scale-up off exactly that value."""
+
+import numpy as np
+
+WINDOW_HISTORY = []  # module-global: process-lifetime accumulator
+
+
+class LatencyTracker:
+    def __init__(self):
+        self.latencies_ms = []  # lifetime accumulator, never trimmed
+
+    def record(self, ms: float):
+        self.latencies_ms.append(ms)
+
+    def p99_ms(self) -> float:
+        if not self.latencies_ms:
+            return 0.0
+        return percentile(  # EXPECT: lifetime-quantile
+            sorted(self.latencies_ms), 99.0
+        )
+
+    def p50_ms(self) -> float:
+        return float(
+            np.percentile(self.latencies_ms, 50)  # EXPECT: lifetime-quantile
+        )
+
+
+def percentile(sorted_vals, q: float) -> float:
+    idx = min(int(len(sorted_vals) * q / 100.0), len(sorted_vals) - 1)
+    return float(sorted_vals[idx])
+
+
+def record_global(ms: float):
+    WINDOW_HISTORY.append(ms)
+
+
+def global_p99() -> float:
+    return float(np.percentile(WINDOW_HISTORY, 99))  # EXPECT: lifetime-quantile

@@ -1,0 +1,50 @@
+# dmlint-scope: obs-metrics
+"""Idiomatic twins of bad_lifetime_quantile.py: quantiles over BOUNDED
+windows (the serve/metrics.py latency-ring idiom) — memory capped by
+construction and the p99 reflects current traffic only."""
+
+from collections import deque
+
+import numpy as np
+
+
+class WindowedLatencyTracker:
+    """The house idiom: a deque(maxlen=...) ring is bounded by
+    construction, so its quantile is windowed by construction."""
+
+    def __init__(self, window: int = 512):
+        self.latencies_ms = deque(maxlen=window)
+
+    def record(self, ms: float):
+        self.latencies_ms.append(ms)
+
+    def p99_ms(self) -> float:
+        if not self.latencies_ms:
+            return 0.0
+        return float(np.percentile(list(self.latencies_ms), 99))
+
+
+class TrimmedTracker:
+    """A plain list that is explicitly re-trimmed on every record is
+    bounded too (the reassignment IS the bound)."""
+
+    def __init__(self):
+        self.latencies_ms = []
+
+    def record(self, ms: float):
+        self.latencies_ms.append(ms)
+        self.latencies_ms = self.latencies_ms[-512:]
+
+    def p99_ms(self) -> float:
+        if not self.latencies_ms:
+            return 0.0
+        return float(np.percentile(self.latencies_ms, 99))
+
+
+def batch_p99(batch_latencies_ms) -> float:
+    """Function-local accumulation dies with the call — per-batch
+    quantiles are not lifetime quantiles."""
+    vals = []
+    for ms in batch_latencies_ms:
+        vals.append(float(ms))
+    return float(np.percentile(vals, 99)) if vals else 0.0
