@@ -2473,6 +2473,81 @@ def child_serve_soak() -> None:
         "wall_s": round(soak_wall, 2),
     }
     srv.close()
+
+    # Quantized arm (ISSUE 16): the SAME architecture served as int8
+    # beside an f32 control.  Both arms get a clean fixed-replica server
+    # (no chaos, no autoscale) so rps-per-replica and p99 compare the
+    # PRECISION, not the fault schedule; each arm's ``comparability`` is
+    # keyed on precision so trend tooling never diffs across the
+    # f32/int8 boundary.
+    from distributed_machine_learning_tpu import quant
+
+    def _precision_arm(bundle):
+        arm_n = max(requests_n // 4, 24)
+        s2 = serve.PredictionServer(
+            bundle, port=0, num_replicas=2, max_batch_size=16,
+            max_bucket=16, batcher="continuous", max_queue=256,
+            request_timeout_s=30.0,
+        )
+        s2.warmup(x0)
+        h2, p2 = s2.start()
+        arm_url = f"http://{h2}:{p2}/predict"
+        arm_ok = [0]
+        arm_lock = threading.Lock()
+
+        def _req():
+            req = urllib.request.Request(
+                arm_url, data=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    resp.read()
+                with arm_lock:
+                    arm_ok[0] += 1
+            except Exception:  # noqa: BLE001 - arm is a measurement
+                pass
+
+        t_arm = time.time()
+        ths = []
+        for _ in range(arm_n):
+            th = threading.Thread(target=_req, daemon=True)
+            th.start()
+            ths.append(th)
+            time.sleep(1.0 / base_rps)
+        for th in ths:
+            th.join(timeout=60)
+        arm_wall = time.time() - t_arm
+        m2 = s2.handle_metrics()
+        replicas = max(m2["num_healthy"], 1)
+        arm = {
+            "precision": m2["precision"],
+            "requests": arm_n,
+            "ok": arm_ok[0],
+            "rps_per_replica": round(
+                arm_ok[0] / max(arm_wall, 1e-9) / replicas, 2
+            ),
+            "p99_ms": m2["latency_ms_p99"],
+            "new_programs_since_warmup":
+                m2["compile"]["new_programs_since_warmup"],
+            "comparability": f"cpu-{m2['precision']}",
+        }
+        if m2.get("quality_delta_mape") is not None:
+            arm["quality_delta_mape"] = round(m2["quality_delta_mape"], 6)
+        s2.close()
+        return arm
+
+    qvariables, _qstats = quant.quantize_variables(variables_b, "int8")
+    bundle_q = serve.ServableBundle(
+        config=dict(config), variables=qvariables,
+        manifest={"precision": "int8"}, path="soak://b-int8",
+    )
+    result["precision"] = "f32"
+    result["comparability"] = "cpu-f32"
+    result["precision_arms"] = {
+        "f32": _precision_arm(bundle_b),
+        "int8": _precision_arm(bundle_q),
+    }
     print(json.dumps(result))
 
 
@@ -2762,9 +2837,20 @@ def emit(value: float, vs_baseline, backend: str, extra: dict) -> None:
             {k: ss.get(k) for k in (
                 "achieved_rps", "p99_ms", "slo_met", "shed_rate",
                 "dropped", "post_swap_new_programs", "scale_ups",
-                "scale_downs",
+                "scale_downs", "precision",
             ) if ss.get(k) is not None}
         )
+        arms = ss.get("precision_arms")
+        if arms and "error" not in ss:
+            # One line per precision arm: throughput-per-replica + tail
+            # latency, tagged with the precision-keyed comparability
+            # class (an int8 number never trends against an f32 one).
+            compact["serve_soak"]["precision_arms"] = {
+                p: {k: a.get(k) for k in (
+                    "rps_per_replica", "p99_ms", "comparability",
+                ) if a.get(k) is not None}
+                for p, a in arms.items()
+            }
     st = extra.get("streaming")
     if st:
         compact["streaming"] = (

@@ -696,19 +696,34 @@ def _export_bundle(rest) -> None:
     p.add_argument("--mode", default=None, choices=("min", "max"))
     p.add_argument("--trial", default=None,
                    help="serve a specific trial instead of the best")
+    p.add_argument("--precision", default="f32",
+                   choices=("f32", "bf16", "int8"),
+                   help="stored weight dtype (quant/); bf16/int8 require "
+                        "--calibration")
+    p.add_argument("--calibration", default=None,
+                   help="path to a .npy calibration batch (n, features...) "
+                        "— quantized exports measure their quality delta "
+                        "on it")
     args = p.parse_args(rest)
 
     from distributed_machine_learning_tpu.serve import export_bundle
 
+    calibration = None
+    if args.calibration:
+        import numpy as np
+
+        calibration = np.load(args.calibration)
     try:
         out = export_bundle(
             args.experiment_dir, args.out_dir,
             metric=args.metric, mode=args.mode, trial_id=args.trial,
+            precision=args.precision, calibration_batch=calibration,
         )
     except (FileNotFoundError, ValueError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         raise SystemExit(1) from None
-    print(f"exported best trial of {args.experiment_dir} -> {out}")
+    note = f" [{args.precision}]" if args.precision != "f32" else ""
+    print(f"exported best trial of {args.experiment_dir} -> {out}{note}")
 
 
 def _serve(rest) -> None:
@@ -805,6 +820,10 @@ def _serve(rest) -> None:
     print(json.dumps({
         "serving": f"http://{host}:{port}",
         "model_family": bundle.model_family,
+        # Always printed (satellite of the quant/ PR): a mixed fleet's
+        # logs say which dtype each process answers in.
+        "precision": bundle.precision,
+        "quality_delta_mape": bundle.quality_delta_mape,
         "replicas": args.replicas,
         "batcher": args.batcher,
         "autoscale": (

@@ -2454,6 +2454,136 @@ class LifetimeQuantileRule(Rule):
         )
 
 
+# --------------------------------------------------------------------------
+# DML018 implicit-upcast-in-quantized-path
+# --------------------------------------------------------------------------
+
+
+# Files on the quantized serving path (quant/'s own modules and the engine
+# that compiles its programs); `# dmlint-scope: quant-path` opts others in.
+QUANT_PATH_PATTERNS = (
+    "quant/",
+    "serve/engine.py",
+)
+
+_F32_DTYPE_NAMES = {
+    "float32",
+    "jnp.float32",
+    "np.float32",
+    "numpy.float32",
+    "jax.numpy.float32",
+}
+
+# jnp/lax namespaces whose dtype= kwarg runs on device; plain np is
+# host-side bookkeeping and exempt.
+_JAX_NS_HEADS = {"jnp", "jax", "lax"}
+
+
+class ImplicitUpcastInQuantizedPathRule(Rule):
+    name = "implicit-upcast-in-quantized-path"
+    rule_id = "DML018"
+    severity = "error"
+    description = (
+        "an explicit float32 promotion (astype/asarray/convert_element_"
+        "type) on the quantized serving path OUTSIDE the designated "
+        "dequant helpers: the int8/bf16 program's whole point is that "
+        "weights and activations stay narrow until the one sanctioned "
+        "f32 cast on the way out (quant.dequantize_output) — a stray "
+        "upcast mid-graph silently re-inflates the memory traffic the "
+        "quantization paid for, and XLA will happily keep the rest of "
+        "the graph in f32 from that op on.  Enforced in quant/ and "
+        "serve/engine.py (QUANT_PATH_PATTERNS / `# dmlint-scope: "
+        "quant-path`); functions named `dequant*` are the exemption."
+    )
+    _HINT = (
+        "move the cast into a dequant*-named helper (quant/core.py's "
+        "dequantize_* family) if it is genuinely the dequantization "
+        "boundary — otherwise keep the op in the compute dtype "
+        "(bf16) and let dequantize_output do the one f32 cast"
+    )
+
+    def applies(self, ctx) -> bool:
+        if "quant-path" in ctx.scopes:
+            return True
+        rel = ctx.display_path.replace("\\", "/")
+        return any(pat in rel for pat in QUANT_PATH_PATTERNS)
+
+    @staticmethod
+    def _is_f32(node: Optional[ast.AST]) -> bool:
+        if node is None:
+            return False
+        if isinstance(node, ast.Constant):
+            return node.value == "float32"
+        return _dotted(node) in _F32_DTYPE_NAMES
+
+    @staticmethod
+    def _kwarg(node: ast.Call, *names: str) -> Optional[ast.AST]:
+        for kw in node.keywords:
+            if kw.arg in names:
+                return kw.value
+        return None
+
+    def check(self, ctx) -> Iterator[Finding]:
+        exempt: Set[int] = set()
+        for fn in ast.walk(ctx.tree):
+            if isinstance(
+                fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and fn.name.lstrip("_").startswith("dequant"):
+                exempt.update(id(n) for n in ast.walk(fn))
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or id(node) in exempt:
+                continue
+            # .astype(float32): receiver-agnostic — in scoped files every
+            # tensor on this path is meant to be narrow.
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype"
+            ):
+                dty = node.args[0] if node.args else self._kwarg(
+                    node, "dtype"
+                )
+                if self._is_f32(dty):
+                    yield self.finding(
+                        ctx, node,
+                        "float32 astype on the quantized path outside a "
+                        "dequant helper",
+                        self._HINT,
+                    )
+                continue
+            callee = _call_name(node) or ""
+            head = callee.split(".", 1)[0]
+            tail = callee.rsplit(".", 1)[-1]
+            if tail in ("asarray", "array", "full_like", "zeros_like",
+                        "ones_like") and head in _JAX_NS_HEADS:
+                if self._is_f32(self._kwarg(node, "dtype")):
+                    yield self.finding(
+                        ctx, node,
+                        f"{callee}(dtype=float32) materializes f32 on the "
+                        f"quantized path outside a dequant helper",
+                        self._HINT,
+                    )
+            elif tail == "convert_element_type":
+                dty = (
+                    node.args[1] if len(node.args) > 1
+                    else self._kwarg(node, "new_dtype", "dtype")
+                )
+                if self._is_f32(dty):
+                    yield self.finding(
+                        ctx, node,
+                        "lax.convert_element_type(..., float32) on the "
+                        "quantized path outside a dequant helper",
+                        self._HINT,
+                    )
+            elif callee in ("jnp.float32", "jax.numpy.float32") \
+                    and node.args:
+                yield self.finding(
+                    ctx, node,
+                    "jnp.float32(...) promotion on the quantized path "
+                    "outside a dequant helper",
+                    self._HINT,
+                )
+
+
 ALL_RULES: List[Rule] = [
     DonationAliasRule(),
     UnlockedDispatchRule(),
@@ -2472,6 +2602,7 @@ ALL_RULES: List[Rule] = [
     UseAfterDonationRule(),
     TransitiveChaosRule(),
     UnguardedSharedStateRule(),
+    ImplicitUpcastInQuantizedPathRule(),
 ]
 
 

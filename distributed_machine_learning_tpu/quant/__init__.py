@@ -1,0 +1,71 @@
+# dmlint-scope: quant-path
+"""Post-training quantization for the serving plane (ROADMAP item 1).
+
+``quant/`` turns a sweep winner's f32 bundle into a cheaper-to-serve
+sibling with *measured* quality evidence:
+
+* :mod:`~distributed_machine_learning_tpu.quant.core` — symmetric
+  per-channel int8 / bf16 weight quantization, plus the designated
+  ``dequant*`` helpers the compiled inference path calls (the only
+  sanctioned f32 upcasts per dmlint DML018);
+* :mod:`~distributed_machine_learning_tpu.quant.calibrate` — the
+  export-time calibration pass: activation ranges + quality delta (MAPE
+  vs the f32 parent on a held-out batch), recorded in the manifest;
+* :mod:`~distributed_machine_learning_tpu.quant.api` — quantize an
+  already-exported bundle (the fleet-migration entry point
+  ``examples/serve_quantized.py`` walks).
+
+See docs/performance.md "Quantized serving" for the promotion runbook.
+"""
+
+from distributed_machine_learning_tpu.quant.api import (
+    build_quant_block,
+    quantize_bundle,
+)
+from distributed_machine_learning_tpu.quant.calibrate import (
+    activation_ranges,
+    calibrate,
+    predict_f32,
+    predict_quantized,
+    quality_delta,
+)
+from distributed_machine_learning_tpu.quant.core import (
+    PRECISIONS,
+    cast_input,
+    check_precision,
+    dequantize_leaf,
+    dequantize_output,
+    dequantize_params,
+    dequantize_variables,
+    fake_quant_population,
+    fake_quant_tree,
+    quantizable,
+    quantize_leaf,
+    quantize_params,
+    quantize_variables,
+    tree_precision,
+)
+
+__all__ = [
+    "PRECISIONS",
+    "activation_ranges",
+    "build_quant_block",
+    "calibrate",
+    "cast_input",
+    "check_precision",
+    "dequantize_leaf",
+    "dequantize_output",
+    "dequantize_params",
+    "dequantize_variables",
+    "fake_quant_population",
+    "fake_quant_tree",
+    "predict_f32",
+    "predict_quantized",
+    "quality_delta",
+    "quantizable",
+    "quantize_bundle",
+    "quantize_leaf",
+    "quantize_params",
+    "quantize_variables",
+    "tree_precision",
+]

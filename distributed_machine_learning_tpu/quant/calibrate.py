@@ -1,0 +1,166 @@
+# dmlint-scope: quant-path
+"""Calibration: measure what quantization costs BEFORE promoting it.
+
+A quantized bundle's manifest must carry evidence, not faith — the
+promotion runbook reads ``quality_delta_mape`` off ``/metrics`` and
+decides from a number that was *measured at export time* on a held-out
+calibration batch:
+
+* f32 predictions and quantized predictions over the same batch ->
+  MAPE/MAE of the quantized answers against the f32 parent's (labels are
+  not required: the question is "does int8 change the answers", not "is
+  the model good" — the sweep already answered that);
+* per-layer activation ranges (max|activation| via flax intermediate
+  capture) — the saturation diagnostic: an activation whose range dwarfs
+  its weights' is where symmetric int8 clips first.
+
+Everything runs eagerly on host-sized batches; the calibration pass adds
+no compiled program to any cache.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from distributed_machine_learning_tpu.quant.core import (
+    cast_input,
+    check_precision,
+    dequantize_output,
+    dequantize_variables,
+)
+
+# Activation-range tables in the manifest are bounded like scale digests.
+_RANGE_SUMMARY_MAX = 32
+
+_MAPE_EPS = 1e-8
+
+
+def eval_flag(model) -> str:
+    """The model's eval-mode kwarg (``deterministic=True`` vs
+    ``train=False``) — the same signature probe ``serve.engine`` uses."""
+    import inspect
+
+    try:
+        params = inspect.signature(type(model).__call__).parameters
+    except (TypeError, ValueError):
+        params = {}
+    return "train" if (
+        "train" in params and "deterministic" not in params
+    ) else "deterministic"
+
+
+def _eval_kwargs(model) -> Dict[str, Any]:
+    flag = eval_flag(model)
+    return {flag: flag == "deterministic"}
+
+
+def predict_f32(model, variables, x) -> np.ndarray:
+    """Reference predictions with the unquantized variables."""
+    y = model.apply(variables, np.asarray(x), **_eval_kwargs(model))
+    return np.asarray(y)
+
+
+def predict_quantized(model, qvariables, x, precision: str) -> np.ndarray:
+    """Predictions through the SAME dequant-fused path the serving engine
+    compiles (storage tree -> bf16 compute -> f32 out), run eagerly."""
+    check_precision(precision)
+    fvars = dequantize_variables(qvariables, precision)
+    y = model.apply(
+        fvars, cast_input(np.asarray(x), precision), **_eval_kwargs(model)
+    )
+    return np.asarray(dequantize_output(y))
+
+
+def quality_delta(f32_pred, quant_pred) -> Dict[str, float]:
+    """MAPE/MAE of quantized predictions against the f32 parent's."""
+    f = np.asarray(f32_pred, dtype=np.float64).ravel()
+    q = np.asarray(quant_pred, dtype=np.float64).ravel()
+    if f.shape != q.shape:
+        raise ValueError(
+            f"prediction shapes diverge: f32 {f.shape} vs quant {q.shape}"
+        )
+    err = np.abs(q - f)
+    return {
+        "mape": float(np.mean(err / (np.abs(f) + _MAPE_EPS))),
+        "mae": float(np.mean(err)),
+        "max_abs_err": float(np.max(err)) if err.size else 0.0,
+    }
+
+
+def activation_ranges(model, variables, x) -> Dict[str, float]:
+    """Per-layer max|activation| over the calibration batch, bounded to
+    the first ``_RANGE_SUMMARY_MAX`` paths (module definition order).
+    Best-effort: a model family without intermediate capture support
+    yields an empty table, never a failed export."""
+    try:
+        _, state = model.apply(
+            variables,
+            np.asarray(x),
+            capture_intermediates=True,
+            mutable=["intermediates"],
+            **_eval_kwargs(model),
+        )
+    except Exception:  # noqa: BLE001 - diagnostics must not block export
+        return {}
+    ranges: Dict[str, float] = {}
+
+    def walk(node, path):
+        if len(ranges) >= _RANGE_SUMMARY_MAX:
+            return
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, path + (k,))
+            return
+        if isinstance(node, (tuple, list)):
+            for v in node:
+                walk(v, path)
+            return
+        arr = np.asarray(node)
+        if arr.size:
+            key = "/".join(p for p in path if p != "__call__") or "output"
+            ranges[key] = max(
+                ranges.get(key, 0.0), float(np.max(np.abs(arr)))
+            )
+
+    walk(dict(state.get("intermediates", {})), ())
+    return ranges
+
+
+def calibrate(
+    model,
+    f32_variables: Dict[str, Any],
+    qvariables: Dict[str, Any],
+    batch,
+    precision: str,
+) -> Dict[str, Any]:
+    """The manifest's ``calibration`` block: batch identity, activation
+    ranges, and the measured quality delta vs the f32 parent."""
+    check_precision(precision)
+    x = np.asarray(batch)
+    if x.ndim < 2 or x.shape[0] == 0:
+        raise ValueError(
+            f"calibration batch needs shape (n, features...), got {x.shape}"
+        )
+    f_pred = predict_f32(model, f32_variables, x)
+    q_pred = predict_quantized(model, qvariables, x, precision)
+    delta = quality_delta(f_pred, q_pred)
+    return {
+        "batch_size": int(x.shape[0]),
+        "batch_shape": list(x.shape),
+        "activation_ranges": activation_ranges(model, f32_variables, x),
+        "quality_delta_mape": delta["mape"],
+        "quality_delta_mae": delta["mae"],
+        "max_abs_err": delta["max_abs_err"],
+    }
+
+
+__all__ = [
+    "eval_flag",
+    "predict_f32",
+    "predict_quantized",
+    "quality_delta",
+    "activation_ranges",
+    "calibrate",
+]

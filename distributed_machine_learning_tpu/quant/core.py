@@ -1,0 +1,336 @@
+# dmlint-scope: quant-path
+"""Post-training weight quantization: symmetric per-channel int8 + bf16.
+
+The serving-economics lever the Gemma study (PAPERS.md) names: weights
+dominate a small model's memory traffic, so shrinking them 4x (int8) or
+2x (bf16) moves the inference program toward the bandwidth roof the perf
+observatory's ``roofline()`` measures.  Everything here is *post-training*
+— no quantization-aware training, no optimizer state — so it composes
+with any checkpoint ``tune`` already wrote.
+
+Quantization scheme (int8):
+
+* per-channel symmetric — one scale per output channel (the LAST axis of
+  a >=2-d weight), ``scale = max|w| / 127`` reduced over every other
+  axis; values round-to-nearest into ``[-127, 127]`` (the -128 code is
+  unused so the grid is symmetric around zero);
+* sub-2-d leaves (biases, layer-norm gains, scalars) stay f32 — they are
+  a rounding error of the byte budget and the cheapest accuracy insurance
+  there is;
+* scales ride next to the weights in the bundle's msgpack under the
+  ``quant_scales`` collection, mirroring the params tree structure for
+  the quantized leaves only.
+
+Dequantization happens INSIDE the jitted inference program (XLA fuses the
+int8->bf16 cast + scale multiply into the consuming matmul), with bf16
+accumulation and one f32 cast on the way out.  Every float32-promoting
+cast in the quantized path lives in a ``dequant*``-named helper below —
+the designated sites dmlint's DML018 (implicit-upcast-in-quantized-path)
+exempts; an f32 upcast anywhere else in ``quant/`` or ``serve/engine.py``
+silently re-inflates the memory traffic the quantization paid for.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+PRECISIONS = ("f32", "bf16", "int8")
+
+# Symmetric int8 grid: [-127, 127], -128 unused.
+_QMAX = 127.0
+
+# Per-leaf scale summaries in the manifest are bounded — a transformer has
+# hundreds of leaves and the manifest must stay human-readable.
+_SCALE_SUMMARY_MAX = 16
+
+
+def check_precision(precision: str) -> str:
+    if precision not in PRECISIONS:
+        raise ValueError(
+            f"precision must be one of {PRECISIONS}, got {precision!r}"
+        )
+    return precision
+
+
+def _bf16_dtype():
+    import jax.numpy as jnp
+
+    return jnp.bfloat16
+
+
+def quantize_leaf(w: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """One >=2-d weight -> ``(q_int8, scale_f32)`` with a per-out-channel
+    scale (reduced over all axes but the last, keepdims so the dequant
+    multiply broadcasts with no reshape)."""
+    w = np.asarray(w)
+    axes = tuple(range(w.ndim - 1))
+    amax = np.max(np.abs(w), axis=axes, keepdims=True)
+    scale = np.where(amax > 0, amax, 1.0) / _QMAX
+    scale = np.asarray(scale, dtype=w.dtype)
+    q = np.clip(np.rint(w / scale), -_QMAX, _QMAX).astype(np.int8)
+    return q, scale
+
+
+def quantizable(leaf: Any) -> bool:
+    """int8 targets: >=2-d floating leaves (matmul weights / embeddings)."""
+    arr = np.asarray(leaf)
+    return arr.ndim >= 2 and np.issubdtype(arr.dtype, np.floating)
+
+
+def quantize_params(
+    params: Dict[str, Any], precision: str
+) -> Tuple[Dict[str, Any], Dict[str, Any], Dict[str, Any]]:
+    """Quantize a (host) params tree -> ``(qparams, scales, stats)``.
+
+    ``scales`` mirrors the tree structure for quantized leaves only (int8;
+    empty for bf16 — a straight cast has no side table).  ``stats`` is the
+    manifest-ready summary: leaf counts, byte budgets, and a bounded
+    per-leaf scale digest.
+    """
+    check_precision(precision)
+    stats: Dict[str, Any] = {
+        "method": (
+            "symmetric-per-channel" if precision == "int8" else "cast"
+        ),
+        "quantized_leaves": 0,
+        "total_leaves": 0,
+        "bytes_f32": 0,
+        "bytes_quant": 0,
+        "scales": {},
+    }
+    if precision == "f32":
+        return params, {}, stats
+
+    def walk(node, path):
+        if isinstance(node, Mapping):
+            q, s = {}, {}
+            for k, v in node.items():
+                qk, sk = walk(v, path + (k,))
+                q[k] = qk
+                if sk is not None:
+                    s[k] = sk
+            return q, (s or None)
+        leaf = np.asarray(node)
+        stats["total_leaves"] += 1
+        stats["bytes_f32"] += int(leaf.nbytes)
+        if precision == "bf16":
+            if np.issubdtype(leaf.dtype, np.floating):
+                out = leaf.astype(_bf16_dtype())
+                stats["quantized_leaves"] += 1
+                stats["bytes_quant"] += int(out.nbytes)
+                return out, None
+            stats["bytes_quant"] += int(leaf.nbytes)
+            return leaf, None
+        if not quantizable(leaf):
+            stats["bytes_quant"] += int(leaf.nbytes)
+            return leaf, None
+        q, scale = quantize_leaf(leaf)
+        stats["quantized_leaves"] += 1
+        stats["bytes_quant"] += int(q.nbytes) + int(scale.nbytes)
+        if len(stats["scales"]) < _SCALE_SUMMARY_MAX:
+            stats["scales"]["/".join(path)] = {
+                "shape": list(leaf.shape),
+                "scale_min": float(scale.min()),
+                "scale_max": float(scale.max()),
+                "scale_mean": float(scale.mean()),
+            }
+        return q, scale
+
+    qparams, scales = walk(params, ())
+    if stats["bytes_f32"]:
+        stats["compression"] = round(
+            stats["bytes_f32"] / max(stats["bytes_quant"], 1), 3
+        )
+    return qparams, (scales or {}), stats
+
+
+def quantize_variables(
+    variables: Dict[str, Any], precision: str
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Quantize a full variables dict ``{"params": .., ["batch_stats": ..]}``
+    -> ``(qvariables, stats)``.
+
+    The quantized tree gains a ``quant_scales`` collection (int8 only);
+    ``batch_stats`` stay f32 — they are tiny running moments, and norm
+    statistics are exactly where rounding hurts most.
+    """
+    check_precision(precision)
+    qparams, scales, stats = quantize_params(variables["params"], precision)
+    out = dict(variables)
+    out["params"] = qparams
+    if scales:
+        out["quant_scales"] = scales
+    return out, stats
+
+
+# -- designated dequant sites (DML018 exemption by name) ---------------------
+
+
+def dequantize_leaf(q, scale):
+    """int8 codes * per-channel scale -> bf16 weight (fused into the
+    consuming matmul by XLA; bf16 is the accumulation dtype)."""
+    import jax.numpy as jnp
+
+    return q.astype(jnp.bfloat16) * jnp.asarray(scale).astype(jnp.bfloat16)
+
+
+def dequantize_params(params, scales):
+    """Rebuild a compute-ready (bf16) params tree from quantized leaves;
+    unquantized leaves downcast to the same compute dtype."""
+    import jax.numpy as jnp
+
+    def walk(node, snode):
+        if isinstance(node, Mapping):
+            return {
+                k: walk(v, (snode or {}).get(k)) for k, v in node.items()
+            }
+        if str(getattr(node, "dtype", "")) == "int8":
+            if snode is None:
+                raise ValueError(
+                    "int8 leaf with no matching entry in quant_scales — "
+                    "bundle params and scales are out of sync"
+                )
+            return dequantize_leaf(node, snode)
+        return node.astype(jnp.bfloat16)
+
+    return walk(params, scales)
+
+
+def dequantize_variables(variables, precision: str):
+    """The single entry the inference program calls: quantized storage
+    tree -> compute-dtype variables (``quant_scales`` consumed, not
+    forwarded to ``model.apply``)."""
+    import jax.numpy as jnp
+
+    check_precision(precision)
+    if precision == "f32":
+        return {k: v for k, v in variables.items() if k != "quant_scales"}
+    out = {
+        "params": dequantize_params(
+            variables["params"], variables.get("quant_scales") or {}
+        )
+    }
+    for coll, tree in variables.items():
+        if coll in ("params", "quant_scales"):
+            continue
+        # Running statistics (batch_stats) join the compute dtype so the
+        # normalization arithmetic stays in one precision.
+        out[coll] = _tree_astype(tree, jnp.bfloat16)
+    return out
+
+
+def dequantize_output(y):
+    """The one sanctioned f32 upcast on the serving path: bf16 program
+    output -> f32 answer for the client."""
+    import jax.numpy as jnp
+
+    return y.astype(jnp.float32)
+
+
+def cast_input(x, precision: str):
+    """Inputs join the compute dtype (bf16) for quantized programs — a
+    downcast, so it lives outside the dequant exemption on purpose."""
+    import jax.numpy as jnp
+
+    if precision == "f32":
+        return x
+    return x.astype(jnp.bfloat16)
+
+
+def _tree_astype(tree, dtype):
+    if isinstance(tree, Mapping):
+        return {k: _tree_astype(v, dtype) for k, v in tree.items()}
+    return tree.astype(dtype)
+
+
+# -- fake-quant (quantize -> dequantize round trip, f32 in / f32 out) --------
+
+
+def fake_quant_tree(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Host-side int8 round trip of a single-model params tree: the f32
+    weights a model would effectively serve with after int8 export.
+    Dtypes are unchanged (f32 in, f32 out), so evaluating with the result
+    reuses the caller's already-compiled eval program."""
+
+    def walk(node):
+        if isinstance(node, Mapping):
+            return {k: walk(v) for k, v in node.items()}
+        leaf = np.asarray(node)
+        if not quantizable(leaf):
+            return leaf
+        q, scale = quantize_leaf(leaf)
+        return (q.astype(leaf.dtype) * scale).astype(leaf.dtype)
+
+    return walk(params)
+
+
+def fake_quant_population(params: Dict[str, Any]) -> Dict[str, Any]:
+    """``fake_quant_tree`` for population-stacked trees (leading axis =
+    population row): per-(row, out-channel) scales, so each row is
+    quantized exactly as its own int8 export would be.  Used by the PBT
+    ``quality_after_quant`` objective."""
+
+    def walk(node):
+        if isinstance(node, Mapping):
+            return {k: walk(v) for k, v in node.items()}
+        leaf = np.asarray(node)
+        # Row axis + a >=2-d weight => ndim >= 3; row-wise biases stay f32.
+        if leaf.ndim < 3 or not np.issubdtype(leaf.dtype, np.floating):
+            return leaf
+        axes = tuple(range(1, leaf.ndim - 1))
+        amax = np.max(np.abs(leaf), axis=axes, keepdims=True)
+        scale = np.asarray(
+            np.where(amax > 0, amax, 1.0) / _QMAX, dtype=leaf.dtype
+        )
+        q = np.clip(np.rint(leaf / scale), -_QMAX, _QMAX)
+        return (q * scale).astype(leaf.dtype)
+
+    return walk(params)
+
+
+def tree_precision(variables: Dict[str, Any]) -> str:
+    """Infer the storage precision of a loaded variables tree (the
+    manifest is authoritative; this is the cross-check)."""
+    dtypes = set()
+
+    def walk(node):
+        if isinstance(node, Mapping):
+            for v in node.values():
+                walk(v)
+            return
+        dtypes.add(str(np.asarray(node).dtype))
+
+    walk(variables.get("params", {}))
+    if "int8" in dtypes:
+        return "int8"
+    if "bfloat16" in dtypes:
+        return "bf16"
+    return "f32"
+
+
+def leaf_count(tree: Any) -> int:
+    if isinstance(tree, Mapping):
+        return sum(leaf_count(v) for v in tree.values())
+    return 1
+
+
+__all__ = [
+    "PRECISIONS",
+    "check_precision",
+    "quantize_leaf",
+    "quantize_params",
+    "quantize_variables",
+    "quantizable",
+    "dequantize_leaf",
+    "dequantize_params",
+    "dequantize_variables",
+    "dequantize_output",
+    "cast_input",
+    "fake_quant_tree",
+    "fake_quant_population",
+    "tree_precision",
+    "leaf_count",
+]

@@ -73,6 +73,11 @@ class InferenceEngine:
         self.bundle = bundle
         self.model = bundle.build_model()
         self._variables = bundle.variables
+        # Storage precision from the manifest (quant/): selects the
+        # dequant-fused apply path and splits program identity, so an f32
+        # and an int8 replica of the same architecture never share (or
+        # clobber) a compiled program.
+        self._precision = getattr(bundle, "precision", "f32")
         self._device = device
         self._buckets = tuple(sorted(set(buckets or bucket_sizes(max_bucket))))
         self._flag_name: Optional[str] = None
@@ -123,8 +128,30 @@ class InferenceEngine:
 
     # -- programs ------------------------------------------------------------
 
+    @property
+    def precision(self) -> str:
+        return self._precision
+
     def _apply_fn(self):
         model, flag = self.model, self._eval_flag()
+        precision = self._precision
+
+        if precision != "f32":
+            from distributed_machine_learning_tpu import quant as _quant
+
+            # Quantized path: weights dequantize INSIDE the program (XLA
+            # fuses int8->bf16 + scale into the consuming matmul), inputs
+            # join the bf16 compute dtype, and the one f32 upcast on the
+            # way out is quant's designated dequant helper (DML018).
+            def apply(variables, x):
+                kwargs = {flag: flag == "deterministic"}
+                fvars = _quant.dequantize_variables(variables, precision)
+                out = model.apply(
+                    fvars, _quant.cast_input(x, precision), **kwargs
+                )
+                return _quant.dequantize_output(out)
+
+            return apply
 
         def apply(variables, x):
             kwargs = {flag: flag == "deterministic"}
@@ -152,6 +179,10 @@ class InferenceEngine:
                 dtype=dtype,
                 extra={
                     "serve": 1,
+                    # Storage precision is program identity: the int8
+                    # program embeds dequant ops and bf16 accumulation the
+                    # f32 program does not, at identical input shapes.
+                    "precision": self._precision,
                     # AOT executables embed their device assignment; a
                     # deserialized program silently runs THERE, so the
                     # device is program identity (a restarted replica of
@@ -176,6 +207,7 @@ class InferenceEngine:
         """Compile counters for /metrics and the zero-recompile check."""
         with self._lock:
             stats = {
+                "precision": self._precision,
                 "programs": len(self._programs),
                 "program_hits": self._program_hits,
                 "backend_compile_s": round(

@@ -54,6 +54,22 @@ class ServableBundle:
         return self.config.get("model", "transformer")
 
     @property
+    def precision(self) -> str:
+        """Storage precision of the params tree — always recorded by
+        export (f32 included), so a mixed fleet is diagnosable from
+        manifests alone.  Pre-precision manifests read as f32 (the only
+        precision those exports could write)."""
+        return str(self.manifest.get("precision", "f32"))
+
+    @property
+    def quality_delta_mape(self) -> Optional[float]:
+        """Calibration-measured MAPE of quantized predictions vs the f32
+        parent (None for unquantized bundles)."""
+        quant = self.manifest.get("quant") or {}
+        delta = quant.get("quality_delta_mape")
+        return None if delta is None else float(delta)
+
+    @property
     def feature_names(self) -> List[str]:
         return list((self.manifest.get("features") or {}).get("names", []))
 
@@ -79,6 +95,8 @@ def export_bundle(
     mode: Optional[str] = None,
     trial_id: Optional[str] = None,
     feature_schema: str = "canonical",
+    precision: str = "f32",
+    calibration_batch=None,
 ) -> str:
     """Resolve the best trial of ``source`` and write a servable bundle.
 
@@ -88,7 +106,16 @@ def export_bundle(
     to the objective recorded in ``experiment_state.json``.  ``trial_id``
     overrides best-trial selection (serve a specific trial).  Returns
     ``out_dir``.
+
+    ``precision`` selects the stored weight dtype (``"f32"``, ``"bf16"``,
+    ``"int8"`` — ``quant/``); quantized exports require a
+    ``calibration_batch`` (an ``(n, features...)`` array) and record the
+    measured quality delta vs the f32 weights in the manifest's ``quant``
+    block.  The manifest ALWAYS records ``precision``, f32 included.
     """
+    from distributed_machine_learning_tpu.quant import check_precision
+
+    check_precision(precision)
     if isinstance(source, ExperimentAnalysis):
         analysis = source
     else:
@@ -148,6 +175,9 @@ def export_bundle(
         "metric": analysis.metric,
         "mode": analysis.mode,
         "best_score": score,
+        # Always present (f32 included): the manifest is the precision
+        # contract a mixed fleet diagnoses from.
+        "precision": precision,
         "features": _feature_block(feature_schema),
         "source": {
             "experiment": analysis.root,
@@ -159,7 +189,29 @@ def export_bundle(
             "checkpoint_load_s": round(ckpt_load_s, 4),
         },
     }
+    if precision != "f32":
+        from distributed_machine_learning_tpu.models import build_model
+        from distributed_machine_learning_tpu.quant import build_quant_block
 
+        quant_block = build_quant_block(
+            build_model(_servable_config(trial.config)),
+            variables,
+            precision,
+            calibration_batch,
+        )
+        variables = quant_block.pop("_variables")
+        manifest["quant"] = quant_block
+
+    write_bundle(out_dir, manifest, variables)
+    return out_dir
+
+
+def write_bundle(
+    out_dir: str, manifest: Dict[str, Any], variables: Dict[str, Any]
+) -> str:
+    """Write a manifest + params pair (the bundle layout) to ``out_dir``
+    on any storage scheme — shared by ``export_bundle`` and
+    ``quant.quantize_bundle``."""
     backend, out = get_storage(out_dir)
     backend.write_bytes(
         backend.join(out, MANIFEST_NAME),
@@ -167,7 +219,7 @@ def export_bundle(
     )
     # Same writer as training checkpoints: identical msgpack bytes in and
     # out, so a served prediction is bit-identical to one made from the
-    # original checkpoint.
+    # original checkpoint (and int8/bf16 leaves round-trip dtype-exact).
     ckpt_lib.save_checkpoint(backend.join(out, PARAMS_NAME), variables)
     return out_dir
 

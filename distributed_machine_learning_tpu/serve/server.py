@@ -137,6 +137,10 @@ class PredictionServer:
             "replicas": health,
             "restarts": self.replicas.restarts,
             "model_family": self.bundle.model_family,
+            # The replica set owns the live bundle pointer — a hot swap
+            # driven through it directly (not /admin/swap) must still
+            # flip the reported precision.
+            "precision": getattr(self.replicas.bundle, "precision", "f32"),
         }
 
     def handle_swap(self, body: Dict[str, Any]) -> Dict[str, Any]:
@@ -187,6 +191,16 @@ class PredictionServer:
             # time): the serving-side half of the ckpt/ wall-time story.
             "checkpoint_load_s": round(
                 getattr(self.bundle, "checkpoint_load_s", 0.0), 4
+            ),
+            # Precision contract (quant/): what dtype this fleet answers
+            # in and what it cost (calibration MAPE vs the f32 parent,
+            # None for unquantized bundles).  Read off the replica set's
+            # LIVE bundle pointer, so a hot swap flips it no matter who
+            # drove the swap; per-replica precision rides
+            # compile.per_replica — mid-swap mixed fleets show there.
+            "precision": getattr(self.replicas.bundle, "precision", "f32"),
+            "quality_delta_mape": getattr(
+                self.replicas.bundle, "quality_delta_mape", None
             ),
         }
         if self._fault_plan is not None:

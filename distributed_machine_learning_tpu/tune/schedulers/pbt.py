@@ -51,6 +51,15 @@ _OBJECTIVE_WEIGHTS = {
     "quality": (0.0, 0.0),
     "quality_latency": (1.0, 0.0),
     "quality_latency_params": (1.0, 1.0),
+    # Post-quantization selection (quant/): weights stay (0, 0) — the
+    # scalarization factor is a frozen per-population constant (bit-parity
+    # contract with the compiled generation step), so int8 scoring cannot
+    # ride it in-generation.  Instead the vectorized driver fake-quantizes
+    # every surviving row at sweep end and emits its int8 validation MAPE
+    # as a final ``pbt_objective`` record — selection (best trial, export)
+    # then prefers the model that SURVIVES int8, not the one that merely
+    # wins at f32.
+    "quality_after_quant": (0.0, 0.0),
 }
 
 
@@ -101,6 +110,9 @@ class PopulationBasedTraining(TrialScheduler):
         self.factors = perturbation_factors
         self.seed = seed
         self.objective, self.objective_weights = _parse_objective(objective)
+        # quality_after_quant: in-generation ranking is pure quality; the
+        # driver adds the post-quantization scoring pass at sweep end.
+        self.quant_aware = self.objective == "quality_after_quant"
         # trial_id -> [(iteration, score), ...] in report order (lower=better)
         self._history: Dict[str, list] = {}
         self._num_perturbations = 0

@@ -2494,6 +2494,53 @@ def _run_population(
         ):
             save_population(epoch0)
 
+    # ---- quality_after_quant: post-quantization final scoring --------------
+    # The PBT generations ranked on pure quality (the scalarization factor
+    # is a frozen constant — bit-parity contract); what the SWEEP selects
+    # on is measured here instead: every surviving row is int8
+    # fake-quantized host-side (per-row, per-channel scales — exactly what
+    # its own export would write) and re-scored on the validation split
+    # through the already-compiled population eval (same shapes/dtypes, so
+    # zero new programs).  One final record per live trial carries the
+    # int8 validation MAPE as ``pbt_objective`` + ``quant_mape`` —
+    # ``ExperimentAnalysis(metric="pbt_objective")`` then picks the winner
+    # that survives quantization.
+    if pbt is not None and getattr(pbt, "quant_aware", False):
+        from distributed_machine_learning_tpu.quant import (
+            fake_quant_population,
+        )
+
+        q_metrics = {
+            k: np.asarray(v)
+            for k, v in program.eval_population(
+                jax.tree.map(
+                    jnp.asarray,
+                    fake_quant_population(jax.tree.map(np.asarray, params)),
+                ),
+                batch_stats, data.x_val, data.y_val, data.val_mask,
+            ).items()
+        }
+        pbt_counters["quant_evals"] = pbt_counters.get("quant_evals", 0) + 1
+        q_now = time.time()
+        for i, r in enumerate(rows):
+            if r < 0 or not active[r]:
+                continue
+            trial = batch[r]
+            q_mape = float(q_metrics["validation_mape"][i])
+            record = {
+                "epoch": epoch0 - 1,
+                "training_iteration": trial.reports_since_restart,
+                "trial_id": trial.trial_id,
+                "timestamp": q_now,
+                "time_total_s": q_now - trial.started_at,
+                "quant_precision": "int8",
+                "quant_mape": q_mape,
+                "pbt_objective": q_mape,
+            }
+            trial.results.append(record)
+            store.append_result(trial, record)
+            safe_cb("on_trial_result", trial, record)
+
     now = time.time()
     for i, trial in enumerate(batch):
         if active[i]:

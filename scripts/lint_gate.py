@@ -58,6 +58,8 @@ def main(argv=None) -> int:
                    help="skip the program-level (jaxlint) tier")
     p.add_argument("--no-perf-guard", action="store_true",
                    help="skip the obs disabled-path overhead guard")
+    p.add_argument("--no-quant-smoke", action="store_true",
+                   help="skip the quantize-export-load smoke")
     args = p.parse_args(argv)
 
     cmd = [sys.executable, "-m", "distributed_machine_learning_tpu",
@@ -100,6 +102,10 @@ def main(argv=None) -> int:
         rc = _obs_perf_guard(env)
         if rc:
             return rc
+    if proc.returncode == 0 and not args.no_quant_smoke:
+        rc = _quant_smoke(env)
+        if rc:
+            return rc
     return proc.returncode
 
 
@@ -140,6 +146,54 @@ def _obs_perf_guard(env) -> int:
         f"{'ok' if ok else 'REGRESSED'}"
     )
     return 0 if ok else 1
+
+
+def _quant_smoke(env) -> int:
+    """Quantize-export-load roundtrip in a child (JAX_PLATFORMS=cpu): a
+    tiny mlp quantizes to int8, writes a bundle, loads it back, and the
+    served predictions stay within the calibrated delta — the quant/
+    manifest contract, gated like a lint finding."""
+    code = (
+        "import json, tempfile\n"
+        "import jax, numpy as np\n"
+        "from distributed_machine_learning_tpu import quant, serve\n"
+        "from distributed_machine_learning_tpu.models import build_model\n"
+        "from distributed_machine_learning_tpu.serve import export as ex\n"
+        "config = {'model': 'mlp', 'hidden_sizes': [8]}\n"
+        "model = build_model(config)\n"
+        "x = np.random.default_rng(0).normal(\n"
+        "    size=(8, 6, 4)).astype(np.float32)\n"
+        "variables = model.init(jax.random.PRNGKey(0), x,\n"
+        "                       deterministic=True)\n"
+        "block = quant.build_quant_block(model, variables, 'int8', x)\n"
+        "qvars = block.pop('_variables')\n"
+        "out = tempfile.mkdtemp(prefix='quant_smoke_')\n"
+        "ex.write_bundle(out, {'bundle_version': ex.BUNDLE_VERSION,\n"
+        "                      'config': config, 'precision': 'int8',\n"
+        "                      'quant': block}, qvars)\n"
+        "bundle = serve.load_bundle(out)\n"
+        "assert bundle.precision == 'int8'\n"
+        "eng = serve.InferenceEngine(bundle, max_bucket=8,\n"
+        "                            persistent_cache=False)\n"
+        "q = eng.predict(x)\n"
+        "f = np.asarray(model.apply(variables, x, deterministic=True))\n"
+        "mape = float(np.mean(np.abs(q - f) / (np.abs(f) + 1e-8)))\n"
+        "delta = bundle.quality_delta_mape\n"
+        "assert mape <= delta * 1.5 + 1e-3, (mape, delta)\n"
+        "print(json.dumps({'quality_delta_mape': round(delta, 6),\n"
+        "                  'served_mape': round(mape, 6),\n"
+        "                  'compression': block.get('compression')}))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO, capture_output=True, text=True, env=env, timeout=300,
+    )
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr)
+        print("quant smoke: FAILED")
+        return 1
+    print(f"quant smoke: ok {proc.stdout.strip().splitlines()[-1]}")
+    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,37 @@
+# dmlint-scope: quant-path
+"""Historical risk pattern (ISSUE 16 satellite): stray float32 promotions
+on the quantized serving path.  The int8 program's economics live and die
+on staying narrow — one `.astype(jnp.float32)` mid-graph and XLA keeps
+everything downstream in f32, silently re-inflating the memory traffic
+the quantization paid for while the manifest still says "int8"."""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def apply_quantized(variables, x):
+    w = variables["params"]["kernel"]
+    # Upcasting the weights before the matmul defeats the dequant fusion.
+    wf = w.astype(jnp.float32)  # EXPECT: implicit-upcast-in-quantized-path
+    return x @ wf
+
+
+def scale_activations(h, gain):
+    hf = h.astype("float32")  # EXPECT: implicit-upcast-in-quantized-path
+    return hf * gain
+
+
+def materialize_f32(scores):
+    return jnp.asarray(  # EXPECT: implicit-upcast-in-quantized-path
+        scores, dtype=jnp.float32
+    )
+
+
+def widen(codes):
+    return lax.convert_element_type(  # EXPECT: implicit-upcast-in-quantized-path
+        codes, jnp.float32
+    )
+
+
+def promote_scalar_style(q):
+    return jnp.float32(q)  # EXPECT: implicit-upcast-in-quantized-path

@@ -1,0 +1,39 @@
+# dmlint-scope: quant-path
+"""Idiomatic twins of bad_implicit_upcast_in_quantized_path.py: narrow
+compute throughout, with the only f32 promotions living inside the
+designated ``dequant*`` helpers (quant/core.py's family) — exactly the
+boundary DML018 sanctions."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def dequantize_weights(q, scale):
+    """The designated dequant site: int8 codes -> bf16 compute dtype."""
+    return q.astype(jnp.bfloat16) * scale.astype(jnp.bfloat16)
+
+
+def dequantize_output(y):
+    """The one sanctioned f32 upcast: program output -> client answer."""
+    return y.astype(jnp.float32)
+
+
+def apply_quantized(variables, x):
+    w = dequantize_weights(
+        variables["params"]["kernel"], variables["quant_scales"]["kernel"]
+    )
+    # Inputs DOWNCAST to the compute dtype — narrowing is always fine.
+    h = x.astype(jnp.bfloat16) @ w
+    return dequantize_output(h)
+
+
+def host_side_bookkeeping(scales):
+    # Plain numpy is host bookkeeping (manifest digests), not the compiled
+    # path — np dtype= stays exempt.
+    table = np.asarray(scales, dtype=np.float64)
+    return float(table.mean())
+
+
+def stay_narrow(codes):
+    # Width changes that do NOT promote to f32 are untouched.
+    return jnp.asarray(codes, dtype=jnp.bfloat16)

@@ -66,7 +66,14 @@ _SOAK_STUB = {
     "slo_ms": 500.0, "slo_met": True, "replica_kills": 1,
     "hot_swap_signals": 1, "swap_landed": True, "swaps_total": 1,
     "post_swap_new_programs": 0, "scale_ups": 1, "scale_downs": 1,
-    "wall_s": 5.0,
+    "wall_s": 5.0, "precision": "f32", "comparability": "cpu-f32",
+    "precision_arms": {
+        "f32": {"precision": "f32", "rps_per_replica": 25.0, "p99_ms": 1.2,
+                "new_programs_since_warmup": 0, "comparability": "cpu-f32"},
+        "int8": {"precision": "int8", "rps_per_replica": 24.0, "p99_ms": 1.4,
+                 "new_programs_since_warmup": 0,
+                 "comparability": "cpu-int8"},
+    },
 }
 
 
@@ -372,6 +379,12 @@ def test_main_cpu_fallback_emit_fields(monkeypatch, capsys):
     assert detail["serve_soak"]["slo_met"] is True
     assert detail["serve_soak"]["dropped"] == 0
     assert line["serve_soak"]["post_swap_new_programs"] == 0
+    # ISSUE 16: the precision arms ride in the compact line too, each
+    # tagged with its precision-keyed comparability class.
+    assert line["serve_soak"]["precision"] == "f32"
+    arms = line["serve_soak"]["precision_arms"]
+    assert arms["int8"]["comparability"] == "cpu-int8"
+    assert arms["f32"]["rps_per_replica"] == 25.0
     assert "serve_soak_s" in detail["phases"]
     # streaming section: acceptance ratio + overlap counters in the
     # artifact, compact slice in the emitted line.
@@ -737,6 +750,19 @@ def test_child_serve_soak_end_to_end_tiny(monkeypatch, capsys):
     assert out["p99_ms"] >= out["p50_ms"] > 0
     assert out["achieved_rps"] > 0
     assert out["trajectory"], "replica-count trajectory must be recorded"
+    # ISSUE 16: precision arms ride beside the soak — f32 and int8 of the
+    # same architecture on identical clean servers, each number tagged
+    # with a precision-keyed comparability class.
+    assert out["precision"] == "f32"
+    assert out["comparability"] == "cpu-f32"
+    arms = out["precision_arms"]
+    assert set(arms) == {"f32", "int8"}
+    for p, arm in arms.items():
+        assert arm["precision"] == p
+        assert arm["comparability"] == f"cpu-{p}"
+        assert arm["rps_per_replica"] > 0
+        assert arm["p99_ms"] > 0
+        assert arm["new_programs_since_warmup"] == 0
 
 
 def test_child_flagship_tiny_shapes(monkeypatch, capsys):
