@@ -38,6 +38,7 @@ import os
 import signal
 import subprocess
 import sys
+import tempfile
 import time
 
 _REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
@@ -2684,6 +2685,139 @@ def child_streaming() -> None:
     print(json.dumps(result))
 
 
+# Child: self-healing loop time-to-recover (ISSUE 17 online_loop section)
+
+
+def child_online_loop() -> None:
+    """The self-healing loop end to end, timed: serve an incumbent, shift
+    the world mid-stream, and measure how long the loop takes to notice
+    (detect_s: first drifted request -> debounced trigger) and to heal
+    (heal_s: trigger -> journaled retrain episode lands ``promoted``).
+
+    Emits ONE JSON line whose claims are counter-verified from /metrics
+    and the loop snapshot: served MAPE before/during/after, requests
+    dropped (must be 0 — detection and promotion both ride the live
+    serving path), and serving-path compiles after warmup (must be 0 —
+    the retrained candidate shares the incumbent's program class and the
+    swap warms through the AOT caches off-path)."""
+    import urllib.request
+
+    import numpy as np
+
+    from distributed_machine_learning_tpu import chaos, loop, serve
+    from distributed_machine_learning_tpu.models import build_model
+    from distributed_machine_learning_tpu.serve import export as serve_export
+    from distributed_machine_learning_tpu.tune._regression_program import (
+        detect_call_convention,
+    )
+
+    t0 = time.time()
+    seq, feat = 4, 3
+    w = np.array([0.7, -0.4, 1.1], np.float32)
+    drift_spec = {"at_request": 0, "feature_shift": 2.5,
+                  "label_shift": 0.5, "seed": 11}
+    config = {"model": "mlp", "hidden_sizes": [8], "seed": 3}
+
+    def make_xy(n, seed, drifted=False):
+        r = np.random.default_rng(seed)
+        x = r.standard_normal((n, seq, feat)).astype(np.float32)
+        y = (x[:, -2:, :] @ w).mean(axis=1, keepdims=True)
+        if drifted:
+            x, y = chaos.apply_drift(drift_spec, x, y)
+        return x.astype(np.float32), y.astype(np.float32)
+
+    def data_fn(kind):
+        seeds = {"train": 100, "holdout": 200, "probation": 300}
+        return make_xy(48, seeds[kind], drifted=True)
+
+    x, y = make_xy(64, 1)
+    probe, _ = detect_call_convention(build_model(config), x[:1])
+    variables, _ = loop.fine_tune(
+        config, {"params": probe["params"]}, x, y,
+        epochs=6, learning_rate=0.05, seed=0,
+    )
+    root = tempfile.mkdtemp(prefix="bench_loop_")
+    inc_dir = os.path.join(root, "incumbent")
+    serve_export.write_bundle(inc_dir, {
+        "bundle_version": serve_export.BUNDLE_VERSION,
+        "config": config, "precision": "f32",
+    }, variables)
+    srv = serve.PredictionServer(
+        serve.load_bundle(inc_dir), port=0, num_replicas=2, max_bucket=16,
+    )
+    srv.warmup(x[:1])
+    host, port = srv.start()
+    base = f"http://{host}:{port}"
+    drift = loop.DriftMonitor(window=16, z_threshold=4.0, sustain=3)
+    srv.metrics.attach_drift(drift)
+    ctl = loop.SelfHealingController(
+        srv, loop.LoopJournal(os.path.join(root, "loop.json")),
+        drift, data_fn, root,
+        loop.LoopConfig(retrain_epochs=4, probation_batches=4),
+    )
+
+    sent = 0
+
+    def feed(n, seed0, drifted=False):
+        nonlocal sent
+        apes = []
+        for i in range(n):
+            xb, yb = make_xy(4, seed0 + i, drifted)
+            req = urllib.request.Request(
+                f"{base}/predict",
+                data=json.dumps({"instances": xb.tolist()}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            preds = np.asarray(json.loads(
+                urllib.request.urlopen(req).read())["predictions"],
+                np.float32)
+            sent += 1
+            apes.append(float(np.mean(
+                np.abs(yb - preds.reshape(yb.shape))
+                / (np.abs(yb) + 1e-8)
+            )))
+        return float(np.mean(apes))
+
+    clean_mape = feed(24, seed0=1000)
+    t_drift = time.time()
+    drifted_mape = feed(30, seed0=2000, drifted=True)
+    trig = drift.snapshot()
+    t_trigger = time.time()
+    outcome = ctl.poll() or {"state": "never_triggered"}
+    t_healed = time.time()
+    healed_mape = feed(24, seed0=3000, drifted=True)
+
+    m = srv.handle_metrics()
+    snap = ctl.snapshot()
+    result = {
+        "platform": "cpu",
+        "state": outcome.get("state"),
+        "detect_s": round(t_trigger - t_drift, 2),
+        "heal_s": round(t_healed - t_trigger, 2),
+        "recovery_s": round(t_healed - t_drift, 2),
+        "clean_mape": round(clean_mape, 4),
+        "drifted_mape": round(drifted_mape, 4),
+        "healed_mape": round(healed_mape, 4),
+        "recovered": bool(healed_mape < drifted_mape),
+        "drift_triggers": trig["triggers"],
+        "episodes": snap["episodes"],
+        "promotions": snap["promotions"],
+        "requests": sent,
+        "requests_total": m["requests_total"],
+        "dropped": sent - m["requests_total"],
+        "swaps_total": m["swap"]["swaps_total"],
+        "post_swap_new_programs":
+            m["compile"]["new_programs_since_warmup"],
+        "probation_mape": round(outcome.get("probation_mape", -1.0), 4),
+        "incumbent_mape": round(outcome.get("incumbent_mape", -1.0), 4),
+        "wall_s": round(time.time() - t0, 1),
+    }
+    ctl.close()
+    drift.close()
+    srv.close()
+    print(json.dumps(result))
+
+
 # ---------------------------------------------------------------------------
 # Parent orchestration
 
@@ -2861,13 +2995,22 @@ def emit(value: float, vs_baseline, backend: str, extra: dict) -> None:
                 "chunks_staged", "consumer_waits", "producer_waits",
             ) if st.get(k) is not None}
         )
+    ol = extra.get("online_loop")
+    if ol:
+        compact["online_loop"] = (
+            {"error": str(ol["error"])[-120:]} if "error" in ol else
+            {k: ol.get(k) for k in (
+                "state", "recovery_s", "recovered", "drifted_mape",
+                "healed_mape", "dropped", "post_swap_new_programs",
+            ) if ol.get(k) is not None}
+        )
     # Belt-and-braces: drop optional blocks until the line fits the
     # driver's tail capture (never the metric/value/backend core).
     out = json.dumps(compact)
     for k in ("compile_cache", "cold_second_run", "last_tpu_capture",
               "flagship_prev", "asha", "flagship", "serve_soak", "pbt",
-              "streaming", "quality_at_budget", "warm_skipped_after",
-              "error"):
+              "streaming", "online_loop", "quality_at_budget",
+              "warm_skipped_after", "error"):
         if len(out) <= EMIT_MAX_CHARS:
             break
         if compact.pop(k, None) is not None:
@@ -3377,6 +3520,24 @@ def main() -> None:
             log(f"streaming child failed rc={rc}; tail: {err[-300:]}")
             streaming = {"error": (err or out)[-300:]}
 
+    # online_loop section (ISSUE 17): the self-healing loop's
+    # time-to-recover — drift detection, journaled retrain, guarded
+    # promotion — always a CPU child; the zero-drop / zero-recompile /
+    # recovered claims are platform-independent counters.
+    online_loop = None
+    if os.environ.get("DML_BENCH_ONLINE_LOOP", "1") != "0" \
+            and ours is not None:
+        log("running online_loop (drift -> retrain -> guarded promotion)")
+        t0 = time.time()
+        rc, out, err, _ = _run_child(
+            ["--child", "online_loop"], _cpu_env(), 300
+        )
+        phases["online_loop_s"] = round(time.time() - t0, 1)
+        online_loop = _parse_result(out) if rc == 0 else None
+        if online_loop is None:
+            log(f"online_loop child failed rc={rc}; tail: {err[-300:]}")
+            online_loop = {"error": (err or out)[-300:]}
+
     # Equal-budget quality comparison (BASELINE.md row 4): ours came from
     # the suite on the TPU path; on the CPU path run it here (CPU children
     # never claim the tunnel).  The torch side always runs on CPU — the
@@ -3576,6 +3737,8 @@ def main() -> None:
         extra["serve_soak"] = serve_soak
     if streaming is not None:
         extra["streaming"] = streaming
+    if online_loop is not None:
+        extra["online_loop"] = online_loop
     if backend == "cpu":
         # On a dead-tunnel day the artifact still carries the most recent
         # real-chip suite, provenance-stamped with its capture time (the
@@ -3673,6 +3836,8 @@ if __name__ == "__main__":
             child_serve_soak()
         elif kind == "streaming":
             child_streaming()
+        elif kind == "online_loop":
+            child_online_loop()
         elif kind == "flagship":
             child_flagship()
         elif kind == "sharded_flagship":

@@ -726,6 +726,86 @@ def _export_bundle(rest) -> None:
     print(f"exported best trial of {args.experiment_dir} -> {out}{note}")
 
 
+def _loop(rest) -> None:
+    """Self-healing loop status: the journal's episode/state/history plus
+    the controller counters from an adjacent experiment_state.json —
+    stdlib-only (readable from any host, no jax import)."""
+    import argparse
+    import json as _json
+    import os as _os
+
+    p = argparse.ArgumentParser(
+        prog="loop",
+        description="inspect a self-healing loop's journal (loop/)",
+    )
+    p.add_argument("action", choices=("status",))
+    p.add_argument("path",
+                   help="the journal file, or a loop out_dir containing "
+                        "loop.json")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    args = p.parse_args(rest)
+
+    path = args.path
+    if _os.path.isdir(path):
+        path = _os.path.join(path, "loop.json")
+    try:
+        with open(path) as f:
+            doc = _json.load(f)
+    except (OSError, ValueError) as exc:
+        print(f"error: cannot read journal {path}: {exc}", file=sys.stderr)
+        raise SystemExit(1) from None
+    state_path = _os.path.join(_os.path.dirname(path),
+                               "experiment_state.json")
+    counters = None
+    try:
+        with open(state_path) as f:
+            counters = _json.load(f).get("loop")
+    except (OSError, ValueError):
+        pass
+    if args.as_json:
+        print(_json.dumps({"journal": doc, "counters": counters},
+                          indent=2))
+        return
+    from distributed_machine_learning_tpu.loop.journal import (
+        TERMINAL_STATES,
+    )
+
+    state = doc.get("state")
+    open_note = (
+        "" if state is None or state in TERMINAL_STATES
+        else "  [OPEN - a controller should resume() this]"
+    )
+    print(f"episode {doc.get('episode', 0)}: "
+          f"{state or 'never triggered'}{open_note}")
+    if doc.get("trace_id"):
+        print(f"trace_id: {doc['trace_id']}")
+    print(f"completed episodes: {doc.get('completed_episodes', 0)} "
+          f"(promotions: {doc.get('promotions', 0)}, "
+          f"rollbacks: {doc.get('rollbacks', 0)})")
+    history = doc.get("history", [])
+    if history:
+        print("history:")
+        t0 = history[0].get("at_unix")
+        for h in history:
+            dt = (f"+{h['at_unix'] - t0:.2f}s"
+                  if t0 and h.get("at_unix") else "")
+            detail = {k: v for k, v in h.items()
+                      if k not in ("state", "at_unix")
+                      and isinstance(v, (str, int, float, bool))}
+            tail = ("  " + ", ".join(
+                f"{k}={v}" for k, v in sorted(detail.items())
+            )) if detail else ""
+            print(f"  {dt:>9}  {h.get('state')}{tail}")
+    if counters:
+        print("controller counters: " + ", ".join(
+            f"{k}={counters[k]}" for k in (
+                "episodes", "promotions", "rollbacks", "resumes",
+                "gate_rejects", "aborts",
+            ) if k in counters
+        ))
+
+
 def _serve(rest) -> None:
     import argparse
     import time
@@ -845,7 +925,7 @@ def main(argv=None) -> None:
     usage = (
         "usage: python -m distributed_machine_learning_tpu "
         "{worker|info|probe|analyze|lint|audit-sharding|perf|trace|serve|"
-        "export-bundle|export-orbax} [args]\n"
+        "loop|export-bundle|export-orbax} [args]\n"
         "  worker         host trial supervisor (see 'worker --help')\n"
         "  lint           dmlint static analysis over the package (or given\n"
         "                 paths); exit 1 on any unsuppressed finding\n"
@@ -868,6 +948,8 @@ def main(argv=None) -> None:
         "                 trial into a servable bundle (serve/export.py)\n"
         "  serve          --bundle <dir>: HTTP prediction service over\n"
         "                 compiled replicas (/predict /healthz /metrics)\n"
+        "  loop           status <journal|out_dir>: a self-healing loop's\n"
+        "                 episode state, history, and counters (loop/)\n"
         "  export-orbax   <ckpt.msgpack> <out_dir>: framework checkpoint\n"
         "                 -> orbax StandardCheckpoint"
     )
@@ -895,6 +977,8 @@ def main(argv=None) -> None:
         _trace(rest)
     elif cmd == "serve":
         _serve(rest)
+    elif cmd == "loop":
+        _loop(rest)
     elif cmd == "export-bundle":
         _export_bundle(rest)
     elif cmd == "export-orbax":

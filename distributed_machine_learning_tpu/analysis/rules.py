@@ -2584,6 +2584,79 @@ class ImplicitUpcastInQuantizedPathRule(Rule):
                 )
 
 
+# --------------------------------------------------------------------------
+# DML019 unguarded-promotion
+# --------------------------------------------------------------------------
+
+
+# Modules that orchestrate live-model promotion (the self-healing loop and
+# the runnable examples); `# dmlint-scope: promotion-guard` opts others in.
+PROMOTION_PATH_PATTERNS = (
+    "loop/",
+    "examples/",
+)
+
+# A promotion call is sanctioned only inside a function whose NAME says it
+# owns the guard: the probation watcher, a rollback path, or an explicit
+# guard helper.  serve/swap.py itself is out of scope (it IS the
+# mechanism); this rule is about orchestration code reaching past the
+# guard.
+_GUARD_FN_RE = re.compile(r"(probation|guard|rollback)")
+
+_PROMOTION_CALLS = {"hot_swap", "warm_swap_bundle"}
+
+
+class UnguardedPromotionRule(Rule):
+    name = "unguarded-promotion"
+    rule_id = "DML019"
+    severity = "error"
+    description = (
+        "a live-bundle promotion (hot_swap / warm_swap_bundle) issued "
+        "from loop-orchestration or example code OUTSIDE a probation/"
+        "guard/rollback context: the self-healing loop's whole contract "
+        "is that a candidate reaches traffic only through the guarded "
+        "path — gate first, probation watch after, retained prior ready "
+        "to roll back to.  A bare hot_swap from a controller or example "
+        "promotes an unvetted model with nothing watching it and (if "
+        "history is bypassed) nothing to roll back to.  Enforced in "
+        "loop/ and examples/ (PROMOTION_PATH_PATTERNS / `# dmlint-scope: "
+        "promotion-guard`); functions named *probation*/*guard*/"
+        "*rollback* are the sanctioned promotion sites."
+    )
+    _HINT = (
+        "route the swap through SelfHealingController."
+        "promote_with_probation (gate + probation + auto-rollback), or "
+        "move the call into a *probation*/*guard*/*rollback*-named "
+        "function that owns the watch window"
+    )
+
+    def applies(self, ctx) -> bool:
+        if "promotion-guard" in ctx.scopes:
+            return True
+        rel = ctx.display_path.replace("\\", "/")
+        return any(pat in rel for pat in PROMOTION_PATH_PATTERNS)
+
+    def check(self, ctx) -> Iterator[Finding]:
+        guarded: Set[int] = set()
+        for fn in ast.walk(ctx.tree):
+            if isinstance(
+                fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and _GUARD_FN_RE.search(fn.name):
+                guarded.update(id(n) for n in ast.walk(fn))
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or id(node) in guarded:
+                continue
+            callee = _call_name(node) or ""
+            tail = callee.rsplit(".", 1)[-1]
+            if tail in _PROMOTION_CALLS:
+                yield self.finding(
+                    ctx, node,
+                    f"{tail}() outside a probation/guard/rollback "
+                    f"context promotes an unwatched bundle",
+                    self._HINT,
+                )
+
+
 ALL_RULES: List[Rule] = [
     DonationAliasRule(),
     UnlockedDispatchRule(),
@@ -2603,6 +2676,7 @@ ALL_RULES: List[Rule] = [
     TransitiveChaosRule(),
     UnguardedSharedStateRule(),
     ImplicitUpcastInQuantizedPathRule(),
+    UnguardedPromotionRule(),
 ]
 
 

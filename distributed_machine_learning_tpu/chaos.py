@@ -71,6 +71,20 @@ class InjectedCommitKill(InjectedFault, RuntimeError):
     commit protocol (readers skip it; the manager deletes it on start)."""
 
 
+class InjectedSwapCrash(InjectedFault, RuntimeError):
+    """A hot-swap procedure killed after it has switched SOME slots but
+    before the set's bundle pointer moved — the mid-promotion crash.  The
+    fleet is left mixed but every slot is serving; the promotion driver
+    (``loop/controller.py``) must converge it back to one bundle."""
+
+
+class InjectedControllerCrash(InjectedFault, RuntimeError):
+    """The self-healing loop controller killed right after journaling a
+    state (``loop/journal.py``) — the crash-between-durable-states fault.
+    A fresh controller must resume from the journal and complete the
+    episode exactly once."""
+
+
 def _hash_fraction(*parts) -> float:
     """Uniform [0, 1) value from a stable hash of the parts."""
     h = hashlib.sha256("/".join(str(p) for p in parts).encode()).digest()
@@ -136,6 +150,32 @@ class FaultPlan:
       bundle swap, ``serve/swap.py``) on a helper thread — the
       deterministic way to land a model promotion MID-soak, keyed to the
       same dispatch counter as the kills.
+    * ``mid_swap_crash`` — slot-switch indices (1-based, counted across
+      every ``hot_swap`` this process runs); the swap procedure raises
+      :class:`InjectedSwapCrash` right after switching that slot, before
+      the set's bundle pointer moves — a promotion that dies halfway,
+      leaving a mixed fleet that is still serving.
+    * ``corrupt_bundle_on_export`` — number of bundle exports whose
+      ``params.msgpack`` is bit-flipped ON DISK after the write
+      (``serve/export.write_bundle``); the loader's msgpack restore
+      detects the damage, so a corrupt candidate can never be promoted.
+    * ``controller_crash_at`` — loop-journal state names
+      (``loop/journal.py``); the self-healing controller raises
+      :class:`InjectedControllerCrash` immediately AFTER journaling each
+      scheduled state (fires once per entry) — the crash between durable
+      states whose recovery contract is "resume completes the episode
+      exactly once".
+
+    Drift injection (``drift_inject`` — the serving-plane distribution
+    shift): a dict ``{"at_request": N, "feature_shift": s,
+    "label_scale": m, "label_shift": b}``.  From the N-th request on
+    (1-based in the caller's own stream index), :meth:`maybe_drift`
+    returns the shift spec (else None) and the stream harness applies it
+    via :func:`apply_drift` — a seeded covariate shift (per-dimension
+    offsets derived from the plan seed) plus an affine label shift, so
+    drift e2e tests and the bench section need no real-world data.  The
+    first activation counts ``drift_injections``; decisions are pure in
+    ``(seed, index)`` (dmlint DML003: no wall-time, no entropy).
 
     Fail-SLOW faults (each fires exactly once; nothing raises — recovery
     depends on the liveness layer noticing the silence):
@@ -188,6 +228,10 @@ class FaultPlan:
         kill_process_at: Iterable[Tuple[str, int, int]] = (),
         replica_kills: Iterable[Tuple[int, int]] = (),
         hot_swaps: Iterable[int] = (),
+        mid_swap_crash: Iterable[int] = (),
+        corrupt_bundle_on_export: int = 0,
+        controller_crash_at: Sequence[str] = (),
+        drift_inject: Optional[Dict[str, float]] = None,
         hang_dispatch_at: Iterable[Tuple[str, int]] = (),
         hang_s: float = 1.5,
         stall_storage_paths: Sequence[str] = (),
@@ -215,6 +259,15 @@ class FaultPlan:
             ((int(n), int(r)) for n, r in replica_kills), reverse=True
         )
         self._hot_swaps = sorted((int(n) for n in hot_swaps), reverse=True)
+        self._mid_swap_crashes = sorted(
+            (int(n) for n in mid_swap_crash), reverse=True
+        )
+        self._bundle_corruptions_pending = int(corrupt_bundle_on_export)
+        self._controller_crashes: List[str] = [
+            str(s) for s in controller_crash_at
+        ]
+        self._drift_inject = dict(drift_inject) if drift_inject else None
+        self._drift_fired = False
         # Fail-slow faults (PR 3): dispatch hangs, storage stalls, worker
         # partitions — silence, not errors, so only liveness machinery
         # (liveness.py watchdogs, cluster lease expiry) can recover them.
@@ -238,6 +291,7 @@ class FaultPlan:
         self._counters: Dict[str, int] = {}
         self._submit_count = 0
         self._result_count = 0
+        self._swap_slot_count = 0
         self.corrupted_paths: List[str] = []
 
     # -- bookkeeping ---------------------------------------------------------
@@ -501,6 +555,110 @@ class FaultPlan:
                 )
                 return True
         return False
+
+    def maybe_mid_swap_crash(self) -> None:
+        """Called by ``serve/swap.hot_swap`` after EACH slot switch;
+        raises :class:`InjectedSwapCrash` when a scheduled slot-switch
+        index comes due.  The counter is process-global across swaps, so
+        ``mid_swap_crash=(2,)`` kills the promotion after its second slot
+        (or the second swap's first slot on 1-replica sets)."""
+        with self._lock:
+            self._swap_slot_count += 1
+            slot = self._swap_slot_count
+            due = (
+                self._mid_swap_crashes
+                and slot >= self._mid_swap_crashes[-1]
+            )
+            if due:
+                self._mid_swap_crashes.pop()
+                self._counters["mid_swap_crashes"] = (
+                    self._counters.get("mid_swap_crashes", 0) + 1
+                )
+        if due:
+            raise InjectedSwapCrash(
+                f"injected crash mid-swap at slot switch {slot}"
+            )
+
+    # -- loop faults ---------------------------------------------------------
+
+    def corrupt_bundle_export(self, path: str, data: bytes) -> bytes:
+        """Called by ``serve/export.write_bundle`` with the params payload
+        it just serialized; returns it bit-flipped while scheduled
+        corruptions remain (``corrupt_bundle_on_export``), counting
+        ``bundle_corruptions`` and recording the path."""
+        with self._lock:
+            if self._bundle_corruptions_pending <= 0:
+                return data
+            self._bundle_corruptions_pending -= 1
+            self.corrupted_paths.append(path)
+            self._counters["bundle_corruptions"] = (
+                self._counters.get("bundle_corruptions", 0) + 1
+            )
+        return corrupt_bytes(data)
+
+    def maybe_crash_controller(self, state: str) -> None:
+        """Raise :class:`InjectedControllerCrash` if the loop controller
+        just journaled a scheduled ``state`` (fires once per entry) — the
+        journal write has already landed, so resume sees this state."""
+        with self._lock:
+            if state not in self._controller_crashes:
+                return
+            self._controller_crashes.remove(state)
+            self._counters["controller_crashes"] = (
+                self._counters.get("controller_crashes", 0) + 1
+            )
+        raise InjectedControllerCrash(
+            f"injected controller crash after journaling {state!r}"
+        )
+
+    def maybe_drift(self, request_index: int) -> Optional[Dict[str, float]]:
+        """The drift-injection decision for the caller's ``request_index``
+        (1-based in its own stream): the shift spec once the scheduled
+        onset is reached, else None.  Pure in (plan args, index); the
+        first activation counts ``drift_injections``."""
+        spec = self._drift_inject
+        if spec is None or int(request_index) < int(
+            spec.get("at_request", 1)
+        ):
+            return None
+        with self._lock:
+            if not self._drift_fired:
+                self._drift_fired = True
+                self._counters["drift_injections"] = (
+                    self._counters.get("drift_injections", 0) + 1
+                )
+        return dict(spec, seed=self.seed)
+
+
+def apply_drift(spec: Dict[str, float], x, y=None):
+    """Apply a :meth:`FaultPlan.maybe_drift` shift spec to one request.
+
+    ``x`` is an ``(rows, ..., features)`` array-like; the covariate shift
+    adds ``feature_shift`` scaled by a per-feature-dimension factor in
+    [0.75, 1.25) derived from the plan seed — deterministic, and uneven
+    across dimensions so a drift detector watching a summary statistic
+    cannot be fooled by offsetting shifts.  ``y`` (optional labels) gets
+    the affine ``label_scale * y + label_shift``.  Returns ``(x, y)`` as
+    numpy arrays (``y`` None if not given)."""
+    import numpy as _np
+
+    x = _np.asarray(x, dtype=_np.float32)
+    shift = float(spec.get("feature_shift", 0.0))
+    if shift:
+        dims = x.shape[-1] if x.ndim else 1
+        seed = spec.get("seed", 0)
+        jitter = _np.asarray(
+            [0.75 + 0.5 * _hash_fraction(seed, "drift_dim", d)
+             for d in range(dims)],
+            dtype=_np.float32,
+        )
+        x = x + shift * jitter
+    if y is not None:
+        y = _np.asarray(y, dtype=_np.float32)
+        y = y * float(spec.get("label_scale", 1.0)) + float(
+            spec.get("label_shift", 0.0)
+        )
+    return x, y
 
 
 def corrupt_bytes(data: bytes, flip_every: int = 97) -> bytes:

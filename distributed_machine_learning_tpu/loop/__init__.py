@@ -1,0 +1,61 @@
+"""Self-healing serving: drift detection → retrain → guarded promotion.
+
+The closed loop over the serve, tune, ckpt and obs planes (ISSUE 17)::
+
+    from distributed_machine_learning_tpu import loop, serve
+
+    drift = loop.DriftMonitor(window=48, sustain=8)
+    srv.metrics.attach_drift(drift)            # serving plane feeds it
+    ctl = loop.SelfHealingController(
+        srv, loop.LoopJournal(run_dir + "/loop.json"), drift,
+        data_fn, run_dir, loop.LoopConfig(), ckpt_dir=ckpt_dir,
+    )
+    ...serve traffic...
+    result = ctl.poll()       # drift trigger -> retrain -> gate ->
+                              # probation -> promoted / rolled_back
+    ctl.resume()              # after a controller crash: finish the
+                              # journaled episode exactly once
+
+Module map: ``drift`` (windowed robust drift scores, debounced trigger),
+``journal`` (atomic episode state machine the controller resumes from),
+``retrain`` (warm-start continual fine-tune, cached program class —
+zero new compiles on repeat episodes), ``controller`` (the state machine
+tying them to ``serve.swap``'s zero-downtime promotion and retained-
+prior rollback).  Chaos hooks for every leg live in ``chaos.FaultPlan``
+(``drift_inject``, ``trial_crashes``, ``mid_swap_crash``,
+``corrupt_bundle_on_export``, ``controller_crash_at``).
+"""
+
+from distributed_machine_learning_tpu.loop.controller import (
+    LoopConfig,
+    SelfHealingController,
+)
+from distributed_machine_learning_tpu.loop.drift import (
+    DriftMonitor,
+    stream_stats,
+)
+from distributed_machine_learning_tpu.loop.journal import (
+    STATES,
+    TERMINAL_STATES,
+    LoopJournal,
+)
+from distributed_machine_learning_tpu.loop.retrain import (
+    clear_program_cache,
+    eval_mape,
+    fine_tune,
+    program_cache_stats,
+)
+
+__all__ = [
+    "DriftMonitor",
+    "LoopConfig",
+    "LoopJournal",
+    "STATES",
+    "SelfHealingController",
+    "TERMINAL_STATES",
+    "clear_program_cache",
+    "eval_mape",
+    "fine_tune",
+    "program_cache_stats",
+    "stream_stats",
+]

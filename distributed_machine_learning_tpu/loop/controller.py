@@ -1,0 +1,570 @@
+"""The self-healing controller: drift → retrain → guarded promotion.
+
+Ties six subsystems into one loop (ISSUE 17 tentpole): the serve plane's
+drift monitor supplies the trigger, ``ckpt/`` the warm-start generation,
+``loop/retrain`` the continual fine-tune, ``serve/export`` the candidate
+bundle, ``serve/swap`` the zero-downtime promotion AND the retained-prior
+rollback, and ``obs/`` the single trace id + flight-dump forensics the
+whole episode shares.
+
+Every durable step goes through ``loop/journal.py`` BEFORE the next
+action, so a controller crash between any two states resumes from the
+journal and completes the episode exactly once::
+
+    detected    drift trigger consumed, scores recorded
+    retraining  warm-start resolved; fine-tune runs (retries absorb
+                injected trial crashes within ``retrain_retries``)
+    candidate   bundle exported; gate = candidate-vs-incumbent holdout
+                MAPE (corrupt candidates are re-exported, never promoted)
+    probation   candidate swapped in (mixed-fleet swap crashes are
+                converged by one retry); live probation traffic scored
+    promoted    probation passed — prior stays in the bounded history,
+                drift re-baselines to the new normal
+    rolled_back probation regressed — ``serve/swap.rollback`` re-promotes
+                the retained prior (zero compiles), drift stays armed
+    aborted     retrain/export budget exhausted or gate rejected — the
+                OLD model keeps serving, nothing swapped
+
+Degradation contract under chaos: every failure path lands in a terminal
+state with the fleet serving SOME complete bundle, leaves a flight dump
+naming the episode, and never drops a request — the guarantees the e2e
+test counts.
+"""
+
+from __future__ import annotations
+
+import os
+import json
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+from distributed_machine_learning_tpu.analysis.locks import named_lock
+from distributed_machine_learning_tpu.loop.journal import LoopJournal
+from distributed_machine_learning_tpu.serve.export import (
+    BUNDLE_VERSION,
+    load_bundle,
+    write_bundle,
+)
+
+
+@dataclass
+class LoopConfig:
+    """Knobs of one self-healing loop (the runbook documents each)."""
+
+    retrain_epochs: int = 6
+    retrain_lr: float = 0.02
+    retrain_batch_size: int = 16
+    retrain_retries: int = 2       # injected/real crashes absorbed
+    export_retries: int = 1        # corrupt-candidate re-exports
+    gate_ratio: float = 1.0        # candidate holdout MAPE must be
+    gate_margin: float = 0.02      # <= incumbent * ratio + margin
+    probation_batches: int = 8     # live batches scored after the swap
+    probation_ratio: float = 1.25  # rollback when served MAPE exceeds
+    probation_margin: float = 0.05  # incumbent * ratio + margin
+    seed: int = 0
+
+
+class SelfHealingController:
+    """Owns one serving fleet's drift → retrain → promote → watch loop.
+
+    ``data_fn(kind)`` supplies recent LABELED windows as ``(x, y)`` numpy
+    arrays for ``kind`` in ``{"train", "holdout", "probation"}`` — in
+    production the labeled-feedback stream, in tests/bench the drifting
+    synthetic stream.  ``server`` is a ``PredictionServer`` (probation
+    traffic goes through its live ReplicaSet, so mid-promotion replica
+    kills land on real dispatch).
+    """
+
+    def __init__(
+        self,
+        server,
+        journal: LoopJournal,
+        drift,
+        data_fn: Callable[[str], Any],
+        out_dir: str,
+        config: Optional[LoopConfig] = None,
+        ckpt_dir: Optional[str] = None,
+        fault_plan=None,
+    ):
+        self.server = server
+        self.rs = server.replicas
+        self.journal = journal
+        self.drift = drift
+        self.data_fn = data_fn
+        self.out_dir = str(out_dir)
+        self.config = config or LoopConfig()
+        self.ckpt_dir = ckpt_dir
+        self._plan = fault_plan
+        self._lock = named_lock("loop.controller")
+        self.episodes = 0
+        self.promotions = 0
+        self.rollbacks = 0
+        self.resumes = 0
+        self.gate_rejects = 0
+        self.retrain_retries = 0
+        self.candidate_corruptions = 0
+        self.swap_retries = 0
+        self.aborts = 0
+        from distributed_machine_learning_tpu.obs import get_registry
+
+        get_registry().register_family("loop", self)
+
+    # -- chaos + journal plumbing --------------------------------------------
+
+    def _journal(self, state: str, **data: Any) -> None:
+        """Durable transition, then the scheduled controller crash — the
+        crash lands BETWEEN journal states by construction."""
+        self.journal.transition(state, **data)
+        self._emit_state(state, data)
+        if self._plan is not None:
+            self._plan.maybe_crash_controller(state)
+
+    def _emit_state(self, state: str, data: Dict[str, Any]) -> None:
+        from distributed_machine_learning_tpu import obs
+
+        obs.event("loop_state", {
+            "episode": self.journal.episode,
+            "state": state,
+            "trace_id": self.journal.trace_id,
+            **{k: v for k, v in data.items()
+               if isinstance(v, (str, int, float, bool, type(None)))},
+        })
+
+    def _dump(self, tag: str, **extra: Any) -> None:
+        from distributed_machine_learning_tpu import obs
+
+        obs.dump_flight_recorder(
+            f"loop_ep{self.journal.episode}_{tag}",
+            extra={"trace_id": self.journal.trace_id, **extra},
+        )
+
+    # -- public surface ------------------------------------------------------
+
+    def poll(self) -> Optional[Dict[str, Any]]:
+        """Consume a pending drift trigger and run one full episode;
+        None when nothing triggered."""
+        trigger = self.drift.consume_trigger()
+        if trigger is None:
+            return None
+        return self.run_episode(trigger)
+
+    def run_episode(
+        self, trigger: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Any]:
+        """One complete detection → terminal-state episode."""
+        from distributed_machine_learning_tpu import obs
+
+        with obs.span("loop.episode", {
+            "episode": self.journal.episode + 1,
+        }):
+            ctx = obs.current_context()
+            trace_id = ctx[0] if ctx else None
+            episode = self.journal.begin_episode(
+                trace_id,
+                trigger=(trigger or {}).get("streams"),
+                scores=(trigger or {}).get("scores"),
+            )
+            with self._lock:
+                self.episodes += 1
+            self._emit_state("detected", {"episode": episode})
+            if self._plan is not None:
+                self._plan.maybe_crash_controller("detected")
+            return self._advance("detected")
+
+    def resume(self) -> Optional[Dict[str, Any]]:
+        """Complete a crashed episode from its journal (exactly once:
+        terminal episodes are a no-op)."""
+        from distributed_machine_learning_tpu import obs
+
+        self.journal.reload()
+        if not self.journal.open_episode():
+            return None
+        with self._lock:
+            self.resumes += 1
+        obs.get_registry().add("loop_resumes")
+        state = self.journal.state
+        parent = (
+            (self.journal.trace_id, None) if self.journal.trace_id else None
+        )
+        with obs.span("loop.resume", {
+            "episode": self.journal.episode, "from_state": state,
+        }, parent=parent):
+            self._emit_state("resume", {"from_state": state})
+            return self._advance(state)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``loop`` registry family (and experiment_state block)."""
+        with self._lock:
+            out = {
+                "episodes": self.episodes,
+                "promotions": self.promotions,
+                "rollbacks": self.rollbacks,
+                "resumes": self.resumes,
+                "gate_rejects": self.gate_rejects,
+                "retrain_retries": self.retrain_retries,
+                "candidate_corruptions": self.candidate_corruptions,
+                "swap_retries": self.swap_retries,
+                "aborts": self.aborts,
+            }
+        out.update({f"journal_{k}": v
+                    for k, v in self.journal.snapshot().items()})
+        return out
+
+    def save_state(self) -> str:
+        """Write ``experiment_state.json`` with the ``loop`` block the
+        e2e/bench assertions read — same filename contract as tune's
+        experiment store."""
+        path = os.path.join(self.out_dir, "experiment_state.json")
+        os.makedirs(self.out_dir, exist_ok=True)
+        doc = {}
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = {}
+        doc["loop"] = {
+            **self.snapshot(),
+            "journal": self.journal.snapshot(),
+            "updated_at": round(time.time(), 3),
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2)
+        os.replace(tmp, path)
+        return path
+
+    def close(self) -> None:
+        from distributed_machine_learning_tpu.obs import get_registry
+
+        get_registry().unregister_family("loop", self)
+
+    # -- state machine -------------------------------------------------------
+
+    def _advance(self, state: str) -> Dict[str, Any]:
+        data = self.journal.data
+        if state in ("detected", "retraining"):
+            # "retraining" re-runs the fine-tune from scratch: it never
+            # swapped anything, so redoing it is exactly-once safe.
+            return self._retrain_and_export()
+        if state == "candidate":
+            return self._gate_and_promote(data.get("candidate"))
+        if state == "probation":
+            return self._promote_under_probation(
+                data.get("candidate"),
+                incumbent_mape=data.get("incumbent_mape"),
+                gate_mape=data.get("candidate_mape"),
+            )
+        raise RuntimeError(f"cannot advance from terminal state {state!r}")
+
+    def _terminal(self, state: str, **data: Any) -> Dict[str, Any]:
+        self._journal(state, **data)
+        with self._lock:
+            if state == "promoted":
+                self.promotions += 1
+            elif state == "rolled_back":
+                self.rollbacks += 1
+            elif state == "aborted":
+                self.aborts += 1
+        self.save_state()
+        return {"state": state, "episode": self.journal.episode, **data}
+
+    # -- retrain + export ----------------------------------------------------
+
+    def _warm_start(self) -> Dict[str, Any]:
+        """Newest committed generation (resharding restore gathers any
+        topology to host), else the live bundle's own variables."""
+        if self.ckpt_dir:
+            from distributed_machine_learning_tpu.ckpt.manager import (
+                newest_valid_generation,
+            )
+            from distributed_machine_learning_tpu.tune.checkpoint import (
+                load_checkpoint,
+            )
+
+            path, step = newest_valid_generation(self.ckpt_dir)
+            if path is not None:
+                ckpt = load_checkpoint(path)
+                if ckpt and "params" in ckpt:
+                    variables = {"params": ckpt["params"]}
+                    if ckpt.get("batch_stats"):
+                        variables["batch_stats"] = ckpt["batch_stats"]
+                    return {"variables": variables,
+                            "source": path, "step": step}
+        bundle = self.rs.bundle
+        return {"variables": dict(bundle.variables),
+                "source": getattr(bundle, "path", None), "step": None}
+
+    def _retrain_and_export(
+        self, corruption_retries: int = 0
+    ) -> Dict[str, Any]:
+        from distributed_machine_learning_tpu.loop.retrain import fine_tune
+
+        cfg = self.config
+        warm = self._warm_start()
+        self._journal(
+            "retraining",
+            warm_start=str(warm["source"]),
+            warm_step=warm["step"],
+            corruption_retries=corruption_retries,
+        )
+        x, y = self.data_fn("train")
+        config = dict(self.rs.bundle.config)
+        trial_id = f"loop-ep{self.journal.episode}"
+        info = None
+        variables = None
+        for attempt in range(cfg.retrain_retries + 1):
+            try:
+                variables, info = fine_tune(
+                    config, warm["variables"], x, y,
+                    epochs=cfg.retrain_epochs,
+                    learning_rate=cfg.retrain_lr,
+                    batch_size=cfg.retrain_batch_size,
+                    seed=cfg.seed + self.journal.episode,
+                    trial_id=trial_id,
+                    plan=self._plan,
+                )
+                break
+            except Exception as exc:  # noqa: BLE001 - retry budget below
+                with self._lock:
+                    self.retrain_retries += 1
+                if attempt >= cfg.retrain_retries:
+                    self._dump("retrain_exhausted", error=repr(exc))
+                    return self._terminal(
+                        "aborted", reason="retrain_failed",
+                        error=repr(exc),
+                    )
+        candidate_dir = os.path.join(
+            self.out_dir, f"candidate_ep{self.journal.episode:03d}"
+        )
+        self._export_candidate(candidate_dir, config, variables, info)
+        self._journal(
+            "candidate",
+            candidate=candidate_dir,
+            retrain_val_mape=info["val_mape"],
+            retrain_program_builds=info["program_builds"],
+        )
+        return self._gate_and_promote(candidate_dir)
+
+    def _export_candidate(
+        self, out_dir, config, variables, info
+    ) -> None:
+        manifest = {
+            "bundle_version": BUNDLE_VERSION,
+            "created_at": time.time(),
+            "model_family": config.get("model", "transformer"),
+            "config": config,
+            "precision": "f32",
+            "loop": {
+                "episode": self.journal.episode,
+                "trace_id": self.journal.trace_id,
+                "val_mape": info["val_mape"],
+            },
+        }
+        write_bundle(out_dir, manifest, variables)
+
+    # -- gate + promotion + probation ----------------------------------------
+
+    def _gate_and_promote(self, candidate_dir) -> Dict[str, Any]:
+        from distributed_machine_learning_tpu.loop.retrain import eval_mape
+
+        cfg = self.config
+        if not candidate_dir:
+            return self._terminal("aborted", reason="no_candidate")
+        hx, hy = self.data_fn("holdout")
+        incumbent = self.rs.bundle
+        incumbent_mape = eval_mape(
+            dict(incumbent.config), incumbent.variables, hx, hy
+        )
+        try:
+            candidate = load_bundle(candidate_dir)
+        except Exception as exc:  # noqa: BLE001 - corrupt candidate
+            with self._lock:
+                self.candidate_corruptions += 1
+            from distributed_machine_learning_tpu import obs
+
+            obs.get_registry().add("loop_candidate_corruptions")
+            self._dump("candidate_corrupt", error=repr(exc),
+                       candidate=str(candidate_dir))
+            # The retry count is JOURNALED (the retraining transition
+            # carries it), so the export budget holds across controller
+            # crash-resume too, and a corruptor that outlives the budget
+            # lands in "aborted" with the old model still serving.
+            retries = int(self.journal.data.get("corruption_retries", 0))
+            if retries >= cfg.export_retries:
+                return self._terminal(
+                    "aborted", reason="candidate_corrupt",
+                    error=repr(exc),
+                )
+            # Re-export from the journaled retrain outcome is not
+            # possible (params live only in the crashed process), so
+            # re-run the fine-tune: still the same episode, still
+            # exactly-once — nothing was promoted.
+            return self._retrain_and_export(
+                corruption_retries=retries + 1
+            )
+        candidate_mape = eval_mape(
+            dict(candidate.config), candidate.variables, hx, hy
+        )
+        if candidate_mape > incumbent_mape * cfg.gate_ratio + cfg.gate_margin:
+            with self._lock:
+                self.gate_rejects += 1
+            self._dump(
+                "gate_reject",
+                candidate_mape=candidate_mape,
+                incumbent_mape=incumbent_mape,
+            )
+            return self._terminal(
+                "aborted", reason="gate_reject",
+                candidate_mape=candidate_mape,
+                incumbent_mape=incumbent_mape,
+            )
+        return self._promote_under_probation(
+            candidate_dir,
+            incumbent_mape=incumbent_mape,
+            gate_mape=candidate_mape,
+        )
+
+    def promote_with_probation(
+        self,
+        candidate_dir: str,
+        incumbent_mape: Optional[float] = None,
+        gate_mape: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """GUARDED promotion: swap the candidate in, watch it over live
+        probation traffic, auto-rollback on regression.  Public so a
+        deliberately-promoted bundle (tests, operators) still gets the
+        probation guard — dmlint DML019 flags promotions outside it."""
+        return self._promote_under_probation(
+            candidate_dir, incumbent_mape=incumbent_mape,
+            gate_mape=gate_mape,
+        )
+
+    def _promote_under_probation(
+        self,
+        candidate_dir,
+        incumbent_mape: Optional[float] = None,
+        gate_mape: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        from distributed_machine_learning_tpu import chaos, obs
+        from distributed_machine_learning_tpu.loop.retrain import eval_mape
+        from distributed_machine_learning_tpu.serve import swap as swap_lib
+
+        cfg = self.config
+        if incumbent_mape is None:
+            hx, hy = self.data_fn("holdout")
+            incumbent = self.rs.bundle
+            incumbent_mape = eval_mape(
+                dict(incumbent.config), incumbent.variables, hx, hy
+            )
+        journaled = self.journal.open_episode()
+        if journaled and self.journal.state != "probation":
+            self._journal(
+                "probation",
+                candidate=str(candidate_dir),
+                incumbent_mape=incumbent_mape,
+                candidate_mape=gate_mape,
+                swapped=False,
+            )
+        # Resume idempotence: skip the swap only when THIS open episode
+        # already journaled it (or the fleet is literally serving the
+        # candidate) — a terminal prior episode's stale ``swapped`` flag
+        # must not make a fresh promotion look done.
+        already_live = (
+            getattr(self.rs.bundle, "path", None) == str(candidate_dir)
+            or (journaled and self.journal.data.get("swapped") is True)
+        )
+        if not already_live:
+            try:
+                candidate = load_bundle(str(candidate_dir))
+            except Exception as exc:  # noqa: BLE001
+                with self._lock:
+                    self.candidate_corruptions += 1
+                self._dump("candidate_corrupt", error=repr(exc))
+                if journaled:
+                    return self._terminal(
+                        "aborted", reason="candidate_corrupt",
+                        error=repr(exc),
+                    )
+                return {"state": "aborted", "error": repr(exc)}
+            event = None
+            for attempt in (0, 1):
+                try:
+                    with obs.span("loop.promote", {
+                        "bundle": str(candidate_dir),
+                    }):
+                        event = swap_lib.hot_swap(self.rs, candidate)
+                    break
+                except chaos.InjectedSwapCrash:
+                    # Mixed fleet, old bundle pointer: every slot is
+                    # still serving.  One retry converges it (scheduled
+                    # faults fire once); counted for the e2e.
+                    with self._lock:
+                        self.swap_retries += 1
+                    obs.get_registry().add("loop_swap_retries")
+                    if attempt == 1:
+                        raise
+            self.server.bundle = self.rs.bundle
+            if journaled:
+                self.journal.transition(
+                    "probation", swapped=True,
+                    swap_duration_s=event.get("duration_s"),
+                )
+                self._emit_state("probation", {"swapped": True})
+        # -- probation window over LIVE traffic ------------------------------
+        probation_mape = self._probation_mape()
+        threshold = (
+            float(incumbent_mape) * cfg.probation_ratio
+            + cfg.probation_margin
+        )
+        detail = {
+            "probation_mape": probation_mape,
+            "incumbent_mape": float(incumbent_mape),
+            "threshold": threshold,
+            "candidate": str(candidate_dir),
+        }
+        if probation_mape > threshold:
+            with obs.span("loop.rollback", detail):
+                swap_lib.rollback(
+                    self.rs, reason="probation_regression"
+                )
+            self.server.bundle = self.rs.bundle
+            self._dump("probation_rollback", **detail)
+            if journaled:
+                return self._terminal("rolled_back", **detail)
+            with self._lock:
+                self.rollbacks += 1
+            self.save_state()
+            return {"state": "rolled_back", **detail}
+        # Probation passed: the drifted distribution is the new normal.
+        self.drift.rearm(rebaseline=True)
+        if journaled:
+            return self._terminal("promoted", **detail)
+        with self._lock:
+            self.promotions += 1
+        self.save_state()
+        return {"state": "promoted", **detail}
+
+    def _probation_mape(self) -> float:
+        """Served MAPE over the probation window — through the LIVE
+        replica set, so scheduled replica kills land on real dispatch
+        and a hung candidate surfaces as timeouts, not silence."""
+        import numpy as np
+
+        cfg = self.config
+        px, py = self.data_fn("probation")
+        px = np.asarray(px, dtype=np.float32)
+        py = np.asarray(py, dtype=np.float32)
+        batches = max(int(cfg.probation_batches), 1)
+        rows = max(len(px) // batches, 1)
+        apes = []
+        for b in range(batches):
+            xb = px[b * rows:(b + 1) * rows]
+            yb = py[b * rows:(b + 1) * rows]
+            if not len(xb):
+                break
+            preds = np.asarray(self.rs.predict(xb))
+            apes.append(float(np.mean(
+                np.abs(yb - preds) / (np.abs(yb) + 1e-8)
+            )))
+        return float(np.mean(apes)) if apes else float("inf")

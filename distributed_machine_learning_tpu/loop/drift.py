@@ -1,0 +1,229 @@
+"""Serving-plane drift detection: windowed robust stats, debounced trigger.
+
+The loop's input signal (ISSUE 17 tentpole, part 1).  The HTTP server
+feeds one scalar summary per stream per request — the request's mean
+feature value and mean prediction (``server.handle_predict`` →
+``ServeMetrics.observe_streams``) — and this monitor turns them into
+**per-window drift scores**: the robust z (median/MAD, ``perf/anomaly``'s
+machinery — mean/std would let the drifted tail drag the threshold
+toward itself) of the CURRENT window's median against a FROZEN baseline
+window captured when the monitor was armed.
+
+Scoring window-median-vs-baseline rather than sample-vs-baseline is what
+makes the score a *distribution* statement: a single outlier request
+barely moves the current median, but a genuine covariate shift moves it
+by the full shift within ``window`` requests.
+
+Debounce: a trigger needs ``sustain`` CONSECUTIVE over-threshold scores
+on the same stream, and once fired the monitor DISARMS until
+:meth:`rearm` — one episode per trigger, no retrain storms while the
+controller is already mid-episode.  ``rearm(rebaseline=True)`` forgets
+both windows and re-learns the baseline from post-promotion traffic:
+after a successful promotion both streams legitimately changed (drifted
+inputs AND a new model's predictions), so the promotion itself must not
+re-trigger.
+
+Stdlib-only (imports ``perf.anomaly``, itself stdlib): the monitor runs
+on the serving hot path's thread and must never drag jax/numpy in.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from distributed_machine_learning_tpu.analysis.locks import named_lock
+from distributed_machine_learning_tpu.perf.anomaly import (
+    MIN_SAMPLES,
+    RobustWindow,
+    _median,
+)
+
+DEFAULT_WINDOW = 48
+DEFAULT_Z_THRESHOLD = 6.0
+DEFAULT_SUSTAIN = 8
+
+STREAMS = ("features", "predictions")
+
+
+class _Stream:
+    """One watched stream: a frozen baseline window + a sliding current
+    window, scored current-median-vs-baseline."""
+
+    def __init__(self, window: int):
+        self.baseline = RobustWindow(window)
+        self.current = RobustWindow(window)
+        self.frozen = False
+        self.score: Optional[float] = None
+        self.streak = 0
+
+    def observe(self, value: float, threshold: float) -> None:
+        if not self.frozen:
+            self.baseline.add(value)
+            if len(self.baseline) >= self.baseline._vals.maxlen:
+                self.frozen = True
+            return
+        self.current.add(value)
+        if len(self.current) < MIN_SAMPLES:
+            return
+        med = _median(list(self.current._vals))
+        z = self.baseline.zscore(med)
+        self.score = None if z is None else abs(z)
+        if self.score is not None and self.score >= threshold:
+            self.streak += 1
+        else:
+            self.streak = 0
+
+    def rebaseline(self) -> None:
+        """Forget both windows and re-learn the normal from the NEXT
+        ``window`` observations.  Deliberately not "adopt the current
+        window": after a promotion the prediction stream is the NEW
+        model's, which the pre-swap window cannot represent — adopting it
+        would re-trigger on the promotion itself.  The re-learn period is
+        a blind window, the standard price of a deploy."""
+        self.baseline = RobustWindow(self.baseline._vals.maxlen)
+        self.frozen = False
+        self.current = RobustWindow(self.current._vals.maxlen)
+        self.score = None
+        self.streak = 0
+
+
+class DriftMonitor:
+    """Windowed drift scores over the serving plane's input/prediction
+    streams, with a debounced, one-shot-per-episode trigger.
+
+    Registered as the ``drift`` family in the unified metrics registry;
+    the HTTP server also surfaces :meth:`snapshot` as the ``drift`` block
+    of ``/metrics``.
+    """
+
+    def __init__(
+        self,
+        window: int = DEFAULT_WINDOW,
+        z_threshold: float = DEFAULT_Z_THRESHOLD,
+        sustain: int = DEFAULT_SUSTAIN,
+    ):
+        self.window = int(window)
+        self.z_threshold = float(z_threshold)
+        self.sustain = max(int(sustain), 1)
+        self._lock = named_lock("loop.drift")
+        self._streams: Dict[str, _Stream] = {
+            name: _Stream(self.window) for name in STREAMS
+        }
+        self.observations = 0
+        self.triggers = 0
+        self._armed = True
+        self._triggered = False
+        self._trigger_detail: Optional[Dict[str, Any]] = None
+        from distributed_machine_learning_tpu.obs import get_registry
+
+        get_registry().register_family("drift", self)
+
+    # -- hot path ------------------------------------------------------------
+
+    def observe(self, feature_stat: float, prediction_stat: float) -> None:
+        """One request's stream summaries.  Never raises into the serving
+        path — scoring failures count, they don't 500 a request."""
+        from distributed_machine_learning_tpu import obs
+
+        try:
+            fired = None
+            with self._lock:
+                self.observations += 1
+                pairs = (
+                    ("features", float(feature_stat)),
+                    ("predictions", float(prediction_stat)),
+                )
+                for name, value in pairs:
+                    self._streams[name].observe(value, self.z_threshold)
+                if self._armed and not self._triggered:
+                    hot = [
+                        (name, s) for name, s in self._streams.items()
+                        if s.streak >= self.sustain
+                    ]
+                    if hot:
+                        self._triggered = True
+                        self._armed = False
+                        self.triggers += 1
+                        fired = {
+                            "streams": [name for name, _ in hot],
+                            "scores": {
+                                name: round(s.score, 3)
+                                for name, s in self._streams.items()
+                                if s.score is not None
+                            },
+                            "observations": self.observations,
+                            "at_unix": round(time.time(), 3),
+                        }
+                        self._trigger_detail = fired
+            if fired is not None:
+                reg = obs.get_registry()
+                reg.add("drift_triggers")
+                obs.event("drift_trigger", fired)
+        except Exception:  # noqa: BLE001 - never fail the request path
+            obs.get_registry().add("drift_monitor_errors")
+
+    # -- controller side -----------------------------------------------------
+
+    def consume_trigger(self) -> Optional[Dict[str, Any]]:
+        """The debounced trigger, exactly once: detail dict when a trigger
+        is pending, else None.  The monitor stays DISARMED afterwards
+        until :meth:`rearm`."""
+        with self._lock:
+            if not self._triggered:
+                return None
+            self._triggered = False
+            return self._trigger_detail
+
+    def rearm(self, rebaseline: bool = True) -> None:
+        """Arm for the next episode.  ``rebaseline`` re-learns the normal
+        from the next ``window`` observations (after a successful
+        promotion); without it the old baseline stands (after a rollback
+        — the drift is still real and should re-trigger)."""
+        with self._lock:
+            if rebaseline:
+                for s in self._streams.values():
+                    s.rebaseline()
+            else:
+                for s in self._streams.values():
+                    s.streak = 0
+            self._armed = True
+            self._triggered = False
+
+    def scores(self) -> Dict[str, Optional[float]]:
+        with self._lock:
+            return {n: s.score for n, s in self._streams.items()}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``drift`` registry family / ``/metrics`` block."""
+        with self._lock:
+            out: Dict[str, Any] = {
+                "observations": self.observations,
+                "triggers": self.triggers,
+                "armed": self._armed,
+                "trigger_pending": self._triggered,
+                "window": self.window,
+                "z_threshold": self.z_threshold,
+                "sustain": self.sustain,
+            }
+            for name, s in self._streams.items():
+                out[f"score_{name}"] = (
+                    round(s.score, 3) if s.score is not None else None
+                )
+                out[f"streak_{name}"] = s.streak
+                out[f"baseline_frozen_{name}"] = s.frozen
+            return out
+
+    def close(self) -> None:
+        from distributed_machine_learning_tpu.obs import get_registry
+
+        get_registry().unregister_family("drift", self)
+
+
+def stream_stats(x, preds) -> List[float]:
+    """Host-side helper for harnesses that feed the monitor directly
+    (bench, examples): the same two summaries the HTTP server computes."""
+    import numpy as np
+
+    return [float(np.mean(np.asarray(x))),
+            float(np.mean(np.asarray(preds)))]

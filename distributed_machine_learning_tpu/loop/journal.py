@@ -1,0 +1,195 @@
+"""The loop journal: durable episode state the controller resumes from.
+
+One JSON file, one episode at a time, atomic transitions (tmp +
+``os.replace`` — the same discipline as ``tune/storage.py``'s atomic
+writes): whatever state the file holds after a controller crash is a
+state that was COMPLETELY journaled, so resume never sees a torn record.
+
+States (ISSUE 17)::
+
+    detected -> retraining -> candidate -> probation -> promoted
+                                   \\                \\-> rolled_back
+                                    \\-> aborted
+
+``promoted``, ``rolled_back`` and ``aborted`` are terminal; resuming a
+terminal episode is a no-op — that, plus atomic transitions, is what
+makes "crash at ANY transition, resume completes the loop exactly once"
+a mechanical property rather than a hope.  Every transition carries the
+episode's trace id, so the whole detection → retrain → swap → probation
+story shares one trace.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from distributed_machine_learning_tpu.analysis.locks import named_lock
+
+STATES = (
+    "detected", "retraining", "candidate", "probation",
+    "promoted", "rolled_back", "aborted",
+)
+TERMINAL_STATES = frozenset({"promoted", "rolled_back", "aborted"})
+
+
+class LoopJournal:
+    """Durable record of the current self-healing episode.
+
+    The on-disk document::
+
+        {"episode": 3, "state": "retraining", "trace_id": "...",
+         "data": {...merged transition payloads...},
+         "history": [{"state": ..., "at_unix": ..., ...payload}, ...],
+         "completed_episodes": 2, "promotions": 1, "rollbacks": 1}
+
+    ``data`` accumulates across transitions (the candidate path journaled
+    at ``candidate`` is still there at ``probation``), ``history`` is the
+    forensic trail a flight dump or postmortem replays.
+    """
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._lock = named_lock("loop.journal")
+        self._doc: Dict[str, Any] = self._read() or {
+            "episode": 0,
+            "state": None,
+            "trace_id": None,
+            "data": {},
+            "history": [],
+            "completed_episodes": 0,
+            "promotions": 0,
+            "rollbacks": 0,
+        }
+
+    # -- durability ----------------------------------------------------------
+
+    def _read(self) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _write(self) -> None:
+        tmp = self.path + ".tmp"
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(self._doc, f, indent=2)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    # -- episode lifecycle ---------------------------------------------------
+
+    def begin_episode(
+        self, trace_id: Optional[str], **data: Any
+    ) -> int:
+        """Open a new episode in ``detected`` state; returns its number.
+        Refuses while a non-terminal episode is open — resume that one
+        first (the exactly-once contract)."""
+        with self._lock:
+            state = self._doc.get("state")
+            if state is not None and state not in TERMINAL_STATES:
+                raise RuntimeError(
+                    f"episode {self._doc['episode']} is still "
+                    f"{state!r}; resume it before starting another"
+                )
+            self._doc["episode"] = int(self._doc.get("episode", 0)) + 1
+            self._doc["state"] = "detected"
+            self._doc["trace_id"] = trace_id
+            self._doc["data"] = dict(data)
+            self._doc["history"] = [{
+                "state": "detected",
+                "at_unix": round(time.time(), 3),
+                **data,
+            }]
+            self._write()
+            return int(self._doc["episode"])
+
+    def transition(self, state: str, **data: Any) -> None:
+        """Atomically advance the open episode to ``state``, merging
+        ``data`` into the episode record."""
+        if state not in STATES:
+            raise ValueError(f"unknown journal state {state!r}")
+        with self._lock:
+            if self._doc.get("state") is None:
+                raise RuntimeError("no open episode to transition")
+            self._doc["state"] = state
+            self._doc["data"].update(data)
+            self._doc["history"].append({
+                "state": state,
+                "at_unix": round(time.time(), 3),
+                **data,
+            })
+            if state in TERMINAL_STATES:
+                self._doc["completed_episodes"] = (
+                    int(self._doc.get("completed_episodes", 0)) + 1
+                )
+                if state == "promoted":
+                    self._doc["promotions"] = (
+                        int(self._doc.get("promotions", 0)) + 1
+                    )
+                elif state == "rolled_back":
+                    self._doc["rollbacks"] = (
+                        int(self._doc.get("rollbacks", 0)) + 1
+                    )
+            self._write()
+
+    # -- read side -----------------------------------------------------------
+
+    def reload(self) -> None:
+        """Re-read the file (a resuming controller adopting another
+        incarnation's journal)."""
+        with self._lock:
+            doc = self._read()
+            if doc is not None:
+                self._doc = doc
+
+    @property
+    def state(self) -> Optional[str]:
+        with self._lock:
+            return self._doc.get("state")
+
+    @property
+    def episode(self) -> int:
+        with self._lock:
+            return int(self._doc.get("episode", 0))
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        with self._lock:
+            return self._doc.get("trace_id")
+
+    @property
+    def data(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._doc.get("data", {}))
+
+    @property
+    def history(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._doc.get("history", []))
+
+    def open_episode(self) -> bool:
+        """True when a non-terminal episode needs resuming."""
+        with self._lock:
+            state = self._doc.get("state")
+            return state is not None and state not in TERMINAL_STATES
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "episode": int(self._doc.get("episode", 0)),
+                "state": self._doc.get("state"),
+                "trace_id": self._doc.get("trace_id"),
+                "completed_episodes": int(
+                    self._doc.get("completed_episodes", 0)
+                ),
+                "promotions": int(self._doc.get("promotions", 0)),
+                "rollbacks": int(self._doc.get("rollbacks", 0)),
+            }

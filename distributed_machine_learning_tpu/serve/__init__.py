@@ -62,7 +62,11 @@ from distributed_machine_learning_tpu.serve.replica import (
     replica_process_env,
 )
 from distributed_machine_learning_tpu.serve.server import PredictionServer
-from distributed_machine_learning_tpu.serve.swap import hot_swap
+from distributed_machine_learning_tpu.serve.swap import (
+    hot_swap,
+    rollback,
+    warm_swap_bundle,
+)
 
 __all__ = [
     "AllReplicasOpen",
@@ -89,4 +93,6 @@ __all__ = [
     "hot_swap",
     "load_bundle",
     "replica_process_env",
+    "rollback",
+    "warm_swap_bundle",
 ]

@@ -220,7 +220,21 @@ def write_bundle(
     # Same writer as training checkpoints: identical msgpack bytes in and
     # out, so a served prediction is bit-identical to one made from the
     # original checkpoint (and int8/bf16 leaves round-trip dtype-exact).
-    ckpt_lib.save_checkpoint(backend.join(out, PARAMS_NAME), variables)
+    params_path = backend.join(out, PARAMS_NAME)
+    ckpt_lib.save_checkpoint(params_path, variables)
+    from distributed_machine_learning_tpu import chaos
+
+    plan = chaos.active_plan()
+    if plan is not None:
+        # corrupt_bundle_on_export: the candidate's params damaged AFTER
+        # the write, so the export reports success and only the loader's
+        # msgpack restore can catch it — exactly the torn-export shape a
+        # promotion guard must refuse to swap in.
+        raw = backend.read_bytes(params_path)
+        if raw is not None:
+            damaged = plan.corrupt_bundle_export(params_path, raw)
+            if damaged is not raw:
+                backend.write_bytes(params_path, damaged)
     return out_dir
 
 

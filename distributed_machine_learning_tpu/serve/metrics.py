@@ -77,6 +77,10 @@ class ServeMetrics:
         self.rejected = 0
         self.timeouts = 0
         self.sheds = 0
+        # Optional drift monitor (loop/drift.py) — attached, not owned:
+        # the serving plane feeds it per-request stream summaries and the
+        # self-healing controller consumes its debounced trigger.
+        self._drift = None
         # The serving process's slice of the unified metrics registry
         # (obs/registry.py): /metrics keeps its exact JSON shape — this
         # adds the same counters to the one-plane view (flight dumps,
@@ -90,6 +94,26 @@ class ServeMetrics:
             self.requests += 1
             self.rows += rows
             self._latencies_ms.add(latency_s * 1000.0)
+
+    def attach_drift(self, monitor) -> None:
+        """Attach a ``loop.DriftMonitor``; the HTTP server then feeds it
+        one (feature, prediction) summary pair per request."""
+        self._drift = monitor
+
+    @property
+    def drift(self):
+        return self._drift
+
+    def observe_streams(
+        self, feature_stat: float, prediction_stat: float
+    ) -> None:
+        """Forward one request's stream summaries to the attached drift
+        monitor (no-op when none is attached).  Lock-free here — the
+        monitor holds its own lock, and this must never serialize the
+        request path behind drift scoring."""
+        d = self._drift
+        if d is not None:
+            d.observe(feature_stat, prediction_stat)
 
     def observe_error(self):
         with self._lock:

@@ -447,6 +447,11 @@ class ReplicaSet:
         self.redispatches = 0  # requests re-routed off a dying replica
         self.swaps = 0
         self.swap_history: List[Dict[str, Any]] = []
+        # Retired bundles retained for rollback (serve/swap.py): each
+        # entry keeps the ServableBundle pointer + its manifest, bounded
+        # to swap.HISTORY_DEPTH — the params trees are the real cost.
+        self.bundle_history: List[Dict[str, Any]] = []
+        self.rollbacks = 0
         self._born = time.monotonic()
         self.scale_events: List[Dict[str, Any]] = []
         self._closing = False

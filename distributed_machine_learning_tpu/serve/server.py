@@ -14,6 +14,10 @@ Endpoints::
                       504 on a per-request deadline miss
     POST /admin/swap  {"bundle": "<dir>"} -> zero-downtime hot swap of a
                       new bundle into the live ReplicaSet (serve/swap.py)
+    POST /admin/rollback  {} -> re-promote the newest RETAINED prior
+                      bundle (serve/swap.rollback) — zero-recompile, works
+                      with or without the loop controller; 409 when no
+                      prior bundle is retained
     GET  /healthz     {"status": "ok"|"degraded", "replicas": [...]}
     GET  /metrics     windowed latency p50/p99, throughput, queue depth,
                       batch fill ratio, shed/backpressure counters,
@@ -123,6 +127,13 @@ class PredictionServer:
             preds = self.replicas.predict(x, timeout=self._timeout_s)
         latency = time.time() - t0
         self.metrics.observe(latency, rows=x.shape[0])
+        if self.metrics.drift is not None:
+            # Drift detection (loop/drift.py): one scalar summary per
+            # stream per request — cheap enough for the hot path, and the
+            # monitor's windows turn it into per-window robust scores.
+            self.metrics.observe_streams(
+                float(np.mean(x)), float(np.mean(np.asarray(preds)))
+            )
         return {
             "predictions": np.asarray(preds).tolist(),
             "latency_ms": round(latency * 1000.0, 3),
@@ -156,6 +167,16 @@ class PredictionServer:
         self.bundle = self.replicas.bundle
         return {"swapped": True, **event}
 
+    def handle_rollback(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Re-promote the newest retained prior bundle (serve/swap.py)."""
+        from distributed_machine_learning_tpu.serve import swap as swap_lib
+
+        event = swap_lib.rollback(
+            self.replicas, reason=str(body.get("reason", "admin"))
+        )
+        self.bundle = self.replicas.bundle
+        return {"rolled_back": True, **event}
+
     def handle_metrics(self) -> Dict[str, Any]:
         programs = self.replicas.program_stats()
         batcher = self.replicas.batcher_stats()
@@ -186,6 +207,15 @@ class PredictionServer:
             "swap": {
                 "swaps_total": self.replicas.swaps,
                 "history": self.replicas.swap_history[-5:],
+                # Rollback readiness: how many retired bundles are still
+                # retained (serve/swap.HISTORY_DEPTH bound) and how many
+                # rollbacks have run — the "can I undo this promotion?"
+                # signals the runbook keys on.
+                "history_depth": len(self.replicas.bundle_history),
+                "retained": [
+                    e.get("path") for e in self.replicas.bundle_history
+                ],
+                "rollbacks_total": self.replicas.rollbacks,
             },
             # Checkpoint-to-ready cost (bundle params restore at load
             # time): the serving-side half of the ckpt/ wall-time story.
@@ -203,6 +233,11 @@ class PredictionServer:
                 self.replicas.bundle, "quality_delta_mape", None
             ),
         }
+        if self.metrics.drift is not None:
+            # The drift monitor's per-window scores + debounced trigger
+            # (loop/drift.py) — the self-healing loop's input signal,
+            # surfaced beside the serving counters it will act on.
+            out["drift"] = self.metrics.drift.snapshot()
         if self._fault_plan is not None:
             # A chaos soak's injections are observable where the breaker
             # state is — one endpoint tells the whole failure story.
@@ -254,7 +289,9 @@ class PredictionServer:
                     self._reply(500, {"error": repr(exc)})
 
             def do_POST(self):
-                if self.path not in ("/predict", "/admin/swap"):
+                if self.path not in (
+                    "/predict", "/admin/swap", "/admin/rollback"
+                ):
                     self._reply(404, {"error": f"no route {self.path}"})
                     return
                 try:
@@ -262,6 +299,15 @@ class PredictionServer:
                     body = json.loads(self.rfile.read(length) or b"{}")
                     if self.path == "/admin/swap":
                         self._reply(200, server.handle_swap(body))
+                        return
+                    if self.path == "/admin/rollback":
+                        try:
+                            self._reply(200, server.handle_rollback(body))
+                        except LookupError as exc:
+                            # Nothing retained: a conflict with current
+                            # state, not a bad request — 409 so retry
+                            # loops don't treat it as transient.
+                            self._reply(409, {"error": str(exc)})
                         return
                     self._reply(200, server.handle_predict(body))
                 except (ValueError, FileNotFoundError) as exc:

@@ -22,12 +22,25 @@ the last slot, the set's bundle pointer moves (monitor restarts now
 build the new model) and the zero-recompile ledger re-baselines, so
 ``new_programs_since_warmup`` keeps meaning "compiles caused by traffic"
 across the swap — the counter the soak bench asserts is zero.
+
+**Rollback** (ISSUE 17): the swap RETAINS the outgoing bundle — object
+pointer plus manifest — in ``replica_set.bundle_history`` (bounded to
+:data:`HISTORY_DEPTH` entries; each holds a full params tree, so the
+bound is memory, not cosmetics).  :func:`rollback` re-swaps the newest
+retained bundle; its AOT programs are still in the process-wide
+executable cache from its original warm, so a rollback compiles nothing
+— the same zero-recompile promotion path run in reverse.  This works
+with or without the loop controller: ``/admin/rollback`` drives it too.
 """
 
 from __future__ import annotations
 
 import time
 from typing import Any, Dict
+
+# Prior-bundle retention bound: each entry pins a full params tree in
+# host memory, so this is a real budget, not a ring-buffer nicety.
+HISTORY_DEPTH = 4
 
 
 def hot_swap(replica_set, new_bundle, sample=None,
@@ -43,9 +56,13 @@ def hot_swap(replica_set, new_bundle, sample=None,
     from distributed_machine_learning_tpu import obs
     from distributed_machine_learning_tpu.serve.replica import Replica
 
+    from distributed_machine_learning_tpu import chaos
+
     rs = replica_set
     if sample is None:
         sample = rs._warmup_sample
+    plan = getattr(rs, "_fault_plan", None) or chaos.active_plan()
+    prior = rs.bundle
     t0 = time.monotonic()
     swapped = 0
     obs.event("hot_swap_begin", {
@@ -76,6 +93,11 @@ def hot_swap(replica_set, new_bundle, sample=None,
             # on the OLD model, nothing is dropped mid-flight.
             old.batcher.stop(drain=True, timeout=10.0)
             swapped += 1
+            if plan is not None:
+                # Mid-promotion crash (chaos): some slots switched, the
+                # bundle pointer below never moves.  Raised OUTSIDE the
+                # dispatch lock, so the mixed fleet keeps serving.
+                plan.maybe_mid_swap_crash()
         rs.bundle = new_bundle
         stats = rs.program_stats()
         if rs._warmup_programs is not None:
@@ -90,6 +112,17 @@ def hot_swap(replica_set, new_bundle, sample=None,
         }
         rs.swap_history.append(event)
         del rs.swap_history[:-16]
+        if prior is not None and prior is not new_bundle:
+            # Retain the outgoing bundle (pointer + manifest) so rollback
+            # needs neither a reload nor a recompile — its programs are
+            # still warm in the process-wide executable cache.
+            rs.bundle_history.append({
+                "bundle": prior,
+                "path": getattr(prior, "path", None),
+                "manifest": dict(getattr(prior, "manifest", {}) or {}),
+                "retired_at_unix": round(time.time(), 3),
+            })
+            del rs.bundle_history[:-HISTORY_DEPTH]
     return event
 
 
@@ -101,3 +134,42 @@ def warm_swap_bundle(replica_set, bundle_dir: str,
 
     bundle = load_bundle(bundle_dir)
     return hot_swap(replica_set, bundle, sample=sample)
+
+
+def rollback(replica_set, sample=None,
+             reason: str = "manual") -> Dict[str, Any]:
+    """Re-promote the newest RETAINED prior bundle (the one the last
+    swap retired) — the ``/admin/rollback`` endpoint and the loop
+    controller's probation-failure path.
+
+    Zero-recompile by construction: the prior bundle's bucket programs
+    were compiled at its original warm and the executable cache is
+    process-wide, so the re-swap's warmup is all cache hits.  Raises
+    :class:`LookupError` when nothing is retained (fresh set, or the
+    history bound already evicted it)."""
+    from distributed_machine_learning_tpu import obs
+
+    rs = replica_set
+    with rs._scale_lock:
+        entry = rs.bundle_history.pop() if rs.bundle_history else None
+    if entry is None:
+        raise LookupError(
+            "no prior bundle retained — nothing to roll back to"
+        )
+    obs.event("rollback_begin", {
+        "to": entry.get("path"), "reason": reason,
+    })
+    event = hot_swap(rs, entry["bundle"], sample=sample)
+    event = dict(
+        event, rollback=True, reason=reason,
+        rolled_back_to=entry.get("path"),
+    )
+    with rs._scale_lock:
+        rs.rollbacks += 1
+        # The plain-swap event already landed in swap_history; overwrite
+        # the tail with the annotated one so /metrics tells a rollback
+        # apart from a promotion.
+        if rs.swap_history:
+            rs.swap_history[-1] = event
+    obs.get_registry().add("serve_rollbacks")
+    return event

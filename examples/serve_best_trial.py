@@ -149,6 +149,7 @@ def main(argv=None):
     # slot drains-and-switches — no request dropped, nothing compiled.
     next_dir = os.path.join(root, "bundle_next")
     serve.export_bundle(analysis, next_dir)
+    # dmlint: disable=unguarded-promotion mechanics demo: the "next model" IS the incumbent re-exported (bit-identical params), and the allclose below is the quality check — probation would watch a model we just proved identical
     event = server.replicas.hot_swap(serve.load_bundle(next_dir))
     after = json.loads(urllib.request.urlopen(f"{base}/metrics").read())
     assert after["swap"]["swaps_total"] == 1

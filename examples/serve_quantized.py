@@ -106,6 +106,7 @@ def main(argv=None):
     swap_at = args.requests // 2
     for i, n in enumerate(sizes):
         if i == swap_at:
+            # dmlint: disable=unguarded-promotion quality is pre-audited, not probation-watched: the manifest carries the MEASURED quality_delta_mape vs the f32 parent and step 5 re-verifies the served delta against it
             event = serve.hot_swap(
                 server.replicas, b8,
                 sample=np.asarray(val.x[:1], np.float32),

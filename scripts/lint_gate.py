@@ -60,6 +60,8 @@ def main(argv=None) -> int:
                    help="skip the obs disabled-path overhead guard")
     p.add_argument("--no-quant-smoke", action="store_true",
                    help="skip the quantize-export-load smoke")
+    p.add_argument("--no-loop-smoke", action="store_true",
+                   help="skip the drift-retrain-promote loop smoke")
     args = p.parse_args(argv)
 
     cmd = [sys.executable, "-m", "distributed_machine_learning_tpu",
@@ -104,6 +106,10 @@ def main(argv=None) -> int:
             return rc
     if proc.returncode == 0 and not args.no_quant_smoke:
         rc = _quant_smoke(env)
+        if rc:
+            return rc
+    if proc.returncode == 0 and not args.no_loop_smoke:
+        rc = _loop_smoke(env)
         if rc:
             return rc
     return proc.returncode
@@ -193,6 +199,82 @@ def _quant_smoke(env) -> int:
         print("quant smoke: FAILED")
         return 1
     print(f"quant smoke: ok {proc.stdout.strip().splitlines()[-1]}")
+    return 0
+
+
+def _loop_smoke(env) -> int:
+    """One self-healing episode in a child (JAX_PLATFORMS=cpu): a tiny
+    served mlp drifts, the monitor triggers, and the controller's
+    journaled retrain-gate-swap-probation episode must land PROMOTED
+    with zero serving-path compiles — the loop/ contract, gated like a
+    lint finding."""
+    code = (
+        "import json, os, tempfile\n"
+        "import numpy as np\n"
+        "from distributed_machine_learning_tpu import chaos, loop, serve\n"
+        "from distributed_machine_learning_tpu.models import build_model\n"
+        "from distributed_machine_learning_tpu.serve import export as ex\n"
+        "from distributed_machine_learning_tpu.tune._regression_program \\\n"
+        "    import detect_call_convention\n"
+        "W = np.array([0.7, -0.4, 1.1], np.float32)\n"
+        "DRIFT = {'at_request': 0, 'feature_shift': 2.5,\n"
+        "         'label_shift': 0.5, 'seed': 11}\n"
+        "def make_xy(n, seed, drifted=False):\n"
+        "    r = np.random.default_rng(seed)\n"
+        "    x = r.standard_normal((n, 4, 3)).astype(np.float32)\n"
+        "    y = (x[:, -2:, :] @ W).mean(axis=1, keepdims=True)\n"
+        "    if drifted:\n"
+        "        x, y = chaos.apply_drift(DRIFT, x, y)\n"
+        "    return x.astype(np.float32), y.astype(np.float32)\n"
+        "def data_fn(kind):\n"
+        "    seeds = {'train': 100, 'holdout': 200, 'probation': 300}\n"
+        "    return make_xy(48, seeds[kind], drifted=True)\n"
+        "config = {'model': 'mlp', 'hidden_sizes': [8], 'seed': 3}\n"
+        "x, y = make_xy(64, 1)\n"
+        "probe, _ = detect_call_convention(build_model(config), x[:1])\n"
+        "variables, _ = loop.fine_tune(config, {'params': probe['params']},\n"
+        "                              x, y, epochs=4, learning_rate=0.05,\n"
+        "                              seed=0)\n"
+        "root = tempfile.mkdtemp(prefix='loop_smoke_')\n"
+        "inc = os.path.join(root, 'incumbent')\n"
+        "ex.write_bundle(inc, {'bundle_version': ex.BUNDLE_VERSION,\n"
+        "                      'config': config, 'precision': 'f32'},\n"
+        "                variables)\n"
+        "srv = serve.PredictionServer(serve.load_bundle(inc), port=0,\n"
+        "                             num_replicas=1, max_bucket=16)\n"
+        "srv.warmup(x[:1])\n"
+        "drift = loop.DriftMonitor(window=16, z_threshold=4.0, sustain=3)\n"
+        "srv.metrics.attach_drift(drift)\n"
+        "for i in range(40):\n"
+        "    xb, _ = make_xy(4, 1000 + i, drifted=i >= 18)\n"
+        "    preds = np.asarray(srv.replicas.predict(xb))\n"
+        "    srv.metrics.observe_streams(float(np.mean(xb)),\n"
+        "                                float(np.mean(preds)))\n"
+        "ctl = loop.SelfHealingController(\n"
+        "    srv, loop.LoopJournal(os.path.join(root, 'loop.json')),\n"
+        "    drift, data_fn, root,\n"
+        "    loop.LoopConfig(retrain_epochs=3, probation_batches=2))\n"
+        "outcome = ctl.poll()\n"
+        "assert outcome is not None, 'drift never triggered'\n"
+        "assert outcome['state'] == 'promoted', outcome\n"
+        "stats = srv.replicas.program_stats()\n"
+        "assert stats['new_programs_since_warmup'] == 0, stats\n"
+        "srv.close()\n"
+        "print(json.dumps({'state': outcome['state'],\n"
+        "                  'probation_mape':\n"
+        "                      round(outcome['probation_mape'], 4),\n"
+        "                  'incumbent_mape':\n"
+        "                      round(outcome['incumbent_mape'], 4)}))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO, capture_output=True, text=True, env=env, timeout=300,
+    )
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr)
+        print("loop smoke: FAILED")
+        return 1
+    print(f"loop smoke: ok {proc.stdout.strip().splitlines()[-1]}")
     return 0
 
 

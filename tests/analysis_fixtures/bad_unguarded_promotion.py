@@ -1,0 +1,28 @@
+# dmlint-scope: promotion-guard
+"""Historical risk pattern (ISSUE 17 satellite): loop-orchestration code
+reaching past the promotion guard.  The self-healing contract is that a
+candidate touches traffic only via gate -> probation -> (auto-rollback);
+a controller or example that calls ``hot_swap``/``warm_swap_bundle``
+directly promotes an unvetted bundle with nothing watching it."""
+
+
+def react_to_drift(replica_set, candidate):
+    """Drift handler that swaps immediately: no gate, no probation."""
+    return replica_set.hot_swap(candidate)  # EXPECT: unguarded-promotion
+
+
+def refresh_model(rs, bundle, sample):
+    from distributed_machine_learning_tpu.serve import swap
+
+    # Skipping the controller "because the candidate looks fine" is
+    # exactly the promotion that regresses in production.
+    swap.warm_swap_bundle(rs, bundle, sample)  # EXPECT: unguarded-promotion
+
+
+class EagerController:
+    def promote(self, candidate):
+        # "promote" is not a guard name — the method neither watches a
+        # probation window nor retains a rollback path.
+        from distributed_machine_learning_tpu.serve.swap import hot_swap
+
+        hot_swap(self.rs, candidate)  # EXPECT: unguarded-promotion

@@ -1,0 +1,37 @@
+# dmlint-scope: promotion-guard
+"""Idiomatic twins of bad_unguarded_promotion.py: every promotion runs
+inside a probation/guard/rollback-owning function — the sites DML019
+sanctions — or goes through the controller's guarded public API."""
+
+
+def promote_with_probation(rs, candidate, watch):
+    """The sanctioned shape: swap, then WATCH, with rollback armed."""
+    from distributed_machine_learning_tpu.serve import swap
+
+    event = swap.hot_swap(rs, candidate)
+    if not watch(rs):
+        swap.rollback(rs, reason="probation_regression")
+    return event
+
+
+def rollback_to_prior(rs, sample):
+    """Rollback paths may swap freely: they restore the vetted prior."""
+    from distributed_machine_learning_tpu.serve import swap
+
+    entry = rs.bundle_history[-1]
+    return swap.warm_swap_bundle(rs, entry["bundle"], sample)
+
+
+def react_to_drift(controller):
+    """Orchestration code routes promotions through the guarded API."""
+    result = controller.poll()
+    return result
+
+
+def guarded_refresh(rs, candidate, probation_ok):
+    event = rs.hot_swap(candidate)
+    if not probation_ok():
+        from distributed_machine_learning_tpu.serve.swap import rollback
+
+        rollback(rs, reason="probation_regression")
+    return event

@@ -50,6 +50,7 @@ def _capture_file_in_tmp(monkeypatch, tmp_path):
     # child stubs minimal.  Same for the streaming section child.
     monkeypatch.setenv("DML_BENCH_QUALITY_BUDGET_S", "0")
     monkeypatch.setenv("DML_BENCH_STREAMING", "0")
+    monkeypatch.setenv("DML_BENCH_ONLINE_LOOP", "0")
 
 
 def _detail() -> dict:
@@ -88,6 +89,19 @@ _STREAMING_STUB = {
     "bytes_staged": 9_000_000, "prefetch_hits": 118, "consumer_waits": 2,
     "consumer_wait_s": 0.4, "producer_waits": 5, "producer_wait_s": 10.0,
     "params_bit_identical": True, "wall_s": 30.0,
+}
+
+
+# What the online_loop child emits, for parent-flow stubs (the child itself
+# runs for real in test_child_online_loop_end_to_end_tiny).
+_ONLINE_LOOP_STUB = {
+    "platform": "cpu", "state": "promoted", "detect_s": 0.05,
+    "heal_s": 1.7, "recovery_s": 1.75, "clean_mape": 0.66,
+    "drifted_mape": 14.2, "healed_mape": 1.0, "recovered": True,
+    "drift_triggers": 1, "episodes": 1, "promotions": 1, "requests": 78,
+    "requests_total": 78, "dropped": 0, "swaps_total": 1,
+    "post_swap_new_programs": 0, "probation_mape": 1.15,
+    "incumbent_mape": 5.86, "wall_s": 3.6,
 }
 
 
@@ -336,10 +350,13 @@ def test_main_cpu_fallback_emit_fields(monkeypatch, capsys):
             return 0, json.dumps(_SOAK_STUB), "", True
         if args[:2] == ["--child", "streaming"]:
             return 0, json.dumps(_STREAMING_STUB), "", True
+        if args[:2] == ["--child", "online_loop"]:
+            return 0, json.dumps(_ONLINE_LOOP_STUB), "", True
         raise AssertionError(f"unexpected child {args}")
 
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
     monkeypatch.setenv("DML_BENCH_STREAMING", "1")
+    monkeypatch.setenv("DML_BENCH_ONLINE_LOOP", "1")
     monkeypatch.delenv("DML_TUNNEL_PYTHONPATH", raising=False)
     # A banked chip capture exists (as in the real repo) -> the reference
     # backend is tpu and a CPU fallback is cross-backend.
@@ -393,6 +410,14 @@ def test_main_cpu_fallback_emit_fields(monkeypatch, capsys):
     assert line["streaming"]["pass_0p9"] is True
     assert line["streaming"]["overlap_efficiency"] == 0.97
     assert line["streaming"]["resident_over_budget"] is True
+    # online_loop section (ISSUE 17): full evidence in the sidecar,
+    # compact recovery claims in the emitted line.
+    assert detail["online_loop"]["state"] == "promoted"
+    assert detail["online_loop"]["drift_triggers"] == 1
+    assert "online_loop_s" in detail["phases"]
+    assert line["online_loop"]["recovered"] is True
+    assert line["online_loop"]["dropped"] == 0
+    assert line["online_loop"]["post_swap_new_programs"] == 0
     assert "streaming_s" in detail["phases"]
 
 
@@ -1276,6 +1301,22 @@ def test_child_streaming_end_to_end_tiny(monkeypatch, capsys):
     # pass_0p9 is the bench ACCEPTANCE on real runs; at this toy size the
     # ratio is noisy, so assert it is derived consistently, not its value.
     assert out["pass_0p9"] == (out["step_rate_vs_resident"] >= 0.9)
+
+
+def test_child_online_loop_end_to_end_tiny(capsys):
+    """child_online_loop for real: the served model drifts, the monitor
+    triggers once, the journaled episode promotes a retrained candidate,
+    and the recovery claims are counter-verified — zero dropped requests,
+    zero serving-path compiles."""
+    bench.child_online_loop()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["state"] == "promoted"
+    assert out["drift_triggers"] == 1 and out["promotions"] == 1
+    assert out["recovered"] is True
+    assert out["healed_mape"] < out["drifted_mape"]
+    assert out["dropped"] == 0
+    assert out["post_swap_new_programs"] == 0
+    assert out["detect_s"] >= 0 and out["heal_s"] > 0
 
 
 def test_multihost_section_cpu_and_tunnel_skip_with_reason(monkeypatch):

@@ -201,3 +201,48 @@ def test_analyze_subcommand(tmp_path):
         assert proc.returncode == 1, extra
         assert "typo_metric" in proc.stderr
         assert "Traceback" not in proc.stderr
+
+
+def test_loop_status_subcommand(tmp_path):
+    """`loop status` reads a journal (file or out_dir), prints the
+    episode trail + counters, flags open episodes, and --json emits the
+    raw documents; unreadable paths get a one-liner, not a stack dump."""
+    doc = {
+        "episode": 2, "state": "retraining", "trace_id": "abc123",
+        "data": {"warm_start": "/ckpts/gen_0007"},
+        "history": [
+            {"state": "detected", "at_unix": 100.0},
+            {"state": "retraining", "at_unix": 101.5,
+             "warm_start": "/ckpts/gen_0007"},
+        ],
+        "completed_episodes": 1, "promotions": 1, "rollbacks": 0,
+    }
+    with open(tmp_path / "loop.json", "w") as f:
+        json.dump(doc, f)
+    with open(tmp_path / "experiment_state.json", "w") as f:
+        json.dump({"loop": {"episodes": 2, "promotions": 1,
+                            "rollbacks": 0, "resumes": 1,
+                            "gate_rejects": 0, "aborts": 0}}, f)
+
+    # Directory form resolves to <dir>/loop.json.
+    proc = _run(["loop", "status", str(tmp_path)], timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "episode 2: retraining" in proc.stdout
+    assert "OPEN" in proc.stdout          # non-terminal -> resume hint
+    assert "abc123" in proc.stdout
+    assert "warm_start=/ckpts/gen_0007" in proc.stdout
+    assert "resumes=1" in proc.stdout
+
+    # --json round-trips both documents.
+    proc = _run(["loop", "status", str(tmp_path / "loop.json"), "--json"],
+                timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["journal"]["state"] == "retraining"
+    assert out["counters"]["resumes"] == 1
+
+    proc = _run(["loop", "status", str(tmp_path / "missing.json")],
+                timeout=60)
+    assert proc.returncode == 1
+    assert "cannot read journal" in proc.stderr
+    assert "Traceback" not in proc.stderr

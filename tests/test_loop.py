@@ -1,0 +1,632 @@
+"""loop/: the self-healing serving loop (ISSUE 17) — drift detection,
+journaled retrain episodes, guarded promotion with probation rollback,
+and the chaos-hardened end-to-end: drifting stream + producer crash +
+mid-promotion replica kill + controller crash, with zero dropped
+requests, zero serving-path compiles after warmup, and one trace id
+spanning detection through probation."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu import chaos, loop, obs, serve
+from distributed_machine_learning_tpu.models import build_model
+from distributed_machine_learning_tpu.serve.export import (
+    BUNDLE_VERSION,
+    write_bundle,
+)
+from distributed_machine_learning_tpu.tune._regression_program import (
+    detect_call_convention,
+)
+
+SEQ, FEAT = 4, 3
+_W = np.array([0.7, -0.4, 1.1], np.float32)
+
+DRIFT_SPEC = {
+    "at_request": 0, "feature_shift": 2.5,
+    "label_scale": 1.0, "label_shift": 0.5, "seed": 11,
+}
+
+
+def _make_xy(n, seed, drift=None):
+    """The synthetic labeled stream: stationary by default, shifted
+    through chaos.apply_drift when ``drift`` is given."""
+    r = np.random.default_rng(seed)
+    x = r.standard_normal((n, SEQ, FEAT)).astype(np.float32)
+    y = (x[:, -2:, :] @ _W).mean(axis=1, keepdims=True)
+    if drift is not None:
+        x, y = chaos.apply_drift(drift, x, y)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def _drifted_data_fn(kind):
+    seeds = {"train": 100, "holdout": 200, "probation": 300}
+    return _make_xy(48, seeds[kind], DRIFT_SPEC)
+
+
+CONFIG = {"model": "mlp", "hidden_sizes": [8], "seed": 3}
+
+
+@pytest.fixture(scope="module")
+def incumbent_variables():
+    """One briefly-trained incumbent shared by the module (training it
+    once keeps gate comparisons meaningful without per-test fit cost)."""
+    x, y = _make_xy(64, 1)
+    model = build_model(CONFIG)
+    probe, _ = detect_call_convention(model, x[:1])
+    variables = {"params": probe["params"]}
+    if "batch_stats" in probe:
+        variables["batch_stats"] = probe["batch_stats"]
+    variables, _ = loop.fine_tune(
+        CONFIG, variables, x, y, epochs=8, learning_rate=0.05, seed=0
+    )
+    return variables
+
+
+def _bundle_dir(tmp_path, variables, name="incumbent", scale=None):
+    out = str(tmp_path / name)
+    if scale is not None:
+        import jax
+
+        variables = dict(variables)
+        variables["params"] = jax.tree.map(
+            lambda a: np.asarray(a) * scale, variables["params"]
+        )
+    write_bundle(
+        out,
+        {"bundle_version": BUNDLE_VERSION, "config": CONFIG,
+         "precision": "f32"},
+        variables,
+    )
+    return out
+
+
+def _server(bundle_dir, fault_plan=None, num_replicas=1):
+    srv = serve.PredictionServer(
+        serve.load_bundle(bundle_dir), port=0,
+        num_replicas=num_replicas, max_bucket=16, fault_plan=fault_plan,
+    )
+    srv.warmup(_make_xy(1, 0)[0])
+    return srv
+
+
+def _controller(srv, tmp_path, drift=None, plan=None, **cfg_kwargs):
+    drift = drift or loop.DriftMonitor(window=24, z_threshold=4.0,
+                                       sustain=4)
+    journal = loop.LoopJournal(str(tmp_path / "loop.json"))
+    cfg = loop.LoopConfig(retrain_epochs=5, probation_batches=4,
+                          **cfg_kwargs)
+    ctl = loop.SelfHealingController(
+        srv, journal, drift, _drifted_data_fn, str(tmp_path),
+        cfg, fault_plan=plan,
+    )
+    return ctl, drift, journal
+
+
+def _feed(srv, n, seed0, drift=None):
+    """``n`` requests through the live replica set + drift monitor;
+    returns mean served MAPE."""
+    apes = []
+    for i in range(n):
+        xb, yb = _make_xy(4, seed0 + i, drift)
+        preds = np.asarray(srv.replicas.predict(xb))
+        srv.metrics.observe_streams(
+            float(np.mean(xb)), float(np.mean(preds))
+        )
+        apes.append(float(np.mean(
+            np.abs(yb - preds) / (np.abs(yb) + 1e-8)
+        )))
+    return float(np.mean(apes))
+
+
+# --------------------------------------------------------------------------
+# drift monitor
+# --------------------------------------------------------------------------
+
+
+def test_drift_monitor_trigger_and_debounce():
+    mon = loop.DriftMonitor(window=16, z_threshold=4.0, sustain=3)
+    try:
+        r = np.random.default_rng(0)
+        for _ in range(20):  # freeze baselines
+            mon.observe(float(r.normal()), float(r.normal()))
+        for _ in range(10):  # stationary current window
+            mon.observe(float(r.normal()), float(r.normal()))
+        assert mon.consume_trigger() is None
+        snap = mon.snapshot()
+        assert snap["baseline_frozen_features"]
+        assert snap["triggers"] == 0
+
+        for _ in range(20):  # a genuine shift on both streams
+            mon.observe(float(5 + r.normal()), float(5 + r.normal()))
+        snap = mon.snapshot()
+        assert snap["triggers"] == 1 and snap["trigger_pending"]
+        assert snap["score_features"] > 4.0
+
+        detail = mon.consume_trigger()
+        assert detail is not None and "features" in detail["streams"]
+        assert mon.consume_trigger() is None  # exactly once
+        # Disarmed: further drift cannot re-trigger until rearm.
+        for _ in range(20):
+            mon.observe(float(9 + r.normal()), float(9 + r.normal()))
+        assert mon.snapshot()["triggers"] == 1
+    finally:
+        mon.close()
+
+
+def test_drift_monitor_rearm_semantics():
+    mon = loop.DriftMonitor(window=16, z_threshold=4.0, sustain=3)
+    try:
+        r = np.random.default_rng(1)
+        for _ in range(40):
+            mon.observe(float(r.normal()), float(r.normal()))
+        for _ in range(20):
+            mon.observe(float(6 + r.normal()), float(6 + r.normal()))
+        assert mon.consume_trigger() is not None
+
+        # rearm(rebaseline=True): the drifted distribution is the new
+        # normal — continuing at the same level must NOT re-trigger.
+        mon.rearm(rebaseline=True)
+        for _ in range(40):
+            mon.observe(float(6 + r.normal()), float(6 + r.normal()))
+        assert mon.consume_trigger() is None
+
+        # ...but a FURTHER shift from the adopted baseline re-triggers.
+        for _ in range(20):
+            mon.observe(float(12 + r.normal()), float(12 + r.normal()))
+        assert mon.consume_trigger() is not None
+
+        # rearm(rebaseline=False) keeps the old baseline: still-drifted
+        # traffic re-triggers (the rollback case — drift is still real).
+        mon.rearm(rebaseline=False)
+        for _ in range(20):
+            mon.observe(float(12 + r.normal()), float(12 + r.normal()))
+        assert mon.consume_trigger() is not None
+    finally:
+        mon.close()
+
+
+def test_drift_monitor_registry_family():
+    mon = loop.DriftMonitor(window=8)
+    try:
+        fams = obs.get_registry().snapshot()["families"]
+        assert "drift" in fams and fams["drift"]["observations"] == 0
+    finally:
+        mon.close()
+    assert "drift" not in obs.get_registry().snapshot()["families"]
+
+
+# --------------------------------------------------------------------------
+# journal
+# --------------------------------------------------------------------------
+
+
+def test_journal_transitions_and_exactly_once_guard(tmp_path):
+    j = loop.LoopJournal(str(tmp_path / "j.json"))
+    ep = j.begin_episode("trace-1", trigger=["features"])
+    assert ep == 1 and j.state == "detected"
+    with pytest.raises(RuntimeError):  # open episode blocks a second
+        j.begin_episode("trace-2")
+    j.transition("retraining", warm_start="/ckpt/3")
+    j.transition("candidate", candidate="/cand")
+    j.transition("probation", swapped=True)
+    j.transition("promoted")
+    # Data merges across transitions; terminal counters bump once.
+    assert j.data["candidate"] == "/cand" and j.data["swapped"] is True
+    snap = j.snapshot()
+    assert snap["completed_episodes"] == 1 and snap["promotions"] == 1
+    assert not j.open_episode()
+    assert j.begin_episode("trace-2") == 2  # terminal episode unblocks
+
+    # Durability: a fresh reader sees exactly the journaled state.
+    j2 = loop.LoopJournal(str(tmp_path / "j.json"))
+    assert j2.episode == 2 and j2.state == "detected"
+    assert j2.trace_id == "trace-2"
+    with pytest.raises(ValueError):
+        j.transition("nonsense")
+
+
+# --------------------------------------------------------------------------
+# controller: crash-resume matrix, rollback, chaos legs
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "crash_state", ["detected", "retraining", "candidate", "probation"]
+)
+def test_controller_crash_resume_completes_exactly_once(
+    tmp_path, incumbent_variables, crash_state
+):
+    """Crash at EVERY journal transition; a fresh controller incarnation
+    resumes from the journal and the episode completes exactly once."""
+    plan = chaos.FaultPlan(seed=7, controller_crash_at=(crash_state,))
+    srv = _server(_bundle_dir(tmp_path, incumbent_variables))
+    ctl, drift, journal = _controller(srv, tmp_path, plan=plan)
+    try:
+        with pytest.raises(chaos.InjectedControllerCrash):
+            ctl.run_episode({"streams": ["features"]})
+        assert journal.open_episode()
+        assert plan.snapshot()["controller_crashes"] == 1
+        ctl.close()
+
+        # A new incarnation (fresh journal object, same path) resumes.
+        drift2 = loop.DriftMonitor(window=24, z_threshold=4.0, sustain=4)
+        journal2 = loop.LoopJournal(str(tmp_path / "loop.json"))
+        ctl2 = loop.SelfHealingController(
+            srv, journal2, drift2, _drifted_data_fn, str(tmp_path),
+            loop.LoopConfig(retrain_epochs=5, probation_batches=4),
+            fault_plan=plan,
+        )
+        try:
+            result = ctl2.resume()
+            assert result is not None
+            assert result["state"] in ("promoted", "rolled_back")
+            assert journal2.snapshot()["completed_episodes"] == 1
+            assert ctl2.resume() is None  # exactly once: terminal no-op
+            assert journal2.snapshot()["completed_episodes"] == 1
+        finally:
+            ctl2.close()
+            drift2.close()
+    finally:
+        ctl.close()
+        drift.close()
+        srv.close()
+
+
+def test_probation_rollback_on_regressed_candidate(
+    tmp_path, incumbent_variables
+):
+    """Satellite 4: a deliberately-worse candidate passes through the
+    guarded promotion and is auto-rolled-back — with zero dropped
+    requests and zero new serving-path compiles, counter-verified."""
+    incumbent_dir = _bundle_dir(tmp_path, incumbent_variables)
+    bad_dir = _bundle_dir(tmp_path, incumbent_variables, "bad", scale=25.0)
+    srv = _server(incumbent_dir)
+    ctl, drift, journal = _controller(srv, tmp_path)
+    try:
+        programs_before = srv.replicas.program_stats()
+        result = ctl.promote_with_probation(bad_dir)
+        assert result["state"] == "rolled_back"
+        assert result["probation_mape"] > result["threshold"]
+        # The fleet serves the incumbent again, remembered by path.
+        assert srv.replicas.bundle.path == incumbent_dir
+        assert srv.bundle.path == incumbent_dir
+        assert srv.replicas.rollbacks == 1
+        assert ctl.snapshot()["rollbacks"] == 1
+        # Zero-recompile promotion AND rollback: same program class.
+        stats = srv.replicas.program_stats()
+        assert stats["new_programs_since_warmup"] == 0, stats
+        assert stats["programs"] == programs_before["programs"]
+        # Probation traffic all answered (predict raised nowhere), and
+        # the swap history annotated the rollback for forensics.
+        last = srv.replicas.swap_history[-1]
+        assert last["rollback"] and last["reason"] == "probation_regression"
+    finally:
+        ctl.close()
+        drift.close()
+        srv.close()
+
+
+def test_mid_retrain_crash_absorbed_by_retry_budget(
+    tmp_path, incumbent_variables
+):
+    plan = chaos.FaultPlan(seed=3, trial_crashes=(("loop-ep1", 2),))
+    srv = _server(_bundle_dir(tmp_path, incumbent_variables))
+    ctl, drift, journal = _controller(srv, tmp_path, plan=plan)
+    try:
+        result = ctl.run_episode({"streams": ["features"]})
+        assert result["state"] in ("promoted", "rolled_back")
+        assert ctl.snapshot()["retrain_retries"] == 1
+        assert plan.snapshot()["trial_crashes"] == 1
+    finally:
+        ctl.close()
+        drift.close()
+        srv.close()
+
+
+def test_corrupt_candidate_reexported_then_promoted(
+    tmp_path, incumbent_variables
+):
+    """One scheduled export corruption: the gate load refuses the torn
+    bundle (checkpoint sha256), the episode rewinds to retraining, and
+    the clean re-export promotes — the old model served throughout."""
+    plan = chaos.FaultPlan(seed=5, corrupt_bundle_on_export=1)
+    srv = _server(_bundle_dir(tmp_path, incumbent_variables))
+    with chaos.active(plan):
+        ctl, drift, journal = _controller(srv, tmp_path, plan=plan)
+        try:
+            result = ctl.run_episode({"streams": ["features"]})
+            assert result["state"] in ("promoted", "rolled_back")
+            snap = ctl.snapshot()
+            assert snap["candidate_corruptions"] == 1
+            assert plan.snapshot()["bundle_corruptions"] == 1
+        finally:
+            ctl.close()
+            drift.close()
+            srv.close()
+
+
+def test_corrupt_candidate_budget_exhausted_aborts_gracefully(
+    tmp_path, incumbent_variables
+):
+    """A corruptor that outlives the export budget lands in ``aborted``
+    with the OLD bundle still serving — degrade, don't promote."""
+    plan = chaos.FaultPlan(seed=5, corrupt_bundle_on_export=5)
+    incumbent_dir = _bundle_dir(tmp_path, incumbent_variables)
+    srv = _server(incumbent_dir)
+    with chaos.active(plan):
+        ctl, drift, journal = _controller(
+            srv, tmp_path, plan=plan, export_retries=1
+        )
+        try:
+            result = ctl.run_episode({"streams": ["features"]})
+            assert result["state"] == "aborted"
+            assert result["reason"] == "candidate_corrupt"
+            assert ctl.snapshot()["candidate_corruptions"] == 2
+            assert srv.replicas.bundle.path == incumbent_dir
+            x = _make_xy(3, 9)[0]
+            assert np.asarray(srv.replicas.predict(x)).shape[0] == 3
+        finally:
+            ctl.close()
+            drift.close()
+            srv.close()
+
+
+def test_gate_rejects_non_improving_candidate(
+    tmp_path, incumbent_variables
+):
+    """The quality gate refuses a candidate that does not beat the
+    incumbent on the holdout window — nothing is ever swapped."""
+    incumbent_dir = _bundle_dir(tmp_path, incumbent_variables)
+    srv = _server(incumbent_dir)
+    # An impossible gate: even a better candidate cannot pass ratio 0.
+    ctl, drift, journal = _controller(
+        srv, tmp_path, gate_ratio=0.0, gate_margin=0.0
+    )
+    try:
+        result = ctl.run_episode({"streams": ["features"]})
+        assert result["state"] == "aborted"
+        assert result["reason"] == "gate_reject"
+        assert ctl.snapshot()["gate_rejects"] == 1
+        assert srv.replicas.bundle.path == incumbent_dir
+        assert srv.replicas.program_stats()[
+            "new_programs_since_warmup"] == 0
+    finally:
+        ctl.close()
+        drift.close()
+        srv.close()
+
+
+# --------------------------------------------------------------------------
+# swap history + /admin/rollback (satellite 2, HTTP surface)
+# --------------------------------------------------------------------------
+
+
+def test_swap_history_metrics_and_admin_rollback(
+    tmp_path, incumbent_variables
+):
+    import urllib.error
+    import urllib.request
+
+    incumbent_dir = _bundle_dir(tmp_path, incumbent_variables)
+    next_dir = _bundle_dir(tmp_path, incumbent_variables, "next")
+    srv = _server(incumbent_dir)
+    host, port = srv.start()
+    base = f"http://{host}:{port}"
+
+    def post(path, body):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    def metrics():
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            return json.loads(r.read())
+
+    try:
+        # A fresh fleet has retired nothing: rollback is 409, not 500.
+        assert metrics()["swap"]["history_depth"] == 0
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            post("/admin/rollback", {})
+        assert exc_info.value.code == 409
+
+        post("/admin/swap", {"bundle": next_dir})
+        m = metrics()["swap"]
+        assert m["history_depth"] == 1
+        assert m["retained"] == [incumbent_dir]
+
+        out = post("/admin/rollback", {"reason": "operator"})
+        assert out["rollback"] and out["rolled_back_to"] == incumbent_dir
+        assert srv.replicas.bundle.path == incumbent_dir
+        m = metrics()["swap"]
+        assert m["rollbacks_total"] == 1
+        # The rolled-back-FROM bundle is itself retained (roll forward
+        # stays possible), so depth is 1 again — now holding next_dir.
+        assert m["history_depth"] == 1
+        assert m["retained"] == [next_dir]
+        assert m["history"][-1]["rollback"] is True
+    finally:
+        srv.close()
+
+
+def test_swap_history_bounded(tmp_path, incumbent_variables):
+    from distributed_machine_learning_tpu.serve import swap as swap_lib
+
+    dirs = [
+        _bundle_dir(tmp_path, incumbent_variables, f"gen{i}")
+        for i in range(swap_lib.HISTORY_DEPTH + 3)
+    ]
+    srv = _server(dirs[0])
+    try:
+        for d in dirs[1:]:
+            swap_lib.hot_swap(srv.replicas, serve.load_bundle(d))
+        assert len(srv.replicas.bundle_history) == swap_lib.HISTORY_DEPTH
+        retained = [e["path"] for e in srv.replicas.bundle_history]
+        assert retained == dirs[-swap_lib.HISTORY_DEPTH - 1:-1]
+    finally:
+        srv.close()
+
+
+# --------------------------------------------------------------------------
+# the chaos-hardened end-to-end (acceptance)
+# --------------------------------------------------------------------------
+
+
+def test_self_healing_e2e_under_chaos(tmp_path, incumbent_variables):
+    """ISSUE 17 acceptance: a drifting stream with a producer crash, a
+    mid-promotion replica kill, a mid-swap crash, and one controller
+    crash.  Quality recovers after promotion, a deliberately-bad
+    candidate auto-rolls-back, zero requests dropped, zero serving-path
+    compiles after warmup, and ONE trace id spans detection -> retrain ->
+    swap -> probation — verified in experiment_state.json["loop"],
+    /metrics, and the trace stream."""
+    obs.configure(trace_dir=str(tmp_path / "traces"),
+                  dump_dir=str(tmp_path / "dumps"))
+    plan = chaos.FaultPlan(
+        seed=13,
+        drift_inject={"at_request": 28, "feature_shift": 2.5,
+                      "label_scale": 1.0, "label_shift": 0.5},
+        producer_crash_at=35,            # the labeled-stream producer
+        replica_kills=((50, 0),),        # lands mid-probation traffic
+        mid_swap_crash=(1,),             # first slot switch of the swap
+        controller_crash_at=("candidate",),
+    )
+    incumbent_dir = _bundle_dir(tmp_path, incumbent_variables)
+    srv = _server(incumbent_dir, fault_plan=plan, num_replicas=2)
+    drift = loop.DriftMonitor(window=24, z_threshold=4.0, sustain=4)
+    srv.metrics.attach_drift(drift)
+
+    global DRIFT_SPEC
+    spec_before = DRIFT_SPEC
+    dropped = 0
+    sent = 0
+
+    def feed(n, seed0):
+        """The labeled request stream: drift injection via the plan, a
+        producer crash restarted by the harness (degrade, don't stop)."""
+        nonlocal dropped, sent
+        apes = []
+        for i in range(n):
+            try:
+                plan.maybe_producer_fault(
+                    _feed_index[0], name="loop-stream"
+                )
+            except chaos.InjectedProducerCrash:
+                continue  # producer restarts; that request is re-made
+            spec = plan.maybe_drift(_feed_index[0])
+            xb, yb = _make_xy(4, seed0 + i, spec)
+            sent += 1
+            _feed_index[0] += 1
+            try:
+                body = srv.handle_predict({"instances": xb.tolist()})
+            except Exception:  # noqa: BLE001 - drops are the assertion
+                dropped += 1
+                continue
+            preds = np.asarray(body["predictions"], np.float32)
+            apes.append(float(np.mean(
+                np.abs(yb - preds) / (np.abs(yb) + 1e-8)
+            )))
+        return float(np.mean(apes)) if apes else float("nan")
+
+    _feed_index = [0]
+    try:
+        # The e2e's retrain windows must carry the SAME injected shift
+        # the serving stream sees.
+        DRIFT_SPEC = {**plan._drift_inject, "seed": plan.seed,
+                      "at_request": 0}
+        ctl, _, journal = _controller(srv, tmp_path, drift=drift,
+                                      plan=plan)
+
+        feed(10, 1000)                       # pre-drift baseline
+        pre_drift_mape = feed(8, 2000)
+        degraded_mape = feed(30, 3000)       # drift fires at request 40
+        assert plan.snapshot()["drift_injections"] == 1
+        assert plan.snapshot()["producer_crashes"] == 1
+        assert degraded_mape > pre_drift_mape * 1.5  # visibly degraded
+        assert drift.snapshot()["trigger_pending"]
+
+        # Episode 1: crashes at the journaled "candidate" transition.
+        with pytest.raises(chaos.InjectedControllerCrash):
+            ctl.poll()
+        ctl.close()
+
+        # New incarnation resumes from the journal; the mid-swap crash
+        # fires during ITS promotion and is converged by one retry; the
+        # scheduled replica kill lands inside probation traffic.
+        journal2 = loop.LoopJournal(str(tmp_path / "loop.json"))
+        ctl2 = loop.SelfHealingController(
+            srv, journal2, drift, _drifted_data_fn, str(tmp_path),
+            loop.LoopConfig(retrain_epochs=5, probation_batches=4),
+            fault_plan=plan,
+        )
+        result = ctl2.resume()
+        assert result is not None and result["state"] == "promoted"
+        snap = plan.snapshot()
+        assert snap["mid_swap_crashes"] == 1
+        assert snap["controller_crashes"] == 1
+        assert ctl2.snapshot()["swap_retries"] == 1
+
+        recovered_mape = feed(10, 4000)      # quality recovers
+        assert recovered_mape < degraded_mape * 0.5, (
+            recovered_mape, degraded_mape,
+        )
+
+        # A deliberately-bad candidate through the SAME guarded path:
+        # probation catches it and auto-rolls-back to the promotion.
+        promoted_path = srv.replicas.bundle.path
+        bad_dir = _bundle_dir(tmp_path, incumbent_variables, "bad",
+                              scale=25.0)
+        bad = ctl2.promote_with_probation(bad_dir)
+        assert bad["state"] == "rolled_back"
+        assert srv.replicas.bundle.path == promoted_path
+        post_rollback_mape = feed(8, 5000)
+        assert post_rollback_mape < degraded_mape * 0.5
+
+        # -- the counters the issue names ------------------------------------
+        assert dropped == 0 and sent > 50
+        stats = srv.replicas.program_stats()
+        assert stats["new_programs_since_warmup"] == 0, stats
+
+        state_path = ctl2.save_state()
+        doc = json.load(open(state_path))["loop"]
+        assert doc["promotions"] == 1 and doc["rollbacks"] == 1
+        assert doc["resumes"] == 1
+        # One journaled episode completed (the bad-candidate probation
+        # ran outside an episode, through the same guarded path).
+        assert doc["journal"]["completed_episodes"] == 1
+        assert doc["journal"]["promotions"] == 1
+
+        m = srv.handle_metrics()
+        assert m["drift"]["triggers"] == 1
+        assert m["swap"]["rollbacks_total"] == 1
+        assert m["injected_faults"]["mid_swap_crashes"] == 1
+        assert m["injected_faults"]["replica_kills"] == 1
+        assert m["swap"]["swaps_total"] >= 2
+
+        # -- one trace id spans detection -> retrain -> swap -> probation ----
+        trace_id = journal2.trace_id
+        assert trace_id
+        assert all(h.get("state") for h in journal2.history)
+        obs.flush()
+        spans = []
+        for f in glob.glob(str(tmp_path / "traces" / "*.jsonl")):
+            with open(f) as fh:
+                spans += [json.loads(line) for line in fh if line.strip()]
+        loop_spans = {
+            s["name"] for s in spans
+            if s.get("args", {}).get("trace_id") == trace_id
+        }
+        assert {"loop.resume", "loop.retrain", "loop.promote"} <= \
+            loop_spans, loop_spans
+        ctl2.close()
+    finally:
+        DRIFT_SPEC = spec_before
+        drift.close()
+        srv.close()
+        obs.shutdown()
