@@ -806,6 +806,70 @@ def _loop(rest) -> None:
         ))
 
 
+def _journal(rest) -> None:
+    """Durable-control-plane status: the head's write-ahead decision
+    journal for an experiment (tune/journal.py) — committed or left open by
+    a crashed head, decision count, head incarnations/replays, per-trial
+    report watermarks.  Stdlib-only (readable from any host, no jax
+    import); docs/operations.md 'Head crash recovery' is the runbook."""
+    import argparse
+    import json as _json
+    import os as _os
+
+    p = argparse.ArgumentParser(
+        prog="journal",
+        description="inspect an experiment's head decision journal "
+                    "(tune/journal.py)",
+    )
+    p.add_argument("action", choices=("status",))
+    p.add_argument("path",
+                   help="the experiment directory (containing "
+                        "journal.jsonl), or the journal file itself")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    args = p.parse_args(rest)
+
+    from distributed_machine_learning_tpu.tune.journal import (
+        FILENAME,
+        journal_status,
+    )
+
+    root = args.path
+    if _os.path.basename(root) == FILENAME:
+        root = _os.path.dirname(root) or "."
+    status = journal_status(root)
+    if args.as_json:
+        print(_json.dumps(status, indent=2))
+        return
+    if not status["present"]:
+        print(f"no journal at {_os.path.join(root, FILENAME)}")
+        raise SystemExit(1)
+    state = (
+        "committed (experiment ended cleanly)" if status["committed"]
+        else "OPEN — head died mid-sweep; resume with resume=\"auto\""
+    )
+    print(f"journal {status['path']}: {state}")
+    print(f"decisions: {status['decisions']} "
+          f"({status['records']} records, next trial index "
+          f"{status['next_index']})")
+    print(f"head incarnations: {status['head_starts']} "
+          f"(journal replays: {status['replays']})")
+    if status.get("trace_id"):
+        print(f"trace_id: {status['trace_id']}")
+    trials = status.get("trials") or {}
+    if trials:
+        print("trials:")
+        for tid in sorted(trials):
+            t = trials[tid]
+            print(f"  {tid}: reported through iteration "
+                  f"{t['reported_through']}, last decision "
+                  f"{t['decision_at_watermark'] or '-'}"
+                  + (f", terminal {t['status']}" if t.get("status")
+                     else ""))
+    if status.get("last_record"):
+        print(f"last record: {status['last_record']}")
+
+
 def _serve(rest) -> None:
     import argparse
     import time
@@ -925,7 +989,7 @@ def main(argv=None) -> None:
     usage = (
         "usage: python -m distributed_machine_learning_tpu "
         "{worker|info|probe|analyze|lint|audit-sharding|perf|trace|serve|"
-        "loop|export-bundle|export-orbax} [args]\n"
+        "loop|journal|export-bundle|export-orbax} [args]\n"
         "  worker         host trial supervisor (see 'worker --help')\n"
         "  lint           dmlint static analysis over the package (or given\n"
         "                 paths); exit 1 on any unsuppressed finding\n"
@@ -950,6 +1014,9 @@ def main(argv=None) -> None:
         "                 compiled replicas (/predict /healthz /metrics)\n"
         "  loop           status <journal|out_dir>: a self-healing loop's\n"
         "                 episode state, history, and counters (loop/)\n"
+        "  journal        status <experiment_dir>: the head's write-ahead\n"
+        "                 decision journal — committed vs crash-open,\n"
+        "                 incarnations, per-trial report watermarks\n"
         "  export-orbax   <ckpt.msgpack> <out_dir>: framework checkpoint\n"
         "                 -> orbax StandardCheckpoint"
     )
@@ -979,6 +1046,8 @@ def main(argv=None) -> None:
         _serve(rest)
     elif cmd == "loop":
         _loop(rest)
+    elif cmd == "journal":
+        _journal(rest)
     elif cmd == "export-bundle":
         _export_bundle(rest)
     elif cmd == "export-orbax":

@@ -2657,6 +2657,145 @@ class UnguardedPromotionRule(Rule):
                 )
 
 
+# --------------------------------------------------------------------------
+# DML020 non-atomic-state-write
+# --------------------------------------------------------------------------
+
+
+# Control-plane state writers: the tune driver/journal/store, the
+# self-healing loop's state docs, and checkpoint manifests.  Other modules
+# opt in with `# dmlint-scope: state-write`.
+STATE_WRITE_PATH_PATTERNS = (
+    "tune/",
+    "loop/",
+    "ckpt/",
+)
+
+# json.dump needs a text handle, so only text write modes can feed it.
+_TEXT_WRITE_MODES = {"w", "wt", "tw", "w+", "w+t"}
+
+# Callee tails that mark a scope as using the write-temp-then-rename
+# discipline (or a helper that wraps it).
+_ATOMIC_TAILS = {"rename", "renames", "mkstemp", "NamedTemporaryFile"}
+
+
+def _open_write_mode(node: ast.Call) -> bool:
+    """True when *node* is an ``open(path, "w")``-style call."""
+    callee = _call_name(node) or ""
+    if callee.rsplit(".", 1)[-1] != "open":
+        return False
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    return (
+        isinstance(mode, ast.Constant)
+        and isinstance(mode.value, str)
+        and mode.value in _TEXT_WRITE_MODES
+    )
+
+
+def _is_atomic_rename(node: ast.Call) -> bool:
+    callee = _call_name(node) or ""
+    tail = callee.rsplit(".", 1)[-1]
+    if callee in ("os.replace", "os.rename"):
+        return True
+    if tail in _ATOMIC_TAILS or "atomic" in tail.lower():
+        return True
+    # Path.replace(target) takes one argument; str.replace(old, new)
+    # takes two — arity separates the rename from the string method.
+    if tail == "replace" and len(node.args) + len(node.keywords) == 1:
+        return True
+    return False
+
+
+class NonAtomicStateWriteRule(Rule):
+    name = "non-atomic-state-write"
+    rule_id = "DML020"
+    severity = "error"
+    description = (
+        "control-plane state written with a bare `open(path, 'w')` + "
+        "`json.dump`: a crash (or chaos SIGKILL) between truncate and "
+        "flush leaves a torn/empty JSON file, and resume/restore then "
+        "fails on the very state it needs.  Every durable state snapshot "
+        "on the tune/loop/ckpt paths must write to a temp name in the "
+        "same directory and `os.replace` it over the target — readers "
+        "then see either the old state or the new one, never a torn "
+        "write.  Append-only journals (`open(..., 'a')` + line-framed "
+        "records) are exempt: torn trailing lines are dropped on replay."
+    )
+    _HINT = (
+        "write to `path + '.tmp'` then `os.replace(tmp, path)` (see "
+        "tune/storage.py / ExperimentStore.write_state), or suppress "
+        "with '# dmlint: disable=non-atomic-state-write <reason>' when "
+        "the file is genuinely advisory"
+    )
+
+    def applies(self, ctx) -> bool:
+        if "state-write" in ctx.scopes:
+            return True
+        rel = ctx.display_path.replace("\\", "/")
+        return any(pat in rel for pat in STATE_WRITE_PATH_PATTERNS)
+
+    def check(self, ctx) -> Iterator[Finding]:
+        parents: Dict[int, ast.AST] = {}
+        for parent in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[id(child)] = parent
+
+        def _enclosing_fns(node: ast.AST) -> List[ast.AST]:
+            chain = []
+            cur = parents.get(id(node))
+            while cur is not None:
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    chain.append(cur)
+                cur = parents.get(id(cur))
+            return chain
+
+        # A scope is "atomic" if anywhere in it a rename/temp-file call
+        # appears — the json.dump then targets the temp name, not the
+        # live state file.
+        atomic_scopes: Set[int] = set()
+        scopes: List[ast.AST] = [ctx.tree] + [
+            n for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            for node in ast.walk(scope):
+                if isinstance(node, ast.Call) and _is_atomic_rename(node):
+                    atomic_scopes.add(id(scope))
+                    break
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _call_name(node) or ""
+            if callee not in ("json.dump", "ujson.dump"):
+                continue
+            chain = _enclosing_fns(node)
+            if any(id(fn) in atomic_scopes for fn in chain):
+                continue
+            if not chain and id(ctx.tree) in atomic_scopes:
+                continue
+            # Require an open-for-write in the innermost scope so dumps
+            # to sockets/stdout or append streams stay out of scope.
+            innermost: ast.AST = chain[0] if chain else ctx.tree
+            if not any(
+                isinstance(n, ast.Call) and _open_write_mode(n)
+                for n in ast.walk(innermost)
+            ):
+                continue
+            yield self.finding(
+                ctx, node,
+                "json.dump onto an open(..., 'w') handle with no "
+                "os.replace in scope — a crash mid-write tears the "
+                "state file",
+                self._HINT,
+            )
+
+
 ALL_RULES: List[Rule] = [
     DonationAliasRule(),
     UnlockedDispatchRule(),
@@ -2677,6 +2816,7 @@ ALL_RULES: List[Rule] = [
     UnguardedSharedStateRule(),
     ImplicitUpcastInQuantizedPathRule(),
     UnguardedPromotionRule(),
+    NonAtomicStateWriteRule(),
 ]
 
 

@@ -165,6 +165,19 @@ class FaultPlan:
       scheduled state (fires once per entry) — the crash between durable
       states whose recovery contract is "resume completes the episode
       exactly once".
+    * ``kill_head_at`` — a decision number N; the HEAD/driver process
+      hard-exits (``os._exit``) immediately after the Nth scheduling
+      decision lands durably in the experiment journal
+      (``tune/journal.py``) and before its effect happens — the
+      journaled-but-not-acted crash window ``resume="auto"`` replays
+      through.  Fires only on head incarnation 1 (the resumed head
+      re-activates the plan from env and must survive the same
+      decision), same guard as ``kill_process_at``.
+    * ``kill_head_during_journal_write`` — a decision number N; the head
+      dies MID-append of the Nth decision record: half the JSON line is
+      written and fsync'd, then ``os._exit`` — the torn-tail fault the
+      journal parser must treat as "decision never happened".  Same
+      first-incarnation guard.
 
     Drift injection (``drift_inject`` — the serving-plane distribution
     shift): a dict ``{"at_request": N, "feature_shift": s,
@@ -231,6 +244,8 @@ class FaultPlan:
         mid_swap_crash: Iterable[int] = (),
         corrupt_bundle_on_export: int = 0,
         controller_crash_at: Sequence[str] = (),
+        kill_head_at: Optional[int] = None,
+        kill_head_during_journal_write: Optional[int] = None,
         drift_inject: Optional[Dict[str, float]] = None,
         hang_dispatch_at: Iterable[Tuple[str, int]] = (),
         hang_s: float = 1.5,
@@ -266,6 +281,13 @@ class FaultPlan:
         self._controller_crashes: List[str] = [
             str(s) for s in controller_crash_at
         ]
+        self._kill_head_at = (
+            int(kill_head_at) if kill_head_at is not None else None
+        )
+        self._torn_journal_at = (
+            int(kill_head_during_journal_write)
+            if kill_head_during_journal_write is not None else None
+        )
         self._drift_inject = dict(drift_inject) if drift_inject else None
         self._drift_fired = False
         # Fail-slow faults (PR 3): dispatch hangs, storage stalls, worker
@@ -610,6 +632,50 @@ class FaultPlan:
         raise InjectedControllerCrash(
             f"injected controller crash after journaling {state!r}"
         )
+
+    def maybe_kill_head(self, decision_n: int, incarnation: int = 1) -> None:
+        """Hard-exit the HEAD process if the scheduled decision number has
+        been reached — called by ``tune/journal.py`` right after a
+        decision record lands durably (fsync'd) and BEFORE its effect
+        happens, so resume must replay a journaled-but-unacted decision.
+        ``os._exit`` (no unwinding): a SIGKILLed head doesn't flush
+        either.  Fires only on head incarnation 1 — the resumed head
+        re-activates the plan from ``DML_CHAOS_PLAN`` and must pass the
+        same decision unharmed (the ``maybe_kill_process`` guard)."""
+        # dmlint: disable=unguarded-shared-state deliberate lock-free fast path: a stale read costs one extra lock round-trip at most — the armed/threshold check re-runs under the lock before firing
+        if int(incarnation) > 1 or self._kill_head_at is None:
+            return
+        with self._lock:
+            if (self._kill_head_at is None
+                    or int(decision_n) < self._kill_head_at):
+                return
+            self._kill_head_at = None
+            self._counters["head_kills"] = (
+                self._counters.get("head_kills", 0) + 1
+            )
+        import os
+
+        os._exit(86)
+
+    def poll_torn_journal_write(
+        self, decision_n: int, incarnation: int = 1
+    ) -> bool:
+        """True when the journal should tear THIS decision's append —
+        the caller writes half the line, fsyncs, and ``os._exit``s, so
+        the journal's tail is a torn record resume must drop.  Fires
+        once, first head incarnation only."""
+        # dmlint: disable=unguarded-shared-state deliberate lock-free fast path: a stale read costs one extra lock round-trip at most — the armed/threshold check re-runs under the lock before firing
+        if int(incarnation) > 1 or self._torn_journal_at is None:
+            return False
+        with self._lock:
+            if (self._torn_journal_at is None
+                    or int(decision_n) < self._torn_journal_at):
+                return False
+            self._torn_journal_at = None
+            self._counters["torn_journal_writes"] = (
+                self._counters.get("torn_journal_writes", 0) + 1
+            )
+        return True
 
     def maybe_drift(self, request_index: int) -> Optional[Dict[str, float]]:
         """The drift-injection decision for the caller's ``request_index``

@@ -24,6 +24,38 @@ from distributed_machine_learning_tpu.tune.stoppers import stop_hit
 from distributed_machine_learning_tpu.tune.trial import Trial, TrialStatus
 
 
+def _summarize(value):
+    """Collections collapse to their sizes — forensic shape, not payload
+    (a BayesOpt X matrix in experiment_state.json would dwarf the trials)."""
+    if isinstance(value, dict):
+        return {str(k): _summarize(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return len(value)
+    return value
+
+
+def scheduler_debug_block(searcher, scheduler) -> Dict[str, Any]:
+    """The ``experiment_state.json["scheduler"]`` forensics block both
+    drivers persist at report boundaries (throttled) and at completion
+    boundaries: who is deciding, and the summarized shape of their state —
+    the first thing a postmortem of a bad stop/exploit wants."""
+    block: Dict[str, Any] = {
+        "scheduler_type": type(scheduler).__name__,
+        "searcher_type": type(searcher).__name__,
+    }
+    debug = getattr(scheduler, "debug_state", None)
+    if callable(debug):
+        try:
+            block["scheduler_state"] = debug()
+        except Exception:  # noqa: BLE001 - forensics never kill a run
+            pass
+    try:
+        block["searcher_state"] = _summarize(searcher.save_state())
+    except Exception:  # noqa: BLE001
+        pass
+    return block
+
+
 class TrialLifecycle:
     """Single-threaded trial state machine shared by both drivers.
 
@@ -48,10 +80,16 @@ class TrialLifecycle:
         time_limit_per_trial_s: Optional[float] = None,
         log: Callable[[str], None] = lambda msg: None,
         config_overlay: Optional[Dict[str, Any]] = None,
+        journal=None,
     ):
         self.searcher = searcher
         self.scheduler = scheduler
         self.store = store
+        # Write-ahead log (tune/journal.ExperimentJournal, or None): every
+        # scheduling decision is journaled with a post-decision
+        # searcher/scheduler snapshot BEFORE its externally visible effect,
+        # so a killed head resumes to bit-identical decision state.
+        self.journal = journal
         self.metric = metric
         self.mode = mode
         self.num_samples = num_samples
@@ -72,6 +110,24 @@ class TrialLifecycle:
         self.next_index = 0
         self.searcher_exhausted = False
         self.start_time = time.time()
+        # Exactly-once epoch accounting after a journal-based resume:
+        # trial_id -> journaled report watermark.  A requeued trial
+        # restored from a checkpoint BELOW its watermark re-reports the
+        # gap; those re-reports are suppressed (counted, never re-persisted
+        # or re-observed) until the watermark is reached.
+        self._suppress: Dict[str, int] = {}
+        self.duplicate_reports_suppressed = 0
+
+    # -- journal -----------------------------------------------------------
+
+    def _snapshot(self) -> Dict[str, Any]:
+        """The decision-state snapshot a journal record carries: restore it
+        and the searcher/scheduler make bit-identical decisions from here."""
+        return {
+            "searcher": self.searcher.save_state(),
+            "scheduler": self.scheduler.save_state(),
+            "next_index": self.next_index,
+        }
 
     # -- creation ----------------------------------------------------------
 
@@ -107,6 +163,14 @@ class TrialLifecycle:
         self.by_id[trial.trial_id] = trial
         self.pending.append(trial)
         self.scheduler.on_trial_add(trial)
+        if self.journal is not None:
+            # WAL: the create decision (searcher suggestion consumed, trial
+            # registered with the scheduler) is durable before its first
+            # external effect (params.json) — a crash here resumes with the
+            # trial recreated from the journaled config.
+            self.journal.record_create(
+                trial.trial_id, dict(config), self._snapshot()
+            )
         self.store.write_params(trial)
         return trial
 
@@ -227,6 +291,175 @@ class TrialLifecycle:
         self.searcher.fast_forward(self.next_index)
         return counts
 
+    def restore_from_journal(self, replay, resources=None) -> Dict[str, int]:
+        """Resume from the write-ahead log (``resume="auto"``): restore the
+        journaled searcher/scheduler snapshot instead of replaying metric
+        streams through their hooks, so the restored decision state is
+        BIT-IDENTICAL to the moment of the last journaled decision — not a
+        reconstruction of it.
+
+        ``replay`` is a :class:`tune.journal.ReplayState`.  Ordering is
+        load-bearing: (1) every live trial is rebuilt and registered via
+        ``on_trial_add`` (PBT's live-ref table, ASHA's rung defaults);
+        (2) THEN ``restore_state`` overwrites the defaults with the
+        journaled snapshot; (3) trials are disposed — journaled-terminal
+        trials get their status set directly (completion hooks already ran
+        and are inside the snapshot), a trial whose watermark decision was
+        "stop" is finished NOW (the decision was journaled but the crash
+        ate its effect), everything else requeues from its newest valid
+        checkpoint at-or-below the journaled report watermark, with
+        re-reports below the watermark suppressed (exactly-once epoch
+        accounting — see :meth:`process_result`).
+        """
+        from distributed_machine_learning_tpu.tune import checkpoint as ckpt_lib
+        from distributed_machine_learning_tpu.tune.experiment import (
+            iter_trial_records,
+        )
+
+        counts = {"finished": 0, "requeued": 0, "suppress_windows": 0}
+        kwargs = {"resources": resources} if resources is not None else {}
+        on_disk: Dict[str, Any] = {}
+        for entry, config, records, _meta in iter_trial_records(
+            self.store.root
+        ):
+            on_disk[entry] = (config, records)
+        # Union: a journaled create whose params.json never landed (crash
+        # inside the create→write_params window) is recreated from the
+        # journaled config.
+        trial_ids = sorted(set(on_disk) | set(replay.trials))
+        pending_disposal = []
+        for entry in trial_ids:
+            jt = replay.trials.get(entry)
+            if jt is not None and jt["config"] is None and entry not in on_disk:
+                continue  # journal mentions it but holds no config (torn)
+            config, records = on_disk.get(entry) or (
+                dict(jt["config"]), []
+            )
+            trial = Trial(trial_id=entry, config=config, **kwargs)
+            self.trials.append(trial)
+            self.by_id[entry] = trial
+            try:
+                self.next_index = max(
+                    self.next_index, int(entry.rsplit("_", 1)[-1]) + 1
+                )
+            except ValueError:
+                self.next_index = max(self.next_index, len(self.trials))
+            self.scheduler.on_trial_add(trial)
+            if entry not in on_disk:
+                self.store.write_params(trial)  # re-run the eaten effect
+
+            watermark = int(jt["reported_through"]) if jt else 0
+            terminal = jt["terminal"] if jt else None
+            # Disk results past the journaled watermark are evidence of
+            # work whose report never became a decision (crash between
+            # append_result and the journal append): truncate, so the
+            # re-reported epoch lands exactly once on disk too.
+            if terminal is None:
+                kept = [
+                    r for r in records
+                    if int(r.get("training_iteration", 0)) <= watermark
+                ]
+                if len(kept) < len(records):
+                    import json
+                    import os
+
+                    path = os.path.join(
+                        self.store.trial_dir(trial), "result.jsonl"
+                    )
+                    with open(path, "w") as f:
+                        for r in kept:
+                            f.write(json.dumps(r) + "\n")
+                records = kept
+            for rec in records:
+                trial.results.append(rec)
+                if self.stop_rules is not None and callable(self.stop_rules):
+                    # Warm STATEFUL stoppers only; scheduler/searcher state
+                    # comes from the snapshot, not from replaying hooks.
+                    stop_hit(self.stop_rules, trial.trial_id, rec)
+            trial.reports_since_restart = len(trial.results)
+            pending_disposal.append((trial, jt, watermark))
+
+        # The journaled snapshot is authoritative: it overwrites the
+        # defaults on_trial_add just installed (ASHA rung cursors, PBT
+        # history) and the searcher's model/cursor state.  next_index from
+        # the snapshot covers creates whose params.json landed but whose
+        # ids don't parse.
+        snap = replay.snapshot
+        if snap:
+            self.searcher.restore_state(snap.get("searcher") or {})
+            self.scheduler.restore_state(snap.get("scheduler") or {})
+            self.next_index = max(
+                self.next_index, int(snap.get("next_index", 0))
+            )
+        else:
+            self.searcher.fast_forward(self.next_index)
+
+        for trial, jt, watermark in pending_disposal:
+            terminal = jt["terminal"] if jt else None
+            if terminal is not None:
+                # Completion hooks ran before the complete record was
+                # journaled and their mutations are inside the snapshot:
+                # set the status directly, never re-run finish().
+                trial.status = TrialStatus(terminal.get("status", "TERMINATED"))
+                trial.error = terminal.get("error")
+                trial.finished_at = time.time()
+                counts["finished"] += 1
+                continue
+            decision = jt["decision_at_watermark"] if jt else None
+            if decision == "stop":
+                # The stop decision is durable; the crash ate its effect.
+                # finish() now runs the completion hooks exactly once (the
+                # control run would have run them at this point too) and
+                # journals the complete record.
+                self.finish(trial, TrialStatus.TERMINATED)
+                counts["finished"] += 1
+                continue
+            ck_dir = self.store.checkpoint_dir(trial)
+            try:
+                ckpt_lib.cleanup_uncommitted(ck_dir, log=self.log)
+                # Checkpoints past the watermark hold epochs whose reports
+                # never became decisions; quarantine so no later fallback
+                # can resurrect them (the requeue_lost discipline).
+                ckpt_lib.quarantine_unreported(
+                    ck_dir, watermark, tag="head", log=self.log
+                )
+            except Exception as exc:  # noqa: BLE001 - best-effort hygiene
+                self.log(f"checkpoint hygiene failed for "
+                         f"{trial.trial_id}: {exc!r}")
+            last_requeue = jt["last_requeue"] if jt else None
+            trial._requeue_on_complete = False
+            if last_requeue is not None:
+                # A journaled PBT exploit owns this trial's current config
+                # and restore target (its in-memory config died with the
+                # head; params.json still holds the original).  Re-apply
+                # the exploit verbatim — re-reports up to the watermark are
+                # suppressed, so re-running the donor window is wasted
+                # compute, never duplicate accounting.
+                trial.config = dict(last_requeue.get("config") or trial.config)
+                trial.restore_path = last_requeue.get("restore_path")
+                trial.restore_base = int(last_requeue.get("restore_base") or 0)
+            else:
+                ck_path, ck_it = ckpt_lib.newest_valid_checkpoint(
+                    ck_dir, max_iteration=watermark
+                )
+                if ck_path:
+                    trial.restore_path = ck_path
+                    trial.restore_base = ck_it
+                    trial.latest_checkpoint = ck_path
+                    trial.latest_checkpoint_iteration = ck_it
+                else:
+                    trial.restore_path = None
+                    trial.restore_base = 0
+            if trial.restore_base < watermark:
+                self._suppress[trial.trial_id] = watermark
+                counts["suppress_windows"] += 1
+            self.requeue(trial)
+            counts["requeued"] += 1
+
+        if self.journal is not None:
+            self.journal.record_replay(**counts)
+        return counts
+
     # -- results -----------------------------------------------------------
 
     def process_result(
@@ -236,6 +469,26 @@ class TrialLifecycle:
         "stop" or "continue" (REQUEUE is folded into stop + a flag consumed
         by :meth:`complete_trial`)."""
         metrics = dict(metrics)
+        watermark = self._suppress.get(trial.trial_id)
+        if watermark is not None:
+            # Journal-resume duplicate window: this incarnation restored
+            # from a checkpoint below the journaled report watermark, so it
+            # re-reports epochs the control plane already observed.  The
+            # iteration clock still advances (training_iteration must line
+            # up when fresh reports start), but nothing is re-persisted,
+            # re-observed, or re-decided — every such epoch was journaled
+            # "continue" (a stop/requeue watermark is resolved at restore).
+            trial.reports_since_restart += 1
+            it = trial.training_iteration
+            if it <= watermark:
+                self.duplicate_reports_suppressed += 1
+                if it == watermark:
+                    del self._suppress[trial.trial_id]
+                return "continue"
+            # Already past the watermark (sparse reporting): fall through
+            # to the normal path, undoing the early increment.
+            del self._suppress[trial.trial_id]
+            trial.reports_since_restart -= 1
         trial.reports_since_restart += 1
         metrics.setdefault("training_iteration", trial.training_iteration)
         metrics["trial_id"] = trial.trial_id
@@ -276,9 +529,38 @@ class TrialLifecycle:
                 f"({trial.incarnation_runtime_s():.0f}s); stopping"
             )
             decision = STOP
-        if decision == REQUEUE:
+        requeued = decision == REQUEUE
+        if requeued:
             trial._requeue_on_complete = True
             decision = STOP
+        if self.journal is not None:
+            # WAL: scheduler/searcher/stopper mutations are all in; journal
+            # the decision (with the post-mutation snapshot) before it is
+            # returned to the executor.  A crash after the append replays
+            # to this exact state and re-applies the decision at resume.
+            requeue_payload = None
+            if requeued:
+                # PBT exploit: the scheduler rewrote config/restore target
+                # in place.  Journaled so resume re-applies the exploit even
+                # if the complete event (which performs the requeue) never
+                # got processed.
+                requeue_payload = {
+                    "config": dict(trial.config),
+                    "restore_path": trial.restore_path,
+                    "restore_base": trial.restore_base,
+                }
+            value = metrics.get(self.metric)
+            self.journal.record_report(
+                trial.trial_id,
+                int(metrics.get("training_iteration",
+                                trial.training_iteration)),
+                "requeue" if requeued
+                else ("stop" if decision == STOP else "continue"),
+                float(value)
+                if isinstance(value, (int, float)) else None,
+                self._snapshot(),
+                requeue=requeue_payload,
+            )
         return "stop" if decision == STOP else "continue"
 
     def final_prune(self) -> None:
@@ -358,6 +640,10 @@ class TrialLifecycle:
                 f"({trial.num_failures}/{self.max_failures}): {why.splitlines()[-1] if why else why}; retrying"
                 + (" from checkpoint" if trial.restore_path else "")
             )
+            if self.journal is not None:
+                self.journal.record_error(
+                    trial.trial_id, True, self._snapshot()
+                )
             self.requeue(trial)
             return True
         trial.error = why
@@ -382,13 +668,27 @@ class TrialLifecycle:
                 trial.trial_id, trial.config, None, self.metric, self.mode
             )
         self.scheduler.on_trial_complete(trial)
+        if self.journal is not None:
+            # Journaled AFTER the completion hooks mutate searcher/scheduler
+            # state, so the snapshot is the post-completion decision state
+            # (a resume that finds this record sets status directly — the
+            # hooks must not run twice).
+            self.journal.record_complete(
+                trial.trial_id, status.value, self._snapshot(),
+                error=trial.error,
+            )
 
     def requeue(self, trial: Trial):
         trial.status = TrialStatus.PENDING
         trial.reports_since_restart = 0
         self.pending.append(trial)
 
-    def mark_running(self, trial: Trial):
+    def mark_running(self, trial: Trial, worker: Optional[str] = None):
+        if self.journal is not None:
+            # WAL: dispatch journaled before the launch frame/thread exists,
+            # so resume knows this trial was in flight (no state snapshot —
+            # dispatch decides nothing).
+            self.journal.record_dispatch(trial.trial_id, worker=worker)
         trial.status = TrialStatus.RUNNING
         now = time.time()
         trial.started_at = trial.started_at or now

@@ -65,7 +65,11 @@ from distributed_machine_learning_tpu.tune.experiment import (
     ExperimentAnalysis,
     ExperimentStore,
 )
-from distributed_machine_learning_tpu.tune._driver import TrialLifecycle
+from distributed_machine_learning_tpu.tune._driver import (
+    TrialLifecycle,
+    scheduler_debug_block,
+)
+from distributed_machine_learning_tpu.tune import journal as journal_lib
 from distributed_machine_learning_tpu.tune.schedulers.base import (
     FIFOScheduler,
     TrialScheduler,
@@ -707,11 +711,33 @@ def serve_worker(
         if debug:
             print(f"[worker] {msg}", flush=True)
 
+    # Head-incarnation fencing watermark.  It OUTLIVES individual driver
+    # connections: after a head crash the resumed head (incarnation N+1)
+    # may connect while the dead head's ghost — a partitioned, not actually
+    # dead, incarnation N whose frames heal late — still speaks.  Frames
+    # stamped with an incarnation below the highest seen are dropped, the
+    # exact mirror of per-trial zombie fencing.  Surfaced via the worker's
+    # obs registry so the head's cluster aggregation reports
+    # ``fenced_head_frames``.
+    from distributed_machine_learning_tpu import obs as _obs
+
+    head_watermark: Dict[str, Any] = {
+        "experiment": None, "incarnation": 0, "fenced_head_frames": 0,
+    }
+    _obs.get_registry().register_family(
+        "head_fencing",
+        lambda: {
+            "head_incarnation": head_watermark["incarnation"],
+            "fenced_head_frames": head_watermark["fenced_head_frames"],
+        },
+    )
+
     while True:
         sock, peer = server.accept()
         dbg(f"accepted driver {peer}")
         shutdown = _serve_driver_connection(
-            sock, secret, devices, slots, dbg, startup_s=startup_s
+            sock, secret, devices, slots, dbg, startup_s=startup_s,
+            head_watermark=head_watermark,
         )
         if shutdown:
             break
@@ -725,6 +751,7 @@ def _serve_driver_connection(
     slots: int,
     dbg: Callable[[str], None],
     startup_s: float = 0.0,
+    head_watermark: Optional[Dict[str, int]] = None,
 ) -> bool:
     """Serve one driver over an established socket (either direction: a
     connection the supervisor accepted, or one ``join_driver`` dialed).
@@ -780,6 +807,29 @@ def _serve_driver_connection(
             break  # driver went away
         mtype = msg.get("type")
         dbg(f"recv {mtype} {msg.get('trial_id', '')}")
+        if head_watermark is not None:
+            hinc = msg.get("head_incarnation")
+            if hinc is not None:
+                # The watermark is scoped PER EXPERIMENT: incarnations only
+                # order heads of the same experiment (a fresh experiment on
+                # this pool legitimately starts back at incarnation 1).
+                hexp = msg.get("head_experiment")
+                if hexp != head_watermark.get("experiment"):
+                    head_watermark["experiment"] = hexp
+                    head_watermark["incarnation"] = 0
+                hinc = int(hinc)
+                if hinc < head_watermark["incarnation"]:
+                    # Ghost head: a lower incarnation than the highest this
+                    # worker has served means the sending head already died
+                    # and was replaced — its late/healed frames must not
+                    # dispatch work or answer decisions.
+                    head_watermark["fenced_head_frames"] += 1
+                    dbg(
+                        f"fenced head frame {mtype} (incarnation {hinc} < "
+                        f"{head_watermark['incarnation']})"
+                    )
+                    continue
+                head_watermark["incarnation"] = hinc
         if mtype == "run_trial":
             # Round-robin device assignment by slot index keeps concurrent
             # trials on distinct cores.  A mesh trial (num_devices > 1)
@@ -948,7 +998,11 @@ def join_driver(
             print(f"[worker->{driver_address}] {msg}", flush=True)
 
     return _serve_driver_connection(
-        sock, secret, devices, slots, dbg, startup_s=startup_s
+        sock, secret, devices, slots, dbg, startup_s=startup_s,
+        # Per-connection watermark: a joiner serves exactly one driver, but
+        # the same ghost-head frames can heal late inside that connection.
+        head_watermark={"experiment": None, "incarnation": 0,
+                        "fenced_head_frames": 0},
     )
 
 
@@ -990,6 +1044,13 @@ def startup_scaled_grace(
 
 class RemoteWorker:
     """Driver-side handle for one host supervisor connection."""
+
+    # Stamped by run_distributed once its journal assigns this head an
+    # incarnation number; every frame sent to the worker then carries it
+    # (plus the experiment name scoping it) so the worker can fence a dead
+    # head's ghost (see serve_worker).
+    head_incarnation: Optional[int] = None
+    head_experiment: Optional[str] = None
 
     def __init__(self, address: str, secret: Optional[bytes] = None):
         self.address = address
@@ -1061,6 +1122,9 @@ class RemoteWorker:
         return self.slots - len(self.running)
 
     def send(self, msg: Dict[str, Any]):
+        if self.head_incarnation is not None:
+            msg.setdefault("head_incarnation", self.head_incarnation)
+            msg.setdefault("head_experiment", self.head_experiment)
         with self._pt_lock:
             if time.monotonic() < self._partition_until:
                 self._out_buffer.append(msg)
@@ -1145,7 +1209,7 @@ def run_distributed(
     input_mode: Optional[str] = None,
     elastic_listen: Union[str, socket.socket, None] = None,
     artifact_origin: Union[bool, "ArtifactRegistry"] = True,
-    resume: bool = False,
+    resume: Union[bool, str] = False,
     points_to_evaluate: Optional[Sequence[Dict[str, Any]]] = None,
     stop=None,
     progress_deadline_s: Optional[float] = None,
@@ -1188,6 +1252,13 @@ def run_distributed(
     explicit ``name``) — same semantics as ``tune.run(resume=True)``:
     finished trials kept and replayed, interrupted trials redispatched from
     their newest shared-storage checkpoint, sampling continued.
+    ``resume="auto"`` resumes IFF the head's decision journal
+    (``<experiment>/journal.jsonl``) was left uncommitted by a crashed
+    head — replaying it restores searcher/scheduler state bit-identically
+    (docs/operations.md, "Head crash recovery") — and otherwise starts
+    fresh, so supervisor loops can pass it unconditionally.  Resuming
+    without ``checkpoint_storage`` is a hard error unless every worker is
+    loopback (worker-local restore points are invisible across hosts).
     ``checkpoint_format``: ``"msgpack"`` (default) or ``"sharded"`` —
     same knob as ``tune.run``; workers write whichever the driver picked,
     and every requeue/restore path reads both.  With ``"sharded"`` each
@@ -1304,6 +1375,19 @@ def run_distributed(
             f"input_mode must be 'auto', 'resident' or 'streaming', "
             f"got {input_mode!r}"
         )
+    # resume="auto": resume IFF a prior head left its decision journal
+    # uncommitted (crashed mid-sweep); otherwise run fresh.  Same contract
+    # as tune.run(resume="auto").
+    journal_resume = False
+    if resume == "auto":
+        if not name:
+            raise ValueError(
+                'resume="auto" needs the explicit experiment `name`'
+            )
+        journal_resume = journal_lib.is_uncommitted(
+            ExperimentStore.root_for(storage_path, name)
+        )
+        resume = journal_resume
     if resume:
         from distributed_machine_learning_tpu.tune.runner import _validate_resume
 
@@ -1312,14 +1396,26 @@ def run_distributed(
             # On a real multi-host pool, workers checkpoint to THEIR local
             # filesystems; the resuming driver would find nothing and re-run
             # interrupted trials from scratch (discarding their progress).
-            print(
-                "[tune.cluster] WARNING: resume=True without "
-                "checkpoint_storage — restore points are only found if the "
-                "checkpoint paths are on a filesystem this driver shares "
-                "with the workers (true on one host; NOT true across hosts: "
-                "use checkpoint_storage='gs://...' or another shared path).",
-                flush=True,
-            )
+            # Hard error, same discipline as _validate_resume — a resume
+            # that silently discards progress is worse than one that fails.
+            # The one provably-safe case: every worker on loopback, where
+            # "a filesystem shared with the workers" is trivially this
+            # host's own.
+            remote = [
+                w for w in workers
+                if not _is_loopback(w.rsplit(":", 1)[0])
+            ]
+            if remote or not workers:
+                raise ValueError(
+                    "resume without checkpoint_storage: workers checkpoint "
+                    "to their own local filesystems, so this driver would "
+                    "find no restore points and re-run interrupted trials "
+                    "from scratch ("
+                    + (f"non-loopback workers: {remote}"
+                       if remote else "elastic joiners may be remote")
+                    + "). Pass checkpoint_storage='gs://...' or another "
+                    "path shared with every worker."
+                )
     if not workers and elastic_listen is None:
         raise ValueError(
             "run_distributed needs at least one worker address "
@@ -1389,8 +1485,18 @@ def run_distributed(
     trace = trace or os.environ.get("DML_OBS_TRACE") == "1"
     trace_dir = os.path.join(store.root, "trace") if trace else None
     prev_dump_dir = obs_lib.dump_dir()
+    # Journal-based resume adopts the dead head's trace identity BEFORE the
+    # tracer is configured: one trace id spans both head incarnations.
+    replay = journal_lib.parse_journal(store.root) if journal_resume else None
+    prior_frame = (replay.trace_frame if replay is not None else None) or {}
     obs_lib.configure(trace_dir=trace_dir, label="head",
-                      dump_dir=store.root)
+                      dump_dir=store.root,
+                      trace_id=prior_frame.get("trace_id"),
+                      parent_span_id=prior_frame.get("parent_span_id"))
+    # Write-ahead decision journal: every scheduling decision is durable
+    # BEFORE its effect (dispatch frame, decision answer) leaves the head.
+    journal = journal_lib.ExperimentJournal(store.root)
+    head_incarnation = journal.open(obs_frame=obs_lib.trace_context_frame())
     obs_counters_base = obs_lib.get_registry().counters_snapshot()
     worker_obs: Dict[str, Dict[str, float]] = {}  # addr -> last snapshot
     trial_spans: Dict[str, Any] = {}
@@ -1426,6 +1532,11 @@ def run_distributed(
                 events.put(("msg", worker, held))
 
     def add_worker(w: RemoteWorker):
+        # Every frame to this worker carries the head's incarnation (scoped
+        # by experiment name) so the supervisor can fence a dead head's
+        # ghost (serve_worker watermark).
+        w.head_incarnation = head_incarnation
+        w.head_experiment = name
         pool.append(w)
         threading.Thread(
             target=reader, args=(w,), name=f"reader-{w.address}", daemon=True
@@ -1560,13 +1671,22 @@ def run_distributed(
             **({"mesh_shape": dict(mesh_shape)} if mesh_shape else {}),
             **({"input_mode": input_mode} if input_mode else {}),
         } or None,
+        journal=journal,
     )
     trials = lifecycle.trials
     by_id = lifecycle.by_id
     pending = lifecycle.pending
     start_time = lifecycle.start_time
 
-    if resume:
+    if journal_resume and replay is not None:
+        counts = lifecycle.restore_from_journal(replay)
+        log(
+            f"resumed {name} from journal (head incarnation "
+            f"{head_incarnation}): {counts['finished']} finished trials "
+            f"kept, {counts['requeued']} interrupted trials requeued, "
+            f"{counts['suppress_windows']} replay suppression windows"
+        )
+    elif resume:
         counts = lifecycle.restore_experiment()
         log(
             f"resumed {name}: {counts['finished']} finished trials kept, "
@@ -1579,7 +1699,7 @@ def run_distributed(
         )
         worker.running[trial.trial_id] = slot
         assignment[trial.trial_id] = worker
-        lifecycle.mark_running(trial)
+        lifecycle.mark_running(trial, worker=worker.address)
         if watchdog is not None:
             # First-beat grace scales from THIS worker's measured spawn
             # time: a loaded host that took a minute to import jax will
@@ -1653,7 +1773,7 @@ def run_distributed(
                                       process_id=i))
         # mark_running bumps the incarnation; the gang id carries the
         # bumped value so member frames and the stale-frame guard agree.
-        lifecycle.mark_running(trial)
+        lifecycle.mark_running(trial, worker=members[0].worker.address)
         gang = Gang(
             gang_id=f"{trial.trial_id}.i{trial.incarnation}",
             trial_id=trial.trial_id,
@@ -1808,6 +1928,7 @@ def run_distributed(
         return retried
 
     last_enforce = [0.0]
+    last_sched_persist = [0.0]
 
     def revive_if_suspect(worker: RemoteWorker):
         """Any frame from a suspect worker means the silence was a
@@ -1857,7 +1978,16 @@ def run_distributed(
                         extra={"worker": worker.address,
                                "silent_s": round(silent, 2)},
                     )
+
                     lost = [by_id[tid] for tid in list(worker.running)]
+                    # Bookkeeping record (no decision counter bump): a
+                    # resumed head reading the journal sees WHY these
+                    # trials were requeued away from their worker.
+                    journal.record_note(
+                        "lease_expiry", worker=worker.address,
+                        silent_s=round(silent, 2),
+                        trials=[t.trial_id for t in lost],
+                    )
                     log(
                         f"worker {worker.address} silent for {silent:.1f}s "
                         f"(> {worker_heartbeat_timeout_s:.1f}s); lease "
@@ -1946,6 +2076,7 @@ def run_distributed(
     # ---- main loop ----
     exp_span = obs_lib.span("experiment", {"name": name})
     exp_span.__enter__()
+    clean_end = False
     try:
         # Inside the try so every setup is paired with on_experiment_end in
         # the finally (a ProfilerCallback's process-global trace must stop
@@ -2249,6 +2380,13 @@ def run_distributed(
                 except OSError:
                     worker.alive = False  # reader will requeue its trials
                 safe_cb("on_trial_result", trial, trial.last_result)
+                # Forensics: scheduler/searcher debug snapshot at report
+                # boundaries, throttled (same cadence as tune.run).
+                if time.time() - last_sched_persist[0] > 2.0:
+                    last_sched_persist[0] = time.time()
+                    store.write_state(trials, extra={
+                        "scheduler": scheduler_debug_block(searcher, sched),
+                    })
 
             elif mtype == "complete":
                 if msg.get("obs_counters"):
@@ -2278,7 +2416,9 @@ def run_distributed(
                 # event — same guard as tune.run.
                 if not lifecycle.complete_trial(trial):
                     safe_cb("on_trial_complete", trial)
-                store.write_state(trials)
+                store.write_state(trials, extra={
+                    "scheduler": scheduler_debug_block(searcher, sched),
+                })
 
             elif mtype == "error":
                 if msg.get("obs_counters"):
@@ -2301,7 +2441,13 @@ def run_distributed(
                 release(trial)
                 safe_cb("on_trial_error", trial, trial.error)
                 lifecycle.fail_trial(trial, trial.error)
-                store.write_state(trials)
+                store.write_state(trials, extra={
+                    "scheduler": scheduler_debug_block(searcher, sched),
+                })
+        # Reaching here means the loop drained normally: only then is the
+        # journal committed in the finally below — an exception leaves it
+        # uncommitted so resume="auto" picks the run back up.
+        clean_end = True
     finally:
         exp_span.__exit__(None, None, None)
         wall = time.time() - start_time
@@ -2376,6 +2522,23 @@ def run_distributed(
             obs_lib.flush()
             merged_trace = obs_lib.merge_trace_dir(trace_dir)
             obs_lib.shutdown()
+        # Control-plane forensics: final scheduler/searcher snapshot + the
+        # journal counters the crash-recovery runbook keys off
+        # (docs/operations.md — head_incarnations / journal_replays /
+        # duplicate_reports_suppressed / fenced_head_frames, the last
+        # arriving worker-side via the obs cluster aggregation).
+        extra["scheduler"] = scheduler_debug_block(searcher, sched)
+        extra["journal"] = {
+            "head_incarnation": head_incarnation,
+            "decisions": journal.n,
+            "journal_replays": (
+                (replay.replays if replay is not None else 0)
+                + (1 if journal_resume else 0)
+            ),
+            "duplicate_reports_suppressed":
+                lifecycle.duplicate_reports_suppressed,
+            "committed": clean_end,
+        }
         obs_delta = obs_lib.get_registry().delta_since(obs_counters_base)
         obs_block: Dict[str, Any] = {
             k: v for k, v in obs_delta.items() if v
@@ -2394,6 +2557,14 @@ def run_distributed(
             store.close()
         except Exception as exc:  # noqa: BLE001
             log(f"store teardown failed: {exc!r}")
+        # Commit AFTER the final state write (resume="auto" stops looking
+        # at this experiment the moment the commit record lands).
+        try:
+            if clean_end:
+                journal.commit()
+            journal.close()
+        except Exception as exc:  # noqa: BLE001
+            log(f"journal teardown failed: {exc!r}")
         counter_scalars = {
             **{f"liveness/{k}": v
                for k, v in (extra.get("liveness") or {}).items()},
@@ -2408,6 +2579,9 @@ def run_distributed(
                if isinstance(v, (int, float)) and not isinstance(v, bool)},
             **{f"obs/{k}": v
                for k, v in (extra.get("obs") or {}).items()
+               if isinstance(v, (int, float)) and not isinstance(v, bool)},
+            **{f"journal/{k}": v
+               for k, v in (extra.get("journal") or {}).items()
                if isinstance(v, (int, float)) and not isinstance(v, bool)},
         }
         if counter_scalars:

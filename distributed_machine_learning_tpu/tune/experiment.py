@@ -90,8 +90,10 @@ class ExperimentStore:
 
         def _write():
             path = os.path.join(self.trial_dir(trial), "params.json")
-            with open(path, "w") as f:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
                 json.dump(_jsonable(trial.config), f, indent=2)
+            os.replace(tmp, path)
 
         retry_call(_write, key=f"params:{trial.trial_id}")
 

@@ -1,0 +1,401 @@
+"""The experiment journal: a write-ahead log for the control plane.
+
+``loop/journal.py`` made ONE subsystem's controller crash-safe with a
+single-document atomic-replace journal; this module generalizes the
+discipline to the whole scheduling control plane, where state is a
+*stream* of decisions rather than one episode document.  Every decision
+the head/driver makes — trial created, dispatched, reported (with the
+scheduler's continue/stop/requeue verdict and the searcher's
+observation), completed, errored-and-retried — is appended to
+``journal.jsonl`` and fsync'd BEFORE the decision takes externally
+visible effect (params written, frame sent, trial finished).  Decision
+records carry a full ``save_state()`` snapshot of the searcher and
+scheduler, so a restarted head replays the journal and arrives at
+bit-identical decision state: BayesOpt suggests the SAME next config,
+ASHA brackets resume mid-rung, PBT's exploit history is intact.
+
+Why append-only rather than ``loop/journal.py``'s replace-the-document:
+the control plane needs the *history* (per-trial report watermarks for
+exactly-once epoch accounting, the forensic decision trail behind
+``dml-tpu journal status``), and an fsync'd append is one write per
+decision instead of rewriting a growing document N times.
+
+Crash anatomy (the contract ``restore_from_journal`` relies on):
+
+* A record in the file is a decision that WAS taken against in-memory
+  state.  Crash after the append but before the effect → replay restores
+  the post-decision snapshot and re-applies the effect idempotently.
+* A decision not in the file never happened — the memory that held it
+  died with the process.  At worst the world holds *evidence* of the
+  lost in-flight work (a worker-written checkpoint, a result.jsonl
+  line past the watermark); resume truncates/quarantines that evidence
+  so the rerun epochs land exactly once.
+* A torn trailing line (killed mid-append — ``kill_head_during_journal_
+  write`` exercises exactly this) parses as "decision never happened"
+  and is dropped; every earlier record was fsync'd whole.
+
+The file lives at ``<experiment root>/journal.jsonl``.  A ``commit``
+record marks clean shutdown; a journal whose last record is anything
+else is *uncommitted* — the signal ``resume="auto"`` keys off.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from distributed_machine_learning_tpu.analysis.locks import named_lock
+
+FILENAME = "journal.jsonl"
+
+#: Record types that advance the decision counter ``n`` (the coordinate
+#: ``chaos.kill_head_at`` aims at).  ``head_start``/``replay``/``note``/
+#: ``commit`` are bookkeeping, not scheduling decisions.
+DECISION_TYPES = frozenset({
+    "create", "dispatch", "report", "complete", "error",
+})
+
+
+def journal_path(root: str) -> str:
+    return os.path.join(root, FILENAME)
+
+
+def read_records(root: str) -> List[Dict[str, Any]]:
+    """Every whole record in the journal, torn tail dropped.
+
+    Unparsable lines are skipped: a torn line can only be the tail
+    (appends are flushed+fsync'd in order), and a torn tail is, by the
+    WAL contract, a decision that never happened."""
+    path = journal_path(root)
+    records: List[Dict[str, Any]] = []
+    try:
+        with open(path, "r") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
+    except OSError:
+        return []
+    return records
+
+
+def has_journal(root: str) -> bool:
+    return os.path.exists(journal_path(root))
+
+
+def is_uncommitted(root: str) -> bool:
+    """True when a journal exists and its last whole record is not a
+    ``commit`` — the head died (or was killed) mid-experiment.  This is
+    what ``resume="auto"`` detects."""
+    records = read_records(root)
+    return bool(records) and records[-1].get("type") != "commit"
+
+
+class ReplayState:
+    """What ``parse_journal`` distills from the record stream — everything
+    ``TrialLifecycle.restore_from_journal`` needs, precomputed so the
+    restore path is a straight-line application of facts.
+
+    * ``snapshot`` — the newest searcher/scheduler ``save_state()``
+      snapshot + ``next_index`` (None when no decision carried one).
+    * ``trials[trial_id]`` — per-trial facts::
+
+        {"config": ...,            # journaled at create
+         "reported_through": int,  # watermark: max journaled report iter
+         "decision_at_watermark": "continue"|"stop"|"requeue"|None,
+         "requeue": {...}|None,    # PBT exploit payload at the watermark
+         "last_requeue": {...}|None,  # newest exploit payload anywhere —
+                                      # the config/restore target the
+                                      # trial's CURRENT incarnation runs
+                                      # under (exploits rewrite config in
+                                      # memory; params.json keeps the
+                                      # original)
+         "terminal": {"status", "error"}|None}  # journaled complete
+
+    * ``head_starts`` — prior head incarnations (this resume will be
+      ``head_starts + 1``).
+    * ``trace_frame`` — the FIRST head_start's obs context frame: the
+      trace id the resumed incarnation adopts so one trace spans both.
+    """
+
+    def __init__(self) -> None:
+        self.records: List[Dict[str, Any]] = []
+        self.head_starts = 0
+        self.replays = 0
+        self.decisions = 0
+        self.committed = False
+        self.trace_frame: Optional[Dict[str, Any]] = None
+        self.snapshot: Optional[Dict[str, Any]] = None
+        self.trials: Dict[str, Dict[str, Any]] = {}
+
+    def trial(self, trial_id: str) -> Dict[str, Any]:
+        return self.trials.setdefault(str(trial_id), {
+            "config": None,
+            "reported_through": 0,
+            "decision_at_watermark": None,
+            "requeue": None,
+            "last_requeue": None,
+            "terminal": None,
+        })
+
+
+def parse_journal(root: str) -> Optional[ReplayState]:
+    """Distill the journal into a :class:`ReplayState`, or None when no
+    journal exists (callers fall back to the checkpoint-only legacy
+    resume path)."""
+    records = read_records(root)
+    if not records:
+        return None
+    state = ReplayState()
+    state.records = records
+    for rec in records:
+        rtype = rec.get("type")
+        if rtype == "head_start":
+            state.head_starts += 1
+            if state.trace_frame is None and rec.get("obs"):
+                state.trace_frame = dict(rec["obs"])
+        elif rtype == "replay":
+            state.replays += 1
+        elif rtype == "commit":
+            pass
+        elif rtype in DECISION_TYPES:
+            state.decisions = max(state.decisions, int(rec.get("n", 0)))
+            snap = rec.get("state")
+            if snap is not None:
+                state.snapshot = snap
+            tid = rec.get("trial_id")
+            if tid is None:
+                continue
+            t = state.trial(tid)
+            if rtype == "create":
+                t["config"] = rec.get("config")
+            elif rtype == "report":
+                it = int(rec.get("iteration", 0))
+                if it >= int(t["reported_through"]):
+                    t["reported_through"] = it
+                    t["decision_at_watermark"] = rec.get("decision")
+                    t["requeue"] = rec.get("requeue")
+                if rec.get("requeue") is not None:
+                    t["last_requeue"] = rec.get("requeue")
+            elif rtype == "complete":
+                t["terminal"] = {
+                    "status": rec.get("status"),
+                    "error": rec.get("error"),
+                }
+    state.committed = records[-1].get("type") == "commit"
+    return state
+
+
+class ExperimentJournal:
+    """The append handle a live head writes decisions through.
+
+    Appends are ``write + flush + os.fsync`` per record — a decision is
+    durable before its effect happens, which is the whole point.  The
+    chaos hooks live here because this is the only place "after the
+    append landed, before the effect" exists as a program point:
+    ``kill_head_at`` hard-exits right after the Nth decision record is
+    durable, ``kill_head_during_journal_write`` writes half the line and
+    dies — the torn-tail case the parser must shrug off.
+    """
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        self.path = journal_path(root)
+        self._lock = named_lock("tune.journal")
+        self._f = None
+        self.n = 0              # decision counter (monotone, journaled)
+        self.incarnation = 0    # head incarnation (head_start count)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def open(self, obs_frame: Optional[Dict[str, Any]] = None) -> int:
+        """Open for append, adopting any prior stream: the decision
+        counter continues from the newest journaled ``n`` and the head
+        incarnation is ``prior head_starts + 1``.  Writes the
+        ``head_start`` record (carrying this process's obs context frame
+        so a later incarnation can adopt the trace) and returns the
+        incarnation number."""
+        with self._lock:
+            prior = parse_journal(self.root)
+            if prior is not None:
+                self.n = prior.decisions
+                self.incarnation = prior.head_starts + 1
+            else:
+                self.n = 0
+                self.incarnation = 1
+            os.makedirs(self.root, exist_ok=True)
+            self._f = open(self.path, "a")
+            self._append_locked({
+                "type": "head_start",
+                "incarnation": self.incarnation,
+                "pid": os.getpid(),
+                "obs": obs_frame,
+            })
+            return self.incarnation
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+
+    # -- durability core -----------------------------------------------------
+
+    def _append_locked(self, rec: Dict[str, Any]) -> None:
+        if self._f is None:
+            return
+        rec.setdefault("at_unix", round(time.time(), 3))
+        line = json.dumps(rec) + "\n"
+        decision = rec.get("type") in DECISION_TYPES
+        plan = _active_plan()
+        if decision and plan is not None:
+            if plan.poll_torn_journal_write(rec.get("n", 0),
+                                            self.incarnation):
+                # Die mid-append: half a line, fsync'd, no newline — the
+                # torn tail restore must drop.  os._exit like a real kill.
+                self._f.write(line[: max(1, len(line) // 2)])
+                self._f.flush()
+                os.fsync(self._f.fileno())
+                os._exit(87)
+        self._f.write(line)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        if decision and plan is not None:
+            # The record is durable; the effect has not happened.  This
+            # is the crash window kill_head_at aims at.
+            plan.maybe_kill_head(rec.get("n", 0), self.incarnation)
+
+    def _append(self, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            self._append_locked(rec)
+
+    # -- decision records ----------------------------------------------------
+
+    def record_create(self, trial_id: str, config: Dict[str, Any],
+                      state: Dict[str, Any]) -> None:
+        with self._lock:
+            self.n += 1
+            self._append_locked({
+                "type": "create", "n": self.n, "trial_id": str(trial_id),
+                "config": config, "state": state,
+            })
+
+    def record_dispatch(self, trial_id: str,
+                        worker: Optional[str] = None) -> None:
+        with self._lock:
+            self.n += 1
+            rec: Dict[str, Any] = {
+                "type": "dispatch", "n": self.n, "trial_id": str(trial_id),
+            }
+            if worker is not None:
+                rec["worker"] = str(worker)
+            self._append_locked(rec)
+
+    def record_report(self, trial_id: str, iteration: int, decision: str,
+                      value: Optional[float], state: Dict[str, Any],
+                      requeue: Optional[Dict[str, Any]] = None) -> None:
+        with self._lock:
+            self.n += 1
+            rec: Dict[str, Any] = {
+                "type": "report", "n": self.n, "trial_id": str(trial_id),
+                "iteration": int(iteration), "decision": str(decision),
+                "value": value, "state": state,
+            }
+            if requeue is not None:
+                rec["requeue"] = requeue
+            self._append_locked(rec)
+
+    def record_complete(self, trial_id: str, status: str,
+                        state: Dict[str, Any],
+                        error: Optional[str] = None) -> None:
+        with self._lock:
+            self.n += 1
+            self._append_locked({
+                "type": "complete", "n": self.n, "trial_id": str(trial_id),
+                "status": str(status), "error": error, "state": state,
+            })
+
+    def record_error(self, trial_id: str, retried: bool,
+                     state: Dict[str, Any]) -> None:
+        with self._lock:
+            self.n += 1
+            self._append_locked({
+                "type": "error", "n": self.n, "trial_id": str(trial_id),
+                "retried": bool(retried), "state": state,
+            })
+
+    # -- bookkeeping records -------------------------------------------------
+
+    def record_note(self, kind: str, **data: Any) -> None:
+        """Non-decision event worth the forensic trail (lease expiry,
+        worker death, fence sent).  No counter bump, no snapshot."""
+        self._append({"type": "note", "kind": str(kind), **data})
+
+    def record_replay(self, **counts: Any) -> None:
+        """A resumed head finished replaying — journaled so
+        ``journal_replays`` survives further crashes."""
+        # dmlint: disable=unguarded-shared-state single-writer: records land only from the driver event loop, and incarnation is fixed at open() before any record
+        incarnation = self.incarnation
+        self._append({"type": "replay", "incarnation": incarnation, **counts})
+
+    def commit(self) -> None:
+        """Clean-shutdown marker: a journal ending in ``commit`` needs no
+        resume (``resume="auto"`` starts fresh)."""
+        # dmlint: disable=unguarded-shared-state single-writer: records land only from the driver event loop, so n/incarnation cannot move under this read
+        n, incarnation = self.n, self.incarnation
+        self._append({"type": "commit", "n": n, "incarnation": incarnation})
+
+
+def _active_plan():
+    # Lazy: chaos imports tune.storage; keep tune.journal import-light and
+    # cycle-proof.
+    try:
+        from distributed_machine_learning_tpu import chaos
+        return chaos.active_plan()
+    except Exception:
+        return None
+
+
+def journal_status(root: str) -> Dict[str, Any]:
+    """The ``dml-tpu journal status`` document: anatomy of the journal at
+    ``root`` without mutating it."""
+    path = journal_path(root)
+    if not os.path.exists(path):
+        return {"present": False, "path": path}
+    state = parse_journal(root)
+    if state is None:
+        return {"present": True, "path": path, "records": 0,
+                "committed": False}
+    per_trial = {}
+    for tid, t in sorted(state.trials.items()):
+        per_trial[tid] = {
+            "reported_through": t["reported_through"],
+            "decision_at_watermark": t["decision_at_watermark"],
+            "status": (t["terminal"] or {}).get("status"),
+        }
+    snap = state.snapshot or {}
+    return {
+        "present": True,
+        "path": path,
+        "records": len(state.records),
+        "decisions": state.decisions,
+        "committed": state.committed,
+        "head_starts": state.head_starts,
+        "replays": state.replays,
+        "trace_id": (state.trace_frame or {}).get("trace_id"),
+        "next_index": snap.get("next_index"),
+        "trials": per_trial,
+        "last_record": (state.records[-1].get("type")
+                        if state.records else None),
+    }

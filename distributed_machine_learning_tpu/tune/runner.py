@@ -27,7 +27,10 @@ from distributed_machine_learning_tpu.tune.experiment import (
     ExperimentAnalysis,
     ExperimentStore,
 )
-from distributed_machine_learning_tpu.tune._driver import TrialLifecycle
+from distributed_machine_learning_tpu.tune._driver import (
+    TrialLifecycle,
+    scheduler_debug_block,
+)
 from distributed_machine_learning_tpu.tune.schedulers.base import (
     FIFOScheduler,
     TrialScheduler,
@@ -93,7 +96,7 @@ def run(
     time_limit_per_trial_s: Optional[float] = None,
     trial_executor: str = "thread",
     prewarm_runners: int = 0,
-    resume: bool = False,
+    resume: Union[bool, str] = False,
     points_to_evaluate: Optional[List[Dict[str, Any]]] = None,
     progress_deadline_s: Optional[float] = None,
     progress_grace_s: Optional[float] = None,
@@ -208,6 +211,23 @@ def run(
     """
     if mode not in ("min", "max"):
         raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+    from distributed_machine_learning_tpu.tune import journal as journal_lib
+
+    # resume="auto": resume IFF a prior head left an uncommitted decision
+    # journal behind (crash mid-sweep); a committed journal or no journal
+    # means the experiment either finished cleanly or never started, and the
+    # run proceeds fresh.  Unlike resume=True this never raises on a missing
+    # directory — "auto" is safe to pass unconditionally in supervisor loops.
+    journal_resume = False
+    if resume == "auto":
+        if not name:
+            raise ValueError(
+                'resume="auto" needs the explicit experiment `name`'
+            )
+        journal_resume = journal_lib.is_uncommitted(
+            ExperimentStore.root_for(storage_path, name)
+        )
+        resume = journal_resume
     if resume:
         _validate_resume(storage_path, name)
     if compile_cache_dir is not None:
@@ -272,8 +292,19 @@ def run(
     trace = trace or _os.environ.get("DML_OBS_TRACE") == "1"
     trace_dir = _os.path.join(store.root, "trace") if trace else None
     prev_dump_dir = obs_lib.dump_dir()
+    # Journal-based resume adopts the dead head's trace identity BEFORE the
+    # tracer is configured, so one trace id spans both head incarnations —
+    # the resumed sweep's spans merge into the same trace.json.
+    replay = journal_lib.parse_journal(store.root) if journal_resume else None
+    prior_frame = (replay.trace_frame if replay is not None else None) or {}
     obs_lib.configure(trace_dir=trace_dir, label="driver",
-                      dump_dir=store.root)
+                      dump_dir=store.root,
+                      trace_id=prior_frame.get("trace_id"),
+                      parent_span_id=prior_frame.get("parent_span_id"))
+    # Every scheduling decision is journaled (write-ahead) before it takes
+    # effect; `journal.commit()` at clean teardown is what "auto" checks for.
+    journal = journal_lib.ExperimentJournal(store.root)
+    head_incarnation = journal.open(obs_frame=obs_lib.trace_context_frame())
     profile_dir = (
         _os.path.join(store.root, "profile")
         if trace_profile_trials > 0 else None
@@ -309,6 +340,7 @@ def run(
     max_concurrent = max_concurrent or device_mgr.num_devices
     running: Dict[str, List] = {}  # trial_id -> leased devices
     last_status_print = 0.0
+    last_sched_persist = 0.0
 
     def log(msg: str):
         if verbose:
@@ -331,6 +363,7 @@ def run(
             **({"mesh_shape": dict(mesh_shape)} if mesh_shape else {}),
             **({"input_mode": input_mode} if input_mode else {}),
         } or None,
+        journal=journal,
     )
     trials = lifecycle.trials
     pending = lifecycle.pending
@@ -346,7 +379,15 @@ def run(
             lambda: {**watchdog.snapshot(), **liveness_counters},
         )
 
-    if resume:
+    if journal_resume and replay is not None:
+        counts = lifecycle.restore_from_journal(replay, resources=resources)
+        log(
+            f"resumed {name} from journal (head incarnation "
+            f"{head_incarnation}): {counts['finished']} finished trials "
+            f"kept, {counts['requeued']} interrupted trials requeued, "
+            f"{counts['suppress_windows']} replay suppression windows"
+        )
+    elif resume:
         counts = lifecycle.restore_experiment(resources=resources)
         log(
             f"resumed {name}: {counts['finished']} finished trials kept, "
@@ -492,7 +533,7 @@ def run(
                 trial.stop_requested = True
 
     def event_loop():
-        nonlocal last_status_print
+        nonlocal last_status_print, last_sched_persist
         while True:
             while not lifecycle.exhausted() and (
                 len(pending) + len(running) < max_concurrent + 2
@@ -583,13 +624,24 @@ def run(
                 # buggy callback must not stall (or hang) training.
                 result_event.done.set()
                 safe_cb("on_trial_result", trial, trial.last_result)
+                # Forensics (satellite of the durable-control-plane work):
+                # persist the scheduler/searcher debug snapshot at report
+                # boundaries, throttled so a chatty sweep doesn't rewrite
+                # experiment_state.json on every epoch.
+                if time.time() - last_sched_persist > 2.0:
+                    last_sched_persist = time.time()
+                    store.write_state(trials, extra={
+                        "scheduler": scheduler_debug_block(searcher, sched),
+                    })
 
             elif kind == "complete":
                 trial = event[1]
                 release_devices(trial)
                 if not lifecycle.complete_trial(trial):
                     safe_cb("on_trial_complete", trial)
-                store.write_state(trials)
+                store.write_state(trials, extra={
+                    "scheduler": scheduler_debug_block(searcher, sched),
+                })
 
             elif kind == "error":
                 trial, tb = event[1], event[2]
@@ -603,11 +655,14 @@ def run(
                     liveness_counters["stall_requeues"] += 1
                 if not retried and verbose:
                     log(f"{trial.trial_id} errored:\n{tb}")
-                store.write_state(trials)
+                store.write_state(trials, extra={
+                    "scheduler": scheduler_debug_block(searcher, sched),
+                })
 
     # Teardown always runs (Ctrl-C, store errors, a callback's setup raising):
     # callbacks must see experiment end so e.g. ProfilerCallback stops the
     # process-global trace and JsonlCallback closes its file.
+    clean_end = False
     try:
         # The experiment root span: every driver-side span (trial
         # dispatches) and, via frame context, every child/worker span
@@ -616,6 +671,10 @@ def run(
             for cb in callbacks:
                 cb.setup(store.root, metric, mode)
             event_loop()
+        # Reaching here means the sweep drained normally — only then is the
+        # journal committed below; an exception (Ctrl-C, store failure)
+        # leaves it uncommitted so resume="auto" picks the run back up.
+        clean_end = True
     finally:
         # Clock first (teardown time is not experiment time), then tear the
         # executor down: an interrupted sweep must not leave orphan trial
@@ -692,6 +751,22 @@ def run(
             obs_lib.flush()
             merged_trace = obs_lib.merge_trace_dir(trace_dir)
             obs_lib.shutdown()
+        # Control-plane forensics: final scheduler/searcher snapshot plus
+        # the journal counters the crash-recovery runbook keys off
+        # (docs/operations.md — head_incarnations / journal_replays /
+        # duplicate_reports_suppressed).
+        extra["scheduler"] = scheduler_debug_block(searcher, sched)
+        extra["journal"] = {
+            "head_incarnation": head_incarnation,
+            "decisions": journal.n,
+            "journal_replays": (
+                (replay.replays if replay is not None else 0)
+                + (1 if journal_resume else 0)
+            ),
+            "duplicate_reports_suppressed":
+                lifecycle.duplicate_reports_suppressed,
+            "committed": clean_end,
+        }
         obs_delta = obs_lib.get_registry().delta_since(obs_counters_base)
         obs_block = {k: v for k, v in obs_delta.items() if v}
         if merged_trace is not None:
@@ -706,6 +781,15 @@ def run(
             store.close()
         except Exception as exc:  # noqa: BLE001 - callbacks still tear down
             log(f"experiment store teardown failed: {exc!r}")
+        # Commit AFTER the final state write: once the commit record lands,
+        # resume="auto" treats the experiment as finished, so everything it
+        # would need must already be durable.
+        try:
+            if clean_end:
+                journal.commit()
+            journal.close()
+        except Exception as exc:  # noqa: BLE001
+            log(f"journal teardown failed: {exc!r}")
         counter_scalars = {
             **{f"liveness/{k}": v
                for k, v in (extra.get("liveness") or {}).items()},
@@ -722,6 +806,9 @@ def run(
                if isinstance(v, (int, float)) and not isinstance(v, bool)},
             **{f"obs/{k}": v
                for k, v in (extra.get("obs") or {}).items()
+               if isinstance(v, (int, float)) and not isinstance(v, bool)},
+            **{f"journal/{k}": v
+               for k, v in (extra.get("journal") or {}).items()
                if isinstance(v, (int, float)) and not isinstance(v, bool)},
         }
         if counter_scalars:

@@ -93,5 +93,24 @@ class ASHAScheduler(TrialScheduler):
         cutoff = sorted(scores)[k - 1]
         return CONTINUE if score <= cutoff else STOP
 
+    def save_state(self) -> Dict[str, Any]:
+        return {
+            "rung_scores": {
+                str(r): list(s) for r, s in self.rung_scores.items()
+            },
+            "trial_next_rung": dict(self._trial_next_rung),
+        }
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        # Runs AFTER on_trial_add re-registered every live trial, so the
+        # journaled rung positions overwrite the fresh zeros.
+        for r, scores in state.get("rung_scores", {}).items():
+            if int(r) in self.rung_scores:
+                self.rung_scores[int(r)] = [float(v) for v in scores]
+        self._trial_next_rung.update({
+            str(t): int(r)
+            for t, r in state.get("trial_next_rung", {}).items()
+        })
+
     def debug_state(self) -> Dict[int, int]:
         return {r: len(s) for r, s in self.rung_scores.items()}

@@ -39,6 +39,20 @@ class TrialScheduler:
     def on_trial_error(self, trial: Trial):
         pass
 
+    def save_state(self) -> Dict[str, Any]:
+        """JSON-serializable snapshot of ALL decision-relevant mutable
+        state — journaled after every scheduling decision
+        (``tune/journal.py``) so a restarted head restores a scheduler
+        that makes bit-identical decisions (ASHA resumes mid-rung, PBT
+        keeps its exploit history).  Live ``Trial`` references are NOT
+        state — resume rebuilds them via ``on_trial_add`` before calling
+        ``restore_state``.  Stateless schedulers (FIFO) inherit this
+        empty default."""
+        return {}
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        pass
+
 
 class FIFOScheduler(TrialScheduler):
     """No early stopping; trials run to completion in submission order."""

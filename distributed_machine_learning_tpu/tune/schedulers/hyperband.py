@@ -104,5 +104,23 @@ class HyperBandScheduler(TrialScheduler):
             return CONTINUE
         return self.brackets[idx].on_trial_result(trial, result)
 
+    def save_state(self) -> Dict[str, Any]:
+        return {
+            "brackets": [b.save_state() for b in self.brackets],
+            "assigned_counts": list(self._assigned_counts),
+            "trial_bracket": dict(self._trial_bracket),
+        }
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        for b, sub in zip(self.brackets, state.get("brackets", [])):
+            b.restore_state(sub)
+        counts = state.get("assigned_counts")
+        if counts is not None:
+            self._assigned_counts = [int(c) for c in counts]
+        self._trial_bracket.update({
+            str(t): int(i)
+            for t, i in state.get("trial_bracket", {}).items()
+        })
+
     def debug_state(self) -> List[Dict[int, int]]:
         return [b.debug_state() for b in self.brackets]

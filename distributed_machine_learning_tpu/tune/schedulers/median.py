@@ -52,3 +52,14 @@ class MedianStoppingRule(TrialScheduler):
             return CONTINUE
         best_so_far = min(self._history[trial.trial_id])
         return STOP if best_so_far > float(np.median(running_avgs)) else CONTINUE
+
+    def save_state(self) -> Dict[str, Any]:
+        return {
+            "history": {t: list(h) for t, h in self._history.items()},
+        }
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        self._history = {
+            str(t): [float(v) for v in h]
+            for t, h in state.get("history", {}).items()
+        }

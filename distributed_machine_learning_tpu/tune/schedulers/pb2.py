@@ -142,6 +142,28 @@ class PB2(PopulationBasedTraining):
             new[k] = self.mutations[k].from_unit(float(ui))
         return new
 
+    def save_state(self) -> Dict[str, Any]:
+        state = super().save_state()
+        state["obs"] = [
+            [[float(v) for v in x], float(dy)] for x, dy in self._obs
+        ]
+        state["last_score"] = {
+            t: [int(i), float(s)]
+            for t, (i, s) in self._last_score.items()
+        }
+        return state
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        super().restore_state(state)
+        self._obs = [
+            (np.array(x, dtype=np.float64), float(dy))
+            for x, dy in state.get("obs", [])
+        ]
+        self._last_score = {
+            str(t): (int(v[0]), float(v[1]))
+            for t, v in state.get("last_score", {}).items()
+        }
+
     def debug_state(self):
         state = super().debug_state()
         state["num_observations"] = len(self._obs)

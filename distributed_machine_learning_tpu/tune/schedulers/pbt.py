@@ -297,6 +297,24 @@ class PopulationBasedTraining(TrialScheduler):
     def _find_trial(self, trial_id: str) -> Optional[Trial]:
         return getattr(self, "_trials", {}).get(trial_id)
 
+    def save_state(self) -> Dict[str, Any]:
+        # ``_trials`` (live Trial refs) is deliberately absent: resume
+        # rebuilds it through on_trial_add before restore_state runs.
+        return {
+            "history": {t: [[int(i), float(s)] for i, s in h]
+                        for t, h in self._history.items()},
+            "num_perturbations": self._num_perturbations,
+            "generation_log": list(self._generation_log),
+        }
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        self._history = {
+            str(t): [(int(i), float(s)) for i, s in h]
+            for t, h in state.get("history", {}).items()
+        }
+        self._num_perturbations = int(state.get("num_perturbations", 0))
+        self._generation_log = list(state.get("generation_log", []))
+
     def debug_state(self):
         return {"num_perturbations": self._num_perturbations}
 

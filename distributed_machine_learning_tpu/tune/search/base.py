@@ -50,6 +50,19 @@ class Searcher:
                           result: Optional[Dict[str, Any]], metric: str, mode: str):
         pass
 
+    def save_state(self) -> Dict[str, Any]:
+        """JSON-serializable snapshot of ALL decision-relevant mutable
+        state — journaled by ``tune/journal.py`` after every decision so
+        a restarted head restores a bit-identical searcher (the WAL
+        contract: ``restore_state(save_state())`` followed by
+        ``suggest(i)`` must equal the uninterrupted ``suggest(i)``).
+        Stateless searchers (RandomSearch — suggest is pure in the trial
+        index) inherit this empty default."""
+        return {}
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        pass
+
 
 class WarmStartSearcher(Searcher):
     """Evaluate given configs first, then delegate to the wrapped searcher.
@@ -87,6 +100,13 @@ class WarmStartSearcher(Searcher):
 
     def on_trial_complete(self, trial_id, config, result, metric, mode):
         self.inner.on_trial_complete(trial_id, config, result, metric, mode)
+
+    def save_state(self) -> Dict[str, Any]:
+        # The points list is constructor state; only the inner model moves.
+        return {"inner": self.inner.save_state()}
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        self.inner.restore_state(state.get("inner", {}))
 
 
 def maybe_warm_start(searcher: Searcher, points) -> Searcher:
@@ -164,6 +184,15 @@ class GridSearch(Searcher):
         for i in range(num_trials):
             if self.suggest(i) is None:
                 break
+
+    def save_state(self) -> Dict[str, Any]:
+        return {"cursor": getattr(self, "_cursor", 0)}
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        # Restoring the cursor directly (instead of fast_forward's re-walk)
+        # lands on the identical next grid point without re-evaluating
+        # feasibility — bit-identical by construction.
+        self._cursor = int(state.get("cursor", 0))
 
     @property
     def num_points(self) -> int:

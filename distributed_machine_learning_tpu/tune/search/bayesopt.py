@@ -179,6 +179,28 @@ class BayesOptSearch(Searcher):
         )
         self._pending.pop(nearest, None)
 
+    def save_state(self) -> Dict[str, Any]:
+        # float64 → JSON shortest-repr → float64 round-trips exactly, so
+        # the restored GP fit (and therefore the next suggestion) is
+        # bit-identical to the uninterrupted run's.
+        return {
+            "X": [[float(v) for v in x] for x in self._X],
+            "y": [float(v) for v in self._y],
+            "pending": {
+                str(k): [float(v) for v in u]
+                for k, u in self._pending.items()
+            },
+        }
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        self._X = [np.array(x, dtype=np.float64)
+                   for x in state.get("X", [])]
+        self._y = [float(v) for v in state.get("y", [])]
+        self._pending = {
+            int(k): np.array(u, dtype=np.float64)
+            for k, u in state.get("pending", {}).items()
+        }
+
     def on_trial_complete(self, trial_id, config, result, metric, mode):
         if not self._cont_keys:
             return

@@ -78,6 +78,28 @@ class Repeater(Searcher):
         # are exactly the noise the averaging exists to remove.
         pass
 
+    def save_state(self) -> Dict[str, Any]:
+        return {
+            "inner": self.inner.save_state(),
+            "group_configs": {
+                str(g): dict(c) for g, c in self._group_configs.items()
+            },
+            "group_scores": {
+                str(g): list(s) for g, s in self._group_scores.items()
+            },
+        }
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        self.inner.restore_state(state.get("inner", {}))
+        self._group_configs = {
+            int(g): dict(c)
+            for g, c in state.get("group_configs", {}).items()
+        }
+        self._group_scores = {
+            int(g): list(s)
+            for g, s in state.get("group_scores", {}).items()
+        }
+
     def on_trial_complete(self, trial_id, config, result, metric, mode):
         m = _TRIAL_ID_RE.search(trial_id or "")
         if not m:  # foreign id (not a framework trial): nothing to map

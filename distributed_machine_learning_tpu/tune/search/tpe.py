@@ -149,6 +149,28 @@ class TPESearch(Searcher):
     def on_trial_complete(self, trial_id, config, result, metric, mode):
         self._record(trial_id, config, result, metric, mode)
 
+    def save_state(self) -> Dict[str, Any]:
+        # JSON keys must be strings; budgets are ints — stringify on the
+        # way out, int() on the way back.
+        return {
+            "obs": {
+                str(budget): {
+                    tid: [score, config]
+                    for tid, (score, config) in per_trial.items()
+                }
+                for budget, per_trial in self._obs.items()
+            },
+        }
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        self._obs = {
+            int(budget): {
+                tid: (float(sc[0]), dict(sc[1]))
+                for tid, sc in per_trial.items()
+            }
+            for budget, per_trial in state.get("obs", {}).items()
+        }
+
     # -- model ----------------------------------------------------------------
     def _training_set(self) -> List[Tuple[float, Dict[str, Any]]]:
         """Observations at the largest budget with >= min_points samples."""

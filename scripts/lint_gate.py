@@ -62,6 +62,8 @@ def main(argv=None) -> int:
                    help="skip the quantize-export-load smoke")
     p.add_argument("--no-loop-smoke", action="store_true",
                    help="skip the drift-retrain-promote loop smoke")
+    p.add_argument("--no-head-smoke", action="store_true",
+                   help="skip the head-crash auto-resume smoke")
     args = p.parse_args(argv)
 
     cmd = [sys.executable, "-m", "distributed_machine_learning_tpu",
@@ -110,6 +112,10 @@ def main(argv=None) -> int:
             return rc
     if proc.returncode == 0 and not args.no_loop_smoke:
         rc = _loop_smoke(env)
+        if rc:
+            return rc
+    if proc.returncode == 0 and not args.no_head_smoke:
+        rc = _head_crash_smoke(env)
         if rc:
             return rc
     return proc.returncode
@@ -275,6 +281,44 @@ def _loop_smoke(env) -> int:
         print("loop smoke: FAILED")
         return 1
     print(f"loop smoke: ok {proc.stdout.strip().splitlines()[-1]}")
+    return 0
+
+
+def _head_crash_smoke(env) -> int:
+    """Durable-control-plane smoke in a child (JAX_PLATFORMS=cpu): a tiny
+    sweep's driver is killed (``os._exit(86)`` mid-journal-append, the
+    chaos ``kill_head_at`` fault) at decision 4, ``resume="auto"``
+    replays the write-ahead journal, and the finished experiment must
+    name the SAME best trial as an uninterrupted control — the tune
+    journal contract, gated like a lint finding."""
+    code = (
+        "import json, tempfile\n"
+        "from distributed_machine_learning_tpu.tune import crashsim\n"
+        "root = tempfile.mkdtemp(prefix='head_crash_smoke_')\n"
+        "spec = dict(num_samples=3, epochs=3, seed=5)\n"
+        "ctrl = crashsim.control_run(root, 'ctrl', **spec)\n"
+        "out = crashsim.killed_then_resumed(root, 'crash', kill_at=4,\n"
+        "                                   **spec)\n"
+        "assert out['crash_rc'] == crashsim.HEAD_KILL_EXIT\n"
+        "res = out['result']\n"
+        "assert res['best_trial'] == ctrl['best_trial'], (res, ctrl)\n"
+        "assert res['best_score'] == ctrl['best_score'], (res, ctrl)\n"
+        "assert out['journal']['committed'] is True\n"
+        "assert out['journal']['head_starts'] == 2\n"
+        "print(json.dumps({'best_trial': res['best_trial'],\n"
+        "                  'detect_s': out['detect_s'],\n"
+        "                  'replay_s': out['replay_s'],\n"
+        "                  'requeue_s': out['requeue_s']}))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO, capture_output=True, text=True, env=env, timeout=300,
+    )
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr)
+        print("head-crash smoke: FAILED")
+        return 1
+    print(f"head-crash smoke: ok {proc.stdout.strip().splitlines()[-1]}")
     return 0
 
 

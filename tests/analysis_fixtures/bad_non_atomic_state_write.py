@@ -1,0 +1,39 @@
+# dmlint-scope: state-write
+"""Historical risk pattern (ISSUE 18 satellite): control-plane state
+written with a bare ``open(path, "w")`` + ``json.dump``.  A head crash
+(or chaos SIGKILL) between truncate and flush leaves a torn/empty JSON
+file, and the very resume path that needs the state then fails parsing
+it.  The repo's discipline is write-temp-then-``os.replace`` (see
+tune/storage.py and ExperimentStore.write_state)."""
+
+import json
+import os
+
+
+def write_trial_params(root, trial_id, config):
+    """Truncates params.json in place: a crash mid-dump tears it."""
+    path = os.path.join(root, trial_id, "params.json")
+    with open(path, "w") as f:
+        json.dump(config, f, indent=2)  # EXPECT: non-atomic-state-write
+
+
+def checkpoint_manifest(directory, manifest):
+    # mode passed by keyword is still a truncating text write
+    f = open(os.path.join(directory, "manifest.json"), mode="w")
+    try:
+        json.dump(manifest, f)  # EXPECT: non-atomic-state-write
+    finally:
+        f.close()
+
+
+class StateStore:
+    def __init__(self, root):
+        self.root = root
+
+    def flush(self, doc):
+        # "I'll fsync later" does not help: the truncate already
+        # destroyed the previous good snapshot.
+        with open(os.path.join(self.root, "state.json"), "w") as f:
+            json.dump(doc, f)  # EXPECT: non-atomic-state-write
+            f.flush()
+            os.fsync(f.fileno())

@@ -1,0 +1,52 @@
+# dmlint-scope: state-write
+"""Idiomatic twins of bad_non_atomic_state_write.py: every durable state
+snapshot goes through write-temp-then-``os.replace`` (readers see the
+old state or the new one, never a torn write), and the shapes DML020
+deliberately exempts — append-only line-framed journals, dumps to
+in-memory sinks — stay silent."""
+
+import json
+import os
+
+
+def write_trial_params(root, trial_id, config):
+    """The sanctioned shape: dump to a temp name, then rename over."""
+    path = os.path.join(root, trial_id, "params.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(config, f, indent=2)
+    os.replace(tmp, path)
+
+
+def checkpoint_manifest(directory, manifest):
+    target = os.path.join(directory, "manifest.json")
+    tmp = target + ".tmp"
+    with open(tmp, mode="w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp, target)
+
+
+def append_journal_record(path, record):
+    """Append-only journals are exempt: torn trailing lines are dropped
+    on replay, so no rename dance is needed per record."""
+    with open(path, "a") as f:
+        f.write(json.dumps(record) + "\n")
+        f.flush()
+
+
+def dump_to_buffer(doc, sink):
+    """json.dump to a caller-provided sink (socket, StringIO): no file
+    truncation happens here, nothing to make atomic."""
+    json.dump(doc, sink)
+
+
+def publish_state(path, doc):
+    """pathlib's one-argument .replace() counts as the atomic rename."""
+    import pathlib
+
+    tmp = pathlib.Path(str(path) + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    tmp.replace(path)

@@ -51,6 +51,7 @@ def _capture_file_in_tmp(monkeypatch, tmp_path):
     monkeypatch.setenv("DML_BENCH_QUALITY_BUDGET_S", "0")
     monkeypatch.setenv("DML_BENCH_STREAMING", "0")
     monkeypatch.setenv("DML_BENCH_ONLINE_LOOP", "0")
+    monkeypatch.setenv("DML_BENCH_HEAD_RECOVERY", "0")
 
 
 def _detail() -> dict:
@@ -102,6 +103,16 @@ _ONLINE_LOOP_STUB = {
     "requests_total": 78, "dropped": 0, "swaps_total": 1,
     "post_swap_new_programs": 0, "probation_mape": 1.15,
     "incumbent_mape": 5.86, "wall_s": 3.6,
+}
+
+
+# What the head_recovery child emits, for parent-flow stubs (the child
+# itself runs for real in test_child_head_recovery_end_to_end_tiny).
+_HEAD_RECOVERY_STUB = {
+    "detect_s": 0.0002, "replay_s": 0.027, "requeue_s": 0.001,
+    "resume_total_s": 1.7, "decisions_journaled": 29,
+    "head_incarnations": 2, "best_matches_control": True,
+    "committed": True,
 }
 
 
@@ -352,11 +363,14 @@ def test_main_cpu_fallback_emit_fields(monkeypatch, capsys):
             return 0, json.dumps(_STREAMING_STUB), "", True
         if args[:2] == ["--child", "online_loop"]:
             return 0, json.dumps(_ONLINE_LOOP_STUB), "", True
+        if args[:2] == ["--child", "head_recovery"]:
+            return 0, json.dumps(_HEAD_RECOVERY_STUB), "", True
         raise AssertionError(f"unexpected child {args}")
 
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
     monkeypatch.setenv("DML_BENCH_STREAMING", "1")
     monkeypatch.setenv("DML_BENCH_ONLINE_LOOP", "1")
+    monkeypatch.setenv("DML_BENCH_HEAD_RECOVERY", "1")
     monkeypatch.delenv("DML_TUNNEL_PYTHONPATH", raising=False)
     # A banked chip capture exists (as in the real repo) -> the reference
     # backend is tpu and a CPU fallback is cross-backend.
@@ -418,6 +432,13 @@ def test_main_cpu_fallback_emit_fields(monkeypatch, capsys):
     assert line["online_loop"]["recovered"] is True
     assert line["online_loop"]["dropped"] == 0
     assert line["online_loop"]["post_swap_new_programs"] == 0
+    # head_recovery section (ISSUE 18): recovery timings in the sidecar,
+    # compact crash-equals-control claim in the emitted line.
+    assert detail["head_recovery"]["head_incarnations"] == 2
+    assert detail["head_recovery"]["committed"] is True
+    assert "head_recovery_s" in detail["phases"]
+    assert line["head_recovery"]["best_matches_control"] is True
+    assert line["head_recovery"]["replay_s"] == 0.027
     assert "streaming_s" in detail["phases"]
 
 
@@ -1317,6 +1338,19 @@ def test_child_online_loop_end_to_end_tiny(capsys):
     assert out["dropped"] == 0
     assert out["post_swap_new_programs"] == 0
     assert out["detect_s"] >= 0 and out["heal_s"] > 0
+
+
+def test_child_head_recovery_end_to_end_tiny(capsys):
+    """child_head_recovery for real: a sweep's head is killed mid-
+    journal-append, auto-resume finishes it, and the emitted timings
+    carry the counter-verified crash-equals-control claim."""
+    bench.child_head_recovery()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["best_matches_control"] is True
+    assert out["committed"] is True
+    assert out["head_incarnations"] == 2
+    assert out["detect_s"] >= 0 and out["replay_s"] >= 0
+    assert out["resume_total_s"] > 0
 
 
 def test_multihost_section_cpu_and_tunnel_skip_with_reason(monkeypatch):

@@ -1,0 +1,293 @@
+"""Head-crash chaos e2e: the ISSUE 18 acceptance scenario.
+
+A sweep whose head/driver is SIGKILLed mid-flight (``chaos.kill_head_at``
+fires ``os._exit(86)`` right after a decision record is fsync'd and
+before its effect happens) must, after ``resume="auto"``:
+
+* finish with the SAME best trial (and score) as an uninterrupted
+  control run of the identical spec;
+* report zero duplicate epochs — every trial's journaled/persisted
+  iteration stream is strictly increasing;
+* span both head incarnations with ONE trace id;
+* restore searcher/scheduler state bit-identically (the replayed
+  BayesOpt proposes the exact config the dead head would have).
+
+All sweeps run in child processes via tune/crashsim.py — the kill is
+real (``os._exit``, no unwinding), not monkeypatched.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, TESTS_DIR)
+
+from distributed_machine_learning_tpu import tune
+from distributed_machine_learning_tpu.tune import crashsim
+from distributed_machine_learning_tpu.tune import journal as journal_lib
+
+
+def _assert_no_duplicate_epochs(result):
+    for tid, iters in result["trial_iterations"].items():
+        assert iters == sorted(set(iters)), (
+            f"{tid} reported duplicate/out-of-order epochs: {iters}"
+        )
+
+
+def _trace_ids(root):
+    ids = []
+    for rec in journal_lib.read_records(root):
+        if rec.get("type") == "head_start":
+            frame = rec.get("obs") or rec.get("trace") or {}
+            tid = frame.get("trace_id")
+            if tid:
+                ids.append(tid)
+    return ids
+
+
+def _journal_counters(root):
+    with open(os.path.join(root, "experiment_state.json")) as f:
+        return json.load(f).get("journal", {})
+
+
+# --------------------------------------------------------------------------
+# thread driver
+# --------------------------------------------------------------------------
+
+
+def test_thread_head_crash_resume_matches_control(tmp_path):
+    spec = dict(num_samples=4, epochs=4, seed=7, trace=True)
+    control = crashsim.control_run(str(tmp_path), "ctrl", **spec)
+    out = crashsim.killed_then_resumed(
+        str(tmp_path), "crash", kill_at=6, **spec
+    )
+
+    assert out["crash_rc"] == crashsim.HEAD_KILL_EXIT
+    result = out["result"]
+    assert result["best_trial"] == control["best_trial"]
+    assert result["best_score"] == pytest.approx(control["best_score"])
+    assert result["num_terminated"] == control["num_terminated"]
+    _assert_no_duplicate_epochs(result)
+
+    status = out["journal"]
+    assert status["committed"] is True
+    assert status["head_starts"] == 2
+    assert status["replays"] == 1
+
+    root = str(tmp_path / "crash")
+    counters = _journal_counters(root)
+    assert counters["head_incarnation"] == 2
+    assert counters["journal_replays"] == 1
+    assert counters["committed"] is True
+
+    # one trace id spans both head incarnations
+    ids = _trace_ids(root)
+    assert len(ids) == 2 and len(set(ids)) == 1, ids
+
+
+def test_torn_journal_append_is_dropped_and_resumed(tmp_path):
+    """Killed MID-append (half a line, fsync'd, no newline): the torn
+    tail parses as "decision never happened" and resume completes."""
+    spec = dict(num_samples=4, epochs=4, seed=7)
+    control = crashsim.control_run(str(tmp_path), "tctrl", **spec)
+    out = crashsim.killed_then_resumed(
+        str(tmp_path), "torn", kill_at=6, torn_write=True, **spec
+    )
+    assert out["crash_rc"] == crashsim.TORN_JOURNAL_EXIT
+    assert out["result"]["best_trial"] == control["best_trial"]
+    assert out["result"]["best_score"] == pytest.approx(
+        control["best_score"]
+    )
+    _assert_no_duplicate_epochs(out["result"])
+    assert out["journal"]["committed"] is True
+
+
+def test_uncommitted_detection_and_auto_skip(tmp_path):
+    """resume="auto" on a CLEAN experiment starts fresh (no journal →
+    not uncommitted), so supervisors can pass it unconditionally."""
+    crashsim.control_run(str(tmp_path), "clean", num_samples=2, epochs=2)
+    root = str(tmp_path / "clean")
+    assert journal_lib.has_journal(root)
+    assert not journal_lib.is_uncommitted(root)
+    # and a second auto run over the committed journal completes fresh
+    rc, result = crashsim.run_child({
+        "driver": "thread", "storage_path": str(tmp_path),
+        "name": "clean2", "num_samples": 2, "epochs": 2,
+        "resume": "auto", "phase": "auto",
+    })
+    assert rc == 0 and result["num_terminated"] == 2
+
+
+# --------------------------------------------------------------------------
+# restart determinism: suggestion streams
+# --------------------------------------------------------------------------
+
+
+def _x_stream(root):
+    return [
+        round(float(cfg["x"]), 12)
+        for _, cfg in crashsim.suggestion_stream(root)
+    ]
+
+
+def test_bayesopt_restart_determinism(tmp_path):
+    """A BayesOpt sweep journaled, killed, and restored mid-sweep emits
+    the identical suggestion stream as its uninterrupted control."""
+    spec = dict(
+        searcher="bayes", max_concurrent=1, num_samples=6, epochs=3,
+        seed=11,
+    )
+    crashsim.control_run(str(tmp_path), "bo_ctrl", **spec)
+    out = crashsim.killed_then_resumed(
+        str(tmp_path), "bo_crash", kill_at=9, **spec
+    )
+    ctrl_stream = _x_stream(str(tmp_path / "bo_ctrl"))
+    crash_stream = _x_stream(str(tmp_path / "bo_crash"))
+    assert len(ctrl_stream) == 6
+    assert crash_stream == ctrl_stream
+    assert out["result"]["best_trial"] is not None
+    _assert_no_duplicate_epochs(out["result"])
+
+
+def test_pbt_restart_determinism(tmp_path):
+    """A PBT sweep killed mid-flight restores its exploit history and
+    population bit-identically: same creates, same final configs."""
+    # max_concurrent=1 serializes the population: PBT's quantile
+    # decisions depend on report interleaving, so concurrency would make
+    # even control-vs-control nondeterministic — this test isolates
+    # journal-replay determinism, not PBT under load.
+    spec = dict(
+        scheduler="pbt", num_samples=4, epochs=6, seed=13,
+        max_concurrent=1,
+    )
+    control = crashsim.control_run(str(tmp_path), "pbt_ctrl", **spec)
+    out = crashsim.killed_then_resumed(
+        str(tmp_path), "pbt_crash", kill_at=8, **spec
+    )
+    assert _x_stream(str(tmp_path / "pbt_crash")) == _x_stream(
+        str(tmp_path / "pbt_ctrl")
+    )
+    assert out["result"]["best_trial"] == control["best_trial"]
+    assert out["result"]["best_score"] == pytest.approx(
+        control["best_score"]
+    )
+    # PBT exploits legitimately re-run an epoch from a donor checkpoint,
+    # so "no duplicates" is the wrong invariant here — instead the
+    # killed+resumed run must reproduce the control's exact per-trial
+    # iteration streams (crash-induced duplicates would diverge).
+    assert out["result"]["trial_iterations"] == control["trial_iterations"]
+
+
+# --------------------------------------------------------------------------
+# bit-identical replayed searcher state
+# --------------------------------------------------------------------------
+
+
+def test_replayed_searcher_proposes_same_next_config(tmp_path):
+    """The WAL contract, asserted directly on the journal: restore a
+    FRESH searcher from the snapshot inside create record k and it must
+    propose exactly the config journaled in create record k+1."""
+    crashsim.control_run(
+        str(tmp_path), "snap", searcher="bayes", max_concurrent=1,
+        num_samples=6, epochs=3, seed=11,
+    )
+    root = str(tmp_path / "snap")
+    creates = [
+        r for r in journal_lib.read_records(root)
+        if r.get("type") == "create"
+    ]
+    assert len(creates) == 6
+    # pick a post-random-phase pair so the GP (not the random warmup) is
+    # the thing being restored
+    prev, nxt = creates[-2], creates[-1]
+    searcher = tune.BayesOptSearch(random_search_steps=4)
+    # the same space + seed the crashsim child's driver used
+    from distributed_machine_learning_tpu.tune.search_space import (
+        SearchSpace,
+    )
+
+    searcher.set_search_space(
+        SearchSpace({
+            "x": tune.uniform(0.0, 1.0), "epochs": 3, "epoch_s": 0.01,
+        }),
+        11,
+    )
+    searcher.restore_state(prev["state"]["searcher"])
+    sugg = searcher.suggest(prev["state"]["next_index"])
+    assert sugg is not None
+    assert float(sugg["x"]) == pytest.approx(
+        float(nxt["config"]["x"]), abs=1e-12
+    )
+
+
+# --------------------------------------------------------------------------
+# cluster driver
+# --------------------------------------------------------------------------
+
+
+def _worker_env():
+    keep = [
+        p
+        for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        if p and ".axon_site" not in p
+    ]
+    return {
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.pathsep.join([TESTS_DIR] + keep),
+    }
+
+
+@pytest.fixture(scope="module")
+def worker_pool():
+    from distributed_machine_learning_tpu.tune.cluster import (
+        start_local_workers,
+    )
+
+    procs, addrs = start_local_workers(2, slots=2, env=_worker_env())
+    yield addrs
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except Exception:
+            p.kill()
+
+
+def test_cluster_head_crash_resume_matches_control(worker_pool, tmp_path):
+    spec = dict(
+        driver="cluster", workers=list(worker_pool),
+        num_samples=4, epochs=4, seed=7, trace=True,
+    )
+    control = crashsim.control_run(str(tmp_path), "cctrl", **spec)
+    out = crashsim.killed_then_resumed(
+        str(tmp_path), "ccrash", kill_at=6, **spec
+    )
+    result = out["result"]
+    assert result["best_trial"] == control["best_trial"]
+    assert result["best_score"] == pytest.approx(control["best_score"])
+    assert result["num_terminated"] == control["num_terminated"]
+    _assert_no_duplicate_epochs(result)
+
+    status = out["journal"]
+    assert status["committed"] is True
+    assert status["head_starts"] == 2
+    assert status["replays"] == 1
+
+    root = str(tmp_path / "ccrash")
+    ids = _trace_ids(root)
+    assert len(ids) == 2 and len(set(ids)) == 1, ids
+
+    # the worker-side fencing family flows into the head's cluster
+    # aggregation: incarnation watermark reached 2 on the workers
+    with open(os.path.join(root, "experiment_state.json")) as f:
+        state = json.load(f)
+    cluster_counters = (state.get("obs") or {}).get("cluster") or {}
+    fence_keys = [
+        k for k in cluster_counters if k.startswith("head_fencing/")
+    ]
+    assert fence_keys, sorted(cluster_counters)[:20]
