@@ -914,6 +914,13 @@ def _serve(rest) -> None:
                    help="comma-separated per-row input shape (e.g. "
                         "'50,10' for seq x features) to pre-compile every "
                         "batch bucket before accepting traffic")
+    p.add_argument("--gang", type=int, default=None,
+                   help="pod-scale serving: each replica is a gang of N "
+                        "member processes over a TP-spanning mesh "
+                        "(serve/gang.py); the bundle is resharded onto "
+                        "the gang's serving mesh at load")
+    p.add_argument("--gang-devices", type=int, default=1,
+                   help="local devices per gang member (with --gang)")
     args = p.parse_args(rest)
 
     import numpy as np
@@ -939,6 +946,29 @@ def _serve(rest) -> None:
             slo_p99_ms=args.slo_p99_ms,
             interval_s=args.autoscale_interval_s,
         )
+    replica_factory = None
+    if args.gang:
+        from distributed_machine_learning_tpu.serve import (
+            make_gang_replica_factory,
+        )
+
+        replica_factory = make_gang_replica_factory(
+            processes=args.gang, local_devices=args.gang_devices,
+        )
+        # Source -> target topology at startup: the manifest records the
+        # TRAINING topology (mesh shape, process count, rule fingerprint),
+        # so the operator sees reshard-vs-direct before the first request.
+        print(json.dumps({
+            "gang_serving": {
+                "source_topology": bundle.source_topology,
+                "target_topology": {
+                    "process_count": args.gang,
+                    "local_device_counts": (
+                        [args.gang_devices] * args.gang
+                    ),
+                },
+            },
+        }), flush=True)
     server = PredictionServer(
         bundle,
         host=args.host,
@@ -953,6 +983,7 @@ def _serve(rest) -> None:
         shed_watermark=args.shed_watermark,
         autoscale=autoscale,
         tb_logdir=args.tb_logdir,
+        replica_factory=replica_factory,
     )
     if args.warmup_shape:
         dims = tuple(
@@ -969,6 +1000,7 @@ def _serve(rest) -> None:
         "precision": bundle.precision,
         "quality_delta_mape": bundle.quality_delta_mape,
         "replicas": args.replicas,
+        "gang": args.gang,
         "batcher": args.batcher,
         "autoscale": (
             {"min": lo, "max": hi} if autoscale is not None else None

@@ -984,6 +984,8 @@ SERVE_REQUEST_PATH_PATTERNS = (
     "serve/metrics.py",
     "serve/autoscale.py",
     "serve/swap.py",
+    "serve/gang.py",
+    "serve/_gang_member.py",
 )
 
 _QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue"}
@@ -2796,6 +2798,91 @@ class NonAtomicStateWriteRule(Rule):
             )
 
 
+# --------------------------------------------------------------------------
+# DML021 local-device-serving-path
+# --------------------------------------------------------------------------
+
+# Device-enumeration callee tails that size the PROCESS-LOCAL world.  A
+# serving module that consults any of these computes a different mesh,
+# bucket grid, or program key on every member of a gang that spans
+# processes — the exact divergence the gang serving path exists to
+# prevent (every member must trace the identical program or the
+# collective wedges).
+_LOCAL_SIZING_TAILS = {
+    "local_device_count", "device_count", "local_devices",
+}
+
+
+class LocalDeviceServingPathRule(Rule):
+    name = "local-device-serving-path"
+    rule_id = "DML021"
+    severity = "error"
+    description = (
+        "serve-request-path code sizing meshes or buckets from process-"
+        "local device enumeration: jax.local_device_count()/"
+        "jax.device_count()/jax.local_devices(), len(jax.devices()), or "
+        "jax.devices() fed into a mesh/array constructor.  On one process "
+        "every such count agrees; the moment a serving gang spans two, "
+        "each member derives a DIFFERENT topology, traces a different "
+        "program, and the first collective wedges the whole gang.  "
+        "Serving topology is decided once at bootstrap "
+        "(multihost.runtime.serving_mesh) and handed down; request-path "
+        "code must only consume the mesh it was given.  A bare "
+        "`jax.devices()[0]` default-device fallback is fine — it picks a "
+        "device, it does not size anything."
+    )
+    _HINT = (
+        "take the mesh from the caller (runtime.serving_mesh() at "
+        "bootstrap) and size from mesh.devices / "
+        "parallel.partition.mesh_axis_sizes(mesh), or from the bundle "
+        "manifest's recorded topology — never from per-process device "
+        "enumeration on the request path"
+    )
+
+    def applies(self, ctx) -> bool:
+        if "serve-request-path" in ctx.scopes:
+            return True
+        rel = ctx.display_path.replace("\\", "/")
+        return any(pat in rel for pat in SERVE_REQUEST_PATH_PATTERNS)
+
+    @staticmethod
+    def _is_devices_call(node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and (_call_name(node) or "").rsplit(".", 1)[-1] == "devices"
+        )
+
+    def check(self, ctx) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _call_name(node) or ""
+            tail = callee.rsplit(".", 1)[-1]
+            if tail in _LOCAL_SIZING_TAILS:
+                yield self.finding(
+                    ctx, node,
+                    f"`{callee}()` on the serve request path — a per-"
+                    f"process count that diverges across gang members",
+                    self._HINT,
+                )
+                continue
+            # jax.devices() used as an argument of another call is a
+            # sizing use (len(jax.devices()), Mesh(np.array(jax.devices()),
+            # ...)); a subscripted jax.devices()[0] fallback is not.
+            for arg in list(node.args) + [
+                kw.value for kw in node.keywords
+            ]:
+                if self._is_devices_call(arg):
+                    yield self.finding(
+                        ctx, arg,
+                        "jax.devices() fed into a constructor on the "
+                        "serve request path — request-path code must "
+                        "consume the mesh it was handed, not enumerate "
+                        "devices itself",
+                        self._HINT,
+                    )
+
+
 ALL_RULES: List[Rule] = [
     DonationAliasRule(),
     UnlockedDispatchRule(),
@@ -2817,6 +2904,7 @@ ALL_RULES: List[Rule] = [
     ImplicitUpcastInQuantizedPathRule(),
     UnguardedPromotionRule(),
     NonAtomicStateWriteRule(),
+    LocalDeviceServingPathRule(),
 ]
 
 

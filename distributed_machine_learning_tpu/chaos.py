@@ -144,6 +144,23 @@ class FaultPlan:
       index (1-based: ``(10, 0)`` kills replica 0 at the 10th request).
       ``replica_idx=-1`` kills whichever replica is serving that request —
       the deterministic way to fail an in-flight request.
+    * ``kill_gang_member_at_request`` — ``(request_index, process_id)``
+      pairs; a SERVING gang member (``serve/_gang_member.py``) whose gang
+      process id matches hard-exits (``os._exit``) at the start of its
+      N-th predict round (1-based in the gang's own dispatch stream) —
+      the member-dies-mid-traffic fault the gang teardown/rebuild path
+      exists for: its peers wedge in the round's collective, the parent
+      reaps the whole gang, the in-flight request redispatches to a
+      surviving gang (zero drops), and the monitor rebuilds.  Fires on
+      the gang's FIRST incarnation only (same guard as
+      ``kill_process_at``: rebuilt members re-activate the plan from the
+      spawn env and must pass the same request index unharmed).
+    * ``gang_bootstrap_hang`` — ``(process_id, seconds)`` pairs; a serving
+      gang member sleeps that long BEFORE joining jax.distributed (fires
+      once per entry, first incarnation only) — the straggler-member
+      bootstrap fault: its peers' join barrier expires, dumping a flight
+      recording that NAMES the absent process id before
+      ``BarrierTimeout`` raises.
     * ``hot_swaps`` — request indices; when the ReplicaSet's dispatch
       counter reaches each one it fires ``on_swap_signal`` (the soak
       harness registers a callback that performs the zero-downtime
@@ -240,6 +257,8 @@ class FaultPlan:
         trial_crashes: Iterable[Tuple[str, int]] = (),
         kill_process_at: Iterable[Tuple[str, int, int]] = (),
         replica_kills: Iterable[Tuple[int, int]] = (),
+        kill_gang_member_at_request: Iterable[Tuple[int, int]] = (),
+        gang_bootstrap_hang: Iterable[Tuple[int, float]] = (),
         hot_swaps: Iterable[int] = (),
         mid_swap_crash: Iterable[int] = (),
         corrupt_bundle_on_export: int = 0,
@@ -273,6 +292,12 @@ class FaultPlan:
         self._kills = sorted(
             ((int(n), int(r)) for n, r in replica_kills), reverse=True
         )
+        self._gang_member_kills = {
+            (int(n), int(p)) for n, p in kill_gang_member_at_request
+        }
+        self._gang_bootstrap_hangs = {
+            int(p): float(s) for p, s in gang_bootstrap_hang
+        }
         self._hot_swaps = sorted((int(n) for n in hot_swaps), reverse=True)
         self._mid_swap_crashes = sorted(
             (int(n) for n in mid_swap_crash), reverse=True
@@ -563,6 +588,54 @@ class FaultPlan:
                 )
                 return idx
         return None
+
+    def maybe_kill_gang_member(
+        self, request_n: int, process_id: int, incarnation: int = 1,
+    ) -> None:
+        """Hard-exit THIS serving gang member if ``(request_n,
+        process_id)`` is scheduled — called by ``serve/_gang_member.py``
+        at the start of every predict round (``request_n`` 1-based in the
+        gang's own dispatch stream), BEFORE the round's collective, so the
+        surviving peers wedge exactly where a preempted host would leave
+        them.  ``os._exit`` (no unwinding).  First incarnation only: the
+        rebuilt gang's members re-activate the plan from the spawn env and
+        must serve the same request index unharmed (the
+        ``maybe_kill_process`` guard).  The counter is best-effort
+        forensics for same-process observers; cross-process assertions
+        read the parent's gang teardown/rebuild counters."""
+        if int(incarnation) > 1:
+            return
+        key = (int(request_n), int(process_id))
+        with self._lock:
+            if key not in self._gang_member_kills:
+                return
+            self._gang_member_kills.discard(key)
+            self._counters["gang_member_kills"] = (
+                self._counters.get("gang_member_kills", 0) + 1
+            )
+        import os
+
+        os._exit(86)
+
+    def maybe_gang_bootstrap_hang(
+        self, process_id: int, incarnation: int = 1,
+    ) -> None:
+        """Sleep the scheduled duration if ``process_id`` has a pending
+        ``gang_bootstrap_hang`` entry — called by ``serve/_gang_member.py``
+        BEFORE ``join_gang``, so the member's peers sit at the all-joined
+        barrier until its deadline expires and the flight dump names THIS
+        process id absent.  Fires once per entry, first incarnation only
+        (the rebuilt member must bootstrap clean)."""
+        if int(incarnation) > 1:
+            return
+        with self._lock:
+            seconds = self._gang_bootstrap_hangs.pop(int(process_id), None)
+            if seconds is None:
+                return
+            self._counters["gang_bootstrap_hangs"] = (
+                self._counters.get("gang_bootstrap_hangs", 0) + 1
+            )
+        time.sleep(seconds)
 
     def poll_hot_swap(self) -> bool:
         """True when a scheduled mid-soak bundle swap comes due.  Reads the

@@ -44,6 +44,11 @@ from distributed_machine_learning_tpu.ckpt.metrics import (  # noqa: F401
     get_metrics,
     note_step,
 )
+from distributed_machine_learning_tpu.ckpt.reshard import (  # noqa: F401
+    place_tree,
+    reshard_onto_mesh,
+    serving_shardings,
+)
 from distributed_machine_learning_tpu.ckpt.writer import (  # noqa: F401
     AsyncCheckpointer,
 )

@@ -350,10 +350,20 @@ def write_snapshot(path: str, skeleton, leaves: List[Any]) -> Tuple[int, int]:
     except Exception:  # pragma: no cover - pre-init
         process_index = 0
     if process_index == 0:
+        try:
+            import jax as _jax
+
+            nproc = _jax.process_count()
+        except Exception:  # pragma: no cover - pre-init
+            nproc = 1
         index = {
             "format_version": FORMAT_VERSION,
             "tree": skeleton,
             "leaves": index_leaves,
+            # Saving-side process layout: consumers (serve/export.py's
+            # manifest topology block) can name the training topology
+            # without probing chunk files.
+            "process_count": nproc,
         }
         index_bytes = json.dumps(index, sort_keys=True).encode()
         backend.write_bytes(backend.join(p, INDEX_NAME), index_bytes)

@@ -1,0 +1,73 @@
+"""Topology-portable placement: a host pytree onto ANY target sharding.
+
+``ckpt/format.py`` proved the restore half of topology portability — a
+generation saved on one mesh restores onto another via
+``jax.make_array_from_callback``, reading only intersecting chunks.  This
+module is the same mechanism for trees that are ALREADY on the host:
+a servable bundle's msgpack params (``serve/export.py`` always gathers to
+full host arrays so the bundle needs no mesh to load), which a serving
+gang must lay back out over its own process-spanning mesh.  One placement
+path serves both directions:
+
+* train on 2x4, export, serve on a 2-process gang — the bundle's host
+  arrays shard out over the serving mesh;
+* train on one device, export, serve sharded — same call, the serving
+  topology alone decides the layout.
+
+Each process's callback slices exactly the shards its devices address, so
+no member ever materializes a peer's slice on device — the
+``stage_global`` contract applied leaf-wise to a params tree.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+def place_tree(tree: Any, shardings: Any) -> Any:
+    """Place a host pytree onto a pytree of shardings (same structure;
+    ``None`` entries stay host-side).  Array leaves become ``jax.Array``s
+    laid out for the target mesh via ``jax.make_array_from_callback``;
+    non-array leaves pass through untouched.
+
+    Must be called by EVERY process of the target mesh (array creation
+    over a process-spanning sharding is collective in effect: each
+    process builds its addressable shards of the same global value).
+    """
+    import jax
+
+    def place(leaf, sharding):
+        if sharding is None or not hasattr(leaf, "shape"):
+            return leaf
+        arr = np.asarray(leaf)
+        if jax.process_count() == 1:
+            return jax.device_put(arr, sharding)
+        return jax.make_array_from_callback(
+            tuple(arr.shape), sharding, lambda idx, a=arr: a[idx]
+        )
+
+    return jax.tree_util.tree_map(place, tree, shardings)
+
+
+def serving_shardings(config: Any, variables: Any, mesh) -> Any:
+    """The target layout for a bundle's variables on a serving mesh:
+    the model family's partition-rule table (``models/partition_rules``)
+    resolved against the actual leaves — the same table training sharded
+    under, so a served forward pass runs the layout it was trained with.
+    """
+    from distributed_machine_learning_tpu.models.partition_rules import (
+        rules_for,
+    )
+    from distributed_machine_learning_tpu.parallel.partition import (
+        shardings_from_rules,
+    )
+
+    return shardings_from_rules(variables, mesh, rules_for(config))
+
+
+def reshard_onto_mesh(config: Any, variables: Any, mesh) -> Any:
+    """``place_tree`` + ``serving_shardings`` in one call — the bundle
+    loader's resharding route (``serve/export.load_bundle(mesh=...)``)."""
+    return place_tree(variables, serving_shardings(config, variables, mesh))

@@ -205,6 +205,23 @@ def spanning_mesh(mesh_shape: Dict[str, int]) -> Mesh:
     return Mesh(arr, tuple(sizes.keys()))
 
 
+def serving_mesh(axis: str = "tp") -> Mesh:
+    """A single-axis mesh over EVERY process's devices — the pod-slice
+    serving layout (``serve/gang.py``).
+
+    Training meshes keep tp/sp/ep inside one host (``multihost_mesh``
+    raises otherwise: per-layer collectives belong on ICI).  Serving is
+    the case where that rule deliberately bends — a model sharded to fit
+    training on a multi-process mesh cannot be served at all unless its
+    tensor axis is allowed to span processes, and inference traffic is a
+    forward pass per request, not per-step gradient exchange.  Device
+    order is canonical (process index, then device id), so every member
+    of a gang builds the IDENTICAL mesh and the compiled programs agree.
+    """
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    return Mesh(np.array(devs), (str(axis),))
+
+
 def global_batch_array(
     host_local: np.ndarray, mesh: Mesh, spec: P = P("dp")
 ) -> jax.Array:

@@ -86,7 +86,14 @@ def member_child_env(
 
 
 class GangChildHandle:
-    """One spawned gang member and its frame pipes."""
+    """One spawned gang member and its frame pipes.
+
+    ``module`` selects the child entrypoint: the default trains one gang
+    trial (``multihost/_gang_child.py``); the serving plane spawns its
+    members with ``serve/_gang_member.py`` — same spec env, same frame
+    pipes, same SIGKILL teardown."""
+
+    DEFAULT_MODULE = "distributed_machine_learning_tpu.multihost._gang_child"
 
     def __init__(
         self,
@@ -95,11 +102,11 @@ class GangChildHandle:
         devices: Optional[List] = None,
         platform: Optional[str] = None,
         env: Optional[Dict[str, str]] = None,
+        module: Optional[str] = None,
     ):
         self.spec = spec
         self.proc = subprocess.Popen(
-            [sys.executable, "-m",
-             "distributed_machine_learning_tpu.multihost._gang_child"],
+            [sys.executable, "-m", module or self.DEFAULT_MODULE],
             env=env if env is not None else member_child_env(
                 spec, devices, platform
             ),

@@ -20,9 +20,12 @@ Layering: ``export`` freezes the best trial into a self-describing bundle;
 coalesces concurrent requests — continuous (inflight, depth-adaptive,
 bounded-queue) by default, micro (size-or-latency) on request;
 ``replica`` scales engines across leased devices with failover and
-elastic add/remove; ``autoscale`` drives the replica count from windowed
-p99 + queue depth; ``swap`` hot-swaps a new bundle with zero dropped
-requests and zero serving-path compiles; ``server`` is the stdlib HTTP
+elastic add/remove; ``gang`` generalizes one replica to N member
+processes over a TP-spanning mesh (pod-scale serving — models too big
+for any single process); ``autoscale`` drives the replica count from
+windowed p99 + queue depth; ``swap`` hot-swaps a new bundle with zero
+dropped requests and zero serving-path compiles; ``server`` is the
+stdlib HTTP
 front end (429 load shedding, ``/admin/swap``); ``metrics`` the
 ring-buffer-windowed latency/throughput accounting behind ``/metrics``.
 """
@@ -47,6 +50,12 @@ from distributed_machine_learning_tpu.serve.export import (
     ServableBundle,
     export_bundle,
     load_bundle,
+)
+from distributed_machine_learning_tpu.serve.gang import (
+    GangDead,
+    GangReplica,
+    gang_counters,
+    make_gang_replica_factory,
 )
 from distributed_machine_learning_tpu.serve.metrics import (
     LatencyWindow,
@@ -76,6 +85,8 @@ __all__ = [
     "BatcherStopped",
     "CircuitBreaker",
     "ContinuousBatcher",
+    "GangDead",
+    "GangReplica",
     "InferenceEngine",
     "LatencyWindow",
     "MicroBatcher",
@@ -90,8 +101,10 @@ __all__ = [
     "ServeMetrics",
     "bucket_sizes",
     "export_bundle",
+    "gang_counters",
     "hot_swap",
     "load_bundle",
+    "make_gang_replica_factory",
     "replica_process_env",
     "rollback",
     "warm_swap_bundle",

@@ -27,6 +27,7 @@ from distributed_machine_learning_tpu.analysis.locks import named_lock
 from distributed_machine_learning_tpu.compilecache import (
     ExecutableCache,
     enable_persistent_cache,
+    gang_program_key,
     get_tracker,
     program_key,
 )
@@ -64,6 +65,7 @@ class InferenceEngine:
         device=None,
         persistent_cache: bool = True,
         aot_cache: bool = True,
+        mesh=None,
     ):
         if persistent_cache:
             # Same on-disk XLA cache as tune: a server restart (or a second
@@ -79,6 +81,12 @@ class InferenceEngine:
         # clobber) a compiled program.
         self._precision = getattr(bundle, "precision", "f32")
         self._device = device
+        # Mesh mode (serve/gang.py): programs lower over a named —
+        # possibly process-spanning — mesh with replicated outputs, keyed
+        # by gang_program_key so process topology, mesh shape, and rule
+        # fingerprint all split program identity.  The bundle's variables
+        # must already be placed on the mesh (load_bundle(mesh=...)).
+        self._mesh = mesh
         self._buckets = tuple(sorted(set(buckets or bucket_sizes(max_bucket))))
         self._flag_name: Optional[str] = None
         self._lock = named_lock("serve.engine")
@@ -90,9 +98,16 @@ class InferenceEngine:
         # shape, dtype, device) — a breaker-triggered replica restart or a
         # second serving process DESERIALIZES the finished executable
         # instead of re-tracing and re-compiling (the persistent XLA cache
-        # only spares the backend stage; this spares all three).
-        self._aot = ExecutableCache() if (aot_cache and persistent_cache) \
-            else None
+        # only spares the backend stage; this spares all three).  On a
+        # process-spanning mesh executable serialization is NOT portable
+        # (the payload bakes in a device assignment only this exact gang
+        # incarnation has), so gang members skip the AOT tier and lean on
+        # the persistent XLA cache — same zero-backend-compile outcome,
+        # honest trace/lower cost (the PR-14 gang-trial precedent).
+        multiproc = mesh is not None and jax.process_count() > 1
+        self._aot = ExecutableCache() if (
+            aot_cache and persistent_cache and not multiproc
+        ) else None
 
     # -- shape bucketing -----------------------------------------------------
 
@@ -172,7 +187,9 @@ class InferenceEngine:
                 self._program_hits += 1
                 return prog
         bucket, trailing, dtype = key
-        if self._aot is not None:
+        if self._mesh is not None:
+            prog = self._mesh_build(key, x)
+        elif self._aot is not None:
             pk = program_key(
                 self.bundle.config,
                 batch_shape=[(bucket, *trailing)],
@@ -202,6 +219,57 @@ class InferenceEngine:
             # Keep the first resolution if two requests raced the build.
             prog = self._programs.setdefault(key, prog)
         return prog
+
+    def _mesh_build(self, key: Tuple, x):
+        """Build (or AOT-resolve, single-process only) one bucket program
+        lowered over the serving mesh.
+
+        The program's identity is :func:`gang_program_key` — process
+        topology, padded bucket shape, dtype, storage precision, mesh
+        shape, and partition-rule fingerprint all fold in, so every
+        member of a gang (and every future gang of the same topology)
+        computes the identical key while any reshape splits it.  Inputs
+        arrive replicated (``stage_global`` in ``_run_bucket``), params
+        arrive laid out by ``load_bundle(mesh=...)``; in_shardings are
+        inferred from those committed arrays and outputs are pinned
+        replicated so the coordinator can read one addressable shard back.
+        """
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from distributed_machine_learning_tpu.models.partition_rules import (
+            rules_fingerprint_for,
+        )
+        from distributed_machine_learning_tpu.multihost import (
+            runtime as _runtime,
+        )
+        from distributed_machine_learning_tpu.parallel.partition import (
+            mesh_axis_sizes,
+        )
+
+        bucket, trailing, dtype = key
+        topology = _runtime.process_topology()
+        pk = gang_program_key(
+            self.bundle.config,
+            process_count=topology["process_count"],
+            local_device_counts=topology["local_device_counts"],
+            batch_shape=[(bucket, *trailing)],
+            dtype=dtype,
+            extra={
+                "serve": 1,
+                "precision": self._precision,
+                "mesh_shape": mesh_axis_sizes(self._mesh),
+                "rules_fp": rules_fingerprint_for(self.bundle.config),
+            },
+        )
+        jit_kwargs = {
+            "out_shardings": NamedSharding(self._mesh, PartitionSpec())
+        }
+        if self._aot is not None:
+            return self._aot.get_or_compile(
+                pk, self._apply_fn(), self._variables, x,
+                jit_kwargs=jit_kwargs,
+            )
+        return jax.jit(self._apply_fn(), **jit_kwargs)
 
     def program_stats(self) -> Dict[str, Any]:
         """Compile counters for /metrics and the zero-recompile check."""
@@ -234,6 +302,8 @@ class InferenceEngine:
             pad = np.zeros((bucket - n, *x.shape[1:]), dtype=x.dtype)
             x = np.concatenate([x, pad], axis=0)
         key = (bucket, x.shape[1:], str(x.dtype))
+        if self._mesh is not None:
+            return self._run_bucket_mesh(key, x)[:n]
         with obs.span("engine.step", {"bucket": bucket}), dispatch_lock():
             ctx = (
                 jax.default_device(self._device)
@@ -248,6 +318,33 @@ class InferenceEngine:
                 out = prog(self._variables, x)
             out = np.asarray(out)  # readback inside the hold (sync point)
         return out[:n]
+
+    def _run_bucket_mesh(self, key: Tuple, x: np.ndarray) -> np.ndarray:
+        """One padded chunk over the serving mesh.  Collective in effect:
+        every gang member must call this with the SAME padded batch (the
+        member loop broadcasts it), stage_global places each member's
+        addressable shards of the replicated input, and the program's
+        cross-process collectives do the rest.  Readback takes one
+        addressable shard — outputs are pinned replicated, so shard 0 IS
+        the full answer on every member."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from distributed_machine_learning_tpu.multihost import (
+            runtime as _runtime,
+        )
+
+        bucket = key[0]
+        with obs.span("engine.step", {"bucket": bucket}), dispatch_lock():
+            staged = _runtime.stage_global(
+                x, NamedSharding(self._mesh, PartitionSpec())
+            )
+            prog = self._program(key, staged)
+            out = prog(self._variables, staged)
+            # np.asarray rejects non-fully-addressable arrays; the
+            # replicated out_shardings guarantee any one local shard
+            # carries the whole value.
+            out = np.asarray(out.addressable_data(0))
+        return out
 
     def predict(self, x) -> np.ndarray:
         """Batched forward pass; axis 0 is the batch dimension.  Requests

@@ -73,6 +73,21 @@ class ServableBundle:
     def feature_names(self) -> List[str]:
         return list((self.manifest.get("features") or {}).get("names", []))
 
+    @property
+    def source_topology(self) -> Dict[str, Any]:
+        """The TRAINING topology this bundle was exported from —
+        ``{"mesh_shape": {axis: size}, "process_count": n,
+        "rules_fingerprint": "pr_..."}``.  Recorded by export so a loader
+        can decide reshard-vs-direct (and a server can log source→target
+        topology) without probing chunk files; pre-topology manifests
+        read as single-device/single-process."""
+        topo = (self.manifest.get("source") or {}).get("topology") or {}
+        return {
+            "mesh_shape": dict(topo.get("mesh_shape") or {}),
+            "process_count": int(topo.get("process_count", 1)),
+            "rules_fingerprint": topo.get("rules_fingerprint"),
+        }
+
     def build_model(self):
         from distributed_machine_learning_tpu.models import build_model
 
@@ -187,6 +202,11 @@ def export_bundle(
                 "sharded" if _is_sharded_source(ckpt_path) else "msgpack"
             ),
             "checkpoint_load_s": round(ckpt_load_s, 4),
+            # The TRAINING topology (mesh axis sizes, process count,
+            # partition-rule fingerprint): what lets load_bundle decide
+            # reshard-vs-direct — and ``dml-tpu serve`` log
+            # source→target — without probing chunk files.
+            "topology": _source_topology(ckpt_path, trial.config),
         },
     }
     if precision != "f32":
@@ -238,6 +258,44 @@ def write_bundle(
     return out_dir
 
 
+def _source_topology(
+    ckpt_path: Optional[str], config: Dict[str, Any]
+) -> Dict[str, Any]:
+    """The training topology of a checkpoint, read from metadata only.
+
+    Sharded generations carry the saving mesh's axis sizes in their leaf
+    partition records and the saving process count in the index
+    (``ckpt/format.py``); legacy msgpack checkpoints were written by a
+    gathered single host, so they read as 1-device/1-process.  The
+    partition-rule fingerprint comes from the config either way — it is
+    what a serving mesh would shard the SAME tree under.
+    """
+    from distributed_machine_learning_tpu.models.partition_rules import (
+        rules_fingerprint_for,
+    )
+
+    mesh_shape: Dict[str, int] = {}
+    process_count = 1
+    if ckpt_path and _is_sharded_source(ckpt_path):
+        from distributed_machine_learning_tpu.ckpt import format as _fmt
+
+        try:
+            index = _fmt.read_index(ckpt_path) or {}
+            process_count = int(index.get("process_count", 1))
+            specs = _fmt.saved_partition_specs(ckpt_path) or {}
+            mesh_shape = {
+                str(k): int(v)
+                for k, v in (specs.get("__mesh__") or {}).items()
+            }
+        except _fmt.CheckpointCorruptionError:
+            pass  # the params load above already vouched for the data
+    return {
+        "mesh_shape": mesh_shape,
+        "process_count": process_count,
+        "rules_fingerprint": rules_fingerprint_for(config),
+    }
+
+
 def _is_sharded_source(path: Optional[str]) -> bool:
     if not path:
         return False
@@ -264,8 +322,19 @@ def _read_state(root: str) -> Dict[str, Any]:
         return json.load(f)
 
 
-def load_bundle(bundle_dir: str) -> ServableBundle:
-    """Read a bundle directory back into a :class:`ServableBundle`."""
+def load_bundle(bundle_dir: str, mesh=None) -> ServableBundle:
+    """Read a bundle directory back into a :class:`ServableBundle`.
+
+    With ``mesh`` the params tree is RESHARDED onto it through the ckpt
+    placement path (``ckpt.reshard`` — the same per-shard-callback
+    mechanism the sharded restore uses), laid out by the model family's
+    partition rules: a bundle exported from ANY training topology serves
+    on ANY serving topology.  Must then be called by every process of the
+    mesh (gang members each place their own addressable shards).  The
+    manifest's recorded source topology says whether this is a reshape
+    (trained sharded) or a first sharding (trained on one device) —
+    either way the values are bit-identical to the exported tree.
+    """
     backend, d = get_storage(bundle_dir)
     raw = backend.read_bytes(backend.join(d, MANIFEST_NAME))
     if raw is None:
@@ -287,8 +356,15 @@ def load_bundle(bundle_dir: str) -> ServableBundle:
         raise FileNotFoundError(
             f"bundle at {bundle_dir!r} is missing {PARAMS_NAME}"
         )
+    config = dict(manifest.get("config", {}))
+    if mesh is not None:
+        from distributed_machine_learning_tpu.ckpt.reshard import (
+            reshard_onto_mesh,
+        )
+
+        variables = reshard_onto_mesh(config, variables, mesh)
     return ServableBundle(
-        config=dict(manifest.get("config", {})),
+        config=config,
         variables=variables,
         manifest=manifest,
         path=bundle_dir,

@@ -1,0 +1,484 @@
+"""Pod-scale serving: one replica = a gang of TP-sharded member processes.
+
+A model sharded to fit training on a multi-process mesh cannot be served
+by any single process — no one process addresses all its devices.  This
+module makes a whole GANG of processes duck-type as one
+:class:`~distributed_machine_learning_tpu.serve.replica.Replica`, so the
+entire serving plane — round-robin dispatch, circuit breakers, admission
+control, monitor restart, autoscale, hot swap — generalizes from "replica
+= thread on a device" to "replica = N processes over a spanning mesh"
+without changing a line of it:
+
+* **Bootstrap** reuses the training gangs' machinery: a
+  :class:`~...multihost.bootstrap.GangSpec` per member, fresh subprocesses
+  (``jax.distributed.initialize`` must precede backend init), the
+  all-joined deadline barrier whose expiry dumps a flight recording
+  naming the absent member.
+* **Dispatch** is coordinator-only: the parent pipes each batch to member
+  0, which broadcasts it in-band (``runtime.broadcast_from_coordinator``)
+  and answers with the replicated output — peers never touch the HTTP
+  plane.
+* **Failure** is all-or-nothing: any member death tears the WHOLE gang
+  down (SIGKILL — survivors are wedged in a collective) and stops the
+  batcher without drain, so queued AND in-flight requests fail with
+  ``BatcherStopped`` and ``ReplicaSet.predict`` redispatches them to a
+  surviving gang — zero drops.  The monitor then rebuilds the slot
+  through the factory, exactly like a thread-replica restart.
+* **Swap** needs no new mechanism: ``serve/swap.py`` builds the
+  replacement through the factory, which spawns a FRESH gang that loads
+  and warms the new bundle on every member off-path, then switches the
+  slot atomically and retires the old gang.
+
+Scale-up/down via ``ReplicaSet.add_replica``/``remove_replica`` adds and
+removes whole gangs (the factory is the unit of construction;
+:meth:`GangReplica.retire` is the unit of teardown).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from distributed_machine_learning_tpu import obs
+from distributed_machine_learning_tpu.analysis.locks import named_lock
+from distributed_machine_learning_tpu.multihost.bootstrap import (
+    GangSpec,
+    allocate_coordinator_port,
+)
+from distributed_machine_learning_tpu.multihost.spawn import (
+    GangChildHandle,
+    member_child_env,
+)
+from distributed_machine_learning_tpu.serve.batcher import (
+    BatcherStopped,
+    ContinuousBatcher,
+    MicroBatcher,
+)
+from distributed_machine_learning_tpu.serve.export import ServableBundle
+from distributed_machine_learning_tpu.tune._process_child import write_frame
+
+MEMBER_MODULE = "distributed_machine_learning_tpu.serve._gang_member"
+
+# How often the watcher polls member liveness.  A dead member leaves its
+# peers wedged in a collective, so this is the detection latency bound on
+# the teardown -> redispatch -> rebuild path.
+WATCH_INTERVAL_S = 0.1
+
+
+class GangDead(BatcherStopped):
+    """The gang lost a member mid-request.  Subclasses
+    :class:`BatcherStopped` deliberately: the batcher fails the in-flight
+    batch with whatever the infer fn raised, and ``ReplicaSet.predict``
+    redispatches ``BatcherStopped`` to a surviving replica — so a member
+    death mid-traffic costs a retry, never a dropped request."""
+
+
+class _GangEngineProxy:
+    """The slice of the engine surface the serving plane reads/drives,
+    answered from the coordinator's frames: ``ReplicaSet.warmup`` and
+    ``hot_swap`` call :meth:`warmup`; ``program_stats`` aggregation and
+    the zero-recompile ledger read the cached per-round stats (every
+    result frame refreshes them, so ``new_programs_since_warmup`` tracks
+    the member truthfully without an extra round-trip)."""
+
+    def __init__(self, gang: "GangReplica"):
+        self._gang = gang
+
+    @property
+    def num_programs(self) -> int:
+        return int(self._gang.last_stats.get("programs", 0))
+
+    def program_stats(self) -> Dict[str, Any]:
+        return dict(self._gang.last_stats)
+
+    def warmup(self, sample) -> Dict[str, Any]:
+        return self._gang.warmup(sample)
+
+
+class GangReplica:
+    """N member processes over a spanning mesh, behind one Replica face.
+
+    ``device`` is the slot's leased device from the set's DeviceManager —
+    recorded for health reporting, but placement inside the gang is the
+    members' serving mesh, not the parent's device list.  Constructed via
+    :func:`make_gang_replica_factory` so every construction site
+    (init, monitor restart, autoscale, hot swap) builds gangs.
+    """
+
+    def __init__(
+        self,
+        idx: int,
+        bundle: ServableBundle,
+        device=None,
+        processes: int = 2,
+        local_devices: int = 1,
+        platform: Optional[str] = None,
+        join_deadline_s: Optional[float] = None,
+        incarnation: int = 1,
+        max_batch_size: int = 64,
+        max_latency_ms: float = 5.0,
+        max_bucket: int = 256,
+        batcher: str = "continuous",
+        max_queue: int = 1024,
+        target_step_ms: Optional[float] = None,
+    ):
+        if bundle.path is None:
+            raise ValueError(
+                "gang serving needs an on-disk bundle (every member loads "
+                "its shards from bundle.path); export it first"
+            )
+        self.idx = idx
+        self.device = device
+        self.processes = int(processes)
+        self.local_devices = int(local_devices)
+        self.incarnation = int(incarnation)
+        self.gang_id = f"serve{idx}-{os.urandom(4).hex()}"
+        self.processed_batches = 0
+        self.last_beat = time.monotonic()
+        self.last_stats: Dict[str, Any] = {}
+        self._max_bucket = int(max_bucket)
+        self._dead = False
+        # One request at a time over the coordinator pipe: the member loop
+        # is strictly round-based (the batcher serializes flushes anyway;
+        # this guards warmup racing a flush).  Teardown deliberately does
+        # NOT take it — a flush may be holding it blocked in coord.read(),
+        # and the teardown's SIGKILL is what unblocks that read — so the
+        # dead flag gets its own lock.
+        self._io_lock = named_lock("serve.gang.io")
+        self._state_lock = named_lock("serve.gang.state")
+        self.engine = _GangEngineProxy(self)
+        self.members: List[GangChildHandle] = self._spawn(
+            bundle, platform, join_deadline_s
+        )
+        self._watcher = threading.Thread(
+            target=self._watch_loop,
+            name=f"gang-watch-{idx}",
+            daemon=True,
+        )
+        self._watcher.start()
+        if batcher == "continuous":
+            self.batcher = ContinuousBatcher(
+                self._infer,
+                max_batch_size=max_batch_size,
+                max_queue=max_queue,
+                target_step_ms=target_step_ms,
+                name=f"replica-{idx}",
+            )
+        elif batcher == "micro":
+            self.batcher = MicroBatcher(
+                self._infer,
+                max_batch_size=max_batch_size,
+                max_latency_ms=max_latency_ms,
+                name=f"replica-{idx}",
+            )
+        else:
+            raise ValueError(
+                f"batcher must be 'continuous' or 'micro': {batcher!r}"
+            )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _spawn(self, bundle, platform, join_deadline_s):
+        port = allocate_coordinator_port()
+        coordinator = f"127.0.0.1:{port}"
+        init_msg = {
+            "bundle_dir": bundle.path,
+            "max_bucket": self._max_bucket,
+            "incarnation": self.incarnation,
+            "obs": obs.trace_context_frame(),
+        }
+        members = []
+        for pid in range(self.processes):
+            spec = GangSpec(
+                gang_id=self.gang_id,
+                coordinator_address=coordinator,
+                num_processes=self.processes,
+                process_id=pid,
+                local_device_count=self.local_devices,
+            )
+            if join_deadline_s is not None:
+                spec.join_deadline_s = float(join_deadline_s)
+            members.append(GangChildHandle(
+                spec,
+                init_msg,
+                platform=platform,
+                env=member_child_env(spec, platform=platform),
+                module=MEMBER_MODULE,
+            ))
+        # Bootstrap gate: every member joined (barrier passed) and loaded
+        # its shards of the bundle.  A straggler surfaces HERE as the
+        # peers' BarrierTimeout error frames naming the absent ids — the
+        # construction site (init / monitor / swap) owns retry policy.
+        try:
+            for m in members:
+                self._expect(m, "joined")
+            for m in members:
+                stats = self._expect(m, "ready")
+                if m.spec.process_id == 0:
+                    self.last_stats = stats
+        except Exception:
+            for m in members:
+                m.kill()
+            raise
+        gang_counters().add("spawns")
+        obs.event("serve_gang_up", {
+            "gang_id": self.gang_id,
+            "replica": self.idx,
+            "processes": self.processes,
+            "incarnation": self.incarnation,
+        })
+        return members
+
+    @staticmethod
+    def _expect(member: GangChildHandle, kind: str):
+        try:
+            frame = member.read()
+        except EOFError:
+            raise RuntimeError(
+                f"gang member {member.spec.process_id} died during "
+                f"bootstrap (exit {member.returncode})"
+            ) from None
+        if frame[0] == "error":
+            raise RuntimeError(
+                f"gang member {member.spec.process_id} failed bootstrap:\n"
+                f"{frame[1]}"
+            )
+        if frame[0] != kind:
+            raise RuntimeError(
+                f"gang member {member.spec.process_id}: expected "
+                f"{kind!r} frame, got {frame[0]!r}"
+            )
+        return frame[1] if len(frame) > 1 else None
+
+    def _watch_loop(self) -> None:
+        """Member liveness: ANY member exit tears the whole gang down.
+        Survivors of a peer death are wedged in a collective — there is
+        no partial-gang serving state — so detection maps straight to
+        teardown + batcher stop, and the in-flight/queued requests all
+        fail as ``BatcherStopped`` for the set to redispatch."""
+        # dmlint: disable=unguarded-shared-state deliberate lock-free poll: _dead is a monotonic bool flip and a stale read only costs one extra 0.1s watch tick before the loop notices teardown
+        while not self._dead:
+            time.sleep(WATCH_INTERVAL_S)
+            # dmlint: disable=unguarded-shared-state deliberate lock-free poll: same monotonic flag — worst case one redundant returncode scan after teardown already ran
+            if self._dead:
+                return
+            if any(m.returncode is not None for m in self.members):
+                # Forensics (member_deaths / chaos_member_kills counters,
+                # the death event) live in _teardown, which either
+                # detection path — this poll or a failed coordinator
+                # round — reaches exactly once.
+                self._teardown("member_death")
+                return
+
+    def _teardown(self, reason: str) -> None:
+        with self._state_lock:
+            if self._dead:
+                return
+            self._dead = True
+        # SIGKILL outside the IO lock: a batcher flush blocked in
+        # coord.read() is HOLDING that lock, and the kill (EOF on the
+        # pipe) is what unblocks it.
+        for m in self.members:
+            m.kill()
+        # Death forensics AFTER the reap, classified by exit code — a
+        # member that was already gone keeps its own code (our SIGKILL on
+        # a dead pid is a no-op), members we just killed show -SIGKILL.
+        # Reaping first dodges the race where teardown arrives off a
+        # failed coordinator round before the OS has the exit visible.
+        # Exit 86 is chaos.maybe_kill_gang_member's signature, counted
+        # separately so /metrics tells a chaos drill apart from a real
+        # member crash.
+        codes = [(m, m.wait(timeout=5.0)) for m in self.members]
+        died = [
+            (m, rc) for m, rc in codes
+            if rc is not None and rc != -signal.SIGKILL
+        ]
+        if died:
+            gang_counters().add("member_deaths", len(died))
+            chaos_kills = sum(1 for _, rc in died if rc == 86)
+            if chaos_kills:
+                gang_counters().add("chaos_member_kills", chaos_kills)
+            obs.event("serve_gang_member_death", {
+                "gang_id": self.gang_id,
+                "replica": self.idx,
+                "process_ids": [m.spec.process_id for m, _ in died],
+                "exit_codes": [rc for _, rc in died],
+            })
+        gang_counters().add("teardowns")
+        obs.event("serve_gang_teardown", {
+            "gang_id": self.gang_id,
+            "replica": self.idx,
+            "reason": reason,
+        })
+        # Fail queued requests fast (BatcherStopped -> redispatch); the
+        # batcher attribute exists except during __init__ bootstrap
+        # failures, where there is nothing queued yet.
+        batcher = getattr(self, "batcher", None)
+        if batcher is None:
+            return
+        if threading.current_thread() is getattr(batcher, "_thread", None):
+            # Teardown reached from the batcher's OWN worker (a flush
+            # detected the death): stop() joins the worker thread, which
+            # would be joining ourselves.  A helper does the stop; the
+            # worker unwinds as soon as this flush raises GangDead.
+            threading.Thread(
+                target=lambda: batcher.stop(drain=False, timeout=2.0),
+                name=f"gang-stop-{self.idx}",
+                daemon=True,
+            ).start()
+        else:
+            batcher.stop(drain=False, timeout=2.0)
+
+    # -- Replica duck type ---------------------------------------------------
+
+    def _roundtrip(self, op: str, payload) -> Any:
+        """One coordinator round: frame down, frame back.  Every failure
+        mode of the pipe — member gone, error frame, torn read — becomes
+        :class:`GangDead` AFTER tearing the gang down, so the caller
+        (batcher flush or warmup) sees one crisp signal and the set's
+        redispatch/monitor machinery owns what happens next."""
+        with self._io_lock:
+            with self._state_lock:
+                if self._dead:
+                    raise GangDead(f"gang {self.gang_id} is down")
+            coord = self.members[0]
+            try:
+                write_frame(coord.proc.stdin, (op, payload))
+                frame = coord.read()
+            except (EOFError, OSError, ValueError):
+                frame = None
+        if frame is None:
+            self._teardown("pipe_failure")
+            raise GangDead(
+                f"gang {self.gang_id} coordinator died mid-{op}"
+            )
+        if frame[0] == "error":
+            self._teardown("member_error")
+            raise GangDead(
+                f"gang {self.gang_id} failed {op}:\n{frame[1]}"
+            )
+        return frame
+
+    def _infer(self, x: np.ndarray) -> np.ndarray:
+        frame = self._roundtrip("predict", np.asarray(x))
+        _, out, stats = frame
+        self.last_stats = stats
+        self.processed_batches += 1
+        self.last_beat = time.monotonic()
+        return np.asarray(out)
+
+    def warmup(self, sample) -> Dict[str, Any]:
+        """Drive every member through the bucket grid off-path (header-only
+        broadcast rounds; members synthesize the batches)."""
+        frame = self._roundtrip("warmup", np.asarray(sample))
+        self.last_stats = frame[1]
+        return dict(self.last_stats)
+
+    def submit(self, x):
+        return self.batcher.submit(x)
+
+    def alive(self) -> bool:
+        # dmlint: disable=unguarded-shared-state deliberate lock-free read: alive() sits on the per-request dispatch path and a single bool load is atomic under the GIL — staleness only delays failover by one round-robin pass
+        return not self._dead and self.batcher.is_alive()
+
+    def kill(self):
+        """Hard-stop (failover tests / chaos): SIGKILL every member, fail
+        the queue fast.  Same observable contract as ``Replica.kill``."""
+        self._teardown("kill")
+
+    def retire(self):
+        """Graceful release after drain (hot swap, scale-down): the gang's
+        member processes are the resource a thread replica doesn't have."""
+        self._teardown("retire")
+
+    def health(self) -> Dict[str, Any]:
+        return {
+            "replica": self.idx,
+            "device": str(self.device),
+            "alive": self.alive(),
+            "queue_depth": self.batcher.queue_depth,
+            "processed_batches": self.processed_batches,
+            "last_beat_age_s": round(time.monotonic() - self.last_beat, 3),
+            "gang": self.gang_stats(),
+        }
+
+    def gang_stats(self) -> Dict[str, Any]:
+        return {
+            "gang_id": self.gang_id,
+            "processes": self.processes,
+            "incarnation": self.incarnation,
+            "members_alive": sum(
+                1 for m in self.members if m.returncode is None
+            ),
+            "topology": self.last_stats.get("topology", {}),
+            "source_topology": self.last_stats.get("source_topology", {}),
+        }
+
+
+class _GangCounters:
+    """Process-wide serve-gang lifecycle counters (spawns, member_deaths,
+    teardowns, rebuilds) — registered as the ``serve_gang`` obs family so
+    ``/metrics`` and the soak assertions read one source of truth."""
+
+    def __init__(self):
+        self._lock = named_lock("serve.gang.counters")
+        self._counts: Dict[str, int] = {}
+
+    def add(self, name: str, value: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + int(value)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+
+_COUNTERS = _GangCounters()
+
+
+def gang_counters() -> _GangCounters:
+    obs.get_registry().register_family("serve_gang", _COUNTERS)
+    return _COUNTERS
+
+
+def make_gang_replica_factory(
+    processes: int = 2,
+    local_devices: int = 1,
+    platform: Optional[str] = None,
+    join_deadline_s: Optional[float] = None,
+):
+    """A ``ReplicaSet`` factory whose unit is a whole gang.
+
+    Tracks per-slot incarnations: the monitor's rebuild of slot ``i``
+    constructs incarnation 2, so env-delivered chaos scheduled against
+    incarnation 1 (``kill_gang_member_at_request``) fires exactly once
+    and the rebuilt gang survives the same request index — the
+    ``kill_process_at`` contract, applied to serving.
+    """
+    incarnations: Dict[int, int] = {}
+    lock = named_lock("serve.gang.factory")
+
+    def factory(idx: int, bundle: ServableBundle, device=None, **kwargs):
+        with lock:
+            incarnation = incarnations.get(idx, 0) + 1
+            incarnations[idx] = incarnation
+        if incarnation > 1:
+            gang_counters().add("rebuilds")
+        return GangReplica(
+            idx,
+            bundle,
+            device,
+            processes=processes,
+            local_devices=local_devices,
+            platform=platform,
+            join_deadline_s=join_deadline_s,
+            incarnation=incarnation,
+            **kwargs,
+        )
+
+    return factory

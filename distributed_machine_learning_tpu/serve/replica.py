@@ -335,6 +335,12 @@ class Replica:
         queued requests fail fast and the batcher thread exits."""
         self.batcher.stop(drain=False, timeout=2.0)
 
+    def retire(self):
+        """Release resources after a graceful drain (hot swap, scale-down).
+        A plain in-process replica holds nothing beyond its batcher thread;
+        gang replicas (``serve/gang.py``) override this to reap their
+        member processes."""
+
     def health(self) -> Dict[str, Any]:
         return {
             "replica": self.idx,
@@ -387,10 +393,17 @@ class ReplicaSet:
         breaker_failure_threshold: int = 3,
         breaker_recovery_s: float = 1.0,
         fault_plan=None,
+        replica_factory=None,
     ):
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1: {num_replicas}")
         self.bundle = bundle
+        # Every replica construction site (init, monitor restart, elastic
+        # scale-up, hot swap) goes through this factory, so a set of gang
+        # units (serve/gang.py — one "replica" = N member processes over a
+        # spanning mesh) inherits restart, autoscale, and swap unchanged.
+        # Signature contract: factory(idx, bundle, device, **kwargs).
+        self._replica_factory = replica_factory or Replica
         self._kwargs = dict(
             max_batch_size=max_batch_size,
             max_latency_ms=max_latency_ms,
@@ -458,7 +471,7 @@ class ReplicaSet:
         self._warmup_programs: Optional[int] = None
         self._warmup_sample = None
         self.replicas: List[Replica] = [
-            Replica(r, bundle, self._devices[r], **self._kwargs)
+            self._replica_factory(r, bundle, self._devices[r], **self._kwargs)
             for r in range(num_replicas)
         ]
         self._record_scale_event(num_replicas, "init")
@@ -633,7 +646,7 @@ class ReplicaSet:
             for old in dead:
                 if self._closing:
                     return
-                fresh = Replica(
+                fresh = self._replica_factory(
                     old.idx, self.bundle, old.device, **self._kwargs
                 )
                 with self._lock:
@@ -697,7 +710,9 @@ class ReplicaSet:
             lease = self._dm.acquire(1) if self._dm.num_free else None
             device = (lease[0][1] if lease
                       else self._dm.devices[idx % self._dm.num_devices])
-            replica = Replica(idx, self.bundle, device, **self._kwargs)
+            replica = self._replica_factory(
+                idx, self.bundle, device, **self._kwargs
+            )
             if self._warmup_sample is not None:
                 replica.engine.warmup(self._warmup_sample)
             breaker = CircuitBreaker(**self._breaker_kwargs)
@@ -729,6 +744,7 @@ class ReplicaSet:
                 count = len(self.replicas)
             self._record_scale_event(count, reason)
             replica.batcher.stop(drain=True, timeout=10.0)
+            replica.retire()
             if lease:
                 self._dm.release(lease)
         if self._warmup_programs is not None:
@@ -830,5 +846,6 @@ class ReplicaSet:
             self._slot_leases = [None] * len(self._slot_leases)
         for r in replicas:
             r.batcher.stop(drain=False, timeout=2.0)
+            r.retire()
         for lease in leases:
             self._dm.release(lease)

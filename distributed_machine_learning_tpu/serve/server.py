@@ -83,8 +83,14 @@ class PredictionServer:
         breaker_failure_threshold: int = 3,
         breaker_recovery_s: float = 1.0,
         fault_plan=None,
+        replica_factory=None,
     ):
         self.bundle = bundle
+        # replica_factory generalizes the unit of serving: None means
+        # in-process thread replicas; serve.make_gang_replica_factory
+        # makes each slot a whole gang of TP-sharded member processes
+        # (pod-scale serving) — restart, autoscale, swap, and every
+        # endpoint below work identically on either.
         self.replicas = ReplicaSet(
             bundle,
             num_replicas=num_replicas,
@@ -98,6 +104,7 @@ class PredictionServer:
             breaker_failure_threshold=breaker_failure_threshold,
             breaker_recovery_s=breaker_recovery_s,
             fault_plan=fault_plan,
+            replica_factory=replica_factory,
         )
         self._fault_plan = fault_plan
         self.metrics = ServeMetrics(window=metrics_window)
@@ -233,6 +240,22 @@ class PredictionServer:
                 self.replicas.bundle, "quality_delta_mape", None
             ),
         }
+        gang_blocks = [
+            r.gang_stats() for r in list(self.replicas.replicas)
+            if hasattr(r, "gang_stats")
+        ]
+        if gang_blocks:
+            # Pod-scale serving (serve/gang.py): per-slot gang identity +
+            # member liveness, beside the process-wide lifecycle counters
+            # (spawns/member_deaths/teardowns/rebuilds ride out["obs"]
+            # under the serve_gang family) — the member-death runbook's
+            # counter->action table reads exactly these.
+            out["gang"] = {
+                "gangs": gang_blocks,
+                "members_alive": sum(
+                    g["members_alive"] for g in gang_blocks
+                ),
+            }
         if self.metrics.drift is not None:
             # The drift monitor's per-window scores + debounced trigger
             # (loop/drift.py) — the self-healing loop's input signal,

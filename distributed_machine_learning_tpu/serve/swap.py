@@ -54,7 +54,6 @@ def hot_swap(replica_set, new_bundle, sample=None,
     requests then compile through the caches — only for bundles whose
     programs are known-cached)."""
     from distributed_machine_learning_tpu import obs
-    from distributed_machine_learning_tpu.serve.replica import Replica
 
     from distributed_machine_learning_tpu import chaos
 
@@ -78,7 +77,12 @@ def hot_swap(replica_set, new_bundle, sample=None,
                 if i >= len(rs.replicas):
                     break  # a concurrent shrink retired this slot
                 old = rs.replicas[i]
-            fresh = Replica(old.idx, new_bundle, old.device, **rs._kwargs)
+            # Through the set's factory, so a gang-unit set swaps whole
+            # gangs: the fresh unit loads+warms the new bundle on EVERY
+            # member off-path before the atomic slot switch below.
+            fresh = rs._replica_factory(
+                old.idx, new_bundle, old.device, **rs._kwargs
+            )
             if warm and sample is not None:
                 fresh.engine.warmup(sample)
             with rs._lock:
@@ -92,6 +96,7 @@ def hot_swap(replica_set, new_bundle, sample=None,
             # Out of dispatch -> drain: accepted requests still answer
             # on the OLD model, nothing is dropped mid-flight.
             old.batcher.stop(drain=True, timeout=10.0)
+            old.retire()
             swapped += 1
             if plan is not None:
                 # Mid-promotion crash (chaos): some slots switched, the

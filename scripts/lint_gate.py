@@ -64,6 +64,8 @@ def main(argv=None) -> int:
                    help="skip the drift-retrain-promote loop smoke")
     p.add_argument("--no-head-smoke", action="store_true",
                    help="skip the head-crash auto-resume smoke")
+    p.add_argument("--no-gang-smoke", action="store_true",
+                   help="skip the 2-process gang serving smoke")
     args = p.parse_args(argv)
 
     cmd = [sys.executable, "-m", "distributed_machine_learning_tpu",
@@ -116,6 +118,10 @@ def main(argv=None) -> int:
             return rc
     if proc.returncode == 0 and not args.no_head_smoke:
         rc = _head_crash_smoke(env)
+        if rc:
+            return rc
+    if proc.returncode == 0 and not args.no_gang_smoke:
+        rc = _gang_serve_smoke(env)
         if rc:
             return rc
     return proc.returncode
@@ -319,6 +325,79 @@ def _head_crash_smoke(env) -> int:
         print("head-crash smoke: FAILED")
         return 1
     print(f"head-crash smoke: ok {proc.stdout.strip().splitlines()[-1]}")
+    return 0
+
+
+def _gang_serve_smoke(env) -> int:
+    """Pod-scale serving smoke in a child (JAX_PLATFORMS=cpu): a 2-process
+    serving GANG loads a TP-sharded bundle, reshards it onto the spanning
+    mesh, and must answer bit-identically to the single-process engine
+    with ZERO serving-path compiles after warmup — the serve/gang
+    contract, gated like a lint finding.  Containers that cannot run
+    2-process jax.distributed over CPU collectives skip (rc 0) WITH the
+    probe's evidence, same as the tier-1 gang tests."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    try:
+        import _env_probe
+        ok, why = _env_probe.multiprocess_cpu_collectives()
+    finally:
+        sys.path.remove(os.path.join(REPO, "tests"))
+    if not ok:
+        print(f"gang smoke: skipped (2-process jax.distributed "
+              f"unavailable here: {why})")
+        return 0
+    # Dense_0 column-sharded into a WIDER Dense_1: propagation all-gathers
+    # the narrow activations (exact) instead of psumming wide partials, so
+    # the gang must match the single-process engine bit for bit.
+    code = (
+        "import json, tempfile\n"
+        "import jax, numpy as np\n"
+        "from distributed_machine_learning_tpu import serve\n"
+        "from distributed_machine_learning_tpu.models import build_model\n"
+        "from distributed_machine_learning_tpu.serve import export as ex\n"
+        "from distributed_machine_learning_tpu.serve.gang import "
+        "GangReplica\n"
+        "config = {'model': 'mlp', 'hidden_sizes': [16, 64],\n"
+        "          'partition_rules': [\n"
+        "              ['params/Dense_0/kernel', [None, 'tp']],\n"
+        "              ['params/Dense_0/bias', ['tp']],\n"
+        "              ['.*', []]]}\n"
+        "model = build_model(config)\n"
+        "x = np.random.default_rng(0).normal(\n"
+        "    size=(5, 6, 4)).astype(np.float32)\n"
+        "variables = model.init(jax.random.PRNGKey(0), x,\n"
+        "                       deterministic=True)\n"
+        "out = tempfile.mkdtemp(prefix='gang_smoke_')\n"
+        "ex.write_bundle(out, {'bundle_version': ex.BUNDLE_VERSION,\n"
+        "                      'config': config, 'precision': 'f32'},\n"
+        "                variables)\n"
+        "bundle = serve.load_bundle(out)\n"
+        "ref = serve.InferenceEngine(bundle, max_bucket=8,\n"
+        "                            persistent_cache=False).predict(x)\n"
+        "gang = GangReplica(0, bundle, processes=2, max_bucket=8)\n"
+        "try:\n"
+        "    warm = gang.warmup(x)\n"
+        "    assert warm['topology']['process_count'] == 2, warm\n"
+        "    got = gang.submit(x).result(timeout=120)\n"
+        "    assert np.array_equal(got, ref), 'gang != single-process'\n"
+        "    stats = gang.engine.program_stats()\n"
+        "    assert stats['programs'] == warm['programs'], (\n"
+        "        'serving-path compile after warmup', stats)\n"
+        "finally:\n"
+        "    gang.retire()\n"
+        "print(json.dumps({'processes': 2,\n"
+        "                  'programs': warm['programs'],\n"
+        "                  'bit_identical': True}))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO, capture_output=True, text=True, env=env, timeout=480,
+    )
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr)
+        print("gang smoke: FAILED")
+        return 1
+    print(f"gang smoke: ok {proc.stdout.strip().splitlines()[-1]}")
     return 0
 
 

@@ -1,0 +1,33 @@
+# dmlint-scope: serve-request-path
+"""Fixture: serving code sizing its world from process-local device
+enumeration.  Every pattern here agrees with itself on one process and
+diverges the moment a serving gang spans two — each member traces a
+different program and the first collective wedges the gang."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def bucket_grid(max_bucket):
+    # Bucket count derived from this host's device count: gang members
+    # with different local counts pad to different shapes.
+    shards = jax.local_device_count()  # EXPECT: local-device-serving-path
+    return [b * shards for b in (8, 16, 32) if b * shards <= max_bucket]
+
+
+def build_serving_mesh():
+    # Re-deriving the mesh inside the request path instead of consuming
+    # the one bootstrap handed down.
+    return Mesh(np.array(jax.devices()), ("tp",))  # EXPECT: local-device-serving-path
+
+
+def replica_slots():
+    # Global device count used to size replica placement.
+    return len(jax.devices())  # EXPECT: local-device-serving-path
+
+
+def member_world():
+    n = jax.device_count()  # EXPECT: local-device-serving-path
+    mine = jax.local_devices()  # EXPECT: local-device-serving-path
+    return n, mine

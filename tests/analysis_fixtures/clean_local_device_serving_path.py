@@ -1,0 +1,24 @@
+# dmlint-scope: serve-request-path
+"""Idiomatic twin: serving topology decided once at bootstrap and handed
+down; request-path code only consumes the mesh it was given.  The bare
+``jax.devices()[0]`` default-device fallback picks a device — it sizes
+nothing — and stays clean."""
+
+import jax
+
+
+def default_device(device=None):
+    # Picking a fallback device is not sizing: subscript, not a count.
+    return device if device is not None else jax.devices()[0]
+
+
+def bucket_grid(mesh, max_bucket):
+    # Shard count comes from the mesh bootstrap handed us, identical on
+    # every gang member by construction.
+    shards = mesh.devices.size
+    return [b * shards for b in (8, 16, 32) if b * shards <= max_bucket]
+
+
+def member_world(bundle):
+    # Source topology from the bundle manifest, not live enumeration.
+    return bundle.source_topology["process_count"]

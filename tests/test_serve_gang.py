@@ -1,0 +1,301 @@
+"""Pod-scale serving (ISSUE 19): TP-sharded inference gangs that span
+processes.
+
+Three layers:
+
+* **Units** (always run): the broadcast header wire format, the chaos
+  gang hooks' decision logic, the manifest's recorded source topology.
+* **Single-process resharding matrix** (always run): a bundle exported
+  from one topology served on an in-process multi-device mesh must
+  answer bit-identically to the unsharded reference engine.
+* **Gang e2e** (probe-gated on 2-process CPU collectives): a real
+  2-process gang serves TP-sharded bundles bit-identically with zero
+  serving-path compiles after warmup, survives a mid-traffic chaos
+  member kill with zero dropped non-shed requests (teardown → redispatch
+  → monitor rebuild), and hot-swaps whole gangs.
+
+The sharded ruleset below is chosen deliberately: Dense_0 column-sharded
+feeding a WIDER second layer means XLA all-gathers the narrow activations
+(exact) instead of psumming wide partials (reordered accumulation), so
+sharded and unsharded programs are bit-identical — the property every
+parity assertion here leans on.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import _env_probe
+
+from distributed_machine_learning_tpu import chaos, serve, tune
+from distributed_machine_learning_tpu.data import dummy_regression_data
+from distributed_machine_learning_tpu.serve import _gang_member as gm
+from distributed_machine_learning_tpu.serve.gang import (
+    GangReplica,
+    gang_counters,
+    make_gang_replica_factory,
+)
+
+# Column-shard Dense_0 into a wider Dense_1: the all-gather propagation
+# choice is exact, so sharded == unsharded bit-for-bit.
+TP_RULES = [
+    ["params/Dense_0/kernel", [None, "tp"]],
+    ["params/Dense_0/bias", ["tp"]],
+    [".*", []],
+]
+
+
+def _train_bundle(tmp, name, seed, rules):
+    train, val = dummy_regression_data(
+        num_samples=96, seq_len=6, num_features=4, seed=7
+    )
+    config = {
+        "model": "mlp", "hidden_sizes": [16, 64], "learning_rate": 0.005,
+        "num_epochs": 2, "batch_size": 32, "seed": seed,
+    }
+    if rules is not None:
+        config["partition_rules"] = rules
+    analysis = tune.run(
+        tune.with_parameters(
+            tune.train_regressor, train_data=train, val_data=val
+        ),
+        config,
+        metric="validation_loss", mode="min", num_samples=1,
+        storage_path=os.path.join(tmp, f"exp_{name}"), name=name, verbose=0,
+    )
+    bundle_dir = os.path.join(tmp, f"bundle_{name}")
+    serve.export_bundle(analysis, bundle_dir)
+    return bundle_dir, np.asarray(val.x[:5], np.float32)
+
+
+@pytest.fixture(scope="module")
+def bundles(tmp_path_factory):
+    """One sharded (TP rules) and one replicated bundle + the reference
+    predictions of each from the plain single-process engine."""
+    tmp = str(tmp_path_factory.mktemp("gang_bundles"))
+    sharded_dir, x = _train_bundle(tmp, "tp", seed=5, rules=TP_RULES)
+    replicated_dir, _ = _train_bundle(tmp, "rep", seed=9, rules=[[".*", []]])
+    out = {}
+    for key, bdir in (("sharded", sharded_dir), ("replicated", replicated_dir)):
+        bundle = serve.load_bundle(bdir)
+        ref = serve.InferenceEngine(bundle, max_bucket=8).predict(x)
+        out[key] = {"dir": bdir, "ref": ref}
+    out["x"] = x
+    return out
+
+
+# --------------------------------------------------------------------------
+# units
+# --------------------------------------------------------------------------
+
+
+def test_broadcast_header_roundtrip():
+    hdr = gm.encode_header(gm.OP_PREDICT, 17, (8, 6, 4), np.float32)
+    assert hdr.dtype == np.int64 and hdr.shape == (gm.HEADER_LEN,)
+    op, n, shape, dtype = gm.decode_header(hdr)
+    assert (op, n, shape, dtype) == (gm.OP_PREDICT, 17, (8, 6, 4), "float32")
+    # Warmup/stop headers carry empty shapes.
+    op, _, shape, _ = gm.decode_header(
+        gm.encode_header(gm.OP_STOP, 1, (), "float32")
+    )
+    assert op == gm.OP_STOP and shape == ()
+    with pytest.raises(ValueError):
+        gm.encode_header(gm.OP_PREDICT, 1, (1,) * 7, np.float32)
+    with pytest.raises(ValueError):
+        gm.encode_header(gm.OP_PREDICT, 1, (4,), np.complex64)
+
+
+def test_chaos_gang_member_kill_decision(monkeypatch):
+    exits = []
+    monkeypatch.setattr(os, "_exit", lambda code: exits.append(code))
+    plan = chaos.FaultPlan(kill_gang_member_at_request=[(3, 1)])
+    # Wrong round / wrong member / wrong incarnation: no fire.
+    plan.maybe_kill_gang_member(2, 1)
+    plan.maybe_kill_gang_member(3, 0)
+    plan.maybe_kill_gang_member(3, 1, incarnation=2)
+    assert exits == [] and "gang_member_kills" not in plan.snapshot()
+    # The scheduled (round, member) fires exactly once, then is consumed.
+    plan.maybe_kill_gang_member(3, 1)
+    assert exits == [86]
+    assert plan.snapshot()["gang_member_kills"] == 1
+    plan.maybe_kill_gang_member(3, 1)
+    assert exits == [86]
+
+
+def test_chaos_gang_bootstrap_hang_decision():
+    plan = chaos.FaultPlan(gang_bootstrap_hang=[(1, 0.05)])
+    t0 = time.monotonic()
+    plan.maybe_gang_bootstrap_hang(0)  # not scheduled
+    plan.maybe_gang_bootstrap_hang(1, incarnation=2)  # rebuilt: clean
+    assert time.monotonic() - t0 < 0.04
+    assert "gang_bootstrap_hangs" not in plan.snapshot()
+    plan.maybe_gang_bootstrap_hang(1)
+    assert time.monotonic() - t0 >= 0.05
+    assert plan.snapshot()["gang_bootstrap_hangs"] == 1
+    t1 = time.monotonic()
+    plan.maybe_gang_bootstrap_hang(1)  # consumed: no second stall
+    assert time.monotonic() - t1 < 0.04
+
+
+def test_manifest_records_source_topology(bundles):
+    """Satellite: export records the training topology so load_bundle
+    decides reshard-vs-direct (and `dml-tpu serve` logs source→target)
+    from the manifest alone, never by probing chunk files."""
+    bundle = serve.load_bundle(bundles["sharded"]["dir"])
+    topo = json.load(
+        open(os.path.join(bundles["sharded"]["dir"], "bundle.json"))
+    )["source"]["topology"]
+    assert set(topo) == {"mesh_shape", "process_count", "rules_fingerprint"}
+    assert topo["process_count"] >= 1
+    assert str(topo["rules_fingerprint"]).startswith("pr_")
+    assert bundle.source_topology == topo
+
+
+def test_gang_replica_requires_on_disk_bundle(bundles):
+    bundle = serve.load_bundle(bundles["sharded"]["dir"])
+    bundle.path = None
+    with pytest.raises(ValueError, match="on-disk bundle"):
+        GangReplica(0, bundle)
+
+
+# --------------------------------------------------------------------------
+# resharding matrix, single-process half: serve on an in-process mesh
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("source", ["sharded", "replicated"])
+def test_mesh_engine_bit_identical_single_process(bundles, source):
+    """{1-device, TP-ruled} exports × 1-process multi-device serving mesh:
+    the resharding load route must not move a single bit."""
+    import jax
+    from jax.sharding import Mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 (virtual) devices")
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    sb = serve.load_bundle(bundles[source]["dir"], mesh=mesh)
+    eng = serve.InferenceEngine(sb, max_bucket=8, mesh=mesh, aot_cache=False)
+    out = eng.predict(bundles["x"])
+    np.testing.assert_array_equal(out, bundles[source]["ref"])
+
+
+# --------------------------------------------------------------------------
+# gang e2e (probe-gated: two real processes, gloo collectives)
+# --------------------------------------------------------------------------
+
+
+def _require_gang_env():
+    ok, why = _env_probe.multiprocess_cpu_collectives()
+    if not ok:
+        pytest.skip(f"2-process jax.distributed unavailable here: {why}")
+
+
+@pytest.mark.parametrize("source", ["sharded", "replicated"])
+def test_gang_serves_bit_identically_zero_compiles(bundles, source):
+    """The tentpole acceptance: a 2-process gang answers bit-identically
+    to the 1-process reference, and traffic after warmup compiles
+    nothing."""
+    _require_gang_env()
+    bundle = serve.load_bundle(bundles[source]["dir"])
+    x = bundles["x"]
+    gang = GangReplica(0, bundle, processes=2, max_bucket=8)
+    try:
+        warm = gang.warmup(x)
+        programs_after_warmup = warm["programs"]
+        assert programs_after_warmup > 0
+        assert warm["topology"]["process_count"] == 2
+        out = gang.submit(x).result(timeout=120)
+        np.testing.assert_array_equal(out, bundles[source]["ref"])
+        stats = gang.engine.program_stats()
+        assert stats["programs"] == programs_after_warmup, (
+            "serving-path compile after warmup"
+        )
+        gs = gang.gang_stats()
+        assert gs["members_alive"] == 2
+        assert gs["incarnation"] == 1
+        assert gs["source_topology"]["process_count"] == 1
+        assert gang.health()["gang"]["gang_id"] == gs["gang_id"]
+    finally:
+        gang.retire()
+    assert not gang.alive()
+    counts = gang_counters().snapshot()
+    assert counts["spawns"] >= 1 and counts["teardowns"] >= 1
+
+
+def test_gang_soak_member_kill_zero_drops_then_swap(bundles):
+    """The chaos soak + swap acceptance in one gang session:
+
+    1. mid-traffic chaos kill of a NON-coordinator member → whole-gang
+       teardown, queued/in-flight requests redispatched, monitor rebuilds
+       the slot as incarnation 2 — every non-shed request answers, zero
+       drops;
+    2. `new_programs_since_warmup` stays 0 across the rebuild;
+    3. hot swap replaces the whole gang with one serving the second
+       bundle, warmed off-path — predictions flip to the new reference
+       with zero serving-path compiles.
+    """
+    _require_gang_env()
+    x = bundles["x"]
+    bundle = serve.load_bundle(bundles["sharded"]["dir"])
+    base = gang_counters().snapshot()
+    # Round 1 is the warmup round; the kill lands on predict round 3,
+    # member 1 (non-coordinator) — mid-traffic by construction.
+    os.environ["DML_CHAOS_PLAN"] = json.dumps(
+        {"kill_gang_member_at_request": [[3, 1]]}
+    )
+    try:
+        rs = serve.ReplicaSet(
+            bundle,
+            num_replicas=1,
+            max_bucket=8,
+            restart=True,
+            monitor_interval_s=0.1,
+            replica_factory=make_gang_replica_factory(processes=2),
+        )
+    finally:
+        os.environ.pop("DML_CHAOS_PLAN", None)
+    try:
+        rs.warmup(x)
+        answered = 0
+        deadline = time.monotonic() + 240
+        for i in range(8):
+            req = np.asarray(x[(i % 3):(i % 3) + 2], np.float32)
+            want = bundles["sharded"]["ref"][(i % 3):(i % 3) + 2]
+            while True:
+                try:
+                    got = rs.predict(req, timeout=60.0)
+                    break
+                except RuntimeError:
+                    # Shed/unavailable while the slot rebuilds (429/503
+                    # upstream): the client's Retry-After loop. A shed is
+                    # not a drop — the request must still answer.
+                    assert time.monotonic() < deadline, (
+                        "gang slot never came back"
+                    )
+                    time.sleep(0.25)
+            np.testing.assert_array_equal(got, want)
+            answered += 1
+        assert answered == 8, "dropped a non-shed request"
+
+        counts = gang_counters().snapshot()
+        for key in ("member_deaths", "teardowns", "rebuilds",
+                    "chaos_member_kills"):
+            assert counts.get(key, 0) > base.get(key, 0), key
+        assert rs.replicas[0].gang_stats()["incarnation"] == 2
+        assert rs.program_stats()["new_programs_since_warmup"] == 0
+        assert rs.restarts >= 1
+
+        # Swap-on-gang: fresh gang loads+warms the OTHER bundle on every
+        # member off-path, then the slot switches atomically.
+        new_bundle = serve.load_bundle(bundles["replicated"]["dir"])
+        event = rs.hot_swap(new_bundle, sample=x)
+        assert event["replicas_swapped"] == 1
+        out = rs.predict(x, timeout=60.0)
+        np.testing.assert_array_equal(out, bundles["replicated"]["ref"])
+        assert rs.program_stats()["new_programs_since_warmup"] == 0
+        assert rs.replicas[0].gang_stats()["incarnation"] == 3
+    finally:
+        rs.close()
