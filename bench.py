@@ -2936,6 +2936,110 @@ def child_head_recovery() -> None:
     }))
 
 
+# Child: content-store dedup + ref-copy export (ISSUE 20 store section)
+
+
+def child_store() -> None:
+    """The content-addressed store's headline numbers, measured: chunk-
+    level dedup on the two write patterns that motivated it (a keep-K
+    generation chain where little changes between saves, and a PBT
+    population whose exploits copy donor rows), and the ref-copy export
+    against the legacy full-rewrite it replaces.
+
+    Emits ONE JSON line: ``bytes_logical``/``bytes_physical`` and their
+    ``dedup_ratio`` (< 0.5 is the acceptance bar), ``dedup_hits``,
+    save walls for the CAS vs the pre-CAS (``DML_STORE_CKPT=0``) chunk
+    writer on the same chain, ref-copy vs full-rewrite export walls, and
+    ``export_param_blob_writes`` — which must be 0: exporting a committed
+    generation moves metadata, not parameter bytes."""
+    import numpy as np
+
+    from distributed_machine_learning_tpu import store
+    from distributed_machine_learning_tpu.ckpt import format as fmt
+
+    # Small pieces so the modest bench arrays split into many blobs and
+    # the row-aligned dedup has boundaries to land on.
+    os.environ["DML_STORE_CHUNK_BYTES"] = "4096"
+    os.environ.pop("DML_STORE_CKPT", None)
+    root = tempfile.mkdtemp(prefix="bench_store_")
+    rng = np.random.default_rng(0)
+
+    def chain_trees(n=4):
+        w = rng.standard_normal((1024, 64)).astype(np.float32)
+        b = rng.standard_normal(64).astype(np.float32)
+        out = []
+        for gen in range(n):
+            w = w.copy()
+            w[gen % w.shape[0]] += 1.0  # one-row update per generation
+            out.append({"params": {"w": w, "b": b}})
+        return out
+
+    # -- keep-K generation chain, CAS on --------------------------------
+    trees = chain_trees()
+    before = store.get_metrics().snapshot()
+    t0 = time.time()
+    for i, tree in enumerate(trees):
+        fmt.save_sharded(os.path.join(root, "cas", f"gen_{i + 1:06d}"),
+                         tree)
+    cas_save_s = time.time() - t0
+    chain = store.get_metrics().delta_since(before)
+
+    # -- PBT population: 3 exploits copying donor rows ------------------
+    pop = rng.standard_normal((8, 32, 512)).astype(np.float32)
+    before = store.get_metrics().snapshot()
+    for step, (dst, src) in enumerate([(3, 0), (5, 1), (7, 0)]):
+        pop = pop.copy()
+        pop[dst] = pop[src]  # exploit: donor member's rows, bit for bit
+        fmt.save_sharded(
+            os.path.join(root, "pbt", f"gen_{step + 1:06d}"),
+            {"pop": pop},
+        )
+    pbt = store.get_metrics().delta_since(before)
+
+    # -- export: ref-copy vs the legacy full rewrite --------------------
+    last = os.path.join(root, "cas", f"gen_{len(trees):06d}")
+    before = store.get_metrics().snapshot()
+    t0 = time.time()
+    copied = fmt.ref_copy_subtree(last, os.path.join(root, "export.cas"))
+    refcopy_s = time.time() - t0
+    dexp = store.get_metrics().delta_since(before)
+    # puts minus the ref-copy's own manifest blob = param-chunk writes.
+    param_blob_writes = int(dexp["puts"]) - 1
+
+    os.environ["DML_STORE_CKPT"] = "0"
+    try:
+        t0 = time.time()
+        loaded = fmt.load_sharded(last)
+        fmt.save_sharded(os.path.join(root, "export_legacy"), loaded)
+        legacy_export_s = time.time() - t0
+        t0 = time.time()
+        for i, tree in enumerate(trees):
+            fmt.save_sharded(
+                os.path.join(root, "legacy", f"gen_{i + 1:06d}"), tree
+            )
+        legacy_save_s = time.time() - t0
+    finally:
+        os.environ.pop("DML_STORE_CKPT", None)
+
+    # Chain + PBT + export together: the dedup the store actually banked.
+    logical = int(chain["bytes_logical"] + pbt["bytes_logical"])
+    physical = int(chain["bytes_physical"] + pbt["bytes_physical"])
+    print(json.dumps({
+        "bytes_logical": logical,
+        "bytes_physical": physical,
+        "dedup_ratio": round(physical / logical, 4) if logical else 1.0,
+        "dedup_hits": int(chain["dedup_hits"] + pbt["dedup_hits"]),
+        "pbt_dedup_hits": int(pbt["dedup_hits"]),
+        "pass_half": bool(logical and physical < 0.5 * logical),
+        "cas_save_s": round(cas_save_s, 3),
+        "legacy_save_s": round(legacy_save_s, 3),
+        "export_refcopy_s": round(refcopy_s, 4),
+        "export_legacy_s": round(legacy_export_s, 4),
+        "export_param_blob_writes": param_blob_writes,
+        "export_chunks": copied["chunks"] if copied else None,
+    }))
+
+
 # ---------------------------------------------------------------------------
 # Parent orchestration
 
@@ -3131,12 +3235,22 @@ def emit(value: float, vs_baseline, backend: str, extra: dict) -> None:
                 "best_matches_control", "head_incarnations",
             ) if hr.get(k) is not None}
         )
+    sr = extra.get("store")
+    if sr:
+        compact["store"] = (
+            {"error": str(sr["error"])[-120:]} if "error" in sr else
+            {k: sr.get(k) for k in (
+                "dedup_ratio", "pass_half", "dedup_hits",
+                "export_refcopy_s", "export_legacy_s",
+                "export_param_blob_writes",
+            ) if sr.get(k) is not None}
+        )
     # Belt-and-braces: drop optional blocks until the line fits the
     # driver's tail capture (never the metric/value/backend core).
     out = json.dumps(compact)
     for k in ("compile_cache", "cold_second_run", "last_tpu_capture",
               "flagship_prev", "asha", "flagship", "serve_soak", "pbt",
-              "streaming", "online_loop", "head_recovery",
+              "streaming", "online_loop", "head_recovery", "store",
               "quality_at_budget", "warm_skipped_after", "error"):
         if len(out) <= EMIT_MAX_CHARS:
             break
@@ -3683,6 +3797,24 @@ def main() -> None:
             log(f"head_recovery child failed rc={rc}; tail: {err[-300:]}")
             head_recovery = {"error": (err or out)[-300:]}
 
+    # store section (ISSUE 20): the content-addressed store's dedup ratio
+    # on the generation-chain + PBT write patterns, and the ref-copy
+    # export vs the full rewrite it replaces — always a CPU child; every
+    # claim is a platform-independent counter.
+    store_res = None
+    if os.environ.get("DML_BENCH_STORE", "1") != "0" \
+            and ours is not None:
+        log("running store (chunk dedup + ref-copy export vs pre-CAS)")
+        t0 = time.time()
+        rc, out, err, _ = _run_child(
+            ["--child", "store"], _cpu_env(), 300
+        )
+        phases["store_s"] = round(time.time() - t0, 1)
+        store_res = _parse_result(out) if rc == 0 else None
+        if store_res is None:
+            log(f"store child failed rc={rc}; tail: {err[-300:]}")
+            store_res = {"error": (err or out)[-300:]}
+
     # Equal-budget quality comparison (BASELINE.md row 4): ours came from
     # the suite on the TPU path; on the CPU path run it here (CPU children
     # never claim the tunnel).  The torch side always runs on CPU — the
@@ -3886,6 +4018,8 @@ def main() -> None:
         extra["online_loop"] = online_loop
     if head_recovery is not None:
         extra["head_recovery"] = head_recovery
+    if store_res is not None:
+        extra["store"] = store_res
     if backend == "cpu":
         # On a dead-tunnel day the artifact still carries the most recent
         # real-chip suite, provenance-stamped with its capture time (the
@@ -3991,6 +4125,8 @@ if __name__ == "__main__":
             child_online_loop()
         elif kind == "head_recovery":
             child_head_recovery()
+        elif kind == "store":
+            child_store()
         elif kind == "flagship":
             child_flagship()
         elif kind == "sharded_flagship":

@@ -870,6 +870,94 @@ def _journal(rest) -> None:
         print(f"last record: {status['last_record']}")
 
 
+def _store(rest) -> None:
+    """Content-store operator surface (store/): dedup stats, blob
+    integrity verification, and reachability GC — the runbook commands
+    behind docs/operations.md's store rows.  GC is a DRY RUN unless
+    --run is given: it reports what the sweep would collect without
+    deleting anything."""
+    import argparse
+    import json as _json
+    import os as _os
+
+    p = argparse.ArgumentParser(
+        prog="store",
+        description="inspect / verify / garbage-collect a content-"
+                    "addressed store (store/)",
+    )
+    p.add_argument("action", choices=("stats", "verify", "gc"))
+    p.add_argument("path",
+                   help="the store root (a .cas directory), or any "
+                        "directory it serves — an experiment or "
+                        "checkpoint dir resolves to its .cas sibling "
+                        "exactly the way writers do")
+    p.add_argument("--run", action="store_true",
+                   help="gc: actually delete unreachable blobs "
+                        "(default is a dry run)")
+    p.add_argument("--dry-run", action="store_true",
+                   help="gc: report-only sweep (the default; explicit "
+                        "spelling for scripts)")
+    p.add_argument("--min-age-s", type=float, default=0.0,
+                   help="gc: retain blobs younger than this many "
+                        "seconds regardless of reachability (guards "
+                        "cross-process writers beyond the pin table)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    args = p.parse_args(rest)
+    if args.run and args.dry_run:
+        p.error("--run and --dry-run are mutually exclusive")
+
+    from distributed_machine_learning_tpu import store as store_lib
+
+    root = args.path
+    if (
+        _os.path.basename(root.rstrip("/")) != store_lib.STORE_DIR_NAME
+        and not _os.path.isdir(_os.path.join(root, store_lib.BLOBS_DIR))
+    ):
+        root = store_lib.store_root_for(_os.path.join(root, "_"))
+    cas = store_lib.get_store(root)
+
+    if args.action == "stats":
+        out = cas.stats()
+        if args.as_json:
+            print(_json.dumps(out, indent=2, sort_keys=True))
+            return
+        print(f"store {out['root']}: {out['blobs']} blob(s), "
+              f"{out['refs']} ref(s), {out['physical_bytes']} "
+              f"physical byte(s)")
+        c = out["counters"]
+        print(f"this process: {c.get('puts', 0)} put(s), "
+              f"{c.get('dedup_hits', 0)} dedup hit(s), "
+              f"{c.get('bytes_logical', 0)} logical -> "
+              f"{c.get('bytes_physical', 0)} physical byte(s) "
+              f"(ratio {out['dedup_ratio']})")
+    elif args.action == "verify":
+        out = cas.verify()
+        out["root"] = cas.root
+        if args.as_json:
+            print(_json.dumps(out, indent=2, sort_keys=True))
+        else:
+            print(f"store {cas.root}: {out['blobs']} blob(s) checked, "
+                  f"{len(out['corrupt'])} corrupt")
+            for digest in out["corrupt"]:
+                print(f"  corrupt: {digest}")
+        if out["corrupt"]:
+            raise SystemExit(1)
+    else:
+        out = cas.gc(dry_run=not args.run, min_age_s=args.min_age_s)
+        out["root"] = cas.root
+        if args.as_json:
+            print(_json.dumps(out, indent=2, sort_keys=True))
+            return
+        verb = "collected" if args.run else "would collect"
+        print(f"store {cas.root}: {verb} {out['collected']} blob(s) "
+              f"({out['reclaimed_bytes']} byte(s)), retained "
+              f"{out['retained']}; {out['refs']} ref(s), "
+              f"{out['broken_refs']} broken")
+        if not args.run:
+            print("dry run — pass --run to delete")
+
+
 def _serve(rest) -> None:
     import argparse
     import time
@@ -1021,7 +1109,7 @@ def main(argv=None) -> None:
     usage = (
         "usage: python -m distributed_machine_learning_tpu "
         "{worker|info|probe|analyze|lint|audit-sharding|perf|trace|serve|"
-        "loop|journal|export-bundle|export-orbax} [args]\n"
+        "loop|journal|store|export-bundle|export-orbax} [args]\n"
         "  worker         host trial supervisor (see 'worker --help')\n"
         "  lint           dmlint static analysis over the package (or given\n"
         "                 paths); exit 1 on any unsuppressed finding\n"
@@ -1049,6 +1137,10 @@ def main(argv=None) -> None:
         "  journal        status <experiment_dir>: the head's write-ahead\n"
         "                 decision journal — committed vs crash-open,\n"
         "                 incarnations, per-trial report watermarks\n"
+        "  store          {stats|verify|gc} <root>: content-addressed\n"
+        "                 store surface (store/) — dedup stats, blob\n"
+        "                 integrity, reachability GC (gc is a dry run\n"
+        "                 unless --run)\n"
         "  export-orbax   <ckpt.msgpack> <out_dir>: framework checkpoint\n"
         "                 -> orbax StandardCheckpoint"
     )
@@ -1080,6 +1172,8 @@ def main(argv=None) -> None:
         _loop(rest)
     elif cmd == "journal":
         _journal(rest)
+    elif cmd == "store":
+        _store(rest)
     elif cmd == "export-bundle":
         _export_bundle(rest)
     elif cmd == "export-orbax":

@@ -2883,6 +2883,128 @@ class LocalDeviceServingPathRule(Rule):
                     )
 
 
+# --------------------------------------------------------------------------
+# DML022 raw-hashed-write-outside-store
+# --------------------------------------------------------------------------
+
+# Modules whose artifact bytes belong in the content store (``store/``):
+# checkpoint chunk writers, compile-artifact shipping, dataset caches, and
+# the export bundler.  Other modules opt in with `# dmlint-scope: cas-path`.
+CAS_PATH_PATTERNS = (
+    "ckpt/",
+    "compilecache/",
+    "data/",
+)
+
+# Names whose presence in a scope marks it as going through the store
+# layer (so its sha256 is the STORE's addressing, not a parallel scheme).
+_STORE_LAYER_NAMES = {
+    "put_blob", "get_blob", "get_store", "ContentStore", "put_manifest",
+    "read_manifest", "ref_copy_subtree", "set_ref", "read_ref",
+    "local_blob_path", "has_blob",
+}
+
+# Binary write modes: a sha256-named payload landing via one of these
+# bypasses the store's first-publish-wins/fsync/GC-pin contract.
+_BINARY_WRITE_MODES = {"wb", "bw", "wb+", "w+b", "bw+", "xb", "bx"}
+
+
+def _open_binary_write(node: ast.Call) -> bool:
+    callee = _call_name(node) or ""
+    if callee.rsplit(".", 1)[-1] != "open":
+        return False
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    return (
+        isinstance(mode, ast.Constant)
+        and isinstance(mode.value, str)
+        and mode.value in _BINARY_WRITE_MODES
+    )
+
+
+class RawHashedWriteOutsideStoreRule(Rule):
+    name = "raw-hashed-write-outside-store"
+    rule_id = "DML022"
+    severity = "error"
+    description = (
+        "a CAS-path module hashing bytes with sha256 and writing them to "
+        "a file itself — a hand-rolled parallel content-addressing scheme "
+        "next to the one the repo already has (``store/``).  Bytes "
+        "published this way are invisible to dedup accounting, unpinned "
+        "against the GC-vs-writer race, not fsync'd under the first-"
+        "publish-wins contract, and the reachability GC can neither "
+        "retain nor reclaim them.  Checkpoint chunks, compile artifacts, "
+        "dataset-cache products, and export payloads all publish through "
+        "``store.ContentStore.put_blob`` + a manifest + a ref."
+    )
+    _HINT = (
+        "publish through the store layer: `store.get_store(root)` then "
+        "`put_blob(data)` (pin digests while the ref is pending), "
+        "`put_manifest({..., 'store_chunks': [...]})`, `set_ref(...)` — "
+        "or suppress with '# dmlint: disable=raw-hashed-write-outside-"
+        "store <reason>' when the sha256 is a checksum over an object "
+        "the store intentionally does not own"
+    )
+
+    def applies(self, ctx) -> bool:
+        if "cas-path" in ctx.scopes:
+            return True
+        rel = ctx.display_path.replace("\\", "/")
+        if rel.endswith("serve/export.py"):
+            return True
+        return any(pat in rel for pat in CAS_PATH_PATTERNS)
+
+    def check(self, ctx) -> Iterator[Finding]:
+        parents: Dict[int, ast.AST] = {}
+        for parent in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[id(child)] = parent
+
+        def _innermost_scope(node: ast.AST) -> ast.AST:
+            cur = parents.get(id(node))
+            while cur is not None:
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    return cur
+                cur = parents.get(id(cur))
+            return ctx.tree
+
+        hashed: Set[int] = set()       # scopes that sha256 something
+        store_layer: Set[int] = set()  # scopes that touch the store API
+        writes: List[ast.AST] = []     # raw binary-write call sites
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Name) and node.id in _STORE_LAYER_NAMES:
+                store_layer.add(id(_innermost_scope(node)))
+            elif (
+                isinstance(node, ast.Attribute)
+                and node.attr in _STORE_LAYER_NAMES
+            ):
+                store_layer.add(id(_innermost_scope(node)))
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _call_name(node) or ""
+            tail = callee.rsplit(".", 1)[-1]
+            if tail == "sha256":
+                hashed.add(id(_innermost_scope(node)))
+            elif tail == "write_bytes" or _open_binary_write(node):
+                writes.append(node)
+
+        for node in writes:
+            scope = _innermost_scope(node)
+            if id(scope) not in hashed or id(scope) in store_layer:
+                continue
+            yield self.finding(
+                ctx, node,
+                "sha256-addressed bytes written with a raw file write — "
+                "a parallel content-addressing scheme the store's dedup, "
+                "pins, and reachability GC cannot see",
+                self._HINT,
+            )
+
+
 ALL_RULES: List[Rule] = [
     DonationAliasRule(),
     UnlockedDispatchRule(),
@@ -2905,6 +3027,7 @@ ALL_RULES: List[Rule] = [
     UnguardedPromotionRule(),
     NonAtomicStateWriteRule(),
     LocalDeviceServingPathRule(),
+    RawHashedWriteOutsideStoreRule(),
 ]
 
 

@@ -71,6 +71,14 @@ class InjectedCommitKill(InjectedFault, RuntimeError):
     commit protocol (readers skip it; the manager deletes it on start)."""
 
 
+class InjectedRefFlipKill(InjectedFault, RuntimeError):
+    """A process killed between preparing a content-store ref update and
+    landing it (``store/core.set_ref``).  NOT an OSError — the storage
+    retry policy must not absorb it (a real SIGKILL doesn't retry).  The
+    atomic-ref contract means the OLD ref value survives intact; the
+    in-flight publish's blobs stay unreferenced until GC collects them."""
+
+
 class InjectedSwapCrash(InjectedFault, RuntimeError):
     """A hot-swap procedure killed after it has switched SOME slots but
     before the set's bundle pointer moved — the mid-promotion crash.  The
@@ -119,10 +127,13 @@ class FaultPlan:
       each substring has its payload bit-flipped ON DISK (the manifest
       checksum is computed upstream, so restore detects the damage).
     * ``chunk_write_error_rate`` — like ``write_error_rate`` but ONLY for
-      sharded-checkpoint chunk files (``*.chunk``, ``ckpt/format.py``):
-      per-chunk fault pressure on the new format without touching metrics
-      or state writes.  Transient (retries re-roll); rates high enough to
-      exhaust the retry budget leave the generation uncommitted.
+      checkpoint chunk payloads: sharded-checkpoint chunk files
+      (``*.chunk``, ``ckpt/format.py``) and content-store blob publishes
+      (``blobs/<hh>/<sha256>``, ``store/core.py`` — the same bytes under
+      the CAS write path).  Per-chunk fault pressure on the format without
+      touching metrics or state writes.  Transient (retries re-roll);
+      rates high enough to exhaust the retry budget leave the generation
+      uncommitted.
     * ``kill_before_commit`` — path substrings; the first write of a
       ``COMMIT`` marker whose generation path contains each substring
       raises :class:`InjectedCommitKill` instead of landing — the
@@ -176,6 +187,16 @@ class FaultPlan:
       ``params.msgpack`` is bit-flipped ON DISK after the write
       (``serve/export.write_bundle``); the loader's msgpack restore
       detects the damage, so a corrupt candidate can never be promoted.
+    * ``blob_corrupt_on_publish`` — number of content-store blob
+      publishes whose bytes are bit-flipped ON DISK as they land
+      (``store/core.put_blob``): the stored bytes no longer hash to the
+      blob's name, which only ``store verify`` (or a checksum-verifying
+      read) can catch — the bit-rot-at-publish fault.
+    * ``kill_during_ref_flip`` — path substrings; the first content-store
+      ref update whose ref path contains each substring raises
+      :class:`InjectedRefFlipKill` BEFORE the atomic replace lands (fires
+      once per entry) — the old ref value must survive untouched and the
+      orphaned publish's blobs become GC food, never a torn ref.
     * ``controller_crash_at`` — loop-journal state names
       (``loop/journal.py``); the self-healing controller raises
       :class:`InjectedControllerCrash` immediately AFTER journaling each
@@ -262,6 +283,8 @@ class FaultPlan:
         hot_swaps: Iterable[int] = (),
         mid_swap_crash: Iterable[int] = (),
         corrupt_bundle_on_export: int = 0,
+        blob_corrupt_on_publish: int = 0,
+        kill_during_ref_flip: Sequence[str] = (),
         controller_crash_at: Sequence[str] = (),
         kill_head_at: Optional[int] = None,
         kill_head_during_journal_write: Optional[int] = None,
@@ -303,6 +326,8 @@ class FaultPlan:
             (int(n) for n in mid_swap_crash), reverse=True
         )
         self._bundle_corruptions_pending = int(corrupt_bundle_on_export)
+        self._blob_corruptions_pending = int(blob_corrupt_on_publish)
+        self._ref_flip_kill_pending: List[str] = list(kill_during_ref_flip)
         self._controller_crashes: List[str] = [
             str(s) for s in controller_crash_at
         ]
@@ -402,7 +427,7 @@ class FaultPlan:
                 )
         if (
             op == "write"
-            and path.endswith(".chunk")
+            and (path.endswith(".chunk") or "/blobs/" in path)
             and self._roll("chunk_write", path, self.chunk_write_error_rate)
         ):
             self._count("chunk_write_errors")
@@ -690,6 +715,43 @@ class FaultPlan:
                 self._counters.get("bundle_corruptions", 0) + 1
             )
         return corrupt_bytes(data)
+
+    # -- content-store faults ------------------------------------------------
+
+    def corrupt_blob_publish(self, path: str, data: bytes) -> bytes:
+        """Called by ``store/core.put_blob`` with the blob payload about
+        to land; returns it bit-flipped while scheduled corruptions
+        remain (``blob_corrupt_on_publish``) — the stored bytes then no
+        longer hash to the blob's name, which only ``store verify`` (or
+        a verifying read) detects.  Counts ``blob_corruptions``."""
+        with self._lock:
+            if self._blob_corruptions_pending <= 0:
+                return data
+            self._blob_corruptions_pending -= 1
+            self.corrupted_paths.append(path)
+            self._counters["blob_corruptions"] = (
+                self._counters.get("blob_corruptions", 0) + 1
+            )
+        return corrupt_bytes(data)
+
+    def maybe_kill_ref_flip(self, path: str) -> None:
+        """Raise :class:`InjectedRefFlipKill` before a content-store ref
+        update whose path contains a scheduled substring lands (fires
+        once per entry; counts ``ref_flip_kills``) — the writer dies mid
+        ref flip, the OLD ref value survives."""
+        with self._lock:
+            hit = next(
+                (s for s in self._ref_flip_kill_pending if s in path), None
+            )
+            if hit is not None:
+                self._ref_flip_kill_pending.remove(hit)
+                self._counters["ref_flip_kills"] = (
+                    self._counters.get("ref_flip_kills", 0) + 1
+                )
+        if hit is not None:
+            raise InjectedRefFlipKill(
+                f"injected kill during ref flip of {path}"
+            )
 
     def maybe_crash_controller(self, state: str) -> None:
         """Raise :class:`InjectedControllerCrash` if the loop controller

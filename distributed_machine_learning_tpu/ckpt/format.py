@@ -37,6 +37,19 @@ every process can evaluate), so hosts write disjoint chunk files into the
 same directory and process 0 writes the index/COMMIT.  Chunks written by
 other hosts carry ``"sha256": null`` in process 0's index (their bytes
 never crossed hosts); they are decode-checked on read instead.
+
+Content-addressed mode (ISSUE 20; single-process saves, default on, see
+``store.store_enabled``): chunk PAYLOADS land in the sibling content
+store instead of per-generation ``*.chunk`` files.  Each chunk record
+additionally carries ``"blobs": [{"h": <sha256>, "nbytes": n}, ...]`` —
+row-aligned pieces published via ``ContentStore.put_blob``, so a piece
+unchanged between generation N and N+1 (or a PBT donor row shared across
+population members) is a dedup hit, not a write.  The index records the
+store root under ``"store"`` and a ``ckpt-<hash(path)>`` ref points GC at
+the generation's manifest; the commit protocol is unchanged (blobs ->
+manifest -> ref -> index.json -> COMMIT), restores stay bit-identical,
+and multi-process saves keep the legacy chunk-file layout (other hosts'
+chunk hashes never cross hosts, so one process cannot name their blobs).
 """
 
 from __future__ import annotations
@@ -49,6 +62,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from distributed_machine_learning_tpu import store as store_lib
 from distributed_machine_learning_tpu.ckpt.metrics import get_metrics
 from distributed_machine_learning_tpu.tune.storage import get_storage
 
@@ -293,89 +307,156 @@ def _mh_barrier(name: str) -> None:
     barrier(name)
 
 
+def _cas_for(path: str) -> Optional["store_lib.ContentStore"]:
+    """The content store serving ``path``'s CAS write path — None when
+    the store is disabled (``DML_STORE_CKPT=0``) or the save spans
+    processes (other hosts' chunk hashes never cross hosts, so one
+    process cannot publish a shared blob namespace)."""
+    if not store_lib.store_enabled():
+        return None
+    try:
+        import jax
+
+        if jax.process_count() > 1:  # pragma: no cover - multihost
+            return None
+    except Exception:  # pragma: no cover - pre-init
+        pass
+    return store_lib.get_store(store_lib.store_root_for(path))
+
+
+def _row_stride(arr: np.ndarray) -> int:
+    """Byte width of one leading-axis row (0 for scalars) — the piece
+    boundary that keeps PBT donor rows and unchanged row ranges hashing
+    to the same blobs across writers."""
+    if arr.ndim < 1:
+        return 0
+    return int(np.prod(arr.shape[1:], dtype=np.int64)) * arr.dtype.itemsize
+
+
 def write_snapshot(path: str, skeleton, leaves: List[Any]) -> Tuple[int, int]:
     """Write a snapshotted tree as one generation under ``path``; returns
     ``(bytes_written, chunks_written)``.  Order is the commit protocol:
-    chunks -> index.json -> COMMIT (multi-process: barriers between the
-    phases, see :func:`_mh_barrier`)."""
+    chunk payloads -> (CAS mode: manifest -> ref) -> index.json -> COMMIT
+    (multi-process: barriers between the phases, see :func:`_mh_barrier`)."""
     backend, p = get_storage(path)
     # Re-saving over a previous attempt at the same step: drop its COMMIT
     # FIRST so no reader ever pairs the old marker with new bytes.
     backend.delete(backend.join(p, COMMIT_NAME))
     _mh_barrier(f"ckpt_clear:{p}")
+    cas = _cas_for(p)
+    # Pin-then-scan GC contract: every digest is pinned the moment it is
+    # published, and the pin is dropped only after the ref (and COMMIT)
+    # landed — a concurrent sweep can never collect an in-flight save.
+    pin = cas.pin() if cas is not None else None
+    gen_digests: List[str] = []
     total_bytes = 0
     total_chunks = 0
     index_leaves: List[Dict[str, Any]] = []
-    for n, leaf in enumerate(leaves):
-        if not isinstance(leaf, HostLeaf):
-            index_leaves.append({"literal": True, "value": leaf})
-            continue
-        chunk_recs = []
-        for start, stop, arr in leaf.chunks:
-            data = np.ascontiguousarray(arr).tobytes()
-            fname = _chunk_file_name(n, start)
-            backend.write_bytes(backend.join(p, fname), data)
-            chunk_recs.append({
-                "file": fname,
-                "start": list(start),
-                "stop": list(stop),
-                "nbytes": len(data),
-                "sha256": hashlib.sha256(data).hexdigest(),
-            })
-            total_bytes += len(data)
-            total_chunks += 1
-        for start, stop in leaf.remote_chunks:  # pragma: no cover - multihost
-            chunk_recs.append({
-                "file": _chunk_file_name(n, start),
-                "start": list(start),
-                "stop": list(stop),
-                "nbytes": None,
-                "sha256": None,
-            })
-        rec = {
-            "shape": list(leaf.shape),
-            "dtype": leaf.dtype,
-            "chunks": chunk_recs,
-        }
-        if leaf.partition is not None:
-            rec["partition"] = leaf.partition
-        index_leaves.append(rec)
-    # All processes' chunks must be on storage before the index/COMMIT
-    # that names them (no-op single-process).
-    _mh_barrier(f"ckpt_chunks:{p}")
     try:
-        import jax
-
-        process_index = jax.process_index()
-    except Exception:  # pragma: no cover - pre-init
-        process_index = 0
-    if process_index == 0:
+        for n, leaf in enumerate(leaves):
+            if not isinstance(leaf, HostLeaf):
+                index_leaves.append({"literal": True, "value": leaf})
+                continue
+            chunk_recs = []
+            for start, stop, arr in leaf.chunks:
+                contiguous = np.ascontiguousarray(arr)
+                data = contiguous.tobytes()
+                fname = _chunk_file_name(n, start)
+                rec = {
+                    "file": fname,
+                    "start": list(start),
+                    "stop": list(stop),
+                    "nbytes": len(data),
+                    "sha256": hashlib.sha256(data).hexdigest(),
+                }
+                if cas is not None:
+                    blob_recs = []
+                    for off, ln in store_lib.split_row_aligned(
+                        len(data), _row_stride(contiguous)
+                    ):
+                        digest = cas.put_blob(data[off:off + ln])
+                        pin.add(digest)
+                        gen_digests.append(digest)
+                        blob_recs.append({"h": digest, "nbytes": ln})
+                    rec["blobs"] = blob_recs
+                else:
+                    backend.write_bytes(backend.join(p, fname), data)
+                chunk_recs.append(rec)
+                total_bytes += len(data)
+                total_chunks += 1
+            for start, stop in leaf.remote_chunks:  # pragma: no cover - multihost
+                chunk_recs.append({
+                    "file": _chunk_file_name(n, start),
+                    "start": list(start),
+                    "stop": list(stop),
+                    "nbytes": None,
+                    "sha256": None,
+                })
+            rec = {
+                "shape": list(leaf.shape),
+                "dtype": leaf.dtype,
+                "chunks": chunk_recs,
+            }
+            if leaf.partition is not None:
+                rec["partition"] = leaf.partition
+            index_leaves.append(rec)
+        # All processes' chunks must be on storage before the index/COMMIT
+        # that names them (no-op single-process).
+        _mh_barrier(f"ckpt_chunks:{p}")
         try:
-            import jax as _jax
+            import jax
 
-            nproc = _jax.process_count()
+            process_index = jax.process_index()
         except Exception:  # pragma: no cover - pre-init
-            nproc = 1
-        index = {
-            "format_version": FORMAT_VERSION,
-            "tree": skeleton,
-            "leaves": index_leaves,
-            # Saving-side process layout: consumers (serve/export.py's
-            # manifest topology block) can name the training topology
-            # without probing chunk files.
-            "process_count": nproc,
-        }
-        index_bytes = json.dumps(index, sort_keys=True).encode()
-        backend.write_bytes(backend.join(p, INDEX_NAME), index_bytes)
-        total_bytes += len(index_bytes)
-        commit = {
-            "index_sha256": hashlib.sha256(index_bytes).hexdigest(),
-            "chunks": total_chunks,
-            "bytes": total_bytes,
-        }
-        backend.write_bytes(
-            backend.join(p, COMMIT_NAME), json.dumps(commit).encode()
-        )
+            process_index = 0
+        if process_index == 0:
+            try:
+                import jax as _jax
+
+                nproc = _jax.process_count()
+            except Exception:  # pragma: no cover - pre-init
+                nproc = 1
+            index = {
+                "format_version": FORMAT_VERSION,
+                "tree": skeleton,
+                "leaves": index_leaves,
+                # Saving-side process layout: consumers (serve/export.py's
+                # manifest topology block) can name the training topology
+                # without probing chunk files.
+                "process_count": nproc,
+            }
+            if cas is not None:
+                # GC root BEFORE visibility: the ref lands ahead of the
+                # index/COMMIT so a committed generation is always
+                # reachable, while a save that dies here leaves only an
+                # unreferenced ref + pinned-then-released blobs — plain
+                # GC food, invisible to readers.
+                manifest_digest = cas.put_manifest({
+                    "kind": "ckpt-generation",
+                    "path": p,
+                    store_lib.MANIFEST_CHUNKS_KEY: sorted(set(gen_digests)),
+                })
+                pin.add(manifest_digest)
+                cas.set_ref(
+                    store_lib.ref_name_for_path("ckpt", p),
+                    manifest_digest,
+                    meta={"path": p, "kind": "ckpt-generation"},
+                )
+                index["store"] = {"root": cas.root, "version": 1}
+            index_bytes = json.dumps(index, sort_keys=True).encode()
+            backend.write_bytes(backend.join(p, INDEX_NAME), index_bytes)
+            total_bytes += len(index_bytes)
+            commit = {
+                "index_sha256": hashlib.sha256(index_bytes).hexdigest(),
+                "chunks": total_chunks,
+                "bytes": total_bytes,
+            }
+            backend.write_bytes(
+                backend.join(p, COMMIT_NAME), json.dumps(commit).encode()
+            )
+    finally:
+        if pin is not None:
+            pin.release()
     return total_bytes, total_chunks
 
 
@@ -437,24 +518,51 @@ def is_committed(path: str) -> bool:
 
 class _ChunkReader:
     """Lazy, cached, checksum-verifying chunk access for one generation —
-    a restore touches only the chunk files its target sharding needs."""
+    a restore touches only the chunk payloads its target sharding needs
+    (``*.chunk`` files, or the content-store blobs a CAS-mode chunk
+    record names — never both)."""
 
-    def __init__(self, path: str, verify: bool = True):
+    def __init__(self, path: str, verify: bool = True,
+                 store_root: Optional[str] = None):
         self.backend, self.base = get_storage(path)
         self.verify = verify
         self._cache: Dict[str, np.ndarray] = {}
         self.bytes_read = 0
+        self._store = (
+            store_lib.get_store(store_root) if store_root else None
+        )
+
+    def _chunk_bytes(self, rec: Dict[str, Any], fname: str) -> bytes:
+        blobs = rec.get("blobs")
+        if blobs:
+            if self._store is None:
+                raise CheckpointCorruptionError(
+                    f"chunk {fname} under {self.base} is stored as content "
+                    f"blobs but the index names no store root"
+                )
+            pieces: List[bytes] = []
+            for b in blobs:
+                piece = self._store.get_blob(b["h"])
+                if piece is None:
+                    raise CheckpointCorruptionError(
+                        f"missing blob {b['h'][:12]}... for chunk {fname} "
+                        f"under {self.base} (store {self._store.root})"
+                    )
+                pieces.append(piece)
+            return b"".join(pieces)
+        data = self.backend.read_bytes(self.backend.join(self.base, fname))
+        if data is None:
+            raise CheckpointCorruptionError(
+                f"missing chunk {fname} under {self.base}"
+            )
+        return data
 
     def chunk_array(self, rec: Dict[str, Any], dtype, shape) -> np.ndarray:
         fname = rec["file"]
         arr = self._cache.get(fname)
         if arr is not None:
             return arr
-        data = self.backend.read_bytes(self.backend.join(self.base, fname))
-        if data is None:
-            raise CheckpointCorruptionError(
-                f"missing chunk {fname} under {self.base}"
-            )
+        data = self._chunk_bytes(rec, fname)
         self.bytes_read += len(data)
         if self.verify and rec.get("sha256") is not None:
             if hashlib.sha256(data).hexdigest() != rec["sha256"]:
@@ -564,7 +672,10 @@ def load_sharded(
     index = read_index(path, verify=verify)
     if index is None:
         return None
-    reader = _ChunkReader(path, verify=verify)
+    reader = _ChunkReader(
+        path, verify=verify,
+        store_root=(index.get("store") or {}).get("root"),
+    )
     leaves = index["leaves"]
 
     def rebuild(node, parts: Tuple[str, ...]):
@@ -602,9 +713,20 @@ def list_files(path: str) -> List[str]:
 
 def delete_generation(path: str) -> int:
     """Remove a generation directory and everything in it (COMMIT first, so
-    a reader racing the delete sees 'uncommitted', never 'torn').  Returns
-    the number of files removed."""
+    a reader racing the delete sees 'uncommitted', never 'torn'), then its
+    content-store ref — a deleted generation whose ref lingered would
+    retain its blobs forever (the ``gc_retained`` ref-leak runbook
+    signal).  Returns the number of files removed."""
     backend, p = get_storage(path)
+    recorded_root = None
+    index_raw = backend.read_bytes(backend.join(p, INDEX_NAME))
+    if index_raw is not None:
+        try:
+            recorded_root = (
+                json.loads(index_raw).get("store") or {}
+            ).get("root")
+        except ValueError:
+            recorded_root = None
     names = backend.listdir(p)
     ordered = sorted(names, key=lambda n: (n != COMMIT_NAME, n))
     removed = 0
@@ -618,7 +740,151 @@ def delete_generation(path: str) -> int:
             os.rmdir(p)
         except OSError:
             pass
+    _drop_store_ref(p, recorded_root)
     return removed
+
+
+def _drop_store_ref(path: str, recorded_root: Optional[str]) -> None:
+    """Best-effort: delete the ``ckpt-*`` ref a generation at ``path``
+    registered.  Tries the root its index recorded, then the default root
+    for the path (a pre-index failure can leave a ref with no index)."""
+    roots: List[str] = []
+    if recorded_root:
+        roots.append(recorded_root)
+    try:
+        fallback = store_lib.store_root_for(path)
+        if fallback not in roots:
+            roots.append(fallback)
+    except Exception:  # noqa: BLE001 - ref cleanup must never fail a delete
+        pass
+    name = store_lib.ref_name_for_path("ckpt", path)
+    for root in roots:
+        try:
+            cas = store_lib.get_store(root)
+            if cas.read_ref(name) is not None:
+                cas.delete_ref(name)
+        except Exception:  # noqa: BLE001 - ref cleanup must never fail a delete
+            continue
+
+
+class _NotRefCopyable(Exception):
+    """Internal: the source generation has chunk payloads outside the
+    content store (legacy layout / multihost save)."""
+
+
+def ref_copy_subtree(
+    src_path: str,
+    dst_path: str,
+    keys: Sequence[str] = ("params", "batch_stats"),
+) -> Optional[Dict[str, Any]]:
+    """Publish a COMMITTED generation at ``dst_path`` whose chunk table
+    names the SAME content-store blobs as ``src_path``'s sub-tree under
+    ``keys`` — a metadata-only export: zero chunk payload bytes move,
+    only a new manifest, ref, index and COMMIT.
+
+    Returns ``{"chunks", "bytes_logical", "store_root", "path"}`` on
+    success; None when the source cannot be ref-copied (legacy chunk-file
+    layout, no store record, or no ``params`` sub-tree) — callers fall
+    back to the load-and-reserialize path.  Raises
+    :class:`CheckpointCorruptionError` when the source is torn or its
+    blobs are missing (a ref-copy must never publish dangling digests).
+
+    The destination registers its OWN ref in the SOURCE's store, so
+    pruning the source generation later cannot strand the export: GC
+    walks the destination's manifest and retains every shared blob.
+    """
+    index = read_index(src_path)
+    if index is None:
+        return None
+    root = (index.get("store") or {}).get("root")
+    if not root:
+        return None
+    tree = index.get("tree")
+    if not isinstance(tree, dict):
+        return None
+    sub = {k: tree[k] for k in keys if k in tree}
+    if "params" not in sub:
+        return None
+    src_leaves = index["leaves"]
+    new_leaves: List[Dict[str, Any]] = []
+    digests: List[str] = []
+    bytes_logical = 0
+    nchunks = 0
+
+    def renumber(node):
+        nonlocal bytes_logical, nchunks
+        if isinstance(node, dict) and set(node) == {_LEAF_KEY}:
+            rec = src_leaves[int(node[_LEAF_KEY])]
+            if not rec.get("literal"):
+                for chunk in rec["chunks"]:
+                    blobs = chunk.get("blobs")
+                    if not blobs:
+                        raise _NotRefCopyable()
+                    digests.extend(b["h"] for b in blobs)
+                    bytes_logical += int(chunk.get("nbytes") or 0)
+                    nchunks += 1
+            new_leaves.append(rec)
+            return {_LEAF_KEY: len(new_leaves) - 1}
+        if isinstance(node, dict):
+            return {k: renumber(v) for k, v in node.items()}
+        if isinstance(node, list):
+            return [renumber(v) for v in node]
+        return node
+
+    try:
+        new_tree = renumber(sub)
+    except _NotRefCopyable:
+        return None
+
+    cas = store_lib.get_store(root)
+    unique = sorted(set(digests))
+    missing = [d for d in unique if not cas.has_blob(d)]
+    if missing:
+        raise CheckpointCorruptionError(
+            f"ref-copy source {src_path} names {len(missing)} missing "
+            f"blob(s) under {root} (first: {missing[0][:12]}...)"
+        )
+    backend, dst = get_storage(dst_path)
+    backend.delete(backend.join(dst, COMMIT_NAME))
+    with cas.pin() as pin:
+        for d in unique:
+            pin.add(d)
+        manifest_digest = cas.put_manifest({
+            "kind": "ckpt-refcopy",
+            "path": dst,
+            "source": get_storage(src_path)[1],
+            store_lib.MANIFEST_CHUNKS_KEY: unique,
+        })
+        pin.add(manifest_digest)
+        cas.set_ref(
+            store_lib.ref_name_for_path("ckpt", dst),
+            manifest_digest,
+            meta={"path": dst, "kind": "ckpt-refcopy"},
+        )
+        new_index = {
+            "format_version": FORMAT_VERSION,
+            "tree": new_tree,
+            "leaves": new_leaves,
+            "process_count": 1,
+            "store": {"root": root, "version": 1},
+        }
+        index_bytes = json.dumps(new_index, sort_keys=True).encode()
+        backend.write_bytes(backend.join(dst, INDEX_NAME), index_bytes)
+        commit = {
+            "index_sha256": hashlib.sha256(index_bytes).hexdigest(),
+            "chunks": nchunks,
+            "bytes": bytes_logical + len(index_bytes),
+        }
+        backend.write_bytes(
+            backend.join(dst, COMMIT_NAME), json.dumps(commit).encode()
+        )
+    store_lib.get_metrics().add("ref_copies", nchunks)
+    return {
+        "chunks": nchunks,
+        "bytes_logical": bytes_logical,
+        "store_root": root,
+        "path": dst,
+    }
 
 
 def saved_partition_specs(path: str) -> Optional[Dict[str, Any]]:

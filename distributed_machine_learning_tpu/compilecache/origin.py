@@ -107,18 +107,39 @@ class ArtifactRegistry:
       this.
     * ``origin_fetch_hits`` / ``origin_fetch_misses`` — fetches answered
       with / without files.
+
+    With ``store`` (a ``store.ContentStore``), artifact bytes live as
+    content-addressed blobs instead of head RAM: each key's files map to
+    a manifest blob behind a ``compile-<hash(key)>`` ref, so executables
+    and their cost sidecars dedup against anything else in the store,
+    survive a head restart (a resumed driver re-fetches by ref), and are
+    garbage-collected by the same reachability walk as checkpoints.  The
+    ``max_bytes`` eviction only applies to the in-RAM mode — store-backed
+    lifecycle belongs to GC (drop the ref, sweep the blobs).
     """
 
-    def __init__(self, max_bytes: int = 256 * 1024 * 1024):
+    def __init__(
+        self, max_bytes: int = 256 * 1024 * 1024, store=None
+    ):
         self._lock = named_lock("compilecache.origin")
         self._by_key: Dict[str, Dict[str, bytes]] = {}
         self._bytes = 0
         self._max_bytes = max_bytes
+        self._store = store
+        # Store mode: key -> {file name: blob digest} (the manifest's
+        # ``files`` map, memoized; the ref is the durable copy).
+        self._manifests: Dict[str, Dict[str, str]] = {}
         self.counters: Dict[str, int] = {
             "origin_publishes": 0,
             "origin_fetch_hits": 0,
             "origin_fetch_misses": 0,
         }
+
+    @staticmethod
+    def _ref_name(key: str):
+        from distributed_machine_learning_tpu import store as store_lib
+
+        return store_lib.ref_name_for_path("compile", key)
 
     def publish(self, key: str, files: Dict[str, bytes]) -> bool:
         """Accept a worker's published artifacts.  First publish per key
@@ -128,6 +149,8 @@ class ArtifactRegistry:
             return False
         size = sum(len(b) for b in files.values())
         with self._lock:
+            if self._store is not None:
+                return self._publish_store(key, files)
             if key in self._by_key:
                 return False
             if self._bytes + size > self._max_bytes:
@@ -144,8 +167,58 @@ class ArtifactRegistry:
             self.counters["origin_publishes"] += 1
             return True
 
+    def _publish_store(self, key: str, files: Dict[str, bytes]) -> bool:
+        from distributed_machine_learning_tpu import store as store_lib
+
+        if key in self._manifests:
+            return False
+        ref_name = self._ref_name(key)
+        if self._store.read_ref(ref_name) is not None:
+            # A previous head incarnation already published this key —
+            # adopt its manifest instead of re-publishing.
+            mapping = self._mapping_from_ref(ref_name)
+            if mapping is not None:
+                self._manifests[key] = mapping
+            return False
+        with self._store.pin() as pin:
+            mapping: Dict[str, str] = {}
+            for name, data in files.items():
+                digest = self._store.put_blob(data)
+                pin.add(digest)
+                mapping[name] = digest
+            manifest_digest = self._store.put_manifest({
+                "kind": "compile-artifacts",
+                "key": key,
+                "files": mapping,
+                store_lib.MANIFEST_CHUNKS_KEY: sorted(set(mapping.values())),
+            })
+            pin.add(manifest_digest)
+            self._store.set_ref(ref_name, manifest_digest, meta={"key": key})
+        self._manifests[key] = mapping
+        self.counters["origin_publishes"] += 1
+        return True
+
+    def _mapping_from_ref(self, ref_name: str) -> Optional[Dict[str, str]]:
+        doc = self._store.read_ref(ref_name)
+        if not doc:
+            return None
+        manifest = self._store.read_manifest(doc.get("manifest"))
+        if not manifest:
+            return None
+        mapping = manifest.get("files")
+        if not isinstance(mapping, dict):
+            return None
+        return {str(k): str(v) for k, v in mapping.items()}
+
     def fetch(self, key: str) -> Optional[Dict[str, bytes]]:
         with self._lock:
+            if self._store is not None:
+                files = self._fetch_store(key)
+                if files is not None:
+                    self.counters["origin_fetch_hits"] += 1
+                    return files
+                self.counters["origin_fetch_misses"] += 1
+                return None
             files = self._by_key.get(key)
             if files:
                 self.counters["origin_fetch_hits"] += 1
@@ -153,10 +226,40 @@ class ArtifactRegistry:
             self.counters["origin_fetch_misses"] += 1
             return None
 
+    def _fetch_store(self, key: str) -> Optional[Dict[str, bytes]]:
+        mapping = self._manifests.get(key)
+        if mapping is None:
+            mapping = self._mapping_from_ref(self._ref_name(key))
+            if mapping is None:
+                return None
+            self._manifests[key] = mapping
+        files: Dict[str, bytes] = {}
+        for name, digest in mapping.items():
+            data = self._store.get_blob(digest)
+            if data is None:
+                # A swept/damaged blob: the worker falls back to a local
+                # compile, exactly like a plain miss.
+                return None
+            files[name] = data
+        return files
+
     def keys(self) -> List[str]:
         with self._lock:
-            return sorted(self._by_key)
+            if self._store is None:
+                return sorted(self._by_key)
+            known = set(self._manifests)
+            for name in self._store.list_refs():
+                if not name.startswith("compile-"):
+                    continue
+                doc = self._store.read_ref(name)
+                key = ((doc or {}).get("meta") or {}).get("key")
+                if key:
+                    known.add(str(key))
+            return sorted(known)
 
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
-            return dict(self.counters, distinct_keys=len(self._by_key))
+            distinct = len(
+                self._manifests if self._store is not None else self._by_key
+            )
+            return dict(self.counters, distinct_keys=distinct)

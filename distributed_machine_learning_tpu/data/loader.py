@@ -212,6 +212,83 @@ def _atomic_np_save(path: str, arr: np.ndarray) -> None:
     os.replace(tmp, path)  # atomic: readers see whole files or nothing
 
 
+def _windowed_via_store(cache_dir, key, build, counters):
+    """Content-store variant of the window cache: the ``.npy`` payloads
+    are published as blobs under ``<cache_dir>/.cas`` behind a
+    ``dataset-win-<key>`` ref, so identical window products dedup against
+    each other (and against anything else in a shared ``$DML_STORE_ROOT``)
+    and unreferenced products are swept by the same reachability GC as
+    checkpoints.  Readers still mmap the blob file directly — same page-
+    cache sharing as the legacy ``win_*.npy`` layout.
+
+    Returns ``(xw, yw)``, or None when the store path is unavailable
+    (store disabled, or a non-mmappable remote scheme) — the caller then
+    falls back to the legacy flat-file cache.
+    """
+    from distributed_machine_learning_tpu import store as store_lib
+
+    if not store_lib.store_enabled():
+        return None
+    cas = store_lib.get_store(
+        store_lib.store_root_for(os.path.join(cache_dir, "win"))
+    )
+    if "://" in cas.root and not cas.root.startswith("file://"):
+        return None  # mmap consumers need a real local file
+    ref_name = f"dataset-win-{key}"
+
+    def _open(mapping):
+        arrays = []
+        for part in ("x", "y"):
+            digest = mapping.get(part)
+            path = cas.local_blob_path(digest) if digest else None
+            if path is None:
+                return None
+            try:
+                arrays.append(np.load(path, mmap_mode="r"))
+            except (OSError, ValueError):
+                return None
+        return tuple(arrays)
+
+    doc = cas.read_ref(ref_name)
+    if doc:
+        manifest = cas.read_manifest(doc.get("manifest")) or {}
+        got = _open(manifest.get("files") or {})
+        if got is not None:
+            counters.add("dataset_cache_hits")
+            counters.add(
+                "dataset_cache_bytes",
+                int(got[0].nbytes) + int(got[1].nbytes),
+            )
+            return got
+    counters.add("dataset_cache_misses")
+    xw, yw = build()
+    try:
+        import io
+
+        with cas.pin() as pin:
+            mapping = {}
+            for part, arr in (("x", xw), ("y", yw)):
+                buf = io.BytesIO()
+                np.save(buf, np.ascontiguousarray(arr))
+                digest = cas.put_blob(buf.getvalue())
+                pin.add(digest)
+                mapping[part] = digest
+            manifest_digest = cas.put_manifest({
+                "kind": "dataset-window",
+                "key": key,
+                "files": mapping,
+                store_lib.MANIFEST_CHUNKS_KEY: sorted(set(mapping.values())),
+            })
+            pin.add(manifest_digest)
+            cas.set_ref(ref_name, manifest_digest, meta={"key": key})
+        got = _open(mapping)
+        if got is not None:
+            return got
+    except (OSError, ValueError):
+        pass  # cache write failure must never fail a build
+    return xw, yw
+
+
 def _windowed_arrays(
     x: np.ndarray,
     y: np.ndarray,
@@ -250,6 +327,9 @@ def _windowed_arrays(
 
     counters = get_host_input_counters()
     key = _window_cache_key(x, y, interval, stride, standardize, nan_policy)
+    via_store = _windowed_via_store(cache_dir, key, build, counters)
+    if via_store is not None:
+        return via_store
     os.makedirs(cache_dir, exist_ok=True)
     fx = os.path.join(cache_dir, f"win_{key}_x.npy")
     fy = os.path.join(cache_dir, f"win_{key}_y.npy")

@@ -34,6 +34,10 @@ from distributed_machine_learning_tpu.tune.storage import get_storage
 BUNDLE_VERSION = 1
 MANIFEST_NAME = "bundle.json"
 PARAMS_NAME = "params.msgpack"
+# Ref-copied params: a committed sharded "generation" whose chunk table
+# points at the SAME content-store blobs the source checkpoint published
+# — export moves metadata, not params (see ckpt.format.ref_copy_subtree).
+PARAMS_CAS_NAME = "params.cas"
 
 
 @dataclass
@@ -164,22 +168,43 @@ def export_bundle(
         ckpt_path, _ = ckpt_lib.find_latest_checkpoint(
             backend.join(root, trial.trial_id, "checkpoints")
         )
-    # load_checkpoint handles both formats: a sharded ``gen_NNNNNN``
-    # generation (any mesh/device count wrote it) GATHERS to full host
-    # arrays via the resharding restore — the bundle is always a
-    # single-host artifact a serving process loads without a mesh.
     t_load = time.time()
-    ckpt = ckpt_lib.load_checkpoint(ckpt_path) if ckpt_path else None
-    ckpt_load_s = time.time() - t_load
-    if ckpt is None or "params" not in ckpt:
-        raise ValueError(
-            f"trial {trial.trial_id} has no restorable checkpoint "
-            f"(path={ckpt_path!r}); run with checkpointing enabled"
-        )
+    # Fast path: an f32 export of a committed CAS-mode sharded generation
+    # is a REF-COPY — the bundle's params.cas names the same blobs the
+    # checkpoint already published, so zero param-chunk bytes move and
+    # nothing is deserialized.  Quantized exports (precision != f32) must
+    # transform values, so they always take the load path below.
+    cas_export = None
+    if precision == "f32" and ckpt_path and _is_sharded_source(ckpt_path):
+        from distributed_machine_learning_tpu.ckpt import format as _fmt
 
-    variables: Dict[str, Any] = {"params": ckpt["params"]}
-    if ckpt.get("batch_stats"):
-        variables["batch_stats"] = ckpt["batch_stats"]
+        backend_out, out = get_storage(out_dir)
+        try:
+            cas_export = _fmt.ref_copy_subtree(
+                ckpt_path,
+                backend_out.join(out, PARAMS_CAS_NAME),
+                ("params", "batch_stats"),
+            )
+        except _fmt.CheckpointCorruptionError:
+            # Torn/damaged source: fall through to the load path, which
+            # raises the same corruption the pre-CAS export surfaced.
+            cas_export = None
+    variables: Dict[str, Any] = {}
+    if cas_export is None:
+        # load_checkpoint handles both formats: a sharded ``gen_NNNNNN``
+        # generation (any mesh/device count wrote it) GATHERS to full host
+        # arrays via the resharding restore — the bundle is always a
+        # single-host artifact a serving process loads without a mesh.
+        ckpt = ckpt_lib.load_checkpoint(ckpt_path) if ckpt_path else None
+        if ckpt is None or "params" not in ckpt:
+            raise ValueError(
+                f"trial {trial.trial_id} has no restorable checkpoint "
+                f"(path={ckpt_path!r}); run with checkpointing enabled"
+            )
+        variables = {"params": ckpt["params"]}
+        if ckpt.get("batch_stats"):
+            variables["batch_stats"] = ckpt["batch_stats"]
+    ckpt_load_s = time.time() - t_load
 
     score = analysis._score(trial)
     manifest = {
@@ -222,8 +247,42 @@ def export_bundle(
         variables = quant_block.pop("_variables")
         manifest["quant"] = quant_block
 
+    if cas_export is not None:
+        manifest["params_file"] = PARAMS_CAS_NAME
+        manifest["source"]["ref_copy"] = {
+            "chunks": cas_export["chunks"],
+            "bytes_logical": cas_export["bytes_logical"],
+            "store_root": cas_export["store_root"],
+        }
+        _write_cas_bundle_manifest(out_dir, manifest)
+        return out_dir
     write_bundle(out_dir, manifest, variables)
     return out_dir
+
+
+def _write_cas_bundle_manifest(
+    out_dir: str, manifest: Dict[str, Any]
+) -> None:
+    """Finish a ref-copied bundle: write ``bundle.json`` next to the
+    already-committed ``params.cas`` and apply the export-corruption
+    chaos hook to the params INDEX (the COMMIT's sha then refuses it at
+    gate-load time — the same torn-export shape the msgpack path has)."""
+    backend, out = get_storage(out_dir)
+    backend.write_bytes(
+        backend.join(out, MANIFEST_NAME),
+        json.dumps(manifest, indent=2).encode(),
+    )
+    from distributed_machine_learning_tpu import chaos
+    from distributed_machine_learning_tpu.ckpt import format as _fmt
+
+    plan = chaos.active_plan()
+    if plan is not None:
+        index_path = backend.join(out, PARAMS_CAS_NAME, _fmt.INDEX_NAME)
+        raw = backend.read_bytes(index_path)
+        if raw is not None:
+            damaged = plan.corrupt_bundle_export(index_path, raw)
+            if damaged is not raw:
+                backend.write_bytes(index_path, damaged)
 
 
 def write_bundle(
@@ -349,12 +408,17 @@ def load_bundle(bundle_dir: str, mesh=None) -> ServableBundle:
             f"bundle at {bundle_dir!r} has version {version!r}; this "
             f"build reads version {BUNDLE_VERSION}"
         )
+    # Ref-copied bundles record their params layout in the manifest;
+    # pre-CAS bundles (no key) read as params.msgpack.  load_checkpoint
+    # dispatches on the layout itself (params.cas is a committed sharded
+    # generation; the gather to host arrays is bit-identical).
+    params_file = str(manifest.get("params_file") or PARAMS_NAME)
     t_load = time.time()
-    variables = ckpt_lib.load_checkpoint(backend.join(d, PARAMS_NAME))
+    variables = ckpt_lib.load_checkpoint(backend.join(d, params_file))
     checkpoint_load_s = time.time() - t_load
     if variables is None or "params" not in variables:
         raise FileNotFoundError(
-            f"bundle at {bundle_dir!r} is missing {PARAMS_NAME}"
+            f"bundle at {bundle_dir!r} is missing {params_file}"
         )
     config = dict(manifest.get("config", {}))
     if mesh is not None:

@@ -1,0 +1,63 @@
+"""store/ — the content-addressed store (ISSUE 20, ROADMAP item 4).
+
+Public surface:
+
+* :class:`ContentStore` / :func:`get_store` — blobs, refs, manifests,
+  pin-then-scan GC, verify, stats.
+* :func:`store_root_for` / :func:`store_enabled` /
+  :func:`ref_name_for_path` — where a writer's store lives and whether
+  the CAS write paths are on.
+* :func:`split_row_aligned` / :func:`target_piece_bytes` — the dedup
+  chunking contract.
+* :func:`get_metrics` — the ``store`` counter family
+  (``puts``, ``dedup_hits``, ``bytes_logical``, ``bytes_physical``,
+  ``gc_collected``, ``gc_retained``, ...).
+"""
+
+from distributed_machine_learning_tpu.store.chunker import (
+    CHUNK_BYTES_ENV_VAR,
+    DEFAULT_TARGET_PIECE_BYTES,
+    split_row_aligned,
+    target_piece_bytes,
+)
+from distributed_machine_learning_tpu.store.core import (
+    BLOBS_DIR,
+    ENABLE_ENV_VAR,
+    MANIFEST_CHUNKS_KEY,
+    REFS_DIR,
+    ROOT_ENV_VAR,
+    STORE_DIR_NAME,
+    ContentStore,
+    PinSession,
+    StoreCorruptionError,
+    get_store,
+    ref_name_for_path,
+    store_enabled,
+    store_root_for,
+)
+from distributed_machine_learning_tpu.store.metrics import (
+    StoreMetrics,
+    get_metrics,
+)
+
+__all__ = [
+    "BLOBS_DIR",
+    "CHUNK_BYTES_ENV_VAR",
+    "DEFAULT_TARGET_PIECE_BYTES",
+    "ENABLE_ENV_VAR",
+    "MANIFEST_CHUNKS_KEY",
+    "REFS_DIR",
+    "ROOT_ENV_VAR",
+    "STORE_DIR_NAME",
+    "ContentStore",
+    "PinSession",
+    "StoreCorruptionError",
+    "StoreMetrics",
+    "get_metrics",
+    "get_store",
+    "ref_name_for_path",
+    "split_row_aligned",
+    "store_enabled",
+    "store_root_for",
+    "target_piece_bytes",
+]

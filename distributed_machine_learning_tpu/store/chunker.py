@@ -1,0 +1,66 @@
+"""Row-aligned sub-chunking: the piece boundaries that make dedup land.
+
+A checkpoint chunk is the raw bytes of one shard of one leaf.  Splitting
+it at arbitrary byte offsets would make dedup brittle — a one-row change
+in a PBT exploit shifts nothing, but piece boundaries that ignore the
+array's row structure turn "one row changed" into "every piece changed"
+the moment shapes differ between writers.  Splitting at LEADING-AXIS row
+boundaries instead means a donor row copied between population members,
+or an optimizer leaf untouched between generation N and N+1, hashes to
+the same blob every time: content addressing does the rest.
+
+``rows_per_piece = max(1, target_piece_bytes // row_stride)`` — small
+leaves become a single piece (no pathological per-row blob explosion),
+large leaves split near the target size so a local edit dirties one
+piece, not the whole leaf.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Tuple
+
+DEFAULT_TARGET_PIECE_BYTES = 256 * 1024
+CHUNK_BYTES_ENV_VAR = "DML_STORE_CHUNK_BYTES"
+
+
+def target_piece_bytes() -> int:
+    """The configured piece-size target (``DML_STORE_CHUNK_BYTES``,
+    default 256 KiB); values < 1 fall back to the default."""
+    raw = os.environ.get(CHUNK_BYTES_ENV_VAR)
+    if not raw:
+        return DEFAULT_TARGET_PIECE_BYTES
+    try:
+        val = int(raw)
+    except ValueError:
+        return DEFAULT_TARGET_PIECE_BYTES
+    return val if val >= 1 else DEFAULT_TARGET_PIECE_BYTES
+
+
+def split_row_aligned(
+    nbytes: int, row_stride: int, target: int = 0
+) -> List[Tuple[int, int]]:
+    """``(offset, length)`` piece spans covering ``[0, nbytes)``.
+
+    ``row_stride`` is the byte width of one leading-axis row (0 for
+    scalars / unknown layout -> a single piece).  Pieces are whole
+    multiples of ``row_stride`` except the last, which absorbs any tail.
+    """
+    if nbytes <= 0:
+        return []
+    target = target if target > 0 else target_piece_bytes()
+    if row_stride <= 0 or row_stride >= nbytes:
+        return [(0, nbytes)]
+    rows_per_piece = max(1, target // row_stride)
+    piece = rows_per_piece * row_stride
+    spans: List[Tuple[int, int]] = []
+    off = 0
+    while off < nbytes:
+        ln = min(piece, nbytes - off)
+        # The final fragment shorter than one row rides with its
+        # predecessor so every boundary except EOF is row-aligned.
+        if 0 < nbytes - (off + ln) < row_stride:
+            ln = nbytes - off
+        spans.append((off, ln))
+        off += ln
+    return spans

@@ -1,0 +1,480 @@
+"""One content-addressed store for every byte-durability scheme.
+
+Five subsystems invented storage-with-integrity independently (ckpt chunk
+sha256 + COMMIT, compilecache's ArtifactRegistry, serve bundle manifests,
+the sha256-keyed dataset cache, obs exports).  This module is the single
+layer they now share.  A store rooted at ``<root>`` (any ``tune.storage``
+scheme) holds::
+
+    <root>/blobs/<hh>/<sha256>   immutable blobs, named by their content
+                                 hash (first-publish-wins; a re-publish of
+                                 identical bytes is a dedup hit, not a
+                                 write), fsync'd on local filesystems
+    <root>/refs/<name>           small mutable JSON refs, updated via the
+                                 backend's tmp+os.replace write (the
+                                 DML020 contract) — each names a manifest
+
+A *manifest* is itself a blob: a JSON object whose ``store_chunks`` key
+flat-lists every blob digest the referencing object needs.  Reachability
+is therefore one hop deep and schema-agnostic: GC walks refs ->
+manifests -> chunks and never needs to understand checkpoint indexes,
+compile-artifact packs, or dataset caches.
+
+GC is pin-then-scan: a writer opens a :meth:`ContentStore.pin` session
+and registers every digest BEFORE its ref lands, and the collector
+snapshots the pin table BEFORE scanning blobs — so a publish racing a
+sweep keeps its new blobs even though no ref names them yet.  An
+optional ``min_age_s`` grace additionally protects blobs written by
+*other* processes (local scheme only, where mtimes exist).
+
+Retry/chaos/fallback is not reimplemented here: every byte moves through
+``tune.storage.get_storage``, so the chaos ``FaultyStorage`` wrapper and
+``RetryingStorage`` compose around the store exactly as they do around
+checkpoints.  Two store-specific chaos hooks ride the active plan:
+``blob_corrupt_on_publish`` (a published blob's bytes no longer match
+its name — ``verify`` must catch it) and ``kill_during_ref_flip`` (the
+writer dies between preparing and landing a ref — the OLD ref survives
+intact, the atomicity contract).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import posixpath
+import re
+import time
+from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
+
+from distributed_machine_learning_tpu.analysis.locks import named_lock
+from distributed_machine_learning_tpu.store.metrics import get_metrics
+from distributed_machine_learning_tpu.tune.storage import get_storage
+
+BLOBS_DIR = "blobs"
+REFS_DIR = "refs"
+MANIFEST_CHUNKS_KEY = "store_chunks"
+STORE_DIR_NAME = ".cas"
+
+ROOT_ENV_VAR = "DML_STORE_ROOT"
+ENABLE_ENV_VAR = "DML_STORE_CKPT"
+
+_DIGEST_RE = re.compile(r"^[0-9a-f]{64}$")
+_REF_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._\-]*$")
+
+
+class StoreCorruptionError(Exception):
+    """Stored blob bytes no longer hash to their name."""
+
+
+def store_enabled() -> bool:
+    """Whether checkpoint/export write paths publish through the store
+    (``DML_STORE_CKPT``; default on — ``0`` restores the pre-CAS path,
+    which is also what the bench ``store`` section compares against)."""
+    return os.environ.get(ENABLE_ENV_VAR, "1") not in ("0", "false", "no")
+
+
+def store_root_for(path: str) -> str:
+    """The store root serving ``path``: ``$DML_STORE_ROOT`` when set
+    (one experiment-wide store -> cross-trial dedup), else a ``.cas``
+    sibling of ``path`` (``<parent>/.cas`` — one store per checkpoint
+    directory, which is where generation chains and PBT populations
+    already share bytes)."""
+    env = os.environ.get(ROOT_ENV_VAR)
+    if env:
+        return env
+    backend, p = get_storage(str(path))
+    parent = posixpath.dirname(p.rstrip("/")) or p
+    return backend.join(parent, STORE_DIR_NAME)
+
+
+def ref_name_for_path(kind: str, path: str) -> str:
+    """Deterministic flat ref name for an object at ``path`` —
+    re-computable by anyone who knows the path (delete paths, GC tools)."""
+    digest = hashlib.sha256(str(path).rstrip("/").encode()).hexdigest()
+    return f"{kind}-{digest[:24]}"
+
+
+# -- pin table (process-global, keyed by store root) ---------------------------
+
+_pin_lock = named_lock("store.pins")
+_pin_table: Dict[str, Dict[int, Set[str]]] = {}
+_pin_seq = [0]
+
+
+class PinSession:
+    """In-flight publish protection: digests added here are invisible to
+    GC's collectable set until the session closes (which the writer does
+    only AFTER its ref landed)."""
+
+    def __init__(self, root: str):
+        self._root = root
+        with _pin_lock:
+            _pin_seq[0] += 1
+            self._id = _pin_seq[0]
+            _pin_table.setdefault(root, {})[self._id] = set()
+
+    def add(self, digest: str) -> None:
+        with _pin_lock:
+            sessions = _pin_table.get(self._root)
+            if sessions is not None and self._id in sessions:
+                sessions[self._id].add(digest)
+
+    def release(self) -> None:
+        with _pin_lock:
+            sessions = _pin_table.get(self._root)
+            if sessions is not None:
+                sessions.pop(self._id, None)
+                if not sessions:
+                    _pin_table.pop(self._root, None)
+
+    def __enter__(self) -> "PinSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def _pinned_digests(root: str) -> Set[str]:
+    with _pin_lock:
+        out: Set[str] = set()
+        for digests in _pin_table.get(root, {}).values():
+            out |= digests
+        return out
+
+
+# -- the store -----------------------------------------------------------------
+
+
+class ContentStore:
+    """Hash-keyed immutable blobs + atomic mutable refs at one root."""
+
+    def __init__(self, root: str):
+        self.root = str(root)
+
+    # get_storage is consulted PER OPERATION (not cached at construction)
+    # so a chaos plan activated after the store was created still wraps
+    # every byte op — the same late-binding contract ckpt/format.py has.
+    def _be(self) -> Tuple[Any, str]:
+        return get_storage(self.root)
+
+    # -- blobs ---------------------------------------------------------------
+
+    def blob_path(self, digest: str) -> str:
+        backend, p = self._be()
+        return backend.join(p, BLOBS_DIR, digest[:2], digest)
+
+    def local_blob_path(self, digest: str) -> Optional[str]:
+        """Filesystem path of a blob for mmap-style consumers
+        (``np.load(mmap_mode='r')``); None on non-local schemes."""
+        if "://" in self.root and not self.root.startswith("file://"):
+            return None
+        path = self.blob_path(digest)
+        return path if os.path.exists(path) else None
+
+    def has_blob(self, digest: str) -> bool:
+        backend, _ = self._be()
+        return backend.exists(self.blob_path(digest))
+
+    def put_blob(self, data: bytes) -> str:
+        """Publish ``data``; returns its digest.  An existing blob of the
+        same content is a dedup hit — no bytes move."""
+        digest = hashlib.sha256(data).hexdigest()
+        m = get_metrics()
+        m.add("puts")
+        m.add("bytes_logical", len(data))
+        backend, _ = self._be()
+        path = self.blob_path(digest)
+        if backend.exists(path):
+            m.add("dedup_hits")
+            return digest
+        payload = data
+        plan = _active_plan()
+        if plan is not None:
+            payload = plan.corrupt_blob_publish(path, payload)
+        backend.write_bytes(path, payload)
+        self._fsync_local(path)
+        m.add("bytes_physical", len(data))
+        return digest
+
+    def get_blob(self, digest: str, verify: bool = False) -> Optional[bytes]:
+        backend, _ = self._be()
+        data = backend.read_bytes(self.blob_path(digest))
+        if data is None:
+            return None
+        m = get_metrics()
+        m.add("blob_reads")
+        m.add("read_bytes", len(data))
+        if verify and hashlib.sha256(data).hexdigest() != digest:
+            raise StoreCorruptionError(
+                f"blob {digest} under {self.root} fails its content hash"
+            )
+        return data
+
+    def iter_blobs(self) -> Iterator[str]:
+        backend, p = self._be()
+        blobs_dir = backend.join(p, BLOBS_DIR)
+        for prefix in backend.listdir(blobs_dir):
+            if len(prefix) != 2:
+                continue
+            for name in backend.listdir(backend.join(blobs_dir, prefix)):
+                if _DIGEST_RE.match(name):
+                    yield name
+
+    def _blob_size(self, digest: str) -> int:
+        local = self.local_blob_path(digest)
+        if local is not None:
+            try:
+                return os.path.getsize(local)
+            except OSError:
+                return 0
+        backend, _ = self._be()
+        data = backend.read_bytes(self.blob_path(digest))
+        return len(data) if data is not None else 0
+
+    @staticmethod
+    def _fsync_local(path: str) -> None:
+        """Durability for local blobs: the backend's tmp+replace makes the
+        write atomic; fsync makes it survive power loss (fsync flushes the
+        inode's pages regardless of which fd wrote them)."""
+        if not os.path.exists(path):
+            return
+        try:
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass  # non-POSIX corners: atomicity still holds
+
+    # -- manifests -----------------------------------------------------------
+
+    def put_manifest(self, payload: Dict[str, Any]) -> str:
+        """Store ``payload`` (which must flat-list its blob digests under
+        ``store_chunks``) as a manifest blob; returns the manifest digest."""
+        chunks = payload.get(MANIFEST_CHUNKS_KEY)
+        if not isinstance(chunks, list):
+            raise ValueError(
+                f"manifest payload needs a {MANIFEST_CHUNKS_KEY!r} list "
+                f"(got {type(chunks).__name__}) — GC walks it"
+            )
+        return self.put_blob(
+            json.dumps(payload, sort_keys=True).encode()
+        )
+
+    def read_manifest(self, digest: str) -> Optional[Dict[str, Any]]:
+        data = self.get_blob(digest)
+        if data is None:
+            return None
+        try:
+            doc = json.loads(data)
+        except ValueError:
+            return None
+        return doc if isinstance(doc, dict) else None
+
+    # -- refs ----------------------------------------------------------------
+
+    def _ref_path(self, name: str) -> str:
+        if not _REF_NAME_RE.match(name):
+            raise ValueError(f"invalid ref name {name!r}")
+        backend, p = self._be()
+        return backend.join(p, REFS_DIR, name)
+
+    def set_ref(
+        self,
+        name: str,
+        manifest_digest: str,
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Point ref ``name`` at ``manifest_digest`` — atomically (the
+        backend's tmp+``os.replace`` write), so a reader sees the old
+        target or the new one, never a torn ref."""
+        path = self._ref_path(name)
+        plan = _active_plan()
+        if plan is not None:
+            # kill_during_ref_flip: the writer dies before the replace
+            # lands; the previous ref value must survive untouched.
+            plan.maybe_kill_ref_flip(path)
+        doc = {"manifest": manifest_digest, "updated_at": time.time()}
+        if meta:
+            doc["meta"] = dict(meta)
+        backend, _ = self._be()
+        backend.write_bytes(path, json.dumps(doc, sort_keys=True).encode())
+        self._fsync_local(path)
+        get_metrics().add("ref_updates")
+
+    def read_ref(self, name: str) -> Optional[Dict[str, Any]]:
+        backend, _ = self._be()
+        raw = backend.read_bytes(self._ref_path(name))
+        if raw is None:
+            return None
+        try:
+            doc = json.loads(raw)
+        except ValueError:
+            return None
+        return doc if isinstance(doc, dict) else None
+
+    def delete_ref(self, name: str) -> None:
+        backend, _ = self._be()
+        backend.delete(self._ref_path(name))
+        get_metrics().add("ref_deletes")
+
+    def list_refs(self) -> List[str]:
+        backend, p = self._be()
+        return [
+            n for n in backend.listdir(backend.join(p, REFS_DIR))
+            if _REF_NAME_RE.match(n)
+        ]
+
+    # -- pins ----------------------------------------------------------------
+
+    def pin(self) -> PinSession:
+        return PinSession(self.root)
+
+    # -- reachability / GC ---------------------------------------------------
+
+    def reachable(self) -> Tuple[Set[str], int, int]:
+        """``(live_digests, refs_walked, broken_refs)`` — refs ->
+        manifests -> chunks.  A ref whose manifest is unreadable counts
+        as broken (its chunks cannot be enumerated; ``verify``/restore
+        is the tool that diagnoses it)."""
+        live: Set[str] = set()
+        refs = 0
+        broken = 0
+        for name in self.list_refs():
+            refs += 1
+            doc = self.read_ref(name)
+            if doc is None:
+                broken += 1
+                continue
+            digest = doc.get("manifest")
+            if not isinstance(digest, str):
+                broken += 1
+                continue
+            live.add(digest)
+            manifest = self.read_manifest(digest)
+            if manifest is None:
+                broken += 1
+                continue
+            for chunk in manifest.get(MANIFEST_CHUNKS_KEY) or []:
+                if isinstance(chunk, str):
+                    live.add(chunk)
+        return live, refs, broken
+
+    def gc(
+        self, dry_run: bool = False, min_age_s: float = 0.0
+    ) -> Dict[str, Any]:
+        """Collect unreachable blobs.  Pin-then-scan: the in-process pin
+        table is snapshotted BEFORE refs and blobs are walked, so a
+        publish in flight during the sweep keeps its blobs.  ``min_age_s``
+        additionally retains young blobs (cross-process writers on local
+        storage)."""
+        pinned = _pinned_digests(self.root)
+        live, refs, broken = self.reachable()
+        now = time.time()
+        collected = retained = 0
+        reclaimed = 0
+        backend, _ = self._be()
+        for digest in list(self.iter_blobs()):
+            if digest in live or digest in pinned:
+                retained += 1
+                continue
+            if min_age_s > 0 and self._age_s(digest, now) < min_age_s:
+                retained += 1
+                continue
+            size = self._blob_size(digest)
+            if not dry_run:
+                backend.delete(self.blob_path(digest))
+            collected += 1
+            reclaimed += size
+        m = get_metrics()
+        if not dry_run:
+            m.add("gc_runs")
+            m.add("gc_collected", collected)
+            m.add("gc_retained", retained)
+            m.add("gc_reclaimed_bytes", reclaimed)
+        return {
+            "dry_run": bool(dry_run),
+            "collected": collected,
+            "retained": retained,
+            "reclaimed_bytes": reclaimed,
+            "refs": refs,
+            "broken_refs": broken,
+        }
+
+    def _age_s(self, digest: str, now: float) -> float:
+        local = self.local_blob_path(digest)
+        if local is None:
+            return float("inf")  # no mtimes: pins are the only guard
+        try:
+            return max(0.0, now - os.path.getmtime(local))
+        except OSError:
+            return float("inf")
+
+    # -- audit ---------------------------------------------------------------
+
+    def verify(self) -> Dict[str, Any]:
+        """Re-hash every blob; report the ones whose bytes no longer match
+        their name (bit rot, or a chaos ``blob_corrupt_on_publish``)."""
+        m = get_metrics()
+        checked = 0
+        corrupt: List[str] = []
+        backend, _ = self._be()
+        for digest in self.iter_blobs():
+            data = backend.read_bytes(self.blob_path(digest))
+            if data is None:
+                continue
+            checked += 1
+            m.add("verify_blobs")
+            if hashlib.sha256(data).hexdigest() != digest:
+                corrupt.append(digest)
+                m.add("verify_corrupt")
+        return {"blobs": checked, "corrupt": sorted(corrupt)}
+
+    def stats(self) -> Dict[str, Any]:
+        """Physical truth from storage plus the process counters: blob and
+        ref counts, physical bytes on disk, logical/physical counter bytes
+        and their dedup ratio."""
+        physical = 0
+        blobs = 0
+        for digest in self.iter_blobs():
+            blobs += 1
+            physical += self._blob_size(digest)
+        snap = get_metrics().snapshot()
+        logical = snap.get("bytes_logical", 0)
+        written = snap.get("bytes_physical", 0)
+        return {
+            "root": self.root,
+            "blobs": blobs,
+            "refs": len(self.list_refs()),
+            "physical_bytes": physical,
+            "counters": snap,
+            "dedup_ratio": (
+                round(float(written) / float(logical), 4)
+                if logical else 1.0
+            ),
+        }
+
+
+def _active_plan():
+    from distributed_machine_learning_tpu import chaos
+
+    return chaos.active_plan()
+
+
+# -- store cache ---------------------------------------------------------------
+
+_stores_lock = named_lock("store.instances")
+_stores: Dict[str, ContentStore] = {}
+
+
+def get_store(root: str) -> ContentStore:
+    """The (cached) store rooted at ``root`` — ContentStore carries no
+    open handles, so caching is just identity stability for pin tables."""
+    key = str(root)
+    with _stores_lock:
+        store = _stores.get(key)
+        if store is None:
+            store = _stores[key] = ContentStore(key)
+        return store

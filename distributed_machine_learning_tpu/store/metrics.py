@@ -1,0 +1,91 @@
+"""Process-wide content-store counters.
+
+The CAS perf claim (ISSUE 20) must be *measured*, not architectural:
+``bytes_logical`` counts every byte a writer asked the store to keep,
+``bytes_physical`` only the bytes that actually landed as new blobs —
+their ratio IS the dedup win the bench ``store`` section reports, and a
+``dedup_hits`` that stays 0 across a PBT exploit or a keep-K generation
+chain is the chunking-regression signal the operations runbook keys on.
+
+Registered as the ``store`` family in the unified metrics registry
+(obs/registry.py), same shape as ``ckpt/metrics.py``: flight dumps,
+``/metrics`` and head aggregation see ``store/puts``,
+``store/dedup_hits``, ... for free.  Drivers scope the process-wide
+totals to one run via :meth:`StoreMetrics.delta_since`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from distributed_machine_learning_tpu.analysis.locks import named_lock
+
+
+class StoreMetrics:
+    """Thread-safe counters for content-store activity."""
+
+    _FIELDS = (
+        "puts",                # blob publish attempts (dedup hits included)
+        "dedup_hits",          # publishes answered by an existing blob
+        "bytes_logical",       # bytes writers asked the store to keep
+        "bytes_physical",      # bytes that landed as NEW blob files
+        "blob_reads",
+        "read_bytes",
+        "ref_updates",
+        "ref_deletes",
+        "ref_copies",          # chunks re-published by reference only
+        "gc_runs",
+        "gc_collected",
+        "gc_retained",
+        "gc_reclaimed_bytes",
+        "verify_blobs",
+        "verify_corrupt",
+    )
+
+    def __init__(self):
+        self._lock = named_lock("store.metrics")
+        self._c: Dict[str, float] = {k: 0 for k in self._FIELDS}
+
+    def add(self, name: str, value: float = 1) -> None:
+        with self._lock:
+            self._c[name] = self._c.get(name, 0) + value
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in self._c.items()
+            }
+
+    def delta_since(self, baseline: Dict[str, float]) -> Dict[str, float]:
+        """Counters accumulated since ``baseline`` (a prior snapshot)."""
+        snap = self.snapshot()
+        return {k: round(v - baseline.get(k, 0), 4) for k, v in snap.items()}
+
+    def dedup_ratio(self) -> float:
+        """``bytes_physical / bytes_logical`` (1.0 on an empty store):
+        1.0 = no sharing at all, 0.0 = everything was already stored."""
+        with self._lock:
+            logical = self._c.get("bytes_logical", 0)
+            if logical <= 0:
+                return 1.0
+            return float(self._c.get("bytes_physical", 0)) / float(logical)
+
+    def reset(self) -> None:
+        """Test hook: zero every counter."""
+        with self._lock:
+            self._c = {k: 0 for k in self._FIELDS}
+
+
+_metrics = StoreMetrics()
+
+from distributed_machine_learning_tpu.obs.registry import (  # noqa: E402
+    get_registry as _obs_registry,
+)
+
+_obs_registry().register_family("store", _metrics)
+
+
+def get_metrics() -> StoreMetrics:
+    """The process-wide store counters (one instance per process)."""
+    return _metrics

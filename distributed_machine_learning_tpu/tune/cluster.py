@@ -1470,7 +1470,23 @@ def run_distributed(
         artifacts = artifact_origin
         artifact_origin = True
     else:
-        artifacts = compilecache.ArtifactRegistry()
+        from distributed_machine_learning_tpu import store as store_lib
+
+        # Store-backed registry when the CAS layer is on: executables and
+        # their cost sidecars land as content-addressed blobs under the
+        # experiment root's store (dedup against re-publishes, durable
+        # across a head restart, collected by the same reachability GC as
+        # checkpoints) instead of head RAM.
+        cas = (
+            store_lib.get_store(
+                store_lib.store_root_for(
+                    os.path.join(store.root, "artifacts")
+                )
+            )
+            if store_lib.store_enabled()
+            else None
+        )
+        artifacts = compilecache.ArtifactRegistry(store=cas)
     artifacts_base = artifacts.snapshot()
     store.set_context(metric, mode)
 

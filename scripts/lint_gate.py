@@ -66,6 +66,8 @@ def main(argv=None) -> int:
                    help="skip the head-crash auto-resume smoke")
     p.add_argument("--no-gang-smoke", action="store_true",
                    help="skip the 2-process gang serving smoke")
+    p.add_argument("--no-store-smoke", action="store_true",
+                   help="skip the content-store publish/dedup/gc smoke")
     args = p.parse_args(argv)
 
     cmd = [sys.executable, "-m", "distributed_machine_learning_tpu",
@@ -122,6 +124,10 @@ def main(argv=None) -> int:
             return rc
     if proc.returncode == 0 and not args.no_gang_smoke:
         rc = _gang_serve_smoke(env)
+        if rc:
+            return rc
+    if proc.returncode == 0 and not args.no_store_smoke:
+        rc = _store_smoke(env)
         if rc:
             return rc
     return proc.returncode
@@ -398,6 +404,60 @@ def _gang_serve_smoke(env) -> int:
         print("gang smoke: FAILED")
         return 1
     print(f"gang smoke: ok {proc.stdout.strip().splitlines()[-1]}")
+    return 0
+
+
+def _store_smoke(env) -> int:
+    """Content-store smoke in a child (JAX_PLATFORMS=cpu): two checkpoint
+    generations that share a leaf publish through the store (the second
+    save must be a dedup hit, not a second copy), load back bit-identical,
+    GC after deleting generation 1 reclaims only its unique blobs, and
+    verify re-hashes clean — the store/ contract, gated like a lint
+    finding."""
+    code = (
+        "import json, os, tempfile\n"
+        "import numpy as np\n"
+        "from distributed_machine_learning_tpu import store\n"
+        "from distributed_machine_learning_tpu.ckpt import format as fmt\n"
+        "root = tempfile.mkdtemp(prefix='store_smoke_')\n"
+        "tree1 = {'w': np.arange(4096, dtype=np.float32),\n"
+        "         'b': np.ones(512, np.float32)}\n"
+        "tree2 = {'w': tree1['w'],  # unchanged -> dedup hit\n"
+        "         'b': np.full(512, 2.0, np.float32)}\n"
+        "g1 = os.path.join(root, 'gen_000001')\n"
+        "g2 = os.path.join(root, 'gen_000002')\n"
+        "before = store.get_metrics().snapshot()\n"
+        "fmt.save_sharded(g1, tree1)\n"
+        "fmt.save_sharded(g2, tree2)\n"
+        "d = store.get_metrics().delta_since(before)\n"
+        "assert d['dedup_hits'] > 0, d\n"
+        "assert d['bytes_physical'] < d['bytes_logical'], d\n"
+        "got = fmt.load_sharded(g2)\n"
+        "assert np.array_equal(np.asarray(got['w']), tree2['w'])\n"
+        "assert np.array_equal(np.asarray(got['b']), tree2['b'])\n"
+        "cas = store.get_store(store.store_root_for(g1))\n"
+        "fmt.delete_generation(g1)\n"
+        "swept = cas.gc()\n"
+        "assert swept['collected'] > 0 and swept['retained'] > 0, swept\n"
+        "got = fmt.load_sharded(g2)  # survivor still loads post-GC\n"
+        "assert np.array_equal(np.asarray(got['b']), tree2['b'])\n"
+        "checked = cas.verify()\n"
+        "assert not checked['corrupt'], checked\n"
+        "print(json.dumps({'dedup_hits': d['dedup_hits'],\n"
+        "                  'bytes_logical': d['bytes_logical'],\n"
+        "                  'bytes_physical': d['bytes_physical'],\n"
+        "                  'gc_collected': swept['collected'],\n"
+        "                  'verified_blobs': checked['blobs']}))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO, capture_output=True, text=True, env=env, timeout=300,
+    )
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr)
+        print("store smoke: FAILED")
+        return 1
+    print(f"store smoke: ok {proc.stdout.strip().splitlines()[-1]}")
     return 0
 
 

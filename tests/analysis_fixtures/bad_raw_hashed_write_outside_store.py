@@ -1,0 +1,31 @@
+# dmlint-scope: cas-path
+"""Historical risk pattern (ISSUE 20 satellite): a CAS-path module
+hand-rolling content addressing — sha256 the payload, then write it to
+a digest-named file itself.  Bytes published this way bypass the
+``store/`` layer entirely: dedup accounting never sees them, nothing
+pins them against the GC-vs-writer race, the write is neither
+first-publish-wins nor fsync'd, and the reachability GC can neither
+retain nor reclaim them.  This is exactly the scheme the checkpoint
+chunk writer, compile-artifact registry, and dataset cache each grew
+independently before they were migrated onto one content store."""
+
+import hashlib
+import os
+
+
+def publish_chunk(root, data):
+    """Digest-named blob written with a raw open(..., 'wb')."""
+    digest = hashlib.sha256(data).hexdigest()
+    path = os.path.join(root, "blobs", digest[:2], digest)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:  # EXPECT: raw-hashed-write-outside-store
+        f.write(data)
+    return digest
+
+
+def publish_via_backend(backend, root, data):
+    """Same scheme over a storage backend: still a parallel store."""
+    digest = hashlib.sha256(data).hexdigest()
+    dest = backend.join(root, f"chunk_{digest[:16]}")
+    backend.write_bytes(dest, data)  # EXPECT: raw-hashed-write-outside-store
+    return digest

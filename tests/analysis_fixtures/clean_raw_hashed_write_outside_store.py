@@ -1,0 +1,46 @@
+# dmlint-scope: cas-path
+"""Idiomatic twins of bad_raw_hashed_write_outside_store.py: artifact
+bytes are published through the content store (``put_blob`` hashes,
+dedups, pins, and fsyncs under first-publish-wins; the manifest + ref
+make them reachable to the GC), and the shapes DML022 deliberately
+exempts — sha256 used as a read-side checksum with no write, and binary
+writes with no content addressing at all — stay silent."""
+
+import hashlib
+
+
+def publish_chunk(store, data):
+    """The sanctioned shape: the store owns hashing and placement."""
+    digest = store.put_blob(data)
+    return digest
+
+
+def publish_files(store, files, ref_name):
+    """Blobs -> manifest -> ref, digests pinned until the ref lands."""
+    with store.pin() as pin:
+        mapping = {}
+        for name, data in sorted(files.items()):
+            digest = store.put_blob(data)
+            pin.add(digest)
+            mapping[name] = digest
+        manifest = store.put_manifest({
+            "kind": "demo",
+            "files": mapping,
+            "store_chunks": sorted(set(mapping.values())),
+        })
+        pin.add(manifest)
+        store.set_ref(ref_name, manifest)
+    return mapping
+
+
+def verify_blob(store, digest):
+    """Read-side checksum: sha256 with no write is not a parallel store."""
+    data = store.get_blob(digest)
+    return data is not None and hashlib.sha256(data).hexdigest() == digest
+
+
+def spill_scratch(path, data):
+    """A binary write with no sha256 anywhere in scope: plain file I/O
+    (scratch spills, logs) is not content addressing."""
+    with open(path, "wb") as f:
+        f.write(data)

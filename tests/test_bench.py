@@ -52,6 +52,7 @@ def _capture_file_in_tmp(monkeypatch, tmp_path):
     monkeypatch.setenv("DML_BENCH_STREAMING", "0")
     monkeypatch.setenv("DML_BENCH_ONLINE_LOOP", "0")
     monkeypatch.setenv("DML_BENCH_HEAD_RECOVERY", "0")
+    monkeypatch.setenv("DML_BENCH_STORE", "0")
 
 
 def _detail() -> dict:
@@ -113,6 +114,16 @@ _HEAD_RECOVERY_STUB = {
     "resume_total_s": 1.7, "decisions_journaled": 29,
     "head_incarnations": 2, "best_matches_control": True,
     "committed": True,
+}
+
+# What the store child emits, for parent-flow stubs (the child itself
+# runs for real in test_child_store_end_to_end_tiny).
+_STORE_STUB = {
+    "bytes_logical": 2642047, "bytes_physical": 753023,
+    "dedup_ratio": 0.285, "dedup_hits": 209, "pbt_dedup_hits": 17,
+    "pass_half": True, "cas_save_s": 0.07, "legacy_save_s": 0.004,
+    "export_refcopy_s": 0.002, "export_legacy_s": 0.004,
+    "export_param_blob_writes": 0, "export_chunks": 2,
 }
 
 
@@ -365,12 +376,15 @@ def test_main_cpu_fallback_emit_fields(monkeypatch, capsys):
             return 0, json.dumps(_ONLINE_LOOP_STUB), "", True
         if args[:2] == ["--child", "head_recovery"]:
             return 0, json.dumps(_HEAD_RECOVERY_STUB), "", True
+        if args[:2] == ["--child", "store"]:
+            return 0, json.dumps(_STORE_STUB), "", True
         raise AssertionError(f"unexpected child {args}")
 
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
     monkeypatch.setenv("DML_BENCH_STREAMING", "1")
     monkeypatch.setenv("DML_BENCH_ONLINE_LOOP", "1")
     monkeypatch.setenv("DML_BENCH_HEAD_RECOVERY", "1")
+    monkeypatch.setenv("DML_BENCH_STORE", "1")
     monkeypatch.delenv("DML_TUNNEL_PYTHONPATH", raising=False)
     # A banked chip capture exists (as in the real repo) -> the reference
     # backend is tpu and a CPU fallback is cross-backend.
@@ -440,6 +454,14 @@ def test_main_cpu_fallback_emit_fields(monkeypatch, capsys):
     assert line["head_recovery"]["best_matches_control"] is True
     assert line["head_recovery"]["replay_s"] == 0.027
     assert "streaming_s" in detail["phases"]
+    # store section (ISSUE 20): dedup + ref-copy evidence in the sidecar,
+    # compact acceptance claims in the emitted line.
+    assert detail["store"]["bytes_physical"] < detail["store"][
+        "bytes_logical"]
+    assert "store_s" in detail["phases"]
+    assert line["store"]["pass_half"] is True
+    assert line["store"]["dedup_ratio"] == 0.285
+    assert line["store"]["export_param_blob_writes"] == 0
 
 
 def _sweep_stub(dtype, tph):
@@ -1351,6 +1373,21 @@ def test_child_head_recovery_end_to_end_tiny(capsys):
     assert out["head_incarnations"] == 2
     assert out["detect_s"] >= 0 and out["replay_s"] >= 0
     assert out["resume_total_s"] > 0
+
+
+def test_child_store_end_to_end_tiny(capsys, monkeypatch):
+    """child_store for real: the generation chain + PBT exploits dedup
+    past the <0.5x acceptance bar, and the ref-copy export moves zero
+    parameter-chunk bytes."""
+    monkeypatch.delenv("DML_STORE_ROOT", raising=False)
+    bench.child_store()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["pass_half"] is True
+    assert out["bytes_physical"] < 0.5 * out["bytes_logical"]
+    assert out["dedup_hits"] > 0 and out["pbt_dedup_hits"] > 0
+    assert out["export_param_blob_writes"] == 0
+    assert out["export_chunks"] == 2  # w + b
+    assert out["export_refcopy_s"] >= 0
 
 
 def test_multihost_section_cpu_and_tunnel_skip_with_reason(monkeypatch):

@@ -40,6 +40,27 @@ def _tree():
     }
 
 
+def _chunk_payload_paths(gen):
+    """Local paths of every chunk payload of a generation — content-store
+    blob files in CAS mode, ``*.chunk`` files in the legacy layout."""
+    with open(os.path.join(gen, fmt.INDEX_NAME)) as f:
+        index = json.load(f)
+    root = (index.get("store") or {}).get("root")
+    out = []
+    for leaf in index["leaves"]:
+        if leaf.get("literal"):
+            continue
+        for rec in leaf["chunks"]:
+            if rec.get("blobs"):
+                out.extend(
+                    os.path.join(root, "blobs", b["h"][:2], b["h"])
+                    for b in rec["blobs"]
+                )
+            else:
+                out.append(os.path.join(gen, rec["file"]))
+    return out
+
+
 # --------------------------------------------------------------------------
 # format
 # --------------------------------------------------------------------------
@@ -69,7 +90,10 @@ def test_sharded_roundtrip_matches_msgpack_container_shapes(tmp_path):
     assert np.array_equal(a["params"]["w"], b["params"]["w"])
 
 
-def test_commit_protocol_order_and_contents(tmp_path):
+def test_commit_protocol_order_and_contents(tmp_path, monkeypatch):
+    # The LEGACY chunk-file layout (still what multi-process saves write):
+    # opt out of the content store for this generation.
+    monkeypatch.setenv("DML_STORE_CKPT", "0")
     gen = str(tmp_path / "gen_000002")
     fmt.save_sharded(gen, _tree())
     names = sorted(os.listdir(gen))
@@ -97,6 +121,58 @@ def test_commit_protocol_order_and_contents(tmp_path):
     assert w.tobytes() in chunk_bytes
 
 
+def test_commit_protocol_cas_layout(tmp_path):
+    """The default (content-addressed) layout: chunk payloads live as
+    blobs in the sibling store, the generation directory holds only
+    index + COMMIT, and a ``ckpt-*`` ref makes the generation a GC root."""
+    from distributed_machine_learning_tpu import store as store_lib
+
+    gen = str(tmp_path / "gen_000002")
+    fmt.save_sharded(gen, _tree())
+    names = sorted(os.listdir(gen))
+    assert names == [fmt.COMMIT_NAME, fmt.INDEX_NAME]  # no chunk files
+    with open(os.path.join(gen, fmt.COMMIT_NAME)) as f:
+        commit = json.load(f)
+    with open(os.path.join(gen, fmt.INDEX_NAME), "rb") as f:
+        index_raw = f.read()
+    import hashlib
+
+    assert commit["index_sha256"] == hashlib.sha256(index_raw).hexdigest()
+    index = json.loads(index_raw)
+    root = index["store"]["root"]
+    assert root == str(tmp_path / ".cas")
+    # Every non-literal chunk names its blobs; the blob bytes ARE the raw
+    # array bytes (still no pickle anywhere).
+    payloads = set()
+    for leaf in index["leaves"]:
+        if leaf.get("literal"):
+            continue
+        for rec in leaf["chunks"]:
+            assert rec["sha256"] and rec["nbytes"] > 0
+            assert rec["blobs"]
+            joined = b"".join(
+                open(os.path.join(root, "blobs", b["h"][:2], b["h"]),
+                     "rb").read()
+                for b in rec["blobs"]
+            )
+            assert hashlib.sha256(joined).hexdigest() == rec["sha256"]
+            payloads.add(joined)
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    assert w.tobytes() in payloads
+    # The generation is a GC root: its ref resolves to a manifest whose
+    # store_chunks cover every blob the index names.
+    cas = store_lib.get_store(root)
+    ref = cas.read_ref(store_lib.ref_name_for_path("ckpt", gen))
+    assert ref is not None
+    manifest = cas.read_manifest(ref["manifest"])
+    named = {
+        b["h"]
+        for leaf in index["leaves"] if not leaf.get("literal")
+        for rec in leaf["chunks"] for b in rec["blobs"]
+    }
+    assert named <= set(manifest[store_lib.MANIFEST_CHUNKS_KEY])
+
+
 def test_uncommitted_generation_is_invisible_and_cleaned(tmp_path):
     d = str(tmp_path)
     fmt.save_sharded(os.path.join(d, "gen_000001"), {"x": np.ones(2)})
@@ -118,12 +194,15 @@ def test_uncommitted_generation_is_invisible_and_cleaned(tmp_path):
 
 def test_chunk_corruption_detected_and_falls_back(tmp_path):
     d = str(tmp_path)
-    fmt.save_sharded(os.path.join(d, "gen_000001"), {"x": np.ones(4)})
+    g1 = os.path.join(d, "gen_000001")
+    fmt.save_sharded(g1, {"x": np.ones(4)})
     g2 = os.path.join(d, "gen_000002")
     fmt.save_sharded(g2, {"x": np.full(4, 2.0)})
+    # Damage a chunk payload OWNED by gen 2 (content addressing can share
+    # payloads across generations; the fallback generation must stay clean).
     chunk = next(
-        os.path.join(g2, n) for n in os.listdir(g2)
-        if n.endswith(fmt.CHUNK_SUFFIX)
+        p for p in _chunk_payload_paths(g2)
+        if p not in set(_chunk_payload_paths(g1))
     )
     with open(chunk, "rb") as f:
         damaged = chaos.corrupt_bytes(f.read())
@@ -215,7 +294,11 @@ def test_async_save_error_surfaces_on_next_save(tmp_path):
             self.inner = inner
 
         def write_bytes(self, path, data):
-            if fail["on"] and path.endswith(fmt.CHUNK_SUFFIX):
+            # Chunk payloads in either layout: legacy chunk files or
+            # content-store blob publishes.
+            if fail["on"] and (
+                path.endswith(fmt.CHUNK_SUFFIX) or "/blobs/" in path
+            ):
                 raise RuntimeError("disk gone")
             return self.inner.write_bytes(path, data)
 
@@ -267,7 +350,13 @@ def test_async_overlap_counters_are_step_based(tmp_path):
             self.inner = inner
 
         def write_bytes(self, path, data):
-            if "gen_000001" in path and path.endswith(fmt.CHUNK_SUFFIX):
+            # Gate the generation's payload-bearing write in either
+            # layout: its chunk files (legacy) or its index (CAS mode,
+            # where blob paths are content-named, not generation-named).
+            if "gen_000001" in path and (
+                path.endswith(fmt.CHUNK_SUFFIX)
+                or path.endswith(fmt.INDEX_NAME)
+            ):
                 blocked.set()
                 assert release.wait(30)
             return self.inner.write_bytes(path, data)

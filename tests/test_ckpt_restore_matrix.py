@@ -37,6 +37,27 @@ def _clean():
     storage_lib.set_fault_wrapper(None)
 
 
+def _chunk_payload_paths(gen):
+    """Local paths of every chunk payload of a generation — content-store
+    blob files in CAS mode, ``*.chunk`` files in the legacy layout."""
+    with open(os.path.join(gen, fmt.INDEX_NAME)) as f:
+        index = json.load(f)
+    root = (index.get("store") or {}).get("root")
+    out = []
+    for leaf in index["leaves"]:
+        if leaf.get("literal"):
+            continue
+        for rec in leaf["chunks"]:
+            if rec.get("blobs"):
+                out.extend(
+                    os.path.join(root, "blobs", b["h"][:2], b["h"])
+                    for b in rec["blobs"]
+                )
+            else:
+                out.append(os.path.join(gen, rec["file"]))
+    return out
+
+
 def _tree(offset: float):
     return {
         "params": {
@@ -103,9 +124,11 @@ def test_restore_matrix(tmp_path, source, target, state):
     else:
         fmt.save_sharded(g2, _place(_tree(2.0), source))
     if state == "chunk_corrupt":
+        # A payload OWNED by gen 2 (content addressing shares identical
+        # payloads across generations; the fallback must stay clean).
         chunk = next(
-            os.path.join(g2, n) for n in sorted(os.listdir(g2))
-            if n.endswith(fmt.CHUNK_SUFFIX)
+            p for p in sorted(_chunk_payload_paths(g2))
+            if p not in set(_chunk_payload_paths(g1))
         )
         with open(chunk, "rb") as f:
             damaged = chaos.corrupt_bytes(f.read())
@@ -139,7 +162,9 @@ def test_resharded_restore_reads_only_needed_chunks(tmp_path):
         NamedSharding(mesh, P("dp")),
     )
     fmt.save_sharded(d, {"w": arr})
-    assert len([n for n in os.listdir(d) if n.endswith(".chunk")]) == 8
+    # One payload per dp shard, whichever layout wrote them.
+    payloads = _chunk_payload_paths(d)
+    assert len(payloads) == 8
     reads = []
 
     class Spy(storage_lib.StorageBackend):
@@ -150,7 +175,9 @@ def test_resharded_restore_reads_only_needed_chunks(tmp_path):
             return self.inner.write_bytes(path, data)
 
         def read_bytes(self, path):
-            if path.endswith(fmt.CHUNK_SUFFIX):
+            # Chunk payload reads in either layout (chunk files or
+            # content-store blobs).
+            if path.endswith(fmt.CHUNK_SUFFIX) or "/blobs/" in path:
                 reads.append(os.path.basename(path))
             return self.inner.read_bytes(path)
 
@@ -199,7 +226,13 @@ def test_async_save_overlaps_training_steps_e2e(tmp_path):
             self.inner = inner
 
         def write_bytes(self, path, data):
-            if "gen_000002" in path and path.endswith(fmt.CHUNK_SUFFIX):
+            # The generation's payload-bearing write in either layout:
+            # its chunk files (legacy) or its index (CAS mode, where
+            # blob paths are content-named, not generation-named).
+            if "gen_000002" in path and (
+                path.endswith(fmt.CHUNK_SUFFIX)
+                or path.endswith(fmt.INDEX_NAME)
+            ):
                 assert release.wait(60), "gate never opened"
             return self.inner.write_bytes(path, data)
 

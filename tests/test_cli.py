@@ -246,3 +246,61 @@ def test_loop_status_subcommand(tmp_path):
     assert proc.returncode == 1
     assert "cannot read journal" in proc.stderr
     assert "Traceback" not in proc.stderr
+
+
+def test_store_subcommand(tmp_path):
+    """`store {stats,verify,gc}`: dedup-aware stats, dry-run-by-default
+    GC (nothing deleted until --run), and verify exiting 1 with the
+    corrupt digest named."""
+    from distributed_machine_learning_tpu import store as store_lib
+
+    root = str(tmp_path / ".cas")
+    cas = store_lib.get_store(root)
+    keep = cas.put_blob(b"keep me" * 64)
+    cas.put_blob(b"keep me" * 64)  # dedup hit, no new blob
+    cas.put_blob(b"drop me" * 64)  # never referenced -> GC fodder
+    manifest = cas.put_manifest({
+        "kind": "demo",
+        store_lib.MANIFEST_CHUNKS_KEY: [keep],
+    })
+    cas.set_ref("demo-ref", manifest)
+
+    proc = _run(["store", "stats", root, "--json"], timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["blobs"] == 3  # keep + drop + the manifest blob
+    assert out["refs"] == 1
+
+    # A served directory resolves to its .cas sibling, same as writers.
+    proc = _run(["store", "stats", str(tmp_path), "--json"], timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(proc.stdout)["root"].endswith(".cas")
+
+    # GC defaults to a dry run: reports the unreachable blob, deletes
+    # nothing.
+    proc = _run(["store", "gc", root, "--json"], timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["dry_run"] is True
+    assert out["collected"] == 1 and out["retained"] == 2
+    assert json.loads(_run(["store", "stats", root, "--json"],
+                           timeout=60).stdout)["blobs"] == 3
+
+    proc = _run(["store", "gc", root, "--run", "--json"], timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["dry_run"] is False and out["collected"] == 1
+    assert json.loads(_run(["store", "stats", root, "--json"],
+                           timeout=60).stdout)["blobs"] == 2
+
+    proc = _run(["store", "verify", root], timeout=60)
+    assert proc.returncode == 0, proc.stderr
+
+    # Bit-rot a live blob: verify names the digest and exits 1.
+    blob_path = os.path.join(root, "blobs", keep[:2], keep)
+    with open(blob_path, "wb") as f:
+        f.write(b"rotten")
+    proc = _run(["store", "verify", root], timeout=60)
+    assert proc.returncode == 1
+    assert keep in proc.stdout
+    assert "Traceback" not in proc.stderr

@@ -1,0 +1,360 @@
+"""The content-addressed store (store/): blobs, refs, manifests, GC.
+
+Core contracts: content-keyed dedup (second publish of the same bytes
+moves nothing), pin-then-scan GC that never collects a live or in-flight
+blob, chaos hooks (a corrupted blob publish is caught by verify / a
+verifying read; a kill during a ref flip leaves the OLD ref intact), and
+the dedup accounting on the two write patterns the store exists for — a
+keep-K generation chain and a PBT population whose exploits copy donor
+rows.  Plus the export acceptance: exporting a committed sharded
+generation is a metadata move, ZERO parameter-chunk writes
+(counter-verified), and a chaos-faulted sharded sweep under the new
+store hooks finds the same best trial as a fault-free control.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_machine_learning_tpu import chaos, serve, store, tune
+from distributed_machine_learning_tpu.ckpt import format as fmt
+from distributed_machine_learning_tpu.data import dummy_regression_data
+from distributed_machine_learning_tpu.tune import storage as storage_lib
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv(store.ROOT_ENV_VAR, raising=False)
+    monkeypatch.delenv(store.ENABLE_ENV_VAR, raising=False)
+    yield
+    chaos.deactivate()
+    storage_lib.set_fault_wrapper(None)
+
+
+# --------------------------------------------------------------------------
+# core: blobs, manifests, refs, stats
+# --------------------------------------------------------------------------
+
+
+def test_blob_roundtrip_and_dedup_counters(tmp_path):
+    cas = store.get_store(str(tmp_path / ".cas"))
+    before = store.get_metrics().snapshot()
+    payload = b"the same bytes" * 100
+    d1 = cas.put_blob(payload)
+    d2 = cas.put_blob(payload)
+    assert d1 == d2
+    assert cas.get_blob(d1, verify=True) == payload
+    delta = store.get_metrics().delta_since(before)
+    assert delta["puts"] == 2
+    assert delta["dedup_hits"] == 1
+    # Physical bytes moved once; logical counted twice.
+    assert delta["bytes_logical"] == 2 * len(payload)
+    assert delta["bytes_physical"] == len(payload)
+    # The blob lands under blobs/<hh>/<digest> — fanout dir matches.
+    local = cas.local_blob_path(d1)
+    assert local and os.path.basename(os.path.dirname(local)) == d1[:2]
+
+
+def test_manifest_requires_chunk_list_and_refs_resolve(tmp_path):
+    cas = store.get_store(str(tmp_path / ".cas"))
+    blob = cas.put_blob(b"chunk bytes")
+    with pytest.raises(ValueError):
+        cas.put_manifest({"kind": "broken"})  # no store_chunks list
+    man = cas.put_manifest({
+        "kind": "demo", store.MANIFEST_CHUNKS_KEY: [blob],
+    })
+    cas.set_ref("demo-ref", man, meta={"path": "/x"})
+    doc = cas.read_ref("demo-ref")
+    assert doc["manifest"] == man
+    assert doc["meta"]["path"] == "/x"
+    assert cas.read_manifest(man)[store.MANIFEST_CHUNKS_KEY] == [blob]
+    assert "demo-ref" in cas.list_refs()
+    with pytest.raises(ValueError):
+        cas.set_ref("../escape", man)  # ref names are flat
+
+
+def test_gc_collects_unreachable_retains_referenced(tmp_path):
+    cas = store.get_store(str(tmp_path / ".cas"))
+    live = cas.put_blob(b"live bytes" * 10)
+    dead = cas.put_blob(b"dead bytes" * 10)
+    man = cas.put_manifest({
+        "kind": "demo", store.MANIFEST_CHUNKS_KEY: [live],
+    })
+    cas.set_ref("keep", man)
+    dry = cas.gc(dry_run=True)
+    assert dry["dry_run"] is True and dry["collected"] == 1
+    assert cas.get_blob(dead) is not None  # dry run deleted nothing
+    swept = cas.gc()
+    assert swept["collected"] == 1 and swept["retained"] == 2
+    assert cas.get_blob(dead) is None
+    assert cas.get_blob(live, verify=True) is not None
+    # Dropping the ref makes everything collectable.
+    cas.delete_ref("keep")
+    assert cas.gc()["collected"] == 2
+
+
+def test_gc_vs_writer_race_pins_protect_inflight_blobs(tmp_path):
+    """Pin-then-scan: a publish whose ref has not landed yet survives a
+    concurrent sweep — its digests are pinned until the session closes."""
+    cas = store.get_store(str(tmp_path / ".cas"))
+    with cas.pin() as pin:
+        d = cas.put_blob(b"in flight, no ref yet" * 8)
+        pin.add(d)
+        swept = cas.gc()  # GC races the writer mid-publish
+        assert swept["collected"] == 0
+        assert swept["retained"] == 1
+        assert cas.get_blob(d, verify=True) is not None
+    # Writer abandoned (pin released, no ref): now it IS garbage.
+    assert cas.gc()["collected"] == 1
+
+
+# --------------------------------------------------------------------------
+# chaos hooks
+# --------------------------------------------------------------------------
+
+
+def test_chaos_blob_corruption_caught_by_verify_and_verifying_read(
+    tmp_path,
+):
+    cas = store.get_store(str(tmp_path / ".cas"))
+    plan = chaos.FaultPlan(seed=3, blob_corrupt_on_publish=1)
+    with chaos.active(plan):
+        bad = cas.put_blob(b"will be corrupted on publish" * 16)
+        good = cas.put_blob(b"lands intact" * 16)
+    assert plan.snapshot()["blob_corruptions"] == 1
+    checked = cas.verify()
+    assert checked["blobs"] == 2
+    assert checked["corrupt"] == [bad]
+    with pytest.raises(store.StoreCorruptionError):
+        cas.get_blob(bad, verify=True)
+    assert cas.get_blob(good, verify=True) is not None
+
+
+def test_chaos_kill_during_ref_flip_preserves_old_ref(tmp_path):
+    cas = store.get_store(str(tmp_path / ".cas"))
+    b1 = cas.put_blob(b"generation one")
+    m1 = cas.put_manifest({
+        "kind": "demo", store.MANIFEST_CHUNKS_KEY: [b1],
+    })
+    cas.set_ref("head", m1)
+    b2 = cas.put_blob(b"generation two")
+    m2 = cas.put_manifest({
+        "kind": "demo", store.MANIFEST_CHUNKS_KEY: [b2],
+    })
+    plan = chaos.FaultPlan(seed=3, kill_during_ref_flip=["head"])
+    with chaos.active(plan):
+        with pytest.raises(chaos.InjectedRefFlipKill):
+            cas.set_ref("head", m2)
+        assert plan.snapshot()["ref_flip_kills"] == 1
+        # The kill fires BEFORE any bytes move: the old ref is intact,
+        # not torn, and still resolves to generation one.
+        assert cas.read_ref("head")["manifest"] == m1
+        # The entry fired once — the retried flip goes through.
+        cas.set_ref("head", m2)
+    assert cas.read_ref("head")["manifest"] == m2
+
+
+# --------------------------------------------------------------------------
+# dedup accounting on the motivating write patterns
+# --------------------------------------------------------------------------
+
+
+def test_generation_chain_dedups_unchanged_rows(tmp_path, monkeypatch):
+    """4-generation keep-K chain, one row updated per generation: the
+    unchanged pieces dedup, physical stays well under logical, and every
+    generation restores bit-identical."""
+    monkeypatch.setenv("DML_STORE_CHUNK_BYTES", "2048")
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((256, 32)).astype(np.float32)
+    b = rng.standard_normal(32).astype(np.float32)
+    trees = []
+    for gen in range(4):
+        w = w.copy()
+        w[gen] += 1.0
+        trees.append({"params": {"w": w, "b": b}})
+    before = store.get_metrics().snapshot()
+    for i, tree in enumerate(trees):
+        fmt.save_sharded(str(tmp_path / f"gen_{i + 1:06d}"), tree)
+    delta = store.get_metrics().delta_since(before)
+    assert delta["dedup_hits"] > 0
+    assert delta["bytes_physical"] < 0.5 * delta["bytes_logical"]
+    for i, tree in enumerate(trees):
+        got = fmt.load_sharded(str(tmp_path / f"gen_{i + 1:06d}"))
+        assert np.asarray(got["params"]["w"]).tobytes() == \
+            tree["params"]["w"].tobytes()
+        assert np.asarray(got["params"]["b"]).tobytes() == \
+            tree["params"]["b"].tobytes()
+
+
+def test_pbt_population_shares_donor_row_bytes(tmp_path, monkeypatch):
+    """3-exploit PBT population: each exploit copies a donor member's
+    rows, so the copied bytes hash to blobs that already exist — dedup
+    both across saves (unchanged members) and within one save (dst ==
+    src member)."""
+    monkeypatch.setenv("DML_STORE_CHUNK_BYTES", "2048")
+    rng = np.random.default_rng(1)
+    pop = rng.standard_normal((6, 16, 64)).astype(np.float32)
+    before = store.get_metrics().snapshot()
+    for step, (dst, src) in enumerate([(2, 0), (4, 1), (5, 0)]):
+        pop = pop.copy()
+        pop[dst] = pop[src]
+        fmt.save_sharded(
+            str(tmp_path / f"gen_{step + 1:06d}"), {"pop": pop}
+        )
+    delta = store.get_metrics().delta_since(before)
+    assert delta["dedup_hits"] > 0
+    assert delta["bytes_physical"] < 0.5 * delta["bytes_logical"]
+    got = fmt.load_sharded(str(tmp_path / "gen_000003"))
+    assert np.asarray(got["pop"]).tobytes() == pop.tobytes()
+
+
+def test_ref_copy_export_moves_no_param_bytes(tmp_path):
+    """ref_copy_subtree publishes a committed generation whose chunk
+    table names the SOURCE's blobs: one manifest blob is the only new
+    physical write, and the copy survives source deletion + GC."""
+    rng = np.random.default_rng(2)
+    tree = {"params": {"w": rng.standard_normal((64, 8)).astype(
+        np.float32)}, "opt": {"mu": np.zeros(8, np.float32)}}
+    src = str(tmp_path / "ck" / "gen_000001")
+    fmt.save_sharded(src, tree)
+    dst = str(tmp_path / "export" / "params.cas")
+    before = store.get_metrics().snapshot()
+    out = fmt.ref_copy_subtree(src, dst)
+    delta = store.get_metrics().delta_since(before)
+    assert out["chunks"] >= 1
+    assert delta["ref_copies"] == out["chunks"]
+    # Exactly one new blob: the ref-copy's manifest.  Zero param chunks.
+    assert delta["puts"] - delta["dedup_hits"] == 1
+    assert delta["bytes_physical"] < 4096
+    # The export keeps only the requested sub-tree, restores identically,
+    # and stays readable after the source is pruned and swept.
+    got = fmt.load_sharded(dst)
+    assert set(got) == {"params"}
+    assert np.asarray(got["params"]["w"]).tobytes() == \
+        tree["params"]["w"].tobytes()
+    fmt.delete_generation(src)
+    cas = store.get_store(out["store_root"])
+    cas.gc()
+    got = fmt.load_sharded(dst)
+    assert np.asarray(got["params"]["w"]).tobytes() == \
+        tree["params"]["w"].tobytes()
+
+
+def test_export_bundle_from_sharded_source_writes_zero_param_chunks(
+    tmp_path_factory,
+):
+    """Acceptance: export_bundle from a committed sharded generation is
+    a ref-copy — counter-verified zero parameter-chunk publishes — and
+    the bundle serves bit-identically to a load of the source."""
+    tmp = str(tmp_path_factory.mktemp("store_export_src"))
+    train, val = dummy_regression_data(
+        num_samples=96, seq_len=6, num_features=4, seed=7
+    )
+    analysis = tune.run(
+        tune.with_parameters(
+            tune.train_regressor, train_data=train, val_data=val
+        ),
+        {"model": "mlp", "hidden_sizes": [16],
+         "learning_rate": tune.loguniform(1e-3, 1e-2),
+         "num_epochs": 2, "batch_size": 32, "seed": 5},
+        metric="validation_loss", mode="min", num_samples=1,
+        storage_path=tmp, name="src", verbose=0,
+        checkpoint_format="sharded",
+    )
+    best_ckpt = analysis.best_trial.latest_checkpoint
+    assert os.path.basename(best_ckpt).startswith("gen_")
+    out = str(tmp_path_factory.mktemp("store_export_out") / "bundle")
+    before = store.get_metrics().snapshot()
+    serve.export_bundle(analysis, out)
+    delta = store.get_metrics().delta_since(before)
+    assert delta["ref_copies"] > 0
+    # One manifest blob; every parameter chunk is a ref, not a write.
+    assert delta["puts"] - delta["dedup_hits"] == 1
+    assert delta["bytes_physical"] < 4096
+    bundle = serve.load_bundle(out)
+    assert bundle.manifest["params_file"] == "params.cas"
+    assert bundle.manifest["source"]["ref_copy"]["chunks"] >= 1
+    from distributed_machine_learning_tpu.tune import (
+        checkpoint as ckpt_lib,
+    )
+    import jax
+
+    ckpt_tree = ckpt_lib.load_checkpoint(best_ckpt)
+    flat_a = jax.tree_util.tree_leaves(bundle.variables["params"])
+    flat_b = jax.tree_util.tree_leaves(ckpt_tree["params"])
+    assert len(flat_a) == len(flat_b) > 0
+    for a, b in zip(flat_a, flat_b):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+# --------------------------------------------------------------------------
+# chaos-faulted sweep parity under the store hooks
+# --------------------------------------------------------------------------
+
+
+def _sweep(tmp_path, name, **over):
+    train, val = dummy_regression_data(
+        num_samples=96, seq_len=8, num_features=4
+    )
+    kw = dict(
+        metric="validation_loss", mode="min", num_samples=4,
+        max_failures=2, seed=0, storage_path=str(tmp_path), name=name,
+        verbose=0, checkpoint_format="sharded",
+    )
+    kw.update(over)
+    return tune.run(
+        tune.with_parameters(
+            tune.train_regressor, train_data=train, val_data=val
+        ),
+        {"model": "mlp", "hidden_sizes": (16,),
+         "learning_rate": tune.loguniform(1e-3, 1e-1),
+         "num_epochs": 4, "batch_size": 32, "lr_schedule": "constant"},
+        **kw,
+    )
+
+
+def test_sweep_under_store_faults_finds_same_best_trial(tmp_path):
+    """Blob corruption on publish + a kill during a trial's ref flip +
+    a trial crash: restores verify chunk hashes over blob bytes, failed
+    saves retry, and the sweep picks the SAME winner as the fault-free
+    control."""
+    storage_lib.set_default_retry_policy(
+        storage_lib.RetryPolicy(attempts=4, base_delay_s=0.005,
+                                max_delay_s=0.02)
+    )
+    try:
+        baseline = _sweep(tmp_path, "control")
+        assert baseline.num_terminated() == 4
+
+        plan = chaos.FaultPlan(
+            seed=7,
+            blob_corrupt_on_publish=1,
+            kill_during_ref_flip=["trial_00001/checkpoints"],
+            trial_crashes=[("trial_00002", 3)],
+        )
+        with chaos.active(plan):
+            chaotic = _sweep(tmp_path, "faulted")
+    finally:
+        storage_lib.set_default_retry_policy(
+            storage_lib.DEFAULT_RETRY_POLICY
+        )
+
+    snap = plan.snapshot()
+    assert snap["blob_corruptions"] == 1
+    assert snap["ref_flip_kills"] == 1
+    assert snap["trial_crashes"] == 1
+
+    assert chaotic.num_terminated() == 4
+    assert chaotic.best_trial.trial_id == baseline.best_trial.trial_id
+    assert chaotic.best_trial.config["learning_rate"] == pytest.approx(
+        baseline.best_trial.config["learning_rate"]
+    )
+    # The faulted run's artifact still verifies end to end: every
+    # committed generation restores (corrupt blob or not, the winner's
+    # chain is intact where it matters — its newest COMMITTED gen).
+    state = json.load(open(
+        os.path.join(str(tmp_path), "faulted", "experiment_state.json")
+    ))
+    assert state["checkpoint"]["saves"] >= 4
